@@ -1,0 +1,10 @@
+"""tpu-cruise: a TPU-native cluster-rebalancing framework.
+
+Capabilities of Kafka Cruise Control (reference: majun9129/cruise-control, a
+fork of linkedin/cruise-control -- see SURVEY.md), re-designed TPU-first: the
+cluster workload model is a pytree of dense tensors, balancing goals are
+vectorized feasibility masks and costs, and the rebalance plan search runs as
+a jit/vmap/shard_map program on TPU.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,167 @@
+"""Object-level builder for readable ClusterState construction.
+
+The tensor model (:mod:`cluster_state`) is the compute representation; tests,
+fixtures, and the monitor assemble clusters through this builder (the role of
+upstream ``ClusterModel.createBroker``/``createReplica`` incremental
+construction, model/ClusterModel.java) and then snapshot to dense arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import (
+    EMPTY_SLOT,
+    FOLLOWER_CPU_RATIO,
+    NUM_RESOURCES,
+    BrokerState,
+    Resource,
+)
+from cruise_control_tpu.models.cluster_state import ClusterState
+
+
+@dataclasses.dataclass
+class _Broker:
+    rack: int
+    capacity: np.ndarray
+    state: BrokerState = BrokerState.ALIVE
+
+
+@dataclasses.dataclass
+class _Partition:
+    topic: int
+    brokers: List[int]
+    leader_slot: int
+    leader_load: np.ndarray
+    follower_load: np.ndarray
+    offline: List[bool]
+
+
+class ClusterModelBuilder:
+    """Accumulates brokers/partitions, emits a dense :class:`ClusterState`."""
+
+    def __init__(self) -> None:
+        self._brokers: List[_Broker] = []
+        self._partitions: List[_Partition] = []
+        self._topics: Dict[str, int] = {}
+        self._racks: Dict[str, int] = {}
+
+    # ---- topology ---------------------------------------------------------------
+    def add_rack(self, name: str) -> int:
+        return self._racks.setdefault(name, len(self._racks))
+
+    def add_broker(
+        self,
+        rack: str | int,
+        capacity: Dict[Resource, float] | Sequence[float],
+        state: BrokerState = BrokerState.ALIVE,
+    ) -> int:
+        rack_id = self.add_rack(rack) if isinstance(rack, str) else int(rack)
+        if isinstance(capacity, dict):
+            cap = np.zeros(NUM_RESOURCES, np.float32)
+            for r, v in capacity.items():
+                cap[int(r)] = v
+        else:
+            cap = np.asarray(capacity, np.float32)
+            assert cap.shape == (NUM_RESOURCES,)
+        self._brokers.append(_Broker(rack_id, cap, state))
+        return len(self._brokers) - 1
+
+    def topic_id(self, topic: str) -> int:
+        return self._topics.setdefault(topic, len(self._topics))
+
+    def add_partition(
+        self,
+        topic: str,
+        brokers: Sequence[int],
+        leader_load: Dict[Resource, float] | Sequence[float],
+        follower_load: Optional[Dict[Resource, float] | Sequence[float]] = None,
+        leader_slot: int = 0,
+        offline: Optional[Sequence[bool]] = None,
+    ) -> int:
+        def vec(x):
+            if isinstance(x, dict):
+                out = np.zeros(NUM_RESOURCES, np.float32)
+                for r, v in x.items():
+                    out[int(r)] = v
+                return out
+            return np.asarray(x, np.float32)
+
+        # Default follower load per upstream semantics: replicates bytes-in
+        # and disk, serves no bytes-out, and costs a fraction of leader CPU.
+        ll = vec(leader_load)
+        if follower_load is None:
+            fl = ll.copy()
+            fl[Resource.NW_OUT] = 0.0
+            fl[Resource.CPU] = ll[Resource.CPU] * FOLLOWER_CPU_RATIO
+        else:
+            fl = vec(follower_load)
+        self._partitions.append(
+            _Partition(
+                topic=self.topic_id(topic),
+                brokers=list(brokers),
+                leader_slot=leader_slot,
+                leader_load=ll,
+                follower_load=fl,
+                offline=list(offline) if offline is not None else [False] * len(brokers),
+            )
+        )
+        return len(self._partitions) - 1
+
+    def set_broker_state(self, broker: int, state: BrokerState) -> None:
+        self._brokers[broker].state = state
+
+    # ---- snapshot ---------------------------------------------------------------
+    def build(self) -> ClusterState:
+        num_b = len(self._brokers)
+        num_p = len(self._partitions)
+        max_rf = max((len(p.brokers) for p in self._partitions), default=1)
+
+        assignment = np.full((num_p, max_rf), EMPTY_SLOT, np.int32)
+        leader_slot = np.zeros(num_p, np.int32)
+        leader_load = np.zeros((num_p, NUM_RESOURCES), np.float32)
+        follower_load = np.zeros((num_p, NUM_RESOURCES), np.float32)
+        topic = np.zeros(num_p, np.int32)
+        offline = np.zeros((num_p, max_rf), bool)
+
+        for i, part in enumerate(self._partitions):
+            assignment[i, : len(part.brokers)] = part.brokers
+            leader_slot[i] = part.leader_slot
+            leader_load[i] = part.leader_load
+            follower_load[i] = part.follower_load
+            topic[i] = part.topic
+            offline[i, : len(part.brokers)] = part.offline
+
+        # Dead brokers' replicas are offline by construction (upstream
+        # ClusterModel marks replicas on dead brokers as immigrants to move).
+        dead = np.array(
+            [b.state in (BrokerState.DEAD, BrokerState.REMOVED) for b in self._brokers]
+        )
+        if dead.any():
+            on_dead = np.isin(assignment, np.nonzero(dead)[0])
+            offline |= on_dead
+
+        return ClusterState(
+            assignment=jnp.asarray(assignment),
+            leader_slot=jnp.asarray(leader_slot),
+            leader_load=jnp.asarray(leader_load),
+            follower_load=jnp.asarray(follower_load),
+            partition_topic=jnp.asarray(topic),
+            broker_capacity=jnp.asarray(
+                np.stack([b.capacity for b in self._brokers])
+                if self._brokers
+                else np.zeros((0, NUM_RESOURCES), np.float32)
+            ),
+            broker_rack=jnp.asarray(
+                np.array([b.rack for b in self._brokers], np.int32)
+            ),
+            broker_state=jnp.asarray(
+                np.array([int(b.state) for b in self._brokers], np.int8)
+            ),
+            replica_offline=jnp.asarray(offline),
+            num_topics=max(len(self._topics), 1),
+        )

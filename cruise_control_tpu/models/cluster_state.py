@@ -1,0 +1,341 @@
+"""Dense-tensor cluster model — the TPU-native ``ClusterModel``.
+
+Re-expresses the reference's mutable object graph (upstream
+``cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/model/ClusterModel.java``
+— racks → brokers → replicas with per-entity ``Load`` roll-ups; SURVEY.md §2.4)
+as an immutable pytree of dense arrays, so the analyzer's inner loop becomes
+vectorized tensor algebra instead of pointer-chasing:
+
+* ``assignment[p, s]``       int32   broker id hosting replica slot ``s`` of
+                                     partition ``p`` (``EMPTY_SLOT`` = -1 pads
+                                     partitions with RF below the slot axis).
+* ``leader_slot[p]``         int32   which slot currently leads partition ``p``.
+* ``leader_load[p, r]``      float32 per-resource load the *leader* replica puts
+                                     on its broker.
+* ``follower_load[p, r]``    float32 per-resource load each *follower* replica
+                                     puts on its broker (NW_OUT ≈ 0, CPU scaled
+                                     — computed upstream by the monitor's
+                                     linear model, here supplied by the
+                                     monitor/generators).
+* ``partition_topic[p]``     int32   topic id (for topic-scoped goals).
+* ``broker_capacity[b, r]``  float32 per-broker resource capacity.
+* ``broker_rack[b]``         int32   rack id.
+* ``broker_state[b]``        int8    :class:`BrokerState`.
+* ``replica_offline[p, s]``  bool    replica lives on a broken disk / dead
+                                     broker and must be evacuated.
+
+The upstream mutators ``relocateReplica`` / ``relocateLeadership`` become pure
+functions (:func:`apply_move`, :func:`apply_leadership`, :func:`apply_swap`)
+returning a new state — one ``.at[].set``; the expensive per-broker load
+roll-up upstream keeps incrementally is a single segment-sum here
+(:func:`broker_load`), which XLA turns into one scatter-add over the MXU-fed
+arrays.  All shapes are static (P, S, B, T fixed per compilation), so every
+function is jit/vmap/shard_map-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cruise_control_tpu.common.resources import (
+    EMPTY_SLOT,
+    NUM_RESOURCES,
+    BrokerState,
+)
+
+
+@struct.dataclass
+class ClusterState:
+    """Immutable snapshot of a cluster's placement + workload.
+
+    Static (non-pytree) metadata: ``num_topics`` — needed for one-hot
+    topic reductions with static output shapes.
+    """
+
+    assignment: jax.Array      # int32 [P, S]
+    leader_slot: jax.Array     # int32 [P]
+    leader_load: jax.Array     # f32   [P, R]
+    follower_load: jax.Array   # f32   [P, R]
+    partition_topic: jax.Array # int32 [P]
+    broker_capacity: jax.Array # f32   [B, R]
+    broker_rack: jax.Array     # int32 [B]
+    broker_state: jax.Array    # int8  [B]
+    replica_offline: jax.Array # bool  [P, S]
+    num_topics: int = struct.field(pytree_node=False, default=0)
+
+    # ---- static shape accessors -------------------------------------------------
+    @property
+    def num_partitions(self) -> int:
+        return self.assignment.shape[0]
+
+    @property
+    def max_replication_factor(self) -> int:
+        return self.assignment.shape[1]
+
+    @property
+    def num_brokers(self) -> int:
+        return self.broker_capacity.shape[0]
+
+    @property
+    def num_racks(self) -> int:
+        # Racks are dense ids assigned at build time; max+1 is not static, so
+        # builders should pass rack ids in [0, num_brokers).  Goals that need a
+        # static rack axis use num_brokers as the upper bound.
+        return self.num_brokers
+
+    # ---- masks ------------------------------------------------------------------
+    def slot_exists(self) -> jax.Array:
+        """bool [P, S] — true where a replica actually occupies the slot."""
+        return self.assignment != EMPTY_SLOT
+
+    def replication_factor(self) -> jax.Array:
+        """int32 [P] — actual RF per partition."""
+        return jnp.sum(self.slot_exists(), axis=1).astype(jnp.int32)
+
+    def broker_alive(self) -> jax.Array:
+        """bool [B] — broker can *host* load (upstream: state != DEAD)."""
+        return (self.broker_state != BrokerState.DEAD) & (
+            self.broker_state != BrokerState.REMOVED
+        )
+
+    def broker_is_new(self) -> jax.Array:
+        return self.broker_state == jnp.int8(BrokerState.NEW)
+
+    def broker_is_demoted(self) -> jax.Array:
+        return self.broker_state == jnp.int8(BrokerState.DEMOTED)
+
+    def leader_broker(self) -> jax.Array:
+        """int32 [P] — broker id of each partition's leader."""
+        return jnp.take_along_axis(
+            self.assignment, self.leader_slot[:, None], axis=1
+        )[:, 0]
+
+
+# ---------------------------------------------------------------------------------
+# Derived loads (upstream Load roll-ups, model/Load.java + ClusterModel caches)
+# ---------------------------------------------------------------------------------
+
+def replica_load(state: ClusterState) -> jax.Array:
+    """f32 [P, S, R] — load each replica slot puts on its broker.
+
+    Leader slot carries ``leader_load``; follower slots carry
+    ``follower_load``; empty slots carry zero.
+    """
+    is_leader = (
+        jnp.arange(state.max_replication_factor)[None, :]
+        == state.leader_slot[:, None]
+    )  # [P, S]
+    load = jnp.where(
+        is_leader[:, :, None],
+        state.leader_load[:, None, :],
+        state.follower_load[:, None, :],
+    )
+    return jnp.where(state.slot_exists()[:, :, None], load, 0.0)
+
+
+def _segment_sum_by_broker(
+    values: jax.Array, assignment: jax.Array, num_brokers: int
+) -> jax.Array:
+    """Sum ``values[p, s, ...]`` into ``out[b, ...]`` grouped by ``assignment[p, s]``.
+
+    Empty slots (id -1) are routed to a dump bucket ``B`` and dropped.  This is
+    the scatter-add at the heart of the tensorized model (SURVEY.md §2.4
+    "relocateReplica ⇒ index update + two scatter-adds").
+    """
+    ids = jnp.where(assignment >= 0, assignment, num_brokers).reshape(-1)
+    flat = values.reshape((ids.shape[0],) + values.shape[2:])
+    out = jax.ops.segment_sum(flat, ids, num_segments=num_brokers + 1)
+    return out[:num_brokers]
+
+
+def broker_load(
+    state: ClusterState, rload: Optional[jax.Array] = None
+) -> jax.Array:
+    """f32 [B, R] — total per-resource load on each broker."""
+    if rload is None:
+        rload = replica_load(state)
+    return _segment_sum_by_broker(rload, state.assignment, state.num_brokers)
+
+
+def broker_replica_count(state: ClusterState) -> jax.Array:
+    """int32 [B] — number of replicas hosted per broker."""
+    ones = state.slot_exists().astype(jnp.int32)[:, :, None]
+    return _segment_sum_by_broker(ones, state.assignment, state.num_brokers)[:, 0]
+
+
+def broker_leader_count(state: ClusterState) -> jax.Array:
+    """int32 [B] — number of leader replicas per broker."""
+    lb = state.leader_broker()
+    ids = jnp.where(lb >= 0, lb, state.num_brokers)
+    ones = jnp.ones_like(ids)
+    return jax.ops.segment_sum(ones, ids, num_segments=state.num_brokers + 1)[
+        : state.num_brokers
+    ]
+
+
+def broker_leader_load(state: ClusterState) -> jax.Array:
+    """f32 [B, R] — load contributed only by leader replicas (for leader-scoped
+    goals, e.g. LeaderBytesInDistributionGoal)."""
+    lb = state.leader_broker()
+    ids = jnp.where(lb >= 0, lb, state.num_brokers)
+    out = jax.ops.segment_sum(
+        state.leader_load, ids, num_segments=state.num_brokers + 1
+    )
+    return out[: state.num_brokers]
+
+
+def broker_potential_nw_out(state: ClusterState) -> jax.Array:
+    """f32 [B] — upstream "potential network outbound": the NW_OUT a broker
+    would serve if it led *every* replica it hosts (model/Load.java potential
+    bytes-out; used by PotentialNwOutGoal)."""
+    from cruise_control_tpu.common.resources import Resource
+
+    pot = state.leader_load[:, Resource.NW_OUT]  # [P] leadership bandwidth
+    per_slot = jnp.broadcast_to(pot[:, None], state.assignment.shape)
+    per_slot = jnp.where(state.slot_exists(), per_slot, 0.0)
+    return _segment_sum_by_broker(
+        per_slot[:, :, None], state.assignment, state.num_brokers
+    )[:, 0]
+
+
+def broker_topic_replica_count(state: ClusterState) -> jax.Array:
+    """int32 [B, T] — replicas of each topic per broker (TopicReplicaDistributionGoal)."""
+    t = state.num_topics
+    topic_per_slot = jnp.broadcast_to(
+        state.partition_topic[:, None], state.assignment.shape
+    )
+    onehot = jax.nn.one_hot(topic_per_slot, t, dtype=jnp.int32)  # [P, S, T]
+    onehot = jnp.where(state.slot_exists()[:, :, None], onehot, 0)
+    return _segment_sum_by_broker(onehot, state.assignment, state.num_brokers)
+
+
+def broker_topic_leader_count(state: ClusterState) -> jax.Array:
+    """int32 [B, T] — leaders of each topic per broker (MinTopicLeadersPerBrokerGoal)."""
+    lb = state.leader_broker()
+    ids = jnp.where(lb >= 0, lb, state.num_brokers)
+    onehot = jax.nn.one_hot(state.partition_topic, state.num_topics, dtype=jnp.int32)
+    out = jax.ops.segment_sum(onehot, ids, num_segments=state.num_brokers + 1)
+    return out[: state.num_brokers]
+
+
+def replica_rack(state: ClusterState) -> jax.Array:
+    """int32 [P, S] — rack id of each replica's broker (-1 for empty slots)."""
+    racks = jnp.where(
+        state.assignment >= 0,
+        state.broker_rack[jnp.clip(state.assignment, 0)],
+        -1,
+    )
+    return racks
+
+
+# ---------------------------------------------------------------------------------
+# Mutators → pure functions (upstream ClusterModel.relocateReplica / ...Leadership)
+# ---------------------------------------------------------------------------------
+
+def apply_move(
+    state: ClusterState, partition: jax.Array, slot: jax.Array, dest_broker: jax.Array
+) -> ClusterState:
+    """Inter-broker replica movement: move ``(partition, slot)`` to ``dest_broker``.
+
+    Upstream ``ClusterModel.relocateReplica``.  Offline flag clears: a moved
+    replica lands on a healthy broker/disk.
+    """
+    return state.replace(
+        assignment=state.assignment.at[partition, slot].set(
+            dest_broker.astype(state.assignment.dtype)
+            if isinstance(dest_broker, jax.Array)
+            else jnp.int32(dest_broker)
+        ),
+        replica_offline=state.replica_offline.at[partition, slot].set(False),
+    )
+
+
+def apply_leadership(
+    state: ClusterState, partition: jax.Array, new_leader_slot: jax.Array
+) -> ClusterState:
+    """Leadership movement (upstream ``ClusterModel.relocateLeadership``)."""
+    return state.replace(
+        leader_slot=state.leader_slot.at[partition].set(
+            new_leader_slot.astype(state.leader_slot.dtype)
+            if isinstance(new_leader_slot, jax.Array)
+            else jnp.int32(new_leader_slot)
+        )
+    )
+
+
+def apply_swap(
+    state: ClusterState,
+    partition_a: jax.Array,
+    slot_a: jax.Array,
+    partition_b: jax.Array,
+    slot_b: jax.Array,
+) -> ClusterState:
+    """Inter-broker replica swap: replica A and replica B trade brokers.
+
+    Upstream ``ActionType.INTER_BROKER_REPLICA_SWAP``.
+    """
+    broker_a = state.assignment[partition_a, slot_a]
+    broker_b = state.assignment[partition_b, slot_b]
+    assignment = state.assignment.at[partition_a, slot_a].set(broker_b)
+    assignment = assignment.at[partition_b, slot_b].set(broker_a)
+    offline = state.replica_offline.at[partition_a, slot_a].set(False)
+    offline = offline.at[partition_b, slot_b].set(False)
+    return state.replace(assignment=assignment, replica_offline=offline)
+
+
+def set_broker_state(
+    state: ClusterState, broker: jax.Array, new_state: BrokerState
+) -> ClusterState:
+    """Upstream ``ClusterModel.setBrokerState``.  Marking a broker DEAD also
+    marks its replicas offline (they become the "immigrants" hard goals must
+    evacuate, SURVEY.md §5.3)."""
+    bs = state.broker_state.at[broker].set(jnp.int8(new_state))
+    offline = state.replica_offline
+    if new_state in (BrokerState.DEAD, BrokerState.REMOVED):
+        offline = offline | (state.assignment == broker)
+    return state.replace(broker_state=bs, replica_offline=offline)
+
+
+# ---------------------------------------------------------------------------------
+# Validation (host-side; upstream ClusterModel.sanityCheck)
+# ---------------------------------------------------------------------------------
+
+def sanity_check(state: ClusterState) -> None:
+    """Host-side structural checks; raises AssertionError on violation."""
+    import numpy as np
+
+    a = np.asarray(state.assignment)
+    p, s = a.shape
+    assert state.leader_slot.shape == (p,)
+    assert state.leader_load.shape == (p, NUM_RESOURCES)
+    assert state.follower_load.shape == (p, NUM_RESOURCES)
+    assert state.partition_topic.shape == (p,)
+    assert state.replica_offline.shape == (p, s)
+    b = state.num_brokers
+    assert state.broker_rack.shape == (b,)
+    assert state.broker_state.shape == (b,)
+    assert a.max() < b, "assignment references unknown broker"
+    assert a.min() >= EMPTY_SLOT
+    ls = np.asarray(state.leader_slot)
+    assert (ls >= 0).all() and (ls < s).all()
+    # leader slot must be occupied
+    leader_brokers = np.take_along_axis(a, ls[:, None], axis=1)[:, 0]
+    assert (leader_brokers != EMPTY_SLOT).all(), "leader on empty slot"
+    # no duplicate brokers within a partition (ignoring empty slots)
+    for row in a:
+        occ = row[row != EMPTY_SLOT]
+        assert len(set(occ.tolist())) == len(occ), "duplicate broker in partition"
+    topics = np.asarray(state.partition_topic)
+    if p:
+        assert topics.max() < max(state.num_topics, 1)
+
+
+def dataclass_summary(state: ClusterState) -> str:
+    return (
+        f"ClusterState(P={state.num_partitions}, S={state.max_replication_factor}, "
+        f"B={state.num_brokers}, T={state.num_topics})"
+    )

@@ -1,0 +1,132 @@
+"""Vectorized ``ClusterModelStats`` (upstream ``model/ClusterModelStats.java``).
+
+Per-resource mean / stddev / coefficient-of-variation of broker utilization,
+replica/leader/topic-replica count distributions, and potential NW-out — the
+numbers the distribution goals balance and ``OptimizerResult`` reports
+before/after.  Everything is a masked reduction over the dense broker axis, so
+a single jitted call replaces upstream's full model walk.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from cruise_control_tpu.models.cluster_state import (
+    ClusterState,
+    broker_leader_count,
+    broker_load,
+    broker_potential_nw_out,
+    broker_replica_count,
+    broker_topic_replica_count,
+)
+
+
+@struct.dataclass
+class ClusterStats:
+    """All fields are per-alive-broker statistics.
+
+    ``resource_*`` arrays are indexed by :class:`Resource` on the last axis.
+    """
+
+    resource_mean: jax.Array        # f32 [R]
+    resource_std: jax.Array         # f32 [R]
+    resource_cv: jax.Array          # f32 [R]  std/mean (upstream "coefficient of variation")
+    utilization_mean: jax.Array     # f32 [R]  mean of load/capacity
+    utilization_std: jax.Array      # f32 [R]
+    replica_count_mean: jax.Array   # f32 []
+    replica_count_std: jax.Array    # f32 []
+    leader_count_mean: jax.Array    # f32 []
+    leader_count_std: jax.Array     # f32 []
+    topic_replica_std_mean: jax.Array  # f32 [] mean over topics of per-topic replica-count std
+    potential_nw_out_mean: jax.Array   # f32 []
+    potential_nw_out_std: jax.Array    # f32 []
+    num_alive_brokers: jax.Array    # int32 []
+
+
+def _masked_mean_std(values: jax.Array, mask: jax.Array):
+    """Mean/std over axis 0 where ``mask`` (broadcastable) is true."""
+    mask_f = mask.astype(values.dtype)
+    while mask_f.ndim < values.ndim:
+        mask_f = mask_f[..., None]
+    n = jnp.maximum(jnp.sum(mask_f, axis=0), 1.0)
+    mean = jnp.sum(values * mask_f, axis=0) / n
+    var = jnp.sum(((values - mean) ** 2) * mask_f, axis=0) / n
+    return mean, jnp.sqrt(var)
+
+
+def cluster_stats(state: ClusterState) -> ClusterStats:
+    alive = state.broker_alive()
+    load = broker_load(state)                               # [B, R]
+    cap = jnp.maximum(state.broker_capacity, 1e-9)
+    util = load / cap
+
+    res_mean, res_std = _masked_mean_std(load, alive)
+    util_mean, util_std = _masked_mean_std(util, alive)
+    cv = res_std / jnp.maximum(res_mean, 1e-9)
+
+    rc = broker_replica_count(state).astype(jnp.float32)
+    lc = broker_leader_count(state).astype(jnp.float32)
+    rc_mean, rc_std = _masked_mean_std(rc, alive)
+    lc_mean, lc_std = _masked_mean_std(lc, alive)
+
+    trc = broker_topic_replica_count(state).astype(jnp.float32)  # [B, T]
+    _, trc_std = _masked_mean_std(trc, alive)                    # [T]
+    trc_std_mean = jnp.mean(trc_std) if state.num_topics else jnp.float32(0.0)
+
+    pot = broker_potential_nw_out(state)
+    pot_mean, pot_std = _masked_mean_std(pot, alive)
+
+    return ClusterStats(
+        resource_mean=res_mean,
+        resource_std=res_std,
+        resource_cv=cv,
+        utilization_mean=util_mean,
+        utilization_std=util_std,
+        replica_count_mean=rc_mean,
+        replica_count_std=rc_std,
+        leader_count_mean=lc_mean,
+        leader_count_std=lc_std,
+        topic_replica_std_mean=jnp.asarray(trc_std_mean),
+        potential_nw_out_mean=pot_mean,
+        potential_nw_out_std=pot_std,
+        num_alive_brokers=jnp.sum(alive.astype(jnp.int32)),
+    )
+
+
+def stats_summary(stats: ClusterStats) -> dict:
+    """Host-side dict for JSON responses (servlet/response parity)."""
+    import numpy as np
+
+    from cruise_control_tpu.common.resources import Resource
+
+    def f(x):
+        return np.asarray(x).tolist()
+
+    return {
+        "numAliveBrokers": int(stats.num_alive_brokers),
+        "resources": {
+            r.name: {
+                "mean": f(stats.resource_mean[r]),
+                "std": f(stats.resource_std[r]),
+                "cv": f(stats.resource_cv[r]),
+                "utilizationMean": f(stats.utilization_mean[r]),
+                "utilizationStd": f(stats.utilization_std[r]),
+            }
+            for r in Resource
+        },
+        "replicaCount": {
+            "mean": f(stats.replica_count_mean),
+            "std": f(stats.replica_count_std),
+        },
+        "leaderCount": {
+            "mean": f(stats.leader_count_mean),
+            "std": f(stats.leader_count_std),
+        },
+        "topicReplicaStdMean": f(stats.topic_replica_std_mean),
+        "potentialNwOut": {
+            "mean": f(stats.potential_nw_out_mean),
+            "std": f(stats.potential_nw_out_std),
+        },
+    }

@@ -1,0 +1,23 @@
+"""Test harness config: force a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in the build environment; sharding
+paths are validated on 8 virtual CPU devices (same XLA SPMD partitioner), per
+SURVEY.md §4's test-strategy mapping.
+
+Gotcha: the ambient environment's ``sitecustomize`` imports jax at interpreter
+startup and registers the real-TPU (axon) backend, so ``JAX_PLATFORMS`` set
+here via ``os.environ`` is read too late.  ``jax.config.update`` works
+post-import as long as no backend has initialized yet — and keeps the tests
+off the single shared TPU chip (dialing it can block on another process's
+session).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

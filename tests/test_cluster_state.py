@@ -1,0 +1,191 @@
+"""Tensor cluster model unit tests (upstream ClusterModelTest's role)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import (
+    EMPTY_SLOT,
+    BrokerState,
+    Resource,
+)
+from cruise_control_tpu.models.builder import ClusterModelBuilder
+from cruise_control_tpu.models.cluster_state import (
+    apply_leadership,
+    apply_move,
+    apply_swap,
+    broker_leader_count,
+    broker_leader_load,
+    broker_load,
+    broker_potential_nw_out,
+    broker_replica_count,
+    broker_topic_leader_count,
+    broker_topic_replica_count,
+    replica_load,
+    replica_rack,
+    sanity_check,
+    set_broker_state,
+)
+from cruise_control_tpu.models.generators import (
+    Distribution,
+    random_cluster,
+    small_deterministic_cluster,
+)
+from cruise_control_tpu.models.stats import cluster_stats
+
+
+@pytest.fixture
+def small():
+    return small_deterministic_cluster()
+
+
+def test_builder_shapes(small):
+    sanity_check(small)
+    assert small.num_partitions == 4
+    assert small.num_brokers == 3
+    assert small.max_replication_factor == 2
+    assert small.num_topics == 2
+
+
+def test_replica_load_leader_vs_follower(small):
+    rl = np.asarray(replica_load(small))
+    # partition 0: leader slot 0 serves NW_OUT=10, follower slot 1 serves 0
+    assert rl[0, 0, Resource.NW_OUT] == pytest.approx(10.0)
+    assert rl[0, 1, Resource.NW_OUT] == pytest.approx(0.0)
+    # follower CPU is scaled by the default ratio 0.2
+    assert rl[0, 1, Resource.CPU] == pytest.approx(10.0 * 0.2)
+    # disk replicated fully
+    assert rl[0, 1, Resource.DISK] == pytest.approx(50.0)
+
+
+def test_broker_load_totals(small):
+    # global conservation: sum of broker loads == sum of replica loads
+    bl = np.asarray(broker_load(small))
+    rl = np.asarray(replica_load(small))
+    np.testing.assert_allclose(bl.sum(0), rl.sum((0, 1)), rtol=1e-5)
+    # b0 hosts: leader of P0(T1), follower of P2(T2), leader of P3(T2)
+    assert np.asarray(broker_replica_count(small)).tolist() == [3, 3, 2]
+    assert np.asarray(broker_leader_count(small)).tolist() == [2, 1, 1]
+
+
+def test_topic_counts(small):
+    trc = np.asarray(broker_topic_replica_count(small))
+    assert trc.shape == (3, 2)
+    # topic T1 (id 0): P0 on (b0,b1), P1 on (b1,b2)
+    assert trc[:, 0].tolist() == [1, 2, 1]
+    tlc = np.asarray(broker_topic_leader_count(small))
+    assert tlc[:, 0].tolist() == [1, 1, 0]
+
+
+def test_apply_move_conserves_load(small):
+    bl0 = np.asarray(broker_load(small))
+    # move partition 0 slot 1 (b1) -> b2
+    moved = apply_move(small, 0, 1, 2)
+    sanity_check(moved)
+    bl1 = np.asarray(broker_load(moved))
+    np.testing.assert_allclose(bl0.sum(0), bl1.sum(0), rtol=1e-5)
+    delta = bl1 - bl0
+    fl = np.asarray(small.follower_load[0])
+    np.testing.assert_allclose(delta[1], -fl, atol=1e-5)
+    np.testing.assert_allclose(delta[2], fl, atol=1e-5)
+    np.testing.assert_allclose(delta[0], 0.0, atol=1e-5)
+
+
+def test_apply_leadership_moves_nw_out(small):
+    moved = apply_leadership(small, 0, 1)
+    bl = np.asarray(broker_load(moved))
+    bl0 = np.asarray(broker_load(small))
+    # NW_OUT of partition 0 (10.0) moves from b0 to b1
+    assert bl0[0, Resource.NW_OUT] - bl[0, Resource.NW_OUT] == pytest.approx(10.0)
+    assert bl[1, Resource.NW_OUT] - bl0[1, Resource.NW_OUT] == pytest.approx(10.0)
+    assert np.asarray(broker_leader_count(moved)).tolist() == [1, 2, 1]
+
+
+def test_apply_swap(small):
+    # swap P0 slot1 (b1) with P2 slot0 (b2): P0 -> [b0,b2], P2 -> [b1,b0]
+    swapped = apply_swap(small, 0, 1, 2, 0)
+    sanity_check(swapped)
+    a = np.asarray(swapped.assignment)
+    assert a[0, 1] == 2
+    assert a[2, 0] == 1
+
+
+def test_set_broker_state_dead_marks_offline(small):
+    dead = set_broker_state(small, 1, BrokerState.DEAD)
+    off = np.asarray(dead.replica_offline)
+    a = np.asarray(dead.assignment)
+    assert (off == (a == 1)).all()
+    assert not np.asarray(dead.broker_alive())[1]
+    # alive brokers unchanged
+    assert np.asarray(dead.broker_alive())[[0, 2]].all()
+
+
+def test_leader_load_and_potential_nw_out(small):
+    ll = np.asarray(broker_leader_load(small))
+    assert ll[0, Resource.NW_IN] == pytest.approx(20.0)  # leads P0, P3
+    pot = np.asarray(broker_potential_nw_out(small))
+    # every broker hosts replicas whose leadership bandwidth is 10 each
+    counts = np.asarray(broker_replica_count(small))
+    np.testing.assert_allclose(pot, counts * 10.0, rtol=1e-5)
+
+
+def test_replica_rack(small):
+    rr = np.asarray(replica_rack(small))
+    assert rr[0].tolist() == [0, 0]  # b0,b1 in rack 0
+    assert rr[1].tolist() == [0, 1]
+
+
+def test_random_cluster_seeded_reproducible():
+    a = random_cluster(seed=7, num_brokers=10, num_partitions=100)
+    b = random_cluster(seed=7, num_brokers=10, num_partitions=100)
+    assert (np.asarray(a.assignment) == np.asarray(b.assignment)).all()
+    np.testing.assert_array_equal(
+        np.asarray(a.leader_load), np.asarray(b.leader_load)
+    )
+    sanity_check(a)
+
+
+@pytest.mark.parametrize(
+    "dist", [Distribution.UNIFORM, Distribution.LINEAR, Distribution.EXPONENTIAL]
+)
+def test_random_cluster_mean_utilization(dist):
+    state = random_cluster(
+        seed=3, num_brokers=20, num_partitions=500, distribution=dist,
+        mean_utilization=0.35,
+    )
+    bl = np.asarray(broker_load(state))
+    cap = np.asarray(state.broker_capacity)
+    util = bl.sum(0) / cap.sum(0)
+    np.testing.assert_allclose(util, 0.35, rtol=0.1)
+
+
+def test_random_cluster_dead_brokers_offline():
+    state = random_cluster(seed=5, num_brokers=10, num_partitions=50, dead_brokers=2)
+    alive = np.asarray(state.broker_alive())
+    assert alive.sum() == 8
+    off = np.asarray(state.replica_offline)
+    a = np.asarray(state.assignment)
+    assert (off == np.isin(a, [8, 9])).all()
+
+
+def test_cluster_stats(small):
+    stats = cluster_stats(small)
+    bl = np.asarray(broker_load(small))
+    np.testing.assert_allclose(
+        np.asarray(stats.resource_mean), bl.mean(0), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats.resource_std), bl.std(0), rtol=1e-5
+    )
+    assert int(stats.num_alive_brokers) == 3
+    assert float(stats.replica_count_mean) == pytest.approx(8 / 3)
+
+
+def test_stats_exclude_dead_brokers(small):
+    dead = set_broker_state(small, 2, BrokerState.DEAD)
+    stats = cluster_stats(dead)
+    assert int(stats.num_alive_brokers) == 2
+    bl = np.asarray(broker_load(dead))
+    np.testing.assert_allclose(
+        np.asarray(stats.resource_mean), bl[:2].mean(0), rtol=1e-5
+    )
