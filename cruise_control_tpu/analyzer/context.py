@@ -1,0 +1,249 @@
+"""Analyzer working context — mutable numpy mirror of a ClusterState.
+
+The greedy baseline (upstream ``GoalOptimizer``/``AbstractGoal`` inner loop,
+SURVEY.md §2.5) makes thousands of dependent moves; recomputing broker
+aggregates per move would be O(P·S) each.  This context keeps every aggregate
+the goals consult updated *incrementally* per action — the numpy twin of the
+"relocate = two scatter-adds" identity the TPU path exploits.
+
+The same aggregate vocabulary is exported as a pytree
+(:func:`goal_arrays`) so goal predicates written against it run unchanged
+under numpy (greedy) and jax.numpy (TPU mask builder) — single-source goal
+semantics, engine-checked for parity in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Set
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import (
+    EMPTY_SLOT,
+    NUM_RESOURCES,
+    BrokerState,
+    Resource,
+)
+from cruise_control_tpu.analyzer.actions import ActionType, BalancingAction
+from cruise_control_tpu.models.cluster_state import ClusterState
+
+
+@dataclasses.dataclass
+class OptimizationOptions:
+    """Upstream ``OptimizationOptions`` (analyzer/OptimizationOptions.java):
+    scoping knobs every goal must respect."""
+
+    excluded_topics: Set[int] = dataclasses.field(default_factory=set)
+    excluded_brokers_for_leadership: Set[int] = dataclasses.field(default_factory=set)
+    excluded_brokers_for_replica_move: Set[int] = dataclasses.field(default_factory=set)
+    #: Brokers requested for removal (demotion of all replicas), upstream
+    #: removeBrokers semantics: treated as non-destinations whose replicas
+    #: must evacuate.
+    brokers_to_remove: Set[int] = dataclasses.field(default_factory=set)
+
+
+class AnalyzerContext:
+    """Mutable placement + aggregates; one instance per optimization run."""
+
+    def __init__(self, state: ClusterState, options: Optional[OptimizationOptions] = None):
+        self.options = options or OptimizationOptions()
+        # placement (mutable copies)
+        self.assignment = np.array(state.assignment, np.int32)
+        self.leader_slot = np.array(state.leader_slot, np.int32)
+        self.replica_offline = np.array(state.replica_offline, bool)
+        # immutable per-partition data
+        self.leader_load = np.array(state.leader_load, np.float32)
+        self.follower_load = np.array(state.follower_load, np.float32)
+        self.partition_topic = np.array(state.partition_topic, np.int32)
+        # broker data
+        self.broker_capacity = np.array(state.broker_capacity, np.float32)
+        self.broker_rack = np.array(state.broker_rack, np.int32)
+        self.broker_state = np.array(state.broker_state, np.int8)
+        self.num_topics = state.num_topics
+
+        self.num_partitions, self.max_rf = self.assignment.shape
+        self.num_brokers = self.broker_capacity.shape[0]
+
+        # Brokers requested for removal: their replicas become "immigrants"
+        # that hard goals must evacuate (upstream removeBrokers semantics —
+        # same machinery as dead-broker self-healing).
+        for b in self.options.brokers_to_remove:
+            self.replica_offline |= self.assignment == b
+
+        self._init_aggregates()
+        self.actions: List[BalancingAction] = []
+
+    # ---- masks ------------------------------------------------------------------
+    @property
+    def broker_alive(self) -> np.ndarray:
+        return (self.broker_state != BrokerState.DEAD) & (
+            self.broker_state != BrokerState.REMOVED
+        )
+
+    @property
+    def broker_demoted(self) -> np.ndarray:
+        return self.broker_state == BrokerState.DEMOTED
+
+    @property
+    def broker_new(self) -> np.ndarray:
+        return self.broker_state == BrokerState.NEW
+
+    def dest_candidates(self) -> np.ndarray:
+        """bool [B] — brokers eligible as replica-move destinations."""
+        ok = self.broker_alive.copy()
+        for b in self.options.excluded_brokers_for_replica_move:
+            ok[b] = False
+        for b in self.options.brokers_to_remove:
+            ok[b] = False
+        return ok
+
+    def leadership_candidates(self) -> np.ndarray:
+        """bool [B] — brokers eligible to take leadership."""
+        ok = self.broker_alive & ~self.broker_demoted
+        for b in self.options.excluded_brokers_for_leadership:
+            ok[b] = False
+        for b in self.options.brokers_to_remove:
+            ok[b] = False
+        return ok
+
+    def partition_excluded(self, p: int) -> bool:
+        return int(self.partition_topic[p]) in self.options.excluded_topics
+
+    # ---- aggregates -------------------------------------------------------------
+    def _init_aggregates(self) -> None:
+        P, S = self.assignment.shape
+        B, T = self.num_brokers, self.num_topics
+        self.broker_load = np.zeros((B, NUM_RESOURCES), np.float64)
+        self.broker_leader_load = np.zeros((B, NUM_RESOURCES), np.float64)
+        self.broker_replica_count = np.zeros(B, np.int64)
+        self.broker_leader_count = np.zeros(B, np.int64)
+        self.broker_topic_replica_count = np.zeros((B, T), np.int64)
+        self.broker_topic_leader_count = np.zeros((B, T), np.int64)
+        self.broker_potential_nw_out = np.zeros(B, np.float64)
+
+        for p in range(P):
+            t = self.partition_topic[p]
+            for s in range(S):
+                b = self.assignment[p, s]
+                if b == EMPTY_SLOT:
+                    continue
+                load = self.replica_load_vec(p, s)
+                self.broker_load[b] += load
+                self.broker_replica_count[b] += 1
+                self.broker_topic_replica_count[b, t] += 1
+                self.broker_potential_nw_out[b] += self.leader_load[p, Resource.NW_OUT]
+            lb = self.leader_broker(p)
+            self.broker_leader_count[lb] += 1
+            self.broker_leader_load[lb] += self.leader_load[p]
+            self.broker_topic_leader_count[lb, t] += 1
+
+    def leader_broker(self, p: int) -> int:
+        return int(self.assignment[p, self.leader_slot[p]])
+
+    def is_leader(self, p: int, s: int) -> bool:
+        return self.leader_slot[p] == s
+
+    def replica_load_vec(self, p: int, s: int) -> np.ndarray:
+        """f64 [R] — the load replica (p, s) puts on its broker right now."""
+        if self.is_leader(p, s):
+            return self.leader_load[p].astype(np.float64)
+        return self.follower_load[p].astype(np.float64)
+
+    def utilization(self, resource: Resource) -> np.ndarray:
+        """f64 [B] — load/capacity for a resource."""
+        return self.broker_load[:, resource] / np.maximum(
+            self.broker_capacity[:, resource], 1e-9
+        )
+
+    def avg_alive_utilization(self, resource: Resource) -> float:
+        """Upstream avgUtilizationPercentage: total load / total alive capacity."""
+        alive = self.broker_alive
+        cap = self.broker_capacity[alive, resource].sum()
+        return float(self.broker_load[:, resource].sum() / max(cap, 1e-9))
+
+    # ---- action application -----------------------------------------------------
+    def apply(self, action: BalancingAction) -> None:
+        """Apply an accepted action, updating placement + every aggregate."""
+        p = action.partition
+        t = self.partition_topic[p]
+        if action.action_type == ActionType.INTER_BROKER_REPLICA_MOVEMENT:
+            s, src, dst = action.slot, action.source_broker, action.dest_broker
+            assert self.assignment[p, s] == src, "stale action"
+            load = self.replica_load_vec(p, s)
+            pot = self.leader_load[p, Resource.NW_OUT]
+            self.assignment[p, s] = dst
+            self.replica_offline[p, s] = False
+            self.broker_load[src] -= load
+            self.broker_load[dst] += load
+            self.broker_replica_count[src] -= 1
+            self.broker_replica_count[dst] += 1
+            self.broker_topic_replica_count[src, t] -= 1
+            self.broker_topic_replica_count[dst, t] += 1
+            self.broker_potential_nw_out[src] -= pot
+            self.broker_potential_nw_out[dst] += pot
+            if self.is_leader(p, s):
+                self.broker_leader_count[src] -= 1
+                self.broker_leader_count[dst] += 1
+                self.broker_leader_load[src] -= self.leader_load[p]
+                self.broker_leader_load[dst] += self.leader_load[p]
+                self.broker_topic_leader_count[src, t] -= 1
+                self.broker_topic_leader_count[dst, t] += 1
+        elif action.action_type == ActionType.LEADERSHIP_MOVEMENT:
+            new_slot = action.dest_slot
+            old_slot = self.leader_slot[p]
+            src = int(self.assignment[p, old_slot])
+            dst = int(self.assignment[p, new_slot])
+            assert src == action.source_broker and dst == action.dest_broker
+            delta = (self.leader_load[p] - self.follower_load[p]).astype(np.float64)
+            self.leader_slot[p] = new_slot
+            self.broker_load[src] -= delta
+            self.broker_load[dst] += delta
+            self.broker_leader_count[src] -= 1
+            self.broker_leader_count[dst] += 1
+            self.broker_leader_load[src] -= self.leader_load[p]
+            self.broker_leader_load[dst] += self.leader_load[p]
+            self.broker_topic_leader_count[src, t] -= 1
+            self.broker_topic_leader_count[dst, t] += 1
+        elif action.action_type == ActionType.INTER_BROKER_REPLICA_SWAP:
+            # decompose into two moves (aggregates stay exact because the two
+            # applies are sequential); record only the swap itself
+            a1 = BalancingAction(
+                ActionType.INTER_BROKER_REPLICA_MOVEMENT,
+                action.partition, action.slot,
+                action.source_broker, action.dest_broker,
+            )
+            a2 = BalancingAction(
+                ActionType.INTER_BROKER_REPLICA_MOVEMENT,
+                action.swap_partition, action.swap_slot,
+                action.dest_broker, action.source_broker,
+            )
+            self.apply(a1)
+            self.apply(a2)
+            self.actions.pop()
+            self.actions.pop()
+            self.actions.append(action)
+            return
+        else:
+            raise NotImplementedError(action.action_type)
+        self.actions.append(action)
+
+    # ---- snapshots --------------------------------------------------------------
+    def to_state(self, template: ClusterState) -> ClusterState:
+        import jax.numpy as jnp
+
+        return template.replace(
+            assignment=jnp.asarray(self.assignment),
+            leader_slot=jnp.asarray(self.leader_slot),
+            replica_offline=jnp.asarray(self.replica_offline),
+        )
+
+    def recompute_check(self, atol: float = 1e-3) -> None:
+        """Debug invariant: incremental aggregates match a fresh recount."""
+        snap_load = self.broker_load.copy()
+        snap_rc = self.broker_replica_count.copy()
+        snap_lc = self.broker_leader_count.copy()
+        self._init_aggregates()
+        assert np.allclose(snap_load, self.broker_load, atol=atol), "load drift"
+        assert (snap_rc == self.broker_replica_count).all(), "replica count drift"
+        assert (snap_lc == self.broker_leader_count).all(), "leader count drift"

@@ -1,0 +1,253 @@
+"""GoalOptimizer — runs the goal stack in priority order and diffs the result
+into execution proposals (upstream ``analyzer/GoalOptimizer.java`` +
+``OptimizerResult`` + ``AnalyzerUtils`` diff; SURVEY.md §2.5, call stack §3.2).
+
+This is the *greedy baseline engine* (BASELINE.json config #1) and the parity
+oracle for the TPU optimizer: both produce the same ``OptimizerResult``
+contract, so everything downstream (executor, REST, self-healing) is
+engine-agnostic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import EMPTY_SLOT
+from cruise_control_tpu.analyzer.actions import BalancingAction
+from cruise_control_tpu.analyzer.context import AnalyzerContext, OptimizationOptions
+from cruise_control_tpu.analyzer.goals.base import (
+    BalancingConstraint,
+    Goal,
+    OptimizationFailure,
+)
+from cruise_control_tpu.analyzer.goals.capacity import (
+    CpuCapacityGoal,
+    DiskCapacityGoal,
+    NetworkInboundCapacityGoal,
+    NetworkOutboundCapacityGoal,
+    ReplicaCapacityGoal,
+)
+from cruise_control_tpu.analyzer.goals.distribution import (
+    BrokerSetAwareGoal,
+    CpuUsageDistributionGoal,
+    DiskUsageDistributionGoal,
+    LeaderBytesInDistributionGoal,
+    LeaderReplicaDistributionGoal,
+    MinTopicLeadersPerBrokerGoal,
+    NetworkInboundUsageDistributionGoal,
+    NetworkOutboundUsageDistributionGoal,
+    PotentialNwOutGoal,
+    PreferredLeaderElectionGoal,
+    ReplicaDistributionGoal,
+    TopicReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.goals.rack import (
+    RackAwareDistributionGoal,
+    RackAwareGoal,
+)
+from cruise_control_tpu.models.cluster_state import ClusterState
+from cruise_control_tpu.models.stats import cluster_stats, stats_summary
+
+#: Upstream default.goals order (cruisecontrol.properties default.goals).
+DEFAULT_GOAL_ORDER = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+]
+
+GOAL_CLASSES = {
+    cls.name: cls
+    for cls in [
+        RackAwareGoal,
+        RackAwareDistributionGoal,
+        ReplicaCapacityGoal,
+        DiskCapacityGoal,
+        NetworkInboundCapacityGoal,
+        NetworkOutboundCapacityGoal,
+        CpuCapacityGoal,
+        ReplicaDistributionGoal,
+        PotentialNwOutGoal,
+        DiskUsageDistributionGoal,
+        NetworkInboundUsageDistributionGoal,
+        NetworkOutboundUsageDistributionGoal,
+        CpuUsageDistributionGoal,
+        TopicReplicaDistributionGoal,
+        LeaderReplicaDistributionGoal,
+        LeaderBytesInDistributionGoal,
+        MinTopicLeadersPerBrokerGoal,
+        BrokerSetAwareGoal,
+        PreferredLeaderElectionGoal,
+    ]
+}
+
+
+def make_goals(
+    names: Optional[Sequence[str]] = None,
+    constraint: Optional[BalancingConstraint] = None,
+) -> List[Goal]:
+    constraint = constraint or BalancingConstraint()
+    return [GOAL_CLASSES[n](constraint) for n in (names or DEFAULT_GOAL_ORDER)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionProposal:
+    """Diff unit handed to the executor (upstream executor/ExecutionProposal.java)."""
+
+    partition: int
+    topic: int
+    old_leader: int
+    new_leader: int
+    old_replicas: tuple
+    new_replicas: tuple
+
+    @property
+    def has_replica_change(self) -> bool:
+        return set(self.old_replicas) != set(self.new_replicas)
+
+    @property
+    def has_leader_change(self) -> bool:
+        return self.old_leader != self.new_leader
+
+    def to_json(self) -> dict:
+        return {
+            "partition": self.partition,
+            "topic": self.topic,
+            "oldLeader": self.old_leader,
+            "newLeader": self.new_leader,
+            "oldReplicas": list(self.old_replicas),
+            "newReplicas": list(self.new_replicas),
+        }
+
+
+@dataclasses.dataclass
+class OptimizerResult:
+    """Upstream ``OptimizerResult``: proposals + before/after accounting."""
+
+    proposals: List[ExecutionProposal]
+    actions: List[BalancingAction]
+    violations_before: Dict[str, int]
+    violations_after: Dict[str, int]
+    stats_before: dict
+    stats_after: dict
+    final_state: ClusterState
+    duration_s: float
+    engine: str = "greedy"
+
+    @property
+    def violation_score_before(self) -> int:
+        return sum(self.violations_before.values())
+
+    @property
+    def violation_score_after(self) -> int:
+        return sum(self.violations_after.values())
+
+    def summary(self) -> dict:
+        return {
+            "engine": self.engine,
+            "numProposals": len(self.proposals),
+            "numActions": len(self.actions),
+            "violationsBefore": self.violations_before,
+            "violationsAfter": self.violations_after,
+            "violationScoreBefore": self.violation_score_before,
+            "violationScoreAfter": self.violation_score_after,
+            "durationSeconds": self.duration_s,
+        }
+
+
+def diff_proposals(
+    initial_assignment: np.ndarray,
+    initial_leader_slot: np.ndarray,
+    ctx: AnalyzerContext,
+) -> List[ExecutionProposal]:
+    """Placement diff → proposals (upstream AnalyzerUtils.getDiff)."""
+    out: List[ExecutionProposal] = []
+    for p in range(ctx.num_partitions):
+        old_row = initial_assignment[p]
+        new_row = ctx.assignment[p]
+        old_leader = int(old_row[initial_leader_slot[p]])
+        new_leader = ctx.leader_broker(p)
+        if (old_row == new_row).all() and old_leader == new_leader:
+            continue
+        # Kafka replica lists are leader-first; emit the new replica list with
+        # the leader first so executors can hand it straight to a reassignment.
+        new_replicas = [int(b) for b in new_row if b != EMPTY_SLOT]
+        new_replicas.sort(key=lambda b: b != new_leader)
+        old_replicas = [int(b) for b in old_row if b != EMPTY_SLOT]
+        old_replicas.sort(key=lambda b: b != old_leader)
+        out.append(
+            ExecutionProposal(
+                partition=p,
+                topic=int(ctx.partition_topic[p]),
+                old_leader=old_leader,
+                new_leader=new_leader,
+                old_replicas=tuple(old_replicas),
+                new_replicas=tuple(new_replicas),
+            )
+        )
+    return out
+
+
+class GoalOptimizer:
+    """Runs goals by priority over an AnalyzerContext (upstream GoalOptimizer)."""
+
+    def __init__(
+        self,
+        goals: Optional[Sequence[Goal]] = None,
+        constraint: Optional[BalancingConstraint] = None,
+    ):
+        self.constraint = constraint or BalancingConstraint()
+        self.goals = list(goals) if goals is not None else make_goals(
+            constraint=self.constraint
+        )
+
+    def optimize(
+        self,
+        state: ClusterState,
+        options: Optional[OptimizationOptions] = None,
+    ) -> OptimizerResult:
+        t0 = time.perf_counter()
+        ctx = AnalyzerContext(state, options)
+        initial_assignment = ctx.assignment.copy()
+        initial_leader_slot = ctx.leader_slot.copy()
+        stats_before = stats_summary(cluster_stats(state))
+        violations_before = {g.name: g.violations(ctx) for g in self.goals}
+
+        optimized: List[Goal] = []
+        for goal in self.goals:
+            goal.optimize(ctx, optimized)
+            if goal.is_hard and goal.violations(ctx) > 0:
+                raise OptimizationFailure(
+                    f"{goal.name} still violated after optimization"
+                )
+            optimized.append(goal)
+
+        violations_after = {g.name: g.violations(ctx) for g in self.goals}
+        final_state = ctx.to_state(state)
+        stats_after = stats_summary(cluster_stats(final_state))
+        return OptimizerResult(
+            proposals=diff_proposals(initial_assignment, initial_leader_slot, ctx),
+            actions=list(ctx.actions),
+            violations_before=violations_before,
+            violations_after=violations_after,
+            stats_before=stats_before,
+            stats_after=stats_after,
+            final_state=final_state,
+            duration_s=time.perf_counter() - t0,
+            engine="greedy",
+        )

@@ -1,0 +1,234 @@
+"""Goal SPI + shared greedy machinery.
+
+Upstream shape (``analyzer/goals/Goal.java`` / ``AbstractGoal.java``,
+SURVEY.md §2.5): goals run in priority order; each goal mutates the model to
+satisfy itself while every candidate action must pass the *acceptance* check
+of all previously-optimized goals (chaining).  Hard goals throw on failure;
+soft goals settle for best-effort.
+
+TPU-first twist: acceptance is expressed **vectorized over the destination
+broker axis** (``accept_move(ctx, p, s) -> bool[B]``) rather than per-action.
+The greedy baseline consumes these masks directly (one numpy op per goal per
+candidate replica instead of B Python calls), and the TPU optimizer reuses the
+same formulas on jnp arrays for its fused feasibility mask — single-source
+goal semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import (
+    DEFAULT_BALANCE_THRESHOLD,
+    DEFAULT_CAPACITY_THRESHOLD,
+    DEFAULT_LOW_UTILIZATION_THRESHOLD,
+    EMPTY_SLOT,
+    NUM_RESOURCES,
+    Resource,
+)
+from cruise_control_tpu.analyzer.actions import ActionType, BalancingAction
+from cruise_control_tpu.analyzer.context import AnalyzerContext
+
+#: Upstream ResourceDistributionGoal.BALANCE_MARGIN: thresholds are tightened
+#: by this factor during optimization so post-optimization drift stays legal.
+BALANCE_MARGIN = 0.9
+
+
+class OptimizationFailure(Exception):
+    """Hard goal could not be satisfied (upstream OptimizationFailureException)."""
+
+
+@dataclasses.dataclass
+class BalancingConstraint:
+    """Analyzer threshold config (upstream AnalyzerConfig keys, SURVEY.md §5.6)."""
+
+    capacity_threshold: Dict[Resource, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_CAPACITY_THRESHOLD)
+    )
+    balance_threshold: Dict[Resource, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_BALANCE_THRESHOLD)
+    )
+    low_utilization_threshold: Dict[Resource, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_LOW_UTILIZATION_THRESHOLD)
+    )
+    #: replica.count.balance.threshold
+    replica_balance_threshold: float = 1.1
+    #: leader.replica.count.balance.threshold
+    leader_replica_balance_threshold: float = 1.1
+    #: topic.replica.count.balance.threshold
+    topic_replica_balance_threshold: float = 3.0
+    #: max.replicas.per.broker
+    max_replicas_per_broker: int = 10_000
+    #: min.topic.leaders.per.broker + the topic ids it applies to
+    min_topic_leaders_per_broker: int = 0
+    min_topic_leaders_topics: Set[int] = dataclasses.field(default_factory=set)
+    #: topic id -> allowed broker ids (BrokerSetAwareGoal config)
+    broker_sets: Dict[int, Set[int]] = dataclasses.field(default_factory=dict)
+
+    def balance_bounds(self, avg: float, resource: Resource) -> Tuple[float, float]:
+        """(lower, upper) utilization bounds around the cluster average."""
+        pct = (self.balance_threshold[resource] - 1.0) * BALANCE_MARGIN
+        return avg * max(0.0, 1.0 - pct), avg * (1.0 + pct)
+
+    def count_bounds(self, avg: float, threshold: float) -> Tuple[int, int]:
+        pct = (threshold - 1.0) * BALANCE_MARGIN
+        import math
+
+        return math.floor(avg * max(0.0, 1.0 - pct)), math.ceil(avg * (1.0 + pct))
+
+
+class Goal:
+    """Base goal.  Subclasses set ``name`` and ``is_hard``."""
+
+    name: str = "goal"
+    is_hard: bool = False
+
+    def __init__(self, constraint: Optional[BalancingConstraint] = None):
+        self.constraint = constraint or BalancingConstraint()
+
+    # ---- acceptance (vectorized over destination brokers) ----------------------
+    def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
+        """bool [B]: for each dest broker, would moving replica (p, s) there
+        keep this goal satisfied?  Goal-specific invariant only — global
+        legality (alive, exclusions, duplicates) is the driver's job."""
+        return np.ones(ctx.num_brokers, bool)
+
+    def accept_leadership(self, ctx: AnalyzerContext, p: int, new_slot: int) -> bool:
+        """Would transferring partition p's leadership to ``new_slot`` keep
+        this goal satisfied?"""
+        return True
+
+    # ---- optimization -----------------------------------------------------------
+    def optimize(
+        self,
+        ctx: AnalyzerContext,
+        optimized: Sequence["Goal"],
+    ) -> None:
+        """Mutate ctx toward this goal, chaining acceptance through
+        ``optimized``.  Hard goals raise OptimizationFailure if impossible."""
+        raise NotImplementedError
+
+    # ---- scoring ---------------------------------------------------------------
+    def violations(self, ctx: AnalyzerContext) -> int:
+        """Number of outstanding violations (0 = satisfied).  Used by the
+        goal-violation detector, the verifier, and the violation score."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------------
+# Driver helpers shared by goal implementations and the GoalOptimizer
+# ---------------------------------------------------------------------------------
+
+def legal_move_dests(ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
+    """bool [B]: structurally legal destinations for replica (p, s):
+    alive + not excluded, not the current broker, not already hosting a
+    replica of p."""
+    ok = ctx.dest_candidates().copy()
+    row = ctx.assignment[p]
+    for b in row:
+        if b != EMPTY_SLOT:
+            ok[b] = False  # includes the source broker itself
+    return ok
+
+
+def accepted_move_dests(
+    ctx: AnalyzerContext,
+    p: int,
+    s: int,
+    current: Goal,
+    optimized: Sequence[Goal],
+) -> np.ndarray:
+    """Destinations passing legality + current goal + all optimized goals."""
+    ok = legal_move_dests(ctx, p, s)
+    if not ok.any():
+        return ok
+    ok &= current.accept_move(ctx, p, s)
+    for g in optimized:
+        if not ok.any():
+            break
+        ok &= g.accept_move(ctx, p, s)
+    return ok
+
+
+def accepted_leadership(
+    ctx: AnalyzerContext,
+    p: int,
+    new_slot: int,
+    current: Goal,
+    optimized: Sequence[Goal],
+) -> bool:
+    b = ctx.assignment[p, new_slot]
+    if b == EMPTY_SLOT or not ctx.leadership_candidates()[b]:
+        return False
+    if ctx.replica_offline[p, new_slot]:
+        return False
+    if not current.accept_leadership(ctx, p, new_slot):
+        return False
+    return all(g.accept_leadership(ctx, p, new_slot) for g in optimized)
+
+
+def move_action(ctx: AnalyzerContext, p: int, s: int, dest: int) -> BalancingAction:
+    return BalancingAction(
+        ActionType.INTER_BROKER_REPLICA_MOVEMENT,
+        p, s, int(ctx.assignment[p, s]), int(dest),
+    )
+
+
+def leadership_action(ctx: AnalyzerContext, p: int, new_slot: int) -> BalancingAction:
+    return BalancingAction(
+        ActionType.LEADERSHIP_MOVEMENT,
+        p, int(ctx.leader_slot[p]),
+        ctx.leader_broker(p), int(ctx.assignment[p, new_slot]),
+        dest_slot=int(new_slot),
+    )
+
+
+def broker_replicas(ctx: AnalyzerContext, b: int) -> List[Tuple[int, int]]:
+    """All (partition, slot) pairs currently hosted on broker b."""
+    ps, ss = np.nonzero(ctx.assignment == b)
+    return list(zip(ps.tolist(), ss.tolist()))
+
+
+def evacuate_offline_replicas(
+    ctx: AnalyzerContext, current: Goal, optimized: Sequence[Goal]
+) -> List[Tuple[int, int]]:
+    """Move every offline replica (dead broker / broken disk) to an accepted
+    destination; transfer leadership off non-leadership-eligible brokers.
+
+    Upstream: each goal's optimize() first relocates "immigrant"/offline
+    replicas (AbstractGoal + GoalUtils); the highest-priority goal does the
+    heavy lifting, later goals find nothing left.  Returns replicas it could
+    NOT place (hard goals treat that as failure)."""
+    failed: List[Tuple[int, int]] = []
+    ps, ss = np.nonzero(ctx.replica_offline & (ctx.assignment != EMPTY_SLOT))
+    for p, s in zip(ps.tolist(), ss.tolist()):
+        if not ctx.replica_offline[p, s]:
+            continue  # earlier evacuation in this loop already fixed it
+        ok = accepted_move_dests(ctx, p, s, current, optimized)
+        if not ok.any():
+            failed.append((p, s))
+            continue
+        # least-loaded eligible dest by disk utilization (stable tie-break)
+        util = ctx.utilization(Resource.DISK)
+        dest = int(np.argmin(np.where(ok, util, np.inf)))
+        ctx.apply(move_action(ctx, p, s, dest))
+    # leadership must not sit on dead/demoted brokers
+    lead_ok = ctx.leadership_candidates()
+    for p in range(ctx.num_partitions):
+        lb = ctx.leader_broker(p)
+        if lead_ok[lb]:
+            continue
+        moved = False
+        for s in range(ctx.max_rf):
+            if s == ctx.leader_slot[p] or ctx.assignment[p, s] == EMPTY_SLOT:
+                continue
+            if accepted_leadership(ctx, p, s, current, optimized):
+                ctx.apply(leadership_action(ctx, p, s))
+                moved = True
+                break
+        if not moved:
+            failed.append((p, int(ctx.leader_slot[p])))
+    return failed

@@ -1,0 +1,177 @@
+"""Hard capacity goals (upstream ``analyzer/goals/CapacityGoal.java`` family:
+ReplicaCapacityGoal, DiskCapacityGoal, NetworkInbound/OutboundCapacityGoal,
+CpuCapacityGoal; SURVEY.md §2.5 hard-goal row).
+
+Invariant per alive broker: utilization ≤ capacity × capacity.threshold.
+Violating brokers shed replicas (largest-for-the-resource first) to the
+least-utilized accepted destination; leadership-bound resources (NW_OUT, CPU)
+also shed by transferring leadership.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import EMPTY_SLOT, Resource
+from cruise_control_tpu.analyzer.context import AnalyzerContext
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal,
+    OptimizationFailure,
+    accepted_leadership,
+    accepted_move_dests,
+    broker_replicas,
+    evacuate_offline_replicas,
+    leadership_action,
+    move_action,
+)
+
+
+class ReplicaCapacityGoal(Goal):
+    """Broker replica count ≤ max.replicas.per.broker (hard)."""
+
+    name = "ReplicaCapacityGoal"
+    is_hard = True
+
+    def _limit(self) -> int:
+        return self.constraint.max_replicas_per_broker
+
+    def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
+        return ctx.broker_replica_count + 1 <= self._limit()
+
+    def violations(self, ctx: AnalyzerContext) -> int:
+        over = ctx.broker_replica_count > self._limit()
+        return int((over & ctx.broker_alive).sum())
+
+    def optimize(self, ctx: AnalyzerContext, optimized: Sequence[Goal]) -> None:
+        failed = evacuate_offline_replicas(ctx, self, optimized)
+        if failed:
+            raise OptimizationFailure(
+                f"{self.name}: {len(failed)} offline replicas could not be placed"
+            )
+        limit = self._limit()
+        for b in np.nonzero(ctx.broker_replica_count > limit)[0].tolist():
+            replicas = broker_replicas(ctx, b)
+            for p, s in replicas:
+                if ctx.broker_replica_count[b] <= limit:
+                    break
+                if ctx.partition_excluded(p):
+                    continue
+                ok = accepted_move_dests(ctx, p, s, self, optimized)
+                if not ok.any():
+                    continue
+                counts = np.where(ok, ctx.broker_replica_count, np.iinfo(np.int64).max)
+                ctx.apply(move_action(ctx, p, s, int(np.argmin(counts))))
+            if ctx.broker_replica_count[b] > limit and ctx.broker_alive[b]:
+                raise OptimizationFailure(
+                    f"{self.name}: broker {b} stuck at "
+                    f"{int(ctx.broker_replica_count[b])} > {limit}"
+                )
+
+
+class CapacityGoal(Goal):
+    """Resource capacity goal (hard); subclasses pin ``resource``."""
+
+    resource: Resource
+    is_hard = True
+
+    def _limits(self, ctx: AnalyzerContext) -> np.ndarray:
+        """f64 [B] — absolute load limit per broker."""
+        return (
+            ctx.broker_capacity[:, self.resource].astype(np.float64)
+            * self.constraint.capacity_threshold[self.resource]
+        )
+
+    def _moved_load(self, ctx: AnalyzerContext, p: int, s: int) -> float:
+        return float(ctx.replica_load_vec(p, s)[self.resource])
+
+    def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
+        delta = self._moved_load(ctx, p, s)
+        return ctx.broker_load[:, self.resource] + delta <= self._limits(ctx)
+
+    def accept_leadership(self, ctx: AnalyzerContext, p: int, new_slot: int) -> bool:
+        if self.resource not in (Resource.NW_OUT, Resource.CPU):
+            return True
+        delta = float(
+            ctx.leader_load[p, self.resource] - ctx.follower_load[p, self.resource]
+        )
+        dst = ctx.assignment[p, new_slot]
+        return bool(
+            ctx.broker_load[dst, self.resource] + delta <= self._limits(ctx)[dst]
+        )
+
+    def violations(self, ctx: AnalyzerContext) -> int:
+        over = ctx.broker_load[:, self.resource] > self._limits(ctx) * (1 + 1e-9)
+        return int((over & ctx.broker_alive).sum())
+
+    def optimize(self, ctx: AnalyzerContext, optimized: Sequence[Goal]) -> None:
+        failed = evacuate_offline_replicas(ctx, self, optimized)
+        if failed:
+            raise OptimizationFailure(
+                f"{self.name}: {len(failed)} offline replicas could not be placed"
+            )
+        limits = self._limits(ctx)
+        r = self.resource
+        over_brokers = np.nonzero(
+            (ctx.broker_load[:, r] > limits) & ctx.broker_alive
+        )[0]
+        # most-overloaded first
+        order = np.argsort(-(ctx.broker_load[over_brokers, r] - limits[over_brokers]))
+        for b in over_brokers[order].tolist():
+            self._shed(ctx, b, optimized)
+            if ctx.broker_load[b, r] > self._limits(ctx)[b] * (1 + 1e-9):
+                raise OptimizationFailure(
+                    f"{self.name}: broker {b} stuck over capacity "
+                    f"({ctx.broker_load[b, r]:.1f} > {self._limits(ctx)[b]:.1f})"
+                )
+
+    def _shed(self, ctx: AnalyzerContext, b: int, optimized: Sequence[Goal]) -> None:
+        r = self.resource
+        limit = self._limits(ctx)[b]
+        replicas = broker_replicas(ctx, b)
+        # biggest contribution first
+        replicas.sort(key=lambda ps: -self._moved_load(ctx, *ps))
+        for p, s in replicas:
+            if ctx.broker_load[b, r] <= limit:
+                return
+            if ctx.partition_excluded(p):
+                continue
+            # leadership-bound resources: try handing off leadership first —
+            # cheaper than a data move (no replication traffic)
+            if ctx.is_leader(p, s) and r in (Resource.NW_OUT, Resource.CPU):
+                done = False
+                for new_slot in range(ctx.max_rf):
+                    if new_slot == s or ctx.assignment[p, new_slot] == EMPTY_SLOT:
+                        continue
+                    if accepted_leadership(ctx, p, new_slot, self, optimized):
+                        ctx.apply(leadership_action(ctx, p, new_slot))
+                        done = True
+                        break
+                if done:
+                    continue
+            ok = accepted_move_dests(ctx, p, s, self, optimized)
+            if not ok.any():
+                continue
+            util = ctx.broker_load[:, r] / np.maximum(ctx.broker_capacity[:, r], 1e-9)
+            ctx.apply(move_action(ctx, p, s, int(np.argmin(np.where(ok, util, np.inf)))))
+
+
+class DiskCapacityGoal(CapacityGoal):
+    name = "DiskCapacityGoal"
+    resource = Resource.DISK
+
+
+class NetworkInboundCapacityGoal(CapacityGoal):
+    name = "NetworkInboundCapacityGoal"
+    resource = Resource.NW_IN
+
+
+class NetworkOutboundCapacityGoal(CapacityGoal):
+    name = "NetworkOutboundCapacityGoal"
+    resource = Resource.NW_OUT
+
+
+class CpuCapacityGoal(CapacityGoal):
+    name = "CpuCapacityGoal"
+    resource = Resource.CPU

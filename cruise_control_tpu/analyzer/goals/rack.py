@@ -1,0 +1,142 @@
+"""Rack-awareness goals (upstream ``analyzer/goals/RackAwareGoal.java`` and
+``RackAwareDistributionGoal.java``; SURVEY.md §2.5 hard-goal row).
+
+* RackAwareGoal — no two replicas of a partition share a rack (requires
+  RF ≤ #alive racks).
+* RackAwareDistributionGoal — relaxed form for RF > #racks: replicas spread
+  across racks as evenly as possible (max per-rack count ≤ ⌈RF/#racks⌉).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import EMPTY_SLOT, Resource
+from cruise_control_tpu.analyzer.context import AnalyzerContext
+from cruise_control_tpu.analyzer.goals.base import (
+    Goal,
+    OptimizationFailure,
+    accepted_move_dests,
+    evacuate_offline_replicas,
+    move_action,
+)
+
+
+def _partition_rack_counts(ctx: AnalyzerContext, p: int, skip_slot: int = -1) -> np.ndarray:
+    """int [num_racks-upper-bound] — replicas of p per rack, optionally
+    excluding one slot (the candidate being moved)."""
+    counts = np.zeros(ctx.num_brokers, np.int32)  # rack ids < num_brokers
+    for s in range(ctx.max_rf):
+        if s == skip_slot:
+            continue
+        b = ctx.assignment[p, s]
+        if b != EMPTY_SLOT:
+            counts[ctx.broker_rack[b]] += 1
+    return counts
+
+
+class RackAwareGoal(Goal):
+    name = "RackAwareGoal"
+    is_hard = True
+
+    def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
+        used = _partition_rack_counts(ctx, p, skip_slot=s) > 0
+        return ~used[ctx.broker_rack]
+
+    def violations(self, ctx: AnalyzerContext) -> int:
+        # Excluded topics are outside this goal's jurisdiction (upstream
+        # RackAwareGoal skips excluded topics entirely).
+        v = 0
+        for p in range(ctx.num_partitions):
+            if ctx.partition_excluded(p):
+                continue
+            counts = _partition_rack_counts(ctx, p)
+            v += int((counts > 1).sum())
+        return v
+
+    def optimize(self, ctx: AnalyzerContext, optimized: Sequence[Goal]) -> None:
+        failed = evacuate_offline_replicas(ctx, self, optimized)
+        if failed:
+            raise OptimizationFailure(
+                f"{self.name}: {len(failed)} offline replicas could not be placed"
+            )
+        for p in range(ctx.num_partitions):
+            if ctx.partition_excluded(p):
+                continue
+            # move every replica whose rack is already taken by a
+            # lower-indexed replica of the same partition
+            seen: set = set()
+            for s in range(ctx.max_rf):
+                b = ctx.assignment[p, s]
+                if b == EMPTY_SLOT:
+                    continue
+                rack = int(ctx.broker_rack[b])
+                if rack not in seen:
+                    seen.add(rack)
+                    continue
+                ok = accepted_move_dests(ctx, p, s, self, optimized)
+                if not ok.any():
+                    raise OptimizationFailure(
+                        f"{self.name}: partition {p} replica {s} has no "
+                        f"rack-aware destination"
+                    )
+                util = ctx.utilization(Resource.DISK)
+                dest = int(np.argmin(np.where(ok, util, np.inf)))
+                ctx.apply(move_action(ctx, p, s, dest))
+                seen.add(int(ctx.broker_rack[dest]))
+
+
+class RackAwareDistributionGoal(Goal):
+    name = "RackAwareDistributionGoal"
+    is_hard = True
+
+    def _alive_racks(self, ctx: AnalyzerContext) -> int:
+        return len(set(ctx.broker_rack[ctx.broker_alive].tolist())) or 1
+
+    def _max_per_rack(self, ctx: AnalyzerContext, p: int) -> int:
+        rf = int((ctx.assignment[p] != EMPTY_SLOT).sum())
+        return math.ceil(rf / self._alive_racks(ctx))
+
+    def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
+        counts = _partition_rack_counts(ctx, p, skip_slot=s)
+        limit = self._max_per_rack(ctx, p)
+        return counts[ctx.broker_rack] + 1 <= limit
+
+    def violations(self, ctx: AnalyzerContext) -> int:
+        v = 0
+        for p in range(ctx.num_partitions):
+            if ctx.partition_excluded(p):
+                continue
+            counts = _partition_rack_counts(ctx, p)
+            v += int((counts > self._max_per_rack(ctx, p)).sum())
+        return v
+
+    def optimize(self, ctx: AnalyzerContext, optimized: Sequence[Goal]) -> None:
+        failed = evacuate_offline_replicas(ctx, self, optimized)
+        if failed:
+            raise OptimizationFailure(
+                f"{self.name}: {len(failed)} offline replicas could not be placed"
+            )
+        for p in range(ctx.num_partitions):
+            if ctx.partition_excluded(p):
+                continue
+            limit = self._max_per_rack(ctx, p)
+            # shed replicas from over-packed racks
+            for s in range(ctx.max_rf):
+                counts = _partition_rack_counts(ctx, p)
+                b = ctx.assignment[p, s]
+                if b == EMPTY_SLOT or counts[ctx.broker_rack[b]] <= limit:
+                    continue
+                ok = accepted_move_dests(ctx, p, s, self, optimized)
+                if not ok.any():
+                    raise OptimizationFailure(
+                        f"{self.name}: partition {p} replica {s} has no "
+                        f"distribution-legal destination"
+                    )
+                util = ctx.utilization(Resource.DISK)
+                ctx.apply(
+                    move_action(ctx, p, s, int(np.argmin(np.where(ok, util, np.inf))))
+                )

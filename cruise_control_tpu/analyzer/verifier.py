@@ -1,0 +1,117 @@
+"""Optimization verifier — the shared test oracle (upstream
+``analyzer/OptimizationVerifier.java``; SURVEY.md §4 tier-1).
+
+Checks any engine's OptimizerResult against the invariants upstream's random
+cluster tests assert: hard goals hold, soft violations didn't regress,
+proposals exactly reproduce the final placement, no replicas remain on dead /
+excluded brokers, excluded topics untouched.  Used to compare greedy vs TPU
+engines on identical inputs (greedy-parity, BASELINE.json metric)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import EMPTY_SLOT
+from cruise_control_tpu.analyzer.context import AnalyzerContext, OptimizationOptions
+from cruise_control_tpu.analyzer.goal_optimizer import OptimizerResult
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.models.cluster_state import ClusterState, sanity_check
+
+
+class VerificationError(AssertionError):
+    pass
+
+
+def verify_result(
+    initial: ClusterState,
+    result: OptimizerResult,
+    goals: Sequence[Goal],
+    options: Optional[OptimizationOptions] = None,
+) -> None:
+    options = options or OptimizationOptions()
+    final = result.final_state
+    sanity_check(final)
+
+    final_ctx = AnalyzerContext(final, options)
+
+    # 1. hard goals hold
+    for g in goals:
+        if g.is_hard:
+            v = g.violations(final_ctx)
+            if v:
+                raise VerificationError(f"hard goal {g.name} violated: {v}")
+
+    # 2. soft violation score did not regress
+    if result.violation_score_after > result.violation_score_before:
+        raise VerificationError(
+            f"violation score regressed: "
+            f"{result.violation_score_before} -> {result.violation_score_after}"
+        )
+
+    # 3. proposals reproduce the final placement exactly
+    a = np.array(initial.assignment)
+    ls = np.array(initial.leader_slot)
+    for prop in result.proposals:
+        p = prop.partition
+        old = [int(b) for b in a[p] if b != EMPTY_SLOT]
+        if set(old) != set(prop.old_replicas):
+            raise VerificationError(f"proposal {p}: stale old replicas")
+        row = np.full(a.shape[1], EMPTY_SLOT, a.dtype)
+        row[: len(prop.new_replicas)] = prop.new_replicas
+        a[p] = row
+        ls[p] = 0  # proposals are leader-first
+    fa = np.array(final.assignment)
+    fls = np.array(final.leader_slot)
+    for p in range(fa.shape[0]):
+        want = set(int(b) for b in fa[p] if b != EMPTY_SLOT)
+        got = set(int(b) for b in a[p] if b != EMPTY_SLOT)
+        if want != got:
+            raise VerificationError(f"partition {p}: proposals diverge from final")
+        want_leader = int(fa[p, fls[p]])
+        got_leader = int(a[p, ls[p]])
+        if want_leader != got_leader:
+            raise VerificationError(f"partition {p}: leader diverges")
+
+    # 4. nothing left on dead / removed brokers; no offline replicas
+    alive = np.array(final.broker_alive())
+    occupied = fa[fa != EMPTY_SLOT]
+    if not alive[occupied].all():
+        raise VerificationError("replicas remain on dead brokers")
+    if np.array(final.replica_offline).any():
+        raise VerificationError("offline replicas remain")
+    for b in options.brokers_to_remove:
+        if (fa == b).any():
+            raise VerificationError(f"removed broker {b} still hosts replicas")
+
+    # 5. excluded topics untouched — except partitions that *had* to move
+    # (replicas on dead/removed brokers: self-healing overrides exclusion,
+    # matching upstream's dead-broker precedence over excluded topics)
+    if options.excluded_topics:
+        topics = np.array(initial.partition_topic)
+        excluded = np.isin(topics, list(options.excluded_topics))
+        ia = np.array(initial.assignment)
+        init_alive = np.array(initial.broker_alive())
+        removed = np.zeros(init_alive.shape[0], bool)
+        if options.brokers_to_remove:
+            removed[list(options.brokers_to_remove)] = True
+        must_move = ((ia != EMPTY_SLOT) & (~init_alive | removed)[np.clip(ia, 0, None)]).any(
+            axis=1
+        ) | np.array(initial.replica_offline).any(axis=1)
+        frozen = excluded & ~must_move
+        if not (fa[frozen] == ia[frozen]).all():
+            raise VerificationError("excluded topic placement changed")
+
+
+def violation_score(
+    state: ClusterState, goals: Sequence[Goal], options: Optional[OptimizationOptions] = None
+) -> int:
+    """Aggregate goal-violation score (BASELINE.json metric; hard goals
+    weighted heavily so any hard violation dominates)."""
+    ctx = AnalyzerContext(state, options or OptimizationOptions())
+    score = 0
+    for g in goals:
+        v = g.violations(ctx)
+        score += v * (1000 if g.is_hard else 1)
+    return score

@@ -24,6 +24,18 @@ from cruise_control_tpu.common.resources import (
 from cruise_control_tpu.models.cluster_state import ClusterState
 
 
+def _resource_vec(x: Dict[Resource, float] | Sequence[float]) -> np.ndarray:
+    """Dict or sequence → f32[NUM_RESOURCES] vector."""
+    if isinstance(x, dict):
+        out = np.zeros(NUM_RESOURCES, np.float32)
+        for r, v in x.items():
+            out[int(r)] = v
+        return out
+    out = np.asarray(x, np.float32)
+    assert out.shape == (NUM_RESOURCES,)
+    return out
+
+
 @dataclasses.dataclass
 class _Broker:
     rack: int
@@ -61,14 +73,7 @@ class ClusterModelBuilder:
         state: BrokerState = BrokerState.ALIVE,
     ) -> int:
         rack_id = self.add_rack(rack) if isinstance(rack, str) else int(rack)
-        if isinstance(capacity, dict):
-            cap = np.zeros(NUM_RESOURCES, np.float32)
-            for r, v in capacity.items():
-                cap[int(r)] = v
-        else:
-            cap = np.asarray(capacity, np.float32)
-            assert cap.shape == (NUM_RESOURCES,)
-        self._brokers.append(_Broker(rack_id, cap, state))
+        self._brokers.append(_Broker(rack_id, _resource_vec(capacity), state))
         return len(self._brokers) - 1
 
     def topic_id(self, topic: str) -> int:
@@ -83,23 +88,15 @@ class ClusterModelBuilder:
         leader_slot: int = 0,
         offline: Optional[Sequence[bool]] = None,
     ) -> int:
-        def vec(x):
-            if isinstance(x, dict):
-                out = np.zeros(NUM_RESOURCES, np.float32)
-                for r, v in x.items():
-                    out[int(r)] = v
-                return out
-            return np.asarray(x, np.float32)
-
         # Default follower load per upstream semantics: replicates bytes-in
         # and disk, serves no bytes-out, and costs a fraction of leader CPU.
-        ll = vec(leader_load)
+        ll = _resource_vec(leader_load)
         if follower_load is None:
             fl = ll.copy()
             fl[Resource.NW_OUT] = 0.0
             fl[Resource.CPU] = ll[Resource.CPU] * FOLLOWER_CPU_RATIO
         else:
-            fl = vec(follower_load)
+            fl = _resource_vec(follower_load)
         self._partitions.append(
             _Partition(
                 topic=self.topic_id(topic),

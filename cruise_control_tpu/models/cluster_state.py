@@ -318,13 +318,15 @@ def sanity_check(state: ClusterState) -> None:
     b = state.num_brokers
     assert state.broker_rack.shape == (b,)
     assert state.broker_state.shape == (b,)
-    assert a.max() < b, "assignment references unknown broker"
-    assert a.min() >= EMPTY_SLOT
+    if a.size:
+        assert a.max() < b, "assignment references unknown broker"
+        assert a.min() >= EMPTY_SLOT
     ls = np.asarray(state.leader_slot)
     assert (ls >= 0).all() and (ls < s).all()
     # leader slot must be occupied
-    leader_brokers = np.take_along_axis(a, ls[:, None], axis=1)[:, 0]
-    assert (leader_brokers != EMPTY_SLOT).all(), "leader on empty slot"
+    if a.size:
+        leader_brokers = np.take_along_axis(a, ls[:, None], axis=1)[:, 0]
+        assert (leader_brokers != EMPTY_SLOT).all(), "leader on empty slot"
     # no duplicate brokers within a partition (ignoring empty slots)
     for row in a:
         occ = row[row != EMPTY_SLOT]

@@ -1,0 +1,299 @@
+"""Per-goal unit tests (upstream analyzer/goals/*Test.java tier) and
+AnalyzerContext incremental-aggregate invariants."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import BrokerState, Resource
+from cruise_control_tpu.analyzer.actions import ActionType, BalancingAction
+from cruise_control_tpu.analyzer.context import AnalyzerContext, OptimizationOptions
+from cruise_control_tpu.analyzer.goals.base import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.capacity import (
+    DiskCapacityGoal,
+    ReplicaCapacityGoal,
+)
+from cruise_control_tpu.analyzer.goals.distribution import (
+    BrokerSetAwareGoal,
+    DiskUsageDistributionGoal,
+    LeaderReplicaDistributionGoal,
+    MinTopicLeadersPerBrokerGoal,
+    PreferredLeaderElectionGoal,
+    ReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.goals.rack import RackAwareGoal
+from cruise_control_tpu.models.builder import ClusterModelBuilder
+from cruise_control_tpu.models.generators import (
+    rack_unaware_cluster,
+    random_cluster,
+    small_deterministic_cluster,
+)
+
+
+def ctx_of(state, **kw):
+    return AnalyzerContext(state, OptimizationOptions(**kw))
+
+
+def test_context_aggregates_match_recount_after_moves():
+    state = random_cluster(seed=11, num_brokers=12, num_partitions=200)
+    ctx = ctx_of(state)
+    rng = np.random.default_rng(0)
+    applied = 0
+    for _ in range(50):
+        p = int(rng.integers(ctx.num_partitions))
+        s = int(rng.integers(ctx.max_rf))
+        dests = [
+            b for b in range(ctx.num_brokers) if b not in ctx.assignment[p]
+        ]
+        if not dests:
+            continue
+        ctx.apply(
+            BalancingAction(
+                ActionType.INTER_BROKER_REPLICA_MOVEMENT,
+                p, s, int(ctx.assignment[p, s]), dests[0],
+            )
+        )
+        applied += 1
+    assert applied > 30
+    ctx.recompute_check()
+
+
+def test_context_leadership_aggregates():
+    state = small_deterministic_cluster()
+    ctx = ctx_of(state)
+    ctx.apply(
+        BalancingAction(
+            ActionType.LEADERSHIP_MOVEMENT, 0, 0,
+            ctx.leader_broker(0), int(ctx.assignment[0, 1]), dest_slot=1,
+        )
+    )
+    ctx.recompute_check()
+    assert ctx.leader_broker(0) == 1
+
+
+def test_rack_aware_goal_fixes_conflicts():
+    state = rack_unaware_cluster()
+    goal = RackAwareGoal()
+    ctx = ctx_of(state)
+    assert goal.violations(ctx) == 2
+    goal.optimize(ctx, [])
+    assert goal.violations(ctx) == 0
+    ctx.recompute_check()
+
+
+def test_rack_aware_acceptance_blocks_same_rack():
+    state = rack_unaware_cluster()  # b0,b1 in r0; b2,b3 in r1
+    goal = RackAwareGoal()
+    ctx = ctx_of(state)
+    # partition 2 = [b0, b2]; moving slot 0 (b0) to b1 keeps r0 free (ok),
+    # moving to b3 collides with b2's rack r1
+    mask = goal.accept_move(ctx, 2, 0)
+    assert mask[1] and not mask[3]
+
+
+def test_replica_capacity_goal():
+    b = ClusterModelBuilder()
+    cap = {r: 1e9 for r in Resource}
+    for i in range(4):
+        b.add_broker(f"r{i}", cap)
+    for i in range(9):
+        b.add_partition("T", [0], {Resource.DISK: 1.0})
+    state = b.build()
+    constraint = BalancingConstraint(max_replicas_per_broker=3)
+    goal = ReplicaCapacityGoal(constraint)
+    ctx = ctx_of(state)
+    assert goal.violations(ctx) == 1
+    goal.optimize(ctx, [])
+    assert goal.violations(ctx) == 0
+    assert ctx.broker_replica_count.max() <= 3
+    ctx.recompute_check()
+
+
+def test_disk_capacity_goal_sheds_overload():
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 1e9, Resource.NW_IN: 1e9, Resource.NW_OUT: 1e9,
+           Resource.DISK: 100.0}
+    for i in range(3):
+        b.add_broker(f"r{i}", cap)
+    # 6 partitions of 20 MB all on broker 0 -> 120 > 80 (threshold .8)
+    for i in range(6):
+        b.add_partition("T", [0], {Resource.DISK: 20.0})
+    state = b.build()
+    goal = DiskCapacityGoal()
+    ctx = ctx_of(state)
+    assert goal.violations(ctx) == 1
+    goal.optimize(ctx, [])
+    assert goal.violations(ctx) == 0
+    assert ctx.broker_load[0, Resource.DISK] <= 80.0 + 1e-6
+    ctx.recompute_check()
+
+
+def test_dead_broker_evacuation_via_hard_goal():
+    state = random_cluster(seed=21, num_brokers=10, num_partitions=60,
+                           dead_brokers=2)
+    goal = RackAwareGoal()
+    ctx = ctx_of(state)
+    goal.optimize(ctx, [])
+    assert not ctx.replica_offline.any()
+    dead = ~ctx.broker_alive
+    assert not np.isin(ctx.assignment, np.nonzero(dead)[0]).any()
+    ctx.recompute_check()
+
+
+def test_disk_usage_distribution_balances():
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 1e9, Resource.NW_IN: 1e9, Resource.NW_OUT: 1e9,
+           Resource.DISK: 1000.0}
+    for i in range(4):
+        b.add_broker(f"r{i % 2}", cap)
+    # all load on brokers 0/1
+    for i in range(8):
+        b.add_partition("T%d" % (i % 2), [i % 2], {Resource.DISK: 50.0})
+    state = b.build()
+    goal = DiskUsageDistributionGoal()
+    ctx = ctx_of(state)
+    before = goal.violations(ctx)
+    assert before > 0
+    goal.optimize(ctx, [])
+    assert goal.violations(ctx) < before
+    ctx.recompute_check()
+
+
+def test_replica_distribution_balances_counts():
+    b = ClusterModelBuilder()
+    cap = {r: 1e9 for r in Resource}
+    for i in range(4):
+        b.add_broker(f"r{i}", cap)
+    for i in range(12):
+        b.add_partition("T", [0], {Resource.DISK: 1.0})
+    state = b.build()
+    goal = ReplicaDistributionGoal()
+    ctx = ctx_of(state)
+    goal.optimize(ctx, [])
+    counts = ctx.broker_replica_count
+    assert counts.max() - counts.min() <= 2
+    ctx.recompute_check()
+
+
+def test_leader_distribution_moves_leadership():
+    b = ClusterModelBuilder()
+    cap = {r: 1e9 for r in Resource}
+    for i in range(3):
+        b.add_broker(f"r{i}", cap)
+    # all leaders on broker 0, followers spread
+    for i in range(9):
+        b.add_partition("T", [0, 1 + i % 2], {Resource.DISK: 1.0})
+    state = b.build()
+    goal = LeaderReplicaDistributionGoal()
+    ctx = ctx_of(state)
+    before = ctx.broker_leader_count.copy()
+    goal.optimize(ctx, [])
+    after = ctx.broker_leader_count
+    assert after.max() < before.max()
+    ctx.recompute_check()
+
+
+def test_preferred_leader_election():
+    b = ClusterModelBuilder()
+    cap = {r: 1e9 for r in Resource}
+    for i in range(3):
+        b.add_broker(f"r{i}", cap)
+    b.add_partition("T", [0, 1], {Resource.DISK: 1.0}, leader_slot=1)
+    b.add_partition("T", [1, 2], {Resource.DISK: 1.0}, leader_slot=0)
+    state = b.build()
+    goal = PreferredLeaderElectionGoal()
+    ctx = ctx_of(state)
+    assert goal.violations(ctx) == 1
+    goal.optimize(ctx, [])
+    assert goal.violations(ctx) == 0
+    assert ctx.leader_slot[0] == 0
+
+
+def test_min_topic_leaders_goal():
+    b = ClusterModelBuilder()
+    cap = {r: 1e9 for r in Resource}
+    for i in range(2):
+        b.add_broker(f"r{i}", cap)
+    # topic 0 with 4 partitions, all led by broker 0, followers on broker 1
+    for i in range(4):
+        b.add_partition("Watched", [0, 1], {Resource.DISK: 1.0})
+    state = b.build()
+    constraint = BalancingConstraint(
+        min_topic_leaders_per_broker=1, min_topic_leaders_topics={0}
+    )
+    goal = MinTopicLeadersPerBrokerGoal(constraint)
+    ctx = ctx_of(state)
+    assert goal.violations(ctx) == 1  # broker 1 has no leaders
+    goal.optimize(ctx, [])
+    assert goal.violations(ctx) == 0
+    ctx.recompute_check()
+
+
+def test_broker_set_aware_goal():
+    b = ClusterModelBuilder()
+    cap = {r: 1e9 for r in Resource}
+    for i in range(4):
+        b.add_broker(f"r{i}", cap)
+    b.add_partition("Pinned", [0, 3], {Resource.DISK: 1.0})
+    state = b.build()
+    constraint = BalancingConstraint(broker_sets={0: {0, 1}})
+    goal = BrokerSetAwareGoal(constraint)
+    ctx = ctx_of(state)
+    assert goal.violations(ctx) == 1  # replica on b3 outside {0,1}
+    goal.optimize(ctx, [])
+    assert goal.violations(ctx) == 0
+    assert set(int(x) for x in ctx.assignment[0]) == {0, 1}
+
+
+def test_excluded_topics_respected():
+    state = random_cluster(seed=31, num_brokers=6, num_partitions=40,
+                           num_topics=4)
+    excluded = {0}
+    goal = DiskUsageDistributionGoal()
+    ctx = ctx_of(state, excluded_topics=excluded)
+    before = ctx.assignment.copy()
+    goal.optimize(ctx, [])
+    topics = ctx.partition_topic
+    mask = np.isin(topics, list(excluded))
+    assert (ctx.assignment[mask] == before[mask]).all()
+
+
+def test_capacity_goal_excluded_topic_fails_loudly():
+    """Hard goal that can only be satisfied by moving excluded replicas must
+    raise, not silently move them (code-review regression)."""
+    from cruise_control_tpu.analyzer.goal_optimizer import GoalOptimizer, make_goals
+    from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
+
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 1e9, Resource.NW_IN: 1e9, Resource.NW_OUT: 1e9,
+           Resource.DISK: 100.0}
+    for i in range(3):
+        b.add_broker(f"r{i}", cap)
+    for i in range(2):
+        b.add_partition("T", [0], {Resource.DISK: 45.0})
+    with pytest.raises(OptimizationFailure):
+        GoalOptimizer(make_goals(["DiskCapacityGoal"])).optimize(
+            b.build(), OptimizationOptions(excluded_topics={0})
+        )
+
+
+def test_swap_records_single_action():
+    state = small_deterministic_cluster()
+    ctx = ctx_of(state)
+    ctx.apply(
+        BalancingAction(
+            ActionType.INTER_BROKER_REPLICA_SWAP,
+            partition=0, slot=1, source_broker=1, dest_broker=2,
+            swap_partition=2, swap_slot=0,
+        )
+    )
+    assert len(ctx.actions) == 1
+    assert ctx.actions[0].action_type == ActionType.INTER_BROKER_REPLICA_SWAP
+    ctx.recompute_check()
+
+
+def test_sanity_check_empty_cluster():
+    from cruise_control_tpu.models.cluster_state import sanity_check
+
+    b = ClusterModelBuilder()
+    b.add_broker("r0", {r: 1.0 for r in Resource})
+    sanity_check(b.build())  # brokers-only cluster is valid

@@ -1,0 +1,96 @@
+"""End-to-end optimizer tests on random clusters (upstream
+RandomClusterTest + OptimizationVerifier tier; SURVEY.md §4 tier-1)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.goal_optimizer import (
+    DEFAULT_GOAL_ORDER,
+    GoalOptimizer,
+    make_goals,
+)
+from cruise_control_tpu.analyzer.verifier import (
+    verify_result,
+    violation_score,
+)
+from cruise_control_tpu.models.generators import (
+    Distribution,
+    random_cluster,
+)
+
+
+@pytest.mark.parametrize(
+    "dist", [Distribution.UNIFORM, Distribution.LINEAR, Distribution.EXPONENTIAL]
+)
+def test_full_stack_random_cluster(dist):
+    state = random_cluster(
+        seed=17, num_brokers=20, num_racks=5, num_partitions=300,
+        distribution=dist, mean_utilization=0.4,
+    )
+    goals = make_goals()
+    opt = GoalOptimizer(goals)
+    result = opt.optimize(state)
+    verify_result(state, result, goals)
+    assert violation_score(result.final_state, goals) <= violation_score(state, goals)
+
+
+def test_self_healing_dead_broker_replan():
+    """BASELINE.json config #4: remove_broker / dead-broker replan."""
+    state = random_cluster(
+        seed=23, num_brokers=12, num_racks=4, num_partitions=150,
+        dead_brokers=2,
+    )
+    goals = make_goals()
+    opt = GoalOptimizer(goals)
+    result = opt.optimize(state)
+    verify_result(state, result, goals)
+    # every replica off the dead brokers
+    fa = np.array(result.final_state.assignment)
+    assert not np.isin(fa, [10, 11]).any()
+
+
+def test_add_broker_replan():
+    state = random_cluster(
+        seed=29, num_brokers=10, num_racks=5, num_partitions=120,
+        new_brokers=2,
+    )
+    goals = make_goals()
+    result = GoalOptimizer(goals).optimize(state)
+    verify_result(state, result, goals)
+    # new brokers (8, 9) received replicas
+    fa = np.array(result.final_state.assignment)
+    assert np.isin(fa, [8, 9]).sum() > 0
+
+
+def test_remove_brokers_option():
+    state = random_cluster(seed=37, num_brokers=8, num_racks=4, num_partitions=80)
+    goals = make_goals()
+    options = OptimizationOptions(brokers_to_remove={7})
+    result = GoalOptimizer(goals).optimize(state, options)
+    verify_result(state, result, goals, options)
+    fa = np.array(result.final_state.assignment)
+    assert not (fa == 7).any()
+
+
+def test_proposals_roundtrip_and_summary():
+    state = random_cluster(seed=41, num_brokers=10, num_partitions=100)
+    goals = make_goals()
+    result = GoalOptimizer(goals).optimize(state)
+    verify_result(state, result, goals)
+    s = result.summary()
+    assert s["engine"] == "greedy"
+    assert s["numProposals"] == len(result.proposals)
+    for prop in result.proposals:
+        d = prop.to_json()
+        assert d["newReplicas"][0] == d["newLeader"]
+
+
+def test_hard_goals_only_stack():
+    """BASELINE.json config #2 goal subset."""
+    state = random_cluster(seed=43, num_brokers=15, num_racks=5, num_partitions=200)
+    goals = make_goals(
+        ["RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal"]
+    )
+    result = GoalOptimizer(goals).optimize(state)
+    verify_result(state, result, goals)
