@@ -1,0 +1,159 @@
+"""Cluster backend SPI + in-process simulated implementation.
+
+The reference executes plans through the Kafka admin protocol
+(``alterPartitionReassignments`` / ``electLeaders`` / dynamic-config
+throttles; upstream ``executor/Executor.java``, SURVEY.md §2.6).  Here the
+admin surface is an explicit interface; the build environment has no Kafka and
+no network, so the first-class implementation is a **simulated cluster** — a
+deterministic state machine that applies reassignments with configurable
+latency and failure injection (SURVEY.md §4 tier-3 "embedded cluster"
+equivalent).  A real-Kafka adapter implements the same interface out of tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclasses.dataclass
+class PartitionState:
+    replicas: List[int]
+    leader: int
+    #: replicas still catching up (in-flight adds); subset of ``replicas``
+    catching_up: Set[int] = dataclasses.field(default_factory=set)
+
+    @property
+    def isr(self) -> List[int]:
+        return [b for b in self.replicas if b not in self.catching_up]
+
+
+class ClusterBackend:
+    """Admin-protocol seam (one method per upstream AdminClient call)."""
+
+    def alter_partition_reassignments(
+        self, reassignments: Dict[int, Sequence[int]]
+    ) -> None:
+        raise NotImplementedError
+
+    def elect_leaders(self, partitions: Dict[int, int]) -> None:
+        """partition → preferred leader broker."""
+        raise NotImplementedError
+
+    def ongoing_reassignments(self) -> Set[int]:
+        raise NotImplementedError
+
+    def partition_state(self, partition: int) -> PartitionState:
+        raise NotImplementedError
+
+    def set_throttles(self, rate: float, partitions: Sequence[int]) -> None:
+        raise NotImplementedError
+
+    def clear_throttles(self) -> None:
+        raise NotImplementedError
+
+    def alive_brokers(self) -> Set[int]:
+        raise NotImplementedError
+
+    def under_replicated_partitions(self) -> Set[int]:
+        raise NotImplementedError
+
+
+class SimulatedClusterBackend(ClusterBackend):
+    """Deterministic in-memory cluster.
+
+    Reassignment model: when a reassignment arrives, new replicas enter
+    ``catching_up``; each :meth:`tick` advances every catching-up replica's
+    progress by one step; after ``move_latency_ticks`` steps the replica
+    joins the ISR and dropped replicas leave.  Failure injection: brokers in
+    ``failed_brokers`` never finish catch-up (their tasks eventually go DEAD
+    via the executor's timeout), and ``fail_partitions`` aborts those
+    reassignments outright.
+    """
+
+    def __init__(
+        self,
+        assignment: Dict[int, Sequence[int]],
+        leaders: Dict[int, int],
+        move_latency_ticks: int = 1,
+        failed_brokers: Optional[Set[int]] = None,
+        fail_partitions: Optional[Set[int]] = None,
+    ):
+        self.partitions: Dict[int, PartitionState] = {
+            p: PartitionState(list(reps), leaders[p]) for p, reps in assignment.items()
+        }
+        self.move_latency_ticks = move_latency_ticks
+        self.failed_brokers = failed_brokers or set()
+        self.fail_partitions = fail_partitions or set()
+        self._target: Dict[int, Tuple[List[int], List[int]]] = {}  # p -> (new, old)
+        self._progress: Dict[int, int] = {}
+        self.throttle_rate: Optional[float] = None
+        self.throttled_partitions: Set[int] = set()
+        self.throttle_history: List[Tuple[str, float]] = []
+        self.ticks = 0
+
+    # ---- admin surface ----------------------------------------------------------
+    def alter_partition_reassignments(
+        self, reassignments: Dict[int, Sequence[int]]
+    ) -> None:
+        for p, new_replicas in reassignments.items():
+            st = self.partitions[p]
+            if p in self.fail_partitions:
+                continue  # silently dropped; executor will time out → DEAD
+            new = list(new_replicas)
+            adds = [b for b in new if b not in st.replicas]
+            st.replicas = list(dict.fromkeys(st.replicas + adds))
+            st.catching_up.update(adds)
+            self._target[p] = (new, [b for b in st.replicas if b not in new])
+            self._progress[p] = 0
+
+    def elect_leaders(self, partitions: Dict[int, int]) -> None:
+        for p, leader in partitions.items():
+            st = self.partitions[p]
+            if leader in st.isr:
+                st.leader = leader
+
+    def ongoing_reassignments(self) -> Set[int]:
+        return set(self._target)
+
+    def partition_state(self, partition: int) -> PartitionState:
+        return self.partitions[partition]
+
+    def set_throttles(self, rate: float, partitions: Sequence[int]) -> None:
+        self.throttle_rate = rate
+        self.throttled_partitions = set(partitions)
+        self.throttle_history.append(("set", rate))
+
+    def clear_throttles(self) -> None:
+        self.throttle_rate = None
+        self.throttled_partitions = set()
+        self.throttle_history.append(("clear", 0.0))
+
+    def alive_brokers(self) -> Set[int]:
+        out: Set[int] = set()
+        for st in self.partitions.values():
+            out.update(st.replicas)
+        return out - self.failed_brokers
+
+    def under_replicated_partitions(self) -> Set[int]:
+        return {p for p, st in self.partitions.items() if st.catching_up}
+
+    # ---- simulation -------------------------------------------------------------
+    def tick(self) -> None:
+        self.ticks += 1
+        done: List[int] = []
+        for p, (new, dropped) in self._target.items():
+            st = self.partitions[p]
+            blocked = any(b in self.failed_brokers for b in st.catching_up)
+            if blocked:
+                continue
+            self._progress[p] += 1
+            if self._progress[p] >= self.move_latency_ticks:
+                st.catching_up -= set(new)
+                st.replicas = list(new)
+                if st.leader not in st.replicas:
+                    st.leader = st.replicas[0]
+                done.append(p)
+        for p in done:
+            del self._target[p]
+            del self._progress[p]

@@ -1,0 +1,261 @@
+"""Executor — applies an optimizer plan to the cluster (upstream
+``executor/Executor.java`` + ``ReplicationThrottleHelper`` +
+``ConcurrencyAdjuster``; SURVEY.md §2.6, call stack §3.2 tail).
+
+Single-writer by design (upstream's ``hasOngoingExecution`` guard): one
+execution at a time; state machine NO_TASK_IN_PROGRESS → STARTING_EXECUTION →
+*_IN_PROGRESS → (STOPPING_EXECUTION) → NO_TASK_IN_PROGRESS.  The drive loop is
+tick-based against the :class:`ClusterBackend` seam, so tests and the
+simulated cluster advance deterministically; a real-Kafka adapter polls on
+wall-clock ticks instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Set
+
+from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
+from cruise_control_tpu.executor.backend import ClusterBackend
+from cruise_control_tpu.executor.tasks import (
+    ExecutionTask,
+    ExecutionTaskPlanner,
+    ReplicaMovementStrategy,
+    TaskState,
+    TaskType,
+)
+
+
+class ExecutorStateValue(enum.Enum):
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = (
+        "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    )
+    LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+@dataclasses.dataclass
+class ExecutorConfig:
+    """Upstream ExecutorConfig keys (SURVEY.md §5.6)."""
+
+    num_concurrent_partition_movements_per_broker: int = 5
+    num_concurrent_leader_movements: int = 1000
+    #: ticks an in-progress move may take before being declared DEAD
+    task_timeout_ticks: int = 100
+    #: replication throttle rate (bytes/s) applied during execution; None = off
+    replication_throttle: Optional[float] = None
+    #: adaptive concurrency: halve caps when URP count exceeds this
+    concurrency_adjuster_urp_threshold: int = 1 << 30
+    #: safety ceiling for one execution's total moves
+    max_inter_broker_moves: int = 1 << 30
+
+
+@dataclasses.dataclass
+class ExecutionResult:
+    completed: int
+    dead: int
+    aborted: int
+    ticks: int
+    stopped: bool
+
+    @property
+    def succeeded(self) -> bool:
+        return not self.stopped and self.dead == 0 and self.aborted == 0
+
+
+class OngoingExecutionError(RuntimeError):
+    pass
+
+
+class Executor:
+    """Drives proposals to completion against a backend."""
+
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        config: Optional[ExecutorConfig] = None,
+        notifier=None,
+    ):
+        self.backend = backend
+        self.config = config or ExecutorConfig()
+        self.notifier = notifier
+        self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
+        self._stop_requested = False
+        self.planner: Optional[ExecutionTaskPlanner] = None
+        self.history: List[ExecutionResult] = []
+
+    # ---- public API -------------------------------------------------------------
+    @property
+    def has_ongoing_execution(self) -> bool:
+        return self.state != ExecutorStateValue.NO_TASK_IN_PROGRESS
+
+    def stop_execution(self) -> None:
+        """Upstream STOP_PROPOSAL_EXECUTION endpoint."""
+        if self.has_ongoing_execution:
+            self._stop_requested = True
+
+    def execute_proposals(
+        self,
+        proposals: Sequence[ExecutionProposal],
+        strategy: Optional[ReplicaMovementStrategy] = None,
+        partition_sizes: Optional[Dict[int, float]] = None,
+        max_ticks: int = 10_000,
+    ) -> ExecutionResult:
+        """Run a plan to completion (or stop/abort).  Synchronous drive loop;
+        async task submission lives in the server layer (UserTaskManager)."""
+        if self.has_ongoing_execution:
+            raise OngoingExecutionError("an execution is already in progress")
+        self.state = ExecutorStateValue.STARTING_EXECUTION
+        self._stop_requested = False
+        sizes = partition_sizes or {}
+        planner = ExecutionTaskPlanner(strategy)
+        planner.add_proposals(proposals)
+        self.planner = planner
+
+        if self.config.replication_throttle is not None:
+            moving = [
+                t.proposal.partition
+                for t in planner.replica_tasks
+            ]
+            self.backend.set_throttles(self.config.replication_throttle, moving)
+
+        ticks = 0
+        try:
+            ticks = self._drive_replica_moves(planner, sizes, max_ticks)
+            if not self._stop_requested:
+                self._drive_leader_moves(planner)
+        finally:
+            if self.config.replication_throttle is not None:
+                self.backend.clear_throttles()
+            completed = sum(
+                1 for t in planner.all_tasks if t.state == TaskState.COMPLETED
+            )
+            dead = sum(1 for t in planner.all_tasks if t.state == TaskState.DEAD)
+            aborted = sum(
+                1 for t in planner.all_tasks if t.state == TaskState.ABORTED
+            )
+            result = ExecutionResult(
+                completed=completed,
+                dead=dead,
+                aborted=aborted,
+                ticks=ticks,
+                stopped=self._stop_requested,
+            )
+            self.history.append(result)
+            self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
+            if self.notifier is not None:
+                self.notifier(result)
+        return result
+
+    # ---- drive loops ------------------------------------------------------------
+    def _caps(self) -> int:
+        cap = self.config.num_concurrent_partition_movements_per_broker
+        urp = len(self.backend.under_replicated_partitions())
+        if urp > self.config.concurrency_adjuster_urp_threshold:
+            cap = max(1, cap // 2)  # upstream ConcurrencyAdjuster back-off
+        return cap
+
+    def _drive_replica_moves(
+        self, planner: ExecutionTaskPlanner, sizes: Dict[int, float], max_ticks: int
+    ) -> int:
+        self.state = (
+            ExecutorStateValue.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        )
+        in_flight: Dict[int, ExecutionTask] = {}
+        in_flight_per_broker: Dict[int, int] = {}
+        ticks = 0
+        while ticks < max_ticks:
+            if self._stop_requested:
+                self.state = ExecutorStateValue.STOPPING_EXECUTION
+                for t in planner.replica_tasks:
+                    if t.state == TaskState.PENDING:
+                        t.transition(TaskState.ABORTED)
+                    elif t.state == TaskState.IN_PROGRESS:
+                        t.transition(TaskState.ABORTING)
+                        t.transition(TaskState.ABORTED)
+                return ticks
+            batch = planner.next_replica_batch(
+                in_flight_per_broker,
+                self._caps(),
+                sizes,
+                self.backend.under_replicated_partitions(),
+            )
+            if batch:
+                reassignments = {
+                    t.proposal.partition: t.proposal.new_replicas for t in batch
+                }
+                self.backend.alter_partition_reassignments(reassignments)
+                for t in batch:
+                    t.transition(TaskState.IN_PROGRESS)
+                    t.started_tick = ticks
+                    in_flight[t.proposal.partition] = t
+                    for b in t.participating_brokers:
+                        in_flight_per_broker[b] = in_flight_per_broker.get(b, 0) + 1
+            if not in_flight:
+                break
+            # advance the world one tick and harvest completions
+            tick = getattr(self.backend, "tick", None)
+            if tick is not None:
+                tick()
+            ticks += 1
+            ongoing = self.backend.ongoing_reassignments()
+            finished = [p for p in in_flight if p not in ongoing]
+            for p in finished:
+                t = in_flight.pop(p)
+                st = self.backend.partition_state(p)
+                ok = list(st.replicas) == list(t.proposal.new_replicas)
+                t.transition(TaskState.COMPLETED if ok else TaskState.DEAD)
+                t.finished_tick = ticks
+                for b in t.participating_brokers:
+                    in_flight_per_broker[b] -= 1
+            # time out stuck moves (upstream: mark DEAD, leave reassignment)
+            for p, t in list(in_flight.items()):
+                if ticks - t.started_tick > self.config.task_timeout_ticks:
+                    t.transition(TaskState.DEAD)
+                    t.finished_tick = ticks
+                    in_flight.pop(p)
+                    for b in t.participating_brokers:
+                        in_flight_per_broker[b] -= 1
+        return ticks
+
+    def _drive_leader_moves(self, planner: ExecutionTaskPlanner) -> None:
+        self.state = ExecutorStateValue.LEADER_MOVEMENT_TASK_IN_PROGRESS
+        while True:
+            if self._stop_requested:
+                self.state = ExecutorStateValue.STOPPING_EXECUTION
+                for t in planner.leader_tasks:
+                    if t.state == TaskState.PENDING:
+                        t.transition(TaskState.ABORTED)
+                return
+            batch = planner.next_leader_batch(
+                self.config.num_concurrent_leader_movements
+            )
+            if not batch:
+                return
+            elections = {
+                t.proposal.partition: t.proposal.new_leader for t in batch
+            }
+            self.backend.elect_leaders(elections)
+            for t in batch:
+                t.transition(TaskState.IN_PROGRESS)
+                st = self.backend.partition_state(t.proposal.partition)
+                t.transition(
+                    TaskState.COMPLETED
+                    if st.leader == t.proposal.new_leader
+                    else TaskState.DEAD
+                )
+
+    # ---- observability ----------------------------------------------------------
+    def state_summary(self) -> dict:
+        tasks = self.planner.all_tasks if self.planner else []
+        by_state: Dict[str, int] = {}
+        for t in tasks:
+            by_state[t.state.value] = by_state.get(t.state.value, 0) + 1
+        return {
+            "state": self.state.value,
+            "taskCounts": by_state,
+            "stopRequested": self._stop_requested,
+        }

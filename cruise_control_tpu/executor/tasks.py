@@ -1,0 +1,187 @@
+"""Execution task machinery (upstream ``executor/ExecutionTask*.java``,
+SURVEY.md §2.6): proposal → per-move tasks with a state machine, batching
+under per-broker concurrency caps, and pluggable movement ordering."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Set
+
+from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
+
+
+class TaskState(enum.Enum):
+    PENDING = "PENDING"
+    IN_PROGRESS = "IN_PROGRESS"
+    COMPLETED = "COMPLETED"
+    ABORTING = "ABORTING"
+    ABORTED = "ABORTED"
+    DEAD = "DEAD"
+
+
+class TaskType(enum.Enum):
+    INTER_BROKER_REPLICA_ACTION = "INTER_BROKER_REPLICA_ACTION"
+    LEADER_ACTION = "LEADER_ACTION"
+    INTRA_BROKER_REPLICA_ACTION = "INTRA_BROKER_REPLICA_ACTION"
+
+
+_VALID_TRANSITIONS = {
+    TaskState.PENDING: {TaskState.IN_PROGRESS, TaskState.ABORTED},
+    TaskState.IN_PROGRESS: {
+        TaskState.COMPLETED,
+        TaskState.ABORTING,
+        TaskState.DEAD,
+    },
+    TaskState.ABORTING: {TaskState.ABORTED, TaskState.DEAD},
+    TaskState.COMPLETED: set(),
+    TaskState.ABORTED: set(),
+    TaskState.DEAD: set(),
+}
+
+
+@dataclasses.dataclass
+class ExecutionTask:
+    task_id: int
+    task_type: TaskType
+    proposal: ExecutionProposal
+    state: TaskState = TaskState.PENDING
+    started_tick: int = -1
+    finished_tick: int = -1
+
+    def transition(self, new_state: TaskState) -> None:
+        if new_state not in _VALID_TRANSITIONS[self.state]:
+            raise ValueError(f"illegal transition {self.state} -> {new_state}")
+        self.state = new_state
+
+    @property
+    def added_brokers(self) -> Set[int]:
+        return set(self.proposal.new_replicas) - set(self.proposal.old_replicas)
+
+    @property
+    def removed_brokers(self) -> Set[int]:
+        return set(self.proposal.old_replicas) - set(self.proposal.new_replicas)
+
+    @property
+    def participating_brokers(self) -> Set[int]:
+        return self.added_brokers | self.removed_brokers
+
+
+# ---------------------------------------------------------------------------------
+# Movement strategies (upstream executor/strategy/*.java)
+# ---------------------------------------------------------------------------------
+
+class ReplicaMovementStrategy:
+    """Orders pending inter-broker tasks; chainable like upstream."""
+
+    name = "BaseReplicaMovementStrategy"
+
+    def sort_key(self, task: ExecutionTask, sizes: Dict[int, float],
+                 urp: Set[int]) -> tuple:
+        return (task.task_id,)
+
+    def order(
+        self,
+        tasks: Sequence[ExecutionTask],
+        sizes: Dict[int, float],
+        urp: Set[int],
+    ) -> List[ExecutionTask]:
+        return sorted(tasks, key=lambda t: self.sort_key(t, sizes, urp))
+
+
+class PrioritizeLargeReplicaMovementStrategy(ReplicaMovementStrategy):
+    name = "PrioritizeLargeReplicaMovementStrategy"
+
+    def sort_key(self, task, sizes, urp):
+        return (-sizes.get(task.proposal.partition, 0.0), task.task_id)
+
+
+class PrioritizeSmallReplicaMovementStrategy(ReplicaMovementStrategy):
+    name = "PrioritizeSmallReplicaMovementStrategy"
+
+    def sort_key(self, task, sizes, urp):
+        return (sizes.get(task.proposal.partition, 0.0), task.task_id)
+
+
+class PostponeUrpReplicaMovementStrategy(ReplicaMovementStrategy):
+    """Move healthy partitions first; under-replicated ones last."""
+
+    name = "PostponeUrpReplicaMovementStrategy"
+
+    def sort_key(self, task, sizes, urp):
+        return (task.proposal.partition in urp, task.task_id)
+
+
+class PrioritizeMinIsrWithOfflineReplicasStrategy(ReplicaMovementStrategy):
+    """Fix at/under-min-ISR partitions with offline replicas first."""
+
+    name = "PrioritizeMinIsrWithOfflineReplicasStrategy"
+
+    def sort_key(self, task, sizes, urp):
+        return (task.proposal.partition not in urp, task.task_id)
+
+
+# ---------------------------------------------------------------------------------
+# Planner (upstream ExecutionTaskPlanner)
+# ---------------------------------------------------------------------------------
+
+class ExecutionTaskPlanner:
+    """Splits proposals into typed tasks and serves broker-cap-respecting
+    batches in strategy order."""
+
+    def __init__(
+        self,
+        strategy: Optional[ReplicaMovementStrategy] = None,
+    ):
+        self.strategy = strategy or ReplicaMovementStrategy()
+        self._next_id = 0
+        self.replica_tasks: List[ExecutionTask] = []
+        self.leader_tasks: List[ExecutionTask] = []
+
+    def add_proposals(self, proposals: Sequence[ExecutionProposal]) -> None:
+        for prop in proposals:
+            if prop.has_replica_change:
+                self.replica_tasks.append(
+                    ExecutionTask(
+                        self._next_id, TaskType.INTER_BROKER_REPLICA_ACTION, prop
+                    )
+                )
+                self._next_id += 1
+            if prop.has_leader_change:
+                # leadership lands after the replica phase (the new leader may
+                # be a replica that is still catching up during the move)
+                self.leader_tasks.append(
+                    ExecutionTask(self._next_id, TaskType.LEADER_ACTION, prop)
+                )
+                self._next_id += 1
+
+    def next_replica_batch(
+        self,
+        in_flight_per_broker: Dict[int, int],
+        cap_per_broker: int,
+        sizes: Dict[int, float],
+        urp: Set[int],
+        max_batch: int = 1 << 30,
+    ) -> List[ExecutionTask]:
+        """Pending tasks whose participating brokers all have spare slots."""
+        budget = dict(in_flight_per_broker)
+        batch: List[ExecutionTask] = []
+        pending = [t for t in self.replica_tasks if t.state == TaskState.PENDING]
+        for task in self.strategy.order(pending, sizes, urp):
+            brokers = task.participating_brokers
+            if any(budget.get(b, 0) >= cap_per_broker for b in brokers):
+                continue
+            for b in brokers:
+                budget[b] = budget.get(b, 0) + 1
+            batch.append(task)
+            if len(batch) >= max_batch:
+                break
+        return batch
+
+    def next_leader_batch(self, max_batch: int) -> List[ExecutionTask]:
+        pending = [t for t in self.leader_tasks if t.state == TaskState.PENDING]
+        return pending[:max_batch]
+
+    @property
+    def all_tasks(self) -> List[ExecutionTask]:
+        return self.replica_tasks + self.leader_tasks

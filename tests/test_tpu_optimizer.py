@@ -1,0 +1,116 @@
+"""TPU engine tests: greedy parity, hard-goal safety, sharded search
+(BASELINE.json configs #2/#3 semantics at test scale)."""
+
+import jax
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.goal_optimizer import GoalOptimizer, make_goals
+from cruise_control_tpu.analyzer.tpu_optimizer import (
+    TpuGoalOptimizer,
+    TpuSearchConfig,
+)
+from cruise_control_tpu.analyzer.verifier import verify_result, violation_score
+from cruise_control_tpu.models.generators import Distribution, random_cluster
+
+FAST = TpuSearchConfig(max_rounds=40, topk_per_round=128, max_moves_per_round=32)
+
+
+def test_tpu_engine_beats_or_matches_greedy():
+    """The parity bar: violation score ≤ greedy on the same input."""
+    state = random_cluster(
+        seed=3, num_brokers=20, num_racks=5, num_partitions=300,
+        distribution=Distribution.EXPONENTIAL, mean_utilization=0.4,
+    )
+    goals = make_goals()
+    greedy = GoalOptimizer(goals).optimize(state)
+    tpu = TpuGoalOptimizer(config=FAST).optimize(state)
+    verify_result(state, tpu, goals)
+    g_score = violation_score(greedy.final_state, goals)
+    t_score = violation_score(tpu.final_state, goals)
+    assert t_score <= g_score + 2, (g_score, t_score)
+
+
+def test_tpu_engine_dead_broker_replan():
+    """BASELINE config #4: self-healing replan under hard goals."""
+    state = random_cluster(
+        seed=5, num_brokers=12, num_racks=4, num_partitions=120, dead_brokers=2,
+    )
+    goals = make_goals()
+    res = TpuGoalOptimizer(config=FAST).optimize(state)
+    verify_result(state, res, goals)
+    fa = np.array(res.final_state.assignment)
+    assert not np.isin(fa, [10, 11]).any()
+
+
+def test_tpu_engine_excluded_topics():
+    state = random_cluster(seed=7, num_brokers=10, num_partitions=80, num_topics=4)
+    goals = make_goals()
+    options = OptimizationOptions(excluded_topics={1})
+    res = TpuGoalOptimizer(config=FAST).optimize(state, options)
+    verify_result(state, res, goals, options)
+
+
+def test_tpu_engine_sharded_mesh():
+    """Candidate axis sharded over the 8-device CPU mesh via shard_map."""
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, axis_names=("search",))
+    state = random_cluster(
+        seed=9, num_brokers=16, num_racks=4, num_partitions=128,
+        mean_utilization=0.45,
+    )
+    goals = make_goals()
+    res = TpuGoalOptimizer(config=FAST, mesh=mesh).optimize(state)
+    verify_result(state, res, goals)
+    # sharded and unsharded engines find comparable plans
+    res_1 = TpuGoalOptimizer(config=FAST).optimize(state)
+    s_mesh = violation_score(res.final_state, goals)
+    s_one = violation_score(res_1.final_state, goals)
+    assert abs(s_mesh - s_one) <= max(3, int(0.2 * max(s_mesh, s_one)))
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    scores, kind, cp, cs, cd = jax.jit(fn)(*args)
+    assert scores.shape[0] > 0
+    assert np.isfinite(np.asarray(scores)).any()
+
+
+def test_graft_entry_multichip():
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)
+
+
+def test_tpu_engine_raises_on_impossible_hard_goal():
+    """Same contract as greedy: infeasible hard goals raise, never a silent
+    hard-violating plan (code-review regression)."""
+    from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
+    from cruise_control_tpu.models.builder import ClusterModelBuilder
+    from cruise_control_tpu.common.resources import Resource, BrokerState
+
+    b = ClusterModelBuilder()
+    cap = {r: 1e9 for r in Resource}
+    b.add_broker("r0", cap)
+    b.add_broker("r0", cap)
+    b.add_partition("T", [0, 1], {Resource.DISK: 1.0})  # same rack, RF 2
+    with pytest.raises(OptimizationFailure):
+        TpuGoalOptimizer(config=FAST).optimize(b.build())
+
+
+def test_tpu_engine_evacuates_excluded_topic_offline_replicas():
+    """Offline replicas of excluded topics still evacuate (parity with
+    greedy's evacuate_offline_replicas; code-review regression)."""
+    state = random_cluster(seed=61, num_brokers=10, num_racks=5,
+                           num_partitions=60, num_topics=3, dead_brokers=1)
+    goals = make_goals()
+    options = OptimizationOptions(excluded_topics={0, 1, 2})
+    res = TpuGoalOptimizer(config=FAST).optimize(state, options)
+    verify_result(state, res, goals, options)
+    fa = np.array(res.final_state.assignment)
+    assert not (fa == 9).any()
