@@ -1,0 +1,53 @@
+"""Driver benchmark entry point.
+
+Measures rebalance-plan wall-clock of the TPU engine against the faithful
+greedy CPU baseline on the 50-broker RandomCluster fixture (BASELINE.md
+config #1; the reference publishes no numbers, so the greedy analyzer we
+implement IS the baseline — same goal stack, same semantics).
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is the speedup factor (greedy wall-clock / TPU wall-clock),
+reported only if the TPU engine's goal-violation score is <= greedy's
+(otherwise the run is a quality regression and vs_baseline is 0).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    from cruise_control_tpu.models.generators import random_cluster
+    from cruise_control_tpu.analyzer.goal_optimizer import GoalOptimizer
+    from cruise_control_tpu.analyzer.tpu_optimizer import TpuGoalOptimizer
+
+    state = random_cluster(
+        seed=42, num_brokers=50, num_racks=10, num_partitions=1000
+    )
+
+    t0 = time.perf_counter()
+    greedy = GoalOptimizer().optimize(state)
+    greedy_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    tpu = TpuGoalOptimizer().optimize(state)
+    tpu_s = time.perf_counter() - t0
+
+    quality_ok = tpu.violation_score_after <= greedy.violation_score_after
+    print(
+        json.dumps(
+            {
+                "metric": "rebalance_plan_wallclock_50b_1000p",
+                "value": round(tpu_s, 3),
+                "unit": "s",
+                "vs_baseline": round(greedy_s / tpu_s, 3) if quality_ok else 0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
