@@ -117,7 +117,11 @@ class DeviceModel:
     lcount: jax.Array          # f32 [B]
 
     def tree_flatten(self):
-        return dataclasses.astuple(self), None
+        # NOT dataclasses.astuple: that deep-copies every device array on each
+        # flatten, and this flattens at the jit boundary every search round
+        return tuple(
+            getattr(self, f.name) for f in dataclasses.fields(self)
+        ), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):

@@ -78,10 +78,19 @@ class SimulatedClusterBackend(ClusterBackend):
         move_latency_ticks: int = 1,
         failed_brokers: Optional[Set[int]] = None,
         fail_partitions: Optional[Set[int]] = None,
+        brokers: Optional[Set[int]] = None,
     ):
         self.partitions: Dict[int, PartitionState] = {
             p: PartitionState(list(reps), leaders[p]) for p, reps in assignment.items()
         }
+        # liveness is an explicit broker set, not inferred from placement: a
+        # live broker hosting zero replicas (e.g. freshly added) is still alive
+        self.brokers: Set[int] = (
+            set(brokers)
+            if brokers is not None
+            else {b for reps in assignment.values() for b in reps}
+            | set(leaders.values())
+        )
         self.move_latency_ticks = move_latency_ticks
         self.failed_brokers = failed_brokers or set()
         self.fail_partitions = fail_partitions or set()
@@ -130,10 +139,7 @@ class SimulatedClusterBackend(ClusterBackend):
         self.throttle_history.append(("clear", 0.0))
 
     def alive_brokers(self) -> Set[int]:
-        out: Set[int] = set()
-        for st in self.partitions.values():
-            out.update(st.replicas)
-        return out - self.failed_brokers
+        return self.brokers - self.failed_brokers
 
     def under_replicated_partitions(self) -> Set[int]:
         return {p for p, st in self.partitions.items() if st.catching_up}

@@ -114,6 +114,10 @@ class Executor:
         planner = ExecutionTaskPlanner(strategy)
         planner.add_proposals(proposals)
         self.planner = planner
+        # safety ceiling: replica moves beyond the cap are aborted up front,
+        # so the result reports a partial execution instead of ignoring it
+        for t in planner.replica_tasks[self.config.max_inter_broker_moves:]:
+            t.transition(TaskState.ABORTED)
 
         if self.config.replication_throttle is not None:
             moving = [
@@ -219,6 +223,14 @@ class Executor:
                     in_flight.pop(p)
                     for b in t.participating_brokers:
                         in_flight_per_broker[b] -= 1
+        # tick budget exhausted: nothing may stay non-terminal, or the result
+        # would misreport an incomplete rebalance as success
+        for t in in_flight.values():
+            t.transition(TaskState.DEAD)
+            t.finished_tick = ticks
+        for t in planner.replica_tasks:
+            if t.state == TaskState.PENDING:
+                t.transition(TaskState.ABORTED)
         return ticks
 
     def _drive_leader_moves(self, planner: ExecutionTaskPlanner) -> None:

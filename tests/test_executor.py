@@ -205,3 +205,54 @@ def test_stop_during_leader_phase():
     result = ex.execute_proposals(proposals)
     assert result.stopped
     assert result.aborted == 3 and result.completed == 1
+
+
+def test_tick_budget_exhaustion_reports_failure():
+    """Exhausting max_ticks must not report success: in-flight moves go DEAD,
+    unstarted ones ABORTED (code-review regression)."""
+    backend, assignment, _ = make_backend(move_latency_ticks=50)
+    ex = Executor(backend)
+    result = ex.execute_proposals([prop(0, assignment[0], [2, 3])], max_ticks=5)
+    assert not result.succeeded
+    # the replica move goes DEAD; so does the dependent leader election
+    # (new leader never joined the ISR)
+    assert result.dead == 2
+    replica_states = {t.state for t in ex.planner.replica_tasks}
+    assert replica_states == {TaskState.DEAD}
+
+
+def test_max_inter_broker_moves_ceiling():
+    """The safety ceiling aborts replica moves beyond the cap up front
+    (code-review regression: field used to be unread)."""
+    backend, assignment, _ = make_backend(num_partitions=6)
+    cfg = ExecutorConfig(max_inter_broker_moves=2)
+    ex = Executor(backend, cfg)
+    # skip partition 2, whose assignment is already [2, 3] (no-op proposal)
+    proposals = [prop(p, assignment[p], [2, 3]) for p in (0, 1, 3, 4)]
+    result = ex.execute_proposals(proposals)
+    assert not result.succeeded
+    aborted = [t for t in ex.planner.replica_tasks if t.state == TaskState.ABORTED]
+    done = [t for t in ex.planner.replica_tasks if t.state == TaskState.COMPLETED]
+    assert len(aborted) == 2 and len(done) == 2
+
+
+def test_alive_brokers_includes_empty_broker():
+    """A live broker hosting zero replicas is still alive (code-review
+    regression: liveness used to be inferred from placement)."""
+    backend = SimulatedClusterBackend(
+        {0: [0, 1]}, {0: 0}, brokers={0, 1, 2, 3}, failed_brokers={1}
+    )
+    assert backend.alive_brokers() == {0, 2, 3}
+
+
+def test_device_model_tree_flatten_no_copy():
+    """DeviceModel.tree_flatten must return array references, not copies
+    (code-review regression: astuple deep-copied every array per round)."""
+    import jax
+    from cruise_control_tpu.analyzer.context import AnalyzerContext
+    from cruise_control_tpu.analyzer.tpu_optimizer import TpuGoalOptimizer
+
+    state = random_cluster(seed=3, num_brokers=4, num_racks=2, num_partitions=8)
+    m = TpuGoalOptimizer()._device_model(AnalyzerContext(state))
+    leaves, _ = jax.tree_util.tree_flatten(m)
+    assert leaves[0] is m.assignment
