@@ -1,0 +1,351 @@
+"""Typed config registry (upstream ``config/KafkaCruiseControlConfig.java`` +
+``config/constants/{Monitor,Analyzer,Executor,AnomalyDetector,WebServer,
+UserTaskManager}Config.java``; SURVEY.md §5.6).
+
+Kafka-style ``AbstractConfig`` semantics: every key has a type, default,
+optional validator, importance and doc string; unknown keys are rejected;
+pluggable classes (samplers, goals, notifiers, strategies) are instantiated
+by dotted name from config values.  Key names keep the upstream dotted
+surface (``metric.sampling.interval.ms`` …) so reference configs map over.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import importlib
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Importance(enum.Enum):
+    HIGH = "HIGH"
+    MEDIUM = "MEDIUM"
+    LOW = "LOW"
+
+
+class ConfigType(enum.Enum):
+    INT = "INT"
+    LONG = "LONG"
+    DOUBLE = "DOUBLE"
+    STRING = "STRING"
+    BOOLEAN = "BOOLEAN"
+    LIST = "LIST"      # comma-separated string or python list
+    CLASS = "CLASS"    # dotted path, instantiated on demand
+
+
+def at_least(lo: float) -> Callable[[str, Any], None]:
+    def check(name: str, v: Any) -> None:
+        if v < lo:
+            raise ConfigException(f"{name}={v} must be >= {lo}")
+    return check
+
+
+def between(lo: float, hi: float) -> Callable[[str, Any], None]:
+    def check(name: str, v: Any) -> None:
+        if not (lo <= v <= hi):
+            raise ConfigException(f"{name}={v} must be in [{lo}, {hi}]")
+    return check
+
+
+class ConfigException(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigKey:
+    name: str
+    type: ConfigType
+    default: Any
+    importance: Importance
+    doc: str
+    validator: Optional[Callable[[str, Any], None]] = None
+    group: str = ""
+
+
+class ConfigDef:
+    """Mutable registry of keys; shared singleton below."""
+
+    def __init__(self) -> None:
+        self._keys: Dict[str, ConfigKey] = {}
+
+    def define(
+        self,
+        name: str,
+        type: ConfigType,
+        default: Any,
+        importance: Importance,
+        doc: str,
+        validator: Optional[Callable[[str, Any], None]] = None,
+        group: str = "",
+    ) -> "ConfigDef":
+        if name in self._keys:
+            raise ConfigException(f"duplicate config key {name}")
+        self._keys[name] = ConfigKey(
+            name, type, default, importance, doc, validator, group
+        )
+        return self
+
+    def keys(self) -> Dict[str, ConfigKey]:
+        return dict(self._keys)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._keys
+
+
+def _coerce(key: ConfigKey, value: Any) -> Any:
+    t = key.type
+    try:
+        if t in (ConfigType.INT, ConfigType.LONG):
+            return int(value)
+        if t == ConfigType.DOUBLE:
+            return float(value)
+        if t == ConfigType.BOOLEAN:
+            if isinstance(value, bool):
+                return value
+            return str(value).strip().lower() in ("true", "1", "yes")
+        if t == ConfigType.LIST:
+            if isinstance(value, str):
+                return [v.strip() for v in value.split(",") if v.strip()]
+            return list(value)
+        if t == ConfigType.STRING or t == ConfigType.CLASS:
+            return None if value is None else str(value)
+    except (TypeError, ValueError) as e:
+        raise ConfigException(f"bad value for {key.name}: {value!r}") from e
+    raise ConfigException(f"unknown type {t}")
+
+
+class CruiseControlConfig:
+    """Validated, typed view over a raw ``{key: value}`` dict."""
+
+    def __init__(
+        self,
+        props: Optional[Dict[str, Any]] = None,
+        definition: Optional[ConfigDef] = None,
+    ):
+        self._def = definition or DEFAULT_CONFIG_DEF
+        keys = self._def.keys()
+        self._values: Dict[str, Any] = {}
+        props = props or {}
+        unknown = set(props) - set(keys)
+        if unknown:
+            raise ConfigException(f"unknown config keys: {sorted(unknown)}")
+        for name, key in keys.items():
+            raw = props.get(name, key.default)
+            v = raw if raw is None else _coerce(key, raw)
+            if key.validator is not None and v is not None:
+                key.validator(name, v)
+            self._values[name] = v
+
+    def get(self, name: str) -> Any:
+        if name not in self._values:
+            raise ConfigException(f"unknown config key {name}")
+        return self._values[name]
+
+    __getitem__ = get
+
+    def get_int(self, name: str) -> int:
+        return int(self.get(name))
+
+    def get_double(self, name: str) -> float:
+        return float(self.get(name))
+
+    def get_list(self, name: str) -> List[str]:
+        return list(self.get(name))
+
+    def get_boolean(self, name: str) -> bool:
+        return bool(self.get(name))
+
+    def get_configured_instance(self, name: str, *args, **kwargs) -> Any:
+        """Instantiate the class named by a CLASS key (upstream
+        ``getConfiguredInstance``); the instance may accept the config."""
+        path = self.get(name)
+        if path is None:
+            return None
+        cls = resolve_class(path)
+        return cls(*args, **kwargs)
+
+    def get_configured_instances(self, name: str, *args, **kwargs) -> List[Any]:
+        return [resolve_class(p)(*args, **kwargs) for p in self.get_list(name)]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+def resolve_class(path: str) -> type:
+    """Dotted-path (or registered short-name) → class object."""
+    if "." not in path:
+        # short names resolve against the goal registry for upstream parity
+        from cruise_control_tpu.analyzer.goal_optimizer import GOAL_CLASSES
+        if path in GOAL_CLASSES:
+            return GOAL_CLASSES[path]
+        raise ConfigException(f"cannot resolve class short-name {path!r}")
+    module, _, cls_name = path.rpartition(".")
+    try:
+        return getattr(importlib.import_module(module), cls_name)
+    except (ImportError, AttributeError) as e:
+        raise ConfigException(f"cannot resolve class {path!r}") from e
+
+
+# ---------------------------------------------------------------------------------
+# Default key surface (upstream config/constants/*Config.java, abridged to the
+# keys this framework consumes; names match upstream where the concept exists)
+# ---------------------------------------------------------------------------------
+
+_DEFAULT_GOALS = (
+    "RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal,"
+    "NetworkInboundCapacityGoal,NetworkOutboundCapacityGoal,CpuCapacityGoal,"
+    "ReplicaDistributionGoal,PotentialNwOutGoal,DiskUsageDistributionGoal,"
+    "NetworkInboundUsageDistributionGoal,NetworkOutboundUsageDistributionGoal,"
+    "CpuUsageDistributionGoal,TopicReplicaDistributionGoal,"
+    "LeaderReplicaDistributionGoal,LeaderBytesInDistributionGoal"
+)
+
+_HARD_GOALS = (
+    "RackAwareGoal,ReplicaCapacityGoal,DiskCapacityGoal,"
+    "NetworkInboundCapacityGoal,NetworkOutboundCapacityGoal,CpuCapacityGoal"
+)
+
+
+def default_config_def() -> ConfigDef:
+    d = ConfigDef()
+    G = "monitor"
+    d.define("metric.sampling.interval.ms", ConfigType.LONG, 120_000,
+             Importance.HIGH, "Interval between metric sampling runs.",
+             at_least(1), G)
+    d.define("partition.metrics.window.ms", ConfigType.LONG, 3_600_000,
+             Importance.HIGH, "Span of one partition-metrics window.",
+             at_least(1), G)
+    d.define("num.partition.metrics.windows", ConfigType.INT, 5,
+             Importance.HIGH, "Completed windows retained per partition.",
+             at_least(1), G)
+    d.define("broker.metrics.window.ms", ConfigType.LONG, 3_600_000,
+             Importance.HIGH, "Span of one broker-metrics window.",
+             at_least(1), G)
+    d.define("num.broker.metrics.windows", ConfigType.INT, 5,
+             Importance.HIGH, "Completed windows retained per broker.",
+             at_least(1), G)
+    d.define("min.samples.per.partition.metrics.window", ConfigType.INT, 1,
+             Importance.MEDIUM, "Samples required for a valid window.",
+             at_least(1), G)
+    d.define("min.samples.per.broker.metrics.window", ConfigType.INT, 1,
+             Importance.MEDIUM, "Samples required for a valid window.",
+             at_least(1), G)
+    d.define("min.valid.partition.ratio", ConfigType.DOUBLE, 0.95,
+             Importance.HIGH, "Monitored-partition ratio for a usable model.",
+             between(0, 1), G)
+    d.define("max.allowed.extrapolations.per.partition", ConfigType.INT, 5,
+             Importance.LOW, "Extrapolated windows tolerated per partition.",
+             at_least(0), G)
+    d.define("broker.capacity.config.resolver.class", ConfigType.CLASS,
+             "cruise_control_tpu.monitor.capacity.BrokerCapacityConfigFileResolver",
+             Importance.MEDIUM, "BrokerCapacityConfigResolver implementation.",
+             None, G)
+    d.define("capacity.config.file", ConfigType.STRING, None,
+             Importance.MEDIUM, "Path of the broker-capacity JSON file.",
+             None, G)
+    d.define("sample.store.class", ConfigType.CLASS,
+             "cruise_control_tpu.monitor.sample_store.FileSampleStore",
+             Importance.MEDIUM, "SampleStore implementation.", None, G)
+    d.define("sample.store.path", ConfigType.STRING, None,
+             Importance.MEDIUM, "Directory for persisted samples.", None, G)
+    d.define("metric.sampler.class", ConfigType.CLASS,
+             "cruise_control_tpu.monitor.sampling.MetricsReporterSampler",
+             Importance.HIGH, "MetricSampler implementation.", None, G)
+
+    G = "analyzer"
+    d.define("default.goals", ConfigType.LIST, _DEFAULT_GOALS,
+             Importance.HIGH, "Goal stack in priority order.", None, G)
+    d.define("hard.goals", ConfigType.LIST, _HARD_GOALS,
+             Importance.HIGH, "Goals that must never be violated.", None, G)
+    d.define("cpu.balance.threshold", ConfigType.DOUBLE, 1.1,
+             Importance.MEDIUM, "Max/avg CPU ratio considered balanced.",
+             at_least(1), G)
+    d.define("disk.balance.threshold", ConfigType.DOUBLE, 1.1,
+             Importance.MEDIUM, "Max/avg disk ratio considered balanced.",
+             at_least(1), G)
+    d.define("network.inbound.balance.threshold", ConfigType.DOUBLE, 1.1,
+             Importance.MEDIUM, "Max/avg NW-in ratio considered balanced.",
+             at_least(1), G)
+    d.define("network.outbound.balance.threshold", ConfigType.DOUBLE, 1.1,
+             Importance.MEDIUM, "Max/avg NW-out ratio considered balanced.",
+             at_least(1), G)
+    d.define("cpu.capacity.threshold", ConfigType.DOUBLE, 0.7,
+             Importance.MEDIUM, "Usable fraction of CPU capacity.",
+             between(0, 1), G)
+    d.define("disk.capacity.threshold", ConfigType.DOUBLE, 0.8,
+             Importance.MEDIUM, "Usable fraction of disk capacity.",
+             between(0, 1), G)
+    d.define("network.inbound.capacity.threshold", ConfigType.DOUBLE, 0.8,
+             Importance.MEDIUM, "Usable fraction of NW-in capacity.",
+             between(0, 1), G)
+    d.define("network.outbound.capacity.threshold", ConfigType.DOUBLE, 0.8,
+             Importance.MEDIUM, "Usable fraction of NW-out capacity.",
+             between(0, 1), G)
+    d.define("max.replicas.per.broker", ConfigType.LONG, 10_000,
+             Importance.MEDIUM, "ReplicaCapacityGoal ceiling.", at_least(1), G)
+    d.define("proposal.expiration.ms", ConfigType.LONG, 900_000,
+             Importance.MEDIUM, "Cached proposal freshness bound.",
+             at_least(0), G)
+    d.define("use.tpu.optimizer", ConfigType.BOOLEAN, True,
+             Importance.HIGH, "Route optimizations through the TPU engine "
+             "(framework-specific; no upstream equivalent).", None, G)
+
+    G = "executor"
+    d.define("num.concurrent.partition.movements.per.broker", ConfigType.INT, 5,
+             Importance.HIGH, "Per-broker in-flight replica-move cap.",
+             at_least(1), G)
+    d.define("num.concurrent.leader.movements", ConfigType.INT, 1000,
+             Importance.HIGH, "Leadership-election batch cap.", at_least(1), G)
+    d.define("execution.progress.check.interval.ms", ConfigType.LONG, 10_000,
+             Importance.MEDIUM, "Metadata poll interval during execution.",
+             at_least(1), G)
+    d.define("default.replication.throttle", ConfigType.DOUBLE, None,
+             Importance.MEDIUM, "Replication throttle (bytes/s); None = off.",
+             None, G)
+    d.define("default.replica.movement.strategies", ConfigType.LIST,
+             "cruise_control_tpu.executor.tasks.ReplicaMovementStrategy",
+             Importance.MEDIUM, "Replica-move ordering strategy chain.",
+             None, G)
+
+    G = "anomaly.detector"
+    d.define("anomaly.detection.interval.ms", ConfigType.LONG, 300_000,
+             Importance.HIGH, "Detector scheduling interval.", at_least(1), G)
+    d.define("anomaly.detection.goals", ConfigType.LIST, _HARD_GOALS,
+             Importance.HIGH, "Goals watched by GoalViolationDetector.",
+             None, G)
+    d.define("self.healing.enabled", ConfigType.BOOLEAN, False,
+             Importance.HIGH, "Master switch for automatic anomaly fixes.",
+             None, G)
+    d.define("broker.failure.alert.threshold.ms", ConfigType.LONG, 900_000,
+             Importance.MEDIUM, "Broker-down time before alerting.",
+             at_least(0), G)
+    d.define("broker.failure.self.healing.threshold.ms", ConfigType.LONG,
+             1_800_000, Importance.MEDIUM,
+             "Broker-down time before self-healing starts.", at_least(0), G)
+    d.define("self.healing.cooldown.ms", ConfigType.LONG, 300_000,
+             Importance.MEDIUM, "Minimum spacing between automatic fixes.",
+             at_least(0), G)
+    d.define("anomaly.notifier.class", ConfigType.CLASS, None,
+             Importance.MEDIUM, "AnomalyNotifier implementation; None keeps "
+             "the built-in SelfHealingNotifier.", None, G)
+    d.define("broker.failures.persistence.path", ConfigType.STRING, None,
+             Importance.LOW, "File persisting first-seen failure times.",
+             None, G)
+
+    G = "webserver"
+    d.define("webserver.http.port", ConfigType.INT, 9090,
+             Importance.HIGH, "REST listen port.", at_least(0), G)
+    d.define("webserver.http.address", ConfigType.STRING, "127.0.0.1",
+             Importance.MEDIUM, "REST bind address.", None, G)
+    d.define("webserver.api.urlprefix", ConfigType.STRING,
+             "/kafkacruisecontrol", Importance.LOW, "API path prefix.",
+             None, G)
+    d.define("max.active.user.tasks", ConfigType.INT, 25,
+             Importance.MEDIUM, "Concurrent async user tasks.", at_least(1), G)
+    d.define("completed.user.task.retention.time.ms", ConfigType.LONG,
+             86_400_000, Importance.LOW,
+             "TTL of finished task results.", at_least(0), G)
+    return d
+
+
+DEFAULT_CONFIG_DEF = default_config_def()

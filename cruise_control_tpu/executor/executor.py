@@ -114,15 +114,21 @@ class Executor:
         planner = ExecutionTaskPlanner(strategy)
         planner.add_proposals(proposals)
         self.planner = planner
-        # safety ceiling: replica moves beyond the cap are aborted up front,
-        # so the result reports a partial execution instead of ignoring it
-        for t in planner.replica_tasks[self.config.max_inter_broker_moves:]:
+        # safety ceiling: replica moves beyond the cap are aborted up front
+        # (in strategy order, so the cap keeps the highest-priority moves),
+        # and the result reports a partial execution instead of ignoring it
+        ordered = planner.strategy.order(
+            planner.replica_tasks, sizes,
+            self.backend.under_replicated_partitions(),
+        )
+        for t in ordered[self.config.max_inter_broker_moves:]:
             t.transition(TaskState.ABORTED)
 
         if self.config.replication_throttle is not None:
             moving = [
                 t.proposal.partition
                 for t in planner.replica_tasks
+                if t.state == TaskState.PENDING
             ]
             self.backend.set_throttles(self.config.replication_throttle, moving)
 

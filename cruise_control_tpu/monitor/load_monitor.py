@@ -1,0 +1,346 @@
+"""LoadMonitor — owns aggregators + metadata and produces ``ClusterState``
+snapshots (upstream ``monitor/LoadMonitor.java`` + ``LoadMonitorState`` +
+``ModelCompletenessRequirements`` + ``MetadataClient``; SURVEY.md §2.3, call
+stacks §3.2/§3.3).
+
+Differences from upstream are TPU-shaped, not semantic: the "model" handed to
+the analyzer is the dense :class:`ClusterState` pytree (built in one pass from
+the aggregate tensors), and window aggregation is vectorized.  The
+concurrency contract is upstream's: a semaphore gates model generation, and
+sampling iterations are explicit ticks (driven by a scheduler thread in a
+real deployment, by tests here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import (
+    FOLLOWER_CPU_RATIO,
+    NUM_RESOURCES,
+    Resource,
+)
+from cruise_control_tpu.models.builder import ClusterModelBuilder
+from cruise_control_tpu.models.cluster_state import ClusterState
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    MetricSampleAggregator,
+)
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityConfigResolver,
+    StaticCapacityResolver,
+)
+from cruise_control_tpu.monitor.sampling import (
+    BROKER_DEF,
+    PARTITION_DEF,
+    P_CPU,
+    P_DISK,
+    P_NW_IN,
+    P_NW_OUT,
+    MetricSampler,
+)
+from cruise_control_tpu.monitor.sample_store import NoopSampleStore, SampleStore
+
+
+class LoadMonitorState(enum.Enum):
+    NOT_STARTED = "NOT_STARTED"
+    RUNNING = "RUNNING"
+    SAMPLING = "SAMPLING"
+    PAUSED = "PAUSED"
+    BOOTSTRAPPING = "BOOTSTRAPPING"
+    LOADING = "LOADING"
+
+
+@dataclasses.dataclass
+class ModelCompletenessRequirements:
+    """Upstream ``ModelCompletenessRequirements``: what a goal demands of the
+    monitored data before trusting a model built from it."""
+
+    min_required_num_windows: int = 1
+    min_monitored_partitions_ratio: float = 0.95
+    include_all_topics: bool = False
+
+    def stronger(self, other: "ModelCompletenessRequirements"):
+        return ModelCompletenessRequirements(
+            max(self.min_required_num_windows, other.min_required_num_windows),
+            max(self.min_monitored_partitions_ratio,
+                other.min_monitored_partitions_ratio),
+            self.include_all_topics or other.include_all_topics,
+        )
+
+
+class NotEnoughValidWindowsError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class ClusterTopology:
+    """Metadata snapshot (upstream ``MetadataClient`` view): placement plus
+    broker attributes."""
+
+    assignment: Dict[int, List[int]]      # partition → replica brokers
+    leaders: Dict[int, int]               # partition → leader broker
+    broker_rack: Dict[int, int]           # broker → rack id
+    partition_topic: Dict[int, str]       # partition → topic name
+    alive_brokers: Optional[set] = None   # None = all referenced brokers
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.assignment)
+
+    def broker_ids(self) -> List[int]:
+        out = set(self.broker_rack)
+        for reps in self.assignment.values():
+            out.update(reps)
+        return sorted(out)
+
+
+class MetadataClient:
+    """SPI: where topology snapshots come from."""
+
+    def refresh(self) -> ClusterTopology:
+        raise NotImplementedError
+
+
+class StaticMetadataClient(MetadataClient):
+    def __init__(self, topology: ClusterTopology):
+        self.topology = topology
+
+    def refresh(self) -> ClusterTopology:
+        return self.topology
+
+
+class BackendMetadataClient(MetadataClient):
+    """Reads topology straight from a cluster backend (the simulated cluster
+    or a real admin adapter), so monitor and executor see one world."""
+
+    def __init__(self, backend, broker_rack: Dict[int, int],
+                 partition_topic: Optional[Dict[int, str]] = None):
+        self.backend = backend
+        self.broker_rack = broker_rack
+        self.partition_topic = partition_topic or {}
+
+    def refresh(self) -> ClusterTopology:
+        assignment = {
+            p: list(st.replicas) for p, st in self.backend.partitions.items()
+        }
+        leaders = {p: st.leader for p, st in self.backend.partitions.items()}
+        return ClusterTopology(
+            assignment=assignment,
+            leaders=leaders,
+            broker_rack=self.broker_rack,
+            partition_topic={
+                p: self.partition_topic.get(p, "topic_0") for p in assignment
+            },
+            alive_brokers=self.backend.alive_brokers(),
+        )
+
+
+class LoadMonitor:
+    """Aggregates samples and generates models on demand."""
+
+    def __init__(
+        self,
+        metadata: MetadataClient,
+        sampler: MetricSampler,
+        capacity_resolver: Optional[BrokerCapacityConfigResolver] = None,
+        sample_store: Optional[SampleStore] = None,
+        window_ms: int = 3_600_000,
+        num_windows: int = 5,
+        min_samples_per_window: int = 1,
+        max_allowed_extrapolations: int = 5,
+    ):
+        self.metadata = metadata
+        self.sampler = sampler
+        self.capacity_resolver = capacity_resolver or StaticCapacityResolver(
+            {Resource.CPU: 100.0, Resource.NW_IN: 1e5, Resource.NW_OUT: 1e5,
+             Resource.DISK: 1e6}
+        )
+        self.sample_store = sample_store or NoopSampleStore()
+        self.window_ms = window_ms
+        self.max_allowed_extrapolations = max_allowed_extrapolations
+        self.state = LoadMonitorState.NOT_STARTED
+        self._model_semaphore = threading.Semaphore(1)
+        self._last_sample_ms = 0
+
+        topo = metadata.refresh()
+        num_p = topo.num_partitions
+        num_b = (max(topo.broker_ids()) + 1) if topo.broker_ids() else 0
+        self.partition_aggregator = MetricSampleAggregator(
+            PARTITION_DEF, num_p, window_ms, num_windows,
+            min_samples_per_window,
+        )
+        self.broker_aggregator = MetricSampleAggregator(
+            BROKER_DEF, num_b, window_ms, num_windows, min_samples_per_window,
+        )
+        self._startup_load()
+        self.state = LoadMonitorState.RUNNING
+
+    # ---- lifecycle --------------------------------------------------------------
+    def _startup_load(self) -> None:
+        """Replay persisted samples (upstream LOADING state, §5.4)."""
+        self.state = LoadMonitorState.LOADING
+        psamples, bsamples = self.sample_store.load_samples()
+        if psamples:
+            self.partition_aggregator.ensure_entities(
+                max(s.partition for s in psamples) + 1
+            )
+        if bsamples:
+            self.broker_aggregator.ensure_entities(
+                max(s.broker_id for s in bsamples) + 1
+            )
+        for s in psamples:
+            self.partition_aggregator.add_sample(s.partition, s.time_ms, s.values)
+        for s in bsamples:
+            self.broker_aggregator.add_sample(s.broker_id, s.time_ms, s.values)
+        if psamples or bsamples:
+            self._last_sample_ms = max(
+                [s.time_ms for s in psamples] + [s.time_ms for s in bsamples]
+            )
+
+    def pause_sampling(self) -> None:
+        self.state = LoadMonitorState.PAUSED
+
+    def resume_sampling(self) -> None:
+        if self.state == LoadMonitorState.PAUSED:
+            self.state = LoadMonitorState.RUNNING
+
+    def run_sampling_iteration(self, now_ms: int) -> int:
+        """One fetcher pass (upstream MetricFetcherManager interval): pull
+        samples in (last, now], aggregate, persist.  Returns #samples."""
+        if self.state == LoadMonitorState.PAUSED:
+            return 0
+        prev_state, self.state = self.state, LoadMonitorState.SAMPLING
+        try:
+            psamples, bsamples = self.sampler.get_samples(
+                self._last_sample_ms, now_ms
+            )
+            if psamples:
+                self.partition_aggregator.ensure_entities(
+                    max(s.partition for s in psamples) + 1
+                )
+            if bsamples:
+                self.broker_aggregator.ensure_entities(
+                    max(s.broker_id for s in bsamples) + 1
+                )
+            for s in psamples:
+                self.partition_aggregator.add_sample(
+                    s.partition, s.time_ms, s.values
+                )
+            for s in bsamples:
+                self.broker_aggregator.add_sample(
+                    s.broker_id, s.time_ms, s.values
+                )
+            self.sample_store.store_samples(psamples, bsamples)
+            self._last_sample_ms = now_ms
+            return len(psamples) + len(bsamples)
+        finally:
+            self.state = prev_state
+
+    # ---- model generation -------------------------------------------------------
+    def acquire_for_model_generation(self) -> "ModelGenerationLock":
+        """Upstream ``acquireForModelGeneration`` semaphore."""
+        return ModelGenerationLock(self._model_semaphore)
+
+    def cluster_model(
+        self,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+    ) -> ClusterState:
+        """Build a ClusterState from current topology + aggregated loads."""
+        req = requirements or ModelCompletenessRequirements()
+        topo = self.metadata.refresh()
+        agg = self.partition_aggregator.aggregate(AggregationOptions(
+            min_valid_entity_ratio=req.min_monitored_partitions_ratio,
+            max_allowed_extrapolations=self.max_allowed_extrapolations,
+        ))
+        comp = agg.completeness
+        if comp.num_valid_windows < req.min_required_num_windows:
+            raise NotEnoughValidWindowsError(
+                f"{comp.num_valid_windows} valid windows < required "
+                f"{req.min_required_num_windows}"
+            )
+        if comp.valid_entity_ratio < req.min_monitored_partitions_ratio:
+            raise NotEnoughValidWindowsError(
+                f"monitored-partition ratio {comp.valid_entity_ratio:.3f} < "
+                f"required {req.min_monitored_partitions_ratio}"
+            )
+
+        # mean over valid windows per partition → one load vector each
+        wsel = (np.array(comp.valid_window_indices, int)
+                if comp.valid_window_indices else np.arange(agg.values.shape[1]))
+        if wsel.size:
+            mean_vals = agg.values[:, wsel, :].mean(axis=1)  # [P, M]
+        else:
+            mean_vals = np.zeros((topo.num_partitions, PARTITION_DEF.num_metrics))
+        # topology may have grown past the aggregate (brand-new partitions
+        # with no samples yet): pad with zero load rather than crashing
+        if mean_vals.shape[0] < topo.num_partitions:
+            pad = np.zeros(
+                (topo.num_partitions - mean_vals.shape[0], mean_vals.shape[1])
+            )
+            mean_vals = np.concatenate([mean_vals, pad], axis=0)
+
+        builder = ClusterModelBuilder()
+        broker_index: Dict[int, int] = {}
+        alive = topo.alive_brokers
+        from cruise_control_tpu.common.resources import BrokerState
+        for b in topo.broker_ids():
+            info = self.capacity_resolver.capacity_for_broker(b)
+            state = (BrokerState.ALIVE if alive is None or b in alive
+                     else BrokerState.DEAD)
+            broker_index[b] = builder.add_broker(
+                topo.broker_rack.get(b, 0), info.capacity, state
+            )
+        for p in sorted(topo.assignment):
+            replicas = topo.assignment[p]
+            leader = topo.leaders[p]
+            lead_slot = replicas.index(leader) if leader in replicas else 0
+            load = np.zeros(NUM_RESOURCES, np.float32)
+            load[Resource.CPU] = mean_vals[p, P_CPU]
+            load[Resource.NW_IN] = mean_vals[p, P_NW_IN]
+            load[Resource.NW_OUT] = mean_vals[p, P_NW_OUT]
+            load[Resource.DISK] = mean_vals[p, P_DISK]
+            follower = load.copy()
+            follower[Resource.NW_OUT] = 0.0
+            follower[Resource.CPU] = load[Resource.CPU] * FOLLOWER_CPU_RATIO
+            builder.add_partition(
+                topic=topo.partition_topic.get(p, "topic_0"),
+                brokers=[broker_index[b] for b in replicas],
+                leader_load=load,
+                follower_load=follower,
+                leader_slot=lead_slot,
+            )
+        return builder.build()
+
+    # ---- observability ----------------------------------------------------------
+    def state_summary(self) -> dict:
+        agg = self.partition_aggregator.aggregate()
+        c = agg.completeness
+        return {
+            "state": self.state.value,
+            "numValidWindows": c.num_valid_windows,
+            "numWindows": c.num_windows,
+            "validPartitionRatio": round(c.valid_entity_ratio, 4),
+            "lastSampleMs": self._last_sample_ms,
+            "aggregatorGeneration": self.partition_aggregator.generation,
+        }
+
+
+class ModelGenerationLock:
+    def __init__(self, sem: threading.Semaphore):
+        self._sem = sem
+
+    def __enter__(self):
+        acquired = self._sem.acquire(timeout=60.0)
+        if not acquired:
+            raise RuntimeError("could not acquire model-generation semaphore")
+        return self
+
+    def __exit__(self, *exc):
+        self._sem.release()
+        return False

@@ -1,0 +1,330 @@
+"""Metric sampling pipeline: raw broker metrics → typed samples.
+
+Covers three upstream pieces (SURVEY.md §2.2–2.3, call stack §3.3):
+
+* the broker-side metrics reporter plugin
+  (``metricsreporter/CruiseControlMetricsReporter.java``) — here a
+  :class:`SimulatedMetricsReporter` that computes each broker's observable
+  metrics from a ground-truth workload and produces them to an in-memory
+  :class:`MetricsTopic` (the ``__CruiseControlMetrics`` stand-in; the build
+  environment has no Kafka);
+* the sample processor (``monitor/sampling/CruiseControlMetricsProcessor.java``
+  + ``model/ModelUtils.java``) — converts raw metrics into
+  ``PartitionMetricSample`` / ``BrokerMetricSample``, **estimating
+  per-partition CPU** from broker CPU × traffic shares (linear model);
+* the ``MetricSampler`` SPI (``monitor/sampling/MetricSampler.java``) with the
+  reporter-consuming implementation
+  (``CruiseControlMetricsReporterSampler.java``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.monitor.metric_defs import (
+    broker_metric_def,
+    partition_metric_def,
+)
+
+PARTITION_DEF = partition_metric_def()
+BROKER_DEF = broker_metric_def()
+
+# column indices into sample value vectors
+P_CPU = PARTITION_DEF.metric_info("CPU_USAGE").metric_id
+P_DISK = PARTITION_DEF.metric_info("DISK_USAGE").metric_id
+P_NW_IN = PARTITION_DEF.metric_info("LEADER_BYTES_IN").metric_id
+P_NW_OUT = PARTITION_DEF.metric_info("LEADER_BYTES_OUT").metric_id
+B_CPU = BROKER_DEF.metric_info("BROKER_CPU_UTIL").metric_id
+B_BYTES_IN = BROKER_DEF.metric_info("ALL_TOPIC_BYTES_IN").metric_id
+B_BYTES_OUT = BROKER_DEF.metric_info("ALL_TOPIC_BYTES_OUT").metric_id
+B_DISK = BROKER_DEF.metric_info("BROKER_DISK_UTIL").metric_id
+
+
+class RawMetricType(enum.Enum):
+    """Raw reporter vocabulary (upstream ``RawMetricType.java``, abridged to
+    the load-model-relevant set)."""
+
+    BROKER_CPU_UTIL = "BROKER_CPU_UTIL"
+    ALL_TOPIC_BYTES_IN = "ALL_TOPIC_BYTES_IN"
+    ALL_TOPIC_BYTES_OUT = "ALL_TOPIC_BYTES_OUT"
+    PARTITION_SIZE = "PARTITION_SIZE"
+    PARTITION_BYTES_IN = "PARTITION_BYTES_IN"
+    PARTITION_BYTES_OUT = "PARTITION_BYTES_OUT"
+
+
+@dataclasses.dataclass(frozen=True)
+class CruiseControlMetric:
+    """One raw metric record (upstream ``CruiseControlMetric`` hierarchy;
+    ``partition`` is -1 for broker-scoped metrics)."""
+
+    metric_type: RawMetricType
+    time_ms: int
+    broker_id: int
+    value: float
+    partition: int = -1
+
+
+class MetricsTopic:
+    """In-memory ``__CruiseControlMetrics``: append-only log with offset-based
+    consumption so multiple samplers can tail it independently."""
+
+    def __init__(self) -> None:
+        self._records: List[CruiseControlMetric] = []
+
+    def produce(self, records: Iterable[CruiseControlMetric]) -> None:
+        self._records.extend(records)
+
+    def consume_from(self, offset: int) -> Tuple[List[CruiseControlMetric], int]:
+        records = self._records[offset:]
+        return records, len(self._records)
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+
+# ---------------------------------------------------------------------------------
+# Simulated broker-side reporter
+# ---------------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class WorkloadModel:
+    """Ground truth the simulated brokers observe: per-partition rates plus
+    topology.  Arrays are float64 [P]."""
+
+    bytes_in: np.ndarray      # leader produce rate (KB/s)
+    bytes_out: np.ndarray     # leader consume rate (KB/s)
+    size_mb: np.ndarray       # on-disk size per replica (MB)
+    assignment: Dict[int, List[int]]   # partition → replica brokers
+    leaders: Dict[int, int]            # partition → leader broker
+    #: linear CPU cost coefficients (percent CPU per KB/s)
+    cpu_per_bytes_in: float = 0.005
+    cpu_per_bytes_out: float = 0.003
+    cpu_per_replication_in: float = 0.002
+    base_cpu: float = 2.0
+
+    def broker_ids(self) -> List[int]:
+        out = set(self.leaders.values())
+        for reps in self.assignment.values():
+            out.update(reps)
+        return sorted(out)
+
+
+class SimulatedMetricsReporter:
+    """Computes what each broker's metrics reporter would see from the
+    ground-truth workload and produces raw records to the metrics topic.
+    One call to :meth:`report` = one reporting interval on every broker."""
+
+    def __init__(
+        self,
+        workload: WorkloadModel,
+        topic: MetricsTopic,
+        noise_std: float = 0.0,
+        seed: int = 0,
+    ):
+        self.workload = workload
+        self.topic = topic
+        self.noise_std = noise_std
+        self._rng = np.random.default_rng(seed)
+
+    def _noisy(self, v: float) -> float:
+        if self.noise_std <= 0:
+            return max(v, 0.0)
+        return max(v * (1.0 + self._rng.normal(0.0, self.noise_std)), 0.0)
+
+    def report(self, time_ms: int) -> None:
+        w = self.workload
+        records: List[CruiseControlMetric] = []
+        leader_in: Dict[int, float] = {}
+        leader_out: Dict[int, float] = {}
+        repl_in: Dict[int, float] = {}
+        for p, leader in w.leaders.items():
+            leader_in[leader] = leader_in.get(leader, 0.0) + float(w.bytes_in[p])
+            leader_out[leader] = leader_out.get(leader, 0.0) + float(w.bytes_out[p])
+            for b in w.assignment[p]:
+                if b != leader:
+                    repl_in[b] = repl_in.get(b, 0.0) + float(w.bytes_in[p])
+            # leader-side per-partition metrics
+            records.append(CruiseControlMetric(
+                RawMetricType.PARTITION_BYTES_IN, time_ms, leader,
+                self._noisy(float(w.bytes_in[p])), p))
+            records.append(CruiseControlMetric(
+                RawMetricType.PARTITION_BYTES_OUT, time_ms, leader,
+                self._noisy(float(w.bytes_out[p])), p))
+            records.append(CruiseControlMetric(
+                RawMetricType.PARTITION_SIZE, time_ms, leader,
+                self._noisy(float(w.size_mb[p])), p))
+        for b in w.broker_ids():
+            lin = leader_in.get(b, 0.0)
+            lout = leader_out.get(b, 0.0)
+            rin = repl_in.get(b, 0.0)
+            cpu = (w.base_cpu + w.cpu_per_bytes_in * lin
+                   + w.cpu_per_bytes_out * lout
+                   + w.cpu_per_replication_in * rin)
+            records.append(CruiseControlMetric(
+                RawMetricType.BROKER_CPU_UTIL, time_ms, b, self._noisy(cpu)))
+            records.append(CruiseControlMetric(
+                RawMetricType.ALL_TOPIC_BYTES_IN, time_ms, b,
+                self._noisy(lin + rin)))
+            records.append(CruiseControlMetric(
+                RawMetricType.ALL_TOPIC_BYTES_OUT, time_ms, b,
+                self._noisy(lout)))
+        self.topic.produce(records)
+
+
+# ---------------------------------------------------------------------------------
+# Samples + processor
+# ---------------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PartitionMetricSample:
+    partition: int
+    time_ms: int
+    values: Tuple[float, ...]  # indexed by PARTITION_DEF metric ids
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerMetricSample:
+    broker_id: int
+    time_ms: int
+    values: Tuple[float, ...]  # indexed by BROKER_DEF metric ids
+
+
+@dataclasses.dataclass
+class ModelParameters:
+    """Coefficients of the partition-CPU linear model (upstream
+    ``ModelParameters`` / ``LinearRegressionModelParameters``): a leader
+    partition's CPU share of its broker is split between its bytes-in and
+    bytes-out shares."""
+
+    cpu_weight_bytes_in: float = 0.6
+    cpu_weight_bytes_out: float = 0.4
+
+
+class MetricsProcessor:
+    """Raw records for one sampling interval → typed samples (upstream
+    ``CruiseControlMetricsProcessor.process``)."""
+
+    def __init__(self, params: Optional[ModelParameters] = None):
+        self.params = params or ModelParameters()
+
+    def process(
+        self, records: Sequence[CruiseControlMetric]
+    ) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
+        by_broker: Dict[int, Dict[RawMetricType, float]] = {}
+        part_raw: Dict[int, Dict[RawMetricType, float]] = {}
+        part_broker: Dict[int, int] = {}
+        times: Dict[int, int] = {}
+        for r in records:
+            if r.partition >= 0:
+                part_raw.setdefault(r.partition, {})[r.metric_type] = r.value
+                part_broker[r.partition] = r.broker_id
+                times[r.partition] = max(times.get(r.partition, 0), r.time_ms)
+            else:
+                by_broker.setdefault(r.broker_id, {})[r.metric_type] = r.value
+
+        # broker totals of leader traffic, for CPU attribution shares
+        tot_in: Dict[int, float] = {}
+        tot_out: Dict[int, float] = {}
+        for p, m in part_raw.items():
+            b = part_broker[p]
+            tot_in[b] = tot_in.get(b, 0.0) + m.get(RawMetricType.PARTITION_BYTES_IN, 0.0)
+            tot_out[b] = tot_out.get(b, 0.0) + m.get(RawMetricType.PARTITION_BYTES_OUT, 0.0)
+
+        psamples: List[PartitionMetricSample] = []
+        for p, m in sorted(part_raw.items()):
+            b = part_broker[p]
+            bm = by_broker.get(b, {})
+            bytes_in = m.get(RawMetricType.PARTITION_BYTES_IN, 0.0)
+            bytes_out = m.get(RawMetricType.PARTITION_BYTES_OUT, 0.0)
+            cpu = estimate_partition_cpu(
+                broker_cpu=bm.get(RawMetricType.BROKER_CPU_UTIL, 0.0),
+                bytes_in=bytes_in, bytes_out=bytes_out,
+                broker_bytes_in=tot_in.get(b, 0.0),
+                broker_bytes_out=tot_out.get(b, 0.0),
+                params=self.params,
+            )
+            values = [0.0] * PARTITION_DEF.num_metrics
+            values[P_CPU] = cpu
+            values[P_DISK] = m.get(RawMetricType.PARTITION_SIZE, 0.0)
+            values[P_NW_IN] = bytes_in
+            values[P_NW_OUT] = bytes_out
+            psamples.append(
+                PartitionMetricSample(p, times.get(p, 0), tuple(values))
+            )
+
+        bsamples: List[BrokerMetricSample] = []
+        bt = max((r.time_ms for r in records), default=0)
+        for b, m in sorted(by_broker.items()):
+            values = [0.0] * BROKER_DEF.num_metrics
+            values[B_CPU] = m.get(RawMetricType.BROKER_CPU_UTIL, 0.0)
+            values[B_BYTES_IN] = m.get(RawMetricType.ALL_TOPIC_BYTES_IN, 0.0)
+            values[B_BYTES_OUT] = m.get(RawMetricType.ALL_TOPIC_BYTES_OUT, 0.0)
+            values[B_DISK] = sum(
+                pm.get(RawMetricType.PARTITION_SIZE, 0.0)
+                for p, pm in part_raw.items() if part_broker[p] == b
+            )
+            bsamples.append(BrokerMetricSample(b, bt, tuple(values)))
+        return psamples, bsamples
+
+
+def estimate_partition_cpu(
+    broker_cpu: float,
+    bytes_in: float,
+    bytes_out: float,
+    broker_bytes_in: float,
+    broker_bytes_out: float,
+    params: ModelParameters,
+) -> float:
+    """Leader-partition CPU estimate (upstream ``ModelUtils``): the broker's
+    CPU is attributed to partitions by a weighted mix of their bytes-in and
+    bytes-out shares."""
+    share = 0.0
+    if broker_bytes_in > 0:
+        share += params.cpu_weight_bytes_in * (bytes_in / broker_bytes_in)
+    if broker_bytes_out > 0:
+        share += params.cpu_weight_bytes_out * (bytes_out / broker_bytes_out)
+    return broker_cpu * share
+
+
+# ---------------------------------------------------------------------------------
+# Sampler SPI
+# ---------------------------------------------------------------------------------
+
+class MetricSampler:
+    """Pluggable sample source (upstream ``MetricSampler`` SPI)."""
+
+    def get_samples(
+        self, start_ms: int, end_ms: int
+    ) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MetricsReporterSampler(MetricSampler):
+    """Tails the metrics topic and runs the processor (upstream
+    ``CruiseControlMetricsReporterSampler``)."""
+
+    def __init__(
+        self,
+        topic: MetricsTopic,
+        processor: Optional[MetricsProcessor] = None,
+    ):
+        self.topic = topic
+        self.processor = processor or MetricsProcessor()
+        self._offset = 0
+        # records consumed but timestamped at/after a poll's end_ms — held
+        # for the next poll instead of being silently dropped
+        self._pending: List[CruiseControlMetric] = []
+
+    def get_samples(self, start_ms: int, end_ms: int):
+        fresh, self._offset = self.topic.consume_from(self._offset)
+        records = self._pending + fresh
+        ready = [r for r in records if r.time_ms < end_ms]
+        self._pending = [r for r in records if r.time_ms >= end_ms]
+        return self.processor.process(ready)

@@ -1,0 +1,62 @@
+"""Config registry tests (upstream KafkaCruiseControlConfig semantics)."""
+
+import pytest
+
+from cruise_control_tpu.config.cruise_control_config import (
+    ConfigException,
+    CruiseControlConfig,
+    resolve_class,
+)
+
+
+def test_defaults_materialize():
+    cfg = CruiseControlConfig()
+    assert cfg.get_int("num.partition.metrics.windows") == 5
+    assert cfg.get_double("cpu.capacity.threshold") == 0.7
+    assert cfg.get_boolean("use.tpu.optimizer") is True
+    goals = cfg.get_list("default.goals")
+    assert goals[0] == "RackAwareGoal" and len(goals) == 15
+
+
+def test_type_coercion_from_strings():
+    cfg = CruiseControlConfig({
+        "webserver.http.port": "8080",
+        "self.healing.enabled": "true",
+        "cpu.balance.threshold": "1.25",
+        "hard.goals": "RackAwareGoal, DiskCapacityGoal",
+    })
+    assert cfg.get_int("webserver.http.port") == 8080
+    assert cfg.get_boolean("self.healing.enabled") is True
+    assert cfg.get_double("cpu.balance.threshold") == 1.25
+    assert cfg.get_list("hard.goals") == ["RackAwareGoal", "DiskCapacityGoal"]
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigException, match="unknown config keys"):
+        CruiseControlConfig({"no.such.key": 1})
+
+
+def test_validator_rejects_out_of_range():
+    with pytest.raises(ConfigException, match="must be"):
+        CruiseControlConfig({"cpu.capacity.threshold": 1.5})
+    with pytest.raises(ConfigException, match="must be"):
+        CruiseControlConfig({"num.partition.metrics.windows": 0})
+
+
+def test_pluggable_class_instantiation():
+    cfg = CruiseControlConfig()
+    from cruise_control_tpu.monitor.sample_store import NoopSampleStore
+    cfg2 = CruiseControlConfig({
+        "sample.store.class":
+            "cruise_control_tpu.monitor.sample_store.NoopSampleStore",
+    })
+    assert isinstance(cfg2.get_configured_instance("sample.store.class"),
+                      NoopSampleStore)
+    # goal short-names resolve through the goal registry
+    from cruise_control_tpu.analyzer.goals.rack import RackAwareGoal
+    assert resolve_class("RackAwareGoal") is RackAwareGoal
+
+
+def test_bad_class_path_raises():
+    with pytest.raises(ConfigException, match="cannot resolve"):
+        resolve_class("no.such.module.Klass")

@@ -256,3 +256,35 @@ def test_device_model_tree_flatten_no_copy():
     m = TpuGoalOptimizer()._device_model(AnalyzerContext(state))
     leaves, _ = jax.tree_util.tree_flatten(m)
     assert leaves[0] is m.assignment
+
+
+def test_move_ceiling_respects_strategy_order():
+    """The max_inter_broker_moves cap keeps the strategy's highest-priority
+    moves, not raw insertion order (code-review regression)."""
+    from cruise_control_tpu.executor.tasks import (
+        PrioritizeSmallReplicaMovementStrategy,
+    )
+    backend, assignment, _ = make_backend(num_partitions=6)
+    cfg = ExecutorConfig(max_inter_broker_moves=1)
+    ex = Executor(backend, cfg)
+    proposals = [prop(p, assignment[p], [2, 3]) for p in (0, 1)]
+    sizes = {0: 500.0, 1: 5.0}  # partition 1 is the small (preferred) move
+    ex.execute_proposals(
+        proposals, strategy=PrioritizeSmallReplicaMovementStrategy(),
+        partition_sizes=sizes,
+    )
+    by_p = {t.proposal.partition: t for t in ex.planner.replica_tasks}
+    assert by_p[1].state == TaskState.COMPLETED
+    assert by_p[0].state == TaskState.ABORTED
+
+
+def test_throttles_exclude_aborted_moves():
+    """Partitions whose moves were capped away are not throttled
+    (code-review regression)."""
+    backend, assignment, _ = make_backend(num_partitions=6)
+    cfg = ExecutorConfig(max_inter_broker_moves=1, replication_throttle=1e6)
+    ex = Executor(backend, cfg)
+    proposals = [prop(p, assignment[p], [2, 3]) for p in (0, 1)]
+    ex.execute_proposals(proposals)
+    set_events = [e for e in backend.throttle_history if e[0] == "set"]
+    assert set_events and len(backend.throttled_partitions) == 0  # cleared

@@ -1,0 +1,333 @@
+"""Monitor-layer tests: aggregator windows/completeness/extrapolation,
+capacity resolver, sample store replay, reporter→sampler→processor pipeline,
+and LoadMonitor end-to-end into the analyzer (SURVEY.md §2.3, §3.3)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    Extrapolation,
+    MetricSampleAggregator,
+)
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityConfigFileResolver,
+    StaticCapacityResolver,
+)
+from cruise_control_tpu.monitor.load_monitor import (
+    BackendMetadataClient,
+    ClusterTopology,
+    LoadMonitor,
+    LoadMonitorState,
+    ModelCompletenessRequirements,
+    NotEnoughValidWindowsError,
+    StaticMetadataClient,
+)
+from cruise_control_tpu.monitor.metric_defs import partition_metric_def
+from cruise_control_tpu.monitor.sampling import (
+    MetricsProcessor,
+    MetricsReporterSampler,
+    MetricsTopic,
+    SimulatedMetricsReporter,
+    WorkloadModel,
+    estimate_partition_cpu,
+    ModelParameters,
+    P_CPU,
+    P_NW_IN,
+)
+from cruise_control_tpu.monitor.sample_store import FileSampleStore
+
+WINDOW = 1000
+
+
+def make_agg(num_entities=2, num_windows=3, min_samples=1):
+    return MetricSampleAggregator(
+        partition_metric_def(), num_entities, WINDOW, num_windows, min_samples
+    )
+
+
+def vec(cpu=0.0, nw_in=0.0):
+    d = partition_metric_def()
+    v = [0.0] * d.num_metrics
+    v[d.metric_info("CPU_USAGE").metric_id] = cpu
+    v[d.metric_info("LEADER_BYTES_IN").metric_id] = nw_in
+    return v
+
+
+class TestAggregator:
+    def test_avg_aggregation_within_window(self):
+        agg = make_agg()
+        agg.add_sample(0, 100, vec(cpu=10))
+        agg.add_sample(0, 200, vec(cpu=20))
+        agg.add_sample(0, WINDOW + 100, vec(cpu=99))  # opens window 1
+        out = agg.aggregate()
+        # only window 0 is complete; CPU is AVG-aggregated
+        assert out.values.shape[1] == 1
+        assert out.values[0, 0, P_CPU] == pytest.approx(15.0)
+
+    def test_incomplete_window_extrapolated_avg_adjacent(self):
+        agg = make_agg(num_entities=2, min_samples=1)
+        for w in range(3):
+            agg.add_sample(0, w * WINDOW + 1, vec(cpu=10 * (w + 1)))
+        # entity 1 misses window 1
+        agg.add_sample(1, 1, vec(cpu=5))
+        agg.add_sample(1, 2 * WINDOW + 1, vec(cpu=7))
+        agg.add_sample(0, 3 * WINDOW + 1, vec())  # complete window 2
+        agg.add_sample(1, 3 * WINDOW + 1, vec())
+        out = agg.aggregate()
+        assert out.extrapolations[1][1] == Extrapolation.AVG_ADJACENT
+        assert out.values[1, 1, P_CPU] == pytest.approx(6.0)
+        assert bool(out.entity_valid[1]) is True
+
+    def test_entity_with_no_samples_is_invalid(self):
+        agg = make_agg(num_entities=2)
+        agg.add_sample(0, 1, vec(cpu=10))
+        agg.add_sample(0, WINDOW + 1, vec(cpu=10))
+        out = agg.aggregate()
+        assert not out.entity_valid[1]
+        assert out.extrapolations[1][0] == Extrapolation.NO_VALID_EXTRAPOLATION
+        assert out.completeness.valid_entity_ratio == pytest.approx(0.5)
+
+    def test_too_many_extrapolations_invalidate_entity(self):
+        agg = MetricSampleAggregator(
+            partition_metric_def(), 1, WINDOW, 4, min_samples_per_window=1
+        )
+        # entity 0 present only in windows 0 and 4 → 3 extrapolated windows
+        agg.add_sample(0, 1, vec(cpu=10))
+        agg.add_sample(0, 4 * WINDOW + 1, vec(cpu=10))
+        out = agg.aggregate(AggregationOptions(max_allowed_extrapolations=2))
+        assert not out.entity_valid[0]
+
+    def test_old_sample_outside_retention_dropped(self):
+        agg = make_agg(num_windows=2)
+        assert agg.add_sample(0, 10 * WINDOW, vec(cpu=1))
+        assert not agg.add_sample(0, 1, vec(cpu=1))
+
+
+class TestCapacity:
+    def test_file_resolver_with_default_and_jbod(self, tmp_path):
+        doc = {
+            "brokerCapacities": [
+                {"brokerId": "-1",
+                 "capacity": {"CPU": "100", "NW_IN": "10000",
+                              "NW_OUT": "10000", "DISK": "500000"}},
+                {"brokerId": "0",
+                 "capacity": {"CPU": "200", "NW_IN": "20000",
+                              "NW_OUT": "20000",
+                              "DISK": {"/d1": "250000", "/d2": "250000"}}},
+            ]
+        }
+        path = tmp_path / "capacity.json"
+        path.write_text(json.dumps(doc))
+        r = BrokerCapacityConfigFileResolver(str(path))
+        assert r.capacity_for_broker(0).capacity[Resource.CPU] == 200
+        assert r.capacity_for_broker(0).capacity[Resource.DISK] == 500000
+        # unknown broker falls back to the -1 default entry
+        info = r.capacity_for_broker(42)
+        assert info.capacity[Resource.CPU] == 100 and info.is_estimated
+
+    def test_missing_default_entry_raises(self, tmp_path):
+        path = tmp_path / "capacity.json"
+        path.write_text(json.dumps({"brokerCapacities": [
+            {"brokerId": "0", "capacity": {"CPU": "1"}}]}))
+        with pytest.raises(ValueError, match="default"):
+            BrokerCapacityConfigFileResolver(str(path))
+
+
+def make_workload(num_partitions=8, brokers=(0, 1, 2)):
+    rng = np.random.default_rng(7)
+    assignment = {
+        p: [brokers[p % len(brokers)], brokers[(p + 1) % len(brokers)]]
+        for p in range(num_partitions)
+    }
+    leaders = {p: assignment[p][0] for p in range(num_partitions)}
+    return WorkloadModel(
+        bytes_in=rng.uniform(100, 1000, num_partitions),
+        bytes_out=rng.uniform(100, 2000, num_partitions),
+        size_mb=rng.uniform(10, 500, num_partitions),
+        assignment=assignment,
+        leaders=leaders,
+    )
+
+
+class TestSamplingPipeline:
+    def test_reporter_to_sampler_roundtrip(self):
+        w = make_workload()
+        topic = MetricsTopic()
+        SimulatedMetricsReporter(w, topic).report(time_ms=500)
+        sampler = MetricsReporterSampler(topic)
+        psamples, bsamples = sampler.get_samples(0, 1000)
+        assert len(psamples) == 8 and len(bsamples) == 3
+        by_p = {s.partition: s for s in psamples}
+        assert by_p[0].values[P_NW_IN] == pytest.approx(w.bytes_in[0])
+        # sampler is offset-tracking: nothing new on the second poll
+        assert sampler.get_samples(0, 1000) == ([], [])
+
+    def test_partition_cpu_estimation_shares_broker_cpu(self):
+        # two partitions on one broker: CPU attributed by traffic share
+        cpu_a = estimate_partition_cpu(
+            50.0, 300, 0, 400, 0, ModelParameters(1.0, 0.0))
+        cpu_b = estimate_partition_cpu(
+            50.0, 100, 0, 400, 0, ModelParameters(1.0, 0.0))
+        assert cpu_a == pytest.approx(37.5) and cpu_b == pytest.approx(12.5)
+
+    def test_processed_cpu_reflects_linear_model(self):
+        w = make_workload()
+        topic = MetricsTopic()
+        SimulatedMetricsReporter(w, topic).report(time_ms=500)
+        psamples, _ = MetricsReporterSampler(topic).get_samples(0, 1000)
+        assert all(s.values[P_CPU] > 0 for s in psamples)
+
+
+class TestSampleStore:
+    def test_roundtrip_replay(self, tmp_path):
+        w = make_workload()
+        topic = MetricsTopic()
+        SimulatedMetricsReporter(w, topic).report(time_ms=500)
+        psamples, bsamples = MetricsReporterSampler(topic).get_samples(0, 1000)
+        store = FileSampleStore(str(tmp_path / "samples"))
+        store.store_samples(psamples, bsamples)
+        p2, b2 = FileSampleStore(str(tmp_path / "samples")).load_samples()
+        assert p2 == psamples and b2 == bsamples
+
+
+def make_monitor(tmp_path=None, num_partitions=8, windows_to_fill=3):
+    w = make_workload(num_partitions)
+    topic = MetricsTopic()
+    reporter = SimulatedMetricsReporter(w, topic)
+    topo = ClusterTopology(
+        assignment=w.assignment,
+        leaders=w.leaders,
+        broker_rack={0: 0, 1: 1, 2: 0},
+        partition_topic={p: f"t{p % 2}" for p in w.assignment},
+    )
+    store = FileSampleStore(str(tmp_path / "s")) if tmp_path else None
+    monitor = LoadMonitor(
+        StaticMetadataClient(topo),
+        MetricsReporterSampler(topic),
+        sample_store=store,
+        window_ms=WINDOW,
+        num_windows=5,
+    )
+    for wdx in range(windows_to_fill):
+        reporter.report(time_ms=wdx * WINDOW + 500)
+        monitor.run_sampling_iteration((wdx + 1) * WINDOW)
+    return monitor, w, reporter
+
+
+class TestLoadMonitor:
+    def test_cluster_model_end_to_end(self, tmp_path):
+        monitor, w, _ = make_monitor(tmp_path)
+        with monitor.acquire_for_model_generation():
+            state = monitor.cluster_model(
+                ModelCompletenessRequirements(min_required_num_windows=2)
+            )
+        assert state.num_partitions == 8 and state.num_brokers == 3
+        # leader loads reflect the ground-truth workload
+        nw_in = np.asarray(state.leader_load)[:, Resource.NW_IN]
+        assert np.allclose(nw_in, w.bytes_in, rtol=1e-4)
+
+    def test_insufficient_windows_raises(self, tmp_path):
+        monitor, _, _ = make_monitor(tmp_path, windows_to_fill=1)
+        with pytest.raises(NotEnoughValidWindowsError):
+            monitor.cluster_model(
+                ModelCompletenessRequirements(min_required_num_windows=5)
+            )
+
+    def test_pause_resume(self, tmp_path):
+        monitor, _, reporter = make_monitor(tmp_path)
+        monitor.pause_sampling()
+        reporter.report(time_ms=10 * WINDOW)
+        assert monitor.run_sampling_iteration(11 * WINDOW) == 0
+        monitor.resume_sampling()
+        assert monitor.state == LoadMonitorState.RUNNING
+
+    def test_sample_store_replay_restores_model(self, tmp_path):
+        monitor, w, _ = make_monitor(tmp_path)
+        # a fresh monitor over the same store sees the same windows (LOADING)
+        topo = ClusterTopology(
+            assignment=w.assignment, leaders=w.leaders,
+            broker_rack={0: 0, 1: 1, 2: 0},
+            partition_topic={p: "t0" for p in w.assignment},
+        )
+        m2 = LoadMonitor(
+            StaticMetadataClient(topo),
+            MetricsReporterSampler(MetricsTopic()),
+            sample_store=FileSampleStore(str(tmp_path / "s")),
+            window_ms=WINDOW, num_windows=5,
+        )
+        s1 = monitor.cluster_model()
+        s2 = m2.cluster_model()
+        assert np.allclose(
+            np.asarray(s1.leader_load), np.asarray(s2.leader_load)
+        )
+
+    def test_model_feeds_optimizer(self, tmp_path):
+        from cruise_control_tpu.analyzer.goal_optimizer import GoalOptimizer
+        monitor, _, _ = make_monitor(tmp_path)
+        opt = GoalOptimizer()
+        result = opt.optimize(monitor.cluster_model())
+        # on a 3-broker toy cluster soft-goal totals may legitimately rise;
+        # the guarantee is that hard goals end clean
+        hard_after = sum(
+            result.violations_after[g.name] for g in opt.goals if g.is_hard
+        )
+        assert hard_after == 0
+
+    def test_backend_metadata_client(self):
+        from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+        backend = SimulatedClusterBackend(
+            {0: [0, 1], 1: [1, 2]}, {0: 0, 1: 1}, brokers={0, 1, 2}
+        )
+        topo = BackendMetadataClient(backend, {0: 0, 1: 1, 2: 0}).refresh()
+        assert topo.assignment == {0: [0, 1], 1: [1, 2]}
+        assert topo.alive_brokers == {0, 1, 2}
+
+
+class TestReviewRegressions:
+    def test_sampler_retains_future_records(self):
+        """Records at/after end_ms are held for the next poll, not dropped
+        (code-review regression)."""
+        w = make_workload()
+        topic = MetricsTopic()
+        SimulatedMetricsReporter(w, topic).report(time_ms=1500)
+        sampler = MetricsReporterSampler(topic)
+        p1, b1 = sampler.get_samples(0, 1000)
+        assert p1 == [] and b1 == []
+        p2, _ = sampler.get_samples(1000, 2000)
+        assert len(p2) == 8
+
+    def test_aggregator_grows_with_topology(self):
+        agg = make_agg(num_entities=2)
+        agg.add_sample(0, 1, vec(cpu=1))
+        agg.ensure_entities(5)
+        assert agg.add_sample(4, 2, vec(cpu=9))
+        agg.add_sample(0, WINDOW + 1, vec())
+        agg.add_sample(4, WINDOW + 1, vec())
+        out = agg.aggregate()
+        assert out.values.shape[0] == 5
+        assert out.values[4, 0, P_CPU] == pytest.approx(9.0)
+
+    def test_monitor_survives_new_partition(self, tmp_path):
+        """A partition appearing after monitor startup neither crashes
+        sampling nor model generation (code-review regression)."""
+        monitor, w, reporter = make_monitor(tmp_path)
+        # grow the workload: partition 8 appears on brokers [0, 1]
+        w.assignment[8] = [0, 1]
+        w.leaders[8] = 0
+        import numpy as _np
+        w.bytes_in = _np.append(w.bytes_in, 100.0)
+        w.bytes_out = _np.append(w.bytes_out, 100.0)
+        w.size_mb = _np.append(w.size_mb, 10.0)
+        monitor.metadata.topology.assignment[8] = [0, 1]
+        monitor.metadata.topology.leaders[8] = 0
+        monitor.metadata.topology.partition_topic[8] = "t0"
+        reporter.report(time_ms=3 * WINDOW + 500)
+        monitor.run_sampling_iteration(4 * WINDOW)
+        state = monitor.cluster_model(
+            ModelCompletenessRequirements(min_monitored_partitions_ratio=0.0)
+        )
+        assert state.num_partitions == 9
