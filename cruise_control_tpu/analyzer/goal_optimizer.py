@@ -148,6 +148,8 @@ class OptimizerResult:
     final_state: ClusterState
     duration_s: float
     engine: str = "greedy"
+    #: Filled by the facade after a non-dryrun execution (ExecutionResult).
+    execution: Optional[object] = None
 
     @property
     def violation_score_before(self) -> int:
@@ -158,8 +160,17 @@ class OptimizerResult:
         return sum(self.violations_after.values())
 
     def summary(self) -> dict:
+        exec_summary = None
+        if self.execution is not None:
+            exec_summary = {
+                "completed": self.execution.completed,
+                "dead": self.execution.dead,
+                "aborted": self.execution.aborted,
+                "succeeded": self.execution.succeeded,
+            }
         return {
             "engine": self.engine,
+            "execution": exec_summary,
             "numProposals": len(self.proposals),
             "numActions": len(self.actions),
             "violationsBefore": self.violations_before,

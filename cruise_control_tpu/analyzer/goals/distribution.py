@@ -492,18 +492,35 @@ class PreferredLeaderElectionGoal(Goal):
         lead_ok = ctx.leadership_candidates()
         v = 0
         for p in range(ctx.num_partitions):
-            if ctx.leader_slot[p] != 0 and lead_ok[ctx.assignment[p, 0]]:
+            cur = ctx.leader_broker(p)
+            if not lead_ok[cur]:
+                # leader sits on an ineligible (demoted/excluded) broker
+                if any(
+                    ctx.assignment[p, s] != EMPTY_SLOT
+                    and lead_ok[ctx.assignment[p, s]]
+                    for s in range(ctx.max_rf)
+                ):
+                    v += 1
+            elif ctx.leader_slot[p] != 0 and lead_ok[ctx.assignment[p, 0]]:
                 v += 1
         return v
 
     def optimize(self, ctx: AnalyzerContext, optimized: Sequence[Goal]) -> None:
+        lead_ok = ctx.leadership_candidates()
         for p in range(ctx.num_partitions):
-            if ctx.leader_slot[p] == 0:
-                continue
-            if ctx.assignment[p, 0] == EMPTY_SLOT:
-                continue
-            if accepted_leadership(ctx, p, 0, self, optimized):
-                ctx.apply(leadership_action(ctx, p, 0))
+            cur = ctx.leader_broker(p)
+            # preferred slot first, then any eligible slot if the current
+            # leader is ineligible (demoted-broker evacuation semantics)
+            slots = [0] if lead_ok[cur] else list(range(ctx.max_rf))
+            for s in slots:
+                if s == ctx.leader_slot[p]:
+                    continue
+                b = ctx.assignment[p, s]
+                if b == EMPTY_SLOT or not lead_ok[b]:
+                    continue
+                if accepted_leadership(ctx, p, s, self, optimized):
+                    ctx.apply(leadership_action(ctx, p, s))
+                    break
 
 
 class MinTopicLeadersPerBrokerGoal(Goal):

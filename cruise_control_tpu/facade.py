@@ -1,0 +1,351 @@
+"""CruiseControl facade — one method per operation (upstream
+``KafkaCruiseControl.java``; SURVEY.md §2.7, L4 in the layer map).
+
+Wires LoadMonitor (L2) + optimizer engines (L3b) + Executor (L3c) behind the
+operation vocabulary the REST layer (L5) and the anomaly detector (L6) both
+drive: ``rebalance``, ``add_brokers``, ``remove_brokers``, ``demote_brokers``,
+``fix_offline_replicas``, ``get_proposals``, ``state``.  Sanity checks
+(ongoing execution, completeness) happen here, once, so every caller gets the
+same guarantees.
+
+Engine-agnostic by construction: both the greedy baseline
+(:class:`GoalOptimizer`) and the TPU search (:class:`TpuGoalOptimizer`)
+produce the same ``OptimizerResult`` contract, selected per-call via
+``engine=`` or per-instance via config ``analyzer.engine``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import BrokerState
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.goal_optimizer import (
+    GoalOptimizer,
+    OptimizerResult,
+    make_goals,
+)
+from cruise_control_tpu.analyzer.goals.base import BalancingConstraint
+from cruise_control_tpu.analyzer.tpu_optimizer import TpuGoalOptimizer
+from cruise_control_tpu.executor.executor import (
+    Executor,
+    OngoingExecutionError,
+)
+from cruise_control_tpu.executor.tasks import ReplicaMovementStrategy
+from cruise_control_tpu.models.cluster_state import ClusterState
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor,
+    ModelCompletenessRequirements,
+)
+from cruise_control_tpu.server.progress import OperationProgress
+
+
+class CruiseControl:
+    """The facade.  One instance per managed cluster."""
+
+    def __init__(
+        self,
+        load_monitor: LoadMonitor,
+        executor: Executor,
+        constraint: Optional[BalancingConstraint] = None,
+        engine: str = "greedy",
+        mesh=None,
+        proposal_ttl_s: float = 300.0,
+    ):
+        self.load_monitor = load_monitor
+        self.executor = executor
+        self.constraint = constraint or BalancingConstraint()
+        self.default_engine = engine
+        self.mesh = mesh
+        self.anomaly_detector = None  # attached by AnomalyDetectorManager
+        self._start_time = time.time()
+        # cached proposals (upstream GoalOptimizer proposal precompute, §3.5)
+        self._proposal_ttl_s = proposal_ttl_s
+        self._cached_proposals: Optional[OptimizerResult] = None
+        self._cached_at: float = 0.0
+        self._cache_lock = threading.Lock()
+
+    # ---- engine selection -------------------------------------------------------
+    def _make_engine(self, engine: Optional[str]):
+        name = engine or self.default_engine
+        if name == "tpu":
+            return TpuGoalOptimizer(constraint=self.constraint, mesh=self.mesh)
+        if name == "greedy":
+            return GoalOptimizer(constraint=self.constraint)
+        raise ValueError(f"unknown analyzer engine {name!r}")
+
+    # ---- model plumbing ---------------------------------------------------------
+    def _model(
+        self,
+        requirements: Optional[ModelCompletenessRequirements],
+        progress: OperationProgress,
+    ) -> ClusterState:
+        with progress.step("Acquiring model-generation semaphore"):
+            lock = self.load_monitor.acquire_for_model_generation()
+        with lock, progress.step("Generating cluster model"):
+            return self.load_monitor.cluster_model(requirements)
+
+    @staticmethod
+    def _to_internal(state: ClusterState, broker_ids: Sequence[int]) -> List[int]:
+        """External (Kafka) broker ids → dense internal indices."""
+        ext = state.broker_ids or tuple(range(state.num_brokers))
+        index = {e: i for i, e in enumerate(ext)}
+        try:
+            return [index[b] for b in broker_ids]
+        except KeyError as e:
+            raise ValueError(f"unknown broker id {e.args[0]}") from None
+
+    @staticmethod
+    def _to_external_proposals(state: ClusterState, proposals):
+        """Internal broker/partition indices → external ids on every proposal
+        field, so the executor hands the backend real Kafka ids."""
+        ext_b = state.broker_ids or tuple(range(state.num_brokers))
+        ext_p = state.partition_ids or tuple(range(state.num_partitions))
+        identity = (
+            ext_b == tuple(range(state.num_brokers))
+            and ext_p == tuple(range(state.num_partitions))
+        )
+        if identity:
+            return list(proposals)
+        out = []
+        for pr in proposals:
+            out.append(
+                dataclasses.replace(
+                    pr,
+                    partition=ext_p[pr.partition],
+                    old_leader=ext_b[pr.old_leader],
+                    new_leader=ext_b[pr.new_leader],
+                    old_replicas=tuple(ext_b[b] for b in pr.old_replicas),
+                    new_replicas=tuple(ext_b[b] for b in pr.new_replicas),
+                )
+            )
+        return out
+
+    @staticmethod
+    def _with_broker_state(
+        state: ClusterState, internal_ids: Sequence[int], value: BrokerState
+    ) -> ClusterState:
+        import jax.numpy as jnp
+
+        bs = np.array(state.broker_state)
+        for b in internal_ids:
+            bs[b] = value
+        return state.replace(broker_state=jnp.asarray(bs))
+
+    def _sanity_check_no_execution(self, dryrun: bool) -> None:
+        if not dryrun and self.executor.has_ongoing_execution:
+            raise OngoingExecutionError(
+                "cannot start a new execution while one is in progress"
+            )
+
+    # ---- the goal-based operations (upstream GoalBasedOperationRunnable) --------
+    def _goal_based_operation(
+        self,
+        operation: str,
+        state: ClusterState,
+        goals: Optional[Sequence[str]],
+        options: OptimizationOptions,
+        dryrun: bool,
+        engine: Optional[str],
+        progress: OperationProgress,
+        strategy: Optional[ReplicaMovementStrategy] = None,
+    ) -> OptimizerResult:
+        opt = self._make_engine(engine)
+        if goals is not None:
+            # A goal subset pins the operation's semantics (e.g. demote =
+            # PreferredLeaderElectionGoal only).  The TPU search optimizes the
+            # full stack, so subset operations always use the greedy engine.
+            opt = GoalOptimizer(
+                goals=make_goals(goals, self.constraint),
+                constraint=self.constraint,
+            )
+        with progress.step(f"Optimizing ({opt.__class__.__name__})"):
+            result = opt.optimize(state, options)
+        if not dryrun:
+            with progress.step(
+                f"Executing {len(result.proposals)} proposals"
+            ):
+                sizes = self._partition_sizes(state)
+                proposals = self._to_external_proposals(state, result.proposals)
+                result.execution = self.executor.execute_proposals(
+                    proposals, strategy=strategy, partition_sizes=sizes
+                )
+            # the cluster just changed; cached proposals describe a stale world
+            self.invalidate_proposal_cache()
+        progress.finish()
+        return result
+
+    @staticmethod
+    def _partition_sizes(state: ClusterState) -> Dict[int, float]:
+        from cruise_control_tpu.common.resources import Resource
+
+        disk = np.array(state.leader_load)[:, Resource.DISK]
+        ext_p = state.partition_ids or tuple(range(state.num_partitions))
+        return {ext_p[p]: float(disk[p]) for p in range(disk.shape[0])}
+
+    def rebalance(
+        self,
+        goals: Optional[Sequence[str]] = None,
+        dryrun: bool = True,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+        options: Optional[OptimizationOptions] = None,
+        engine: Optional[str] = None,
+        strategy: Optional[ReplicaMovementStrategy] = None,
+        progress: Optional[OperationProgress] = None,
+    ) -> OptimizerResult:
+        """Upstream ``rebalance()`` — the §3.2 call stack from the facade down."""
+        progress = progress or OperationProgress("REBALANCE")
+        self._sanity_check_no_execution(dryrun)
+        state = self._model(requirements, progress)
+        return self._goal_based_operation(
+            "REBALANCE", state, goals, options or OptimizationOptions(),
+            dryrun, engine, progress, strategy,
+        )
+
+    def add_brokers(
+        self,
+        broker_ids: Sequence[int],
+        goals: Optional[Sequence[str]] = None,
+        dryrun: bool = True,
+        engine: Optional[str] = None,
+        progress: Optional[OperationProgress] = None,
+    ) -> OptimizerResult:
+        """Upstream ``addBrokers()``: mark the brokers NEW so distribution
+        goals treat them as under-loaded destinations and move load onto
+        them.  The brokers must already be registered in the metadata /
+        capacity resolver (they joined the cluster empty)."""
+        progress = progress or OperationProgress("ADD_BROKER")
+        self._sanity_check_no_execution(dryrun)
+        state = self._model(None, progress)
+        internal = self._to_internal(state, broker_ids)
+        state = self._with_broker_state(state, internal, BrokerState.NEW)
+        return self._goal_based_operation(
+            "ADD_BROKER", state, goals, OptimizationOptions(),
+            dryrun, engine, progress,
+        )
+
+    def remove_brokers(
+        self,
+        broker_ids: Sequence[int],
+        goals: Optional[Sequence[str]] = None,
+        dryrun: bool = True,
+        engine: Optional[str] = None,
+        progress: Optional[OperationProgress] = None,
+    ) -> OptimizerResult:
+        """Upstream ``removeBrokers()``: every replica on the brokers becomes
+        an immigrant that hard goals must evacuate; the brokers are excluded
+        as destinations."""
+        progress = progress or OperationProgress("REMOVE_BROKER")
+        self._sanity_check_no_execution(dryrun)
+        state = self._model(None, progress)
+        options = OptimizationOptions(
+            brokers_to_remove=set(self._to_internal(state, broker_ids))
+        )
+        return self._goal_based_operation(
+            "REMOVE_BROKER", state, goals, options, dryrun, engine, progress,
+        )
+
+    def demote_brokers(
+        self,
+        broker_ids: Sequence[int],
+        dryrun: bool = True,
+        engine: Optional[str] = None,
+        progress: Optional[OperationProgress] = None,
+    ) -> OptimizerResult:
+        """Upstream ``demoteBrokers()``: move leadership (and preferred-leader
+        position) off the brokers without moving replicas.  Runs only
+        PreferredLeaderElectionGoal, with the brokers marked DEMOTED and
+        excluded from leadership."""
+        progress = progress or OperationProgress("DEMOTE_BROKER")
+        self._sanity_check_no_execution(dryrun)
+        state = self._model(None, progress)
+        internal = self._to_internal(state, broker_ids)
+        state = self._with_broker_state(state, internal, BrokerState.DEMOTED)
+        options = OptimizationOptions(
+            excluded_brokers_for_leadership=set(internal)
+        )
+        return self._goal_based_operation(
+            "DEMOTE_BROKER", state, ["PreferredLeaderElectionGoal"], options,
+            dryrun, "greedy" if engine is None else engine, progress,
+        )
+
+    def fix_offline_replicas(
+        self,
+        goals: Optional[Sequence[str]] = None,
+        dryrun: bool = True,
+        engine: Optional[str] = None,
+        progress: Optional[OperationProgress] = None,
+    ) -> OptimizerResult:
+        """Upstream ``fixOfflineReplicas()``: dead brokers' replicas are
+        offline in the model; the hard-goal stack evacuates them."""
+        progress = progress or OperationProgress("FIX_OFFLINE_REPLICAS")
+        self._sanity_check_no_execution(dryrun)
+        state = self._model(None, progress)
+        return self._goal_based_operation(
+            "FIX_OFFLINE_REPLICAS", state, goals, OptimizationOptions(),
+            dryrun, engine, progress,
+        )
+
+    # ---- proposals cache (upstream proposal precompute, §3.5) -------------------
+    def get_proposals(
+        self,
+        engine: Optional[str] = None,
+        ignore_cache: bool = False,
+        progress: Optional[OperationProgress] = None,
+    ) -> OptimizerResult:
+        progress = progress or OperationProgress("PROPOSALS")
+        with self._cache_lock:
+            fresh = (
+                self._cached_proposals is not None
+                and time.time() - self._cached_at < self._proposal_ttl_s
+            )
+            if fresh and not ignore_cache:
+                progress.add_step("Returning cached proposals")
+                progress.finish()
+                return self._cached_proposals
+        state = self._model(None, progress)
+        result = self._goal_based_operation(
+            "PROPOSALS", state, None, OptimizationOptions(), True,
+            engine, progress,
+        )
+        with self._cache_lock:
+            self._cached_proposals = result
+            self._cached_at = time.time()
+        return result
+
+    def invalidate_proposal_cache(self) -> None:
+        with self._cache_lock:
+            self._cached_proposals = None
+
+    # ---- admin ------------------------------------------------------------------
+    def stop_execution(self) -> None:
+        self.executor.stop_execution()
+
+    def pause_sampling(self) -> None:
+        self.load_monitor.pause_sampling()
+
+    def resume_sampling(self) -> None:
+        self.load_monitor.resume_sampling()
+
+    # ---- state aggregate (upstream GET /state, §5.5) ----------------------------
+    def state(self) -> dict:
+        out = {
+            "version": 1,
+            "upTimeSeconds": round(time.time() - self._start_time, 1),
+            "MonitorState": self.load_monitor.state_summary(),
+            "ExecutorState": self.executor.state_summary(),
+            "AnalyzerState": {
+                "engine": self.default_engine,
+                "isProposalReady": self._cached_proposals is not None,
+                "readyGoals": [g.name for g in make_goals(
+                    constraint=self.constraint)],
+            },
+        }
+        if self.anomaly_detector is not None:
+            out["AnomalyDetectorState"] = self.anomaly_detector.state_summary()
+        return out

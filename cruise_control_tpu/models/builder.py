@@ -58,7 +58,9 @@ class ClusterModelBuilder:
 
     def __init__(self) -> None:
         self._brokers: List[_Broker] = []
+        self._broker_ids: List[int] = []
         self._partitions: List[_Partition] = []
+        self._partition_ids: List[int] = []
         self._topics: Dict[str, int] = {}
         self._racks: Dict[str, int] = {}
 
@@ -71,10 +73,15 @@ class ClusterModelBuilder:
         rack: str | int,
         capacity: Dict[Resource, float] | Sequence[float],
         state: BrokerState = BrokerState.ALIVE,
+        broker_id: Optional[int] = None,
     ) -> int:
+        """``broker_id`` is the *external* (Kafka) id; defaults to the dense
+        internal index.  Returns the internal index."""
         rack_id = self.add_rack(rack) if isinstance(rack, str) else int(rack)
+        internal = len(self._brokers)
         self._brokers.append(_Broker(rack_id, _resource_vec(capacity), state))
-        return len(self._brokers) - 1
+        self._broker_ids.append(internal if broker_id is None else int(broker_id))
+        return internal
 
     def topic_id(self, topic: str) -> int:
         return self._topics.setdefault(topic, len(self._topics))
@@ -87,6 +94,7 @@ class ClusterModelBuilder:
         follower_load: Optional[Dict[Resource, float] | Sequence[float]] = None,
         leader_slot: int = 0,
         offline: Optional[Sequence[bool]] = None,
+        partition_id: Optional[int] = None,
     ) -> int:
         # Default follower load per upstream semantics: replicates bytes-in
         # and disk, serves no bytes-out, and costs a fraction of leader CPU.
@@ -107,13 +115,22 @@ class ClusterModelBuilder:
                 offline=list(offline) if offline is not None else [False] * len(brokers),
             )
         )
-        return len(self._partitions) - 1
+        internal = len(self._partitions) - 1
+        self._partition_ids.append(
+            internal if partition_id is None else int(partition_id)
+        )
+        return internal
 
     def set_broker_state(self, broker: int, state: BrokerState) -> None:
         self._brokers[broker].state = state
 
     # ---- snapshot ---------------------------------------------------------------
     def build(self) -> ClusterState:
+        for label, ids in (("broker", self._broker_ids),
+                           ("partition", self._partition_ids)):
+            if len(set(ids)) != len(ids):
+                dupes = sorted({i for i in ids if ids.count(i) > 1})
+                raise ValueError(f"duplicate external {label} ids: {dupes}")
         num_b = len(self._brokers)
         num_p = len(self._partitions)
         max_rf = max((len(p.brokers) for p in self._partitions), default=1)
@@ -161,4 +178,6 @@ class ClusterModelBuilder:
             ),
             replica_offline=jnp.asarray(offline),
             num_topics=max(len(self._topics), 1),
+            broker_ids=tuple(self._broker_ids),
+            partition_ids=tuple(self._partition_ids),
         )

@@ -66,6 +66,16 @@ class ClusterState:
     broker_state: jax.Array    # int8  [B]
     replica_offline: jax.Array # bool  [P, S]
     num_topics: int = struct.field(pytree_node=False, default=0)
+    #: External (Kafka) broker id per internal index; () = identity.  Kafka
+    #: broker ids need not be contiguous (e.g. 1001..1050), but every tensor
+    #: here is dense — the monitor re-indexes and records the mapping so the
+    #: facade can translate proposals back to external ids for the executor.
+    broker_ids: tuple = struct.field(pytree_node=False, default=())
+    #: Same mapping for partitions (external key per dense row; () = identity).
+    #: Static tuple is fine: the TPU hot path jits over the extracted
+    #: DeviceModel arrays, not ClusterState, so this never hits a jit cache key
+    #: on the scale-critical path.
+    partition_ids: tuple = struct.field(pytree_node=False, default=())
 
     # ---- static shape accessors -------------------------------------------------
     @property

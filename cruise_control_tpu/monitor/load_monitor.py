@@ -254,9 +254,17 @@ class LoadMonitor:
         """Build a ClusterState from current topology + aggregated loads."""
         req = requirements or ModelCompletenessRequirements()
         topo = self.metadata.refresh()
+        # completeness is scored over the topology's partition universe, not
+        # the raw entity axis — sparse keys (deleted partitions) leave hole
+        # entities in the aggregator that must not count as missing data
+        interested = [
+            p for p in sorted(topo.assignment)
+            if p < self.partition_aggregator.num_entities
+        ]
         agg = self.partition_aggregator.aggregate(AggregationOptions(
             min_valid_entity_ratio=req.min_monitored_partitions_ratio,
             max_allowed_extrapolations=self.max_allowed_extrapolations,
+            interested_entities=interested,
         ))
         comp = agg.completeness
         if comp.num_valid_windows < req.min_required_num_windows:
@@ -278,11 +286,12 @@ class LoadMonitor:
         else:
             mean_vals = np.zeros((topo.num_partitions, PARTITION_DEF.num_metrics))
         # topology may have grown past the aggregate (brand-new partitions
-        # with no samples yet): pad with zero load rather than crashing
-        if mean_vals.shape[0] < topo.num_partitions:
-            pad = np.zeros(
-                (topo.num_partitions - mean_vals.shape[0], mean_vals.shape[1])
-            )
+        # with no samples yet), and partition keys may be sparse after
+        # deletions — mean_vals is indexed by the raw external key, so pad to
+        # max key + 1, not to the partition count
+        max_pid = max(topo.assignment, default=-1) + 1
+        if mean_vals.shape[0] < max_pid:
+            pad = np.zeros((max_pid - mean_vals.shape[0], mean_vals.shape[1]))
             mean_vals = np.concatenate([mean_vals, pad], axis=0)
 
         builder = ClusterModelBuilder()
@@ -294,7 +303,7 @@ class LoadMonitor:
             state = (BrokerState.ALIVE if alive is None or b in alive
                      else BrokerState.DEAD)
             broker_index[b] = builder.add_broker(
-                topo.broker_rack.get(b, 0), info.capacity, state
+                topo.broker_rack.get(b, 0), info.capacity, state, broker_id=b
             )
         for p in sorted(topo.assignment):
             replicas = topo.assignment[p]
@@ -314,6 +323,7 @@ class LoadMonitor:
                 leader_load=load,
                 follower_load=follower,
                 leader_slot=lead_slot,
+                partition_id=p,
             )
         return builder.build()
 

@@ -1,0 +1,80 @@
+"""Shared full-stack test harness: simulated cluster + metrics pipeline +
+monitor + executor + facade (SURVEY.md §4 tier-3 "embedded cluster"
+equivalent — everything in-process and deterministic)."""
+
+import numpy as np
+
+from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor.load_monitor import (
+    BackendMetadataClient,
+    LoadMonitor,
+)
+from cruise_control_tpu.monitor.sampling import (
+    MetricsReporterSampler,
+    MetricsTopic,
+    SimulatedMetricsReporter,
+    WorkloadModel,
+)
+
+WINDOW = 1000
+
+
+def skewed_workload(num_partitions=24, num_brokers=4, rf=2, seed=11,
+                    extra_brokers=()):
+    """All leaders piled onto broker 0 — plenty for goals to fix."""
+    rng = np.random.default_rng(seed)
+    assignment = {
+        p: [0, 1 + p % (num_brokers - 1)][:rf] for p in range(num_partitions)
+    }
+    leaders = {p: assignment[p][0] for p in range(num_partitions)}
+    w = WorkloadModel(
+        bytes_in=rng.uniform(100, 1000, num_partitions),
+        bytes_out=rng.uniform(100, 2000, num_partitions),
+        size_mb=rng.uniform(10, 500, num_partitions),
+        assignment=assignment,
+        leaders=leaders,
+    )
+    brokers = set(range(num_brokers)) | set(extra_brokers)
+    return w, brokers
+
+
+def full_stack(
+    num_partitions=24,
+    num_brokers=4,
+    rf=2,
+    windows=3,
+    extra_brokers=(),
+    failed_brokers=None,
+    engine="greedy",
+    executor_config=None,
+):
+    """Build the whole system over a skewed simulated cluster.
+
+    Returns (cruise_control, backend, reporter).
+    """
+    w, brokers = skewed_workload(
+        num_partitions, num_brokers, rf, extra_brokers=extra_brokers
+    )
+    backend = SimulatedClusterBackend(
+        {p: list(r) for p, r in w.assignment.items()},
+        dict(w.leaders),
+        brokers=brokers,
+        failed_brokers=failed_brokers,
+    )
+    broker_rack = {b: b % 2 for b in sorted(brokers)}
+    topic = MetricsTopic()
+    reporter = SimulatedMetricsReporter(w, topic)
+    monitor = LoadMonitor(
+        BackendMetadataClient(backend, broker_rack),
+        MetricsReporterSampler(topic),
+        window_ms=WINDOW,
+        num_windows=5,
+    )
+    for wdx in range(windows):
+        reporter.report(time_ms=wdx * WINDOW + 500)
+        monitor.run_sampling_iteration((wdx + 1) * WINDOW)
+    executor = Executor(backend, executor_config or ExecutorConfig())
+    cc = CruiseControl(monitor, executor, engine=engine)
+    return cc, backend, reporter

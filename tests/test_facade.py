@@ -1,0 +1,216 @@
+"""Facade-layer tests (upstream KafkaCruiseControl operations; SURVEY.md
+§2.7): every runnable end-to-end over the simulated cluster."""
+
+import pytest
+
+from cruise_control_tpu.common.resources import BrokerState
+from cruise_control_tpu.executor.executor import OngoingExecutionError
+from cruise_control_tpu.server.progress import OperationProgress
+
+from harness import full_stack
+
+
+class TestRebalance:
+    def test_dryrun_produces_proposals_without_touching_cluster(self):
+        cc, backend, _ = full_stack()
+        before = {p: list(st.replicas) for p, st in backend.partitions.items()}
+        result = cc.rebalance(dryrun=True)
+        assert result.proposals
+        assert result.execution is None
+        after = {p: list(st.replicas) for p, st in backend.partitions.items()}
+        assert before == after
+
+    def test_execute_applies_proposals_to_backend(self):
+        cc, backend, _ = full_stack()
+        result = cc.rebalance(dryrun=False)
+        assert result.execution is not None and result.execution.succeeded
+        # the backend now matches the plan's target placement
+        for prop in result.proposals:
+            st = backend.partitions[prop.partition]
+            assert set(st.replicas) == set(prop.new_replicas)
+            assert st.leader == prop.new_leader
+
+    def test_improves_leader_balance(self):
+        cc, backend, _ = full_stack()
+        result = cc.rebalance(dryrun=False)
+        leaders = [st.leader for st in backend.partitions.values()]
+        # the skewed workload starts with ALL leaders on broker 0
+        assert leaders.count(0) < len(leaders)
+        assert result.violation_score_after <= result.violation_score_before
+
+    def test_goal_subset_by_name(self):
+        cc, _, _ = full_stack()
+        result = cc.rebalance(goals=["ReplicaDistributionGoal"], dryrun=True)
+        assert set(result.violations_after) == {"ReplicaDistributionGoal"}
+
+    def test_progress_steps_recorded(self):
+        cc, _, _ = full_stack()
+        progress = OperationProgress("REBALANCE")
+        cc.rebalance(dryrun=True, progress=progress)
+        steps = [s["step"] for s in progress.to_json()["operationProgress"]]
+        assert any("cluster model" in s.lower() for s in steps)
+        assert any("optimizing" in s.lower() for s in steps)
+
+
+class TestBrokerOperations:
+    def test_add_brokers_moves_load_onto_new_broker(self):
+        cc, backend, _ = full_stack(extra_brokers=(9,))
+        result = cc.add_brokers([9], dryrun=False)
+        assert result.execution.succeeded
+        on_new = [
+            p for p, st in backend.partitions.items() if 9 in st.replicas
+        ]
+        assert on_new, "no replicas moved onto the added broker"
+
+    def test_remove_brokers_evacuates(self):
+        cc, backend, _ = full_stack()
+        result = cc.remove_brokers([3], dryrun=False)
+        assert result.execution.succeeded
+        for p, st in backend.partitions.items():
+            assert 3 not in st.replicas, f"partition {p} still on broker 3"
+
+    def test_demote_brokers_moves_leadership_only(self):
+        cc, backend, _ = full_stack()
+        before = {p: list(st.replicas) for p, st in backend.partitions.items()}
+        result = cc.demote_brokers([0], dryrun=False)
+        assert result.execution.succeeded
+        for p, st in backend.partitions.items():
+            assert st.leader != 0
+            assert set(st.replicas) == set(before[p]), "replicas moved"
+
+    def test_unknown_broker_raises(self):
+        cc, _, _ = full_stack()
+        with pytest.raises(ValueError, match="unknown broker"):
+            cc.add_brokers([99], dryrun=True)
+
+
+class TestFixOfflineReplicas:
+    def test_evacuates_dead_broker(self):
+        cc, backend, _ = full_stack(failed_brokers={2})
+        result = cc.fix_offline_replicas(dryrun=False)
+        assert result.execution is not None
+        for p, st in backend.partitions.items():
+            assert 2 not in st.replicas, f"partition {p} still on dead broker"
+
+
+class TestProposalsCache:
+    def test_cache_hit_and_invalidation(self):
+        cc, _, _ = full_stack()
+        r1 = cc.get_proposals()
+        r2 = cc.get_proposals()
+        assert r2 is r1  # served from cache
+        cc.invalidate_proposal_cache()
+        r3 = cc.get_proposals()
+        assert r3 is not r1
+
+    def test_ignore_cache_recomputes(self):
+        cc, _, _ = full_stack()
+        r1 = cc.get_proposals()
+        r2 = cc.get_proposals(ignore_cache=True)
+        assert r2 is not r1
+
+
+class TestStateAggregate:
+    def test_state_covers_all_subsystems(self):
+        cc, _, _ = full_stack()
+        st = cc.state()
+        assert st["MonitorState"]["state"] == "RUNNING"
+        assert st["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
+        assert st["AnalyzerState"]["readyGoals"]
+
+    def test_sampling_pause_resume_via_facade(self):
+        cc, _, _ = full_stack()
+        cc.pause_sampling()
+        assert cc.state()["MonitorState"]["state"] == "PAUSED"
+        cc.resume_sampling()
+        assert cc.state()["MonitorState"]["state"] == "RUNNING"
+
+
+class TestIdTranslation:
+    def test_goal_subset_with_tpu_engine_falls_back_to_greedy(self):
+        cc, backend, _ = full_stack(engine="tpu")
+        before = {p: list(st.replicas) for p, st in backend.partitions.items()}
+        result = cc.demote_brokers([0], dryrun=False, engine="tpu")
+        assert result.engine == "greedy"  # subset ops pin greedy semantics
+        for p, st in backend.partitions.items():
+            assert st.leader != 0
+            assert set(st.replicas) == set(before[p]), "replicas moved"
+
+    def test_execution_invalidates_proposal_cache(self):
+        cc, _, _ = full_stack()
+        r1 = cc.get_proposals()
+        cc.rebalance(dryrun=False)
+        r2 = cc.get_proposals()
+        assert r2 is not r1, "stale pre-execution proposals served from cache"
+
+    def test_sparse_partition_ids_translate(self):
+        import numpy as np
+        from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+        from cruise_control_tpu.executor.executor import Executor
+        from cruise_control_tpu.facade import CruiseControl
+        from cruise_control_tpu.monitor.load_monitor import (
+            BackendMetadataClient, LoadMonitor,
+        )
+        from cruise_control_tpu.monitor.sampling import (
+            MetricsReporterSampler, MetricsTopic, SimulatedMetricsReporter,
+            WorkloadModel,
+        )
+
+        # sparse partition keys (a deletion left holes) + sparse broker ids
+        pids = [0, 2, 5, 9, 12, 17]
+        brokers = [100, 101, 102]
+        assignment = {p: [100, 101 + i % 2] for i, p in enumerate(pids)}
+        leaders = {p: 100 for p in pids}
+        n = max(pids) + 1
+        rng = np.random.default_rng(5)
+        w = WorkloadModel(
+            bytes_in=rng.uniform(100, 1000, n),
+            bytes_out=rng.uniform(100, 2000, n),
+            size_mb=rng.uniform(10, 500, n),
+            assignment=assignment, leaders=leaders,
+        )
+        backend = SimulatedClusterBackend(
+            {p: list(r) for p, r in assignment.items()}, dict(leaders),
+            brokers=set(brokers),
+        )
+        topic = MetricsTopic()
+        rep = SimulatedMetricsReporter(w, topic)
+        monitor = LoadMonitor(
+            BackendMetadataClient(backend, {b: b % 2 for b in brokers}),
+            MetricsReporterSampler(topic), window_ms=1000, num_windows=5,
+        )
+        for i in range(3):
+            rep.report(time_ms=i * 1000 + 500)
+            monitor.run_sampling_iteration((i + 1) * 1000)
+        cc = CruiseControl(monitor, Executor(backend))
+        result = cc.rebalance(dryrun=False)
+        assert result.execution.succeeded
+        # every executed proposal addressed a real external partition/broker
+        leaders_now = [st.leader for st in backend.partitions.values()]
+        assert leaders_now.count(100) < len(pids)
+        for st in backend.partitions.values():
+            assert set(st.replicas) <= set(brokers)
+
+    def test_duplicate_external_ids_rejected(self):
+        from cruise_control_tpu.models.builder import ClusterModelBuilder
+
+        b = ClusterModelBuilder()
+        b.add_broker(0, [1.0, 1.0, 1.0, 1.0], broker_id=7)
+        b.add_broker(0, [1.0, 1.0, 1.0, 1.0], broker_id=7)
+        with pytest.raises(ValueError, match="duplicate external broker"):
+            b.build()
+
+
+class TestSanityChecks:
+    def test_ongoing_execution_blocks_new_operation(self):
+        cc, _, _ = full_stack()
+        from cruise_control_tpu.executor.executor import ExecutorStateValue
+
+        cc.executor.state = (
+            ExecutorStateValue.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        )
+        with pytest.raises(OngoingExecutionError):
+            cc.rebalance(dryrun=False)
+        # dryrun is still allowed during an execution
+        result = cc.rebalance(dryrun=True)
+        assert result is not None
