@@ -71,6 +71,14 @@ class AnalyzerContext:
         for b in self.options.brokers_to_remove:
             self.replica_offline |= self.assignment == b
 
+        # Where each offline replica started: partition p may never be placed
+        # back on these brokers during this optimization, or the final diff
+        # would keep the broker in p's replica set and the physically dead
+        # replica would survive the plan (dead dir / dead broker).
+        self.offline_origin = np.where(
+            self.replica_offline, self.assignment, EMPTY_SLOT
+        ).astype(np.int32)
+
         self._init_aggregates()
         self.actions: List[BalancingAction] = []
 

@@ -131,6 +131,9 @@ def legal_move_dests(ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
     for b in row:
         if b != EMPTY_SLOT:
             ok[b] = False  # includes the source broker itself
+    for b in ctx.offline_origin[p]:
+        if b != EMPTY_SLOT:
+            ok[b] = False  # p may not return to a broker it died on
     return ok
 
 

@@ -109,6 +109,10 @@ class DeviceModel:
     alive: jax.Array           # bool [B]
     excluded: jax.Array        # bool [P] topic-excluded partitions
     must_move: jax.Array       # bool [P, S] offline/evacuating replicas
+    #: int32 [P, S] broker each offline replica started on (EMPTY_SLOT
+    #: elsewhere): p may never return there during this optimization, or the
+    #: net diff would keep the dead replica in place
+    offline_origin: jax.Array
     # aggregates (recomputed per round)
     broker_load: jax.Array     # f32 [B, R]
     leader_nwin: jax.Array     # f32 [B]
@@ -259,6 +263,7 @@ def _score_candidates(
     # ---- feasibility (fused hard-goal mask) -----------------------------------
     slot_exists = slot_broker != EMPTY_SLOT
     dup = jnp.any(row == dst[:, None], axis=1)          # dest already hosts p
+    dup = dup | jnp.any(m.offline_origin[cp] == dst[:, None], axis=1)
     cand_rack = m.rack[dst_c]
     other_racks = jnp.where(
         (row != EMPTY_SLOT) & (jnp.arange(S)[None, :] != cs[:, None]),
@@ -490,6 +495,7 @@ class TpuGoalOptimizer:
             alive=jnp.asarray(ctx.broker_alive),
             excluded=jnp.asarray(excluded),
             must_move=jnp.asarray(ctx.replica_offline),
+            offline_origin=jnp.asarray(ctx.offline_origin),
             broker_load=jnp.zeros((ctx.num_brokers, NUM_RESOURCES), jnp.float32),
             leader_nwin=jnp.zeros(ctx.num_brokers, jnp.float32),
             pot_nwout=jnp.zeros(ctx.num_brokers, jnp.float32),

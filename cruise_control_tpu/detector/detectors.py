@@ -1,0 +1,221 @@
+"""The six anomaly detectors (upstream ``detector/*Detector.java`` +
+finder SPIs; SURVEY.md §2.8, call stack §3.4).
+
+Each detector is a pure ``detect(now_ms) -> List[Anomaly]`` pass over the
+live system (metadata / model / broker metrics / maintenance stream); the
+:class:`AnomalyDetectorManager` schedules them and routes results through the
+notifier.  Tick-driven, no hidden threads — a production scheduler thread
+drives the manager, tests drive it directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import AnalyzerContext
+from cruise_control_tpu.analyzer.goal_optimizer import make_goals
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    BrokerFailures,
+    DiskFailures,
+    GoalViolations,
+    MaintenanceEvent,
+    MetricAnomaly,
+    TopicAnomaly,
+)
+from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
+
+
+class GoalViolationDetector:
+    """Checks each self-healing goal's violation predicate on a fresh model
+    (upstream ``GoalViolationDetector``: optimize-on-clone; here the goals
+    expose ``violations()`` directly, so no clone mutation is needed)."""
+
+    def __init__(self, cruise_control, goal_names: Optional[Sequence[str]] = None):
+        self.cc = cruise_control
+        self.goal_names = list(goal_names) if goal_names else None
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        try:
+            with self.cc.load_monitor.acquire_for_model_generation():
+                state = self.cc.load_monitor.cluster_model()
+        except NotEnoughValidWindowsError:
+            return []  # not enough data yet; upstream skips the round too
+        ctx = AnalyzerContext(state)
+        goals = make_goals(self.goal_names, self.cc.constraint)
+        violated = {
+            g.name: v for g in goals if (v := g.violations(ctx)) > 0
+        }
+        if not violated:
+            return []
+        return [GoalViolations(now_ms, violated)]
+
+
+class BrokerFailureDetector:
+    """Metadata-diff detection of vanished brokers with first-seen times
+    persisted to a local file, so the alert→self-heal escalation survives
+    restarts (upstream ``BrokerFailureDetector``, §3.4 note)."""
+
+    def __init__(self, cruise_control, persist_path: Optional[str] = None):
+        self.cc = cruise_control
+        self.persist_path = persist_path
+        self._first_seen: Dict[int, int] = {}
+        if persist_path and os.path.exists(persist_path):
+            with open(persist_path) as f:
+                self._first_seen = {int(k): int(v) for k, v in json.load(f).items()}
+
+    def _persist(self) -> None:
+        if self.persist_path:
+            tmp = self.persist_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._first_seen, f)
+            os.replace(tmp, self.persist_path)
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        topo = self.cc.load_monitor.metadata.refresh()
+        # only brokers that still HOST replicas need healing: an evacuated
+        # dead broker is inert, and re-reporting it would re-trigger a full
+        # self-healing rebalance every cycle
+        hosting = {b for reps in topo.assignment.values() for b in reps}
+        alive = topo.alive_brokers if topo.alive_brokers is not None else hosting
+        failed = hosting - set(alive)
+        changed = False
+        for b in failed:
+            if b not in self._first_seen:
+                self._first_seen[b] = now_ms
+                changed = True
+        for b in list(self._first_seen):
+            if b not in failed:  # came back
+                del self._first_seen[b]
+                changed = True
+        if changed:
+            self._persist()
+        if not self._first_seen:
+            return []
+        return [BrokerFailures(now_ms, dict(self._first_seen))]
+
+
+class DiskFailureDetector:
+    """Offline log dirs on alive brokers (upstream ``DiskFailureDetector``
+    via AdminClient describeLogDirs; here the backend's optional
+    ``offline_log_dirs()`` capability)."""
+
+    def __init__(self, cruise_control, backend):
+        self.cc = cruise_control
+        self.backend = backend
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        probe = getattr(self.backend, "offline_log_dirs", None)
+        if probe is None:
+            return []
+        offline: Dict[int, List[str]] = probe()
+        if not offline:
+            return []
+        return [DiskFailures(now_ms, offline)]
+
+
+class PercentileMetricAnomalyFinder:
+    """Percentile-based finder (upstream ``KafkaMetricAnomalyFinder`` SPI):
+    a broker metric is anomalous when its latest value exceeds the
+    ``upper_percentile`` of that broker's own history by ``margin``×."""
+
+    def __init__(self, upper_percentile: float = 95.0, margin: float = 1.5,
+                 min_windows: int = 3):
+        self.upper_percentile = upper_percentile
+        self.margin = margin
+        self.min_windows = min_windows
+
+    def find(self, now_ms: int, values: np.ndarray, metric_names: Sequence[str],
+             ) -> List[MetricAnomaly]:
+        """``values[B, W, M]`` — per-broker windowed history, newest last."""
+        out: List[MetricAnomaly] = []
+        B, W, M = values.shape
+        if W < self.min_windows:
+            return out
+        history, latest = values[:, :-1, :], values[:, -1, :]
+        thresh = np.percentile(history, self.upper_percentile, axis=1)  # [B, M]
+        bad = latest > np.maximum(thresh * self.margin, 1e-9)
+        for b, m in zip(*np.nonzero(bad)):
+            out.append(MetricAnomaly(
+                now_ms, int(b), metric_names[int(m)],
+                float(latest[b, m]), float(thresh[b, m] * self.margin),
+            ))
+        return out
+
+
+class MetricAnomalyDetector:
+    """Feeds the broker aggregator's windowed history through a finder SPI
+    (upstream ``MetricAnomalyDetector``)."""
+
+    def __init__(self, cruise_control, finder: Optional[PercentileMetricAnomalyFinder] = None):
+        self.cc = cruise_control
+        self.finder = finder or PercentileMetricAnomalyFinder()
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        agg = self.cc.load_monitor.broker_aggregator.aggregate()
+        if agg.values.size == 0:
+            return []
+        names = [
+            m.name for m in
+            self.cc.load_monitor.broker_aggregator.metric_def.all_metrics()
+        ]
+        return list(self.finder.find(now_ms, agg.values, names))
+
+
+class TopicReplicationFactorAnomalyFinder:
+    """Partitions whose live RF is below the target (upstream
+    ``TopicReplicationFactorAnomalyFinder``)."""
+
+    def __init__(self, target_rf: int):
+        self.target_rf = target_rf
+
+    def find(self, now_ms: int, topo) -> List[TopicAnomaly]:
+        bad = [
+            p for p, reps in topo.assignment.items()
+            if len(set(reps)) < self.target_rf
+        ]
+        if not bad:
+            return []
+        return [TopicAnomaly(now_ms, self.target_rf, sorted(bad))]
+
+
+class TopicAnomalyDetector:
+    def __init__(self, cruise_control, target_rf: int):
+        self.cc = cruise_control
+        self.finder = TopicReplicationFactorAnomalyFinder(target_rf)
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        topo = self.cc.load_monitor.metadata.refresh()
+        return list(self.finder.find(now_ms, topo))
+
+
+class MaintenanceEventReader:
+    """SPI: source of operator maintenance events (upstream reads a Kafka
+    topic; the in-process default is an appendable queue)."""
+
+    def __init__(self):
+        self._queue: List[dict] = []
+
+    def submit(self, event_type: str, brokers: Optional[Sequence[int]] = None,
+               ) -> None:
+        self._queue.append({"type": event_type, "brokers": list(brokers or [])})
+
+    def read(self) -> List[dict]:
+        out, self._queue = self._queue, []
+        return out
+
+
+class MaintenanceEventDetector:
+    def __init__(self, cruise_control, reader: Optional[MaintenanceEventReader] = None):
+        self.cc = cruise_control
+        self.reader = reader or MaintenanceEventReader()
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        return [
+            MaintenanceEvent(now_ms, e["type"], e.get("brokers"))
+            for e in self.reader.read()
+        ]

@@ -1,0 +1,204 @@
+"""AnomalyDetectorManager — schedules detectors, routes anomalies through the
+notifier, executes self-healing fixes (upstream
+``detector/AnomalyDetectorManager.java`` + ``AnomalyDetectorState``;
+SURVEY.md §2.8, call stack §3.4).
+
+Tick-driven: ``run_detection_cycle(now_ms)`` runs every detector whose
+interval elapsed, then drains the anomaly queue.  A production deployment
+drives it from a scheduler thread (``start()``/``stop()``); tests call it
+directly for determinism.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType
+
+#: Upstream anomaly priority (AnomalyType.priority): operator maintenance
+#: events beat autonomous healing; failures beat balance housekeeping.
+ANOMALY_PRIORITY = {
+    AnomalyType.MAINTENANCE_EVENT: 0,
+    AnomalyType.BROKER_FAILURE: 1,
+    AnomalyType.DISK_FAILURE: 2,
+    AnomalyType.METRIC_ANOMALY: 3,
+    AnomalyType.GOAL_VIOLATION: 4,
+    AnomalyType.TOPIC_ANOMALY: 5,
+}
+from cruise_control_tpu.detector.notifier import (
+    AnomalyNotificationResult,
+    AnomalyNotifier,
+    SelfHealingNotifier,
+)
+from cruise_control_tpu.executor.executor import OngoingExecutionError
+from cruise_control_tpu.server.progress import OperationProgress
+
+
+class AnomalyDetectorManager:
+    def __init__(
+        self,
+        cruise_control,
+        detectors: Optional[Dict[AnomalyType, object]] = None,
+        notifier: Optional[AnomalyNotifier] = None,
+        detection_interval_ms: int = 300_000,
+        fix_cooldown_ms: int = 600_000,
+        history_size: int = 100,
+    ):
+        self.cc = cruise_control
+        self.detectors = dict(detectors or {})
+        self.notifier = notifier or SelfHealingNotifier()
+        self.detection_interval_ms = detection_interval_ms
+        self.fix_cooldown_ms = fix_cooldown_ms
+        self._last_run_ms: Dict[AnomalyType, int] = {}
+        self._last_fix_ms: Optional[int] = None
+        self._history: deque = deque(maxlen=history_size)
+        self._by_action: Dict[str, int] = {r.value: 0 for r in AnomalyNotificationResult}
+        #: anomalies whose FIX was delayed (cooldown/ongoing execution) —
+        #: retried next cycle.  Needed for maintenance events, which are
+        #: consumed destructively from their stream and would otherwise be
+        #: silently lost; harmless for re-detectable anomaly types.
+        self._pending_fixes: deque = deque()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        cruise_control.anomaly_detector = self
+
+    # ---- detection cycle --------------------------------------------------------
+    def run_detection_cycle(self, now_ms: int) -> List[Anomaly]:
+        """Run due detectors, then handle retries + fresh anomalies in
+        priority order.  Returns anomalies handled."""
+        queue: List[Anomaly]
+        queue, self._pending_fixes = list(self._pending_fixes), deque()
+        for atype, det in self.detectors.items():
+            last = self._last_run_ms.get(atype)
+            if last is not None and now_ms - last < self.detection_interval_ms:
+                continue
+            self._last_run_ms[atype] = now_ms
+            try:
+                queue.extend(det.detect(now_ms))
+            except Exception as e:  # a broken detector must not kill the loop
+                self._history.append({
+                    "detector": atype.value,
+                    "action": "DETECT_FAILED",
+                    "error": repr(e),
+                    "timeMs": now_ms,
+                })
+        queue.sort(key=lambda a: (ANOMALY_PRIORITY[a.anomaly_type],
+                                  a.detected_ms))
+        for anomaly in queue:
+            self._handle(anomaly, now_ms)
+        return queue
+
+    def _handle(self, anomaly: Anomaly, now_ms: int) -> None:
+        action = self.notifier.on_anomaly(anomaly, now_ms)
+        record = {
+            "anomaly": anomaly.to_json(),
+            "action": action.value,
+            "timeMs": now_ms,
+            "fixStarted": False,
+        }
+        if action == AnomalyNotificationResult.FIX:
+            in_cooldown = (
+                self._last_fix_ms is not None
+                and now_ms - self._last_fix_ms < self.fix_cooldown_ms
+            )
+            if in_cooldown:
+                record["action"] = "FIX_DELAYED_COOLDOWN"
+                self._pending_fixes.append(anomaly)
+            elif self.cc.executor.has_ongoing_execution:
+                record["action"] = "FIX_DELAYED_ONGOING_EXECUTION"
+                self._pending_fixes.append(anomaly)
+            else:
+                progress = OperationProgress(
+                    f"SELF_HEAL_{anomaly.anomaly_type.value}"
+                )
+                try:
+                    anomaly.fix(self.cc, progress)
+                    record["fixStarted"] = True
+                    self._last_fix_ms = now_ms
+                except OngoingExecutionError:
+                    record["action"] = "FIX_DELAYED_ONGOING_EXECUTION"
+                    self._pending_fixes.append(anomaly)
+                except Exception as e:  # fix failures must not kill the loop
+                    record["action"] = "FIX_FAILED"
+                    record["error"] = repr(e)
+        final = record["action"]
+        self._by_action[final] = self._by_action.get(final, 0) + 1
+        self._history.append(record)
+
+    # ---- background scheduling --------------------------------------------------
+    def start(self, tick_s: float = 1.0) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(tick_s):
+                self.run_detection_cycle(int(time.time() * 1000))
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="anomaly-detector")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # ---- observability (upstream AnomalyDetectorState) --------------------------
+    def state_summary(self) -> dict:
+        return {
+            "selfHealingEnabled": {
+                t.value: on
+                for t, on in self.notifier.self_healing_enabled().items()
+            },
+            "recentAnomalies": list(self._history)[-10:],
+            "metrics": dict(self._by_action),
+            "lastFixMs": self._last_fix_ms,
+            "detectors": [t.value for t in self.detectors],
+        }
+
+
+def make_detector_manager(
+    cruise_control,
+    backend=None,
+    target_rf: Optional[int] = None,
+    maintenance_reader=None,
+    broker_failure_persist_path: Optional[str] = None,
+    notifier: Optional[AnomalyNotifier] = None,
+    **kwargs,
+) -> AnomalyDetectorManager:
+    """Assemble the full upstream detector set for a facade instance."""
+    from cruise_control_tpu.detector.detectors import (
+        BrokerFailureDetector,
+        DiskFailureDetector,
+        GoalViolationDetector,
+        MaintenanceEventDetector,
+        MetricAnomalyDetector,
+        TopicAnomalyDetector,
+    )
+
+    detectors: Dict[AnomalyType, object] = {
+        AnomalyType.GOAL_VIOLATION: GoalViolationDetector(cruise_control),
+        AnomalyType.BROKER_FAILURE: BrokerFailureDetector(
+            cruise_control, broker_failure_persist_path
+        ),
+        AnomalyType.METRIC_ANOMALY: MetricAnomalyDetector(cruise_control),
+        AnomalyType.MAINTENANCE_EVENT: MaintenanceEventDetector(
+            cruise_control, maintenance_reader
+        ),
+    }
+    if backend is not None:
+        detectors[AnomalyType.DISK_FAILURE] = DiskFailureDetector(
+            cruise_control, backend
+        )
+    if target_rf is not None:
+        detectors[AnomalyType.TOPIC_ANOMALY] = TopicAnomalyDetector(
+            cruise_control, target_rf
+        )
+    return AnomalyDetectorManager(
+        cruise_control, detectors, notifier=notifier, **kwargs
+    )

@@ -99,7 +99,43 @@ class SimulatedClusterBackend(ClusterBackend):
         self.throttle_rate: Optional[float] = None
         self.throttled_partitions: Set[int] = set()
         self.throttle_history: List[Tuple[str, float]] = []
+        #: broker → offline log dirs (JBOD disk-failure injection; consumed by
+        #: DiskFailureDetector the way upstream consumes describeLogDirs)
+        self.offline_dirs: Dict[int, List[str]] = {}
+        #: (partition, broker) → log dir hosting that replica.  Unmapped
+        #: replicas on a broker with offline dirs are treated as offline
+        #: (conservative, matches losing the whole JBOD mount set).
+        self.replica_dir: Dict[Tuple[int, int], str] = {}
         self.ticks = 0
+
+    def offline_log_dirs(self) -> Dict[int, List[str]]:
+        return {b: list(d) for b, d in self.offline_dirs.items() if d}
+
+    def offline_replicas(self) -> Dict[int, List[int]]:
+        """partition → brokers whose replica sits on an offline dir."""
+        out: Dict[int, List[int]] = {}
+        for p, st in self.partitions.items():
+            for b in st.replicas:
+                dead_dirs = self.offline_dirs.get(b)
+                if not dead_dirs:
+                    continue
+                d = self.replica_dir.get((p, b))
+                if d is None or d in dead_dirs:
+                    out.setdefault(p, []).append(b)
+        return out
+
+    def _healthy_dirs(self, broker: int) -> Set[str]:
+        known = {d for (_, rb), d in self.replica_dir.items() if rb == broker}
+        known.update(self.offline_dirs.get(broker, []))
+        return known - set(self.offline_dirs.get(broker, []))
+
+    def degraded_brokers(self) -> Set[int]:
+        """Brokers with offline dirs and no known healthy dir left — they
+        must not receive new replicas until the disk is replaced."""
+        return {
+            b for b, dead in self.offline_dirs.items()
+            if dead and not self._healthy_dirs(b)
+        }
 
     # ---- admin surface ----------------------------------------------------------
     def alter_partition_reassignments(
@@ -156,9 +192,22 @@ class SimulatedClusterBackend(ClusterBackend):
             self._progress[p] += 1
             if self._progress[p] >= self.move_latency_ticks:
                 st.catching_up -= set(new)
+                old = st.replicas
                 st.replicas = list(new)
                 if st.leader not in st.replicas:
                     st.leader = st.replicas[0]
+                # keep the replica→dir map honest: dropped replicas free
+                # their dir entry; arrivals land on a healthy dir when the
+                # broker has one (upstream: alterReplicaLogDirs picks a
+                # live log dir)
+                for b in old:
+                    if b not in new:
+                        self.replica_dir.pop((p, b), None)
+                for b in new:
+                    if (p, b) not in self.replica_dir:
+                        healthy = self._healthy_dirs(b)
+                        if healthy:
+                            self.replica_dir[(p, b)] = sorted(healthy)[0]
                 done.append(p)
         for p in done:
             del self._target[p]

@@ -45,6 +45,20 @@ from cruise_control_tpu.monitor.load_monitor import (
 from cruise_control_tpu.server.progress import OperationProgress
 
 
+@dataclasses.dataclass
+class TopicConfigurationResult:
+    """Result of a replication-factor change (no optimizer involved)."""
+
+    proposals: list
+    execution: Optional[object] = None
+
+    def summary(self) -> dict:
+        return {
+            "numProposals": len(self.proposals),
+            "executed": self.execution is not None,
+        }
+
+
 class CruiseControl:
     """The facade.  One instance per managed cluster."""
 
@@ -155,7 +169,15 @@ class CruiseControl:
         progress: OperationProgress,
         strategy: Optional[ReplicaMovementStrategy] = None,
     ) -> OptimizerResult:
-        opt = self._make_engine(engine)
+        # brokers whose every log dir is offline stay alive in the model (their
+        # partitions need evacuating) but must not receive new replicas
+        topo = self.load_monitor.metadata.refresh()
+        for b in topo.degraded_brokers or ():
+            try:
+                (internal,) = self._to_internal(state, [b])
+            except ValueError:
+                continue
+            options.excluded_brokers_for_replica_move.add(internal)
         if goals is not None:
             # A goal subset pins the operation's semantics (e.g. demote =
             # PreferredLeaderElectionGoal only).  The TPU search optimizes the
@@ -164,16 +186,21 @@ class CruiseControl:
                 goals=make_goals(goals, self.constraint),
                 constraint=self.constraint,
             )
+        else:
+            opt = self._make_engine(engine)
         with progress.step(f"Optimizing ({opt.__class__.__name__})"):
             result = opt.optimize(state, options)
+        # the proposals leaving the facade always speak external (Kafka) ids —
+        # dryrun consumers (REST, operators) act on them too, not just the
+        # executor
+        result.proposals = self._to_external_proposals(state, result.proposals)
         if not dryrun:
             with progress.step(
                 f"Executing {len(result.proposals)} proposals"
             ):
                 sizes = self._partition_sizes(state)
-                proposals = self._to_external_proposals(state, result.proposals)
                 result.execution = self.executor.execute_proposals(
-                    proposals, strategy=strategy, partition_sizes=sizes
+                    result.proposals, strategy=strategy, partition_sizes=sizes
                 )
             # the cluster just changed; cached proposals describe a stale world
             self.invalidate_proposal_cache()
@@ -271,7 +298,7 @@ class CruiseControl:
         )
         return self._goal_based_operation(
             "DEMOTE_BROKER", state, ["PreferredLeaderElectionGoal"], options,
-            dryrun, "greedy" if engine is None else engine, progress,
+            dryrun, engine, progress,
         )
 
     def fix_offline_replicas(
@@ -290,6 +317,68 @@ class CruiseControl:
             "FIX_OFFLINE_REPLICAS", state, goals, OptimizationOptions(),
             dryrun, engine, progress,
         )
+
+    def fix_topic_replication_factor(
+        self,
+        target_rf: int,
+        dryrun: bool = True,
+        progress: Optional[OperationProgress] = None,
+    ) -> "TopicConfigurationResult":
+        """Upstream ``TopicConfigurationRunnable`` (update_topic_config
+        endpoint): raise under-replicated partitions to the target RF by
+        adding replicas rack-aware on the least-loaded alive brokers.
+
+        Works on the raw topology rather than the tensor model because the
+        model's replica-slot axis is sized to the *current* max RF."""
+        from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
+
+        progress = progress or OperationProgress("TOPIC_CONFIGURATION")
+        self._sanity_check_no_execution(dryrun)
+        with progress.step("Planning replication-factor changes"):
+            topo = self.load_monitor.metadata.refresh()
+            hosting = set(topo.broker_ids())
+            alive = set(
+                topo.alive_brokers if topo.alive_brokers is not None else hosting
+            )
+            counts = {b: 0 for b in hosting}
+            for reps in topo.assignment.values():
+                for b in reps:
+                    counts[b] = counts.get(b, 0) + 1
+            rack_of = topo.broker_rack
+            proposals = []
+            for p in sorted(topo.assignment):
+                cur = list(dict.fromkeys(topo.assignment[p]))
+                if len(cur) >= target_rf:
+                    continue
+                old = tuple(cur)
+                while len(cur) < target_rf:
+                    used_racks = {rack_of.get(b) for b in cur}
+                    cands = sorted(
+                        (b for b in alive if b not in cur),
+                        key=lambda b: (rack_of.get(b) in used_racks,
+                                       counts.get(b, 0), b),
+                    )
+                    if not cands:
+                        break  # fewer alive brokers than target RF
+                    cur.append(cands[0])
+                    counts[cands[0]] = counts.get(cands[0], 0) + 1
+                if tuple(cur) == old:
+                    continue
+                leader = topo.leaders[p]
+                order = sorted(cur, key=lambda b: b != leader)
+                proposals.append(ExecutionProposal(
+                    partition=p, topic=0,
+                    old_leader=leader, new_leader=leader,
+                    old_replicas=tuple(sorted(old, key=lambda b: b != leader)),
+                    new_replicas=tuple(order),
+                ))
+        execution = None
+        if not dryrun and proposals:
+            with progress.step(f"Executing {len(proposals)} RF changes"):
+                execution = self.executor.execute_proposals(proposals)
+            self.invalidate_proposal_cache()
+        progress.finish()
+        return TopicConfigurationResult(proposals, execution)
 
     # ---- proposals cache (upstream proposal precompute, §3.5) -------------------
     def get_proposals(

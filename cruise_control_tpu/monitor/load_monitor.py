@@ -88,6 +88,11 @@ class ClusterTopology:
     broker_rack: Dict[int, int]           # broker → rack id
     partition_topic: Dict[int, str]       # partition → topic name
     alive_brokers: Optional[set] = None   # None = all referenced brokers
+    #: partition → brokers whose replica is offline (failed disk) even though
+    #: the broker itself is alive; None = none
+    offline_replicas: Optional[Dict[int, List[int]]] = None
+    #: alive brokers that must not receive replicas (all log dirs offline)
+    degraded_brokers: Optional[set] = None
 
     @property
     def num_partitions(self) -> int:
@@ -130,6 +135,8 @@ class BackendMetadataClient(MetadataClient):
             p: list(st.replicas) for p, st in self.backend.partitions.items()
         }
         leaders = {p: st.leader for p, st in self.backend.partitions.items()}
+        probe = getattr(self.backend, "offline_replicas", None)
+        degraded = getattr(self.backend, "degraded_brokers", None)
         return ClusterTopology(
             assignment=assignment,
             leaders=leaders,
@@ -138,6 +145,8 @@ class BackendMetadataClient(MetadataClient):
                 p: self.partition_topic.get(p, "topic_0") for p in assignment
             },
             alive_brokers=self.backend.alive_brokers(),
+            offline_replicas=probe() if probe is not None else None,
+            degraded_brokers=degraded() if degraded is not None else None,
         )
 
 
@@ -317,6 +326,7 @@ class LoadMonitor:
             follower = load.copy()
             follower[Resource.NW_OUT] = 0.0
             follower[Resource.CPU] = load[Resource.CPU] * FOLLOWER_CPU_RATIO
+            off_brokers = (topo.offline_replicas or {}).get(p, ())
             builder.add_partition(
                 topic=topo.partition_topic.get(p, "topic_0"),
                 brokers=[broker_index[b] for b in replicas],
@@ -324,6 +334,7 @@ class LoadMonitor:
                 follower_load=follower,
                 leader_slot=lead_slot,
                 partition_id=p,
+                offline=[b in off_brokers for b in replicas],
             )
         return builder.build()
 

@@ -1,0 +1,311 @@
+"""Detector / self-healing tests (upstream AnomalyDetectorManagerTest /
+SelfHealingNotifierTest semantics; SURVEY.md §2.8, §5.3, call stack §3.4)."""
+
+import pytest
+
+from cruise_control_tpu.detector import (
+    AnomalyNotificationResult,
+    AnomalyType,
+    BrokerFailureDetector,
+    BrokerFailures,
+    GoalViolationDetector,
+    MaintenanceEventReader,
+    MetricAnomaly,
+    PercentileMetricAnomalyFinder,
+    SelfHealingNotifier,
+    make_detector_manager,
+)
+
+from harness import full_stack
+
+MIN = 60_000
+
+
+def healing_notifier(alert_ms=0, heal_ms=0, **types):
+    enabled = {AnomalyType[k.upper()]: v for k, v in types.items()}
+    return SelfHealingNotifier(
+        enabled=enabled,
+        broker_failure_alert_threshold_ms=alert_ms,
+        broker_failure_self_healing_threshold_ms=heal_ms,
+    )
+
+
+class TestGoalViolationDetector:
+    def test_detects_violations_on_skewed_cluster(self):
+        cc, _, _ = full_stack()
+        det = GoalViolationDetector(cc)
+        anomalies = det.detect(now_ms=0)
+        assert len(anomalies) == 1
+        assert anomalies[0].violated_goals
+
+    def test_clean_after_rebalance(self):
+        cc, _, _ = full_stack()
+        cc.rebalance(dryrun=False)
+        det = GoalViolationDetector(cc)
+        anomalies = det.detect(now_ms=0)
+        # leader-bytes-in balance may remain slightly off; hard goals must not
+        for a in anomalies:
+            for name in a.violated_goals:
+                assert "Capacity" not in name and "RackAware" not in name
+
+
+class TestBrokerFailureDetector:
+    def test_first_seen_persisted_across_restart(self, tmp_path):
+        cc, backend, _ = full_stack()
+        path = str(tmp_path / "failed_brokers.json")
+        det = BrokerFailureDetector(cc, path)
+        assert det.detect(now_ms=1000) == []
+        backend.failed_brokers.add(2)
+        (anomaly,) = det.detect(now_ms=2000)
+        assert anomaly.failed_brokers == {2: 2000}
+        # a new detector instance (post-restart) keeps the first-seen time
+        det2 = BrokerFailureDetector(cc, path)
+        (anomaly2,) = det2.detect(now_ms=9000)
+        assert anomaly2.failed_brokers == {2: 2000}
+
+    def test_recovered_broker_cleared(self, tmp_path):
+        cc, backend, _ = full_stack()
+        det = BrokerFailureDetector(cc, str(tmp_path / "f.json"))
+        backend.failed_brokers.add(2)
+        det.detect(now_ms=2000)
+        backend.failed_brokers.clear()
+        assert det.detect(now_ms=3000) == []
+
+
+class TestSelfHealingNotifier:
+    def test_broker_failure_escalation(self):
+        n = healing_notifier(alert_ms=10 * MIN, heal_ms=30 * MIN,
+                             broker_failure=True)
+        a = BrokerFailures(0, {1: 0})
+        assert n.on_anomaly(a, 5 * MIN) == AnomalyNotificationResult.CHECK
+        assert not n.alerts
+        assert n.on_anomaly(a, 15 * MIN) == AnomalyNotificationResult.CHECK
+        assert n.alerts and not n.alerts[-1]["autoFixTriggered"]
+        assert n.on_anomaly(a, 31 * MIN) == AnomalyNotificationResult.FIX
+        assert n.alerts[-1]["autoFixTriggered"]
+
+    def test_healing_disabled_never_fixes(self):
+        n = healing_notifier(alert_ms=0, heal_ms=0, broker_failure=False)
+        a = BrokerFailures(0, {1: 0})
+        assert n.on_anomaly(a, 10 * MIN) == AnomalyNotificationResult.IGNORE
+
+    def test_unfixable_anomaly_alerts_only(self):
+        n = healing_notifier(metric_anomaly=True)
+        a = MetricAnomaly(0, broker_id=1, metric="CPU", current=9.0,
+                          threshold=1.0)
+        assert n.on_anomaly(a, 0) == AnomalyNotificationResult.IGNORE
+        assert n.alerts
+
+
+class TestPercentileFinder:
+    def test_flags_spike_against_own_history(self):
+        import numpy as np
+
+        finder = PercentileMetricAnomalyFinder(upper_percentile=95, margin=1.5)
+        vals = np.ones((2, 6, 1))
+        vals[1, -1, 0] = 10.0  # broker 1 spikes in the newest window
+        out = finder.find(0, vals, ["CPU"])
+        assert [a.broker_id for a in out] == [1]
+        assert out[0].metric == "CPU"
+
+    def test_insufficient_history_silent(self):
+        import numpy as np
+
+        finder = PercentileMetricAnomalyFinder(min_windows=3)
+        assert finder.find(0, np.ones((2, 2, 1)), ["CPU"]) == []
+
+
+class TestManagerEndToEnd:
+    def test_goal_violation_self_heals(self):
+        cc, backend, _ = full_stack()
+        mgr = make_detector_manager(
+            cc, backend=backend,
+            notifier=healing_notifier(goal_violation=True),
+        )
+        assert cc.anomaly_detector is mgr
+        handled = mgr.run_detection_cycle(now_ms=0)
+        assert any(
+            a.anomaly_type == AnomalyType.GOAL_VIOLATION for a in handled
+        )
+        # the fix actually rebalanced the backend
+        leaders = [st.leader for st in backend.partitions.values()]
+        assert leaders.count(0) < len(leaders)
+        st = mgr.state_summary()
+        assert st["metrics"]["FIX"] >= 1
+        assert st["recentAnomalies"][-1]["fixStarted"] or any(
+            r["fixStarted"] for r in st["recentAnomalies"]
+        )
+
+    def test_broker_failure_self_heals_after_threshold(self, tmp_path):
+        cc, backend, _ = full_stack(failed_brokers={2})
+        mgr = make_detector_manager(
+            cc, backend=backend,
+            notifier=healing_notifier(alert_ms=MIN, heal_ms=3 * MIN,
+                                      broker_failure=True),
+            broker_failure_persist_path=str(tmp_path / "f.json"),
+            detection_interval_ms=MIN,
+        )
+        mgr.run_detection_cycle(now_ms=0)       # first seen at 0; CHECK
+        assert all(2 in st.replicas or True for st in backend.partitions.values())
+        assert any(2 in st.replicas for st in backend.partitions.values())
+        mgr.run_detection_cycle(now_ms=4 * MIN)  # past healing threshold: FIX
+        assert all(
+            2 not in st.replicas for st in backend.partitions.values()
+        ), "failed broker not evacuated"
+
+    def test_fix_cooldown_blocks_second_fix(self):
+        cc, backend, _ = full_stack()
+        mgr = make_detector_manager(
+            cc, backend=backend,
+            notifier=healing_notifier(goal_violation=True,
+                                      maintenance_event=True),
+            fix_cooldown_ms=10 * MIN,
+            detection_interval_ms=0,
+        )
+        mgr.run_detection_cycle(now_ms=0)
+        reader = mgr.detectors[AnomalyType.MAINTENANCE_EVENT].reader
+        reader.submit("REBALANCE")
+        mgr.run_detection_cycle(now_ms=MIN)  # within cooldown
+        st = mgr.state_summary()
+        assert any(
+            r["action"] == "FIX_DELAYED_COOLDOWN" for r in st["recentAnomalies"]
+        )
+
+    def test_maintenance_event_remove_broker(self):
+        cc, backend, _ = full_stack()
+        reader = MaintenanceEventReader()
+        mgr = make_detector_manager(
+            cc, backend=backend, maintenance_reader=reader,
+            notifier=healing_notifier(maintenance_event=True),
+        )
+        reader.submit("REMOVE_BROKER", brokers=[3])
+        mgr.run_detection_cycle(now_ms=0)
+        assert all(3 not in st.replicas for st in backend.partitions.values())
+
+    def test_disk_failure_detector_sees_injected_offline_dirs(self):
+        cc, backend, _ = full_stack()
+        mgr = make_detector_manager(cc, backend=backend)
+        backend.offline_dirs = {1: ["/data/d1"]}
+        handled = mgr.run_detection_cycle(now_ms=0)
+        disk = [a for a in handled
+                if a.anomaly_type == AnomalyType.DISK_FAILURE]
+        assert len(disk) == 1 and disk[0].failed_disks == {1: ["/data/d1"]}
+
+    def test_disk_failure_self_heal_evacuates_broker_replicas(self):
+        cc, backend, _ = full_stack()
+        mgr = make_detector_manager(
+            cc, backend=backend,
+            notifier=healing_notifier(disk_failure=True),
+        )
+        # broker 1 loses its only dir; every replica there becomes offline
+        backend.offline_dirs = {1: ["/data/d1"]}
+        assert any(1 in st.replicas for st in backend.partitions.values())
+        mgr.run_detection_cycle(now_ms=0)
+        assert all(
+            1 not in st.replicas for st in backend.partitions.values()
+        ), "replicas not moved off the failed disk's broker"
+
+    def test_partial_disk_failure_evacuates_only_mapped_replicas(self):
+        cc, backend, _ = full_stack()
+        # pin every replica on broker 1 to /d1 except one partition on /d2
+        on_b1 = [p for p, st in backend.partitions.items() if 1 in st.replicas]
+        keep = on_b1[0]
+        for p in on_b1:
+            backend.replica_dir[(p, 1)] = "/d2" if p == keep else "/d1"
+        backend.offline_dirs = {1: ["/d1"]}
+        mgr = make_detector_manager(
+            cc, backend=backend,
+            notifier=healing_notifier(disk_failure=True),
+        )
+        mgr.run_detection_cycle(now_ms=0)
+        assert 1 in backend.partitions[keep].replicas, "healthy-disk replica moved"
+        # nothing is left (or newly placed) on the dead dir; broker 1 may
+        # still host replicas — on its healthy /d2
+        assert backend.offline_replicas() == {}
+        for (p, b), d in backend.replica_dir.items():
+            if b == 1 and 1 in backend.partitions[p].replicas:
+                assert d == "/d2"
+
+    def test_detector_exception_does_not_kill_cycle(self):
+        cc, backend, _ = full_stack()
+        mgr = make_detector_manager(
+            cc, backend=backend,
+            notifier=healing_notifier(goal_violation=True),
+        )
+
+        class Broken:
+            def detect(self, now_ms):
+                raise RuntimeError("metadata unavailable")
+
+        mgr.detectors[AnomalyType.TOPIC_ANOMALY] = Broken()
+        handled = mgr.run_detection_cycle(now_ms=0)
+        # the goal-violation detector still ran and healed
+        assert any(
+            a.anomaly_type == AnomalyType.GOAL_VIOLATION for a in handled
+        )
+        assert any(
+            r.get("action") == "DETECT_FAILED"
+            for r in mgr.state_summary()["recentAnomalies"]
+        )
+
+    def test_delayed_maintenance_event_retried_after_cooldown(self):
+        cc, backend, _ = full_stack()
+        reader = MaintenanceEventReader()
+        mgr = make_detector_manager(
+            cc, backend=backend, maintenance_reader=reader,
+            notifier=healing_notifier(goal_violation=True,
+                                      maintenance_event=True),
+            fix_cooldown_ms=5 * MIN,
+            detection_interval_ms=0,
+        )
+        mgr.run_detection_cycle(now_ms=0)  # goal-violation fix starts cooldown
+        reader.submit("REMOVE_BROKER", brokers=[3])
+        mgr.run_detection_cycle(now_ms=MIN)  # delayed by cooldown
+        assert any(3 in st.replicas for st in backend.partitions.values())
+        mgr.run_detection_cycle(now_ms=7 * MIN)  # retried from pending queue
+        assert all(3 not in st.replicas for st in backend.partitions.values())
+
+    def test_detection_interval_respected(self):
+        cc, backend, _ = full_stack()
+        mgr = make_detector_manager(
+            cc, backend=backend, detection_interval_ms=5 * MIN,
+        )
+        mgr.run_detection_cycle(now_ms=0)
+        n1 = sum(mgr.state_summary()["metrics"].values())
+        mgr.run_detection_cycle(now_ms=MIN)  # too soon; nothing runs
+        assert sum(mgr.state_summary()["metrics"].values()) == n1
+
+
+class TestTopicAnomaly:
+    def test_rf_fix_raises_replication_factor(self):
+        cc, backend, _ = full_stack(rf=1)
+        mgr = make_detector_manager(
+            cc, backend=backend, target_rf=2,
+            notifier=healing_notifier(topic_anomaly=True),
+        )
+        handled = mgr.run_detection_cycle(now_ms=0)
+        assert any(a.anomaly_type == AnomalyType.TOPIC_ANOMALY for a in handled)
+        for p, st in backend.partitions.items():
+            assert len(set(st.replicas)) >= 2, f"partition {p} still RF<2"
+
+    def test_rf_fix_is_rack_aware_when_possible(self):
+        cc, backend, _ = full_stack(rf=1)
+        result = cc.fix_topic_replication_factor(2, dryrun=False)
+        assert result.execution is not None
+        rack = {b: b % 2 for b in range(4)}  # harness broker_rack
+        multi_rack = sum(
+            1 for st in backend.partitions.values()
+            if len({rack[b] for b in st.replicas}) > 1
+        )
+        assert multi_rack == len(backend.partitions)
+
+
+class TestStateIntegration:
+    def test_facade_state_includes_detector(self):
+        cc, backend, _ = full_stack()
+        make_detector_manager(cc, backend=backend)
+        st = cc.state()
+        assert "AnomalyDetectorState" in st
+        assert set(st["AnomalyDetectorState"]["selfHealingEnabled"]) == {
+            t.value for t in AnomalyType
+        }
