@@ -1,0 +1,5 @@
+"""Python CLI client (upstream ``cruise-control-client`` / ``cccli``)."""
+
+from cruise_control_tpu.client.cccli import CruiseControlClient, main
+
+__all__ = ["CruiseControlClient", "main"]

@@ -411,6 +411,20 @@ class CruiseControl:
         with self._cache_lock:
             self._cached_proposals = None
 
+    def rightsize(
+        self, progress: Optional[OperationProgress] = None
+    ) -> "ProvisionResponse":
+        """Upstream RIGHTSIZE endpoint: provisioning analysis of the live
+        cluster (ProvisionResponse)."""
+        from cruise_control_tpu.analyzer.provision import analyze_provisioning
+
+        progress = progress or OperationProgress("RIGHTSIZE")
+        state = self._model(None, progress)
+        with progress.step("Analyzing provisioning"):
+            response = analyze_provisioning(state)
+        progress.finish()
+        return response
+
     # ---- admin ------------------------------------------------------------------
     def stop_execution(self) -> None:
         self.executor.stop_execution()

@@ -1,0 +1,509 @@
+"""REST API server (upstream ``KafkaCruiseControlServlet`` +
+``CruiseControlEndPoint`` + request/parameter classes; SURVEY.md §2.7,
+call stack §3.2 head).
+
+Endpoint names, methods, and the async ``202 + User-Task-ID`` protocol match
+upstream so ``cccli``-style clients port over directly.  Pure stdlib
+(``http.server``) — the build environment has no web framework, and the
+throughput needs (operator API) don't justify one.
+
+GET  /kafkacruisecontrol/state | load | partition_load | proposals |
+     kafka_cluster_state | user_tasks | review_board
+POST /kafkacruisecontrol/rebalance | add_broker | remove_broker |
+     demote_broker | fix_offline_replicas | topic_configuration |
+     stop_proposal_execution | pause_sampling | resume_sampling |
+     admin | review | train | rightsize
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
+from cruise_control_tpu.server.purgatory import Purgatory
+from cruise_control_tpu.server.user_tasks import (
+    TooManyTasksError,
+    UserTaskManager,
+)
+
+PREFIX = "/kafkacruisecontrol"
+USER_TASK_HEADER = "User-Task-ID"
+
+GET_ENDPOINTS = {
+    "state", "load", "partition_load", "proposals", "kafka_cluster_state",
+    "user_tasks", "review_board",
+}
+ASYNC_POST_ENDPOINTS = {
+    "rebalance", "add_broker", "remove_broker", "demote_broker",
+    "fix_offline_replicas", "topic_configuration", "rightsize",
+}
+SYNC_POST_ENDPOINTS = {
+    "stop_proposal_execution", "pause_sampling", "resume_sampling",
+    "admin", "review", "train",
+}
+
+
+class BasicSecurityProvider:
+    """HTTP Basic auth (upstream ``BasicSecurityProvider``); None = open."""
+
+    def __init__(self, users: Dict[str, str]):
+        self.users = dict(users)
+
+    def authenticate(self, auth_header: Optional[str]) -> bool:
+        if not auth_header or not auth_header.startswith("Basic "):
+            return False
+        try:
+            decoded = base64.b64decode(auth_header[6:]).decode()
+            user, _, password = decoded.partition(":")
+        except Exception:
+            return False
+        return self.users.get(user) == password
+
+
+class CruiseControlHttpServer:
+    """Wires the facade to HTTP.  ``start()`` binds and serves on a daemon
+    thread; ``port=0`` picks a free port (tests)."""
+
+    def __init__(
+        self,
+        cruise_control,
+        host: str = "127.0.0.1",
+        port: int = 9090,
+        security_provider: Optional[BasicSecurityProvider] = None,
+        two_step_verification: bool = False,
+        user_task_manager: Optional[UserTaskManager] = None,
+    ):
+        self.cc = cruise_control
+        self.host = host
+        self.port = port
+        self.security = security_provider
+        self.two_step = two_step_verification
+        self.tasks = user_task_manager or UserTaskManager()
+        self.purgatory = Purgatory()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle --------------------------------------------------------------
+    def start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet; metrics cover observability
+                pass
+
+            def do_GET(self):
+                server._dispatch(self, "GET")
+
+            def do_POST(self):
+                server._dispatch(self, "POST")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="cc-http"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.tasks.shutdown()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}{PREFIX}"
+
+    # ---- dispatch ---------------------------------------------------------------
+    def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
+        try:
+            parsed = urlparse(handler.path)
+            if not parsed.path.startswith(PREFIX + "/"):
+                return self._send(handler, 404, {"errorMessage": "not found"})
+            endpoint = parsed.path[len(PREFIX) + 1:].strip("/").lower()
+            params = {
+                k: v[-1] for k, v in parse_qs(parsed.query).items()
+            }
+            if self.security is not None and not self.security.authenticate(
+                handler.headers.get("Authorization")
+            ):
+                handler.send_response(401)
+                handler.send_header("WWW-Authenticate", "Basic")
+                handler.end_headers()
+                return
+            if method == "GET" and endpoint in GET_ENDPOINTS:
+                return self._handle_get(handler, endpoint, params)
+            if method == "POST" and endpoint in ASYNC_POST_ENDPOINTS:
+                return self._handle_async_post(handler, endpoint, params)
+            if method == "POST" and endpoint in SYNC_POST_ENDPOINTS:
+                return self._handle_sync_post(handler, endpoint, params)
+            self._send(handler, 404, {
+                "errorMessage": f"unknown endpoint {method} {endpoint!r}"
+            })
+        except (ValueError, KeyError) as e:
+            self._send(handler, 400, {"errorMessage": str(e)})
+        except NotEnoughValidWindowsError as e:
+            self._send(handler, 503, {"errorMessage": str(e)})
+        except Exception as e:
+            self._send(handler, 500, {"errorMessage": repr(e)})
+
+    @staticmethod
+    def _send(handler, code: int, body: dict,
+              headers: Optional[Dict[str, str]] = None) -> None:
+        data = json.dumps(body, default=str).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    # ---- GET endpoints ----------------------------------------------------------
+    def _handle_get(self, handler, endpoint: str, params: dict) -> None:
+        if endpoint == "state":
+            return self._send(handler, 200, self.cc.state())
+        if endpoint == "load":
+            return self._send(handler, 200, self._load_response())
+        if endpoint == "partition_load":
+            return self._send(handler, 200, self._partition_load_response(params))
+        if endpoint == "proposals":
+            result = self.cc.get_proposals(
+                ignore_cache=_flag(params, "ignore_proposal_cache"),
+            )
+            return self._send(handler, 200, _optimizer_response(result, params))
+        if endpoint == "kafka_cluster_state":
+            return self._send(handler, 200, self._cluster_state_response())
+        if endpoint == "user_tasks":
+            wanted = params.get("user_task_ids")
+            tasks = self.tasks.tasks()
+            if wanted:
+                ids = set(wanted.split(","))
+                tasks = [t for t in tasks if t.task_id in ids]
+            return self._send(
+                handler, 200, {"userTasks": [t.to_json() for t in tasks]}
+            )
+        if endpoint == "review_board":
+            return self._send(
+                handler, 200, {"requestInfo": self.purgatory.review_board()}
+            )
+
+    def _load_response(self) -> dict:
+        with self.cc.load_monitor.acquire_for_model_generation():
+            state = self.cc.load_monitor.cluster_model()
+        from cruise_control_tpu.models.cluster_state import broker_load
+
+        load = np.asarray(broker_load(state))
+        ext = state.broker_ids or tuple(range(state.num_brokers))
+        alive = np.asarray(state.broker_alive())
+        rack = np.asarray(state.broker_rack)
+        cap = np.asarray(state.broker_capacity)
+        brokers = []
+        for i in range(state.num_brokers):
+            brokers.append({
+                "Broker": int(ext[i]),
+                "BrokerState": "ALIVE" if alive[i] else "DEAD",
+                "Rack": int(rack[i]),
+                "CpuPct": round(float(load[i, Resource.CPU]), 3),
+                "NwInRate": round(float(load[i, Resource.NW_IN]), 3),
+                "NwOutRate": round(float(load[i, Resource.NW_OUT]), 3),
+                "DiskMB": round(float(load[i, Resource.DISK]), 3),
+                "DiskCapacityMB": float(cap[i, Resource.DISK]),
+            })
+        return {"brokers": brokers}
+
+    def _partition_load_response(self, params: dict) -> dict:
+        with self.cc.load_monitor.acquire_for_model_generation():
+            state = self.cc.load_monitor.cluster_model()
+        resource = params.get("resource", "DISK").upper()
+        r = Resource[resource]
+        ll = np.asarray(state.leader_load)
+        ext_p = state.partition_ids or tuple(range(state.num_partitions))
+        ext_b = state.broker_ids or tuple(range(state.num_brokers))
+        leader = np.asarray(state.leader_broker())
+        order = np.argsort(-ll[:, r])
+        n = int(params.get("entries", 20))
+        records = []
+        for p in order[:n]:
+            records.append({
+                "partition": int(ext_p[int(p)]),
+                "leader": int(ext_b[int(leader[p])]),
+                "cpu": round(float(ll[p, Resource.CPU]), 3),
+                "networkInbound": round(float(ll[p, Resource.NW_IN]), 3),
+                "networkOutbound": round(float(ll[p, Resource.NW_OUT]), 3),
+                "disk": round(float(ll[p, Resource.DISK]), 3),
+            })
+        return {"records": records, "sortedBy": resource}
+
+    def _cluster_state_response(self) -> dict:
+        topo = self.cc.load_monitor.metadata.refresh()
+        alive = topo.alive_brokers
+        offline = topo.offline_replicas or {}
+        partitions = []
+        for p in sorted(topo.assignment):
+            reps = topo.assignment[p]
+            partitions.append({
+                "partition": p,
+                "topic": topo.partition_topic.get(p),
+                "leader": topo.leaders.get(p),
+                "replicas": list(reps),
+                "in-sync": [
+                    b for b in reps
+                    if (alive is None or b in alive)
+                    and b not in offline.get(p, ())
+                ],
+                "offline": list(offline.get(p, ())),
+            })
+        return {
+            "KafkaBrokerState": {
+                "IsController": {},
+                "Brokers": sorted(topo.broker_rack),
+                "AliveBrokers": sorted(alive) if alive is not None else None,
+            },
+            "KafkaPartitionState": {"partitions": partitions},
+        }
+
+    # ---- async POST endpoints ---------------------------------------------------
+    def _handle_async_post(self, handler, endpoint: str, params: dict) -> None:
+        # poll path: a request carrying a known task id returns its status
+        tid = handler.headers.get(USER_TASK_HEADER) or params.get(
+            "user_task_id"
+        )
+        if tid:
+            task = self.tasks.get(tid)
+            if task is None:
+                return self._send(handler, 404, {
+                    "errorMessage": f"unknown user task {tid}"
+                })
+            if task.endpoint != endpoint:
+                return self._send(handler, 400, {
+                    "errorMessage": (
+                        f"task {tid} belongs to {task.endpoint}, "
+                        f"not {endpoint}"
+                    )
+                })
+            return self._respond_task(handler, task, params)
+
+        if self.two_step:
+            rid = params.get("review_id")
+            if rid is None:
+                info = self.purgatory.add(endpoint, params)
+                return self._send(handler, 202, {
+                    "reviewId": info.review_id,
+                    "status": info.status,
+                    "message": "two-step verification: approve via /review",
+                })
+            # execute exactly what the admin approved — the resubmission's
+            # own params must not be able to smuggle in e.g. dryrun=false
+            info = self.purgatory.take_approved(int(rid), endpoint)
+            params = dict(info.params)
+
+        fn = self._operation(endpoint, params)
+        try:
+            task = self.tasks.submit(
+                endpoint, lambda progress: fn(progress)
+            )
+        except TooManyTasksError as e:
+            return self._send(handler, 429, {"errorMessage": str(e)})
+        return self._respond_task(handler, task, params)
+
+    def _respond_task(self, handler, task, params: dict) -> None:
+        timeout_s = float(params.get("get_response_timeout_s", 0.0))
+        if timeout_s:
+            try:
+                task.future.result(timeout=timeout_s)
+            except Exception:
+                pass
+        if not task.future.done():
+            return self._send(
+                handler, 202, task.to_json(),
+                headers={USER_TASK_HEADER: task.task_id},
+            )
+        err = task.future.exception()
+        if err is not None:
+            code = 503 if isinstance(err, NotEnoughValidWindowsError) else 500
+            return self._send(
+                handler, code,
+                {"errorMessage": repr(err), "UserTaskId": task.task_id},
+                headers={USER_TASK_HEADER: task.task_id},
+            )
+        result = task.future.result()
+        if hasattr(result, "violations_after"):
+            body = _optimizer_response(result, params)
+        elif hasattr(result, "to_json"):
+            body = result.to_json()
+        elif hasattr(result, "summary"):
+            body = dict(result.summary())
+        else:
+            body = {"message": str(result)}
+        body["UserTaskId"] = task.task_id
+        return self._send(
+            handler, 200, body, headers={USER_TASK_HEADER: task.task_id}
+        )
+
+    def _operation(self, endpoint: str, params: dict):
+        cc = self.cc
+        dryrun = _flag(params, "dryrun", default=True)
+        goals = params.get("goals")
+        goal_list = goals.split(",") if goals else None
+        engine = params.get("engine")
+
+        if endpoint == "rebalance":
+            return lambda progress: cc.rebalance(
+                goals=goal_list, dryrun=dryrun, engine=engine,
+                progress=progress,
+            )
+        if endpoint in ("add_broker", "remove_broker", "demote_broker"):
+            ids = _broker_ids(params)
+            op = {
+                "add_broker": cc.add_brokers,
+                "remove_broker": cc.remove_brokers,
+                "demote_broker": cc.demote_brokers,
+            }[endpoint]
+            if endpoint == "demote_broker":
+                return lambda progress: op(
+                    ids, dryrun=dryrun, progress=progress
+                )
+            return lambda progress: op(
+                ids, dryrun=dryrun, engine=engine, progress=progress
+            )
+        if endpoint == "fix_offline_replicas":
+            return lambda progress: cc.fix_offline_replicas(
+                dryrun=dryrun, engine=engine, progress=progress
+            )
+        if endpoint == "topic_configuration":
+            rf = int(params["replication_factor"])
+            return lambda progress: cc.fix_topic_replication_factor(
+                rf, dryrun=dryrun, progress=progress
+            )
+        if endpoint == "rightsize":
+            return lambda progress: cc.rightsize(progress=progress)
+        raise ValueError(f"unhandled async endpoint {endpoint}")
+
+    # ---- sync POST endpoints ----------------------------------------------------
+    def _handle_sync_post(self, handler, endpoint: str, params: dict) -> None:
+        if endpoint == "stop_proposal_execution":
+            self.cc.stop_execution()
+            return self._send(handler, 200, {"message": "stop requested"})
+        if endpoint == "pause_sampling":
+            self.cc.pause_sampling()
+            return self._send(handler, 200, {"message": "sampling paused"})
+        if endpoint == "resume_sampling":
+            self.cc.resume_sampling()
+            return self._send(handler, 200, {"message": "sampling resumed"})
+        if endpoint == "admin":
+            return self._send(handler, 200, self._admin(params))
+        if endpoint == "review":
+            approve = params.get("approve")
+            discard = params.get("discard")
+            reason = params.get("reason")
+            out: List[dict] = []
+            for rid in (approve or "").split(","):
+                if rid:
+                    out.append(self.purgatory.approve(int(rid), reason).to_json())
+            for rid in (discard or "").split(","):
+                if rid:
+                    out.append(self.purgatory.discard(int(rid), reason).to_json())
+            return self._send(handler, 200, {"requestInfo": out})
+        if endpoint == "train":
+            return self._send(handler, 200, self._train())
+
+    def _admin(self, params: dict) -> dict:
+        # import at use-site: detector.anomalies uses server.progress, so a
+        # module-level import here would close an import cycle through the
+        # two package __init__s
+        from cruise_control_tpu.detector.anomalies import AnomalyType
+
+        changed = {}
+        detector = self.cc.anomaly_detector
+        enable = params.get("enable_self_healing_for")
+        disable = params.get("disable_self_healing_for")
+        if (enable or disable) and detector is None:
+            raise ValueError("no anomaly detector attached")
+        for name in (enable or "").split(","):
+            if name:
+                detector.notifier.set_self_healing(
+                    AnomalyType[name.upper()], True
+                )
+                changed[name.upper()] = True
+        for name in (disable or "").split(","):
+            if name:
+                detector.notifier.set_self_healing(
+                    AnomalyType[name.upper()], False
+                )
+                changed[name.upper()] = False
+        concurrency = params.get("concurrent_partition_movements_per_broker")
+        if concurrency is not None:
+            self.cc.executor.config.\
+                num_concurrent_partition_movements_per_broker = int(concurrency)
+            changed["concurrentPartitionMovementsPerBroker"] = int(concurrency)
+        leader_conc = params.get("concurrent_leader_movements")
+        if leader_conc is not None:
+            self.cc.executor.config.num_concurrent_leader_movements = int(
+                leader_conc
+            )
+            changed["concurrentLeaderMovements"] = int(leader_conc)
+        return {"selfHealingEnabledChanged": changed}
+
+    def _train(self) -> dict:
+        """Refit the partition-CPU linear model from broker history (upstream
+        TRAIN endpoint → LinearRegressionModelParameters)."""
+        from cruise_control_tpu.monitor.sampling import (
+            B_BYTES_IN, B_BYTES_OUT, B_CPU,
+        )
+
+        agg = self.cc.load_monitor.broker_aggregator.aggregate()
+        vals = agg.values  # [B, W, M]
+        if vals.size == 0 or vals.shape[1] < 2:
+            return {"trained": False, "message": "not enough windows"}
+        x = vals[:, :, [B_BYTES_IN, B_BYTES_OUT]].reshape(-1, 2)
+        y = vals[:, :, B_CPU].reshape(-1)
+        mask = (x.sum(axis=1) > 0) & (y > 0)
+        if mask.sum() < 4:
+            return {"trained": False, "message": "not enough samples"}
+        w, *_ = np.linalg.lstsq(x[mask], y[mask], rcond=None)
+        w = np.maximum(w, 0.0)
+        total = float(w.sum()) or 1.0
+        processor = getattr(self.cc.load_monitor.sampler, "processor", None)
+        if processor is None:
+            return {"trained": False, "message": "sampler has no processor"}
+        processor.params.cpu_weight_bytes_in = float(w[0] / total)
+        processor.params.cpu_weight_bytes_out = float(w[1] / total)
+        return {
+            "trained": True,
+            "cpuWeightBytesIn": processor.params.cpu_weight_bytes_in,
+            "cpuWeightBytesOut": processor.params.cpu_weight_bytes_out,
+        }
+
+
+# ---------------------------------------------------------------------------------
+def _flag(params: dict, name: str, default: bool = False) -> bool:
+    v = params.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("true", "1", "yes")
+
+
+def _broker_ids(params: dict) -> List[int]:
+    raw = params.get("brokerid") or params.get("broker_id")
+    if not raw:
+        raise ValueError("brokerid parameter required")
+    return [int(b) for b in raw.split(",")]
+
+
+def _optimizer_response(result, params: dict) -> dict:
+    body = dict(result.summary())
+    if _flag(params, "verbose"):
+        body["proposals"] = [p.to_json() for p in result.proposals]
+    else:
+        body["proposals"] = [p.to_json() for p in result.proposals[:20]]
+    return body

@@ -1,0 +1,125 @@
+"""UserTaskManager — the async operation protocol (upstream
+``servlet/UserTaskManager.java`` + ``OperationFuture``; SURVEY.md §2.7).
+
+POST on an async endpoint creates a task and immediately returns ``202`` with
+a ``User-Task-ID`` header; the client polls (same endpoint or
+``/user_tasks``) with that id until the result is ready.  Completed tasks are
+cached with a TTL so late polls still see the result.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+from cruise_control_tpu.server.progress import OperationProgress
+
+
+class UserTaskState:
+    ACTIVE = "Active"
+    COMPLETED = "Completed"
+    COMPLETED_WITH_ERROR = "CompletedWithError"
+
+
+class UserTask:
+    def __init__(self, task_id: str, endpoint: str):
+        self.task_id = task_id
+        self.endpoint = endpoint
+        self.future: Future = Future()
+        self.progress = OperationProgress(endpoint)
+        self.created_s = time.time()
+        self.completed_s: Optional[float] = None
+
+    @property
+    def state(self) -> str:
+        if not self.future.done():
+            return UserTaskState.ACTIVE
+        if self.future.exception() is not None:
+            return UserTaskState.COMPLETED_WITH_ERROR
+        return UserTaskState.COMPLETED
+
+    def to_json(self) -> dict:
+        out = {
+            "UserTaskId": self.task_id,
+            "RequestURL": self.endpoint,
+            "Status": self.state,
+            "StartMs": int(self.created_s * 1000),
+        }
+        if self.completed_s is not None:
+            out["DurationMs"] = int((self.completed_s - self.created_s) * 1000)
+        out.update(self.progress.to_json())
+        return out
+
+
+class UserTaskManager:
+    def __init__(self, max_active_tasks: int = 25,
+                 completed_task_ttl_s: float = 3600.0,
+                 max_workers: int = 4):
+        self.max_active_tasks = max_active_tasks
+        self.completed_task_ttl_s = completed_task_ttl_s
+        self._tasks: Dict[str, UserTask] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="user-task"
+        )
+
+    # ---- lifecycle --------------------------------------------------------------
+    def submit(self, endpoint: str, fn: Callable[[OperationProgress], object],
+               task_id: Optional[str] = None) -> UserTask:
+        """Run ``fn(progress)`` on the pool under a new (or supplied) task id."""
+        self._expire()
+        with self._lock:
+            active = sum(
+                1 for t in self._tasks.values()
+                if t.state == UserTaskState.ACTIVE
+            )
+            if active >= self.max_active_tasks:
+                raise TooManyTasksError(
+                    f"{active} active tasks >= cap {self.max_active_tasks}"
+                )
+            tid = task_id or str(uuid.uuid4())
+            if tid in self._tasks:
+                return self._tasks[tid]  # idempotent resubmit: same task
+            task = UserTask(tid, endpoint)
+            self._tasks[tid] = task
+
+        def run() -> None:
+            try:
+                task.future.set_result(fn(task.progress))
+            except BaseException as e:  # surfaced via the future
+                task.future.set_exception(e)
+            finally:
+                task.completed_s = time.time()
+
+        self._pool.submit(run)
+        return task
+
+    def get(self, task_id: str) -> Optional[UserTask]:
+        self._expire()
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def tasks(self) -> List[UserTask]:
+        self._expire()
+        with self._lock:
+            return sorted(self._tasks.values(), key=lambda t: t.created_s)
+
+    def _expire(self) -> None:
+        now = time.time()
+        with self._lock:
+            for tid, t in list(self._tasks.items()):
+                if (
+                    t.completed_s is not None
+                    and now - t.completed_s > self.completed_task_ttl_s
+                ):
+                    del self._tasks[tid]
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class TooManyTasksError(RuntimeError):
+    pass

@@ -1,0 +1,316 @@
+"""REST server + CLI client tests: real HTTP over a loopback port, driven by
+the cccli client class (upstream servlet + UserTaskManager semantics;
+SURVEY.md §2.7)."""
+
+import json
+
+import pytest
+
+from cruise_control_tpu.client.cccli import (
+    CruiseControlClient,
+    CruiseControlError,
+    main as cccli_main,
+)
+from cruise_control_tpu.server import (
+    BasicSecurityProvider,
+    CruiseControlHttpServer,
+)
+
+from harness import full_stack
+
+
+@pytest.fixture
+def server():
+    cc, backend, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    yield srv, cc, backend
+    srv.stop()
+
+
+def client_for(srv, **kw) -> CruiseControlClient:
+    return CruiseControlClient(srv.url, **kw)
+
+
+class TestGetEndpoints:
+    def test_state(self, server):
+        srv, _, _ = server
+        body = client_for(srv).get("state")
+        assert body["MonitorState"]["state"] == "RUNNING"
+        assert body["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
+
+    def test_load(self, server):
+        srv, _, _ = server
+        body = client_for(srv).get("load")
+        assert len(body["brokers"]) == 4
+        assert all("DiskMB" in b for b in body["brokers"])
+
+    def test_partition_load_sorted(self, server):
+        srv, _, _ = server
+        body = client_for(srv).get("partition_load", resource="NW_IN",
+                                   entries=5)
+        recs = body["records"]
+        assert len(recs) == 5
+        vals = [r["networkInbound"] for r in recs]
+        assert vals == sorted(vals, reverse=True)
+
+    def test_kafka_cluster_state(self, server):
+        srv, _, backend = server
+        body = client_for(srv).get("kafka_cluster_state")
+        parts = body["KafkaPartitionState"]["partitions"]
+        assert len(parts) == len(backend.partitions)
+
+    def test_unknown_endpoint_404(self, server):
+        srv, _, _ = server
+        with pytest.raises(CruiseControlError) as e:
+            client_for(srv).get("nonsense")
+        assert e.value.code == 404
+
+
+class TestAsyncProtocol:
+    def test_rebalance_long_poll(self, server):
+        srv, _, backend = server
+        body = client_for(srv).post("rebalance", dryrun="false")
+        assert body["numProposals"] > 0
+        assert body["execution"]["succeeded"] is True
+        assert "UserTaskId" in body
+        leaders = [st.leader for st in backend.partitions.values()]
+        assert leaders.count(0) < len(leaders)
+
+    def test_dryrun_returns_proposals(self, server):
+        srv, _, _ = server
+        body = client_for(srv).post("rebalance", dryrun="true",
+                                    verbose="true")
+        assert body["numProposals"] == len(body["proposals"])
+
+    def test_user_tasks_listing(self, server):
+        srv, _, _ = server
+        c = client_for(srv)
+        done = c.post("rebalance", dryrun="true")
+        tasks = c.get("user_tasks")["userTasks"]
+        assert any(
+            t["UserTaskId"] == done["UserTaskId"]
+            and t["Status"] == "Completed"
+            for t in tasks
+        )
+
+    def test_unknown_task_404(self, server):
+        srv, _, _ = server
+        with pytest.raises(CruiseControlError) as e:
+            client_for(srv).post("rebalance", user_task_id="nope")
+        assert e.value.code == 404
+
+    def test_task_id_bound_to_endpoint(self, server):
+        srv, _, _ = server
+        c = client_for(srv)
+        done = c.post("rebalance", dryrun="true")
+        with pytest.raises(CruiseControlError) as e:
+            c.post("add_broker", user_task_id=done["UserTaskId"])
+        assert e.value.code == 400
+        assert "belongs to rebalance" in str(e.value)
+
+    def test_broker_operations(self, server):
+        srv, _, backend = server
+        c = client_for(srv)
+        c.post("remove_broker", brokerid="3", dryrun="false")
+        assert all(3 not in st.replicas for st in backend.partitions.values())
+        c.post("demote_broker", brokerid="0", dryrun="false")
+        assert all(st.leader != 0 for st in backend.partitions.values())
+
+    def test_missing_brokerid_400(self, server):
+        srv, _, _ = server
+        with pytest.raises(CruiseControlError) as e:
+            client_for(srv).post("remove_broker", dryrun="true")
+        assert e.value.code == 400
+
+    def test_operation_error_reported_500(self, server):
+        srv, _, _ = server
+        with pytest.raises(CruiseControlError) as e:
+            client_for(srv).post("add_broker", brokerid="99", dryrun="true")
+        assert e.value.code == 500
+        assert "unknown broker" in str(e.value)
+
+
+class TestSyncEndpoints:
+    def test_pause_resume_sampling(self, server):
+        srv, cc, _ = server
+        c = client_for(srv)
+        c.post("pause_sampling")
+        assert cc.state()["MonitorState"]["state"] == "PAUSED"
+        c.post("resume_sampling")
+        assert cc.state()["MonitorState"]["state"] == "RUNNING"
+
+    def test_stop_proposal_execution(self, server):
+        srv, _, _ = server
+        assert "stop" in client_for(srv).post(
+            "stop_proposal_execution")["message"]
+
+    def test_admin_self_healing_toggle(self, server):
+        srv, cc, backend = server
+        from cruise_control_tpu.detector import make_detector_manager
+
+        make_detector_manager(cc, backend=backend)
+        c = client_for(srv)
+        body = c.post("admin", enable_self_healing_for="goal_violation")
+        assert body["selfHealingEnabledChanged"] == {"GOAL_VIOLATION": True}
+        st = c.get("state")
+        assert st["AnomalyDetectorState"]["selfHealingEnabled"][
+            "GOAL_VIOLATION"] is True
+
+    def test_admin_concurrency(self, server):
+        srv, cc, _ = server
+        client_for(srv).post(
+            "admin", concurrent_partition_movements_per_broker="9"
+        )
+        assert (cc.executor.config.
+                num_concurrent_partition_movements_per_broker == 9)
+
+    def test_train(self, server):
+        srv, _, _ = server
+        body = client_for(srv).post("train")
+        assert body["trained"] is True
+        assert 0.0 <= body["cpuWeightBytesIn"] <= 1.0
+
+    def test_rightsize(self, server):
+        srv, _, _ = server
+        body = client_for(srv).post("rightsize")
+        assert body["status"] in (
+            "RIGHT_SIZED", "UNDER_PROVISIONED", "OVER_PROVISIONED"
+        )
+        assert "UserTaskId" in body
+
+    def test_topic_configuration(self):
+        cc, backend, _ = full_stack(rf=1)
+        srv = CruiseControlHttpServer(cc, port=0)
+        srv.start()
+        try:
+            body = client_for(srv).post(
+                "topic_configuration", replication_factor="2",
+                dryrun="false",
+            )
+            assert body["numProposals"] > 0
+            assert all(
+                len(set(st.replicas)) >= 2
+                for st in backend.partitions.values()
+            )
+        finally:
+            srv.stop()
+
+
+class TestSecurity:
+    def test_basic_auth_rejects_and_accepts(self):
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(
+            cc, port=0,
+            security_provider=BasicSecurityProvider({"ccop": "s3cret"}),
+        )
+        srv.start()
+        try:
+            with pytest.raises(Exception):
+                client_for(srv).get("state")
+            body = client_for(srv, user="ccop", password="s3cret").get("state")
+            assert body["MonitorState"]
+            with pytest.raises(Exception):
+                client_for(srv, user="ccop", password="wrong").get("state")
+        finally:
+            srv.stop()
+
+
+class TestTwoStepVerification:
+    def test_purgatory_flow(self):
+        cc, backend, _ = full_stack()
+        srv = CruiseControlHttpServer(cc, port=0, two_step_verification=True)
+        srv.start()
+        try:
+            c = client_for(srv)
+            body = c.post("rebalance", dryrun="false")
+            rid = body["reviewId"]
+            assert body["status"] == "PENDING_REVIEW"
+            board = c.get("review_board")["requestInfo"]
+            assert board and board[0]["EndPoint"] == "rebalance"
+            c.post("review", approve=str(rid), reason="lgtm")
+            done = c.post("rebalance", dryrun="false", review_id=str(rid))
+            assert done["numProposals"] > 0
+            # a second execution with the same review id is rejected
+            with pytest.raises(CruiseControlError) as e:
+                c.post("rebalance", dryrun="false", review_id=str(rid))
+            assert e.value.code == 400
+        finally:
+            srv.stop()
+
+    def test_approved_params_cannot_be_smuggled(self):
+        cc, backend, _ = full_stack()
+        srv = CruiseControlHttpServer(cc, port=0, two_step_verification=True)
+        srv.start()
+        try:
+            c = client_for(srv)
+            before = {
+                p: list(st.replicas) for p, st in backend.partitions.items()
+            }
+            rid = c.post("rebalance", dryrun="true")["reviewId"]
+            c.post("review", approve=str(rid))
+            # resubmission tries to flip dryrun=false; the approved request
+            # said dryrun=true and that is what must execute
+            c.post("rebalance", dryrun="false", review_id=str(rid))
+            after = {
+                p: list(st.replicas) for p, st in backend.partitions.items()
+            }
+            assert before == after, "approval bypass: cluster was mutated"
+        finally:
+            srv.stop()
+
+    def test_discarded_request_cannot_run(self):
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(cc, port=0, two_step_verification=True)
+        srv.start()
+        try:
+            c = client_for(srv)
+            rid = c.post("rebalance", dryrun="true")["reviewId"]
+            c.post("review", discard=str(rid))
+            with pytest.raises(CruiseControlError) as e:
+                c.post("rebalance", dryrun="true", review_id=str(rid))
+            assert e.value.code == 400
+        finally:
+            srv.stop()
+
+
+class TestCliMain:
+    def test_main_state(self, server, capsys):
+        srv, _, _ = server
+        rc = cccli_main(["-a", f"http://127.0.0.1:{srv.port}", "state"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["MonitorState"]["state"] == "RUNNING"
+
+    def test_main_rebalance_defaults_to_dryrun(self, server, capsys):
+        srv, _, backend = server
+        before = {p: list(st.replicas) for p, st in backend.partitions.items()}
+        rc = cccli_main(
+            ["-a", f"http://127.0.0.1:{srv.port}", "rebalance"]
+        )
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["numProposals"] >= 0
+        after = {p: list(st.replicas) for p, st in backend.partitions.items()}
+        assert before == after, "bare rebalance must be a dry run"
+
+    def test_main_no_dryrun_executes(self, server, capsys):
+        srv, _, backend = server
+        rc = cccli_main(
+            ["-a", f"http://127.0.0.1:{srv.port}", "rebalance", "--no-dryrun"]
+        )
+        assert rc == 0
+        leaders = [st.leader for st in backend.partitions.values()]
+        assert leaders.count(0) < len(leaders)
+
+    def test_main_connection_refused_clean_error(self, capsys):
+        rc = cccli_main(["-a", "http://127.0.0.1:1", "state"])
+        assert rc == 1
+        assert "cannot reach" in capsys.readouterr().err
+
+    def test_main_error_exit_code(self, server, capsys):
+        srv, _, _ = server
+        rc = cccli_main(
+            ["-a", f"http://127.0.0.1:{srv.port}", "remove_broker", ""]
+        )
+        assert rc == 1
