@@ -48,6 +48,9 @@ class BalancingAction:
     dest_slot: int = -1
     swap_partition: int = -1
     swap_slot: int = -1
+    #: JBOD: disk indices on the (single) broker for intra-broker moves
+    source_disk: int = -1
+    dest_disk: int = -1
 
     def __str__(self) -> str:
         if self.action_type == ActionType.LEADERSHIP_MOVEMENT:
@@ -59,6 +62,11 @@ class BalancingAction:
             return (
                 f"Swap(P{self.partition}[s{self.slot}]@b{self.source_broker} <-> "
                 f"P{self.swap_partition}[s{self.swap_slot}]@b{self.dest_broker})"
+            )
+        if self.action_type == ActionType.INTRA_BROKER_REPLICA_MOVEMENT:
+            return (
+                f"IntraMove(P{self.partition}[s{self.slot}]@b{self.source_broker}: "
+                f"d{self.source_disk}->d{self.dest_disk})"
             )
         return (
             f"Move(P{self.partition}[s{self.slot}]: b{self.source_broker}"

@@ -61,6 +61,19 @@ class AnalyzerContext:
         self.broker_rack = np.array(state.broker_rack, np.int32)
         self.broker_state = np.array(state.broker_state, np.int8)
         self.num_topics = state.num_topics
+        # JBOD (None when the model carries no per-disk data)
+        self.replica_disk = (
+            None if state.replica_disk is None
+            else np.array(state.replica_disk, np.int32)
+        )
+        self.disk_capacity = (
+            None if state.disk_capacity is None
+            else np.array(state.disk_capacity, np.float32)
+        )
+        self.disk_offline = (
+            None if state.disk_offline is None
+            else np.array(state.disk_offline, bool)
+        )
 
         self.num_partitions, self.max_rf = self.assignment.shape
         self.num_brokers = self.broker_capacity.shape[0]
@@ -129,6 +142,10 @@ class AnalyzerContext:
         self.broker_topic_replica_count = np.zeros((B, T), np.int64)
         self.broker_topic_leader_count = np.zeros((B, T), np.int64)
         self.broker_potential_nw_out = np.zeros(B, np.float64)
+        if self.disk_capacity is not None:
+            self.disk_load = np.zeros(self.disk_capacity.shape, np.float64)
+        else:
+            self.disk_load = None
 
         for p in range(P):
             t = self.partition_topic[p]
@@ -141,6 +158,10 @@ class AnalyzerContext:
                 self.broker_replica_count[b] += 1
                 self.broker_topic_replica_count[b, t] += 1
                 self.broker_potential_nw_out[b] += self.leader_load[p, Resource.NW_OUT]
+                if self.disk_load is not None:
+                    d = self.replica_disk[p, s]
+                    if d >= 0:
+                        self.disk_load[b, d] += load[Resource.DISK]
             lb = self.leader_broker(p)
             self.broker_leader_count[lb] += 1
             self.broker_leader_load[lb] += self.leader_load[p]
@@ -157,6 +178,24 @@ class AnalyzerContext:
         if self.is_leader(p, s):
             return self.leader_load[p].astype(np.float64)
         return self.follower_load[p].astype(np.float64)
+
+    def disk_alive_mask(self, b: int) -> np.ndarray:
+        """bool [D] — existing, non-failed disks of broker b."""
+        ok = self.disk_capacity[b] > 0
+        if self.disk_offline is not None:
+            ok &= ~self.disk_offline[b]
+        return ok
+
+    def least_loaded_disk(self, b: int) -> int:
+        """Healthy disk of b with the lowest utilization; -1 if none."""
+        if self.disk_capacity is None:
+            return -1
+        ok = self.disk_alive_mask(b)
+        if not ok.any():
+            return -1
+        util = self.disk_load[b] / np.maximum(self.disk_capacity[b], 1e-9)
+        util = np.where(ok, util, np.inf)
+        return int(util.argmin())
 
     def utilization(self, resource: Resource) -> np.ndarray:
         """f64 [B] — load/capacity for a resource."""
@@ -175,11 +214,33 @@ class AnalyzerContext:
         """Apply an accepted action, updating placement + every aggregate."""
         p = action.partition
         t = self.partition_topic[p]
+        if action.action_type == ActionType.INTRA_BROKER_REPLICA_MOVEMENT:
+            s, b = action.slot, action.source_broker
+            assert self.assignment[p, s] == b == action.dest_broker
+            d_src, d_dst = action.source_disk, action.dest_disk
+            assert self.replica_disk[p, s] == d_src, "stale intra action"
+            dl = self.replica_load_vec(p, s)[Resource.DISK]
+            self.replica_disk[p, s] = d_dst
+            self.disk_load[b, d_src] -= dl
+            self.disk_load[b, d_dst] += dl
+            self.replica_offline[p, s] = False  # moved off a dead disk
+            self.actions.append(action)
+            return
         if action.action_type == ActionType.INTER_BROKER_REPLICA_MOVEMENT:
             s, src, dst = action.slot, action.source_broker, action.dest_broker
             assert self.assignment[p, s] == src, "stale action"
             load = self.replica_load_vec(p, s)
             pot = self.leader_load[p, Resource.NW_OUT]
+            if self.disk_load is not None:
+                # leave the source disk; land on the destination's
+                # least-loaded healthy disk (upstream: live log dir choice)
+                d_src = self.replica_disk[p, s]
+                if d_src >= 0:
+                    self.disk_load[src, d_src] -= load[Resource.DISK]
+                d_dst = self.least_loaded_disk(dst)
+                self.replica_disk[p, s] = d_dst
+                if d_dst >= 0:
+                    self.disk_load[dst, d_dst] += load[Resource.DISK]
             self.assignment[p, s] = dst
             self.replica_offline[p, s] = False
             self.broker_load[src] -= load
@@ -240,18 +301,25 @@ class AnalyzerContext:
     def to_state(self, template: ClusterState) -> ClusterState:
         import jax.numpy as jnp
 
-        return template.replace(
+        out = template.replace(
             assignment=jnp.asarray(self.assignment),
             leader_slot=jnp.asarray(self.leader_slot),
             replica_offline=jnp.asarray(self.replica_offline),
         )
+        if self.replica_disk is not None:
+            out = out.replace(replica_disk=jnp.asarray(self.replica_disk))
+        return out
 
     def recompute_check(self, atol: float = 1e-3) -> None:
         """Debug invariant: incremental aggregates match a fresh recount."""
         snap_load = self.broker_load.copy()
         snap_rc = self.broker_replica_count.copy()
         snap_lc = self.broker_leader_count.copy()
+        snap_disk = None if self.disk_load is None else self.disk_load.copy()
         self._init_aggregates()
         assert np.allclose(snap_load, self.broker_load, atol=atol), "load drift"
         assert (snap_rc == self.broker_replica_count).all(), "replica count drift"
         assert (snap_lc == self.broker_leader_count).all(), "leader count drift"
+        if snap_disk is not None:
+            assert np.allclose(snap_disk, self.disk_load, atol=atol), \
+                "disk load drift"

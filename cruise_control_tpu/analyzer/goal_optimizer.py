@@ -45,6 +45,10 @@ from cruise_control_tpu.analyzer.goals.distribution import (
     ReplicaDistributionGoal,
     TopicReplicaDistributionGoal,
 )
+from cruise_control_tpu.analyzer.goals.intrabroker import (
+    IntraBrokerDiskCapacityGoal,
+    IntraBrokerDiskUsageDistributionGoal,
+)
 from cruise_control_tpu.analyzer.goals.rack import (
     RackAwareDistributionGoal,
     RackAwareGoal,
@@ -93,8 +97,16 @@ GOAL_CLASSES = {
         MinTopicLeadersPerBrokerGoal,
         BrokerSetAwareGoal,
         PreferredLeaderElectionGoal,
+        IntraBrokerDiskCapacityGoal,
+        IntraBrokerDiskUsageDistributionGoal,
     ]
 }
+
+#: The JBOD goal list (upstream rebalance?rebalance_disk=true).
+INTRA_BROKER_GOAL_ORDER = [
+    "IntraBrokerDiskCapacityGoal",
+    "IntraBrokerDiskUsageDistributionGoal",
+]
 
 
 def make_goals(
@@ -115,6 +127,10 @@ class ExecutionProposal:
     new_leader: int
     old_replicas: tuple
     new_replicas: tuple
+    #: JBOD intra-broker moves: (broker, old_disk, new_disk) triples —
+    #: disk ids while inside the analyzer, log-dir names once the facade has
+    #: translated for the executor (upstream replicasToMoveBetweenDisksByBroker)
+    disk_moves: tuple = ()
 
     @property
     def has_replica_change(self) -> bool:
@@ -124,6 +140,10 @@ class ExecutionProposal:
     def has_leader_change(self) -> bool:
         return self.old_leader != self.new_leader
 
+    @property
+    def has_disk_move(self) -> bool:
+        return bool(self.disk_moves)
+
     def to_json(self) -> dict:
         return {
             "partition": self.partition,
@@ -132,6 +152,7 @@ class ExecutionProposal:
             "newLeader": self.new_leader,
             "oldReplicas": list(self.old_replicas),
             "newReplicas": list(self.new_replicas),
+            "diskMoves": [list(m) for m in self.disk_moves],
         }
 
 
@@ -185,6 +206,7 @@ def diff_proposals(
     initial_assignment: np.ndarray,
     initial_leader_slot: np.ndarray,
     ctx: AnalyzerContext,
+    initial_replica_disk: Optional[np.ndarray] = None,
 ) -> List[ExecutionProposal]:
     """Placement diff → proposals (upstream AnalyzerUtils.getDiff)."""
     out: List[ExecutionProposal] = []
@@ -193,7 +215,26 @@ def diff_proposals(
         new_row = ctx.assignment[p]
         old_leader = int(old_row[initial_leader_slot[p]])
         new_leader = ctx.leader_broker(p)
-        if (old_row == new_row).all() and old_leader == new_leader:
+        disk_moves: List[tuple] = []
+        if initial_replica_disk is not None:
+            for s in range(old_row.shape[0]):
+                b = int(old_row[s])
+                # a disk change only yields an intra move when the replica
+                # stayed on its broker; cross-broker moves pick their dir on
+                # arrival
+                if (
+                    b != EMPTY_SLOT
+                    and b == int(new_row[s])
+                    and initial_replica_disk[p, s] != ctx.replica_disk[p, s]
+                    and ctx.replica_disk[p, s] >= 0
+                ):
+                    disk_moves.append((
+                        b,
+                        int(initial_replica_disk[p, s]),
+                        int(ctx.replica_disk[p, s]),
+                    ))
+        if ((old_row == new_row).all() and old_leader == new_leader
+                and not disk_moves):
             continue
         # Kafka replica lists are leader-first; emit the new replica list with
         # the leader first so executors can hand it straight to a reassignment.
@@ -209,6 +250,7 @@ def diff_proposals(
                 new_leader=new_leader,
                 old_replicas=tuple(old_replicas),
                 new_replicas=tuple(new_replicas),
+                disk_moves=tuple(disk_moves),
             )
         )
     return out
@@ -236,6 +278,9 @@ class GoalOptimizer:
         ctx = AnalyzerContext(state, options)
         initial_assignment = ctx.assignment.copy()
         initial_leader_slot = ctx.leader_slot.copy()
+        initial_replica_disk = (
+            ctx.replica_disk.copy() if ctx.replica_disk is not None else None
+        )
         stats_before = stats_summary(cluster_stats(state))
         violations_before = {g.name: g.violations(ctx) for g in self.goals}
 
@@ -252,7 +297,10 @@ class GoalOptimizer:
         final_state = ctx.to_state(state)
         stats_after = stats_summary(cluster_stats(final_state))
         return OptimizerResult(
-            proposals=diff_proposals(initial_assignment, initial_leader_slot, ctx),
+            proposals=diff_proposals(
+                initial_assignment, initial_leader_slot, ctx,
+                initial_replica_disk,
+            ),
             actions=list(ctx.actions),
             violations_before=violations_before,
             violations_after=violations_after,

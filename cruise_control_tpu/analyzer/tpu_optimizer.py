@@ -575,6 +575,9 @@ class TpuGoalOptimizer:
         ctx = AnalyzerContext(state, options)
         initial_assignment = ctx.assignment.copy()
         initial_leader_slot = ctx.leader_slot.copy()
+        initial_replica_disk = (
+            ctx.replica_disk.copy() if ctx.replica_disk is not None else None
+        )
         goals = make_goals(constraint=self.constraint)
         violations_before = {g.name: g.violations(ctx) for g in goals}
         stats_before = stats_summary(cluster_stats(state))
@@ -665,7 +668,10 @@ class TpuGoalOptimizer:
         final_state = ctx.to_state(state)
         stats_after = stats_summary(cluster_stats(final_state))
         return OptimizerResult(
-            proposals=diff_proposals(initial_assignment, initial_leader_slot, ctx),
+            proposals=diff_proposals(
+                initial_assignment, initial_leader_slot, ctx,
+                initial_replica_disk,
+            ),
             actions=actions,
             violations_before=violations_before,
             violations_after=violations_after,

@@ -119,7 +119,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--review-id", type=int)
         return sp
 
-    mutating("rebalance")
+    rb = mutating("rebalance")
+    rb.add_argument("--rebalance-disk", action="store_true",
+                    help="JBOD intra-broker disk balancing")
     for name in ("add_broker", "remove_broker", "demote_broker"):
         sp = mutating(name)
         sp.add_argument("brokers", help="comma-separated broker ids")
@@ -170,6 +172,8 @@ def run_command(client: CruiseControlClient, args: argparse.Namespace) -> dict:
             params["review_id"] = str(args.review_id)
         if cmd in ("add_broker", "remove_broker", "demote_broker"):
             params["brokerid"] = args.brokers
+        if cmd == "rebalance" and args.rebalance_disk:
+            params["rebalance_disk"] = "true"
         return client.post(cmd, **params)
     if cmd == "topic_configuration":
         return client.post(

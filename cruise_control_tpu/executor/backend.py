@@ -40,6 +40,18 @@ class ClusterBackend:
         """partition → preferred leader broker."""
         raise NotImplementedError
 
+    def alter_replica_log_dirs(
+        self, moves: Dict[int, Dict[int, str]]
+    ) -> None:
+        """partition → {broker → target log dir} (JBOD intra-broker moves;
+        upstream AdminClient.alterReplicaLogDirs)."""
+        raise NotImplementedError
+
+    def replica_log_dir(self, partition: int, broker: int) -> Optional[str]:
+        """Current log dir of a replica (upstream describeReplicaLogDirs);
+        None when unknown."""
+        raise NotImplementedError
+
     def ongoing_reassignments(self) -> Set[int]:
         raise NotImplementedError
 
@@ -157,6 +169,21 @@ class SimulatedClusterBackend(ClusterBackend):
             st = self.partitions[p]
             if leader in st.isr:
                 st.leader = leader
+
+    def alter_replica_log_dirs(
+        self, moves: Dict[int, Dict[int, str]]
+    ) -> None:
+        for p, by_broker in moves.items():
+            st = self.partitions[p]
+            for b, target in by_broker.items():
+                if b not in st.replicas:
+                    continue  # upstream: ReplicaNotAvailable, move skipped
+                if target in self.offline_dirs.get(b, ()):
+                    continue  # cannot land on a dead dir
+                self.replica_dir[(p, b)] = target
+
+    def replica_log_dir(self, partition: int, broker: int) -> Optional[str]:
+        return self.replica_dir.get((partition, broker))
 
     def ongoing_reassignments(self) -> Set[int]:
         return set(self._target)

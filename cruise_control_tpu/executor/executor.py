@@ -33,6 +33,9 @@ class ExecutorStateValue(enum.Enum):
     INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = (
         "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
     )
+    INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = (
+        "INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    )
     LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
     STOPPING_EXECUTION = "STOPPING_EXECUTION"
 
@@ -42,6 +45,7 @@ class ExecutorConfig:
     """Upstream ExecutorConfig keys (SURVEY.md §5.6)."""
 
     num_concurrent_partition_movements_per_broker: int = 5
+    num_concurrent_intra_broker_partition_movements: int = 2
     num_concurrent_leader_movements: int = 1000
     #: ticks an in-progress move may take before being declared DEAD
     task_timeout_ticks: int = 100
@@ -137,6 +141,8 @@ class Executor:
             ticks = self._drive_replica_moves(planner, sizes, max_ticks)
             if not self._stop_requested:
                 self._drive_leader_moves(planner)
+            if not self._stop_requested:
+                self._drive_intra_moves(planner)
         finally:
             if self.config.replication_throttle is not None:
                 self.backend.clear_throttles()
@@ -265,6 +271,58 @@ class Executor:
                     if st.leader == t.proposal.new_leader
                     else TaskState.DEAD
                 )
+
+    def _drive_intra_moves(self, planner: ExecutionTaskPlanner) -> None:
+        """JBOD disk-to-disk moves via alterReplicaLogDirs.  Proposals reach
+        the executor with dir NAMES in disk_moves (facade-translated)."""
+        if not planner.intra_tasks:
+            return
+        self.state = (
+            ExecutorStateValue.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        )
+        while True:
+            if self._stop_requested:
+                self.state = ExecutorStateValue.STOPPING_EXECUTION
+                for t in planner.intra_tasks:
+                    if t.state == TaskState.PENDING:
+                        t.transition(TaskState.ABORTED)
+                return
+            batch = planner.next_intra_batch(
+                self.config.num_concurrent_intra_broker_partition_movements
+            )
+            if not batch:
+                return
+            moves = {
+                t.proposal.partition: {
+                    b: new_dir for b, _old, new_dir in t.proposal.disk_moves
+                }
+                for t in batch
+            }
+            self.backend.alter_replica_log_dirs(moves)
+            for t in batch:
+                t.transition(TaskState.IN_PROGRESS)
+            # a real backend copies data asynchronously — poll with the same
+            # tick/timeout budget replica moves get
+            tick = getattr(self.backend, "tick", None)
+            for waited in range(self.config.task_timeout_ticks + 1):
+                pending = [
+                    t for t in batch
+                    if t.state == TaskState.IN_PROGRESS and not all(
+                        self.backend.replica_log_dir(t.proposal.partition, b)
+                        == new_dir
+                        for b, _old, new_dir in t.proposal.disk_moves
+                    )
+                ]
+                for t in batch:
+                    if t.state == TaskState.IN_PROGRESS and t not in pending:
+                        t.transition(TaskState.COMPLETED)
+                if not pending:
+                    break
+                if tick is None or waited == self.config.task_timeout_ticks:
+                    for t in pending:
+                        t.transition(TaskState.DEAD)
+                    break
+                tick()
 
     # ---- observability ----------------------------------------------------------
     def state_summary(self) -> dict:

@@ -137,6 +137,7 @@ class ExecutionTaskPlanner:
         self._next_id = 0
         self.replica_tasks: List[ExecutionTask] = []
         self.leader_tasks: List[ExecutionTask] = []
+        self.intra_tasks: List[ExecutionTask] = []
 
     def add_proposals(self, proposals: Sequence[ExecutionProposal]) -> None:
         for prop in proposals:
@@ -152,6 +153,13 @@ class ExecutionTaskPlanner:
                 # be a replica that is still catching up during the move)
                 self.leader_tasks.append(
                     ExecutionTask(self._next_id, TaskType.LEADER_ACTION, prop)
+                )
+                self._next_id += 1
+            if prop.has_disk_move:
+                self.intra_tasks.append(
+                    ExecutionTask(
+                        self._next_id, TaskType.INTRA_BROKER_REPLICA_ACTION, prop
+                    )
                 )
                 self._next_id += 1
 
@@ -182,6 +190,10 @@ class ExecutionTaskPlanner:
         pending = [t for t in self.leader_tasks if t.state == TaskState.PENDING]
         return pending[:max_batch]
 
+    def next_intra_batch(self, max_batch: int) -> List[ExecutionTask]:
+        pending = [t for t in self.intra_tasks if t.state == TaskState.PENDING]
+        return pending[:max_batch]
+
     @property
     def all_tasks(self) -> List[ExecutionTask]:
-        return self.replica_tasks + self.leader_tasks
+        return self.replica_tasks + self.leader_tasks + self.intra_tasks

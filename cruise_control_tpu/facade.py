@@ -116,16 +116,28 @@ class CruiseControl:
 
     @staticmethod
     def _to_external_proposals(state: ClusterState, proposals):
-        """Internal broker/partition indices → external ids on every proposal
-        field, so the executor hands the backend real Kafka ids."""
+        """Internal broker/partition indices → external ids, and disk indices
+        → log-dir names, so the executor hands the backend real Kafka ids."""
         ext_b = state.broker_ids or tuple(range(state.num_brokers))
         ext_p = state.partition_ids or tuple(range(state.num_partitions))
+        names = state.disk_names
         identity = (
             ext_b == tuple(range(state.num_brokers))
             and ext_p == tuple(range(state.num_partitions))
+            and not names
         )
         if identity:
             return list(proposals)
+
+        def dirs(pr):
+            if not pr.disk_moves:
+                return ()
+            # old disk may be unknown (-1): never negative-index into names
+            return tuple(
+                (ext_b[b], names[b][od] if od >= 0 else "", names[b][nd])
+                for b, od, nd in pr.disk_moves
+            )
+
         out = []
         for pr in proposals:
             out.append(
@@ -136,6 +148,7 @@ class CruiseControl:
                     new_leader=ext_b[pr.new_leader],
                     old_replicas=tuple(ext_b[b] for b in pr.old_replicas),
                     new_replicas=tuple(ext_b[b] for b in pr.new_replicas),
+                    disk_moves=dirs(pr),
                 )
             )
         return out
@@ -224,10 +237,19 @@ class CruiseControl:
         engine: Optional[str] = None,
         strategy: Optional[ReplicaMovementStrategy] = None,
         progress: Optional[OperationProgress] = None,
+        rebalance_disk: bool = False,
     ) -> OptimizerResult:
-        """Upstream ``rebalance()`` — the §3.2 call stack from the facade down."""
+        """Upstream ``rebalance()`` — the §3.2 call stack from the facade
+        down.  ``rebalance_disk=True`` runs the JBOD intra-broker goal list
+        instead (upstream rebalance?rebalance_disk=true)."""
         progress = progress or OperationProgress("REBALANCE")
         self._sanity_check_no_execution(dryrun)
+        if rebalance_disk:
+            if goals is None:
+                from cruise_control_tpu.analyzer.goal_optimizer import (
+                    INTRA_BROKER_GOAL_ORDER,
+                )
+                goals = INTRA_BROKER_GOAL_ORDER
         state = self._model(requirements, progress)
         return self._goal_based_operation(
             "REBALANCE", state, goals, options or OptimizationOptions(),

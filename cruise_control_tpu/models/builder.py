@@ -41,6 +41,8 @@ class _Broker:
     rack: int
     capacity: np.ndarray
     state: BrokerState = BrokerState.ALIVE
+    #: JBOD: (name, capacity MB, offline) per disk; empty = no disk modeling
+    disks: List[tuple] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -51,6 +53,7 @@ class _Partition:
     leader_load: np.ndarray
     follower_load: np.ndarray
     offline: List[bool]
+    disks: Optional[List[int]] = None  # disk index per replica slot
 
 
 class ClusterModelBuilder:
@@ -74,12 +77,20 @@ class ClusterModelBuilder:
         capacity: Dict[Resource, float] | Sequence[float],
         state: BrokerState = BrokerState.ALIVE,
         broker_id: Optional[int] = None,
+        disks: Optional[Sequence[tuple]] = None,
     ) -> int:
         """``broker_id`` is the *external* (Kafka) id; defaults to the dense
-        internal index.  Returns the internal index."""
+        internal index.  ``disks`` (JBOD): sequence of ``(name, capacity_mb)``
+        or ``(name, capacity_mb, offline)``.  Returns the internal index."""
         rack_id = self.add_rack(rack) if isinstance(rack, str) else int(rack)
         internal = len(self._brokers)
-        self._brokers.append(_Broker(rack_id, _resource_vec(capacity), state))
+        disk_list = [
+            (d[0], float(d[1]), bool(d[2]) if len(d) > 2 else False)
+            for d in (disks or [])
+        ]
+        self._brokers.append(
+            _Broker(rack_id, _resource_vec(capacity), state, disk_list)
+        )
         self._broker_ids.append(internal if broker_id is None else int(broker_id))
         return internal
 
@@ -95,6 +106,7 @@ class ClusterModelBuilder:
         leader_slot: int = 0,
         offline: Optional[Sequence[bool]] = None,
         partition_id: Optional[int] = None,
+        disks: Optional[Sequence[int]] = None,
     ) -> int:
         # Default follower load per upstream semantics: replicates bytes-in
         # and disk, serves no bytes-out, and costs a fraction of leader CPU.
@@ -113,6 +125,7 @@ class ClusterModelBuilder:
                 leader_load=ll,
                 follower_load=fl,
                 offline=list(offline) if offline is not None else [False] * len(brokers),
+                disks=list(disks) if disks is not None else None,
             )
         )
         internal = len(self._partitions) - 1
@@ -159,6 +172,51 @@ class ClusterModelBuilder:
             on_dead = np.isin(assignment, np.nonzero(dead)[0])
             offline |= on_dead
 
+        # JBOD disk tensors (only when any broker declared disks)
+        replica_disk = disk_capacity = disk_offline = None
+        disk_names: tuple = ()
+        if any(b.disks for b in self._brokers):
+            D = max(len(b.disks) for b in self._brokers) or 1
+            disk_capacity = np.zeros((num_b, D), np.float32)
+            disk_offline_arr = np.zeros((num_b, D), bool)
+            names = []
+            for bi, b in enumerate(self._brokers):
+                row = []
+                for di, (name, cap_mb, off) in enumerate(b.disks):
+                    disk_capacity[bi, di] = cap_mb
+                    disk_offline_arr[bi, di] = off
+                    row.append(name)
+                names.append(tuple(row))
+            disk_names = tuple(names)
+            replica_disk = np.full((num_p, max_rf), -1, np.int32)
+            default_disk_counts: dict = {}
+            for i, part in enumerate(self._partitions):
+                if part.disks is not None:
+                    replica_disk[i, : len(part.disks)] = part.disks
+                else:
+                    # default placement: healthy disk with the fewest
+                    # replicas so far (never an offline disk)
+                    for s, bi in enumerate(part.brokers):
+                        healthy = [
+                            di for di, (_, _, off) in
+                            enumerate(self._brokers[bi].disks) if not off
+                        ]
+                        if healthy:
+                            counts = default_disk_counts.setdefault(
+                                bi, dict.fromkeys(healthy, 0)
+                            )
+                            di = min(healthy, key=lambda d: counts[d])
+                            counts[di] += 1
+                            replica_disk[i, s] = di
+            # replicas on offline disks are offline (same immigrant semantics
+            # as dead brokers)
+            for i in range(num_p):
+                for s in range(max_rf):
+                    bi, di = assignment[i, s], replica_disk[i, s]
+                    if bi != EMPTY_SLOT and di >= 0 and disk_offline_arr[bi, di]:
+                        offline[i, s] = True
+            disk_offline = disk_offline_arr
+
         return ClusterState(
             assignment=jnp.asarray(assignment),
             leader_slot=jnp.asarray(leader_slot),
@@ -180,4 +238,14 @@ class ClusterModelBuilder:
             num_topics=max(len(self._topics), 1),
             broker_ids=tuple(self._broker_ids),
             partition_ids=tuple(self._partition_ids),
+            replica_disk=(
+                None if replica_disk is None else jnp.asarray(replica_disk)
+            ),
+            disk_capacity=(
+                None if disk_capacity is None else jnp.asarray(disk_capacity)
+            ),
+            disk_offline=(
+                None if disk_offline is None else jnp.asarray(disk_offline)
+            ),
+            disk_names=disk_names,
         )

@@ -76,6 +76,24 @@ class ClusterState:
     #: DeviceModel arrays, not ClusterState, so this never hits a jit cache key
     #: on the scale-critical path.
     partition_ids: tuple = struct.field(pytree_node=False, default=())
+    # ---- JBOD (upstream model/Disk.java); None = no per-disk modeling -------
+    #: int32 [P, S] disk index (within hosting broker) of each replica; -1 =
+    #: unknown/none
+    replica_disk: Optional[jax.Array] = None
+    #: f32 [B, D] per-disk capacity MB, 0 where the disk slot doesn't exist
+    disk_capacity: Optional[jax.Array] = None
+    #: bool [B, D] offline (failed) disks
+    disk_offline: Optional[jax.Array] = None
+    #: log-dir name per (broker, disk index) for executor translation
+    disk_names: tuple = struct.field(pytree_node=False, default=())
+
+    @property
+    def has_disks(self) -> bool:
+        return self.disk_capacity is not None
+
+    @property
+    def max_disks(self) -> int:
+        return 0 if self.disk_capacity is None else self.disk_capacity.shape[1]
 
     # ---- static shape accessors -------------------------------------------------
     @property

@@ -32,6 +32,8 @@ class BrokerCapacityInfo:
     num_cpu_cores: int = 1
     is_estimated: bool = False
     estimation_info: str = ""
+    #: JBOD: logdir → capacity MB (None = single unnamed volume)
+    disk_capacities: Optional[Dict[str, float]] = None
 
 
 class BrokerCapacityConfigResolver:
@@ -44,11 +46,16 @@ class BrokerCapacityConfigResolver:
 class StaticCapacityResolver(BrokerCapacityConfigResolver):
     """Uniform capacity for every broker (tests / synthetic clusters)."""
 
-    def __init__(self, capacity: Dict[Resource, float], num_cpu_cores: int = 1):
+    def __init__(self, capacity: Dict[Resource, float], num_cpu_cores: int = 1,
+                 disk_capacities: Optional[Dict[str, float]] = None):
         vec = np.zeros(NUM_RESOURCES, np.float32)
         for r, v in capacity.items():
             vec[int(r)] = v
-        self._info = BrokerCapacityInfo(vec, num_cpu_cores)
+        if disk_capacities:
+            vec[int(Resource.DISK)] = sum(disk_capacities.values())
+        self._info = BrokerCapacityInfo(
+            vec, num_cpu_cores, disk_capacities=disk_capacities
+        )
 
     def capacity_for_broker(self, broker_id: int) -> BrokerCapacityInfo:
         return self._info
@@ -66,12 +73,14 @@ class BrokerCapacityConfigFileResolver(BrokerCapacityConfigResolver):
             broker_id = int(entry["brokerId"])
             cap = entry.get("capacity", {})
             vec = np.zeros(NUM_RESOURCES, np.float32)
+            disk_caps: Optional[Dict[str, float]] = None
             for key, res in _JSON_KEYS.items():
                 v = cap.get(key)
                 if v is None:
                     continue
                 if isinstance(v, dict):  # JBOD: logdir → MB
-                    vec[int(res)] = sum(float(x) for x in v.values())
+                    disk_caps = {d: float(x) for d, x in v.items()}
+                    vec[int(res)] = sum(disk_caps.values())
                 else:
                     vec[int(res)] = float(v)
             cores = int(entry.get("num.cores", cap.get("num.cores", 1)))
@@ -79,6 +88,7 @@ class BrokerCapacityConfigFileResolver(BrokerCapacityConfigResolver):
                 vec, cores, is_estimated=broker_id == DEFAULT_BROKER_ID,
                 estimation_info="default capacity entry"
                 if broker_id == DEFAULT_BROKER_ID else "",
+                disk_capacities=disk_caps,
             )
         if DEFAULT_BROKER_ID not in self._by_broker:
             raise ValueError(

@@ -93,6 +93,10 @@ class ClusterTopology:
     offline_replicas: Optional[Dict[int, List[int]]] = None
     #: alive brokers that must not receive replicas (all log dirs offline)
     degraded_brokers: Optional[set] = None
+    #: JBOD: (partition, broker) → log dir currently hosting the replica
+    replica_dirs: Optional[Dict] = None
+    #: JBOD: broker → offline log dirs
+    offline_dirs: Optional[Dict[int, List[str]]] = None
 
     @property
     def num_partitions(self) -> int:
@@ -137,6 +141,8 @@ class BackendMetadataClient(MetadataClient):
         leaders = {p: st.leader for p, st in self.backend.partitions.items()}
         probe = getattr(self.backend, "offline_replicas", None)
         degraded = getattr(self.backend, "degraded_brokers", None)
+        dirs = getattr(self.backend, "replica_dir", None)
+        off_dirs = getattr(self.backend, "offline_log_dirs", None)
         return ClusterTopology(
             assignment=assignment,
             leaders=leaders,
@@ -147,6 +153,8 @@ class BackendMetadataClient(MetadataClient):
             alive_brokers=self.backend.alive_brokers(),
             offline_replicas=probe() if probe is not None else None,
             degraded_brokers=degraded() if degraded is not None else None,
+            replica_dirs=dict(dirs) if dirs else None,
+            offline_dirs=off_dirs() if off_dirs is not None else None,
         )
 
 
@@ -305,14 +313,25 @@ class LoadMonitor:
 
         builder = ClusterModelBuilder()
         broker_index: Dict[int, int] = {}
+        #: broker → {dir name → disk index} for replica_disk resolution
+        dir_index: Dict[int, Dict[str, int]] = {}
         alive = topo.alive_brokers
         from cruise_control_tpu.common.resources import BrokerState
         for b in topo.broker_ids():
             info = self.capacity_resolver.capacity_for_broker(b)
             state = (BrokerState.ALIVE if alive is None or b in alive
                      else BrokerState.DEAD)
+            disks = None
+            if info.disk_capacities:
+                off = set((topo.offline_dirs or {}).get(b, ()))
+                disks = [
+                    (name, mb, name in off)
+                    for name, mb in sorted(info.disk_capacities.items())
+                ]
+                dir_index[b] = {name: i for i, (name, _, _) in enumerate(disks)}
             broker_index[b] = builder.add_broker(
-                topo.broker_rack.get(b, 0), info.capacity, state, broker_id=b
+                topo.broker_rack.get(b, 0), info.capacity, state, broker_id=b,
+                disks=disks,
             )
         for p in sorted(topo.assignment):
             replicas = topo.assignment[p]
@@ -327,6 +346,14 @@ class LoadMonitor:
             follower[Resource.NW_OUT] = 0.0
             follower[Resource.CPU] = load[Resource.CPU] * FOLLOWER_CPU_RATIO
             off_brokers = (topo.offline_replicas or {}).get(p, ())
+            disks = None
+            if dir_index:
+                disks = [
+                    dir_index.get(b, {}).get(
+                        (topo.replica_dirs or {}).get((p, b)), -1
+                    )
+                    for b in replicas
+                ]
             builder.add_partition(
                 topic=topo.partition_topic.get(p, "topic_0"),
                 brokers=[broker_index[b] for b in replicas],
@@ -335,6 +362,7 @@ class LoadMonitor:
                 leader_slot=lead_slot,
                 partition_id=p,
                 offline=[b in off_brokers for b in replicas],
+                disks=disks,
             )
         return builder.build()
 

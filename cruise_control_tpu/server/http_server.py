@@ -306,6 +306,8 @@ class CruiseControlHttpServer:
             # own params must not be able to smuggle in e.g. dryrun=false
             info = self.purgatory.take_approved(int(rid), endpoint)
             params = dict(info.params)
+        else:
+            info = None
 
         fn = self._operation(endpoint, params)
         try:
@@ -313,6 +315,9 @@ class CruiseControlHttpServer:
                 endpoint, lambda progress: fn(progress)
             )
         except TooManyTasksError as e:
+            if info is not None:
+                # the approval must survive a transient capacity rejection
+                self.purgatory.requeue(info.review_id)
             return self._send(handler, 429, {"errorMessage": str(e)})
         return self._respond_task(handler, task, params)
 
@@ -358,9 +363,10 @@ class CruiseControlHttpServer:
         engine = params.get("engine")
 
         if endpoint == "rebalance":
+            rebalance_disk = _flag(params, "rebalance_disk")
             return lambda progress: cc.rebalance(
                 goals=goal_list, dryrun=dryrun, engine=engine,
-                progress=progress,
+                progress=progress, rebalance_disk=rebalance_disk,
             )
         if endpoint in ("add_broker", "remove_broker", "demote_broker"):
             ids = _broker_ids(params)

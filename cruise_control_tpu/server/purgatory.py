@@ -82,6 +82,13 @@ class Purgatory:
             info.status = ReviewStatus.SUBMITTED
             return info
 
+    def requeue(self, review_id: int) -> RequestInfo:
+        """Return a claimed (SUBMITTED) request to APPROVED — used when
+        execution could not start and the approval must not be consumed."""
+        return self._transition(
+            review_id, ReviewStatus.SUBMITTED, ReviewStatus.APPROVED, None
+        )
+
     def _transition(self, review_id: int, expect: str, to: str,
                     reason: Optional[str]) -> RequestInfo:
         with self._lock:

@@ -49,9 +49,12 @@ def full_stack(
     failed_brokers=None,
     engine="greedy",
     executor_config=None,
+    jbod_disks=None,
 ):
     """Build the whole system over a skewed simulated cluster.
 
+    ``jbod_disks``: dict of dir name → capacity MB to give EVERY broker a
+    JBOD layout; initial replicas all land on the first dir (skewed).
     Returns (cruise_control, backend, reporter).
     """
     w, brokers = skewed_workload(
@@ -63,12 +66,26 @@ def full_stack(
         brokers=brokers,
         failed_brokers=failed_brokers,
     )
+    capacity_resolver = None
+    if jbod_disks:
+        from cruise_control_tpu.common.resources import Resource
+        from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+
+        first = sorted(jbod_disks)[0]
+        for p, reps in w.assignment.items():
+            for b in reps:
+                backend.replica_dir[(p, b)] = first
+        capacity_resolver = StaticCapacityResolver(
+            {Resource.CPU: 100.0, Resource.NW_IN: 1e5, Resource.NW_OUT: 1e5},
+            disk_capacities=dict(jbod_disks),
+        )
     broker_rack = {b: b % 2 for b in sorted(brokers)}
     topic = MetricsTopic()
     reporter = SimulatedMetricsReporter(w, topic)
     monitor = LoadMonitor(
         BackendMetadataClient(backend, broker_rack),
         MetricsReporterSampler(topic),
+        capacity_resolver=capacity_resolver,
         window_ms=WINDOW,
         num_windows=5,
     )

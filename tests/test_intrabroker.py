@@ -1,0 +1,219 @@
+"""JBOD intra-broker disk model + goal tests (upstream
+``analyzer/goals/intrabroker`` + ``model/Disk.java`` semantics;
+SURVEY.md §2.4/§2.5)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.analyzer.context import AnalyzerContext
+from cruise_control_tpu.analyzer.goal_optimizer import (
+    INTRA_BROKER_GOAL_ORDER,
+    GoalOptimizer,
+    make_goals,
+)
+from cruise_control_tpu.analyzer.goals.intrabroker import (
+    IntraBrokerDiskCapacityGoal,
+    IntraBrokerDiskUsageDistributionGoal,
+)
+from cruise_control_tpu.models.builder import ClusterModelBuilder
+
+from harness import full_stack
+
+CAP = {Resource.CPU: 100.0, Resource.NW_IN: 1e5, Resource.NW_OUT: 1e5,
+       Resource.DISK: 2000.0}
+
+
+def jbod_cluster(loads, disks=2, disk_cap=1000.0, offline=()):
+    """One broker with `disks` disks; every replica starts on disk 0 unless
+    its entry in `loads` is a (load, disk) pair."""
+    b = ClusterModelBuilder()
+    b.add_broker(
+        0, CAP,
+        disks=[(f"/d{i}", disk_cap, i in offline) for i in range(disks)],
+    )
+    for i, item in enumerate(loads):
+        load, disk = item if isinstance(item, tuple) else (item, 0)
+        b.add_partition(
+            "t", [0], {Resource.DISK: load, Resource.NW_IN: 1.0},
+            disks=[disk],
+        )
+    return b.build()
+
+
+class TestDiskModel:
+    def test_builder_assembles_disk_tensors(self):
+        state = jbod_cluster([100.0, (200.0, 1)])
+        assert state.has_disks and state.max_disks == 2
+        assert state.disk_names == (("/d0", "/d1"),)
+        rd = np.asarray(state.replica_disk)
+        assert rd[0, 0] == 0 and rd[1, 0] == 1
+
+    def test_context_disk_load_aggregates(self):
+        state = jbod_cluster([100.0, (200.0, 1), 50.0])
+        ctx = AnalyzerContext(state)
+        assert ctx.disk_load[0, 0] == pytest.approx(150.0)
+        assert ctx.disk_load[0, 1] == pytest.approx(200.0)
+
+    def test_offline_disk_marks_replicas_offline(self):
+        state = jbod_cluster([100.0, (200.0, 1)], offline=(1,))
+        off = np.asarray(state.replica_offline)
+        assert not off[0, 0] and off[1, 0]
+
+    def test_intra_action_updates_aggregates(self):
+        from cruise_control_tpu.analyzer.goals.intrabroker import _intra_action
+
+        state = jbod_cluster([100.0])
+        ctx = AnalyzerContext(state)
+        ctx.apply(_intra_action(ctx, 0, 0, 1))
+        assert ctx.disk_load[0, 0] == pytest.approx(0.0)
+        assert ctx.disk_load[0, 1] == pytest.approx(100.0)
+        assert ctx.replica_disk[0, 0] == 1
+
+
+class TestIntraBrokerGoals:
+    def test_capacity_goal_relieves_overloaded_disk(self):
+        # disk 0 holds 900/1000 against threshold 0.8 → must shed ≥100
+        state = jbod_cluster([500.0, 250.0, 150.0])
+        goal = make_goals(["IntraBrokerDiskCapacityGoal"])[0]
+        ctx = AnalyzerContext(state)
+        assert goal.violations(ctx) == 1
+        goal.optimize(ctx, [])
+        assert goal.violations(ctx) == 0
+        assert ctx.disk_load[0, 0] <= 800.0 + 1e-6
+
+    def test_capacity_goal_evacuates_offline_disk(self):
+        state = jbod_cluster([(300.0, 1), 100.0], offline=(1,))
+        goal = make_goals(["IntraBrokerDiskCapacityGoal"])[0]
+        ctx = AnalyzerContext(state)
+        goal.optimize(ctx, [])
+        assert ctx.disk_load[0, 1] == pytest.approx(0.0)
+        assert ctx.replica_disk[0, 0] == 0
+        assert not ctx.replica_offline[0, 0]
+
+    def test_distribution_goal_balances_disks(self):
+        state = jbod_cluster([300.0, 280.0, 290.0, 30.0])  # all on disk 0
+        goal = make_goals(["IntraBrokerDiskUsageDistributionGoal"])[0]
+        ctx = AnalyzerContext(state)
+        assert goal.violations(ctx) > 0
+        goal.optimize(ctx, [])
+        utils = ctx.disk_load[0] / 1000.0
+        assert abs(utils[0] - utils[1]) < 0.35
+
+    def test_distribution_respects_capacity_goal_chaining(self):
+        # disk 1 is tiny: distribution pressure must not push it past the
+        # 0.8 capacity threshold the hard goal enforced first
+        b = ClusterModelBuilder()
+        b.add_broker(0, CAP, disks=[("/big", 10_000.0), ("/small", 100.0)])
+        for load in [400.0, 400.0, 300.0, 60.0, 50.0]:
+            b.add_partition("t", [0], {Resource.DISK: load}, disks=[0])
+        state = b.build()
+        opt = GoalOptimizer(goals=make_goals(INTRA_BROKER_GOAL_ORDER))
+        result = opt.optimize(state)
+        ctx = AnalyzerContext(result.final_state)
+        assert ctx.disk_load[0, 1] <= 100.0 * 0.8 + 1e-6, \
+            "distribution goal overfilled the small disk past the hard cap"
+
+    def test_intra_moves_complete_with_async_backend(self):
+        # a backend that applies dir moves only after a tick must still
+        # complete (executor polls instead of checking synchronously)
+        from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+
+        class SlowDirBackend(SimulatedClusterBackend):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self._pending_dirs = {}
+
+            def alter_replica_log_dirs(self, moves):
+                self._pending_dirs.update(
+                    {(p, b): d for p, by in moves.items()
+                     for b, d in by.items()}
+                )
+
+            def tick(self):
+                super().tick()
+                for (p, b), d in self._pending_dirs.items():
+                    self.replica_dir[(p, b)] = d
+                self._pending_dirs = {}
+
+        cc, backend, _ = full_stack(
+            jbod_disks={"/d0": 50_000.0, "/d1": 50_000.0}
+        )
+        slow = SlowDirBackend(
+            {p: list(st.replicas) for p, st in backend.partitions.items()},
+            {p: st.leader for p, st in backend.partitions.items()},
+            brokers=backend.brokers,
+        )
+        slow.replica_dir = dict(backend.replica_dir)
+        cc.executor.backend = slow
+        result = cc.rebalance(rebalance_disk=True, dryrun=False)
+        assert result.execution.succeeded, result.execution
+        assert any(d == "/d1" for d in slow.replica_dir.values())
+
+    def test_builder_default_placement_skips_offline_disks(self):
+        b = ClusterModelBuilder()
+        b.add_broker(0, CAP, disks=[("/ok", 1000.0), ("/dead", 1000.0, True)])
+        for load in [10.0, 20.0, 30.0]:
+            b.add_partition("t", [0], {Resource.DISK: load})  # no disks=
+        state = b.build()
+        rd = np.asarray(state.replica_disk)
+        assert (rd[:, 0] == 0).all(), "default placement used an offline disk"
+        assert not np.asarray(state.replica_offline).any()
+
+    def test_goals_vacuous_without_disk_model(self):
+        from harness import skewed_workload
+        from cruise_control_tpu.models.generators import random_cluster
+
+        state = random_cluster(seed=3, num_brokers=6, num_racks=3,
+                               num_partitions=32)
+        for cls in (IntraBrokerDiskCapacityGoal,
+                    IntraBrokerDiskUsageDistributionGoal):
+            goal = make_goals([cls.name])[0]
+            ctx = AnalyzerContext(state)
+            assert goal.violations(ctx) == 0
+            goal.optimize(ctx, [])
+            assert ctx.actions == []
+
+
+class TestIntraProposalsAndExecution:
+    def test_optimizer_emits_disk_move_proposals(self):
+        state = jbod_cluster([500.0, 250.0, 150.0])
+        opt = GoalOptimizer(goals=make_goals(INTRA_BROKER_GOAL_ORDER))
+        result = opt.optimize(state)
+        assert result.proposals
+        for pr in result.proposals:
+            assert pr.has_disk_move
+            assert not pr.has_replica_change and not pr.has_leader_change
+            for b, old_d, new_d in pr.disk_moves:
+                assert old_d != new_d
+
+    def test_end_to_end_rebalance_disk(self):
+        cc, backend, _ = full_stack(
+            jbod_disks={"/d0": 50_000.0, "/d1": 50_000.0}
+        )
+        # everything starts on /d0
+        assert all(d == "/d0" for d in backend.replica_dir.values())
+        result = cc.rebalance(rebalance_disk=True, dryrun=False)
+        assert result.execution is not None and result.execution.succeeded
+        assert result.proposals, "no disk moves planned"
+        moved = [d for d in backend.replica_dir.values() if d == "/d1"]
+        assert moved, "no replica physically moved to /d1"
+        # replica placement untouched — intra moves only
+        for pr in result.proposals:
+            assert not pr.has_replica_change
+
+    def test_disk_moves_translated_to_dir_names(self):
+        cc, _, _ = full_stack(jbod_disks={"/d0": 50_000.0, "/d1": 50_000.0})
+        result = cc.rebalance(rebalance_disk=True, dryrun=True)
+        for pr in result.proposals:
+            for b, old_dir, new_dir in pr.disk_moves:
+                assert old_dir.startswith("/d") and new_dir.startswith("/d")
+
+    def test_inter_broker_rebalance_unaffected_by_disk_model(self):
+        cc, backend, _ = full_stack(
+            jbod_disks={"/d0": 50_000.0, "/d1": 50_000.0}
+        )
+        result = cc.rebalance(dryrun=False)
+        assert result.execution.succeeded
+        leaders = [st.leader for st in backend.partitions.values()]
+        assert leaders.count(0) < len(leaders)

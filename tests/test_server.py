@@ -259,6 +259,28 @@ class TestTwoStepVerification:
         finally:
             srv.stop()
 
+    def test_capacity_rejection_preserves_approval(self):
+        from cruise_control_tpu.server import UserTaskManager
+
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(
+            cc, port=0, two_step_verification=True,
+            user_task_manager=UserTaskManager(max_active_tasks=0),
+        )
+        srv.start()
+        try:
+            c = client_for(srv)
+            rid = c.post("rebalance", dryrun="true")["reviewId"]
+            c.post("review", approve=str(rid))
+            with pytest.raises(CruiseControlError) as e:
+                c.post("rebalance", dryrun="true", review_id=str(rid))
+            assert e.value.code == 429
+            board = c.get("review_board")["requestInfo"]
+            assert board[0]["Status"] == "APPROVED", \
+                "429 must not consume the approval"
+        finally:
+            srv.stop()
+
     def test_discarded_request_cannot_run(self):
         cc, _, _ = full_stack()
         srv = CruiseControlHttpServer(cc, port=0, two_step_verification=True)
