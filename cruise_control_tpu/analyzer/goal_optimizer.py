@@ -49,6 +49,10 @@ from cruise_control_tpu.analyzer.goals.intrabroker import (
     IntraBrokerDiskCapacityGoal,
     IntraBrokerDiskUsageDistributionGoal,
 )
+from cruise_control_tpu.analyzer.goals.kafka_assigner import (
+    KafkaAssignerDiskUsageDistributionGoal,
+    KafkaAssignerEvenRackAwareGoal,
+)
 from cruise_control_tpu.analyzer.goals.rack import (
     RackAwareDistributionGoal,
     RackAwareGoal,
@@ -99,6 +103,8 @@ GOAL_CLASSES = {
         PreferredLeaderElectionGoal,
         IntraBrokerDiskCapacityGoal,
         IntraBrokerDiskUsageDistributionGoal,
+        KafkaAssignerEvenRackAwareGoal,
+        KafkaAssignerDiskUsageDistributionGoal,
     ]
 }
 
@@ -106,6 +112,12 @@ GOAL_CLASSES = {
 INTRA_BROKER_GOAL_ORDER = [
     "IntraBrokerDiskCapacityGoal",
     "IntraBrokerDiskUsageDistributionGoal",
+]
+
+#: Legacy kafka-assigner mode (upstream kafka_assigner=true).
+KAFKA_ASSIGNER_GOAL_ORDER = [
+    "KafkaAssignerEvenRackAwareGoal",
+    "KafkaAssignerDiskUsageDistributionGoal",
 ]
 
 
@@ -171,6 +183,8 @@ class OptimizerResult:
     engine: str = "greedy"
     #: Filled by the facade after a non-dryrun execution (ExecutionResult).
     execution: Optional[object] = None
+    #: Provisioning hints from the final state (ProvisionResponse).
+    provision: Optional[object] = None
 
     @property
     def violation_score_before(self) -> int:
@@ -192,6 +206,9 @@ class OptimizerResult:
         return {
             "engine": self.engine,
             "execution": exec_summary,
+            "provision": (
+                self.provision.to_json() if self.provision is not None else None
+            ),
             "numProposals": len(self.proposals),
             "numActions": len(self.actions),
             "violationsBefore": self.violations_before,
@@ -296,6 +313,9 @@ class GoalOptimizer:
         violations_after = {g.name: g.violations(ctx) for g in self.goals}
         final_state = ctx.to_state(state)
         stats_after = stats_summary(cluster_stats(final_state))
+        from cruise_control_tpu.analyzer.provision import analyze_provisioning
+
+        provision = analyze_provisioning(final_state)
         return OptimizerResult(
             proposals=diff_proposals(
                 initial_assignment, initial_leader_slot, ctx,
@@ -309,4 +329,5 @@ class GoalOptimizer:
             final_state=final_state,
             duration_s=time.perf_counter() - t0,
             engine="greedy",
+            provision=provision,
         )

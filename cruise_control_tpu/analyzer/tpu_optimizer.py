@@ -667,6 +667,8 @@ class TpuGoalOptimizer:
             )
         final_state = ctx.to_state(state)
         stats_after = stats_summary(cluster_stats(final_state))
+        from cruise_control_tpu.analyzer.provision import analyze_provisioning
+
         return OptimizerResult(
             proposals=diff_proposals(
                 initial_assignment, initial_leader_slot, ctx,
@@ -680,4 +682,5 @@ class TpuGoalOptimizer:
             final_state=final_state,
             duration_s=time.perf_counter() - t0,
             engine="tpu",
+            provision=analyze_provisioning(final_state),
         )

@@ -122,6 +122,8 @@ def build_parser() -> argparse.ArgumentParser:
     rb = mutating("rebalance")
     rb.add_argument("--rebalance-disk", action="store_true",
                     help="JBOD intra-broker disk balancing")
+    rb.add_argument("--kafka-assigner", action="store_true",
+                    help="legacy kafka-assigner mode goals")
     for name in ("add_broker", "remove_broker", "demote_broker"):
         sp = mutating(name)
         sp.add_argument("brokers", help="comma-separated broker ids")
@@ -174,6 +176,8 @@ def run_command(client: CruiseControlClient, args: argparse.Namespace) -> dict:
             params["brokerid"] = args.brokers
         if cmd == "rebalance" and args.rebalance_disk:
             params["rebalance_disk"] = "true"
+        if cmd == "rebalance" and args.kafka_assigner:
+            params["kafka_assigner"] = "true"
         return client.post(cmd, **params)
     if cmd == "topic_configuration":
         return client.post(

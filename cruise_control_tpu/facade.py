@@ -238,18 +238,20 @@ class CruiseControl:
         strategy: Optional[ReplicaMovementStrategy] = None,
         progress: Optional[OperationProgress] = None,
         rebalance_disk: bool = False,
+        kafka_assigner: bool = False,
     ) -> OptimizerResult:
         """Upstream ``rebalance()`` — the §3.2 call stack from the facade
-        down.  ``rebalance_disk=True`` runs the JBOD intra-broker goal list
-        instead (upstream rebalance?rebalance_disk=true)."""
+        down.  ``rebalance_disk=True`` runs the JBOD intra-broker goal list;
+        ``kafka_assigner=True`` the legacy kafka-assigner mode goals."""
         progress = progress or OperationProgress("REBALANCE")
         self._sanity_check_no_execution(dryrun)
-        if rebalance_disk:
-            if goals is None:
-                from cruise_control_tpu.analyzer.goal_optimizer import (
-                    INTRA_BROKER_GOAL_ORDER,
-                )
-                goals = INTRA_BROKER_GOAL_ORDER
+        if goals is None and (rebalance_disk or kafka_assigner):
+            from cruise_control_tpu.analyzer.goal_optimizer import (
+                INTRA_BROKER_GOAL_ORDER,
+                KAFKA_ASSIGNER_GOAL_ORDER,
+            )
+            goals = (INTRA_BROKER_GOAL_ORDER if rebalance_disk
+                     else KAFKA_ASSIGNER_GOAL_ORDER)
         state = self._model(requirements, progress)
         return self._goal_based_operation(
             "REBALANCE", state, goals, options or OptimizationOptions(),

@@ -364,9 +364,11 @@ class CruiseControlHttpServer:
 
         if endpoint == "rebalance":
             rebalance_disk = _flag(params, "rebalance_disk")
+            kafka_assigner = _flag(params, "kafka_assigner")
             return lambda progress: cc.rebalance(
                 goals=goal_list, dryrun=dryrun, engine=engine,
                 progress=progress, rebalance_disk=rebalance_disk,
+                kafka_assigner=kafka_assigner,
             )
         if endpoint in ("add_broker", "remove_broker", "demote_broker"):
             ids = _broker_ids(params)
