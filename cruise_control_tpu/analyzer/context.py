@@ -131,6 +131,16 @@ class AnalyzerContext:
     def partition_excluded(self, p: int) -> bool:
         return int(self.partition_topic[p]) in self.options.excluded_topics
 
+    def excluded_partition_mask(self) -> np.ndarray:
+        """bool [P] — partitions whose topic is excluded from optimization.
+
+        Single source for the device mask builder, the host commit evaluator,
+        and the verifier (exclusion semantics must agree between all three).
+        """
+        if not self.options.excluded_topics:
+            return np.zeros(self.num_partitions, bool)
+        return np.isin(self.partition_topic, list(self.options.excluded_topics))
+
     # ---- aggregates -------------------------------------------------------------
     def _init_aggregates(self) -> None:
         P, S = self.assignment.shape

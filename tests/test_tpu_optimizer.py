@@ -114,3 +114,45 @@ def test_tpu_engine_evacuates_excluded_topic_offline_replicas():
     verify_result(state, res, goals, options)
     fa = np.array(res.final_state.assignment)
     assert not (fa == 9).any()
+
+
+def test_host_device_cost_parity():
+    """_np_broker_cost (host commit criterion) must match _broker_cost (device
+    score) term-for-term: drift would make the host reject every device
+    proposal or commit unfavored actions (code-review finding)."""
+    import jax.numpy as jnp
+    from cruise_control_tpu.analyzer.context import AnalyzerContext
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        _broker_cost,
+        _np_broker_cost,
+    )
+
+    state = random_cluster(seed=17, num_brokers=12, num_racks=4, num_partitions=80)
+    opt = TpuGoalOptimizer()
+    ctx = AnalyzerContext(state)
+    can = opt._constraint_arrays_np(ctx)
+    ca = {k: jnp.asarray(v) for k, v in can.items()}
+    m = opt._device_model(ctx)
+
+    rng = np.random.default_rng(3)
+    for b in rng.integers(0, ctx.num_brokers, size=8):
+        b = int(b)
+        load = ctx.broker_load[b] * rng.uniform(0.5, 1.5)
+        lnwin = float(ctx.broker_leader_load[b][2]) * 1.1
+        pot = float(ctx.broker_potential_nw_out[b]) * 0.9
+        rc = float(ctx.broker_replica_count[b]) + 1
+        lc = float(ctx.broker_leader_count[b])
+        dev = float(
+            _broker_cost(
+                m, opt.config, ca,
+                jnp.asarray(load, jnp.float32), jnp.float32(lnwin),
+                jnp.float32(pot), jnp.float32(rc), jnp.float32(lc),
+                jnp.int32(b),
+            )
+        )
+        host = _np_broker_cost(
+            opt.config, can, ctx.broker_capacity[b],
+            load, lnwin, pot, rc, lc,
+        )
+        assert abs(dev - host) <= 1e-3 * max(1.0, abs(dev)), (b, dev, host)
