@@ -28,12 +28,21 @@ def main() -> None:
         seed=42, num_brokers=50, num_racks=10, num_partitions=1000
     )
 
+    # steady-state measurement: the server compiles the search program once
+    # (module-level jit cache) and serves every subsequent rebalance warm, so
+    # both engines get one untimed warm-up pass (greedy's warms the jitted
+    # cluster-stats used by both)
+    greedy_opt = GoalOptimizer()
+    tpu_opt = TpuGoalOptimizer()
+    greedy_opt.optimize(state)
+    tpu_opt.optimize(state)
+
     t0 = time.perf_counter()
-    greedy = GoalOptimizer().optimize(state)
+    greedy = greedy_opt.optimize(state)
     greedy_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    tpu = TpuGoalOptimizer().optimize(state)
+    tpu = tpu_opt.optimize(state)
     tpu_s = time.perf_counter() - t0
 
     quality_ok = tpu.violation_score_after <= greedy.violation_score_after

@@ -65,13 +65,30 @@ def analyze_provisioning(
     low_utilization: float = 0.2,
     min_brokers: int = 3,
 ) -> ProvisionResponse:
+    return analyze_provisioning_arrays(
+        np.asarray(state.broker_alive()),
+        np.asarray(broker_load(state)),
+        np.asarray(state.broker_capacity),
+        capacity_threshold, low_utilization, min_brokers,
+    )
+
+
+def analyze_provisioning_arrays(
+    alive: np.ndarray,          # bool [B]
+    broker_load: np.ndarray,    # f32 [B, R]
+    broker_capacity: np.ndarray,  # f32 [B, R]
+    capacity_threshold: Optional[Dict[Resource, float]] = None,
+    low_utilization: float = 0.2,
+    min_brokers: int = 3,
+) -> ProvisionResponse:
+    """Host-array fast path: callers holding numpy copies (AnalyzerContext)
+    skip the three device fetches of the state-based entry point."""
     thr = capacity_threshold or DEFAULT_CAPACITY_THRESHOLD
-    alive = np.asarray(state.broker_alive())
     n_alive = int(alive.sum())
     if n_alive == 0:
         return ProvisionResponse(ProvisionStatus.UNDECIDED)
-    load = np.asarray(broker_load(state)).sum(axis=0)          # [R] total
-    cap = np.asarray(state.broker_capacity)[alive].sum(axis=0)  # [R] alive
+    load = np.asarray(broker_load).sum(axis=0)                  # [R] total
+    cap = np.asarray(broker_capacity)[alive].sum(axis=0)        # [R] alive
     cap = np.maximum(cap, 1e-9)
     util = load / cap
     utilization = {r.name: round(float(util[r]), 4) for r in Resource}

@@ -57,6 +57,7 @@ from cruise_control_tpu.analyzer.goal_optimizer import (
 from cruise_control_tpu.analyzer.goals.base import BALANCE_MARGIN, BalancingConstraint
 from cruise_control_tpu.models.cluster_state import ClusterState
 from cruise_control_tpu.models.stats import cluster_stats, stats_summary
+from cruise_control_tpu.ops.cost import broker_cost
 
 KIND_MOVE = 0
 KIND_LEADERSHIP = 1
@@ -94,6 +95,17 @@ class TpuSearchConfig:
     w_pot_nwout: float = 1.0
     #: movement friction: prefer smaller data moves on near-ties
     w_move_size: float = 1e-3
+    #: move-candidate scoring path: "columnar" materializes K·D candidate
+    #: rows (gather-bound at scale); "grid" scores the K×D grid by broadcast
+    #: (ops.grid); "pallas" runs the fused VMEM kernel (ops.pallas_grid);
+    #: "auto" picks pallas on TPU (single-device), grid elsewhere
+    scoring: str = "auto"
+    #: device-resident search: commit this many best-action steps per device
+    #: call inside a lax.scan (rescore → argmin → apply, incrementally), so
+    #: host↔device round-trips drop T-fold.  0 disables (score-only rounds
+    #: with host-side batch commit).  Single-device engines only; the host
+    #: still exact-rechecks every returned action before accepting it.
+    steps_per_call: int = 128
 
 
 # ---------------------------------------------------------------------------------
@@ -190,45 +202,9 @@ def _broker_cost(
     lcount: jax.Array,      # f32 [...]
     b: jax.Array,           # int32 [...] broker index (capacity lookup)
 ) -> jax.Array:
-    """Per-broker contribution to the global soft-goal cost.
-
-    Global cost = Σ_b f(b); a candidate changes only f(src) and f(dst), so its
-    score is an exact O(1) delta.  Terms mirror the soft-goal stack:
-    utilization spread (×4 resources), balance-bound overruns, replica/leader
-    count balance, leader-bytes-in balance, potential-NW-out overrun, plus a
-    heavy capacity-overrun term that drives hard-goal repair.
-    """
-    cap = jnp.maximum(m.capacity[b], 1e-9)           # [..., R]
-    util = load / cap
-    c_var = jnp.sum(util * util, axis=-1) * cfg.w_util_var
-    over = jnp.maximum(util - ca["util_upper"], 0.0)
-    under = jnp.maximum(ca["util_lower"] - util, 0.0)
-    c_bound = jnp.sum(over + under, axis=-1) * cfg.w_bound
-    cap_over = jnp.maximum(util - ca["cap_threshold"], 0.0)
-    c_cap = jnp.sum(cap_over, axis=-1) * 1000.0
-    c_rc = ((rcount / ca["avg_rcount"] - 1.0) ** 2) * cfg.w_count
-    c_lc = ((lcount / ca["avg_lcount"] - 1.0) ** 2) * cfg.w_leader_count
-    # count balance-bound overruns (drives the count-distribution violation
-    # metric directly, same bounds as the numpy goals)
-    c_rc_b = (
-        jnp.maximum(rcount - ca["rcount_upper"], 0.0)
-        + jnp.maximum(ca["rcount_lower"] - rcount, 0.0)
-    ) / ca["avg_rcount"] * cfg.w_bound
-    c_lc_b = (
-        jnp.maximum(lcount - ca["lcount_upper"], 0.0)
-        + jnp.maximum(ca["lcount_lower"] - lcount, 0.0)
-    ) / ca["avg_lcount"] * cfg.w_bound
-    lnw = leader_nwin / cap[..., Resource.NW_IN]
-    c_lnw = lnw * lnw * cfg.w_leader_nwin
-    c_lnw_b = jnp.maximum(lnw - ca["leader_nwin_upper"], 0.0) * cfg.w_bound
-    pot_u = pot_nwout / cap[..., Resource.NW_OUT]
-    c_pot = (
-        jnp.maximum(pot_u - ca["cap_threshold"][Resource.NW_OUT], 0.0)
-        * cfg.w_pot_nwout
-    )
-    return (
-        c_var + c_bound + c_cap + c_rc + c_lc + c_rc_b + c_lc_b
-        + c_lnw + c_lnw_b + c_pot
+    """Per-broker soft-goal cost at broker index ``b`` (ops.cost.broker_cost)."""
+    return broker_cost(
+        cfg, ca, m.capacity[b], load, leader_nwin, pot_nwout, rcount, lcount
     )
 
 
@@ -360,17 +336,17 @@ def _score_candidates(
     return jnp.where(feasible, delta, jnp.inf), feasible
 
 
-def _build_round_candidates(
+def _build_round_pools(
     m: DeviceModel,
     ca: Dict[str, jax.Array],
     K: int,
     D: int,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Device-side candidate pruning for one round.
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Device-side candidate pruning for one round → (kp[K], ks[K], dest[D]).
 
     Source pool: top-K replicas by priority (offline ≫ on-over-bound-broker,
     tie-broken by replica size).  Dest pool: top-D least-loaded eligible
-    brokers.  Moves = K×D grid; leadership = every (p, slot).
+    brokers.
     """
     P, S = m.assignment.shape
     B = m.capacity.shape[0]
@@ -407,9 +383,19 @@ def _build_round_candidates(
     # dest pool: least max-utilization eligible brokers
     dest_score = jnp.max(util, axis=1) + jnp.where(m.dest_ok, 0.0, jnp.inf)
     _, dest_pool = jax.lax.top_k(-dest_score, D)
-    dest_pool = dest_pool.astype(jnp.int32)
+    return kp, ks, dest_pool.astype(jnp.int32)
 
-    # K×D move grid
+
+def _build_round_candidates(
+    m: DeviceModel,
+    ca: Dict[str, jax.Array],
+    K: int,
+    D: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Columnar candidate batch: the K×D move grid flattened + every possible
+    leadership transfer (the "columnar" scoring path's input)."""
+    P, S = m.assignment.shape
+    kp, ks, dest_pool = _build_round_pools(m, ca, K, D)
     cp_m = jnp.repeat(kp, D)
     cs_m = jnp.repeat(ks, D)
     cd_m = jnp.tile(dest_pool, K)
@@ -425,6 +411,112 @@ def _build_round_candidates(
         jnp.concatenate([cs_m, cs_l]),
         jnp.concatenate([cd_m, cd_l]),
     )
+
+
+# ---------------------------------------------------------------------------------
+# Device-resident search: score → argmin → apply, entirely on device (lax.scan)
+# ---------------------------------------------------------------------------------
+
+def _apply_on_device(
+    m: DeviceModel,
+    apply: jax.Array,    # bool — gate (False = no-op step)
+    is_move: jax.Array,  # bool
+    p: jax.Array, s: jax.Array, d: jax.Array,  # int32 scalars
+) -> DeviceModel:
+    """Commit one action to the device model with O(1) scatter updates —
+    the device twin of AnalyzerContext.apply (host) for the two action kinds."""
+    S = m.assignment.shape[1]
+    row = m.assignment[p]                      # [S]
+    lslot = m.leader_slot[p]
+    src_move = row[s]
+    leader_b = row[lslot]
+    leader_now = lslot == s
+
+    lnwin_p = m.leader_load[p, Resource.NW_IN]
+    nwout_p = m.leader_load[p, Resource.NW_OUT]
+    move_load = jnp.where(leader_now, m.leader_load[p], m.follower_load[p])
+    lead_delta = m.leader_load[p] - m.follower_load[p]
+
+    src = jnp.where(is_move, src_move, leader_b)
+    dst = jnp.where(is_move, d, src_move)
+    dload = jnp.where(is_move, move_load, lead_delta)
+    dlnwin = jnp.where(
+        is_move, jnp.where(leader_now, lnwin_p, 0.0), lnwin_p
+    )
+    dpot = jnp.where(is_move, nwout_p, 0.0)
+    drc = jnp.where(is_move, 1.0, 0.0)
+    dlc = jnp.where(is_move & ~leader_now, 0.0, 1.0)
+
+    gate = jnp.where(apply, 1.0, 0.0)
+    dload = dload * gate
+    dlnwin = dlnwin * gate
+    dpot = dpot * gate
+    drc = drc * gate
+    dlc = dlc * gate
+    src_c, dst_c = jnp.clip(src, 0), jnp.clip(dst, 0)
+
+    apply_move = apply & is_move
+    apply_lead = apply & ~is_move
+    new_assign = m.assignment.at[p, s].set(
+        jnp.where(apply_move, d, src_move).astype(m.assignment.dtype)
+    )
+    new_lslot = m.leader_slot.at[p].set(
+        jnp.where(apply_lead, s, lslot).astype(m.leader_slot.dtype)
+    )
+    new_must = m.must_move.at[p, s].set(m.must_move[p, s] & ~apply_move)
+    return dataclasses.replace(
+        m,
+        assignment=new_assign,
+        leader_slot=new_lslot,
+        must_move=new_must,
+        broker_load=m.broker_load.at[src_c].add(-dload).at[dst_c].add(dload),
+        leader_nwin=m.leader_nwin.at[src_c].add(-dlnwin).at[dst_c].add(dlnwin),
+        pot_nwout=m.pot_nwout.at[src_c].add(-dpot).at[dst_c].add(dpot),
+        rcount=m.rcount.at[src_c].add(-drc).at[dst_c].add(drc),
+        lcount=m.lcount.at[src_c].add(-dlc).at[dst_c].add(dlc),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
+    """Compiled device-resident search: T (score → argmin → apply) steps per
+    call.  Returns (packed [5, T] committed actions, updated model) — the
+    host replays the sequence through the exact evaluator and reuses the
+    returned model when every action validates (the common case)."""
+    from cruise_control_tpu.ops.grid import move_grid_scores
+
+    use_pallas = _resolve_scoring(cfg, None) == "pallas"
+    if use_pallas:
+        from cruise_control_tpu.ops.pallas_grid import move_grid_scores_pallas
+
+    def step(carry, _):
+        m, ca, done = carry
+        S = m.assignment.shape[1]
+        grid_fn = move_grid_scores_pallas if use_pallas else move_grid_scores
+        scores, kp, ks, dest_pool = _merged_scores(m, cfg, ca, K, D, grid_fn)
+        idx = jnp.argmin(scores)
+        best = scores[idx]
+        is_move, kind, p, s, d = _decode_flat_idx(idx, K, D, S, kp, ks,
+                                                  dest_pool)
+        improve = (best < cfg.improvement_tol) & ~done
+        m = _apply_on_device(m, improve, is_move, p, s, d)
+        out = jnp.stack(
+            [
+                jnp.where(improve, best, jnp.inf).astype(jnp.float32),
+                kind.astype(jnp.float32),
+                p.astype(jnp.float32),
+                s.astype(jnp.float32),
+                d.astype(jnp.float32),
+            ]
+        )
+        return (m, ca, done | ~improve), out
+
+    def run(m: DeviceModel, ca):
+        (m, _, _), outs = jax.lax.scan(step, (m, ca, jnp.bool_(False)),
+                                       xs=None, length=T)
+        return outs.T, m
+
+    return jax.jit(run)
 
 
 # ---------------------------------------------------------------------------------
@@ -587,6 +679,55 @@ def _unpack_round_result(packed) -> Tuple:
     return scores, kind, cp, cs, cd
 
 
+def _resolve_scoring(cfg: TpuSearchConfig, mesh) -> str:
+    if cfg.scoring != "auto":
+        return cfg.scoring
+    # the fused Pallas kernel is the single-device TPU fast path; under a
+    # mesh (or on CPU test rigs) the jnp grid path shards/interprets cleanly
+    if mesh is None and jax.default_backend() == "tpu":
+        return "pallas"
+    return "grid"
+
+
+def _leadership_grid(P: int, S: int) -> Tuple[jax.Array, jax.Array]:
+    ps = jnp.arange(P * S, dtype=jnp.int32)
+    return ps // S, ps % S
+
+
+def _merged_scores(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
+                   grid_fn):
+    """Move grid + full leadership scores flattened into one score vector.
+
+    Layout: index i < K·D is move (source kp[i//D], ks[i//D] → dest[i%D]);
+    i >= K·D is leadership transfer (partition (i-K·D)//S to slot (i-K·D)%S).
+    Shared by the scan step and the score-only round path — keep the decode
+    (:func:`_decode_flat_idx`) in lockstep with this layout.
+    """
+    P, S = m.assignment.shape
+    kp, ks, dest_pool = _build_round_pools(m, ca, K, D)
+    g = grid_fn(m, cfg, ca, kp, ks, dest_pool)
+    lp, lsl = _leadership_grid(P, S)
+    l_scores, _ = _score_candidates(
+        m, cfg, ca, jnp.ones(P * S, jnp.int32), lp, lsl,
+        jnp.zeros(P * S, jnp.int32),
+    )
+    return jnp.concatenate([g.reshape(-1), l_scores]), kp, ks, dest_pool
+
+
+def _decode_flat_idx(idx, K: int, D: int, S: int, kp, ks, dest_pool):
+    """Inverse of the :func:`_merged_scores` layout → (kind, p, s, d)."""
+    is_move = idx < K * D
+    ki = jnp.clip(idx // D, 0, K - 1)
+    li = jnp.clip(idx - K * D, 0)
+    p = jnp.where(is_move, kp[ki], li // S).astype(jnp.int32)
+    s = jnp.where(is_move, ks[ki], li % S).astype(jnp.int32)
+    d = jnp.where(
+        is_move, dest_pool[jnp.clip(idx % D, 0, D - 1)], 0
+    ).astype(jnp.int32)
+    kind = jnp.where(is_move, KIND_MOVE, KIND_LEADERSHIP).astype(jnp.int32)
+    return is_move, kind, p, s, d
+
+
 @functools.lru_cache(maxsize=64)
 def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
     """One compiled round program per (config, K, D, mesh).
@@ -596,62 +737,114 @@ def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
     precompute, detectors, REST — hits the jit cache instead of tracing a
     fresh closure and recompiling.
     """
+    scoring = _resolve_scoring(cfg, mesh)
 
-    def round_fn(m: DeviceModel, ca):
-        kind, cp, cs, cd = _build_round_candidates(m, ca, K, D)
+    def columnar_topk(m, ca, kind, cp, cs, cd):
         scores, _ = _score_candidates(m, cfg, ca, kind, cp, cs, cd)
         k = min(cfg.topk_per_round, scores.shape[0])
         vals, idx = jax.lax.top_k(-scores, k)
         return _pack_round_result(-vals, kind[idx], cp[idx], cs[idx], cd[idx])
 
+    if scoring == "columnar":
+        def round_fn(m: DeviceModel, ca):
+            kind, cp, cs, cd = _build_round_candidates(m, ca, K, D)
+            return columnar_topk(m, ca, kind, cp, cs, cd)
+    else:
+        from cruise_control_tpu.ops.grid import move_grid_scores
+
+        if scoring == "pallas":
+            from cruise_control_tpu.ops.pallas_grid import (
+                move_grid_scores_pallas as _grid_fn,
+            )
+        else:
+            _grid_fn = None
+
+        def round_fn(m: DeviceModel, ca):
+            # moves scored on the K×D grid (no per-candidate gathers),
+            # leaderships columnar (cheap: P*S rows); merged top-k
+            S = m.assignment.shape[1]
+            grid_fn = _grid_fn if _grid_fn is not None else move_grid_scores
+            scores, kp, ks, dest_pool = _merged_scores(m, cfg, ca, K, D,
+                                                       grid_fn)
+            k = min(cfg.topk_per_round, scores.shape[0])
+            vals, idx = jax.lax.top_k(-scores, k)
+            _, kind, cp, cs, cd = _decode_flat_idx(idx, K, D, S, kp, ks,
+                                                   dest_pool)
+            return _pack_round_result(-vals, kind, cp, cs, cd)
+
     if mesh is None:
         return jax.jit(round_fn)
 
-    # Sharded variant: candidates built once (replicated inputs), then the
-    # candidate axis is sharded; each device scores its slice and emits a
-    # local top-k, concatenated across the mesh axis.
-    from jax.sharding import PartitionSpec as PS
+    # Sharded variants: pools/candidates built once (replicated inputs), the
+    # candidate axis sharded via parallel.sharded_columnar_topk; each device
+    # scores its slice and emits a local top-k, concatenated across the mesh
+    # axis (exact: the host exact-recheck consumes the merged set).
+    from cruise_control_tpu.parallel import sharded_columnar_topk
 
-    import inspect
+    if scoring == "columnar":
+        def sharded(m: DeviceModel, ca):
+            kind, cp, cs, cd = _build_round_candidates(m, ca, K, D)
+            # padding aliases candidate 0 but with dest == -1, which the
+            # feasibility mask rejects — padding never scores as real work
+            return sharded_columnar_topk(
+                mesh,
+                columnar_topk,
+                replicated_args=(m, ca),
+                columnar_args=(kind, cp, cs, cd),
+                pad_fills=(0, 0, 0, -1),
+            )
+    else:
+        from cruise_control_tpu.ops.grid import move_grid_scores
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
+        if scoring == "pallas":  # explicit request — auto never picks it here
+            from cruise_control_tpu.ops.pallas_grid import (
+                move_grid_scores_pallas as _shard_grid_fn,
+            )
+        else:
+            _shard_grid_fn = move_grid_scores
 
-    # jax >= 0.8 renamed check_rep -> check_vma; keep both spellings working
-    _params = inspect.signature(shard_map).parameters
-    _no_rep = {"check_vma": False} if "check_vma" in _params else {"check_rep": False}
+        def score_move_shard(m, ca, dest_pool, kp, ks):
+            g = _shard_grid_fn(m, cfg, ca, kp, ks, dest_pool)
+            flat = g.reshape(-1)
+            k = min(cfg.topk_per_round, flat.shape[0])
+            vals, idx = jax.lax.top_k(-flat, k)
+            ki, di = idx // D, idx % D
+            return _pack_round_result(
+                -vals, jnp.zeros(k, jnp.int32), kp[ki], ks[ki], dest_pool[di]
+            )
 
-    axis = mesh.axis_names[0]
-    n_dev = mesh.shape[axis]
-
-    def sharded(m: DeviceModel, ca):
-        kind, cp, cs, cd = _build_round_candidates(m, ca, K, D)
-        pad = (-kind.shape[0]) % n_dev
-        if pad:
-            # padding aliases candidate 0 but with dest == EMPTY_SLOT,
-            # which the mask rejects (dest_ok lookup clips, src==dst=0
-            # check kills it): mark kind MOVE, dest 0, partition 0 slot 0
-            kind = jnp.pad(kind, (0, pad))
-            cp = jnp.pad(cp, (0, pad))
-            cs = jnp.pad(cs, (0, pad))
-            cd = jnp.pad(cd, (0, pad), constant_values=-1)
-
-        @functools.partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(PS(), PS(), PS(axis), PS(axis), PS(axis), PS(axis)),
-            out_specs=PS(None, axis),
-            **_no_rep,
-        )
-        def score_shard(m, ca, kind, cp, cs, cd):
-            scores, _ = _score_candidates(m, cfg, ca, kind, cp, cs, cd)
+        def score_lead_shard(m, ca, lp, lsl):
+            scores, _ = _score_candidates(
+                m, cfg, ca, jnp.ones_like(lp), lp, lsl, jnp.zeros_like(lp)
+            )
             k = min(cfg.topk_per_round, scores.shape[0])
             vals, idx = jax.lax.top_k(-scores, k)
-            return _pack_round_result(-vals, kind[idx], cp[idx], cs[idx], cd[idx])
+            return _pack_round_result(
+                -vals, jnp.ones(k, jnp.int32), lp[idx], lsl[idx],
+                jnp.zeros(k, jnp.int32),
+            )
 
-        return score_shard(m, ca, kind, cp, cs, cd)
+        def sharded(m: DeviceModel, ca):
+            P, S = m.assignment.shape
+            kp, ks, dest_pool = _build_round_pools(m, ca, K, D)
+            # source-pool padding duplicates entry 0 — a duplicate candidate
+            # is harmless (the host exact-recheck commits it at most once)
+            moves = sharded_columnar_topk(
+                mesh,
+                score_move_shard,
+                replicated_args=(m, ca, dest_pool),
+                columnar_args=(kp, ks),
+                pad_fills=(0, 0),
+            )
+            lp, lsl = _leadership_grid(P, S)
+            leads = sharded_columnar_topk(
+                mesh,
+                score_lead_shard,
+                replicated_args=(m, ca),
+                columnar_args=(lp, lsl),
+                pad_fills=(0, 0),
+            )
+            return jnp.concatenate([moves, leads], axis=1)
 
     return jax.jit(sharded)
 
@@ -776,10 +969,74 @@ class TpuGoalOptimizer:
         ca = {k: jnp.asarray(v) for k, v in can.items()}
         P, S, B = ctx.num_partitions, ctx.max_rf, ctx.num_brokers
         K, D = self._pool_sizes(P, S, B)
-        round_fn = self._make_round_fn(K, D)
         evaluator = _HostEvaluator(ctx, cfg, can)
-
         actions: List[BalancingAction] = []
+
+        if (
+            cfg.steps_per_call
+            and self.mesh is None
+            # an explicit "columnar" choice means the K·D columnar scorer,
+            # which only the score-only round path runs
+            and _resolve_scoring(cfg, None) != "columnar"
+        ):
+            # Device-resident search: the device commits steps_per_call
+            # actions per call (scan); the host replays them through the
+            # exact evaluator.  If every action validates (common — the host
+            # check is the f64 twin of the device math), the device-updated
+            # model is reused without re-upload; a rejection truncates the
+            # batch and rebuilds device state from the live context.
+            scan_fn = _cached_scan_fn(cfg, K, D, cfg.steps_per_call)
+            # same total action budget as the score-only path's rounds cap
+            calls_budget = max(
+                1, -(cfg.max_rounds * cfg.max_moves_per_round)
+                // -cfg.steps_per_call
+            )
+            for _ in range(calls_budget):
+                packed, m_new = scan_fn(m, ca)
+                scores, k_top, p_top, s_top, d_top = _unpack_round_result(
+                    np.asarray(packed)
+                )
+                batch, rejected = 0, 0
+                for t in range(scores.shape[0]):
+                    if not np.isfinite(scores[t]):
+                        break
+                    action, delta = evaluator.evaluate(
+                        int(k_top[t]), int(p_top[t]), int(s_top[t]),
+                        int(d_top[t]),
+                    )
+                    if action is None or delta >= cfg.improvement_tol:
+                        # f32 device math disagreed with the f64 recheck on
+                        # this action; skip it but keep validating the rest
+                        # of the sequence — later actions are exact-checked
+                        # against the live context, so order is safe
+                        rejected += 1
+                        continue
+                    ctx.apply(action)
+                    actions.append(action)
+                    batch += 1
+                if not batch:
+                    break  # nothing validated — no further progress possible
+                if not rejected:
+                    m = m_new
+                    if batch < cfg.steps_per_call:
+                        break  # device converged mid-batch
+                else:
+                    # device state includes skipped actions — rebuild from
+                    # the live context before the next call
+                    m = dataclasses.replace(
+                        m,
+                        assignment=jnp.asarray(ctx.assignment),
+                        leader_slot=jnp.asarray(ctx.leader_slot),
+                        must_move=jnp.asarray(ctx.replica_offline),
+                    )
+                    m = _recompute_aggregates(m)
+            return self._finalize(
+                state, ctx, goals, actions, violations_before, stats_before,
+                initial_assignment, initial_leader_slot, initial_replica_disk,
+                t0,
+            )
+
+        round_fn = self._make_round_fn(K, D)
         for _ in range(cfg.max_rounds):
             scores, k_top, p_top, s_top, d_top = _unpack_round_result(
                 np.asarray(round_fn(m, ca))
@@ -817,6 +1074,15 @@ class TpuGoalOptimizer:
             )
             m = _recompute_aggregates(m)
 
+        return self._finalize(
+            state, ctx, goals, actions, violations_before, stats_before,
+            initial_assignment, initial_leader_slot, initial_replica_disk, t0,
+        )
+
+    def _finalize(
+        self, state, ctx, goals, actions, violations_before, stats_before,
+        initial_assignment, initial_leader_slot, initial_replica_disk, t0,
+    ) -> OptimizerResult:
         violations_after = {g.name: g.violations(ctx) for g in goals}
         # same contract as GoalOptimizer: a plan that leaves hard goals
         # violated must not reach the executor
@@ -834,7 +1100,9 @@ class TpuGoalOptimizer:
             )
         final_state = ctx.to_state(state)
         stats_after = stats_summary(cluster_stats(final_state))
-        from cruise_control_tpu.analyzer.provision import analyze_provisioning
+        from cruise_control_tpu.analyzer.provision import (
+            analyze_provisioning_arrays,
+        )
 
         return OptimizerResult(
             proposals=diff_proposals(
@@ -849,5 +1117,7 @@ class TpuGoalOptimizer:
             final_state=final_state,
             duration_s=time.perf_counter() - t0,
             engine="tpu",
-            provision=analyze_provisioning(final_state),
+            provision=analyze_provisioning_arrays(
+                ctx.broker_alive, ctx.broker_load, ctx.broker_capacity
+            ),
         )

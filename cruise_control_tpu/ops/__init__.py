@@ -1,0 +1,6 @@
+"""TPU compute kernels: the fused cost and the K×D grid scorers."""
+
+from cruise_control_tpu.ops.cost import broker_cost
+from cruise_control_tpu.ops.grid import move_grid_scores, move_grid_terms
+
+__all__ = ["broker_cost", "move_grid_scores", "move_grid_terms"]

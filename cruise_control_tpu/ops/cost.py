@@ -1,0 +1,65 @@
+"""The fused soft-goal broker cost — single source of truth for the engine.
+
+Global cost = Σ_b broker_cost(b); every candidate action changes exactly two
+brokers, so its score is an exact O(1) delta (SURVEY.md §2.4 "two
+scatter-adds" identity).  Terms mirror the reference's soft-goal stack
+(upstream ``analyzer/goals/*.java``): utilization spread per resource,
+balance-bound overruns, replica/leader count balance, leader-bytes-in
+balance, potential-NW-out overrun, plus a heavy capacity-overrun term that
+drives hard-goal repair.
+
+Shapes broadcast: callers pass scalars, [N] columnar batches, or [K, D]
+grids — everything reduces over the trailing resource axis only.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import Resource
+
+
+def broker_cost(
+    cfg,
+    ca: Dict[str, jax.Array],
+    cap: jax.Array,         # f32 [..., R] broker capacity
+    load: jax.Array,        # f32 [..., R] broker load (possibly hypothetical)
+    leader_nwin: jax.Array, # f32 [...]
+    pot_nwout: jax.Array,   # f32 [...]
+    rcount: jax.Array,      # f32 [...]
+    lcount: jax.Array,      # f32 [...]
+) -> jax.Array:
+    """Per-broker contribution to the global soft-goal cost (see module doc)."""
+    cap = jnp.maximum(cap, 1e-9)
+    util = load / cap
+    c_var = jnp.sum(util * util, axis=-1) * cfg.w_util_var
+    over = jnp.maximum(util - ca["util_upper"], 0.0)
+    under = jnp.maximum(ca["util_lower"] - util, 0.0)
+    c_bound = jnp.sum(over + under, axis=-1) * cfg.w_bound
+    cap_over = jnp.maximum(util - ca["cap_threshold"], 0.0)
+    c_cap = jnp.sum(cap_over, axis=-1) * 1000.0
+    c_rc = ((rcount / ca["avg_rcount"] - 1.0) ** 2) * cfg.w_count
+    c_lc = ((lcount / ca["avg_lcount"] - 1.0) ** 2) * cfg.w_leader_count
+    c_rc_b = (
+        jnp.maximum(rcount - ca["rcount_upper"], 0.0)
+        + jnp.maximum(ca["rcount_lower"] - rcount, 0.0)
+    ) / ca["avg_rcount"] * cfg.w_bound
+    c_lc_b = (
+        jnp.maximum(lcount - ca["lcount_upper"], 0.0)
+        + jnp.maximum(ca["lcount_lower"] - lcount, 0.0)
+    ) / ca["avg_lcount"] * cfg.w_bound
+    lnw = leader_nwin / cap[..., Resource.NW_IN]
+    c_lnw = lnw * lnw * cfg.w_leader_nwin
+    c_lnw_b = jnp.maximum(lnw - ca["leader_nwin_upper"], 0.0) * cfg.w_bound
+    pot_u = pot_nwout / cap[..., Resource.NW_OUT]
+    c_pot = (
+        jnp.maximum(pot_u - ca["cap_threshold"][Resource.NW_OUT], 0.0)
+        * cfg.w_pot_nwout
+    )
+    return (
+        c_var + c_bound + c_cap + c_rc + c_lc + c_rc_b + c_lc_b
+        + c_lnw + c_lnw_b + c_pot
+    )

@@ -1,0 +1,157 @@
+"""Grid-form candidate scoring: K source replicas × D destination brokers.
+
+The columnar scorer (analyzer.tpu_optimizer._score_candidates) materializes
+K·D candidate rows, each gathering its partition row, source aggregates and
+destination aggregates from HBM — at the 10k-broker/1M-partition scale
+(K=65k, D=128 ⇒ 8.4M candidates × S-wide rows) that is gather-bound.
+
+Here the move grid is scored as a broadcast: per-source terms are computed
+once on [K] columns, per-destination terms once on [D] columns, and the
+[K, D] score matrix is pure VPU broadcast arithmetic — no per-candidate
+gathers at all.  This is the shape the TPU wants (dense tiles, trailing
+128-lane axis on D) and what the Pallas kernel (ops.pallas_grid) fuses.
+
+Semantics are bit-identical to the columnar scorer on move candidates
+(parity-tested in tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import EMPTY_SLOT, Resource
+from cruise_control_tpu.ops.cost import broker_cost
+
+
+def move_grid_terms(
+    m,
+    cfg,
+    ca: Dict[str, jax.Array],
+    kp: jax.Array,         # int32 [K] source partition
+    ks: jax.Array,         # int32 [K] source slot
+) -> Dict[str, jax.Array]:
+    """Per-source ([K]-shaped) terms shared by the jnp and Pallas grid paths."""
+    S = m.assignment.shape[1]
+    row = m.assignment[kp]                               # [K, S]
+    slot_broker = jnp.take_along_axis(row, ks[:, None], axis=1)[:, 0]
+    src = slot_broker
+    src_c = jnp.clip(src, 0)
+    leader_now = m.leader_slot[kp] == ks
+    slot_exists = slot_broker != EMPTY_SLOT
+
+    slot_racks = jnp.where(row != EMPTY_SLOT, m.rack[jnp.clip(row, 0)], -1)
+    my_rack = jnp.take_along_axis(slot_racks, ks[:, None], axis=1)[:, 0]
+    lower = jnp.arange(S)[None, :] < ks[:, None]
+    rack_viol_here = jnp.any(
+        lower & (slot_racks == my_rack[:, None]) & (row != EMPTY_SLOT), axis=1
+    )
+    # racks of the *other* replicas of p (self slot masked to -1: broker
+    # racks are non-negative so -1 never matches a destination rack)
+    other_racks = jnp.where(
+        (row != EMPTY_SLOT) & (jnp.arange(S)[None, :] != ks[:, None]),
+        slot_racks,
+        -1,
+    )
+
+    move_load = jnp.where(
+        leader_now[:, None], m.leader_load[kp], m.follower_load[kp]
+    )                                                     # [K, R]
+    must_move = m.must_move[kp, jnp.clip(ks, 0, S - 1)]
+    excluded = m.excluded[kp] & ~must_move
+    l_delta = jnp.where(leader_now, 1.0, 0.0)
+    lnwin_delta = jnp.where(leader_now, m.leader_load[kp, Resource.NW_IN], 0.0)
+    pot_delta = m.leader_load[kp, Resource.NW_OUT]
+
+    f_src_old = broker_cost(
+        cfg, ca, m.capacity[src_c], m.broker_load[src_c],
+        m.leader_nwin[src_c], m.pot_nwout[src_c], m.rcount[src_c],
+        m.lcount[src_c],
+    )
+    f_src_new = broker_cost(
+        cfg, ca, m.capacity[src_c], m.broker_load[src_c] - move_load,
+        m.leader_nwin[src_c] - lnwin_delta, m.pot_nwout[src_c] - pot_delta,
+        m.rcount[src_c] - 1.0, m.lcount[src_c] - l_delta,
+    )
+    friction = move_load[:, Resource.DISK] / ca["avg_disk_cap"] * cfg.w_move_size
+    evac = jnp.where(must_move, -1e6, 0.0)
+    rack_fix = jnp.where(rack_viol_here, -1e4, 0.0)
+    src_term = (f_src_new - f_src_old) + friction + evac + rack_fix
+
+    return {
+        "row": row,
+        "origin_row": m.offline_origin[kp],
+        "other_racks": other_racks,
+        "src": src,
+        "leader_now": leader_now,
+        "slot_exists": slot_exists,
+        "excluded": excluded,
+        "must_move": must_move,
+        "move_load": move_load,
+        "l_delta": l_delta,
+        "lnwin_delta": lnwin_delta,
+        "pot_delta": pot_delta,
+        "src_term": src_term,
+    }
+
+
+def move_grid_scores(
+    m,
+    cfg,
+    ca: Dict[str, jax.Array],
+    kp: jax.Array,
+    ks: jax.Array,
+    dest_pool: jax.Array,  # int32 [D] (may contain -1 shard padding)
+) -> jax.Array:
+    """Scores [K, D] for every (source replica, destination) move; +inf where
+    infeasible.  Exact same mask + delta as the columnar scorer."""
+    t = move_grid_terms(m, cfg, ca, kp, ks)
+    d_c = jnp.clip(dest_pool, 0)
+    d_cap = m.capacity[d_c]                               # [D, R]
+    d_load = m.broker_load[d_c]                           # [D, R]
+    d_rack = m.rack[d_c]                                  # [D]
+
+    # ---- feasibility [K, D] --------------------------------------------------
+    dup = jnp.any(t["row"][:, :, None] == d_c[None, None, :], axis=1)
+    dup = dup | jnp.any(
+        t["origin_row"][:, :, None] == d_c[None, None, :], axis=1
+    )
+    rack_clash = jnp.any(
+        t["other_racks"][:, :, None] == d_rack[None, None, :], axis=1
+    )
+    load_after = d_load[None, :, :] + t["move_load"][:, None, :]  # [K, D, R]
+    cap_ok = jnp.all(
+        load_after <= d_cap[None] * ca["cap_threshold"][None, None, :] + 1e-6,
+        axis=2,
+    )
+    feasible = (
+        (dest_pool[None, :] >= 0)
+        & (t["src"][:, None] != dest_pool[None, :])
+        & t["slot_exists"][:, None]
+        & m.dest_ok[d_c][None, :]
+        & ~dup
+        & ~rack_clash
+        & cap_ok
+        & (m.rcount[d_c][None, :] + 1.0 <= ca["max_replicas"])
+        & ~t["excluded"][:, None]
+        & (~t["leader_now"][:, None] | m.lead_ok[d_c][None, :])
+    )
+
+    # ---- destination cost delta [K, D] ---------------------------------------
+    f_dst_old = broker_cost(
+        cfg, ca, d_cap, d_load, m.leader_nwin[d_c], m.pot_nwout[d_c],
+        m.rcount[d_c], m.lcount[d_c],
+    )                                                     # [D]
+    f_dst_new = broker_cost(
+        cfg, ca,
+        d_cap[None],
+        load_after,
+        m.leader_nwin[d_c][None, :] + t["lnwin_delta"][:, None],
+        m.pot_nwout[d_c][None, :] + t["pot_delta"][:, None],
+        m.rcount[d_c][None, :] + 1.0,
+        m.lcount[d_c][None, :] + t["l_delta"][:, None],
+    )                                                     # [K, D]
+    delta = t["src_term"][:, None] + (f_dst_new - f_dst_old[None, :])
+    return jnp.where(feasible, delta, jnp.inf)

@@ -1,0 +1,229 @@
+"""Pallas TPU kernel for the K×D move-grid scorer.
+
+One fused VMEM pass computes the full feasibility mask + exact cost delta for
+a (TK, D) tile of the move grid — no HBM round-trips between the mask terms
+and the cost terms, and the D axis rides the 128-lane VPU dimension.  The
+per-source ([K]) and per-destination ([D]) terms are precomputed in XLA
+(ops.grid.move_grid_terms); the kernel is the O(K·D) part.
+
+Layout:
+* per-k f32 block  (TK, 8): src_term, lnwin_Δ, pot_Δ, l_Δ, leader_now,
+  feas_k, src_id, move-load rows follow in a separate (TK, R) block
+* per-k int32 block (TK, 3S): [row | offline_origin | other_racks]
+* per-d f32 (10, D): f_dst_old, lnwin, pot, rcount, lcount, d_ok, lead_ok,
+  rack, dest_id, unused — D on lanes
+* per-d f32 (R, D) ×2: dest load, dest capacity
+* constraint scalars in SMEM (20,)
+
+Weights from the (static) search config are baked into the kernel at trace
+time.  On non-TPU backends the kernel runs in interpret mode (tests); the
+jnp twin (ops.grid.move_grid_scores) is the reference semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.ops.grid import move_grid_terms
+
+_TK = 256          # K tile (sublane axis)
+_LANES = 128       # D padding multiple (lane axis)
+_INF = float("inf")
+
+
+def _kernel(S, w, scal_ref, kf_ref, ml_ref, ki_ref, df_ref, dl_ref, dc_ref,
+            out_ref):
+    """Score one (TK, D) tile.  ``S`` = replica slots, ``w`` = static weights."""
+    dest = df_ref[8, :][None, :]                     # (1, D) broker ids (f32)
+    d_rack = df_ref[7, :][None, :]
+
+    src_term = kf_ref[:, 0][:, None]
+    lnwin_d = kf_ref[:, 1][:, None]
+    pot_d = kf_ref[:, 2][:, None]
+    l_d = kf_ref[:, 3][:, None]
+    leader_now = kf_ref[:, 4][:, None] > 0.5
+    feas_k = kf_ref[:, 5][:, None] > 0.5
+    src_id = kf_ref[:, 6][:, None]
+
+    feasible = (
+        (dest >= 0.0)
+        & (src_id != dest)
+        & feas_k
+        & (df_ref[5, :][None, :] > 0.5)              # dest_ok & rcount_ok
+        & (~leader_now | (df_ref[6, :][None, :] > 0.5))
+    )
+    # duplicate-broker / offline-origin / rack clash: unrolled over S slots
+    for s in range(S):
+        feasible &= ki_ref[:, s][:, None] != dest
+        feasible &= ki_ref[:, S + s][:, None] != dest
+        feasible &= ki_ref[:, 2 * S + s][:, None] != d_rack
+
+    # fused cost of the destination with the replica added, minus before
+    c = jnp.zeros(out_ref.shape, jnp.float32)
+    for r in range(NUM_RESOURCES):
+        cap = jnp.maximum(dc_ref[r, :][None, :], 1e-9)
+        la = dl_ref[r, :][None, :] + ml_ref[:, r][:, None]
+        util = la / cap
+        feasible &= la <= cap * scal_ref[8 + r] + 1e-6
+        c += util * util * w["util_var"]
+        c += (
+            jnp.maximum(util - scal_ref[4 + r], 0.0)
+            + jnp.maximum(scal_ref[r] - util, 0.0)
+        ) * w["bound"]
+        c += jnp.maximum(util - scal_ref[8 + r], 0.0) * 1000.0
+        if r == Resource.NW_IN:
+            lnw = (df_ref[1, :][None, :] + lnwin_d) / cap
+            c += lnw * lnw * w["leader_nwin"]
+            c += jnp.maximum(lnw - scal_ref[18], 0.0) * w["bound"]
+        if r == Resource.NW_OUT:
+            pot_u = (df_ref[2, :][None, :] + pot_d) / cap
+            c += jnp.maximum(pot_u - scal_ref[8 + r], 0.0) * w["pot_nwout"]
+
+    avg_rc, rc_lo, rc_up = scal_ref[12], scal_ref[13], scal_ref[14]
+    avg_lc, lc_lo, lc_up = scal_ref[15], scal_ref[16], scal_ref[17]
+    rc_new = df_ref[3, :][None, :] + 1.0
+    lc_new = df_ref[4, :][None, :] + l_d
+    c += (rc_new / avg_rc - 1.0) ** 2 * w["count"]
+    c += (lc_new / avg_lc - 1.0) ** 2 * w["leader_count"]
+    c += (
+        jnp.maximum(rc_new - rc_up, 0.0) + jnp.maximum(rc_lo - rc_new, 0.0)
+    ) / avg_rc * w["bound"]
+    c += (
+        jnp.maximum(lc_new - lc_up, 0.0) + jnp.maximum(lc_lo - lc_new, 0.0)
+    ) / avg_lc * w["bound"]
+
+    delta = src_term + (c - df_ref[0, :][None, :])
+    out_ref[:] = jnp.where(feasible, delta, _INF)
+
+
+def _pad(x, mult, axis, fill):
+    pad = (-x.shape[axis]) % mult
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def move_grid_scores_pallas(
+    m,
+    cfg,
+    ca: Dict[str, jax.Array],
+    kp: jax.Array,
+    ks: jax.Array,
+    dest_pool: jax.Array,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Pallas twin of ops.grid.move_grid_scores → f32 [K, D]."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    K = kp.shape[0]
+    D = dest_pool.shape[0]
+    S = m.assignment.shape[1]
+    t = move_grid_terms(m, cfg, ca, kp, ks)
+    f32 = jnp.float32
+
+    kf = jnp.stack(
+        [
+            t["src_term"].astype(f32),
+            t["lnwin_delta"].astype(f32),
+            t["pot_delta"].astype(f32),
+            t["l_delta"].astype(f32),
+            t["leader_now"].astype(f32),
+            (t["slot_exists"] & ~t["excluded"]).astype(f32),
+            t["src"].astype(f32),
+            jnp.zeros(K, f32),
+        ],
+        axis=1,
+    )                                                  # [K, 8]
+    ml = t["move_load"].astype(f32)                    # [K, R]
+    # ids compare exactly in f32 (all < 2^24); -1 padding stays -1
+    ki = jnp.concatenate(
+        [t["row"], t["origin_row"], t["other_racks"]], axis=1
+    ).astype(f32)                                      # [K, 3S]
+
+    d_c = jnp.clip(dest_pool, 0)
+    from cruise_control_tpu.ops.cost import broker_cost
+
+    f_dst_old = broker_cost(
+        cfg, ca, m.capacity[d_c], m.broker_load[d_c], m.leader_nwin[d_c],
+        m.pot_nwout[d_c], m.rcount[d_c], m.lcount[d_c],
+    )
+    d_ok = (
+        m.dest_ok[d_c] & (m.rcount[d_c] + 1.0 <= ca["max_replicas"])
+    )
+    df = jnp.stack(
+        [
+            f_dst_old.astype(f32),
+            m.leader_nwin[d_c].astype(f32),
+            m.pot_nwout[d_c].astype(f32),
+            m.rcount[d_c].astype(f32),
+            m.lcount[d_c].astype(f32),
+            d_ok.astype(f32),
+            m.lead_ok[d_c].astype(f32),
+            m.rack[d_c].astype(f32),
+            dest_pool.astype(f32),
+            jnp.zeros(D, f32),
+        ]
+    )                                                  # [10, D]
+    dl = m.broker_load[d_c].T.astype(f32)              # [R, D]
+    dc = m.capacity[d_c].T.astype(f32)                 # [R, D]
+
+    scal = jnp.concatenate(
+        [
+            ca["util_lower"].astype(f32),              # 0..3
+            ca["util_upper"].astype(f32),              # 4..7
+            ca["cap_threshold"].astype(f32),           # 8..11
+            jnp.stack(
+                [
+                    ca["avg_rcount"], ca["rcount_lower"], ca["rcount_upper"],
+                    ca["avg_lcount"], ca["lcount_lower"], ca["lcount_upper"],
+                    ca["leader_nwin_upper"], ca["max_replicas"],
+                ]
+            ).astype(f32),                             # 12..19
+        ]
+    )
+
+    # pad: K to the tile, D to the lane width (dest -1 ⇒ infeasible)
+    kf = _pad(kf, _TK, 0, 0)
+    ml = _pad(ml, _TK, 0, 0)
+    ki = _pad(ki, _TK, 0, -1)
+    df = _pad(df, _LANES, 1, -1)
+    dl = _pad(dl, _LANES, 1, 0)
+    dc = _pad(dc, _LANES, 1, 1)
+    Kp, Dp = kf.shape[0], df.shape[1]
+
+    w = {
+        "util_var": cfg.w_util_var,
+        "bound": cfg.w_bound,
+        "count": cfg.w_count,
+        "leader_count": cfg.w_leader_count,
+        "leader_nwin": cfg.w_leader_nwin,
+        "pot_nwout": cfg.w_pot_nwout,
+    }
+    grid = (Kp // _TK,)
+    out = pl.pallas_call(
+        functools.partial(_kernel, S, w),
+        out_shape=jax.ShapeDtypeStruct((Kp, Dp), f32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                     # scal
+            pl.BlockSpec((_TK, 8), lambda i: (i, 0)),                  # kf
+            pl.BlockSpec((_TK, NUM_RESOURCES), lambda i: (i, 0)),      # ml
+            pl.BlockSpec((_TK, 3 * S), lambda i: (i, 0)),              # ki
+            pl.BlockSpec((10, Dp), lambda i: (0, 0)),                  # df
+            pl.BlockSpec((NUM_RESOURCES, Dp), lambda i: (0, 0)),       # dl
+            pl.BlockSpec((NUM_RESOURCES, Dp), lambda i: (0, 0)),       # dc
+        ],
+        out_specs=pl.BlockSpec((_TK, Dp), lambda i: (i, 0)),
+        interpret=interpret,
+    )(scal, kf, ml, ki, df, dl, dc)
+    return out[:K, :D]

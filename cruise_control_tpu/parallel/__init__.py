@@ -1,0 +1,19 @@
+"""Mesh / sharding utilities (the distributed search backend)."""
+
+from cruise_control_tpu.parallel.mesh import (
+    SEARCH_AXIS,
+    auto_mesh,
+    make_mesh,
+    pad_axis,
+    shard_map_norep,
+    sharded_columnar_topk,
+)
+
+__all__ = [
+    "SEARCH_AXIS",
+    "auto_mesh",
+    "make_mesh",
+    "pad_axis",
+    "shard_map_norep",
+    "sharded_columnar_topk",
+]

@@ -1,0 +1,100 @@
+"""Device-mesh utilities — the framework's distributed backend.
+
+The reference's only cross-process backend is the Kafka protocol plus a
+single multi-threaded JVM (SURVEY.md §5.8); its one "data parallel" axis is
+the proposal-precompute thread pool.  The TPU-native equivalent is a
+1-D device mesh over the **candidate/search axis**: every device holds the
+(replicated, small) cluster tensors and scores a shard of the candidate
+batch, with per-device top-k merged over ICI by concatenation — no psum
+needed because top-k-of-concatenated-top-ks is exact.
+
+Multi-host pods need no extra code: `jax.devices()` already spans hosts
+under `jax.distributed`, and shard_map's collectives ride ICI within a pod
+slice (DCN only across slices).  On CPU test rigs,
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` fakes the mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+try:  # jax >= 0.7 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# jax >= 0.8 renamed check_rep -> check_vma; support both spellings
+_params = inspect.signature(_shard_map).parameters
+_NO_REP_CHECK = (
+    {"check_vma": False} if "check_vma" in _params else {"check_rep": False}
+)
+
+SEARCH_AXIS = "search"
+
+
+def shard_map_norep(fn, mesh: Mesh, in_specs, out_specs):
+    """`shard_map` with replication checking off (portable across jax versions)."""
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_NO_REP_CHECK
+    )
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = SEARCH_AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A 1-D mesh over the search axis (all local devices by default)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), axis_names=(axis_name,))
+
+
+def auto_mesh(axis_name: str = SEARCH_AXIS) -> Optional[Mesh]:
+    """Mesh over all devices, or None when a single device makes sharding moot."""
+    devs = jax.devices()
+    return None if len(devs) < 2 else make_mesh(devices=devs, axis_name=axis_name)
+
+
+def pad_axis(x: jax.Array, multiple: int, fill=0) -> jax.Array:
+    """Pad the leading axis of ``x`` up to a multiple (static shapes for SPMD)."""
+    pad = (-x.shape[0]) % multiple
+    if not pad:
+        return x
+    widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def sharded_columnar_topk(
+    mesh: Mesh,
+    score_pack_fn: Callable[..., jax.Array],
+    replicated_args: tuple,
+    columnar_args: tuple,
+    pad_fills: tuple,
+):
+    """Score columnar candidate arrays sharded across ``mesh`` and return the
+    per-device packed top-k results concatenated along the last axis.
+
+    ``score_pack_fn(*replicated, *columnar) -> f32 [F, k]`` runs per shard;
+    output is ``[F, n_dev * k]``.  Columnar args are padded to a device
+    multiple with ``pad_fills`` (choose fills the feasibility mask rejects,
+    e.g. dest = -1, so padding never scores as a real candidate).
+    """
+    axis = mesh.axis_names[0]
+    n_dev = mesh.shape[axis]
+    cols = tuple(
+        pad_axis(c, n_dev, fill) for c, fill in zip(columnar_args, pad_fills)
+    )
+    n_rep = len(replicated_args)
+    in_specs = tuple([PartitionSpec()] * n_rep + [PartitionSpec(axis)] * len(cols))
+    out_specs = PartitionSpec(None, axis)
+    fn = shard_map_norep(score_pack_fn, mesh, in_specs, out_specs)
+    return fn(*replicated_args, *cols)

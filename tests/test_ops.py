@@ -1,0 +1,80 @@
+"""ops/ kernels: grid scorer must match the columnar scorer bit-for-bit."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import AnalyzerContext
+from cruise_control_tpu.analyzer.tpu_optimizer import (
+    KIND_MOVE,
+    TpuGoalOptimizer,
+    TpuSearchConfig,
+    _build_round_candidates,
+    _score_candidates,
+)
+from cruise_control_tpu.models.generators import random_cluster
+from cruise_control_tpu.ops import move_grid_scores
+
+
+def _setup(seed=3, brokers=12, racks=4, partitions=48, **kw):
+    state = random_cluster(
+        seed=seed, num_brokers=brokers, num_racks=racks,
+        num_partitions=partitions, **kw,
+    )
+    opt = TpuGoalOptimizer(config=TpuSearchConfig())
+    ctx = AnalyzerContext(state)
+    m = opt._device_model(ctx)
+    ca = opt._constraint_arrays(ctx)
+    return opt, ctx, m, ca
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_grid_matches_columnar(seed):
+    opt, ctx, m, ca = _setup(seed=seed)
+    K, D = opt._pool_sizes(ctx.num_partitions, ctx.max_rf, ctx.num_brokers)
+    kind, cp, cs, cd = _build_round_candidates(m, ca, K, D)
+    n_moves = K * D
+    col_scores, _ = _score_candidates(
+        m, opt.config, ca, kind[:n_moves], cp[:n_moves], cs[:n_moves], cd[:n_moves]
+    )
+    kp = cp[:n_moves:D]
+    ks = cs[:n_moves:D]
+    dest_pool = cd[:D]
+    grid = move_grid_scores(m, opt.config, ca, kp, ks, dest_pool)
+    got = np.asarray(grid).reshape(-1)
+    want = np.asarray(col_scores)
+    same_inf = np.isinf(got) == np.isinf(want)
+    assert same_inf.all()
+    finite = ~np.isinf(want)
+    np.testing.assert_allclose(got[finite], want[finite], rtol=1e-5, atol=1e-6)
+
+
+def test_grid_matches_columnar_with_dead_broker():
+    opt, ctx, m, ca = _setup(seed=5, brokers=10, racks=5, partitions=40)
+    # padding dest (-1) must be rejected, matching columnar's dst>=0 rule
+    K, D = opt._pool_sizes(ctx.num_partitions, ctx.max_rf, ctx.num_brokers)
+    kind, cp, cs, cd = _build_round_candidates(m, ca, K, D)
+    kp, ks = cp[: K * D : D], cs[: K * D : D]
+    dest = jnp.concatenate([cd[: D - 1], jnp.array([-1], jnp.int32)])
+    grid = np.asarray(move_grid_scores(m, opt.config, ca, kp, ks, dest))
+    assert np.isinf(grid[:, -1]).all()
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_pallas_grid_matches_jnp(seed):
+    from cruise_control_tpu.ops.pallas_grid import move_grid_scores_pallas
+
+    opt, ctx, m, ca = _setup(seed=seed, brokers=14, racks=7, partitions=56)
+    K, D = opt._pool_sizes(ctx.num_partitions, ctx.max_rf, ctx.num_brokers)
+    kind, cp, cs, cd = _build_round_candidates(m, ca, K, D)
+    kp, ks = cp[: K * D : D], cs[: K * D : D]
+    dest_pool = cd[:D]
+    want = np.asarray(move_grid_scores(m, opt.config, ca, kp, ks, dest_pool))
+    got = np.asarray(
+        move_grid_scores_pallas(m, opt.config, ca, kp, ks, dest_pool,
+                                interpret=True)
+    )
+    assert (np.isinf(got) == np.isinf(want)).all()
+    fin = ~np.isinf(want)
+    # f32 summation order differs between the fused kernel and the jnp twin
+    np.testing.assert_allclose(got[fin], want[fin], rtol=1e-4, atol=1e-4)

@@ -156,3 +156,23 @@ def test_host_device_cost_parity():
             load, lnwin, pot, rc, lc,
         )
         assert abs(dev - host) <= 1e-3 * max(1.0, abs(dev)), (b, dev, host)
+
+
+@pytest.mark.parametrize("scoring", ["columnar", "grid", "pallas"])
+def test_engine_scoring_paths_agree(scoring):
+    """All three scoring paths must produce verifiable plans of equal quality
+    (same scores → same committed actions, modulo f32 tie-breaks)."""
+    from cruise_control_tpu.analyzer.goal_optimizer import make_goals
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.analyzer.verifier import verify_result
+
+    state = random_cluster(seed=29, num_brokers=16, num_racks=4,
+                           num_partitions=96, mean_utilization=0.45)
+    result = TpuGoalOptimizer(
+        config=TpuSearchConfig(max_rounds=40, topk_per_round=64,
+                               scoring=scoring)
+    ).optimize(state)
+    verify_result(state, result, make_goals())
