@@ -64,6 +64,19 @@ class ClusterBackend:
     def clear_throttles(self) -> None:
         raise NotImplementedError
 
+    def describe_config(self, scope: str, entity: int) -> Dict[str, str]:
+        """Dynamic configs for ("broker", id) or ("partition", id) — the
+        upstream AdminClient.describeConfigs surface the throttle helper
+        reads to preserve user-set throttles."""
+        raise NotImplementedError
+
+    def alter_config(
+        self, scope: str, entity: int, updates: Dict[str, Optional[str]]
+    ) -> None:
+        """Apply dynamic-config updates; a ``None`` value deletes the key
+        (upstream incrementalAlterConfigs DELETE op)."""
+        raise NotImplementedError
+
     def alive_brokers(self) -> Set[int]:
         raise NotImplementedError
 
@@ -111,6 +124,8 @@ class SimulatedClusterBackend(ClusterBackend):
         self.throttle_rate: Optional[float] = None
         self.throttled_partitions: Set[int] = set()
         self.throttle_history: List[Tuple[str, float]] = []
+        #: ("broker"|"partition", id) → dynamic config key/values
+        self.dynamic_configs: Dict[Tuple[str, int], Dict[str, str]] = {}
         #: broker → offline log dirs (JBOD disk-failure injection; consumed by
         #: DiskFailureDetector the way upstream consumes describeLogDirs)
         self.offline_dirs: Dict[int, List[str]] = {}
@@ -200,6 +215,21 @@ class SimulatedClusterBackend(ClusterBackend):
         self.throttle_rate = None
         self.throttled_partitions = set()
         self.throttle_history.append(("clear", 0.0))
+
+    def describe_config(self, scope: str, entity: int) -> Dict[str, str]:
+        return dict(self.dynamic_configs.get((scope, entity), {}))
+
+    def alter_config(
+        self, scope: str, entity: int, updates: Dict[str, Optional[str]]
+    ) -> None:
+        cfg = self.dynamic_configs.setdefault((scope, entity), {})
+        for k, v in updates.items():
+            if v is None:
+                cfg.pop(k, None)
+            else:
+                cfg[k] = v
+        if not cfg:
+            self.dynamic_configs.pop((scope, entity), None)
 
     def alive_brokers(self) -> Set[int]:
         return self.brokers - self.failed_brokers

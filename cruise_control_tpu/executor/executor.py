@@ -18,6 +18,8 @@ from typing import Dict, List, Optional, Sequence, Set
 
 from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
 from cruise_control_tpu.executor.backend import ClusterBackend
+from cruise_control_tpu.executor.concurrency import ConcurrencyAdjuster
+from cruise_control_tpu.executor.notifier import ExecutorNotifier
 from cruise_control_tpu.executor.tasks import (
     ExecutionTask,
     ExecutionTaskPlanner,
@@ -25,6 +27,7 @@ from cruise_control_tpu.executor.tasks import (
     TaskState,
     TaskType,
 )
+from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
 
 
 class ExecutorStateValue(enum.Enum):
@@ -51,7 +54,17 @@ class ExecutorConfig:
     task_timeout_ticks: int = 100
     #: replication throttle rate (bytes/s) applied during execution; None = off
     replication_throttle: Optional[float] = None
-    #: adaptive concurrency: halve caps when URP count exceeds this
+    #: adaptive concurrency (ConcurrencyAdjuster): AIMD between the floor and
+    #: ceiling, reacting to under-replicated partitions not caused by the
+    #: execution's own moves.  Off by default (upstream
+    #: concurrency.adjuster.enabled=false) — the configured cap is then a
+    #: hard limit.
+    concurrency_adjuster_enabled: bool = False
+    concurrency_adjuster_min_cap: int = 1
+    #: None → 2× the configured per-broker cap
+    concurrency_adjuster_max_cap: Optional[int] = None
+    concurrency_adjuster_healthy_ticks: int = 3
+    #: legacy coarse back-off: halve caps when URP count exceeds this
     concurrency_adjuster_urp_threshold: int = 1 << 30
     #: safety ceiling for one execution's total moves
     max_inter_broker_moves: int = 1 << 30
@@ -90,6 +103,8 @@ class Executor:
         self._stop_requested = False
         self.planner: Optional[ExecutionTaskPlanner] = None
         self.history: List[ExecutionResult] = []
+        self.adjuster: Optional[ConcurrencyAdjuster] = None
+        self.throttle_helper: Optional[ReplicationThrottleHelper] = None
 
     # ---- public API -------------------------------------------------------------
     @property
@@ -129,12 +144,27 @@ class Executor:
             t.transition(TaskState.ABORTED)
 
         if self.config.replication_throttle is not None:
-            moving = [
-                t.proposal.partition
-                for t in planner.replica_tasks
-                if t.state == TaskState.PENDING
-            ]
-            self.backend.set_throttles(self.config.replication_throttle, moving)
+            self.throttle_helper = ReplicationThrottleHelper(
+                self.backend, self.config.replication_throttle
+            )
+            self.throttle_helper.set_throttles(
+                [
+                    t.proposal
+                    for t in planner.replica_tasks
+                    if t.state == TaskState.PENDING
+                ]
+            )
+        if self.config.concurrency_adjuster_enabled:
+            self.adjuster = ConcurrencyAdjuster(
+                initial_cap=(
+                    self.config.num_concurrent_partition_movements_per_broker
+                ),
+                min_cap=self.config.concurrency_adjuster_min_cap,
+                max_cap=self.config.concurrency_adjuster_max_cap,
+                healthy_ticks_before_increase=(
+                    self.config.concurrency_adjuster_healthy_ticks
+                ),
+            )
 
         ticks = 0
         try:
@@ -144,8 +174,9 @@ class Executor:
             if not self._stop_requested:
                 self._drive_intra_moves(planner)
         finally:
-            if self.config.replication_throttle is not None:
-                self.backend.clear_throttles()
+            if self.throttle_helper is not None:
+                self.throttle_helper.clear_throttles()
+                self.throttle_helper = None
             completed = sum(
                 1 for t in planner.all_tasks if t.state == TaskState.COMPLETED
             )
@@ -162,16 +193,30 @@ class Executor:
             )
             self.history.append(result)
             self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
-            if self.notifier is not None:
-                self.notifier(result)
+            self._notify(result)
         return result
 
+    def _notify(self, result: ExecutionResult) -> None:
+        if self.notifier is None:
+            return
+        if isinstance(self.notifier, ExecutorNotifier):
+            if result.stopped:
+                self.notifier.on_execution_stopped(result)
+            else:
+                self.notifier.on_execution_finished(result)
+        else:  # plain callable hook
+            self.notifier(result)
+
     # ---- drive loops ------------------------------------------------------------
-    def _caps(self) -> int:
+    def _caps(self, in_flight: Optional[Set[int]] = None) -> int:
         cap = self.config.num_concurrent_partition_movements_per_broker
-        urp = len(self.backend.under_replicated_partitions())
-        if urp > self.config.concurrency_adjuster_urp_threshold:
-            cap = max(1, cap // 2)  # upstream ConcurrencyAdjuster back-off
+        urp = self.backend.under_replicated_partitions()
+        if self.adjuster is not None:
+            # URPs the execution itself created don't count as stress
+            external = urp - (in_flight or set())
+            cap = self.adjuster.observe(external)
+        if len(urp) > self.config.concurrency_adjuster_urp_threshold:
+            cap = max(1, cap // 2)  # legacy coarse back-off
         return cap
 
     def _drive_replica_moves(
@@ -195,7 +240,7 @@ class Executor:
                 return ticks
             batch = planner.next_replica_batch(
                 in_flight_per_broker,
-                self._caps(),
+                self._caps(set(in_flight)),
                 sizes,
                 self.backend.under_replicated_partitions(),
             )

@@ -288,3 +288,77 @@ def test_throttles_exclude_aborted_moves():
     ex.execute_proposals(proposals)
     set_events = [e for e in backend.throttle_history if e[0] == "set"]
     assert set_events and len(backend.throttled_partitions) == 0  # cleared
+
+
+def test_throttle_helper_sets_and_removes_dynamic_configs():
+    """ReplicationThrottleHelper writes rate configs on participating brokers
+    and throttled-replica lists on moving partitions, then removes exactly
+    what it set — preserving a pre-existing user throttle."""
+    from cruise_control_tpu.executor.throttle import (
+        LEADER_RATE, ReplicationThrottleHelper,
+    )
+
+    backend, assignment, _ = make_backend(num_partitions=4)
+    # user throttle on broker 0 must survive the execution
+    backend.alter_config("broker", 0, {LEADER_RATE: "123"})
+    cfg = ExecutorConfig(replication_throttle=5e6)
+    ex = Executor(backend, cfg)
+    p = prop(0, assignment[0], [assignment[0][0], 3])
+    result = ex.execute_proposals([p])
+    assert result.succeeded
+    # helper cleaned up after itself...
+    for (scope, ent), cfgs in backend.dynamic_configs.items():
+        assert (scope, ent) == ("broker", 0), (scope, ent, cfgs)
+    # ...but the user's pre-existing rate survived
+    assert backend.describe_config("broker", 0) == {LEADER_RATE: "123"}
+
+
+def test_throttle_configs_present_during_execution():
+    from cruise_control_tpu.executor.throttle import (
+        FOLLOWER_REPLICAS, LEADER_RATE, ReplicationThrottleHelper,
+    )
+
+    backend, assignment, _ = make_backend(num_partitions=4)
+    helper = ReplicationThrottleHelper(backend, 7e6)
+    p = prop(1, assignment[1], [assignment[1][0], 3])
+    helper.set_throttles([p])
+    assert backend.describe_config("broker", 3)[LEADER_RATE] == "7000000.0"
+    assert backend.describe_config("partition", 1)[FOLLOWER_REPLICAS] == "3"
+    helper.clear_throttles()
+    assert not backend.dynamic_configs
+
+
+def test_concurrency_adjuster_aimd():
+    from cruise_control_tpu.executor.concurrency import ConcurrencyAdjuster
+
+    adj = ConcurrencyAdjuster(initial_cap=4, min_cap=1, max_cap=8,
+                              healthy_ticks_before_increase=2)
+    assert adj.observe({10}) == 2      # stress → halve
+    assert adj.observe({10}) == 1      # halve again, floored at min
+    assert adj.observe({10}) == 1
+    assert adj.observe(set()) == 1     # healthy streak building
+    assert adj.observe(set()) == 2     # additive increase
+    assert adj.observe(set()) == 2
+    assert adj.observe(set()) == 3
+    for _ in range(20):
+        adj.observe(set())
+    assert adj.cap == 8                # capped at ceiling
+
+
+def test_executor_notifier_spi():
+    from cruise_control_tpu.executor.notifier import ExecutorNotifier
+
+    events = []
+
+    class Spy(ExecutorNotifier):
+        def on_execution_finished(self, result):
+            events.append(("finished", result.completed))
+
+        def on_execution_stopped(self, result):
+            events.append(("stopped", result.completed))
+
+    backend, assignment, _ = make_backend(num_partitions=4)
+    ex = Executor(backend, notifier=Spy())
+    p = prop(0, assignment[0], [assignment[0][0], 3])
+    result = ex.execute_proposals([p])
+    assert events == [("finished", result.completed)]
