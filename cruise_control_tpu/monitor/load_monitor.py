@@ -227,16 +227,13 @@ class LoadMonitor:
         if self.state == LoadMonitorState.PAUSED:
             self.state = LoadMonitorState.RUNNING
 
-    def run_sampling_iteration(self, now_ms: int) -> int:
-        """One fetcher pass (upstream MetricFetcherManager interval): pull
-        samples in (last, now], aggregate, persist.  Returns #samples."""
+    def ingest_samples(self, psamples, bsamples, now_ms: int) -> int:
+        """Aggregate + persist one batch of samples (shared by the single-
+        sampler iteration below and the MetricFetcherManager fetcher pool)."""
         if self.state == LoadMonitorState.PAUSED:
             return 0
         prev_state, self.state = self.state, LoadMonitorState.SAMPLING
         try:
-            psamples, bsamples = self.sampler.get_samples(
-                self._last_sample_ms, now_ms
-            )
             if psamples:
                 self.partition_aggregator.ensure_entities(
                     max(s.partition for s in psamples) + 1
@@ -254,10 +251,20 @@ class LoadMonitor:
                     s.broker_id, s.time_ms, s.values
                 )
             self.sample_store.store_samples(psamples, bsamples)
-            self._last_sample_ms = now_ms
+            self._last_sample_ms = max(self._last_sample_ms, now_ms)
             return len(psamples) + len(bsamples)
         finally:
             self.state = prev_state
+
+    def run_sampling_iteration(self, now_ms: int) -> int:
+        """One fetcher pass (upstream MetricFetcherManager interval): pull
+        samples in (last, now], aggregate, persist.  Returns #samples."""
+        if self.state == LoadMonitorState.PAUSED:
+            return 0
+        psamples, bsamples = self.sampler.get_samples(
+            self._last_sample_ms, now_ms
+        )
+        return self.ingest_samples(psamples, bsamples, now_ms)
 
     # ---- model generation -------------------------------------------------------
     def acquire_for_model_generation(self) -> "ModelGenerationLock":

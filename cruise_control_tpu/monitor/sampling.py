@@ -196,12 +196,38 @@ class BrokerMetricSample:
 @dataclasses.dataclass
 class ModelParameters:
     """Coefficients of the partition-CPU linear model (upstream
-    ``ModelParameters`` / ``LinearRegressionModelParameters``): a leader
-    partition's CPU share of its broker is split between its bytes-in and
-    bytes-out shares."""
+    ``ModelParameters``): a leader partition's CPU share of its broker is
+    split between its bytes-in and bytes-out shares."""
 
     cpu_weight_bytes_in: float = 0.6
     cpu_weight_bytes_out: float = 0.4
+
+
+class LinearRegressionModelParameters:
+    """Trainable CPU model (upstream ``LinearRegressionModelParameters``,
+    driven by the TRAIN endpoint): least-squares fit of broker CPU against
+    broker bytes-in/bytes-out over the aggregated windows, normalized into
+    the attribution weights the processor uses."""
+
+    @staticmethod
+    def fit(broker_values: "np.ndarray") -> Optional[ModelParameters]:
+        """``broker_values``: f32 [B, W, M] aggregated broker windows.
+        Returns fitted params, or None when the history can't support a fit
+        (fewer than two windows or four positive samples)."""
+        if broker_values.size == 0 or broker_values.shape[1] < 2:
+            return None
+        x = broker_values[:, :, [B_BYTES_IN, B_BYTES_OUT]].reshape(-1, 2)
+        y = broker_values[:, :, B_CPU].reshape(-1)
+        mask = (x.sum(axis=1) > 0) & (y > 0)
+        if mask.sum() < 4:
+            return None
+        w, *_ = np.linalg.lstsq(x[mask], y[mask], rcond=None)
+        w = np.maximum(w, 0.0)
+        total = float(w.sum()) or 1.0
+        return ModelParameters(
+            cpu_weight_bytes_in=float(w[0] / total),
+            cpu_weight_bytes_out=float(w[1] / total),
+        )
 
 
 class MetricsProcessor:

@@ -466,26 +466,18 @@ class CruiseControlHttpServer:
         """Refit the partition-CPU linear model from broker history (upstream
         TRAIN endpoint → LinearRegressionModelParameters)."""
         from cruise_control_tpu.monitor.sampling import (
-            B_BYTES_IN, B_BYTES_OUT, B_CPU,
+            LinearRegressionModelParameters,
         )
 
         agg = self.cc.load_monitor.broker_aggregator.aggregate()
-        vals = agg.values  # [B, W, M]
-        if vals.size == 0 or vals.shape[1] < 2:
-            return {"trained": False, "message": "not enough windows"}
-        x = vals[:, :, [B_BYTES_IN, B_BYTES_OUT]].reshape(-1, 2)
-        y = vals[:, :, B_CPU].reshape(-1)
-        mask = (x.sum(axis=1) > 0) & (y > 0)
-        if mask.sum() < 4:
-            return {"trained": False, "message": "not enough samples"}
-        w, *_ = np.linalg.lstsq(x[mask], y[mask], rcond=None)
-        w = np.maximum(w, 0.0)
-        total = float(w.sum()) or 1.0
+        fitted = LinearRegressionModelParameters.fit(agg.values)
+        if fitted is None:
+            return {"trained": False, "message": "not enough training data"}
         processor = getattr(self.cc.load_monitor.sampler, "processor", None)
         if processor is None:
             return {"trained": False, "message": "sampler has no processor"}
-        processor.params.cpu_weight_bytes_in = float(w[0] / total)
-        processor.params.cpu_weight_bytes_out = float(w[1] / total)
+        processor.params.cpu_weight_bytes_in = fitted.cpu_weight_bytes_in
+        processor.params.cpu_weight_bytes_out = fitted.cpu_weight_bytes_out
         return {
             "trained": True,
             "cpuWeightBytesIn": processor.params.cpu_weight_bytes_in,

@@ -1,0 +1,101 @@
+"""MetricFetcherManager / partition assignor / Prometheus sampler tests
+(upstream MetricFetcherManagerTest tier; SURVEY.md §2.3)."""
+
+import sys
+
+from harness import full_stack, skewed_workload, WINDOW
+
+from cruise_control_tpu.monitor.fetcher import (
+    MetricFetcherManager,
+    MetricSamplerPartitionAssignor,
+)
+from cruise_control_tpu.monitor.prometheus import (
+    PrometheusMetricSampler,
+    parse_exposition,
+)
+from cruise_control_tpu.monitor.sampling import (
+    MetricsReporterSampler,
+    RawMetricType,
+)
+
+
+def test_assignor_round_robin_deterministic():
+    a = MetricSamplerPartitionAssignor()
+    got = a.assign([5, 1, 3, 2, 4, 0], 3)
+    assert got == [{0, 3}, {1, 4}, {2, 5}]
+    assert a.assign([1, 2], 5)[:2] == [{1}, {2}]
+
+
+def test_fetcher_manager_covers_universe_without_double_count():
+    cc, backend, reporter = full_stack(num_partitions=12, num_brokers=4)
+    monitor = cc.load_monitor
+    topic = monitor.sampler.topic
+    mgr = MetricFetcherManager(
+        monitor,
+        sampler_factory=lambda: MetricsReporterSampler(topic),
+        num_fetchers=3,
+        sampling_interval_ms=WINDOW,
+    )
+    reporter.report(time_ms=WINDOW * 10 + 1)
+    n = mgr.fetch_once(now_ms=WINDOW * 10 + 2)
+    # every partition sampled exactly once + broker samples once
+    assert n == 12 + 4
+    # a second interval with no new reports adds nothing
+    assert mgr.fetch_once(now_ms=WINDOW * 11) == 0
+
+
+def test_fetcher_manager_threaded_start_stop():
+    cc, backend, reporter = full_stack(num_partitions=6, num_brokers=3)
+    mgr = MetricFetcherManager(cc.load_monitor)
+    mgr.start(tick_s=0.01)
+    import time as _t
+
+    deadline = _t.time() + 2.0
+    while mgr.fetch_count == 0 and _t.time() < deadline:
+        _t.sleep(0.01)
+    mgr.stop()
+    assert mgr.fetch_count > 0
+
+
+EXPO = """\
+# HELP kafka_server_broker_cpu_util cpu
+kafka_server_broker_cpu_util{broker="0"} 42.5
+kafka_server_brokertopicmetrics_bytesin_total{broker="0"} 900.0
+kafka_server_brokertopicmetrics_bytesout_total{broker="0"} 300.0
+kafka_partition_bytesin_rate{broker="0",partition="7"} 600.0
+kafka_partition_bytesin_rate{broker="0",partition="8"} 300.0
+kafka_partition_bytesout_rate{broker="0",partition="7"} 300.0
+kafka_log_log_size{broker="0",partition="7"} 123.0
+not_a_mapped_metric{broker="0"} 1.0
+malformed line without value
+"""
+
+
+def test_parse_exposition():
+    rows = parse_exposition(EXPO)
+    names = [r[0] for r in rows]
+    assert "kafka_server_broker_cpu_util" in names
+    assert all("malformed" not in n for n in names)
+    cpu = next(r for r in rows if r[0] == "kafka_server_broker_cpu_util")
+    assert cpu[1] == {"broker": "0"} and cpu[2] == 42.5
+
+
+def test_prometheus_sampler_end_to_end():
+    urls = []
+
+    def fake_get(url):
+        urls.append(url)
+        return EXPO
+
+    sampler = PrometheusMetricSampler(fake_get, endpoint="http://x/metrics")
+    psamples, bsamples = sampler.get_samples(0, 10_000)
+    assert urls == ["http://x/metrics"]
+    assert {s.partition for s in psamples} == {7, 8}
+    assert len(bsamples) == 1 and bsamples[0].broker_id == 0
+    # CPU attribution ran through the shared MetricsProcessor: partition 7
+    # has 2/3 of bytes-in and all bytes-out -> the larger share
+    p7 = next(s for s in psamples if s.partition == 7)
+    p8 = next(s for s in psamples if s.partition == 8)
+    from cruise_control_tpu.monitor.sampling import P_CPU
+
+    assert p7.values[P_CPU] > p8.values[P_CPU] > 0
