@@ -1,0 +1,79 @@
+"""Background proposal precomputation (upstream GoalOptimizer's
+``ProposalPrecomputingExecutor`` thread pool; SURVEY.md §2.5 ◆, call stack
+§3.5): keeps the facade's proposal cache warm on an interval so
+``GET /proposals`` answers from cache instead of paying a full optimization.
+
+Each refresh runs on its own model snapshot (the facade's ``get_proposals``
+acquires the model-generation semaphore internally), mirroring upstream's
+per-thread ClusterModel clones — the reference's only data-parallel axis.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ProposalPrecomputingExecutor:
+    def __init__(self, cruise_control, interval_s: float = 30.0,
+                 engine: Optional[str] = None):
+        self.cc = cruise_control
+        self.interval_s = interval_s
+        self.engine = engine
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.runs = 0
+        self.errors = 0
+        self.last_run_s: Optional[float] = None
+        self.last_error: Optional[str] = None
+
+    def refresh_once(self) -> bool:
+        """One precompute pass; False when the model/optimizer declined."""
+        try:
+            self.cc.get_proposals(engine=self.engine, ignore_cache=True)
+            self.runs += 1
+            self.last_run_s = time.time()
+            return True
+        except Exception as exc:  # model not ready, ongoing execution, ...
+            self.errors += 1
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            logger.debug("proposal precompute skipped: %s", self.last_error)
+            return False
+
+    def start(self, tick_s: Optional[float] = None) -> None:
+        if self._thread is not None:
+            return
+        interval = tick_s if tick_s is not None else self.interval_s
+
+        def loop() -> None:
+            while not self._stop.wait(interval):
+                self.refresh_once()
+
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=loop, name="proposal-precompute", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._thread = None
+
+    def state_summary(self) -> dict:
+        return {
+            "runs": self.runs,
+            "errors": self.errors,
+            "lastRunSecondsAgo": (
+                round(time.time() - self.last_run_s, 1)
+                if self.last_run_s else None
+            ),
+            "lastError": self.last_error,
+            "running": self._thread is not None,
+        }

@@ -43,6 +43,7 @@ from cruise_control_tpu.monitor.load_monitor import (
     ModelCompletenessRequirements,
 )
 from cruise_control_tpu.server.progress import OperationProgress
+from cruise_control_tpu.utils.metrics import DEFAULT_REGISTRY, MetricRegistry
 
 
 @dataclasses.dataclass
@@ -70,13 +71,16 @@ class CruiseControl:
         engine: str = "greedy",
         mesh=None,
         proposal_ttl_s: float = 300.0,
+        registry: Optional[MetricRegistry] = None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
+        self.registry = registry or DEFAULT_REGISTRY
         self.constraint = constraint or BalancingConstraint()
         self.default_engine = engine
         self.mesh = mesh
         self.anomaly_detector = None  # attached by AnomalyDetectorManager
+        self.proposal_precomputer = None  # started on demand (§3.5)
         self._start_time = time.time()
         # cached proposals (upstream GoalOptimizer proposal precompute, §3.5)
         self._proposal_ttl_s = proposal_ttl_s
@@ -202,7 +206,10 @@ class CruiseControl:
         else:
             opt = self._make_engine(engine)
         with progress.step(f"Optimizing ({opt.__class__.__name__})"):
-            result = opt.optimize(state, options)
+            # upstream GoalOptimizer's "proposal-computation-timer"
+            with self.registry.timer("proposal-computation-timer"):
+                result = opt.optimize(state, options)
+        self.registry.meter(f"operation.{operation.lower()}").mark()
         # the proposals leaving the facade always speak external (Kafka) ids —
         # dryrun consumers (REST, operators) act on them too, not just the
         # executor
@@ -212,9 +219,11 @@ class CruiseControl:
                 f"Executing {len(result.proposals)} proposals"
             ):
                 sizes = self._partition_sizes(state)
-                result.execution = self.executor.execute_proposals(
-                    result.proposals, strategy=strategy, partition_sizes=sizes
-                )
+                with self.registry.timer("execution-timer"):
+                    result.execution = self.executor.execute_proposals(
+                        result.proposals, strategy=strategy,
+                        partition_sizes=sizes,
+                    )
             # the cluster just changed; cached proposals describe a stale world
             self.invalidate_proposal_cache()
         progress.finish()
@@ -435,6 +444,26 @@ class CruiseControl:
         with self._cache_lock:
             self._cached_proposals = None
 
+    def start_proposal_precomputation(
+        self, interval_s: float = 30.0, engine: Optional[str] = None
+    ) -> "ProposalPrecomputingExecutor":
+        """Launch the background proposal-precompute thread (§3.5)."""
+        from cruise_control_tpu.analyzer.precompute import (
+            ProposalPrecomputingExecutor,
+        )
+
+        if self.proposal_precomputer is None:
+            self.proposal_precomputer = ProposalPrecomputingExecutor(
+                self, interval_s, engine
+            )
+            self.proposal_precomputer.start()
+        return self.proposal_precomputer
+
+    def stop_proposal_precomputation(self) -> None:
+        if self.proposal_precomputer is not None:
+            self.proposal_precomputer.stop()
+            self.proposal_precomputer = None
+
     def rightsize(
         self, progress: Optional[OperationProgress] = None
     ) -> "ProvisionResponse":
@@ -471,8 +500,14 @@ class CruiseControl:
                 "isProposalReady": self._cached_proposals is not None,
                 "readyGoals": [g.name for g in make_goals(
                     constraint=self.constraint)],
+                **(
+                    {"proposalPrecompute":
+                     self.proposal_precomputer.state_summary()}
+                    if self.proposal_precomputer is not None else {}
+                ),
             },
         }
         if self.anomaly_detector is not None:
             out["AnomalyDetectorState"] = self.anomaly_detector.state_summary()
+        out["Metrics"] = self.registry.snapshot()
         return out

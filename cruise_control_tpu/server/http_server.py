@@ -130,6 +130,9 @@ class CruiseControlHttpServer:
             if not parsed.path.startswith(PREFIX + "/"):
                 return self._send(handler, 404, {"errorMessage": "not found"})
             endpoint = parsed.path[len(PREFIX) + 1:].strip("/").lower()
+            registry = getattr(self.cc, "registry", None)
+            if registry is not None:  # servlet request rates (§5.1)
+                registry.meter(f"http.{method}.{endpoint or 'root'}").mark()
             params = {
                 k: v[-1] for k, v in parse_qs(parsed.query).items()
             }

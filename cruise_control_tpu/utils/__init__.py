@@ -1,0 +1,11 @@
+"""Shared utilities: the metric registry (observability spine)."""
+
+from cruise_control_tpu.utils.metrics import (
+    DEFAULT_REGISTRY,
+    Counter,
+    Meter,
+    MetricRegistry,
+    Timer,
+)
+
+__all__ = ["DEFAULT_REGISTRY", "Counter", "Meter", "MetricRegistry", "Timer"]

@@ -1,0 +1,153 @@
+"""Dropwizard-style metric registry (upstream wires a
+``com.codahale.metrics.MetricRegistry`` through every subsystem and exposes
+it via JMX; SURVEY.md §5.1).  Timers, meters, counters and gauges with a
+JSON snapshot — the TPU build's observability spine, surfaced through
+``GET /state`` instead of JMX.
+
+Thread-safe: the registry is shared by the servlet worker threads, the
+detector scheduler, the fetcher manager and the executor.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+
+    def snapshot(self) -> dict:
+        return {"count": self.count}
+
+
+class Meter(Counter):
+    """Counter + event rate over the process lifetime and a recent window."""
+
+    _WINDOW_S = 300.0
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._start = time.time()
+        self._recent: List[float] = []
+
+    def mark(self, n: int = 1) -> None:
+        now = time.time()
+        with self._lock:
+            self.count += n
+            self._recent.extend([now] * n)
+            cutoff = now - self._WINDOW_S
+            while self._recent and self._recent[0] < cutoff:
+                self._recent.pop(0)
+
+    def snapshot(self) -> dict:
+        elapsed = max(time.time() - self._start, 1e-9)
+        with self._lock:
+            recent = len(self._recent)
+        return {
+            "count": self.count,
+            "meanRatePerSec": round(self.count / elapsed, 4),
+            "fiveMinCount": recent,
+        }
+
+
+class Timer:
+    """Duration histogram; use as a context manager or record seconds."""
+
+    _KEEP = 1024
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self._samples: List[float] = []
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.update(time.perf_counter() - self._t0)
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
+            self._samples.append(seconds)
+            if len(self._samples) > self._KEEP:
+                self._samples = self._samples[-self._KEEP:]
+
+    def _percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+        idx = min(int(q * len(s)), len(s) - 1)
+        return s[idx]
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "meanSec": round(self.total_s / self.count, 6) if self.count else 0.0,
+            "maxSec": round(self.max_s, 6),
+            "p50Sec": round(self._percentile(0.50), 6),
+            "p99Sec": round(self._percentile(0.99), 6),
+        }
+
+
+class MetricRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._timers: Dict[str, Timer] = {}
+        self._meters: Dict[str, Meter] = {}
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            return self._meters.setdefault(name, Meter())
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            timers = dict(self._timers)
+            meters = dict(self._meters)
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+        out: dict = {
+            "timers": {n: t.snapshot() for n, t in timers.items()},
+            "meters": {n: m.snapshot() for n, m in meters.items()},
+            "counters": {n: c.snapshot() for n, c in counters.items()},
+        }
+        gvals = {}
+        for n, fn in gauges.items():
+            try:
+                gvals[n] = fn()
+            except Exception as exc:
+                gvals[n] = f"error: {exc}"
+        out["gauges"] = gvals
+        return out
+
+
+#: process-wide default (constructor injection overrides it everywhere)
+DEFAULT_REGISTRY = MetricRegistry()

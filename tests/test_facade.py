@@ -214,3 +214,37 @@ class TestSanityChecks:
         # dryrun is still allowed during an execution
         result = cc.rebalance(dryrun=True)
         assert result is not None
+
+
+class TestProposalPrecompute:
+    def test_background_precompute_fills_cache(self):
+        import time as _t
+
+        cc, backend, _ = full_stack()
+        assert cc._cached_proposals is None
+        pre = cc.start_proposal_precomputation(interval_s=0.01)
+        deadline = _t.time() + 5.0
+        while pre.runs == 0 and _t.time() < deadline:
+            _t.sleep(0.02)
+        cc.stop_proposal_precomputation()
+        assert pre.runs > 0
+        assert cc._cached_proposals is not None
+        # GET /proposals is now a cache hit
+        r = cc.get_proposals()
+        assert r is cc._cached_proposals
+        st = cc.state()["AnalyzerState"]
+        assert st["isProposalReady"]
+
+    def test_refresh_once_records_errors(self):
+        cc, backend, _ = full_stack()
+        from cruise_control_tpu.analyzer.precompute import (
+            ProposalPrecomputingExecutor,
+        )
+
+        class Boom:
+            def get_proposals(self, **kw):
+                raise RuntimeError("model not ready")
+
+        pre = ProposalPrecomputingExecutor(Boom(), interval_s=999)
+        assert pre.refresh_once() is False
+        assert pre.errors == 1 and "model not ready" in pre.last_error

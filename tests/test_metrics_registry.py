@@ -1,0 +1,49 @@
+"""utils.metrics registry tests (upstream MetricRegistry/JMX analog, §5.1)."""
+
+import threading
+
+from cruise_control_tpu.utils.metrics import MetricRegistry
+
+from harness import full_stack
+
+
+def test_timer_meter_counter_gauge_snapshot():
+    reg = MetricRegistry()
+    with reg.timer("op"):
+        pass
+    reg.timer("op").update(0.5)
+    reg.meter("reqs").mark(3)
+    reg.counter("errs").inc()
+    reg.gauge("depth", lambda: 7)
+    snap = reg.snapshot()
+    assert snap["timers"]["op"]["count"] == 2
+    assert snap["timers"]["op"]["maxSec"] >= 0.5
+    assert snap["meters"]["reqs"]["count"] == 3
+    assert snap["counters"]["errs"]["count"] == 1
+    assert snap["gauges"]["depth"] == 7
+
+
+def test_registry_thread_safety():
+    reg = MetricRegistry()
+
+    def work():
+        for _ in range(500):
+            reg.meter("m").mark()
+            reg.timer("t").update(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["meters"]["m"]["count"] == 4000
+    assert snap["timers"]["t"]["count"] == 4000
+
+
+def test_facade_wires_registry_into_state():
+    cc, backend, _ = full_stack()
+    cc.rebalance(dryrun=True)
+    metrics = cc.state()["Metrics"]
+    assert metrics["timers"]["proposal-computation-timer"]["count"] >= 1
+    assert metrics["meters"]["operation.rebalance"]["count"] >= 1
