@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Standalone server launcher (upstream kafka-cruise-control-start.sh).
+# Usage: bin/cruise-control-start.sh [config/cruisecontrol.properties] [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m cruise_control_tpu "${1:-config/cruisecontrol.properties}" "${@:2}"

@@ -1,0 +1,203 @@
+"""Standalone server bootstrap (upstream ``KafkaCruiseControlMain`` +
+``KafkaCruiseControlApp``; SURVEY.md §3.1).
+
+Assembles the full stack from a properties file: simulated cluster backend →
+metrics reporter → sampler → LoadMonitor (+ fetcher manager) → facade (with
+the chosen analyzer engine) → anomaly detector → REST server (+ proposal
+precompute).  The build environment has no Kafka, so the managed cluster is
+the deterministic simulation (``simulation.*`` keys); a real deployment
+implements ClusterBackend over AdminClient and swaps it here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.detector.manager import make_detector_manager
+from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
+from cruise_control_tpu.monitor.load_monitor import (
+    BackendMetadataClient,
+    LoadMonitor,
+)
+from cruise_control_tpu.monitor.sampling import (
+    MetricsReporterSampler,
+    MetricsTopic,
+    SimulatedMetricsReporter,
+    WorkloadModel,
+)
+from cruise_control_tpu.server.http_server import CruiseControlHttpServer
+from cruise_control_tpu.server.user_tasks import UserTaskManager
+
+
+def load_properties(path: str) -> Dict[str, str]:
+    """Java-style ``key=value`` properties (comments with # or !)."""
+    props: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line[0] in "#!":
+                continue
+            key, _, value = line.partition("=")
+            props[key.strip()] = value.strip()
+    return props
+
+
+@dataclasses.dataclass
+class App:
+    """Everything ``main`` starts; ``shutdown`` stops it in reverse order."""
+
+    config: CruiseControlConfig
+    backend: SimulatedClusterBackend
+    reporter: SimulatedMetricsReporter
+    cruise_control: CruiseControl
+    fetcher_manager: MetricFetcherManager
+    server: CruiseControlHttpServer
+    detector_manager: object
+
+    def shutdown(self) -> None:
+        self.cruise_control.stop_proposal_precomputation()
+        self.detector_manager.stop()
+        self.fetcher_manager.stop()
+        self.server.stop()
+
+
+def _synthetic_workload(cfg: CruiseControlConfig) -> Tuple[WorkloadModel, set]:
+    rng = np.random.default_rng(cfg.get_int("simulation.seed"))
+    P = cfg.get_int("simulation.num.partitions")
+    B = cfg.get_int("simulation.num.brokers")
+    rf = min(cfg.get_int("simulation.replication.factor"), B)
+    assignment = {
+        p: [(p + i) % B for i in range(rf)] for p in range(P)
+    }
+    leaders = {p: assignment[p][0] for p in range(P)}
+    w = WorkloadModel(
+        bytes_in=rng.uniform(50, 1500, P),
+        bytes_out=rng.uniform(50, 3000, P),
+        size_mb=rng.uniform(100, 2000, P),
+        assignment=assignment,
+        leaders=leaders,
+    )
+    return w, set(range(B))
+
+
+def _capacity_for(w: WorkloadModel, num_brokers: int,
+                  target_mean_util: float = 0.45):
+    """Size per-broker capacities so the simulated cluster is feasible by
+    construction (mean utilization ≈ target under perfect balance)."""
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+
+    rf = np.array([len(w.assignment[p]) for p in sorted(w.assignment)])
+    total_cpu = (
+        w.base_cpu * num_brokers
+        + float(np.sum(w.bytes_in * (w.cpu_per_bytes_in
+                                     + w.cpu_per_replication_in * (rf - 1))))
+        + float(np.sum(w.bytes_out * w.cpu_per_bytes_out))
+    )
+    totals = {
+        Resource.CPU: total_cpu,
+        Resource.DISK: float(np.sum(w.size_mb * rf)),
+        Resource.NW_IN: float(np.sum(w.bytes_in * rf)),
+        Resource.NW_OUT: float(np.sum(w.bytes_out)),
+    }
+    per_broker = {
+        r: max(t / num_brokers / target_mean_util, 1.0)
+        for r, t in totals.items()
+    }
+    return StaticCapacityResolver(per_broker)
+
+
+def build_app(
+    config: Optional[CruiseControlConfig] = None,
+    port: Optional[int] = None,
+) -> App:
+    cfg = config or CruiseControlConfig()
+    workload, brokers = _synthetic_workload(cfg)
+    backend = SimulatedClusterBackend(
+        workload.assignment, workload.leaders, brokers=brokers
+    )
+    topic = MetricsTopic()
+    reporter = SimulatedMetricsReporter(workload, topic)
+    num_racks = cfg.get_int("simulation.num.racks")
+    metadata = BackendMetadataClient(
+        backend,
+        broker_rack={b: f"rack_{b % num_racks}" for b in brokers},
+    )
+    window_ms = cfg.get("partition.metrics.window.ms")
+    monitor = LoadMonitor(
+        metadata,
+        MetricsReporterSampler(topic),
+        capacity_resolver=_capacity_for(workload, len(brokers)),
+        window_ms=window_ms,
+        num_windows=cfg.get_int("num.partition.metrics.windows"),
+        min_samples_per_window=cfg.get_int(
+            "min.samples.per.partition.metrics.window"
+        ),
+        max_allowed_extrapolations=cfg.get_int(
+            "max.allowed.extrapolations.per.partition"
+        ),
+    )
+    executor = Executor(
+        backend,
+        ExecutorConfig(
+            num_concurrent_partition_movements_per_broker=cfg.get_int(
+                "num.concurrent.partition.movements.per.broker"
+            ),
+            num_concurrent_leader_movements=cfg.get_int(
+                "num.concurrent.leader.movements"
+            ),
+            replication_throttle=cfg.get("default.replication.throttle"),
+        ),
+    )
+    cc = CruiseControl(
+        monitor,
+        executor,
+        engine="tpu" if cfg.get_boolean("use.tpu.optimizer") else "greedy",
+        proposal_ttl_s=cfg.get("proposal.expiration.ms") / 1000,
+    )
+    fetchers = MetricFetcherManager(
+        monitor, sampling_interval_ms=cfg.get("metric.sampling.interval.ms")
+    )
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+
+    healing = cfg.get_boolean("self.healing.enabled")
+    notifier = SelfHealingNotifier(
+        enabled={t: healing for t in AnomalyType},
+        broker_failure_alert_threshold_ms=cfg.get(
+            "broker.failure.alert.threshold.ms"
+        ),
+        broker_failure_self_healing_threshold_ms=cfg.get(
+            "broker.failure.self.healing.threshold.ms"
+        ),
+    )
+    detector = make_detector_manager(
+        cc,
+        backend=backend,
+        notifier=notifier,
+        broker_failure_persist_path=cfg.get(
+            "broker.failures.persistence.path"
+        ),
+        detection_interval_ms=cfg.get("anomaly.detection.interval.ms"),
+        fix_cooldown_ms=cfg.get("self.healing.cooldown.ms"),
+    )
+    tasks = UserTaskManager(
+        max_active_tasks=cfg.get_int("max.active.user.tasks"),
+        completed_task_ttl_s=(
+            cfg.get("completed.user.task.retention.time.ms") / 1000
+        ),
+    )
+    server = CruiseControlHttpServer(
+        cc,
+        host=cfg.get("webserver.http.address"),
+        port=port if port is not None else cfg.get_int("webserver.http.port"),
+        user_task_manager=tasks,
+    )
+    return App(cfg, backend, reporter, cc, fetchers, server, detector)

@@ -345,6 +345,21 @@ def default_config_def() -> ConfigDef:
     d.define("completed.user.task.retention.time.ms", ConfigType.LONG,
              86_400_000, Importance.LOW,
              "TTL of finished task results.", at_least(0), G)
+
+    # the build environment has no Kafka: the standalone server manages a
+    # simulated cluster whose shape these keys control (bootstrap.py); a
+    # real-Kafka deployment swaps the backend and ignores them
+    G = "simulation"
+    d.define("simulation.num.brokers", ConfigType.INT, 12,
+             Importance.LOW, "Simulated cluster broker count.", at_least(1), G)
+    d.define("simulation.num.partitions", ConfigType.INT, 120,
+             Importance.LOW, "Simulated partition count.", at_least(1), G)
+    d.define("simulation.replication.factor", ConfigType.INT, 2,
+             Importance.LOW, "Simulated replication factor.", at_least(1), G)
+    d.define("simulation.num.racks", ConfigType.INT, 4,
+             Importance.LOW, "Simulated rack count.", at_least(1), G)
+    d.define("simulation.seed", ConfigType.INT, 42,
+             Importance.LOW, "Workload RNG seed.", None, G)
     return d
 
 

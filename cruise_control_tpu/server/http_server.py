@@ -29,6 +29,10 @@ import numpy as np
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
 from cruise_control_tpu.server.purgatory import Purgatory
+from cruise_control_tpu.server.security import (  # re-exported (legacy import site)
+    BasicSecurityProvider,
+    SecurityProvider,
+)
 from cruise_control_tpu.server.user_tasks import (
     TooManyTasksError,
     UserTaskManager,
@@ -49,23 +53,6 @@ SYNC_POST_ENDPOINTS = {
     "stop_proposal_execution", "pause_sampling", "resume_sampling",
     "admin", "review", "train",
 }
-
-
-class BasicSecurityProvider:
-    """HTTP Basic auth (upstream ``BasicSecurityProvider``); None = open."""
-
-    def __init__(self, users: Dict[str, str]):
-        self.users = dict(users)
-
-    def authenticate(self, auth_header: Optional[str]) -> bool:
-        if not auth_header or not auth_header.startswith("Basic "):
-            return False
-        try:
-            decoded = base64.b64decode(auth_header[6:]).decode()
-            user, _, password = decoded.partition(":")
-        except Exception:
-            return False
-        return self.users.get(user) == password
 
 
 class CruiseControlHttpServer:
@@ -127,6 +114,8 @@ class CruiseControlHttpServer:
     def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
         try:
             parsed = urlparse(handler.path)
+            if method == "GET" and parsed.path.rstrip("/") in ("/ui", ""):
+                return self._serve_ui(handler)
             if not parsed.path.startswith(PREFIX + "/"):
                 return self._send(handler, 404, {"errorMessage": "not found"})
             endpoint = parsed.path[len(PREFIX) + 1:].strip("/").lower()
@@ -136,9 +125,7 @@ class CruiseControlHttpServer:
             params = {
                 k: v[-1] for k, v in parse_qs(parsed.query).items()
             }
-            if self.security is not None and not self.security.authenticate(
-                handler.headers.get("Authorization")
-            ):
+            if self.security is not None and not self._authenticated(handler):
                 handler.send_response(401)
                 handler.send_header("WWW-Authenticate", "Basic")
                 handler.end_headers()
@@ -158,6 +145,29 @@ class CruiseControlHttpServer:
             self._send(handler, 503, {"errorMessage": str(e)})
         except Exception as e:
             self._send(handler, 500, {"errorMessage": repr(e)})
+
+    def _authenticated(self, handler) -> bool:
+        """Support both the provider SPI (authenticate_request) and the
+        legacy single-header authenticate."""
+        fn = getattr(self.security, "authenticate_request", None)
+        if fn is not None:
+            return fn(handler.headers, handler.client_address)
+        return self.security.authenticate(
+            handler.headers.get("Authorization")
+        )
+
+    def _serve_ui(self, handler) -> None:
+        """Serve the single-file dashboard (upstream serves the Vue UI's
+        dist/ at /ui; SURVEY.md §2.9)."""
+        import pathlib
+
+        ui = pathlib.Path(__file__).with_name("ui.html")
+        body = ui.read_bytes()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/html; charset=utf-8")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
 
     @staticmethod
     def _send(handler, code: int, body: dict,

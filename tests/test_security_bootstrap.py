@@ -1,0 +1,127 @@
+"""Security providers, /ui dashboard, and standalone bootstrap tests
+(upstream servlet/security + KafkaCruiseControlMain tier; SURVEY.md §2.7)."""
+
+import json
+import time
+import urllib.request
+
+from cruise_control_tpu.server.security import (
+    BasicSecurityProvider,
+    JwtSecurityProvider,
+    SpnegoSecurityProvider,
+    TrustedProxySecurityProvider,
+)
+
+
+class Headers(dict):
+    def get(self, k, default=None):  # case-exact is fine for tests
+        return super().get(k, default)
+
+
+def test_jwt_provider_roundtrip():
+    p = JwtSecurityProvider(b"secret", audience="cc")
+    tok = JwtSecurityProvider.issue(
+        b"secret", {"sub": "op", "aud": "cc", "exp": time.time() + 60}
+    )
+    assert p.authenticate_request(
+        Headers({"Authorization": f"Bearer {tok}"}), ("127.0.0.1", 1)
+    )
+    # wrong secret / expired / wrong audience / garbage all fail
+    bad = JwtSecurityProvider.issue(b"other", {"aud": "cc"})
+    assert not p.authenticate_request(
+        Headers({"Authorization": f"Bearer {bad}"}), None
+    )
+    expired = JwtSecurityProvider.issue(
+        b"secret", {"aud": "cc", "exp": time.time() - 1}
+    )
+    assert not p.authenticate_request(
+        Headers({"Authorization": f"Bearer {expired}"}), None
+    )
+    wrong_aud = JwtSecurityProvider.issue(
+        b"secret", {"aud": "nope", "exp": time.time() + 60}
+    )
+    assert not p.authenticate_request(
+        Headers({"Authorization": f"Bearer {wrong_aud}"}), None
+    )
+    assert not p.authenticate_request(
+        Headers({"Authorization": "Bearer not.a.jwt"}), None
+    )
+
+
+def test_trusted_proxy_provider():
+    p = TrustedProxySecurityProvider(
+        {"10.0.0.1"}, allowed_users=["alice"]
+    )
+    h = Headers({"X-Forwarded-User": "alice"})
+    assert p.authenticate_request(h, ("10.0.0.1", 999))
+    assert not p.authenticate_request(h, ("10.0.0.2", 999))
+    assert not p.authenticate_request(Headers({}), ("10.0.0.1", 999))
+    assert not p.authenticate_request(
+        Headers({"X-Forwarded-User": "mallory"}), ("10.0.0.1", 999)
+    )
+
+
+def test_spnego_fails_closed():
+    p = SpnegoSecurityProvider()
+    assert not p.authenticate_request(Headers({}), ("127.0.0.1", 1))
+
+
+def test_basic_provider_spi_signature():
+    p = BasicSecurityProvider({"u": "pw"})
+    import base64
+
+    h = Headers(
+        {"Authorization": "Basic " + base64.b64encode(b"u:pw").decode()}
+    )
+    assert p.authenticate_request(h, ("127.0.0.1", 1))
+
+
+def test_bootstrap_serves_rest_and_ui():
+    """Full standalone app: build, start, drive REST + /ui, shut down."""
+    from cruise_control_tpu.bootstrap import build_app
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+
+    cfg = CruiseControlConfig({
+        "simulation.num.brokers": 6,
+        "simulation.num.partitions": 24,
+        "metric.sampling.interval.ms": 1000,
+        "partition.metrics.window.ms": 1000,
+        "use.tpu.optimizer": "false",
+    })
+    app = build_app(cfg, port=0)
+    try:
+        app.server.start()
+        # feed a few metric windows so the model is generatable
+        for w in range(3):
+            app.reporter.report(time_ms=w * 1000 + 500)
+        app.fetcher_manager.fetch_once(now_ms=4000)
+        base = app.server.url
+
+        state = json.load(urllib.request.urlopen(f"{base}/state"))
+        assert state["MonitorState"]["state"] == "RUNNING"
+
+        ui = urllib.request.urlopen(
+            base.replace("/kafkacruisecontrol", "/ui")
+        ).read().decode()
+        assert "<title>cruise-control</title>" in ui
+
+        proposals = json.load(
+            urllib.request.urlopen(f"{base}/proposals?json=true")
+        )
+        assert "proposals" in proposals or "summary" in proposals
+    finally:
+        app.shutdown()
+
+
+def test_load_properties(tmp_path):
+    from cruise_control_tpu.bootstrap import load_properties
+
+    f = tmp_path / "cc.properties"
+    f.write_text(
+        "# comment\n! other comment\n\nwebserver.http.port=1234\n"
+        "default.goals=A,B\n"
+    )
+    props = load_properties(str(f))
+    assert props == {"webserver.http.port": "1234", "default.goals": "A,B"}
