@@ -74,13 +74,15 @@ class TpuSearchConfig:
     """
 
     max_rounds: int = 150
-    #: candidate budget per round: K source replicas × D destination brokers
-    candidate_budget: int = 1 << 23
-    max_source_replicas: int = 1 << 16
+    #: candidate budget per round: K source replicas × D destination brokers.
+    #: Pools re-rank every step, so modest pools lose little quality while
+    #: the per-step rescore cost scales linearly with the budget.
+    candidate_budget: int = 1 << 21
+    max_source_replicas: int = 8192
     #: top-k candidates returned from device per round; the host exact-recheck
     #: commits as many of them as still improve, so this bounds the
     #: actions-per-round and therefore the number of device round-trips
-    topk_per_round: int = 1024
+    topk_per_round: int = 2048
     max_moves_per_round: int = 4096
     #: stop when the best available improvement is above this (improvements
     #: are negative deltas); also the per-action commit threshold — keeps the
@@ -100,12 +102,17 @@ class TpuSearchConfig:
     #: (ops.grid); "pallas" runs the fused VMEM kernel (ops.pallas_grid);
     #: "auto" picks pallas on TPU (single-device), grid elsewhere
     scoring: str = "auto"
-    #: device-resident search: commit this many best-action steps per device
-    #: call inside a lax.scan (rescore → argmin → apply, incrementally), so
-    #: host↔device round-trips drop T-fold.  0 disables (score-only rounds
-    #: with host-side batch commit).  Single-device engines only; the host
-    #: still exact-rechecks every returned action before accepting it.
-    steps_per_call: int = 128
+    #: device-resident search: run this many (rescore → select → apply)
+    #: steps per device call inside a lax.while_loop, so host↔device
+    #: round-trips drop T-fold.  0 disables (score-only rounds with
+    #: host-side batch commit).  Single-device engines only; the host still
+    #: exact-rechecks every returned action before accepting it.
+    steps_per_call: int = 32
+    #: conflict-free actions committed per device step: the top candidates
+    #: are greedily filtered to disjoint (src broker, dst broker, partition)
+    #: sets, whose deltas are exactly independent — one rescore then commits
+    #: up to this many actions instead of one
+    device_batch_per_step: int = 64
 
 
 # ---------------------------------------------------------------------------------
@@ -417,6 +424,123 @@ def _build_round_candidates(
 # Device-resident search: score → argmin → apply, entirely on device (lax.scan)
 # ---------------------------------------------------------------------------------
 
+def _candidate_endpoints(m: DeviceModel, is_move, p, s, d):
+    """(src broker, dst broker) of each decoded candidate ([N] arrays)."""
+    slot_b = m.assignment[p, s]
+    leader_b = jnp.take_along_axis(
+        m.assignment[p], m.leader_slot[p][:, None], axis=1
+    )[:, 0]
+    src = jnp.where(is_move, slot_b, leader_b)
+    dst = jnp.where(is_move, d, slot_b)
+    return src, dst
+
+
+def _select_disjoint(scores, src, dst, p, tol: float, M: int, B: int, P: int):
+    """Greedy conflict-free selection: walk candidates best-first, take those
+    whose src broker, dst broker, AND partition are all untouched so far
+    (≤ M).  Partition disjointness makes the applied placement/aggregate
+    deltas exact; broker disjointness keeps each taken candidate's *score*
+    (incl. capacity feasibility) valid against the pre-batch state.  This
+    deliberately serializes evacuations off one dead broker to one per step:
+    their destinations are chosen under a forced bias that bypasses the
+    improvement gate, so each needs a fresh rescore — batching them with
+    pre-batch scores measurably regresses the final violation score.  Drain
+    throughput comes from the call budget instead (see optimize()).
+
+    ``scores`` is ascending, so the walk exits as soon as the batch fills, a
+    score fails ``tol`` (every later one fails too), or a long run of
+    conflicts yields nothing (a drain round ranks thousands of same-src
+    evacuations first — without the stall bound the walk would visit all N
+    every step) — typically touching only the first ~M of the N candidates."""
+    N = scores.shape[0]
+    stall_limit = max(4 * M, 64)
+
+    def cond(carry):
+        _, _, count, i, stall, _ = carry
+        return (
+            (i < N)
+            & (count < M)
+            & (stall < stall_limit)
+            & (scores[jnp.clip(i, 0, N - 1)] < tol)
+        )
+
+    def body(carry):
+        used_b, used_p, count, i, stall, take = carry
+        si, di, pi = jnp.clip(src[i], 0), jnp.clip(dst[i], 0), jnp.clip(p[i], 0)
+        ok = ~used_b[si] & ~used_b[di] & ~used_p[pi]
+        used_b = used_b.at[si].set(used_b[si] | ok)
+        used_b = used_b.at[di].set(used_b[di] | ok)
+        used_p = used_p.at[pi].set(used_p[pi] | ok)
+        return (
+            used_b, used_p, count + ok.astype(jnp.int32), i + 1,
+            jnp.where(ok, 0, stall + 1),
+            take.at[i].set(ok),
+        )
+
+    _, _, count, _, _, take = jax.lax.while_loop(
+        cond,
+        body,
+        (
+            jnp.zeros(B, bool), jnp.zeros(P, bool), jnp.int32(0),
+            jnp.int32(0), jnp.int32(0), jnp.zeros(N, bool),
+        ),
+    )
+    return take, count
+
+
+def _apply_batch_on_device(
+    m: DeviceModel,
+    take: jax.Array,     # bool [N] — which candidates to commit
+    is_move: jax.Array,  # bool [N]
+    p: jax.Array, s: jax.Array, d: jax.Array,  # int32 [N]
+    src: jax.Array, dst: jax.Array,  # int32 [N] — from _candidate_endpoints
+) -> DeviceModel:
+    """Vectorized twin of :func:`_apply_on_device` for a disjoint batch: all
+    aggregate updates collapse into segment-sums; placement updates scatter
+    with ``mode="drop"`` for unselected rows.  ``src``/``dst`` must be the
+    :func:`_candidate_endpoints` of exactly the candidates that
+    :func:`_select_disjoint` keyed its conflict sets on."""
+    P, S = m.assignment.shape
+    B = m.capacity.shape[0]
+    lslot = m.leader_slot[p]
+    leader_now = lslot == s
+
+    lnwin_p = m.leader_load[p, Resource.NW_IN]
+    nwout_p = m.leader_load[p, Resource.NW_OUT]
+    move_load = jnp.where(leader_now[:, None], m.leader_load[p], m.follower_load[p])
+    lead_delta = m.leader_load[p] - m.follower_load[p]
+
+    gate = take.astype(jnp.float32)
+    dload = jnp.where(is_move[:, None], move_load, lead_delta) * gate[:, None]
+    dlnwin = jnp.where(is_move & ~leader_now, 0.0, lnwin_p) * gate
+    dpot = jnp.where(is_move, nwout_p, 0.0) * gate
+    drc = jnp.where(is_move, 1.0, 0.0) * gate
+    dlc = jnp.where(is_move & ~leader_now, 0.0, 1.0) * gate
+
+    ids = jnp.concatenate([jnp.clip(src, 0), jnp.clip(dst, 0)])
+
+    def seg(contrib):
+        return jax.ops.segment_sum(contrib, ids, num_segments=B)
+
+    load_delta = seg(
+        jnp.concatenate([-dload, dload], axis=0)
+    )
+    # placement scatters: unselected rows target row P (dropped)
+    pm = jnp.where(take & is_move, p, P)
+    pl = jnp.where(take & ~is_move, p, P)
+    return dataclasses.replace(
+        m,
+        assignment=m.assignment.at[pm, s].set(d, mode="drop"),
+        leader_slot=m.leader_slot.at[pl].set(s, mode="drop"),
+        must_move=m.must_move.at[pm, s].set(False, mode="drop"),
+        broker_load=m.broker_load + load_delta,
+        leader_nwin=m.leader_nwin + seg(jnp.concatenate([-dlnwin, dlnwin])),
+        pot_nwout=m.pot_nwout + seg(jnp.concatenate([-dpot, dpot])),
+        rcount=m.rcount + seg(jnp.concatenate([-drc, drc])),
+        lcount=m.lcount + seg(jnp.concatenate([-dlc, dlc])),
+    )
+
+
 def _apply_on_device(
     m: DeviceModel,
     apply: jax.Array,    # bool — gate (False = no-op step)
@@ -479,42 +603,71 @@ def _apply_on_device(
 
 @functools.lru_cache(maxsize=64)
 def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
-    """Compiled device-resident search: T (score → argmin → apply) steps per
-    call.  Returns (packed [5, T] committed actions, updated model) — the
-    host replays the sequence through the exact evaluator and reuses the
-    returned model when every action validates (the common case)."""
+    """Compiled device-resident search: up to T (rescore → select-disjoint →
+    batch-apply) steps per call, each committing ≤ device_batch_per_step
+    conflict-free actions, exiting early on convergence (lax.while_loop).
+
+    Returns (packed [5, T·M] actions in commit order — unused slots +inf,
+    done flag, updated model).  The host replays the sequence through the
+    exact evaluator and reuses the returned model when every action
+    validates (the common case)."""
     from cruise_control_tpu.ops.grid import move_grid_scores
 
     use_pallas = _resolve_scoring(cfg, None) == "pallas"
     if use_pallas:
         from cruise_control_tpu.ops.pallas_grid import move_grid_scores_pallas
+    M = cfg.device_batch_per_step
 
-    def step(carry, _):
-        m, ca, done = carry
-        S = m.assignment.shape[1]
+    def step(carry):
+        m, ca, done, t, out = carry
+        P = m.assignment.shape[0]
+        B = m.capacity.shape[0]
         grid_fn = move_grid_scores_pallas if use_pallas else move_grid_scores
-        scores, kp, ks, dest_pool = _merged_scores(m, cfg, ca, K, D, grid_fn)
-        idx = jnp.argmin(scores)
-        best = scores[idx]
-        is_move, kind, p, s, d = _decode_flat_idx(idx, K, D, S, kp, ks,
-                                                  dest_pool)
-        improve = (best < cfg.improvement_tol) & ~done
-        m = _apply_on_device(m, improve, is_move, p, s, d)
-        out = jnp.stack(
-            [
-                jnp.where(improve, best, jnp.inf).astype(jnp.float32),
-                kind.astype(jnp.float32),
-                p.astype(jnp.float32),
-                s.astype(jnp.float32),
-                d.astype(jnp.float32),
-            ]
+        scores, kp, ks, dest_pool, lp, lsl = _merged_scores(
+            m, cfg, ca, K, D, grid_fn
         )
-        return (m, ca, done | ~improve), out
+        k = min(cfg.topk_per_round, scores.shape[0])
+        vals, idx = jax.lax.top_k(-scores, k)
+        vals = -vals
+        is_move, kind, p, s, d = _decode_flat_idx(idx, K, D, kp, ks,
+                                                  dest_pool, lp, lsl)
+        src, dst = _candidate_endpoints(m, is_move, p, s, d)
+        take, count = _select_disjoint(
+            vals, src, dst, p, cfg.improvement_tol, M, B, P
+        )
+        m = _apply_batch_on_device(m, take, is_move, p, s, d, src, dst)
+        # pack the ≤M taken candidates (commit order = score order: vals is
+        # ascending, so taken-in-index-order is best-first) into the out
+        # buffer columns [t*M, t*M+M)
+        order = jnp.argsort(jnp.where(take, jnp.arange(k), k))[:M]
+        sel_ok = take[order]
+        batch = jnp.stack(
+            [
+                jnp.where(sel_ok, vals[order], jnp.inf).astype(jnp.float32),
+                kind[order].astype(jnp.float32),
+                p[order].astype(jnp.float32),
+                s[order].astype(jnp.float32),
+                d[order].astype(jnp.float32),
+            ]
+        )                                                # [5, M]
+        out = jax.lax.dynamic_update_slice(out, batch, (0, t * M))
+        return (m, ca, done | (count == 0), t + 1, out)
+
+    def cond(carry):
+        _, _, done, t, _ = carry
+        return (~done) & (t < T)
 
     def run(m: DeviceModel, ca):
-        (m, _, _), outs = jax.lax.scan(step, (m, ca, jnp.bool_(False)),
-                                       xs=None, length=T)
-        return outs.T, m
+        out0 = jnp.full((5, T * M), jnp.inf, jnp.float32)
+        m, _, done, _, out = jax.lax.while_loop(
+            cond, step, (m, ca, jnp.bool_(False), jnp.int32(0), out0)
+        )
+        # done flag rides the packed array's last column (row 0) so the host
+        # pays ONE transfer per call
+        flag = jnp.full((5, 1), jnp.inf, jnp.float32).at[0, 0].set(
+            jnp.where(done, 1.0, 0.0)
+        )
+        return jnp.concatenate([out, flag], axis=1), m
 
     return jax.jit(run)
 
@@ -675,7 +828,11 @@ def _pack_round_result(scores, kind, cp, cs, cd) -> jax.Array:
 def _unpack_round_result(packed) -> Tuple:
     """Host-side inverse of :func:`_pack_round_result` (numpy in, numpy out)."""
     scores = packed[0]
-    kind, cp, cs, cd = (packed[i].astype(np.int32) for i in range(1, 5))
+    # unused slots carry +inf in every row; cast them to -1, not UB
+    kind, cp, cs, cd = (
+        np.where(np.isfinite(packed[i]), packed[i], -1).astype(np.int32)
+        for i in range(1, 5)
+    )
     return scores, kind, cp, cs, cd
 
 
@@ -689,38 +846,81 @@ def _resolve_scoring(cfg: TpuSearchConfig, mesh) -> str:
     return "grid"
 
 
-def _leadership_grid(P: int, S: int) -> Tuple[jax.Array, jax.Array]:
-    ps = jnp.arange(P * S, dtype=jnp.int32)
-    return ps // S, ps % S
+def _leadership_pool_size(P: int, S: int, K: int) -> int:
+    """Static leadership-pool size: full grid for small models, pruned to
+    the move-pool scale for large ones (the P·S axis is the step-cost
+    driver at the 1M-partition scale)."""
+    return min(P * S, max(2 * K, 8192))
+
+
+def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-L leadership candidates (p, s) by the current leader broker's
+    stress — the analog of the move source pool.  Priority: max resource
+    utilization of the leader's broker + its leader-NW-in utilization
+    (what a leadership transfer can actually relieve)."""
+    P, S = m.assignment.shape
+    lb = jnp.take_along_axis(m.assignment, m.leader_slot[:, None], axis=1)[:, 0]
+    lb_c = jnp.clip(lb, 0)
+    cap = jnp.maximum(m.capacity, 1e-9)
+    util = m.broker_load / cap                              # [B, R]
+    # leader-count pressure keeps lcount-bound repairs in the pool even when
+    # the overloaded leader's partitions are tiny (near-zero util / NW-in)
+    lc_over = jnp.maximum(m.lcount - ca["lcount_upper"], 0.0) / jnp.maximum(
+        ca["lcount_upper"], 1.0
+    )
+    lc_need = jnp.maximum(ca["lcount_lower"] - m.lcount, 0.0) / jnp.maximum(
+        ca["lcount_lower"], 1.0
+    )
+    stress = (
+        jnp.max(util, axis=1) + m.leader_nwin / cap[:, Resource.NW_IN] + lc_over
+    )
+    # src relief (current leader's broker) + dst need (slot's broker)
+    prio = stress[lb_c][:, None] + lc_need[jnp.clip(m.assignment, 0)]  # [P, S]
+    # mirror lead_feasible's static terms (_score_candidates) so the pruned
+    # pool never fills with always-infeasible candidates, starving feasible
+    # transfers that the full grid would have scored
+    valid = (
+        (m.assignment != EMPTY_SLOT)
+        & (jnp.arange(S)[None, :] != m.leader_slot[:, None])
+        & ~m.excluded[:, None]
+        & ~m.must_move
+        & m.lead_ok[jnp.clip(m.assignment, 0)]
+    )
+    flat = jnp.where(valid, prio, -jnp.inf).reshape(-1)
+    _, idx = jax.lax.top_k(flat, L)
+    return (idx // S).astype(jnp.int32), (idx % S).astype(jnp.int32)
 
 
 def _merged_scores(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
                    grid_fn):
-    """Move grid + full leadership scores flattened into one score vector.
+    """Move grid + pruned leadership scores flattened into one vector.
 
     Layout: index i < K·D is move (source kp[i//D], ks[i//D] → dest[i%D]);
-    i >= K·D is leadership transfer (partition (i-K·D)//S to slot (i-K·D)%S).
-    Shared by the scan step and the score-only round path — keep the decode
+    i >= K·D is leadership transfer (lp[i-K·D], ls[i-K·D]).  Shared by the
+    scan step and the score-only round path — keep the decode
     (:func:`_decode_flat_idx`) in lockstep with this layout.
     """
     P, S = m.assignment.shape
     kp, ks, dest_pool = _build_round_pools(m, ca, K, D)
     g = grid_fn(m, cfg, ca, kp, ks, dest_pool)
-    lp, lsl = _leadership_grid(P, S)
+    L = _leadership_pool_size(P, S, K)
+    lp, lsl = _leadership_pool(m, ca, L)
     l_scores, _ = _score_candidates(
-        m, cfg, ca, jnp.ones(P * S, jnp.int32), lp, lsl,
-        jnp.zeros(P * S, jnp.int32),
+        m, cfg, ca, jnp.ones(L, jnp.int32), lp, lsl, jnp.zeros(L, jnp.int32)
     )
-    return jnp.concatenate([g.reshape(-1), l_scores]), kp, ks, dest_pool
+    return (
+        jnp.concatenate([g.reshape(-1), l_scores]), kp, ks, dest_pool, lp, lsl
+    )
 
 
-def _decode_flat_idx(idx, K: int, D: int, S: int, kp, ks, dest_pool):
+def _decode_flat_idx(idx, K: int, D: int, kp, ks, dest_pool, lp, lsl):
     """Inverse of the :func:`_merged_scores` layout → (kind, p, s, d)."""
+    L = lp.shape[0]
     is_move = idx < K * D
     ki = jnp.clip(idx // D, 0, K - 1)
-    li = jnp.clip(idx - K * D, 0)
-    p = jnp.where(is_move, kp[ki], li // S).astype(jnp.int32)
-    s = jnp.where(is_move, ks[ki], li % S).astype(jnp.int32)
+    li = jnp.clip(idx - K * D, 0, L - 1)
+    p = jnp.where(is_move, kp[ki], lp[li]).astype(jnp.int32)
+    s = jnp.where(is_move, ks[ki], lsl[li]).astype(jnp.int32)
     d = jnp.where(
         is_move, dest_pool[jnp.clip(idx % D, 0, D - 1)], 0
     ).astype(jnp.int32)
@@ -761,15 +961,15 @@ def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
 
         def round_fn(m: DeviceModel, ca):
             # moves scored on the K×D grid (no per-candidate gathers),
-            # leaderships columnar (cheap: P*S rows); merged top-k
-            S = m.assignment.shape[1]
+            # leaderships columnar (pruned pool); merged top-k
             grid_fn = _grid_fn if _grid_fn is not None else move_grid_scores
-            scores, kp, ks, dest_pool = _merged_scores(m, cfg, ca, K, D,
-                                                       grid_fn)
+            scores, kp, ks, dest_pool, lp, lsl = _merged_scores(
+                m, cfg, ca, K, D, grid_fn
+            )
             k = min(cfg.topk_per_round, scores.shape[0])
             vals, idx = jax.lax.top_k(-scores, k)
-            _, kind, cp, cs, cd = _decode_flat_idx(idx, K, D, S, kp, ks,
-                                                   dest_pool)
+            _, kind, cp, cs, cd = _decode_flat_idx(idx, K, D, kp, ks,
+                                                   dest_pool, lp, lsl)
             return _pack_round_result(-vals, kind, cp, cs, cd)
 
     if mesh is None:
@@ -836,7 +1036,7 @@ def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
                 columnar_args=(kp, ks),
                 pad_fills=(0, 0),
             )
-            lp, lsl = _leadership_grid(P, S)
+            lp, lsl = _leadership_pool(m, ca, _leadership_pool_size(P, S, K))
             leads = sharded_columnar_topk(
                 mesh,
                 score_lead_shard,
@@ -986,20 +1186,27 @@ class TpuGoalOptimizer:
             # model is reused without re-upload; a rejection truncates the
             # batch and rebuilds device state from the live context.
             scan_fn = _cached_scan_fn(cfg, K, D, cfg.steps_per_call)
-            # same total action budget as the score-only path's rounds cap
+            # convergence exits via the device done flag / no-progress break;
+            # the bound preserves the score-only path's total action budget
+            # counted in *steps* (evacuations commit one per step), so
+            # draining a dead broker with thousands of replicas never
+            # exhausts it
             calls_budget = max(
-                1, -(cfg.max_rounds * cfg.max_moves_per_round)
-                // -cfg.steps_per_call
+                cfg.max_rounds,
+                -(cfg.max_rounds * cfg.max_moves_per_round)
+                // -cfg.steps_per_call,
             )
             for _ in range(calls_budget):
                 packed, m_new = scan_fn(m, ca)
+                arr = np.asarray(packed)
+                device_done = bool(arr[0, -1] > 0)
                 scores, k_top, p_top, s_top, d_top = _unpack_round_result(
-                    np.asarray(packed)
+                    arr[:, :-1]
                 )
                 batch, rejected = 0, 0
                 for t in range(scores.shape[0]):
                     if not np.isfinite(scores[t]):
-                        break
+                        continue  # unused slot of a partially-filled step
                     action, delta = evaluator.evaluate(
                         int(k_top[t]), int(p_top[t]), int(s_top[t]),
                         int(d_top[t]),
@@ -1018,8 +1225,8 @@ class TpuGoalOptimizer:
                     break  # nothing validated — no further progress possible
                 if not rejected:
                     m = m_new
-                    if batch < cfg.steps_per_call:
-                        break  # device converged mid-batch
+                    if device_done:
+                        break  # device search converged
                 else:
                     # device state includes skipped actions — rebuild from
                     # the live context before the next call
