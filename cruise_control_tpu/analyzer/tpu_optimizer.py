@@ -111,8 +111,9 @@ class TpuSearchConfig:
     #: conflict-free actions committed per device step: the top candidates
     #: are greedily filtered to disjoint (src broker, dst broker, partition)
     #: sets, whose deltas are exactly independent — one rescore then commits
-    #: up to this many actions instead of one
-    device_batch_per_step: int = 64
+    #: up to this many actions instead of one.  0 = auto (scales with broker
+    #: count: B//4 clamped to [32, 512])
+    device_batch_per_step: int = 0
 
 
 # ---------------------------------------------------------------------------------
@@ -384,6 +385,10 @@ def _build_round_pools(
     # evacuation overrides exclusion (greedy parity: evacuate_offline_replicas)
     eligible = slot_exists & (~m.excluded[:, None] | m.must_move)
     prio = jnp.where(eligible, prio, -jnp.inf)
+    # exact top-k: must-move (offline) replicas carry forced priority and
+    # MUST enter the pool — approx_max_k keeps one entry per bin and can
+    # deterministically drop a placeable offline replica forever (hard-goal
+    # failure); the leadership pool below uses approx (soft quality only)
     _, flat_idx = jax.lax.top_k(prio.reshape(-1), K)
     kp = (flat_idx // S).astype(jnp.int32)
     ks = (flat_idx % S).astype(jnp.int32)
@@ -424,82 +429,18 @@ def _build_round_candidates(
 # Device-resident search: score → argmin → apply, entirely on device (lax.scan)
 # ---------------------------------------------------------------------------------
 
-def _candidate_endpoints(m: DeviceModel, is_move, p, s, d):
-    """(src broker, dst broker) of each decoded candidate ([N] arrays)."""
-    slot_b = m.assignment[p, s]
-    leader_b = jnp.take_along_axis(
-        m.assignment[p], m.leader_slot[p][:, None], axis=1
-    )[:, 0]
-    src = jnp.where(is_move, slot_b, leader_b)
-    dst = jnp.where(is_move, d, slot_b)
-    return src, dst
-
-
-def _select_disjoint(scores, src, dst, p, tol: float, M: int, B: int, P: int):
-    """Greedy conflict-free selection: walk candidates best-first, take those
-    whose src broker, dst broker, AND partition are all untouched so far
-    (≤ M).  Partition disjointness makes the applied placement/aggregate
-    deltas exact; broker disjointness keeps each taken candidate's *score*
-    (incl. capacity feasibility) valid against the pre-batch state.  This
-    deliberately serializes evacuations off one dead broker to one per step:
-    their destinations are chosen under a forced bias that bypasses the
-    improvement gate, so each needs a fresh rescore — batching them with
-    pre-batch scores measurably regresses the final violation score.  Drain
-    throughput comes from the call budget instead (see optimize()).
-
-    ``scores`` is ascending, so the walk exits as soon as the batch fills, a
-    score fails ``tol`` (every later one fails too), or a long run of
-    conflicts yields nothing (a drain round ranks thousands of same-src
-    evacuations first — without the stall bound the walk would visit all N
-    every step) — typically touching only the first ~M of the N candidates."""
-    N = scores.shape[0]
-    stall_limit = max(4 * M, 64)
-
-    def cond(carry):
-        _, _, count, i, stall, _ = carry
-        return (
-            (i < N)
-            & (count < M)
-            & (stall < stall_limit)
-            & (scores[jnp.clip(i, 0, N - 1)] < tol)
-        )
-
-    def body(carry):
-        used_b, used_p, count, i, stall, take = carry
-        si, di, pi = jnp.clip(src[i], 0), jnp.clip(dst[i], 0), jnp.clip(p[i], 0)
-        ok = ~used_b[si] & ~used_b[di] & ~used_p[pi]
-        used_b = used_b.at[si].set(used_b[si] | ok)
-        used_b = used_b.at[di].set(used_b[di] | ok)
-        used_p = used_p.at[pi].set(used_p[pi] | ok)
-        return (
-            used_b, used_p, count + ok.astype(jnp.int32), i + 1,
-            jnp.where(ok, 0, stall + 1),
-            take.at[i].set(ok),
-        )
-
-    _, _, count, _, _, take = jax.lax.while_loop(
-        cond,
-        body,
-        (
-            jnp.zeros(B, bool), jnp.zeros(P, bool), jnp.int32(0),
-            jnp.int32(0), jnp.int32(0), jnp.zeros(N, bool),
-        ),
-    )
-    return take, count
-
-
 def _apply_batch_on_device(
     m: DeviceModel,
     take: jax.Array,     # bool [N] — which candidates to commit
     is_move: jax.Array,  # bool [N]
     p: jax.Array, s: jax.Array, d: jax.Array,  # int32 [N]
-    src: jax.Array, dst: jax.Array,  # int32 [N] — from _candidate_endpoints
+    src: jax.Array, dst: jax.Array,  # int32 [N] — candidate endpoints
 ) -> DeviceModel:
     """Vectorized twin of :func:`_apply_on_device` for a disjoint batch: all
     aggregate updates collapse into segment-sums; placement updates scatter
     with ``mode="drop"`` for unselected rows.  ``src``/``dst`` must be the
-    :func:`_candidate_endpoints` of exactly the candidates that
-    :func:`_select_disjoint` keyed its conflict sets on."""
+    endpoint brokers of exactly the candidates that :func:`_match_batch`
+    keyed its conflict sets on."""
     P, S = m.assignment.shape
     B = m.capacity.shape[0]
     lslot = m.leader_slot[p]
@@ -620,37 +561,57 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
 
     def step(carry):
         m, ca, done, t, out = carry
-        P = m.assignment.shape[0]
+        P, S = m.assignment.shape
         B = m.capacity.shape[0]
+        M_ = min(M, 2 * B)
         grid_fn = move_grid_scores_pallas if use_pallas else move_grid_scores
-        scores, kp, ks, dest_pool, lp, lsl = _merged_scores(
-            m, cfg, ca, K, D, grid_fn
+        kp, ks, _row_scores, brow, b_scores, best_d, lp, lsl, l_scores = (
+            _reduced_candidates(m, cfg, ca, K, D, grid_fn)
         )
-        k = min(cfg.topk_per_round, scores.shape[0])
-        vals, idx = jax.lax.top_k(-scores, k)
+        bl_score, bl_p, bl_s, bl_dst = _reduce_leadership_per_src(
+            m, lp, lsl, l_scores
+        )
+        R = b_scores.shape[1]
+        # matcher input: rows [0, B) = per-src-broker best move with R
+        # alternate dests; rows [B, 2B) = per-leader-broker best transfer
+        inf_pad = jnp.full((B, R - 1), jnp.inf, b_scores.dtype)
+        cand_score = jnp.concatenate(
+            [b_scores, jnp.concatenate([bl_score[:, None], inf_pad], axis=1)]
+        )                                                 # [2B, R]
+        cand_dst = jnp.concatenate(
+            [best_d[brow], jnp.broadcast_to(bl_dst[:, None], (B, R))]
+        )
+        arange_b = jnp.arange(B, dtype=jnp.int32)
+        cand_src = jnp.concatenate([arange_b, arange_b])
+        cand_p = jnp.concatenate([kp[brow], bl_p])
+        cand_s = jnp.concatenate([ks[brow], bl_s])
+        is_move_row = jnp.arange(2 * B) < B
+        take, win_score, win_dst = _match_batch(
+            cand_score, cand_dst, cand_src, cand_p, cfg.improvement_tol, B, P
+        )
+        # cap to the M_ best matches (the packed slot budget); commit order =
+        # score order
+        vals, order = jax.lax.top_k(-jnp.where(take, win_score, jnp.inf), M_)
         vals = -vals
-        is_move, kind, p, s, d = _decode_flat_idx(idx, K, D, kp, ks,
-                                                  dest_pool, lp, lsl)
-        src, dst = _candidate_endpoints(m, is_move, p, s, d)
-        take, count = _select_disjoint(
-            vals, src, dst, p, cfg.improvement_tol, M, B, P
+        sel_ok = jnp.isfinite(vals)
+        take_f = jnp.zeros(2 * B, bool).at[order].max(sel_ok)
+        count = jnp.sum(sel_ok.astype(jnp.int32))
+        m = _apply_batch_on_device(
+            m, take_f, is_move_row, cand_p, cand_s, win_dst,
+            cand_src, win_dst,
         )
-        m = _apply_batch_on_device(m, take, is_move, p, s, d, src, dst)
-        # pack the ≤M taken candidates (commit order = score order: vals is
-        # ascending, so taken-in-index-order is best-first) into the out
-        # buffer columns [t*M, t*M+M)
-        order = jnp.argsort(jnp.where(take, jnp.arange(k), k))[:M]
-        sel_ok = take[order]
         batch = jnp.stack(
             [
-                jnp.where(sel_ok, vals[order], jnp.inf).astype(jnp.float32),
-                kind[order].astype(jnp.float32),
-                p[order].astype(jnp.float32),
-                s[order].astype(jnp.float32),
-                d[order].astype(jnp.float32),
+                jnp.where(sel_ok, vals, jnp.inf).astype(jnp.float32),
+                jnp.where(
+                    is_move_row[order], KIND_MOVE, KIND_LEADERSHIP
+                ).astype(jnp.float32),
+                cand_p[order].astype(jnp.float32),
+                cand_s[order].astype(jnp.float32),
+                win_dst[order].astype(jnp.float32),
             ]
-        )                                                # [5, M]
-        out = jax.lax.dynamic_update_slice(out, batch, (0, t * M))
+        )                                                # [5, M_]
+        out = jax.lax.dynamic_update_slice(out, batch, (0, t * M_))
         return (m, ca, done | (count == 0), t + 1, out)
 
     def cond(carry):
@@ -658,7 +619,8 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         return (~done) & (t < T)
 
     def run(m: DeviceModel, ca):
-        out0 = jnp.full((5, T * M), jnp.inf, jnp.float32)
+        M_ = min(M, 2 * m.capacity.shape[0])
+        out0 = jnp.full((5, T * M_), jnp.inf, jnp.float32)
         m, _, done, _, out = jax.lax.while_loop(
             cond, step, (m, ca, jnp.bool_(False), jnp.int32(0), out0)
         )
@@ -849,8 +811,9 @@ def _resolve_scoring(cfg: TpuSearchConfig, mesh) -> str:
 def _leadership_pool_size(P: int, S: int, K: int) -> int:
     """Static leadership-pool size: full grid for small models, pruned to
     the move-pool scale for large ones (the P·S axis is the step-cost
-    driver at the 1M-partition scale)."""
-    return min(P * S, max(2 * K, 8192))
+    driver at the 1M-partition scale; only a handful of transfers commit
+    per step, so recall — not coverage — sizes the pool)."""
+    return min(P * S, max(K, 4096))
 
 
 def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
@@ -887,42 +850,192 @@ def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
         & m.lead_ok[jnp.clip(m.assignment, 0)]
     )
     flat = jnp.where(valid, prio, -jnp.inf).reshape(-1)
-    _, idx = jax.lax.top_k(flat, L)
+    # approximate pool selection — see the note in _build_round_pools
+    _, idx = jax.lax.approx_max_k(flat, L)
     return (idx // S).astype(jnp.int32), (idx % S).astype(jnp.int32)
 
 
-def _merged_scores(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
-                   grid_fn):
-    """Move grid + pruned leadership scores flattened into one vector.
+#: alternate destinations kept per src broker after the reductions below
+#: (fallbacks tried by the batch matcher when a better-scored source takes
+#: the same destination in the same step)
+DESTS_PER_SOURCE = 8
 
-    Layout: index i < K·D is move (source kp[i//D], ks[i//D] → dest[i%D]);
-    i >= K·D is leadership transfer (lp[i-K·D], ls[i-K·D]).  Shared by the
-    scan step and the score-only round path — keep the decode
-    (:func:`_decode_flat_idx`) in lockstep with this layout.
+
+def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
+                        D: int, grid_fn):
+    """Per-src-broker move candidates + pruned leadership candidates.
+
+    The disjoint batch commit takes at most ONE move per src broker per
+    step, so the only move candidates worth ranking are each src broker's
+    best (replica, dest): the raw K×D grid's global top-k concentrates on a
+    few hot brokers × many near-equivalent candidates, all conflicting, and
+    collapses commits per rescore to a handful.  The grid is reduced in two
+    stages: best ``DESTS_PER_SOURCE`` dests per source row (top-k over D),
+    then best row per src broker (scatter-min over rows).
+
+    Returns (kp, ks, row_scores [K, R], brow [B], b_scores [B, R],
+    best_d [K, R], lp, lsl, l_scores); ``b_scores`` carries +inf rows for
+    brokers with no candidate.
     """
     P, S = m.assignment.shape
+    B = m.capacity.shape[0]
+    R = min(DESTS_PER_SOURCE, D)
     kp, ks, dest_pool = _build_round_pools(m, ca, K, D)
-    g = grid_fn(m, cfg, ca, kp, ks, dest_pool)
+    g = grid_fn(m, cfg, ca, kp, ks, dest_pool)          # [K, D]
+    neg_best, best_i = jax.lax.top_k(-g, R)             # [K, R]
+    best_d = dest_pool[best_i]                          # [K, R] broker ids
+    row_best = -neg_best[:, 0]                          # [K]
+    sb = jnp.clip(m.assignment[kp, ks], 0)              # [K] src broker/row
+    seg_best = jnp.full(B, jnp.inf).at[sb].min(row_best)
+    # lowest row index among each broker's min-score rows (deterministic)
+    brow = jnp.full(B, K, jnp.int32).at[sb].min(
+        jnp.where(
+            row_best <= seg_best[sb], jnp.arange(K, dtype=jnp.int32), K
+        )
+    )
+    valid = brow < K
+    brow = jnp.clip(brow, 0, K - 1)
+    b_scores = jnp.where(
+        valid[:, None], -neg_best[brow], jnp.inf
+    )                                                   # [B, R]
     L = _leadership_pool_size(P, S, K)
     lp, lsl = _leadership_pool(m, ca, L)
     l_scores, _ = _score_candidates(
         m, cfg, ca, jnp.ones(L, jnp.int32), lp, lsl, jnp.zeros(L, jnp.int32)
     )
+    return kp, ks, -neg_best, brow, b_scores, best_d, lp, lsl, l_scores
+
+
+def _merged_scores(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
+                   grid_fn):
+    """Score-only round path's flat vector over the reduced candidates.
+
+    PER-SOURCE layout — one entry per pool replica × R alternate dests, NOT
+    the per-src-broker reduction the device scan batches on: the score-only
+    path's host loop rescores between commits, so it profitably commits many
+    dependent actions per round (e.g. every replica of a draining dead
+    broker), which the per-broker reduction would collapse to one.
+
+    Layout: index i < K·R is move (source kp[i//R], ks[i//R] →
+    best_d[i//R, i%R]); i >= K·R is leadership transfer (lp[i-K·R],
+    ls[i-K·R]).  Keep the decode (:func:`_decode_flat_idx`) in lockstep.
+    """
+    kp, ks, row_scores, brow, b_scores, best_d, lp, lsl, l_scores = (
+        _reduced_candidates(m, cfg, ca, K, D, grid_fn)
+    )
     return (
-        jnp.concatenate([g.reshape(-1), l_scores]), kp, ks, dest_pool, lp, lsl
+        jnp.concatenate([row_scores.reshape(-1), l_scores]),
+        kp, ks, best_d, lp, lsl,
     )
 
 
-def _decode_flat_idx(idx, K: int, D: int, kp, ks, dest_pool, lp, lsl):
-    """Inverse of the :func:`_merged_scores` layout → (kind, p, s, d)."""
+def _reduce_leadership_per_src(m: DeviceModel, lp, lsl, l_scores):
+    """Best leadership transfer per current-leader broker.
+
+    → (score [B], p [B], s [B], dst broker [B]); +inf score where a broker
+    leads no pool entry."""
+    B = m.capacity.shape[0]
     L = lp.shape[0]
-    is_move = idx < K * D
-    ki = jnp.clip(idx // D, 0, K - 1)
-    li = jnp.clip(idx - K * D, 0, L - 1)
-    p = jnp.where(is_move, kp[ki], lp[li]).astype(jnp.int32)
-    s = jnp.where(is_move, ks[ki], lsl[li]).astype(jnp.int32)
+    lb = jnp.take_along_axis(
+        m.assignment[lp], m.leader_slot[lp][:, None], axis=1
+    )[:, 0]
+    lb_c = jnp.clip(lb, 0)
+    seg = jnp.full(B, jnp.inf).at[lb_c].min(l_scores)
+    row = jnp.full(B, L, jnp.int32).at[lb_c].min(
+        jnp.where(
+            l_scores <= seg[lb_c], jnp.arange(L, dtype=jnp.int32), L
+        )
+    )
+    ok = row < L
+    row_c = jnp.clip(row, 0, L - 1)
+    score = jnp.where(ok, l_scores[row_c], jnp.inf)
+    p, s = lp[row_c], lsl[row_c]
+    return score, p, s, jnp.clip(m.assignment[p, s], 0)
+
+
+def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
+                 P: int):
+    """Parallel auction matching candidates to disjoint broker/partition sets.
+
+    Each candidate is one src broker's best action with A alternate
+    destinations, best-first.  Per round, every unmatched candidate proposes
+    its current alternate; the lowest-score proposal per destination wins
+    (ties to the lowest candidate index); a loser advances to its next
+    alternate only once the destination it lost is actually used — so the
+    advance never skips a still-free destination.  A rounds of [N]-vector
+    ops replace the sequential conflict walk, and the match size approaches
+    the number of free destinations instead of collapsing to a handful.
+
+    cand_score/cand_dst [N, A]; cand_src/cand_p [N]
+    → (take [N] bool, win_score [N], win_dst [N])
+    """
+    N, A = cand_score.shape
+    idx_n = jnp.arange(N, dtype=jnp.int32)
+    p_c = jnp.clip(cand_p, 0)
+
+    def round_fn(carry, _):
+        take, used_dst, used_p, used_src, ptr, win_score, win_dst = carry
+        pa = jnp.clip(ptr, 0, A - 1)
+        cur_s = cand_score[idx_n, pa]
+        cur_d = jnp.clip(cand_dst[idx_n, pa], 0)
+        # src and dst conflict sets are deliberately SEPARATE: a broker may
+        # be one action's dest and another's src in the same batch.  Every
+        # per-broker cost term is convex in the broker's aggregates, so a
+        # same-batch overlap shifts the second action's endpoint in the
+        # direction that can only IMPROVE its realized delta (removal from a
+        # higher base / addition to a relieved base beats its pre-batch
+        # score for convex f) — pre-batch scores understate, never
+        # overstate, and the improvement gate stays sound.  Same-dst and
+        # same-src overlaps (where scores could overstate) stay excluded.
+        active = (
+            ~take & (ptr < A) & (cur_s < tol)
+            & ~used_src[cand_src] & ~used_p[p_c]
+        )
+        prop = active & ~used_dst[cur_d]
+        best = jnp.full(B, jnp.inf).at[cur_d].min(
+            jnp.where(prop, cur_s, jnp.inf)
+        )
+        win = prop & (cur_s <= best[cur_d])
+        for ids, size in ((cur_d, B), (cand_src, B), (p_c, P)):
+            fmin = jnp.full(size, N, jnp.int32).at[ids].min(
+                jnp.where(win, idx_n, N)
+            )
+            win = win & (idx_n == fmin[ids])
+        take = take | win
+        used_dst = used_dst.at[cur_d].max(win)
+        used_src = used_src.at[cand_src].max(win)
+        used_p = used_p.at[p_c].max(win)
+        win_score = jnp.where(win, cur_s, win_score)
+        win_dst = jnp.where(win, cur_d, win_dst)
+        # advance only candidates whose current destination is actually used
+        # now (their loss is permanent); a loser whose provisional winner was
+        # itself eliminated by the src/partition tie-breaks keeps its alt —
+        # the destination is still free and stays its best option
+        ptr = ptr + (active & ~win & used_dst[cur_d]).astype(jnp.int32)
+        return (take, used_dst, used_p, used_src, ptr, win_score, win_dst), None
+
+    init = (
+        jnp.zeros(N, bool), jnp.zeros(B, bool), jnp.zeros(P, bool),
+        jnp.zeros(B, bool), jnp.zeros(N, jnp.int32),
+        jnp.full(N, jnp.inf), jnp.zeros(N, jnp.int32),
+    )
+    (take, _, _, _, _, win_score, win_dst), _ = jax.lax.scan(
+        round_fn, init, None, length=A
+    )
+    return take, win_score, win_dst
+
+
+def _decode_flat_idx(idx, kp, ks, best_d, lp, lsl):
+    """Inverse of the :func:`_merged_scores` layout → (kind, p, s, d)."""
+    K, R = best_d.shape
+    L = lp.shape[0]
+    is_move = idx < K * R
+    row = jnp.clip(idx // R, 0, K - 1)
+    li = jnp.clip(idx - K * R, 0, L - 1)
+    p = jnp.where(is_move, kp[row], lp[li]).astype(jnp.int32)
+    s = jnp.where(is_move, ks[row], lsl[li]).astype(jnp.int32)
     d = jnp.where(
-        is_move, dest_pool[jnp.clip(idx % D, 0, D - 1)], 0
+        is_move, best_d[row, jnp.clip(idx % R, 0, R - 1)], 0
     ).astype(jnp.int32)
     kind = jnp.where(is_move, KIND_MOVE, KIND_LEADERSHIP).astype(jnp.int32)
     return is_move, kind, p, s, d
@@ -963,13 +1076,13 @@ def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
             # moves scored on the K×D grid (no per-candidate gathers),
             # leaderships columnar (pruned pool); merged top-k
             grid_fn = _grid_fn if _grid_fn is not None else move_grid_scores
-            scores, kp, ks, dest_pool, lp, lsl = _merged_scores(
+            scores, kp, ks, best_d, lp, lsl = _merged_scores(
                 m, cfg, ca, K, D, grid_fn
             )
             k = min(cfg.topk_per_round, scores.shape[0])
             vals, idx = jax.lax.top_k(-scores, k)
-            _, kind, cp, cs, cd = _decode_flat_idx(idx, K, D, kp, ks,
-                                                   dest_pool, lp, lsl)
+            _, kind, cp, cs, cd = _decode_flat_idx(idx, kp, ks,
+                                                   best_d, lp, lsl)
             return _pack_round_result(-vals, kind, cp, cs, cd)
 
     if mesh is None:
@@ -1185,6 +1298,14 @@ class TpuGoalOptimizer:
             # check is the f64 twin of the device math), the device-updated
             # model is reused without re-upload; a rejection truncates the
             # batch and rebuilds device state from the live context.
+            if cfg.device_batch_per_step == 0:
+                # auto: the disjointness cap scales with broker count, so the
+                # useful batch does too — large clusters need big batches to
+                # keep (rescores per committed action) low, small clusters
+                # can't fill them
+                cfg = dataclasses.replace(
+                    cfg, device_batch_per_step=int(np.clip(B // 4, 32, 512))
+                )
             scan_fn = _cached_scan_fn(cfg, K, D, cfg.steps_per_call)
             # convergence exits via the device done flag / no-progress break;
             # the bound preserves the score-only path's total action budget

@@ -181,7 +181,7 @@ def test_engine_scoring_paths_agree(scoring):
 def test_tpu_engine_drains_large_dead_broker_device_path():
     """A dead broker holding many replicas must fully evacuate through the
     device-resident path: evacuations serialize to one per step (each needs
-    a fresh rescore — see _select_disjoint), so the call budget must scale
+    a fresh rescore — see _match_batch), so the call budget must scale
     with the step-counted action budget, not bare max_rounds (code-review
     regression)."""
     state = random_cluster(
@@ -192,6 +192,22 @@ def test_tpu_engine_drains_large_dead_broker_device_path():
         max_rounds=6, topk_per_round=256, max_moves_per_round=512,
         steps_per_call=4, device_batch_per_step=16,
     )
+    res = TpuGoalOptimizer(config=cfg).optimize(state)
+    verify_result(state, res, make_goals())
+    fa = np.array(res.final_state.assignment)
+    assert not (fa == 11).any()
+
+
+def test_score_only_path_drains_large_dead_broker():
+    """The score-only (steps_per_call=0) path keeps per-source candidate
+    rows, so a dead broker exposes ALL its replicas per round — the
+    per-src-broker reduction is a device-scan-only concept (code-review
+    regression)."""
+    state = random_cluster(
+        seed=17, num_brokers=12, num_racks=4, num_partitions=600,
+        dead_brokers=1,
+    )
+    cfg = TpuSearchConfig(max_rounds=150, steps_per_call=0, scoring="grid")
     res = TpuGoalOptimizer(config=cfg).optimize(state)
     verify_result(state, res, make_goals())
     fa = np.array(res.final_state.assignment)
