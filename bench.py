@@ -18,6 +18,8 @@ from __future__ import annotations
 import json
 import time
 
+import numpy as np
+
 
 def main() -> None:
     from cruise_control_tpu.models.generators import random_cluster
@@ -37,13 +39,19 @@ def main() -> None:
     greedy_opt.optimize(state)
     tpu_opt.optimize(state)
 
-    t0 = time.perf_counter()
-    greedy = greedy_opt.optimize(state)
-    greedy_s = time.perf_counter() - t0
+    # best-of-3: the tunneled dev TPU adds seconds-scale transfer jitter a
+    # single sample would fold into the steady-state number
+    greedy_s = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        greedy = greedy_opt.optimize(state)
+        greedy_s = min(greedy_s, time.perf_counter() - t0)
 
-    t0 = time.perf_counter()
-    tpu = tpu_opt.optimize(state)
-    tpu_s = time.perf_counter() - t0
+    tpu_s = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        tpu = tpu_opt.optimize(state)
+        tpu_s = min(tpu_s, time.perf_counter() - t0)
 
     quality_ok = tpu.violation_score_after <= greedy.violation_score_after
     print(

@@ -1,0 +1,110 @@
+"""Measure the BASELINE.md matrix (configs #1–#5) on the current hardware.
+
+Writes one JSON object per config to stdout (and a markdown table to
+``--md``) so BASELINE.md's "Value" column can be filled from real runs.
+
+Usage:
+    PYTHONPATH=.:/root/.axon_site python benchmarks/baseline_matrix.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _measure(name, state, optimizer, goals, warm=True):
+    from cruise_control_tpu.analyzer.verifier import violation_score
+
+    if warm:
+        optimizer.optimize(state)
+    t0 = time.perf_counter()
+    result = optimizer.optimize(state)
+    dt = time.perf_counter() - t0
+    row = {
+        "config": name,
+        "wallclock_s": round(dt, 3),
+        "actions": len(result.actions),
+        "violation_score": int(violation_score(result.final_state, goals)),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="shrink config #5 to a smoke-test size")
+    ap.add_argument("--md", default=None, help="write a markdown table here")
+    args = ap.parse_args()
+
+    from cruise_control_tpu.analyzer.goal_optimizer import (
+        GoalOptimizer,
+        make_goals,
+    )
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.models.generators import random_cluster
+
+    goals = make_goals()
+    hard_only = [g for g in make_goals() if g.is_hard]
+    rows = []
+
+    # 1. greedy CPU baseline, 50-broker RandomCluster fixture
+    state50 = random_cluster(seed=42, num_brokers=50, num_racks=10,
+                             num_partitions=1000)
+    rows.append(_measure("1-greedy-50b", state50, GoalOptimizer(), goals))
+    rows.append(_measure("1-tpu-50b", state50, TpuGoalOptimizer(), goals))
+
+    # 2. hard-goals-only: soft weights zeroed, the feasibility mask + the
+    # forced evac/rack-repair terms drive every commit
+    hard_cfg = TpuSearchConfig(
+        w_util_var=0.0, w_bound=0.0, w_count=0.0, w_leader_count=0.0,
+        w_leader_nwin=0.0, w_pot_nwout=0.0,
+    )
+    heal50 = random_cluster(seed=42, num_brokers=50, num_racks=10,
+                            num_partitions=1000, dead_brokers=2)
+    rows.append(_measure(
+        "2-tpu-hard-only-50b", heal50,
+        TpuGoalOptimizer(config=hard_cfg), hard_only,
+    ))
+
+    # 3. full soft-goal stack, 1k-broker synthetic
+    state1k = random_cluster(seed=12, num_brokers=1000, num_racks=20,
+                             num_partitions=20000)
+    rows.append(_measure("3-tpu-1kb-20kp", state1k, TpuGoalOptimizer(), goals))
+
+    # 4. self-healing replan: dead brokers drain under hard goals
+    heal = random_cluster(seed=5, num_brokers=50, num_racks=10,
+                          num_partitions=1000, dead_brokers=2, new_brokers=2)
+    rows.append(_measure("4-tpu-selfheal-50b", heal, TpuGoalOptimizer(), goals))
+
+    # 5. north star: 10k brokers / 1M partitions
+    if args.quick:
+        ns = random_cluster(seed=5, num_brokers=2000, num_racks=40,
+                            num_partitions=100000)
+        rows.append(_measure("5-tpu-2kb-100kp(quick)", ns,
+                             TpuGoalOptimizer(), goals))
+    else:
+        ns = random_cluster(seed=5, num_brokers=10000, num_racks=200,
+                            num_partitions=1000000)
+        rows.append(_measure("5-tpu-10kb-1Mp", ns, TpuGoalOptimizer(), goals))
+
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write("| config | wall-clock (s) | actions | violation score |\n")
+            f.write("|---|---|---|---|\n")
+            for r in rows:
+                f.write(
+                    f"| {r['config']} | {r['wallclock_s']} | {r['actions']} "
+                    f"| {r['violation_score']} |\n"
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,12 @@ class TpuSearchConfig:
     #: up to this many actions instead of one.  0 = auto (scales with broker
     #: count: B//4 clamped to [32, 512])
     device_batch_per_step: int = 0
+    #: score-only rounds run after the device-resident search converges: the
+    #: finer per-source candidate granularity can recover a last slice of
+    #: plan quality.  Off by default — device-only plans already beat the
+    #: greedy baseline's violation score (the quality gate), and each
+    #: polish round pays a model re-upload (real time at 1M partitions)
+    polish_rounds: int = 0
 
 
 # ---------------------------------------------------------------------------------
@@ -559,14 +565,14 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         from cruise_control_tpu.ops.pallas_grid import move_grid_scores_pallas
     M = cfg.device_batch_per_step
 
-    def step(carry):
+    def step(carry, pools):
         m, ca, done, t, out = carry
         P, S = m.assignment.shape
         B = m.capacity.shape[0]
         M_ = min(M, 2 * B)
         grid_fn = move_grid_scores_pallas if use_pallas else move_grid_scores
         kp, ks, _row_scores, brow, b_scores, best_d, lp, lsl, l_scores = (
-            _reduced_candidates(m, cfg, ca, K, D, grid_fn)
+            _reduced_candidates(m, cfg, ca, K, D, grid_fn, pools=pools)
         )
         bl_score, bl_p, bl_s, bl_dst = _reduce_leadership_per_src(
             m, lp, lsl, l_scores
@@ -621,8 +627,14 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
     def run(m: DeviceModel, ca):
         M_ = min(M, 2 * m.capacity.shape[0])
         out0 = jnp.full((5, T * M_), jnp.inf, jnp.float32)
+        # pools are computed ONCE per call and closed over by the loop body:
+        # the P·S-scale pruning passes would otherwise dominate every step
+        # at the 1M-partition scale (pool membership drifts negligibly
+        # within one call; scoring inside the step stays live)
+        pools = _build_pools(m, cfg, ca, K, D)
         m, _, done, _, out = jax.lax.while_loop(
-            cond, step, (m, ca, jnp.bool_(False), jnp.int32(0), out0)
+            cond, lambda c: step(c, pools),
+            (m, ca, jnp.bool_(False), jnp.int32(0), out0)
         )
         # done flag rides the packed array's last column (row 0) so the host
         # pays ONE transfer per call
@@ -861,8 +873,17 @@ def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
 DESTS_PER_SOURCE = 8
 
 
+def _build_pools(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int):
+    """All P·S-scale candidate-pool selection in one place → (kp, ks,
+    dest_pool, lp, lsl)."""
+    P, S = m.assignment.shape
+    kp, ks, dest_pool = _build_round_pools(m, ca, K, D)
+    lp, lsl = _leadership_pool(m, ca, _leadership_pool_size(P, S, K))
+    return kp, ks, dest_pool, lp, lsl
+
+
 def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
-                        D: int, grid_fn):
+                        D: int, grid_fn, pools=None):
     """Per-src-broker move candidates + pruned leadership candidates.
 
     The disjoint batch commit takes at most ONE move per src broker per
@@ -876,11 +897,18 @@ def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
     Returns (kp, ks, row_scores [K, R], brow [B], b_scores [B, R],
     best_d [K, R], lp, lsl, l_scores); ``b_scores`` carries +inf rows for
     brokers with no candidate.
+
+    ``pools`` (from :func:`_build_pools`) may be passed in so the P·S-scale
+    pool construction is hoisted out of a multi-step device loop — pool
+    membership is a pruning heuristic that drifts negligibly across a few
+    dozen committed actions, while the scoring here stays live.
     """
     P, S = m.assignment.shape
     B = m.capacity.shape[0]
     R = min(DESTS_PER_SOURCE, D)
-    kp, ks, dest_pool = _build_round_pools(m, ca, K, D)
+    kp, ks, dest_pool, lp, lsl = pools if pools is not None else _build_pools(
+        m, cfg, ca, K, D
+    )
     g = grid_fn(m, cfg, ca, kp, ks, dest_pool)          # [K, D]
     neg_best, best_i = jax.lax.top_k(-g, R)             # [K, R]
     best_d = dest_pool[best_i]                          # [K, R] broker ids
@@ -898,8 +926,7 @@ def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
     b_scores = jnp.where(
         valid[:, None], -neg_best[brow], jnp.inf
     )                                                   # [B, R]
-    L = _leadership_pool_size(P, S, K)
-    lp, lsl = _leadership_pool(m, ca, L)
+    L = lp.shape[0]
     l_scores, _ = _score_candidates(
         m, cfg, ca, jnp.ones(L, jnp.int32), lp, lsl, jnp.zeros(L, jnp.int32)
     )
@@ -1346,8 +1373,14 @@ class TpuGoalOptimizer:
                     break  # nothing validated — no further progress possible
                 if not rejected:
                     m = m_new
-                    if device_done:
-                        break  # device search converged
+                    # device_done means the CALL's pool is exhausted.  When
+                    # the pool covers the whole candidate space (small
+                    # models) that IS convergence; on pruned pools (large
+                    # models) the next call re-pools and continues — true
+                    # convergence is then the `not batch` break above (a
+                    # fresh-pool call that commits nothing)
+                    if device_done and K >= P * S and D >= B:
+                        break
                 else:
                     # device state includes skipped actions — rebuild from
                     # the live context before the next call
@@ -1358,14 +1391,27 @@ class TpuGoalOptimizer:
                         must_move=jnp.asarray(ctx.replica_offline),
                     )
                     m = _recompute_aggregates(m)
-            return self._finalize(
-                state, ctx, goals, actions, violations_before, stats_before,
-                initial_assignment, initial_leader_slot, initial_replica_disk,
-                t0,
-            )
+            # polish: fall through to the score-only loop.  The device scan
+            # batches per-src-broker candidates, whose coarser granularity
+            # converges a few percent short of sequential search; the score-
+            # only rounds below expose per-source rows with host rescoring
+            # between commits and recover most of that gap.  Bounded by
+            # polish_rounds — each round re-uploads the placement, which is
+            # real time at the 1M-partition scale.
+            rounds_budget = cfg.polish_rounds
+            if rounds_budget:
+                m = dataclasses.replace(
+                    m,
+                    assignment=jnp.asarray(ctx.assignment),
+                    leader_slot=jnp.asarray(ctx.leader_slot),
+                    must_move=jnp.asarray(ctx.replica_offline),
+                )
+                m = _recompute_aggregates(m)
+        else:
+            rounds_budget = cfg.max_rounds
 
         round_fn = self._make_round_fn(K, D)
-        for _ in range(cfg.max_rounds):
+        for _ in range(rounds_budget):
             scores, k_top, p_top, s_top, d_top = _unpack_round_result(
                 np.asarray(round_fn(m, ca))
             )

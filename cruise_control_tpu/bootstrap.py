@@ -130,11 +130,22 @@ def build_app(
         backend,
         broker_rack={b: f"rack_{b % num_racks}" for b in brokers},
     )
+    capacity_file = cfg.get("capacity.config.file")
+    if capacity_file:
+        from cruise_control_tpu.monitor.capacity import (
+            BrokerCapacityConfigFileResolver,
+        )
+
+        capacity_resolver = BrokerCapacityConfigFileResolver(capacity_file)
+    else:
+        # no file configured: size capacities so the simulated cluster is
+        # feasible by construction
+        capacity_resolver = _capacity_for(workload, len(brokers))
     window_ms = cfg.get("partition.metrics.window.ms")
     monitor = LoadMonitor(
         metadata,
         MetricsReporterSampler(topic),
-        capacity_resolver=_capacity_for(workload, len(brokers)),
+        capacity_resolver=capacity_resolver,
         window_ms=window_ms,
         num_windows=cfg.get_int("num.partition.metrics.windows"),
         min_samples_per_window=cfg.get_int(
@@ -178,10 +189,20 @@ def build_app(
             "broker.failure.self.healing.threshold.ms"
         ),
     )
+    cluster_configs_file = cfg.get("cluster.configs.file")
+    target_rf = None
+    if cluster_configs_file:
+        import json
+
+        with open(cluster_configs_file) as f:
+            cluster_configs = json.load(f)
+        rf = cluster_configs.get("replication.factor")
+        target_rf = int(rf) if rf is not None else None
     detector = make_detector_manager(
         cc,
         backend=backend,
         notifier=notifier,
+        target_rf=target_rf,
         broker_failure_persist_path=cfg.get(
             "broker.failures.persistence.path"
         ),

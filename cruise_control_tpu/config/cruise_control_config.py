@@ -243,6 +243,11 @@ def default_config_def() -> ConfigDef:
     d.define("capacity.config.file", ConfigType.STRING, None,
              Importance.MEDIUM, "Path of the broker-capacity JSON file.",
              None, G)
+    d.define("cluster.configs.file", ConfigType.STRING, None,
+             Importance.LOW,
+             "Path of the cluster-default-configs JSON file "
+             "(upstream config/clusterConfigs.json); replication.factor "
+             "seeds the topic-anomaly detector's target RF.", None, G)
     d.define("sample.store.class", ConfigType.CLASS,
              "cruise_control_tpu.monitor.sample_store.FileSampleStore",
              Importance.MEDIUM, "SampleStore implementation.", None, G)

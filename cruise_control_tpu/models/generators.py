@@ -77,10 +77,33 @@ def random_cluster(
     broker_rack = np.arange(num_brokers, dtype=np.int32) % num_racks
     broker_capacity = np.broadcast_to(cap, (num_brokers, NUM_RESOURCES)).copy()
 
-    # placement: per-partition random RF-subset of brokers
-    assignment = np.empty((num_partitions, rf), np.int32)
-    for p in range(num_partitions):
-        assignment[p] = rng.choice(num_brokers, size=rf, replace=False)
+    # placement: per-partition random RF-subset of brokers, vectorized
+    # (a per-partition Python loop dominates generation at 1M partitions).
+    # Dense regime (rf close to num_brokers): random-keys argsort — a
+    # uniform permutation per row, first rf entries.  Sparse regime:
+    # rejection sampling (resample rows with duplicate brokers) — uniform
+    # over distinct tuples like choice(replace=False), geometric
+    # convergence when collisions are rare.
+    if 2 * rf >= num_brokers:
+        keys = rng.random((num_partitions, num_brokers))
+        assignment = np.argsort(keys, axis=1)[:, :rf].astype(np.int32)
+    else:
+        def _dup_rows(a: np.ndarray) -> np.ndarray:
+            srt = np.sort(a, axis=1)
+            return (srt[:, 1:] == srt[:, :-1]).any(axis=1)
+
+        assignment = rng.integers(
+            0, num_brokers, size=(num_partitions, rf)
+        ).astype(np.int32)
+        bad = _dup_rows(assignment)
+        while bad.any():
+            assignment[bad] = rng.integers(
+                0, num_brokers, size=(int(bad.sum()), rf)
+            )
+            still = _dup_rows(assignment[bad])
+            nxt = np.zeros_like(bad)
+            nxt[np.flatnonzero(bad)[still]] = True
+            bad = nxt
     leader_slot = np.zeros(num_partitions, np.int32)
 
     # workload shape across partitions

@@ -125,3 +125,40 @@ def test_load_properties(tmp_path):
     )
     props = load_properties(str(f))
     assert props == {"webserver.http.port": "1234", "default.goals": "A,B"}
+
+
+def test_bootstrap_reads_capacity_and_cluster_configs_files(tmp_path):
+    """capacity.config.file drives the file resolver; cluster.configs.file
+    seeds the topic-anomaly detector's target RF (upstream
+    config/capacity.json + config/clusterConfigs.json side-files)."""
+    import json
+
+    from cruise_control_tpu.bootstrap import build_app
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    from cruise_control_tpu.monitor.capacity import (
+        BrokerCapacityConfigFileResolver,
+    )
+
+    cap = tmp_path / "capacity.json"
+    cap.write_text(json.dumps({
+        "brokerCapacities": [
+            {"brokerId": -1, "capacity": {
+                "DISK": 1e9, "CPU": 1e9, "NW_IN": 1e9, "NW_OUT": 1e9}},
+        ],
+    }))
+    cl = tmp_path / "clusterConfigs.json"
+    cl.write_text(json.dumps({"replication.factor": 3}))
+    cfg = CruiseControlConfig({
+        "capacity.config.file": str(cap),
+        "cluster.configs.file": str(cl),
+    })
+    app = build_app(cfg, port=0)
+    assert isinstance(
+        app.cruise_control.load_monitor.capacity_resolver,
+        BrokerCapacityConfigFileResolver,
+    )
+    topic_det = app.detector_manager.detectors[AnomalyType.TOPIC_ANOMALY]
+    assert topic_det.finder.target_rf == 3
