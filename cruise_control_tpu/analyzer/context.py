@@ -338,15 +338,15 @@ class AnalyzerContext:
 
     # ---- snapshots --------------------------------------------------------------
     def to_state(self, template: ClusterState) -> ClusterState:
-        import jax.numpy as jnp
-
+        # host-first like the rest of ClusterState: device upload happens
+        # only where a consumer actually jits over it
         out = template.replace(
-            assignment=jnp.asarray(self.assignment),
-            leader_slot=jnp.asarray(self.leader_slot),
-            replica_offline=jnp.asarray(self.replica_offline),
+            assignment=self.assignment.copy(),
+            leader_slot=self.leader_slot.copy(),
+            replica_offline=self.replica_offline.copy(),
         )
         if self.replica_disk is not None:
-            out = out.replace(replica_disk=jnp.asarray(self.replica_disk))
+            out = out.replace(replica_disk=self.replica_disk.copy())
         return out
 
     def recompute_check(self, atol: float = 1e-3) -> None:

@@ -77,7 +77,7 @@ class TpuSearchConfig:
     #: candidate budget per round: K source replicas × D destination brokers.
     #: Pools re-rank every step, so modest pools lose little quality while
     #: the per-step rescore cost scales linearly with the budget.
-    candidate_budget: int = 1 << 21
+    candidate_budget: int = 1 << 23
     max_source_replicas: int = 8192
     #: top-k candidates returned from device per round; the host exact-recheck
     #: commits as many of them as still improve, so this bounds the
@@ -104,16 +104,22 @@ class TpuSearchConfig:
     scoring: str = "auto"
     #: device-resident search: run this many (rescore → select → apply)
     #: steps per device call inside a lax.while_loop, so host↔device
-    #: round-trips drop T-fold.  0 disables (score-only rounds with
-    #: host-side batch commit).  Single-device engines only; the host still
-    #: exact-rechecks every returned action before accepting it.
-    steps_per_call: int = 32
+    #: round-trips AND per-call pool builds amortize T-fold.  0 disables
+    #: (score-only rounds with host-side batch commit).  Single-device
+    #: engines only; the host still exact-rechecks every returned action
+    #: before accepting it.
+    steps_per_call: int = 64
     #: conflict-free actions committed per device step: the top candidates
     #: are greedily filtered to disjoint (src broker, dst broker, partition)
     #: sets, whose deltas are exactly independent — one rescore then commits
     #: up to this many actions instead of one.  0 = auto (scales with broker
-    #: count: B//4 clamped to [32, 512])
+    #: count: B//4 clamped to [32, 1024])
     device_batch_per_step: int = 0
+    #: anytime budget: stop starting new search rounds once this many
+    #: seconds have elapsed (0 = unlimited).  Hard-goal work (offline-
+    #: replica evacuation) always runs to completion — only soft-goal
+    #: refinement is cut short, and _finalize still enforces hard goals
+    time_budget_s: float = 0.0
     #: score-only rounds run after the device-resident search converges: the
     #: finer per-source candidate granularity can recover a last slice of
     #: plan quality.  Off by default — device-only plans already beat the
@@ -391,11 +397,20 @@ def _build_round_pools(
     # evacuation overrides exclusion (greedy parity: evacuate_offline_replicas)
     eligible = slot_exists & (~m.excluded[:, None] | m.must_move)
     prio = jnp.where(eligible, prio, -jnp.inf)
-    # exact top-k: must-move (offline) replicas carry forced priority and
-    # MUST enter the pool — approx_max_k keeps one entry per bin and can
-    # deterministically drop a placeable offline replica forever (hard-goal
-    # failure); the leadership pool below uses approx (soft quality only)
-    _, flat_idx = jax.lax.top_k(prio.reshape(-1), K)
+    # Pool selection must be EXACT top-k whenever forced-priority
+    # candidates exist — must-move (offline) replicas AND rack-violating
+    # replicas both repair hard goals, and approx_max_k keeps one entry
+    # per bin, so it can deterministically drop a placeable repair forever
+    # (hard-goal failure).  Without forced candidates the pool is a recall
+    # heuristic and the approx kernel is several times faster on the P·S
+    # axis.
+    flat = prio.reshape(-1)
+    _, flat_idx = jax.lax.cond(
+        jnp.any(m.must_move) | jnp.any(rack_dup),
+        lambda f: jax.lax.top_k(f, K),
+        lambda f: jax.lax.approx_max_k(f, K),
+        flat,
+    )
     kp = (flat_idx // S).astype(jnp.int32)
     ks = (flat_idx % S).astype(jnp.int32)
     # dest pool: least max-utilization eligible brokers
@@ -813,10 +828,9 @@ def _unpack_round_result(packed) -> Tuple:
 def _resolve_scoring(cfg: TpuSearchConfig, mesh) -> str:
     if cfg.scoring != "auto":
         return cfg.scoring
-    # the fused Pallas kernel is the single-device TPU fast path; under a
-    # mesh (or on CPU test rigs) the jnp grid path shards/interprets cleanly
-    if mesh is None and jax.default_backend() == "tpu":
-        return "pallas"
+    # XLA's fused grid beats the hand-written Pallas kernel at the current
+    # K×D shapes (measured 14.4ms vs 16.5ms at 8192×1024 on v5e) — auto
+    # picks the jnp grid everywhere; "pallas" stays selectable and tested
     return "grid"
 
 
@@ -1277,9 +1291,14 @@ class TpuGoalOptimizer:
 
     def _pool_sizes(self, P: int, S: int, B: int) -> Tuple[int, int]:
         cfg = self.config
-        K = min(P * S, cfg.max_source_replicas)
-        D = max(8, min(B, cfg.candidate_budget // max(K, 1)))
-        return K, min(D, B)
+        # the auction commits at most one move per destination broker per
+        # step, so on large clusters the K×D budget leans toward D (dest
+        # slots bound batch size); sources re-pool every call, so a smaller
+        # K costs little
+        D = max(8, min(B, 1024))
+        K = min(P * S, cfg.max_source_replicas,
+                max(256, cfg.candidate_budget // D))
+        return K, min(D, B, max(8, cfg.candidate_budget // max(K, 1)))
 
     def _make_round_fn(self, K: int, D: int):
         return _cached_round_fn(self.config, K, D, self.mesh)
@@ -1312,6 +1331,18 @@ class TpuGoalOptimizer:
         evaluator = _HostEvaluator(ctx, cfg, can)
         actions: List[BalancingAction] = []
 
+        def budget_exhausted() -> bool:
+            # anytime exit: only once the plan-so-far satisfies every hard
+            # goal (offline evacuation, rack repairs, capacity) — until it
+            # does, the budget keeps extending.  Shared by both search
+            # phases so their validity guarantees cannot drift apart.
+            return bool(
+                cfg.time_budget_s
+                and time.perf_counter() - t0 > cfg.time_budget_s
+                and not ctx.replica_offline.any()
+                and all(g.violations(ctx) == 0 for g in goals if g.is_hard)
+            )
+
         if (
             cfg.steps_per_call
             and self.mesh is None
@@ -1331,7 +1362,7 @@ class TpuGoalOptimizer:
                 # keep (rescores per committed action) low, small clusters
                 # can't fill them
                 cfg = dataclasses.replace(
-                    cfg, device_batch_per_step=int(np.clip(B // 4, 32, 512))
+                    cfg, device_batch_per_step=int(np.clip(B // 4, 32, 1024))
                 )
             scan_fn = _cached_scan_fn(cfg, K, D, cfg.steps_per_call)
             # convergence exits via the device done flag / no-progress break;
@@ -1345,6 +1376,8 @@ class TpuGoalOptimizer:
                 // -cfg.steps_per_call,
             )
             for _ in range(calls_budget):
+                if budget_exhausted():
+                    break
                 packed, m_new = scan_fn(m, ca)
                 arr = np.asarray(packed)
                 device_done = bool(arr[0, -1] > 0)
@@ -1412,6 +1445,8 @@ class TpuGoalOptimizer:
 
         round_fn = self._make_round_fn(K, D)
         for _ in range(rounds_budget):
+            if budget_exhausted():
+                break
             scores, k_top, p_top, s_top, d_top = _unpack_round_result(
                 np.asarray(round_fn(m, ca))
             )

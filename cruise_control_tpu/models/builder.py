@@ -12,7 +12,6 @@ import dataclasses
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
-import jax.numpy as jnp
 
 from cruise_control_tpu.common.resources import (
     EMPTY_SLOT,
@@ -218,34 +217,34 @@ class ClusterModelBuilder:
             disk_offline = disk_offline_arr
 
         return ClusterState(
-            assignment=jnp.asarray(assignment),
-            leader_slot=jnp.asarray(leader_slot),
-            leader_load=jnp.asarray(leader_load),
-            follower_load=jnp.asarray(follower_load),
-            partition_topic=jnp.asarray(topic),
-            broker_capacity=jnp.asarray(
+            assignment=np.asarray(assignment),
+            leader_slot=np.asarray(leader_slot),
+            leader_load=np.asarray(leader_load),
+            follower_load=np.asarray(follower_load),
+            partition_topic=np.asarray(topic),
+            broker_capacity=np.asarray(
                 np.stack([b.capacity for b in self._brokers])
                 if self._brokers
                 else np.zeros((0, NUM_RESOURCES), np.float32)
             ),
-            broker_rack=jnp.asarray(
+            broker_rack=np.asarray(
                 np.array([b.rack for b in self._brokers], np.int32)
             ),
-            broker_state=jnp.asarray(
+            broker_state=np.asarray(
                 np.array([int(b.state) for b in self._brokers], np.int8)
             ),
-            replica_offline=jnp.asarray(offline),
+            replica_offline=np.asarray(offline),
             num_topics=max(len(self._topics), 1),
             broker_ids=tuple(self._broker_ids),
             partition_ids=tuple(self._partition_ids),
             replica_disk=(
-                None if replica_disk is None else jnp.asarray(replica_disk)
+                None if replica_disk is None else np.asarray(replica_disk)
             ),
             disk_capacity=(
-                None if disk_capacity is None else jnp.asarray(disk_capacity)
+                None if disk_capacity is None else np.asarray(disk_capacity)
             ),
             disk_offline=(
-                None if disk_offline is None else jnp.asarray(disk_offline)
+                None if disk_offline is None else np.asarray(disk_offline)
             ),
             disk_names=disk_names,
         )

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -264,6 +265,17 @@ def replica_rack(state: ClusterState) -> jax.Array:
 # Mutators → pure functions (upstream ClusterModel.relocateReplica / ...Leadership)
 # ---------------------------------------------------------------------------------
 
+def _functional_set(arr, idx, val):
+    """Pure single-element update for either array family: ``.at[].set``
+    on jax arrays (incl. tracers under jit), copy-assign on host numpy —
+    ClusterState is host-first, but these mutators must stay jittable."""
+    if isinstance(arr, jax.Array):
+        return arr.at[idx].set(val)
+    out = arr.copy()
+    out[idx] = val
+    return out
+
+
 def apply_move(
     state: ClusterState, partition: jax.Array, slot: jax.Array, dest_broker: jax.Array
 ) -> ClusterState:
@@ -273,12 +285,15 @@ def apply_move(
     replica lands on a healthy broker/disk.
     """
     return state.replace(
-        assignment=state.assignment.at[partition, slot].set(
+        assignment=_functional_set(
+            state.assignment, (partition, slot),
             dest_broker.astype(state.assignment.dtype)
             if isinstance(dest_broker, jax.Array)
-            else jnp.int32(dest_broker)
+            else np.int32(dest_broker),
         ),
-        replica_offline=state.replica_offline.at[partition, slot].set(False),
+        replica_offline=_functional_set(
+            state.replica_offline, (partition, slot), False
+        ),
     )
 
 
@@ -287,10 +302,11 @@ def apply_leadership(
 ) -> ClusterState:
     """Leadership movement (upstream ``ClusterModel.relocateLeadership``)."""
     return state.replace(
-        leader_slot=state.leader_slot.at[partition].set(
+        leader_slot=_functional_set(
+            state.leader_slot, partition,
             new_leader_slot.astype(state.leader_slot.dtype)
             if isinstance(new_leader_slot, jax.Array)
-            else jnp.int32(new_leader_slot)
+            else np.int32(new_leader_slot),
         )
     )
 
@@ -308,10 +324,10 @@ def apply_swap(
     """
     broker_a = state.assignment[partition_a, slot_a]
     broker_b = state.assignment[partition_b, slot_b]
-    assignment = state.assignment.at[partition_a, slot_a].set(broker_b)
-    assignment = assignment.at[partition_b, slot_b].set(broker_a)
-    offline = state.replica_offline.at[partition_a, slot_a].set(False)
-    offline = offline.at[partition_b, slot_b].set(False)
+    assignment = _functional_set(state.assignment, (partition_a, slot_a), broker_b)
+    assignment = _functional_set(assignment, (partition_b, slot_b), broker_a)
+    offline = _functional_set(state.replica_offline, (partition_a, slot_a), False)
+    offline = _functional_set(offline, (partition_b, slot_b), False)
     return state.replace(assignment=assignment, replica_offline=offline)
 
 
@@ -321,7 +337,7 @@ def set_broker_state(
     """Upstream ``ClusterModel.setBrokerState``.  Marking a broker DEAD also
     marks its replicas offline (they become the "immigrants" hard goals must
     evacuate, SURVEY.md §5.3)."""
-    bs = state.broker_state.at[broker].set(jnp.int8(new_state))
+    bs = _functional_set(state.broker_state, broker, np.int8(new_state))
     offline = state.replica_offline
     if new_state in (BrokerState.DEAD, BrokerState.REMOVED):
         offline = offline | (state.assignment == broker)

@@ -20,7 +20,6 @@ import enum
 from typing import Optional
 
 import numpy as np
-import jax.numpy as jnp
 
 from cruise_control_tpu.common.resources import (
     EMPTY_SLOT,
@@ -147,15 +146,15 @@ def random_cluster(
     replica_offline = dead_mask[assignment] & (assignment != EMPTY_SLOT)
 
     return ClusterState(
-        assignment=jnp.asarray(assignment),
-        leader_slot=jnp.asarray(leader_slot),
-        leader_load=jnp.asarray(leader_load),
-        follower_load=jnp.asarray(follower_load),
-        partition_topic=jnp.asarray(partition_topic),
-        broker_capacity=jnp.asarray(broker_capacity),
-        broker_rack=jnp.asarray(broker_rack),
-        broker_state=jnp.asarray(broker_state),
-        replica_offline=jnp.asarray(replica_offline),
+        assignment=np.asarray(assignment),
+        leader_slot=np.asarray(leader_slot),
+        leader_load=np.asarray(leader_load),
+        follower_load=np.asarray(follower_load),
+        partition_topic=np.asarray(partition_topic),
+        broker_capacity=np.asarray(broker_capacity),
+        broker_rack=np.asarray(broker_rack),
+        broker_state=np.asarray(broker_state),
+        replica_offline=np.asarray(replica_offline),
         num_topics=num_topics,
     )
 

@@ -66,8 +66,13 @@ def cluster_stats(state: ClusterState) -> ClusterStats:
     on TPU backends, so the whole reduction graph is compiled once instead.
     The jit key deliberately excludes the non-array metadata (broker_ids /
     partition_ids / disk_names) — only ``num_topics`` shapes the program.
+
+    ClusterState is host-first (numpy): when the arrays are not already on
+    an accelerator, the program is pinned to the CPU backend so a stats
+    call never ships ~50MB of model over the accelerator link (seconds on
+    a tunneled dev TPU, and the reductions are bandwidth-bound anyway).
     """
-    return _cluster_stats_jit(
+    args = (
         state.assignment,
         state.leader_slot,
         state.leader_load,
@@ -75,8 +80,15 @@ def cluster_stats(state: ClusterState) -> ClusterStats:
         state.partition_topic,
         state.broker_capacity,
         state.broker_state,
-        state.num_topics,
     )
+    if any(isinstance(a, jax.Array) for a in args):
+        return _cluster_stats_jit(*args, state.num_topics)
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:  # CPU backend disabled (e.g. JAX_PLATFORMS=tpu)
+        return _cluster_stats_jit(*args, state.num_topics)
+    with jax.default_device(cpu):
+        return _cluster_stats_jit(*args, state.num_topics)
 
 
 @functools.partial(jax.jit, static_argnums=(7,))

@@ -212,3 +212,62 @@ def test_score_only_path_drains_large_dead_broker():
     verify_result(state, res, make_goals())
     fa = np.array(res.final_state.assignment)
     assert not (fa == 11).any()
+
+
+def test_match_batch_disjoint_and_best_first():
+    """_match_batch invariants: taken actions are disjoint on src broker,
+    dst broker, and partition; every taken score beats tol; and a candidate
+    whose provisional winner was eliminated keeps (not skips) its best
+    still-free destination."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.tpu_optimizer import _match_batch
+
+    B, P = 8, 16
+    # 4 candidates: 0 and 1 fight for dst 5 (0 wins on score); 2 shares
+    # src with nobody but proposes dst 6; 3 duplicates partition of 2.
+    cand_score = jnp.array([
+        [-3.0, -1.0],
+        [-2.0, -0.5],
+        [-1.5, -0.2],
+        [-1.0, -0.9],
+    ])
+    cand_dst = jnp.array([
+        [5, 6],
+        [5, 7],
+        [6, 4],
+        [3, 2],
+    ], dtype=jnp.int32)
+    cand_src = jnp.array([0, 1, 2, 3], dtype=jnp.int32)
+    cand_p = jnp.array([10, 11, 12, 12], dtype=jnp.int32)
+    take, win_score, win_dst = _match_batch(
+        cand_score, cand_dst, cand_src, cand_p, tol=-1e-4, B=B, P=P,
+    )
+    take = np.asarray(take)
+    win_dst = np.asarray(win_dst)
+    win_score = np.asarray(win_score)
+    assert take[0] and take[2]            # best per contested dst wins
+    assert take[1]                        # loser falls back to alt dst 7
+    assert win_dst[0] == 5 and win_dst[1] == 7 and win_dst[2] == 6
+    assert not take[3] or win_dst[3] != win_dst[2]  # partition 12 dedup
+    taken = np.flatnonzero(take)
+    # disjointness across the taken set
+    assert len({int(cand_src[i]) for i in taken}) == len(taken)
+    assert len({int(win_dst[i]) for i in taken}) == len(taken)
+    assert len({int(cand_p[i]) for i in taken}) == len(taken)
+    assert (win_score[take] < -1e-4).all()
+
+
+def test_time_budget_still_satisfies_hard_goals():
+    """The anytime budget may cut soft-goal refinement short but never hard
+    goals: a near-zero budget must still produce a verified plan (dead
+    broker drained, rack repairs done) rather than OptimizationFailure
+    (code-review regression)."""
+    state = random_cluster(
+        seed=23, num_brokers=12, num_racks=4, num_partitions=200,
+        dead_brokers=1,
+    )
+    cfg = TpuSearchConfig(max_rounds=60, time_budget_s=1e-6)
+    res = TpuGoalOptimizer(config=cfg).optimize(state)
+    verify_result(state, res, make_goals())
+    assert not (np.array(res.final_state.assignment) == 11).any()
