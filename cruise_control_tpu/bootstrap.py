@@ -167,6 +167,11 @@ def build_app(
             replication_throttle=cfg.get("default.replication.throttle"),
         ),
     )
+    # upstream executor recovery: surface (and optionally stop) reassignments
+    # a previous instance left in flight
+    executor.detect_ongoing_at_startup(
+        stop=cfg.get_boolean("stop.ongoing.execution.at.startup")
+    )
     cc = CruiseControl(
         monitor,
         executor,

@@ -243,6 +243,11 @@ def default_config_def() -> ConfigDef:
     d.define("capacity.config.file", ConfigType.STRING, None,
              Importance.MEDIUM, "Path of the broker-capacity JSON file.",
              None, G)
+    d.define("stop.ongoing.execution.at.startup", ConfigType.BOOLEAN, False,
+             Importance.LOW,
+             "Cancel reassignments a previous instance left in flight "
+             "instead of letting them drain (upstream executor recovery).",
+             None, G)
     d.define("cluster.configs.file", ConfigType.STRING, None,
              Importance.LOW,
              "Path of the cluster-default-configs JSON file "

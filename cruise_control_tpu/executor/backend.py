@@ -55,6 +55,12 @@ class ClusterBackend:
     def ongoing_reassignments(self) -> Set[int]:
         raise NotImplementedError
 
+    def cancel_reassignments(self, partitions: Sequence[int]) -> None:
+        """Revert in-flight reassignments (upstream
+        alterPartitionReassignments with an empty target — the executor's
+        startup stop path)."""
+        raise NotImplementedError
+
     def partition_state(self, partition: int) -> PartitionState:
         raise NotImplementedError
 
@@ -202,6 +208,10 @@ class SimulatedClusterBackend(ClusterBackend):
 
     def ongoing_reassignments(self) -> Set[int]:
         return set(self._target)
+
+    def cancel_reassignments(self, partitions: Sequence[int]) -> None:
+        for p in list(partitions):
+            self._target.pop(p, None)
 
     def partition_state(self, partition: int) -> PartitionState:
         return self.partitions[partition]

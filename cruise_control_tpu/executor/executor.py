@@ -103,6 +103,7 @@ class Executor:
         self._stop_requested = False
         self.planner: Optional[ExecutionTaskPlanner] = None
         self.history: List[ExecutionResult] = []
+        self.adopted_at_startup: Set[int] = set()
         self.adjuster: Optional[ConcurrencyAdjuster] = None
         self.throttle_helper: Optional[ReplicationThrottleHelper] = None
 
@@ -115,6 +116,24 @@ class Executor:
         """Upstream STOP_PROPOSAL_EXECUTION endpoint."""
         if self.has_ongoing_execution:
             self._stop_requested = True
+
+    def detect_ongoing_at_startup(self, stop: bool = False) -> Set[int]:
+        """Upstream executor recovery (SURVEY.md §5.4c): on startup, detect
+        reassignments already in flight in the cluster (e.g. a previous
+        instance died mid-execution).  Returns the partitions involved;
+        with ``stop=True`` the backend is told to cancel them, otherwise
+        they are left to finish under the cluster's own control and the
+        executor simply refuses to start a new plan until they drain
+        (``has_ongoing_execution`` stays authoritative for OUR plans —
+        adopted work is surfaced via state()).
+        """
+        ongoing = set(self.backend.ongoing_reassignments())
+        if ongoing and stop:
+            cancel = getattr(self.backend, "cancel_reassignments", None)
+            if cancel is not None:
+                cancel(ongoing)
+        self.adopted_at_startup = ongoing
+        return ongoing
 
     def execute_proposals(
         self,
@@ -379,4 +398,5 @@ class Executor:
             "state": self.state.value,
             "taskCounts": by_state,
             "stopRequested": self._stop_requested,
+            "adoptedAtStartup": sorted(self.adopted_at_startup),
         }

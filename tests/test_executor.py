@@ -362,3 +362,21 @@ def test_executor_notifier_spi():
     p = prop(0, assignment[0], [assignment[0][0], 3])
     result = ex.execute_proposals([p])
     assert events == [("finished", result.completed)]
+
+
+def test_detect_ongoing_at_startup_adopts_or_stops():
+    """Upstream executor recovery: reassignments left by a dead instance are
+    detected at startup and either surfaced (adopted) or cancelled."""
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import Executor
+
+    backend = SimulatedClusterBackend(
+        {0: [0, 1], 1: [1, 2]}, {0: 0, 1: 1}, brokers={0, 1, 2},
+    )
+    backend.alter_partition_reassignments({0: [0, 2]})
+    ex = Executor(backend)
+    assert ex.detect_ongoing_at_startup() == {0}
+    assert ex.adopted_at_startup == {0}
+    # stop=True cancels in the cluster
+    assert ex.detect_ongoing_at_startup(stop=True) == {0}
+    assert backend.ongoing_reassignments() == set()
