@@ -1,0 +1,105 @@
+"""Micro-profile of the device-resident search at north-star shapes.
+
+Times, warm: the full scan call at several T (marginal per-step cost), the
+candidate-pool build, one grid rescore, the leadership rescore, and the
+auction matcher — so device-side optimization targets the real hot spot.
+
+Usage: PYTHONPATH=.:/root/.axon_site python benchmarks/profile_device_step.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def sync(out):
+    # the axon relay's block_until_ready can report ready before remote
+    # execution finishes; a concrete scalar fetch is an honest barrier
+    import numpy as np
+
+    leaves = [x for x in jax.tree_util.tree_leaves(out)
+              if hasattr(x, "block_until_ready")]
+    for x in leaves:
+        x.block_until_ready()
+    if leaves:
+        np.asarray(jax.numpy.ravel(leaves[0])[0])
+
+
+def bench(fn, *args, reps=3):
+    sync(fn(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--brokers", type=int, default=10000)
+    ap.add_argument("--partitions", type=int, default=1000000)
+    args = ap.parse_args()
+
+    import cruise_control_tpu.analyzer.tpu_optimizer as T
+    from cruise_control_tpu.analyzer.context import AnalyzerContext
+    from cruise_control_tpu.models.generators import random_cluster
+    from cruise_control_tpu.ops.grid import move_grid_scores
+
+    state = random_cluster(
+        seed=5, num_brokers=args.brokers, num_racks=200,
+        num_partitions=args.partitions,
+    )
+    opt = T.TpuGoalOptimizer()
+    cfg = opt.config
+    ctx = AnalyzerContext(state)
+    m = opt._device_model(ctx)
+    ca = opt._constraint_arrays(ctx)
+    P, S, B = ctx.num_partitions, ctx.max_rf, ctx.num_brokers
+    K, D = opt._pool_sizes(P, S, B)
+    cfg = dataclasses.replace(
+        cfg, device_batch_per_step=int(min(max(B // 4, 32), 1024))
+    )
+    res = {"K": K, "D": D, "B": B, "P": P}
+
+    for Tn in (1, 8, 64):
+        fn = T._cached_scan_fn(cfg, K, D, Tn)
+        res[f"scan_T{Tn}_s"] = round(bench(fn, m, ca), 4)
+        print(json.dumps(res), flush=True)
+
+    pools_fn = jax.jit(lambda m, ca: T._build_pools(m, cfg, ca, K, D))
+    res["build_pools_s"] = round(bench(pools_fn, m, ca), 4)
+    pools = pools_fn(m, ca)
+
+    kp, ks, dest_pool, lp, lsl = pools
+    grid_fn = jax.jit(
+        lambda m, ca, kp, ks, dp: move_grid_scores(m, cfg, ca, kp, ks, dp)
+    )
+    res["grid_rescore_s"] = round(bench(grid_fn, m, ca, kp, ks, dest_pool), 4)
+
+    lead_fn = jax.jit(
+        lambda m, ca, lp, lsl: T._score_candidates(
+            m, cfg, ca, jnp.ones_like(lp), lp, lsl, jnp.zeros_like(lp)
+        )
+    )
+    res["lead_rescore_s"] = round(bench(lead_fn, m, ca, lp, lsl), 4)
+
+    reduced_fn = jax.jit(
+        lambda m, ca, pools: T._reduced_candidates(
+            m, cfg, ca, K, D, move_grid_scores, pools=pools
+        )
+    )
+    res["reduced_cands_s"] = round(bench(reduced_fn, m, ca, pools), 4)
+
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

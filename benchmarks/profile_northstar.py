@@ -1,0 +1,108 @@
+"""Phase-level wall-clock profile of the north-star config (#5).
+
+Monkeypatch-instruments the TPU engine's main phases so optimization work
+can be targeted where the time actually goes:
+
+    gen          synthetic cluster generation (not part of the plan clock)
+    ctx_init     AnalyzerContext construction (host mirror)
+    upload       device model build + aggregate recompute
+    device       compiled search calls (includes device→host transfer)
+    host_eval    exact recheck (_HostEvaluator.evaluate)
+    host_apply   ctx.apply of committed actions
+    finalize     goal violations + diff + stats after search
+
+Usage:
+    PYTHONPATH=.:/root/.axon_site python benchmarks/profile_northstar.py \
+        [--brokers 10000] [--partitions 1000000] [--budget 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import functools
+import json
+import time
+
+TIMES: dict = collections.defaultdict(float)
+COUNTS: dict = collections.defaultdict(int)
+
+
+def timed(name, fn):
+    @functools.wraps(fn)
+    def wrap(*a, **kw):
+        t0 = time.perf_counter()
+        out = fn(*a, **kw)
+        TIMES[name] += time.perf_counter() - t0
+        COUNTS[name] += 1
+        return out
+    return wrap
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--brokers", type=int, default=10000)
+    ap.add_argument("--partitions", type=int, default=1000000)
+    ap.add_argument("--racks", type=int, default=200)
+    ap.add_argument("--budget", type=float, default=0.0)
+    args = ap.parse_args()
+
+    import cruise_control_tpu.analyzer.tpu_optimizer as T
+    from cruise_control_tpu.analyzer import context as C
+    from cruise_control_tpu.models.generators import random_cluster
+
+    t0 = time.perf_counter()
+    state = random_cluster(
+        seed=5, num_brokers=args.brokers, num_racks=args.racks,
+        num_partitions=args.partitions,
+    )
+    TIMES["gen"] = time.perf_counter() - t0
+
+    C.AnalyzerContext.__init__ = timed("ctx_init", C.AnalyzerContext.__init__)
+    C.AnalyzerContext.apply = timed("host_apply", C.AnalyzerContext.apply)
+    T._HostEvaluator.evaluate = timed("host_eval", T._HostEvaluator.evaluate)
+    T.TpuGoalOptimizer._device_model = timed(
+        "upload", T.TpuGoalOptimizer._device_model
+    )
+    T.TpuGoalOptimizer._finalize = timed("finalize", T.TpuGoalOptimizer._finalize)
+
+    orig_scan = T._cached_scan_fn
+
+    @functools.lru_cache(maxsize=64)
+    def scan_wrap(cfg, K, D, Tn):
+        fn = orig_scan(cfg, K, D, Tn)
+
+        def run(m, ca):
+            t0 = time.perf_counter()
+            packed, m_new = fn(m, ca)
+            packed.block_until_ready()
+            TIMES["device"] += time.perf_counter() - t0
+            COUNTS["device"] += 1
+            return packed, m_new
+        return run
+
+    T._cached_scan_fn = scan_wrap
+
+    cfg = T.TpuSearchConfig(time_budget_s=args.budget)
+    opt = T.TpuGoalOptimizer(config=cfg)
+    t0 = time.perf_counter()
+    result = opt.optimize(state)
+    total = time.perf_counter() - t0
+
+    out = {
+        "total_s": round(total, 2),
+        "actions": len(result.actions),
+        "phases": {k: round(v, 2) for k, v in sorted(TIMES.items())},
+        "counts": dict(COUNTS),
+    }
+    other = total - sum(
+        v for k, v in TIMES.items() if k not in ("gen", "ctx_init")
+    ) + TIMES["ctx_init"] * 0  # ctx_init happens inside optimize
+    out["phases"]["untracked"] = round(
+        total - sum(v for k, v in TIMES.items() if k != "gen"), 2
+    )
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -569,10 +569,19 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
     batch-apply) steps per call, each committing ≤ device_batch_per_step
     conflict-free actions, exiting early on convergence (lax.while_loop).
 
-    Returns (packed [5, T·M] actions in commit order — unused slots +inf,
-    done flag, updated model).  The host replays the sequence through the
-    exact evaluator and reuses the returned model when every action
-    validates (the common case)."""
+    Returns (packed [4, T·M + T + 2] f32, updated model).  Columns
+    [0, T·M): committed (kind, p, s, dst) rows in commit order, written
+    *compacted* — each step's accepted batch lands at the running total
+    offset, so every valid entry is contiguous from column 0.  Row 0 of the
+    tail columns carries the meta: per-step accepted counts [T], then the
+    total count, then the done flag.  The compaction lets the host fetch
+    the tiny meta first and then only the valid prefix
+    (:func:`_fetch_scan_result`): the fixed-layout alternative moves
+    T·M slots per call (~1.3MB at the 1M-partition shapes) over a device
+    link that runs ~5MB/s tunneled, which alone was ~15s of a north-star
+    run.  The host replays the sequence through the exact evaluator and
+    reuses the returned model when every action validates (the common
+    case)."""
     from cruise_control_tpu.ops.grid import move_grid_scores
 
     use_pallas = _resolve_scoring(cfg, None) == "pallas"
@@ -581,7 +590,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
     M = cfg.device_batch_per_step
 
     def step(carry, pools):
-        m, ca, done, t, out = carry
+        m, ca, done, t, count, out, counts = carry
         P, S = m.assignment.shape
         B = m.capacity.shape[0]
         M_ = min(M, 2 * B)
@@ -610,20 +619,20 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         take, win_score, win_dst = _match_batch(
             cand_score, cand_dst, cand_src, cand_p, cfg.improvement_tol, B, P
         )
-        # cap to the M_ best matches (the packed slot budget); commit order =
-        # score order
+        # cap to the M_ best matches; commit order = score order.  The sort
+        # puts accepted entries (finite scores) first, so the step's batch
+        # is valid-prefix-contiguous and can compact at the running offset
         vals, order = jax.lax.top_k(-jnp.where(take, win_score, jnp.inf), M_)
         vals = -vals
         sel_ok = jnp.isfinite(vals)
         take_f = jnp.zeros(2 * B, bool).at[order].max(sel_ok)
-        count = jnp.sum(sel_ok.astype(jnp.int32))
+        c_step = jnp.sum(sel_ok.astype(jnp.int32))
         m = _apply_batch_on_device(
             m, take_f, is_move_row, cand_p, cand_s, win_dst,
             cand_src, win_dst,
         )
         batch = jnp.stack(
             [
-                jnp.where(sel_ok, vals, jnp.inf).astype(jnp.float32),
                 jnp.where(
                     is_move_row[order], KIND_MOVE, KIND_LEADERSHIP
                 ).astype(jnp.float32),
@@ -631,34 +640,67 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
                 cand_s[order].astype(jnp.float32),
                 win_dst[order].astype(jnp.float32),
             ]
-        )                                                # [5, M_]
-        out = jax.lax.dynamic_update_slice(out, batch, (0, t * M_))
-        return (m, ca, done | (count == 0), t + 1, out)
+        )                                                # [4, M_]
+        # compacted write: offset = actions committed so far, so the next
+        # step overwrites this one's invalid tail.  Max offset is
+        # (T-1)·M_ (each step advances count by ≤ M_), so the slice never
+        # clamps
+        out = jax.lax.dynamic_update_slice(out, batch, (0, count))
+        counts = counts.at[t].set(c_step)
+        return (m, ca, done | (c_step == 0), t + 1, count + c_step, out,
+                counts)
 
     def cond(carry):
-        _, _, done, t, _ = carry
+        _, _, done, t, _, _, _ = carry
         return (~done) & (t < T)
 
     def run(m: DeviceModel, ca):
         M_ = min(M, 2 * m.capacity.shape[0])
-        out0 = jnp.full((5, T * M_), jnp.inf, jnp.float32)
+        out0 = jnp.full((4, T * M_), -1.0, jnp.float32)
         # pools are computed ONCE per call and closed over by the loop body:
         # the P·S-scale pruning passes would otherwise dominate every step
         # at the 1M-partition scale (pool membership drifts negligibly
         # within one call; scoring inside the step stays live)
         pools = _build_pools(m, cfg, ca, K, D)
-        m, _, done, _, out = jax.lax.while_loop(
+        m, _, done, _, count, out, counts = jax.lax.while_loop(
             cond, lambda c: step(c, pools),
-            (m, ca, jnp.bool_(False), jnp.int32(0), out0)
+            (m, ca, jnp.bool_(False), jnp.int32(0), jnp.int32(0), out0,
+             jnp.zeros(T, jnp.int32))
         )
-        # done flag rides the packed array's last column (row 0) so the host
-        # pays ONE transfer per call
-        flag = jnp.full((5, 1), jnp.inf, jnp.float32).at[0, 0].set(
-            jnp.where(done, 1.0, 0.0)
-        )
-        return jnp.concatenate([out, flag], axis=1), m
+        meta = jnp.zeros((4, T + 2), jnp.float32)
+        meta = meta.at[0, :T].set(counts.astype(jnp.float32))
+        meta = meta.at[0, T].set(count.astype(jnp.float32))
+        meta = meta.at[0, T + 1].set(jnp.where(done, 1.0, 0.0))
+        return jnp.concatenate([out, meta], axis=1), m
 
     return jax.jit(run)
+
+
+def _fetch_scan_result(packed, T: int):
+    """Host fetch of a :func:`_cached_scan_fn` result, minimizing transfer.
+
+    → (kind[n], p[n], s[n], d[n], step_counts[T], done) where n = total
+    committed actions.  Small outputs come over in one fetch; large ones
+    fetch the [4, T+2] meta tail first, then only the valid prefix rounded
+    up to a power of two (so the slice programs XLA compiles stay few and
+    cached).  Index values are < 2^24, exact in the f32 wire format."""
+    total_cols = packed.shape[1]
+    n_slots = total_cols - (T + 2)
+    if n_slots <= 4096:
+        arr = np.asarray(packed)
+        meta, body = arr[:, n_slots:], arr
+    else:
+        meta = np.asarray(packed[:, n_slots:])
+        count = int(meta[0, T])
+        n2 = 256
+        while n2 < count:
+            n2 <<= 1
+        body = np.asarray(packed[:, : min(n2, n_slots)])
+    counts = meta[0, :T].astype(np.int64)
+    n = int(meta[0, T])
+    done = bool(meta[0, T + 1] > 0)
+    kind, p, s, d = (body[i, :n].astype(np.int32) for i in range(4))
+    return kind, p, s, d, counts, done
 
 
 # ---------------------------------------------------------------------------------
@@ -673,27 +715,52 @@ def _np_broker_cost(cfg: TpuSearchConfig, can, cap, load, lnwin, pot, rc, lc):
     *live* aggregates with this function, so a single device round can commit
     hundreds of dependent actions without broker-disjointness restrictions —
     every committed action's improvement is exact, not stale.
+
+    Delegates to the batch form so the scalar and vectorized paths cannot
+    drift apart.
     """
+    return float(
+        _np_broker_cost_batch(
+            cfg, can,
+            np.asarray(cap)[None], np.asarray(load)[None],
+            np.asarray([lnwin]), np.asarray([pot]),
+            np.asarray([rc], np.float64), np.asarray([lc], np.float64),
+        )[0]
+    )
+
+
+def _np_broker_cost_batch(cfg: TpuSearchConfig, can, cap, load, lnwin, pot,
+                          rc, lc):
+    """Per-broker soft-goal cost, batch form: cap/load [n, R], rest [n].
+
+    The single source of the host-side cost math — the scalar
+    :func:`_np_broker_cost` delegates here (batch-vs-scalar replay parity is
+    additionally covered in tests/test_tpu_optimizer.py)."""
     cap = np.maximum(cap, 1e-9)
     util = load / cap
-    c = float(np.sum(util * util)) * cfg.w_util_var
+    c = np.sum(util * util, axis=1) * cfg.w_util_var
     over = np.maximum(util - can["util_upper"], 0.0)
     under = np.maximum(can["util_lower"] - util, 0.0)
-    c += float(np.sum(over + under)) * cfg.w_bound
-    c += float(np.sum(np.maximum(util - can["cap_threshold"], 0.0))) * 1000.0
+    c += np.sum(over + under, axis=1) * cfg.w_bound
+    c += np.sum(np.maximum(util - can["cap_threshold"], 0.0), axis=1) * 1000.0
     c += (rc / can["avg_rcount"] - 1.0) ** 2 * cfg.w_count
     c += (lc / can["avg_lcount"] - 1.0) ** 2 * cfg.w_leader_count
     c += (
-        max(rc - can["rcount_upper"], 0.0) + max(can["rcount_lower"] - rc, 0.0)
+        np.maximum(rc - can["rcount_upper"], 0.0)
+        + np.maximum(can["rcount_lower"] - rc, 0.0)
     ) / can["avg_rcount"] * cfg.w_bound
     c += (
-        max(lc - can["lcount_upper"], 0.0) + max(can["lcount_lower"] - lc, 0.0)
+        np.maximum(lc - can["lcount_upper"], 0.0)
+        + np.maximum(can["lcount_lower"] - lc, 0.0)
     ) / can["avg_lcount"] * cfg.w_bound
-    lnw = lnwin / cap[Resource.NW_IN]
+    lnw = lnwin / cap[:, Resource.NW_IN]
     c += lnw * lnw * cfg.w_leader_nwin
-    c += max(lnw - can["leader_nwin_upper"], 0.0) * cfg.w_bound
-    pot_u = pot / cap[Resource.NW_OUT]
-    c += max(pot_u - can["cap_threshold"][Resource.NW_OUT], 0.0) * cfg.w_pot_nwout
+    c += np.maximum(lnw - can["leader_nwin_upper"], 0.0) * cfg.w_bound
+    pot_u = pot / cap[:, Resource.NW_OUT]
+    c += (
+        np.maximum(pot_u - can["cap_threshold"][Resource.NW_OUT], 0.0)
+        * cfg.w_pot_nwout
+    )
     return c
 
 
@@ -800,6 +867,191 @@ class _HostEvaluator:
         )
         return action, delta
 
+    def commit_batch(self, kind, p, s, d) -> Tuple[List[BalancingAction], int]:
+        """Vectorized evaluate + apply of ONE device step's batch.
+
+        The device selected these actions with partitions, src brokers, and
+        dst brokers each pairwise-distinct (_match_batch) — but a broker MAY
+        be one action's dest and another action's src in the same batch
+        (the matcher allows it on purpose; see its conflict-set comment).
+        Evaluating the whole batch against the step-start snapshot therefore
+        matches the device's own acceptance semantics exactly, and by the
+        convexity argument in _match_batch any src/dst overlap only
+        *improves* realized deltas, so batch acceptance is the conservative
+        side of the gate the sequential replay applied.  The batched apply
+        stays exact under that overlap ONLY because every aggregate update
+        uses unbuffered accumulation (np.add.at) — do not "simplify" those
+        to fancy-index assignment, which drops one of two updates to a
+        broker that is src of one action and dst of another.  The
+        per-action Python replay this replaces cost ~180µs × 70k actions
+        ≈ 13s on a north-star run; this is the same arithmetic in a handful
+        of numpy passes per step.
+
+        Returns (accepted actions — already applied to the context, #rejected).
+        """
+        ctx, cfg, can = self.ctx, self.cfg, self.can
+        if ctx.replica_disk is not None:
+            # JBOD placement picks each move's destination disk from live
+            # disk loads (least_loaded_disk) — inherently sequential
+            acts: List[BalancingAction] = []
+            rej = 0
+            for i in range(kind.shape[0]):
+                action, delta = self.evaluate(
+                    int(kind[i]), int(p[i]), int(s[i]), int(d[i])
+                )
+                if action is None or delta >= cfg.improvement_tol:
+                    rej += 1
+                    continue
+                ctx.apply(action)
+                acts.append(action)
+            return acts, rej
+
+        n = kind.shape[0]
+        S = ctx.assignment.shape[1]
+        B = ctx.num_brokers
+        ar = np.arange(n)
+        sc = np.clip(s, 0, S - 1)
+        row = ctx.assignment[p]                              # [n, S]
+        slot_b = row[ar, sc]
+        lslot = ctx.leader_slot[p]
+        leader_b = row[ar, lslot]
+        is_lead = kind == KIND_LEADERSHIP
+        src = np.where(is_lead, leader_b, slot_b).astype(np.int64)
+        dst = np.where(is_lead, slot_b, d).astype(np.int64)
+        exists = slot_b != EMPTY_SLOT
+        leader_now = lslot == sc
+        must_move = ctx.replica_offline[p, sc]
+        excluded = self.excluded[p]
+
+        move_load = np.where(
+            leader_now[:, None], ctx.leader_load[p], ctx.follower_load[p]
+        ).astype(np.float64)
+        lead_delta = (ctx.leader_load[p] - ctx.follower_load[p]).astype(
+            np.float64
+        )
+        dload = np.where(is_lead[:, None], lead_delta, move_load)
+
+        dst_c = np.clip(dst, 0, B - 1)
+        src_c = np.clip(src, 0, B - 1)
+        cap_ok = (
+            ctx.broker_load[dst_c] + dload
+            <= ctx.broker_capacity[dst_c] * can["cap_threshold"] + 1e-6
+        ).all(axis=1)
+
+        row_safe = np.clip(row, 0, None)
+        dup = (row == dst[:, None]).any(axis=1) | (
+            ctx.offline_origin[p] == dst[:, None]
+        ).any(axis=1)
+        others = (row != EMPTY_SLOT) & (np.arange(S)[None, :] != sc[:, None])
+        other_racks = np.where(others, ctx.broker_rack[row_safe], -1)
+        rack_clash = (other_racks == ctx.broker_rack[dst_c][:, None]).any(axis=1)
+        move_ok = (
+            (d >= 0)
+            & (src != dst)
+            & exists
+            & self.dest_ok[dst_c]
+            & ~dup
+            & ~rack_clash
+            & cap_ok
+            & (ctx.broker_replica_count[dst_c] + 1 <= can["max_replicas"])
+            & ~(excluded & ~must_move)
+            & (~leader_now | self.lead_ok[dst_c])
+        )
+        lead_ok = (
+            exists & ~leader_now & self.lead_ok[dst_c] & ~must_move
+            & ~excluded & cap_ok
+        )
+        feasible = np.where(is_lead, lead_ok, move_ok) & (src >= 0)
+
+        l_delta = np.where(is_lead | leader_now, 1.0, 0.0)
+        r_delta = np.where(is_lead, 0.0, 1.0)
+        lnwin_delta = np.where(
+            is_lead | leader_now, ctx.leader_load[p, Resource.NW_IN], 0.0
+        ).astype(np.float64)
+        pot_delta = np.where(
+            is_lead, 0.0, ctx.leader_load[p, Resource.NW_OUT]
+        ).astype(np.float64)
+
+        def cost(b, dl, dlnw, dpot, drc, dlc):
+            return _np_broker_cost_batch(
+                cfg, can, ctx.broker_capacity[b],
+                ctx.broker_load[b] + dl,
+                ctx.broker_leader_load[b, Resource.NW_IN] + dlnw,
+                ctx.broker_potential_nw_out[b] + dpot,
+                ctx.broker_replica_count[b].astype(np.float64) + drc,
+                ctx.broker_leader_count[b].astype(np.float64) + dlc,
+            )
+
+        z1 = np.zeros(n)
+        zR = np.zeros((n, NUM_RESOURCES))
+        delta = (
+            cost(src_c, -dload, -lnwin_delta, -pot_delta, -r_delta, -l_delta)
+            - cost(src_c, zR, z1, z1, z1, z1)
+            + cost(dst_c, dload, lnwin_delta, pot_delta, r_delta, l_delta)
+            - cost(dst_c, zR, z1, z1, z1, z1)
+        )
+        delta += np.where(
+            is_lead, 0.0,
+            move_load[:, Resource.DISK] / can["avg_disk_cap"] * cfg.w_move_size,
+        )
+        lower = (np.arange(S)[None, :] < sc[:, None]) & (row != EMPTY_SLOT)
+        lower_racks = np.where(lower, ctx.broker_rack[row_safe], -1)
+        rack_viol = (lower_racks == ctx.broker_rack[src_c][:, None]).any(axis=1)
+        delta = np.where(~is_lead & must_move, delta - 1e6, delta)
+        delta = np.where(~is_lead & ~must_move & rack_viol, delta - 1e4, delta)
+
+        acc = feasible & (delta < cfg.improvement_tol)
+        idx = np.nonzero(acc)[0]
+        n_rej = n - idx.size
+        if not idx.size:
+            return [], n_rej
+
+        # ---- batched apply (numpy twin of ctx.apply for the disjoint set) ----
+        pm, sm = p[idx], sc[idx]
+        t = ctx.partition_topic[pm]
+        srcs, dsts = src[idx], dst[idx]
+        mv = ~is_lead[idx]
+        dl = dload[idx]
+        ctx.assignment[pm[mv], sm[mv]] = dsts[mv].astype(np.int32)
+        ctx.replica_offline[pm[mv], sm[mv]] = False
+        ctx.leader_slot[pm[~mv]] = sm[~mv]
+        np.add.at(ctx.broker_load, srcs, -dl)
+        np.add.at(ctx.broker_load, dsts, dl)
+        one = np.ones(int(mv.sum()), np.int64)
+        np.add.at(ctx.broker_replica_count, srcs[mv], -one)
+        np.add.at(ctx.broker_replica_count, dsts[mv], one)
+        np.add.at(ctx.broker_topic_replica_count, (srcs[mv], t[mv]), -one)
+        np.add.at(ctx.broker_topic_replica_count, (dsts[mv], t[mv]), one)
+        np.add.at(ctx.broker_potential_nw_out, srcs, -pot_delta[idx])
+        np.add.at(ctx.broker_potential_nw_out, dsts, pot_delta[idx])
+        ll = l_delta[idx] > 0          # leadership landed on dst
+        lone = np.ones(int(ll.sum()), np.int64)
+        np.add.at(ctx.broker_leader_count, srcs[ll], -lone)
+        np.add.at(ctx.broker_leader_count, dsts[ll], lone)
+        lload = ctx.leader_load[pm[ll]].astype(np.float64)
+        np.add.at(ctx.broker_leader_load, srcs[ll], -lload)
+        np.add.at(ctx.broker_leader_load, dsts[ll], lload)
+        np.add.at(ctx.broker_topic_leader_count, (srcs[ll], t[ll]), -lone)
+        np.add.at(ctx.broker_topic_leader_count, (dsts[ll], t[ll]), lone)
+
+        acts = []
+        old_lslot = lslot[idx]
+        for j in range(idx.size):
+            if mv[j]:
+                a = BalancingAction(
+                    ActionType.INTER_BROKER_REPLICA_MOVEMENT,
+                    int(pm[j]), int(sm[j]), int(srcs[j]), int(dsts[j]),
+                )
+            else:
+                a = BalancingAction(
+                    ActionType.LEADERSHIP_MOVEMENT,
+                    int(pm[j]), int(old_lslot[j]), int(srcs[j]), int(dsts[j]),
+                    dest_slot=int(sm[j]),
+                )
+            acts.append(a)
+        ctx.actions.extend(acts)
+        return acts, n_rej
+
 
 def _pack_round_result(scores, kind, cp, cs, cd) -> jax.Array:
     """Pack the round's top-k into ONE f32 [5, k] array.
@@ -823,6 +1075,22 @@ def _unpack_round_result(packed) -> Tuple:
         for i in range(1, 5)
     )
     return scores, kind, cp, cs, cd
+
+
+def _resync_device_model(m: DeviceModel, ctx: AnalyzerContext) -> DeviceModel:
+    """Rebuild device placement + aggregates from the live host context
+    (after a host-side rejection or before a polish phase)."""
+    must = (
+        jnp.asarray(ctx.replica_offline) if ctx.replica_offline.any()
+        else jnp.zeros(ctx.assignment.shape, bool)
+    )
+    m = dataclasses.replace(
+        m,
+        assignment=jnp.asarray(ctx.assignment),
+        leader_slot=jnp.asarray(ctx.leader_slot),
+        must_move=must,
+    )
+    return _recompute_aggregates(m)
 
 
 def _resolve_scoring(cfg: TpuSearchConfig, mesh) -> str:
@@ -1267,20 +1535,36 @@ class TpuGoalOptimizer:
 
     def _device_model(self, ctx: AnalyzerContext) -> DeviceModel:
         excluded = ctx.excluded_partition_mask()
+        P, S = ctx.assignment.shape
+        # the P- and P·S-shaped masks are usually trivial (healthy cluster,
+        # no exclusions) — build those on device instead of paying ~20MB of
+        # host→device transfer for arrays of constants.  partition_topic is
+        # carried for shape parity but never read by the device scorers
+        # (topic-distribution goals are host-side), so it never transfers.
+        any_off = bool(ctx.replica_offline.any())
         m = DeviceModel(
             assignment=jnp.asarray(ctx.assignment),
             leader_slot=jnp.asarray(ctx.leader_slot),
             leader_load=jnp.asarray(ctx.leader_load),
             follower_load=jnp.asarray(ctx.follower_load),
-            partition_topic=jnp.asarray(ctx.partition_topic),
+            partition_topic=jnp.zeros(P, jnp.int32),
             capacity=jnp.asarray(ctx.broker_capacity),
             rack=jnp.asarray(ctx.broker_rack),
             dest_ok=jnp.asarray(ctx.dest_candidates()),
             lead_ok=jnp.asarray(ctx.leadership_candidates()),
             alive=jnp.asarray(ctx.broker_alive),
-            excluded=jnp.asarray(excluded),
-            must_move=jnp.asarray(ctx.replica_offline),
-            offline_origin=jnp.asarray(ctx.offline_origin),
+            excluded=(
+                jnp.asarray(excluded) if excluded.any()
+                else jnp.zeros(P, bool)
+            ),
+            must_move=(
+                jnp.asarray(ctx.replica_offline) if any_off
+                else jnp.zeros((P, S), bool)
+            ),
+            offline_origin=(
+                jnp.asarray(ctx.offline_origin) if any_off
+                else jnp.full((P, S), EMPTY_SLOT, jnp.int32)
+            ),
             broker_load=jnp.zeros((ctx.num_brokers, NUM_RESOURCES), jnp.float32),
             leader_nwin=jnp.zeros(ctx.num_brokers, jnp.float32),
             pot_nwout=jnp.zeros(ctx.num_brokers, jnp.float32),
@@ -1379,29 +1663,27 @@ class TpuGoalOptimizer:
                 if budget_exhausted():
                     break
                 packed, m_new = scan_fn(m, ca)
-                arr = np.asarray(packed)
-                device_done = bool(arr[0, -1] > 0)
-                scores, k_top, p_top, s_top, d_top = _unpack_round_result(
-                    arr[:, :-1]
+                k_all, p_all, s_all, d_all, step_counts, device_done = (
+                    _fetch_scan_result(packed, cfg.steps_per_call)
                 )
                 batch, rejected = 0, 0
-                for t in range(scores.shape[0]):
-                    if not np.isfinite(scores[t]):
-                        continue  # unused slot of a partially-filled step
-                    action, delta = evaluator.evaluate(
-                        int(k_top[t]), int(p_top[t]), int(s_top[t]),
-                        int(d_top[t]),
-                    )
-                    if action is None or delta >= cfg.improvement_tol:
-                        # f32 device math disagreed with the f64 recheck on
-                        # this action; skip it but keep validating the rest
-                        # of the sequence — later actions are exact-checked
-                        # against the live context, so order is safe
-                        rejected += 1
+                off = 0
+                for c in step_counts:
+                    c = int(c)
+                    if c == 0:
                         continue
-                    ctx.apply(action)
-                    actions.append(action)
-                    batch += 1
+                    # one device step = one disjoint batch: vectorized
+                    # exact recheck + apply.  A rejection (f32 device math
+                    # vs the f64 recheck) skips just that action; later
+                    # steps still validate against the live context
+                    acts, n_rej = evaluator.commit_batch(
+                        k_all[off:off + c], p_all[off:off + c],
+                        s_all[off:off + c], d_all[off:off + c],
+                    )
+                    off += c
+                    actions.extend(acts)
+                    batch += len(acts)
+                    rejected += n_rej
                 if not batch:
                     break  # nothing validated — no further progress possible
                 if not rejected:
@@ -1417,13 +1699,7 @@ class TpuGoalOptimizer:
                 else:
                     # device state includes skipped actions — rebuild from
                     # the live context before the next call
-                    m = dataclasses.replace(
-                        m,
-                        assignment=jnp.asarray(ctx.assignment),
-                        leader_slot=jnp.asarray(ctx.leader_slot),
-                        must_move=jnp.asarray(ctx.replica_offline),
-                    )
-                    m = _recompute_aggregates(m)
+                    m = _resync_device_model(m, ctx)
             # polish: fall through to the score-only loop.  The device scan
             # batches per-src-broker candidates, whose coarser granularity
             # converges a few percent short of sequential search; the score-
@@ -1433,13 +1709,7 @@ class TpuGoalOptimizer:
             # real time at the 1M-partition scale.
             rounds_budget = cfg.polish_rounds
             if rounds_budget:
-                m = dataclasses.replace(
-                    m,
-                    assignment=jnp.asarray(ctx.assignment),
-                    leader_slot=jnp.asarray(ctx.leader_slot),
-                    must_move=jnp.asarray(ctx.replica_offline),
-                )
-                m = _recompute_aggregates(m)
+                m = _resync_device_model(m, ctx)
         else:
             rounds_budget = cfg.max_rounds
 
@@ -1475,13 +1745,7 @@ class TpuGoalOptimizer:
                     break
             if not batch:
                 break
-            m = dataclasses.replace(
-                m,
-                assignment=jnp.asarray(ctx.assignment),
-                leader_slot=jnp.asarray(ctx.leader_slot),
-                must_move=jnp.asarray(ctx.replica_offline),
-            )
-            m = _recompute_aggregates(m)
+            m = _resync_device_model(m, ctx)
 
         return self._finalize(
             state, ctx, goals, actions, violations_before, stats_before,

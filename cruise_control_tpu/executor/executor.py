@@ -129,9 +129,16 @@ class Executor:
         """
         ongoing = set(self.backend.ongoing_reassignments())
         if ongoing and stop:
-            cancel = getattr(self.backend, "cancel_reassignments", None)
-            if cancel is not None:
-                cancel(ongoing)
+            try:
+                self.backend.cancel_reassignments(ongoing)
+            except NotImplementedError:
+                # a minimal adapter may not support cancellation; leave the
+                # reassignments to finish under the cluster's own control
+                self.adopted_at_startup = ongoing
+                return ongoing
+            # cancelled work is not in flight: nothing to adopt or gate on
+            self.adopted_at_startup = set()
+            return ongoing
         self.adopted_at_startup = ongoing
         return ongoing
 
@@ -146,6 +153,17 @@ class Executor:
         async task submission lives in the server layer (UserTaskManager)."""
         if self.has_ongoing_execution:
             raise OngoingExecutionError("an execution is already in progress")
+        if self.adopted_at_startup:
+            # reassignments adopted from a previous instance: issuing a new
+            # plan could produce conflicting targets for the same partitions;
+            # refuse until the adopted set drains (refreshed live, so callers
+            # can simply retry)
+            self.adopted_at_startup &= set(self.backend.ongoing_reassignments())
+            if self.adopted_at_startup:
+                raise OngoingExecutionError(
+                    "reassignments adopted at startup are still in flight: "
+                    f"{sorted(self.adopted_at_startup)}"
+                )
         self.state = ExecutorStateValue.STARTING_EXECUTION
         self._stop_requested = False
         sizes = partition_sizes or {}

@@ -380,3 +380,45 @@ def test_detect_ongoing_at_startup_adopts_or_stops():
     # stop=True cancels in the cluster
     assert ex.detect_ongoing_at_startup(stop=True) == {0}
     assert backend.ongoing_reassignments() == set()
+
+
+def test_adopted_reassignments_gate_new_plans():
+    """A new plan must be refused while reassignments adopted at startup are
+    still in flight (conflicting targets otherwise), allowed again once they
+    drain, and stop=True clears the gate immediately (nothing left in
+    flight to adopt)."""
+    import pytest
+
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import (
+        Executor,
+        OngoingExecutionError,
+    )
+
+    def fresh_backend():
+        b = SimulatedClusterBackend(
+            {0: [0, 1], 1: [1, 2]}, {0: 0, 1: 1}, brokers={0, 1, 2},
+        )
+        b.alter_partition_reassignments({0: [0, 2]})
+        return b
+
+    backend = fresh_backend()
+    ex = Executor(backend)
+    ex.detect_ongoing_at_startup()
+    plan = [prop(1, [1, 2], [1, 0])]
+    with pytest.raises(OngoingExecutionError, match="adopted at startup"):
+        ex.execute_proposals(plan)
+    # drain the adopted reassignment, then the same call succeeds
+    while backend.ongoing_reassignments():
+        backend.tick()
+    result = ex.execute_proposals(plan)
+    assert result.completed == 1
+    assert ex.adopted_at_startup == set()
+
+    # stop=True cancels in-cluster work: no gate, and state() has nothing
+    # adopted to report
+    backend2 = fresh_backend()
+    ex2 = Executor(backend2)
+    ex2.detect_ongoing_at_startup(stop=True)
+    assert ex2.adopted_at_startup == set()
+    assert ex2.execute_proposals(plan).completed == 1
