@@ -22,6 +22,8 @@ import numpy as np
 
 
 def main() -> None:
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+    _jc()
     from cruise_control_tpu.models.generators import random_cluster
     from cruise_control_tpu.analyzer.goal_optimizer import GoalOptimizer
     from cruise_control_tpu.analyzer.tpu_optimizer import TpuGoalOptimizer

@@ -35,6 +35,8 @@ def _measure(name, state, optimizer, goals, warm=True):
 
 
 def main() -> None:
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+    _jc()
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="shrink config #5 to a smoke-test size")

@@ -43,6 +43,8 @@ def bench(fn, *args, reps=3):
 
 
 def main() -> None:
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+    _jc()
     ap = argparse.ArgumentParser()
     ap.add_argument("--brokers", type=int, default=10000)
     ap.add_argument("--partitions", type=int, default=1000000)

@@ -40,11 +40,16 @@ def timed(name, fn):
 
 
 def main() -> None:
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+    _jc()
     ap = argparse.ArgumentParser()
     ap.add_argument("--brokers", type=int, default=10000)
     ap.add_argument("--partitions", type=int, default=1000000)
     ap.add_argument("--racks", type=int, default=200)
     ap.add_argument("--budget", type=float, default=0.0)
+    ap.add_argument("--warm", action="store_true",
+                    help="run optimize twice; report the second (compile "
+                         "amortized) with phase timers reset")
     args = ap.parse_args()
 
     import cruise_control_tpu.analyzer.tpu_optimizer as T
@@ -64,6 +69,18 @@ def main() -> None:
     T.TpuGoalOptimizer._device_model = timed(
         "upload", T.TpuGoalOptimizer._device_model
     )
+    step_counts_log = []
+    orig_fetch = T._fetch_scan_result
+
+    def fetch_wrap(packed, Tn):
+        t0 = time.perf_counter()
+        out = orig_fetch(packed, Tn)
+        TIMES["fetch"] += time.perf_counter() - t0
+        COUNTS["fetch"] += 1
+        step_counts_log.append(out[4].copy())
+        return out
+
+    T._fetch_scan_result = fetch_wrap
     T.TpuGoalOptimizer._finalize = timed("finalize", T.TpuGoalOptimizer._finalize)
 
     orig_scan = T._cached_scan_fn
@@ -85,6 +102,11 @@ def main() -> None:
 
     cfg = T.TpuSearchConfig(time_budget_s=args.budget)
     opt = T.TpuGoalOptimizer(config=cfg)
+    if args.warm:
+        opt.optimize(state)
+        TIMES.clear()
+        COUNTS.clear()
+        step_counts_log.clear()
     t0 = time.perf_counter()
     result = opt.optimize(state)
     total = time.perf_counter() - t0
@@ -95,12 +117,29 @@ def main() -> None:
         "phases": {k: round(v, 2) for k, v in sorted(TIMES.items())},
         "counts": dict(COUNTS),
     }
-    other = total - sum(
-        v for k, v in TIMES.items() if k not in ("gen", "ctx_init")
-    ) + TIMES["ctx_init"] * 0  # ctx_init happens inside optimize
     out["phases"]["untracked"] = round(
         total - sum(v for k, v in TIMES.items() if k != "gen"), 2
     )
+    if step_counts_log:
+        import numpy as np
+
+        # counts[t] for steps that never ran stay 0 — approximate the
+        # executed-step count by trimming each call's counts just past its
+        # final nonzero index (keeping one trailing zero-commit step, which
+        # is a real executed step: the convergence probe)
+        executed = []
+        for c in step_counts_log:
+            nz = np.nonzero(c)[0]
+            executed.append(c[: (nz[-1] + 2 if nz.size else 1)])
+        ex = np.concatenate(executed)
+        out["steps"] = {
+            "executed": int(ex.size),
+            "actions": int(ex.sum()),
+            "mean_commits": round(float(ex.mean()), 1),
+            "p50": int(np.percentile(ex, 50)),
+            "p90": int(np.percentile(ex, 90)),
+            "max": int(ex.max()),
+        }
     print(json.dumps(out, indent=1))
 
 

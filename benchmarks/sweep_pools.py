@@ -1,0 +1,58 @@
+"""Sweep (K, D) pool sizes on a large config: wall-clock vs plan quality.
+
+Usage:
+    PYTHONPATH=.:/root/.axon_site python benchmarks/sweep_pools.py \
+        [--brokers 10000] [--partitions 1000000] [--warm]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> None:
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+    _jc()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--brokers", type=int, default=10000)
+    ap.add_argument("--partitions", type=int, default=1000000)
+    ap.add_argument("--racks", type=int, default=200)
+    ap.add_argument("--warm", action="store_true",
+                    help="one untimed pass per config first")
+    ap.add_argument("--configs", default="8192x1024,4096x512,2048x512")
+    args = ap.parse_args()
+
+    from cruise_control_tpu.analyzer.goal_optimizer import make_goals
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.analyzer.verifier import violation_score
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(
+        seed=5, num_brokers=args.brokers, num_racks=args.racks,
+        num_partitions=args.partitions,
+    )
+    goals = make_goals()
+
+    for spec in args.configs.split(","):
+        k, d = (int(x) for x in spec.split("x"))
+        cfg = TpuSearchConfig(max_source_replicas=k, max_dest_brokers=d)
+        opt = TpuGoalOptimizer(config=cfg)
+        if args.warm:
+            opt.optimize(state)
+        t0 = time.perf_counter()
+        res = opt.optimize(state)
+        print(json.dumps({
+            "K": k, "D": d,
+            "wallclock_s": round(time.perf_counter() - t0, 2),
+            "actions": len(res.actions),
+            "violation_score": int(violation_score(res.final_state, goals)),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

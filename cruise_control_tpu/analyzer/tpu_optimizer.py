@@ -79,6 +79,10 @@ class TpuSearchConfig:
     #: the per-step rescore cost scales linearly with the budget.
     candidate_budget: int = 1 << 23
     max_source_replicas: int = 8192
+    #: destination-pool cap (D ≤ min(B, this)).  The auction commits at most
+    #: one move per destination per step and typical step batches are tens
+    #: of actions, so D far above the commit rate only buys rescore cost
+    max_dest_brokers: int = 1024
     #: top-k candidates returned from device per round; the host exact-recheck
     #: commits as many of them as still improve, so this bounds the
     #: actions-per-round and therefore the number of device round-trips
@@ -102,13 +106,21 @@ class TpuSearchConfig:
     #: (ops.grid); "pallas" runs the fused VMEM kernel (ops.pallas_grid);
     #: "auto" picks pallas on TPU (single-device), grid elsewhere
     scoring: str = "auto"
-    #: device-resident search: run this many (rescore → select → apply)
-    #: steps per device call inside a lax.while_loop, so host↔device
-    #: round-trips AND per-call pool builds amortize T-fold.  0 disables
-    #: (score-only rounds with host-side batch commit).  Single-device
-    #: engines only; the host still exact-rechecks every returned action
-    #: before accepting it.
-    steps_per_call: int = 64
+    #: device-resident search: run up to this many (rescore → select →
+    #: apply) steps per device call inside a lax.while_loop, so host↔device
+    #: round-trips amortize T-fold.  0 disables (score-only rounds with
+    #: host-side batch commit).  Single-device engines only; the host still
+    #: exact-rechecks every returned action before accepting it.  Each call
+    #: costs ~seconds of fixed dispatch/marshalling overhead on a tunneled
+    #: device, so the cap is high and convergence/repooling live on device.
+    steps_per_call: int = 512
+    #: rebuild the candidate pools on device every this many steps (and
+    #: immediately after any step that commits nothing on stale pools).
+    #: Pool builds are P·S-scale — the priority scan over every replica —
+    #: so they are amortized across a window of steps; within a window the
+    #: membership drifts negligibly while scoring stays live.  A step that
+    #: commits nothing right after a repool ends the call (converged)
+    repool_steps: int = 64
     #: conflict-free actions committed per device step: the top candidates
     #: are greedily filtered to disjoint (src broker, dst broker, partition)
     #: sets, whose deltas are exactly independent — one rescore then commits
@@ -120,6 +132,11 @@ class TpuSearchConfig:
     #: replica evacuation) always runs to completion — only soft-goal
     #: refinement is cut short, and _finalize still enforces hard goals
     time_budget_s: float = 0.0
+    #: when set, wrap the device search in a ``jax.profiler.trace`` written
+    #: here (TensorBoard/XProf-viewable) — the kernel-granularity analog of
+    #: the reference's Dropwizard ``proposal-computation-timer`` (SURVEY.md
+    #: §5.1); the coarse timer still lands in the shared metric registry
+    profiler_trace_dir: str = ""
     #: score-only rounds run after the device-resident search converges: the
     #: finer per-source candidate granularity can recover a last slice of
     #: plan quality.  Off by default — device-only plans already beat the
@@ -569,18 +586,29 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
     batch-apply) steps per call, each committing ≤ device_batch_per_step
     conflict-free actions, exiting early on convergence (lax.while_loop).
 
-    Returns (packed [4, T·M + T + 2] f32, updated model).  Columns
-    [0, T·M): committed (kind, p, s, dst) rows in commit order, written
-    *compacted* — each step's accepted batch lands at the running total
-    offset, so every valid entry is contiguous from column 0.  Row 0 of the
-    tail columns carries the meta: per-step accepted counts [T], then the
-    total count, then the done flag.  The compaction lets the host fetch
-    the tiny meta first and then only the valid prefix
+    Returns (packed [4, slots + T + 2] f32, updated model) with
+    slots = min(T, repool_steps)·M.  Columns [0, slots): committed
+    (kind, p, s, dst) rows in commit order, written *compacted* — each
+    step's accepted batch lands at the running total offset, so every valid
+    entry is contiguous from column 0 (the call also ends if the next step
+    could overflow the slot budget; the host just calls again).  Row 0 of
+    the tail columns carries the meta: per-step accepted counts [T], then
+    the total count, then the done flag.  The compaction lets the host
+    fetch the tiny meta first and then only the valid prefix
     (:func:`_fetch_scan_result`): the fixed-layout alternative moves
     T·M slots per call (~1.3MB at the 1M-partition shapes) over a device
     link that runs ~5MB/s tunneled, which alone was ~15s of a north-star
-    run.  The host replays the sequence through the exact evaluator and
-    reuses the returned model when every action validates (the common
+    run.
+
+    Candidate pools are rebuilt ON DEVICE every ``cfg.repool_steps`` steps
+    (and right after a zero-commit step on stale pools), so one call spans
+    many pool generations: per-call fixed cost (remote dispatch +
+    marshalling, ~2s on the tunneled chip) amortizes over hundreds of
+    steps instead of being paid once per pool generation.  A zero-commit
+    step on FRESH pools sets the done flag — the same convergence signal a
+    fresh call committing nothing used to give the host, minus the
+    round-trip.  The host replays the sequence through the exact evaluator
+    and reuses the returned model when every action validates (the common
     case)."""
     from cruise_control_tpu.ops.grid import move_grid_scores
 
@@ -588,9 +616,17 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
     if use_pallas:
         from cruise_control_tpu.ops.pallas_grid import move_grid_scores_pallas
     M = cfg.device_batch_per_step
+    repool = max(1, cfg.repool_steps)
 
-    def step(carry, pools):
-        m, ca, done, t, count, out, counts = carry
+    def step(carry):
+        m, ca, done, t, count, out, counts, pools, since_pool = carry
+        need_pool = since_pool >= repool
+        pools = jax.lax.cond(
+            need_pool,
+            lambda: _build_pools(m, cfg, ca, K, D),
+            lambda: pools,
+        )
+        since_pool = jnp.where(need_pool, 0, since_pool)
         P, S = m.assignment.shape
         B = m.capacity.shape[0]
         M_ = min(M, 2 * B)
@@ -642,30 +678,43 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
             ]
         )                                                # [4, M_]
         # compacted write: offset = actions committed so far, so the next
-        # step overwrites this one's invalid tail.  Max offset is
-        # (T-1)·M_ (each step advances count by ≤ M_), so the slice never
-        # clamps
+        # step overwrites this one's invalid tail.  The loop condition
+        # guarantees count ≤ slots - M_ on entry, so the slice never clamps
         out = jax.lax.dynamic_update_slice(out, batch, (0, count))
         counts = counts.at[t].set(c_step)
-        return (m, ca, done | (c_step == 0), t + 1, count + c_step, out,
-                counts)
+        # zero commits on fresh pools = converged; on stale pools = force a
+        # repool next step and keep going
+        done = done | ((c_step == 0) & (since_pool == 0))
+        since_pool = jnp.where(c_step == 0, repool, since_pool + 1)
+        return (m, ca, done, t + 1, count + c_step, out, counts, pools,
+                since_pool)
 
-    def cond(carry):
-        _, _, done, t, _, _, _ = carry
-        return (~done) & (t < T)
+    def cond_fn(slots):
+        def cond(carry):
+            _, _, done, t, count, _, _, _, _ = carry
+            return (~done) & (t < T) & (count <= slots)
+        return cond
 
     def run(m: DeviceModel, ca):
-        M_ = min(M, 2 * m.capacity.shape[0])
-        out0 = jnp.full((4, T * M_), -1.0, jnp.float32)
-        # pools are computed ONCE per call and closed over by the loop body:
-        # the P·S-scale pruning passes would otherwise dominate every step
-        # at the 1M-partition scale (pool membership drifts negligibly
-        # within one call; scoring inside the step stays live)
-        pools = _build_pools(m, cfg, ca, K, D)
-        m, _, done, _, count, out, counts = jax.lax.while_loop(
-            cond, lambda c: step(c, pools),
+        B = m.capacity.shape[0]
+        M_ = min(M, 2 * B)
+        # slot budget bounds memory like the pre-repool layout did (T and
+        # repool_steps were the same number then); commits beyond it simply
+        # end the call and the host issues another
+        slots = min(T, repool) * M_
+        out0 = jnp.full((4, slots), -1.0, jnp.float32)
+        pools0 = (
+            jnp.zeros(K, jnp.int32), jnp.zeros(K, jnp.int32),
+            jnp.zeros(D, jnp.int32),
+            jnp.zeros(_leadership_pool_size(*m.assignment.shape, K),
+                      jnp.int32),
+            jnp.zeros(_leadership_pool_size(*m.assignment.shape, K),
+                      jnp.int32),
+        )
+        m, _, done, _, count, out, counts, _, _ = jax.lax.while_loop(
+            cond_fn(slots - M_), step,
             (m, ca, jnp.bool_(False), jnp.int32(0), jnp.int32(0), out0,
-             jnp.zeros(T, jnp.int32))
+             jnp.zeros(T, jnp.int32), pools0, jnp.int32(repool)),
         )
         meta = jnp.zeros((4, T + 2), jnp.float32)
         meta = meta.at[0, :T].set(counts.astype(jnp.float32))
@@ -1579,7 +1628,7 @@ class TpuGoalOptimizer:
         # step, so on large clusters the K×D budget leans toward D (dest
         # slots bound batch size); sources re-pool every call, so a smaller
         # K costs little
-        D = max(8, min(B, 1024))
+        D = max(8, min(B, cfg.max_dest_brokers))
         K = min(P * S, cfg.max_source_replicas,
                 max(256, cfg.candidate_budget // D))
         return K, min(D, B, max(8, cfg.candidate_budget // max(K, 1)))
@@ -1607,6 +1656,24 @@ class TpuGoalOptimizer:
         violations_before = {g.name: g.violations(ctx) for g in goals}
         stats_before = stats_summary(cluster_stats(state))
 
+        import contextlib
+
+        trace_ctx = (
+            jax.profiler.trace(cfg.profiler_trace_dir)
+            if cfg.profiler_trace_dir else contextlib.nullcontext()
+        )
+        with trace_ctx:
+            return self._search(
+                state, ctx, goals, violations_before, stats_before,
+                initial_assignment, initial_leader_slot, initial_replica_disk,
+                t0, cfg,
+            )
+
+    def _search(
+        self, state, ctx, goals, violations_before, stats_before,
+        initial_assignment, initial_leader_slot, initial_replica_disk, t0,
+        cfg,
+    ) -> OptimizerResult:
         m = self._device_model(ctx)
         can = self._constraint_arrays_np(ctx)
         ca = {k: jnp.asarray(v) for k, v in can.items()}
@@ -1688,13 +1755,11 @@ class TpuGoalOptimizer:
                     break  # nothing validated — no further progress possible
                 if not rejected:
                     m = m_new
-                    # device_done means the CALL's pool is exhausted.  When
-                    # the pool covers the whole candidate space (small
-                    # models) that IS convergence; on pruned pools (large
-                    # models) the next call re-pools and continues — true
-                    # convergence is then the `not batch` break above (a
-                    # fresh-pool call that commits nothing)
-                    if device_done and K >= P * S and D >= B:
+                    # device_done = a freshly-repooled step committed
+                    # nothing: converged under the pool regime (the same
+                    # signal a fresh call committing nothing used to give,
+                    # without the extra round-trip)
+                    if device_done:
                         break
                 else:
                     # device state includes skipped actions — rebuild from

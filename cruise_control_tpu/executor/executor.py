@@ -131,7 +131,7 @@ class Executor:
         if ongoing and stop:
             try:
                 self.backend.cancel_reassignments(ongoing)
-            except NotImplementedError:
+            except (NotImplementedError, AttributeError):
                 # a minimal adapter may not support cancellation; leave the
                 # reassignments to finish under the cluster's own control
                 self.adopted_at_startup = ongoing

@@ -1,0 +1,32 @@
+"""Persistent XLA compilation cache (opt-in helper).
+
+The search programs at north-star shapes take minutes of XLA compile time
+on first use in a process; the server amortizes that via its precompute
+threads, but one-shot entry points (bench.py, benchmarks/, driver runs)
+pay it every process.  JAX's persistent compilation cache keeps compiled
+executables on disk keyed by program fingerprint, so repeat invocations
+skip compilation entirely (when the backend supports executable
+serialization; otherwise this is a silent no-op).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable(cache_dir: str | None = None) -> None:
+    import jax
+
+    cache_dir = cache_dir or os.environ.get(
+        "CRUISE_JIT_CACHE", os.path.join(os.path.dirname(__file__),
+                                         "..", "..", ".jax_cache")
+    )
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything, however small/fast-compiling
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # unknown flags on an older jax: keep going uncached
