@@ -1,0 +1,147 @@
+"""Loop-amortized timing of the device step's components at scale.
+
+Each component runs inside lax.fori_loop(ITERS) within ONE jit call, so
+per-iteration cost excludes dispatch/marshalling overhead — the number that
+actually multiplies by search steps.
+
+Usage: PYTHONPATH=.:/root/.axon_site python benchmarks/profile_step_parts.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_loop(make_body, iters, *args):
+    """Time fn applied `iters` times inside one jit; returns s/iter."""
+
+    @jax.jit
+    def run(*a):
+        def body(i, carry):
+            return make_body(i, carry)
+        return jax.lax.fori_loop(0, iters, body, a)
+
+    out = run(*args)
+    jax.block_until_ready(out)
+    np.asarray(jnp.ravel(jax.tree_util.tree_leaves(out)[0])[0])
+    t0 = time.perf_counter()
+    out = run(*args)
+    jax.block_until_ready(out)
+    np.asarray(jnp.ravel(jax.tree_util.tree_leaves(out)[0])[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+    _jc()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--brokers", type=int, default=10000)
+    ap.add_argument("--partitions", type=int, default=1000000)
+    ap.add_argument("--iters", type=int, default=32)
+    args = ap.parse_args()
+
+    import cruise_control_tpu.analyzer.tpu_optimizer as T
+    from cruise_control_tpu.analyzer.context import AnalyzerContext
+    from cruise_control_tpu.models.generators import random_cluster
+    from cruise_control_tpu.ops.grid import move_grid_scores
+    from cruise_control_tpu.common.resources import Resource
+
+    state = random_cluster(
+        seed=5, num_brokers=args.brokers, num_racks=200,
+        num_partitions=args.partitions,
+    )
+    opt = T.TpuGoalOptimizer()
+    cfg = opt.config
+    ctx = AnalyzerContext(state)
+    m = opt._device_model(ctx)
+    ca = opt._constraint_arrays(ctx)
+    P, S, B = ctx.num_partitions, ctx.max_rf, ctx.num_brokers
+    K, D = opt._pool_sizes(P, S, B)
+    res = {"K": K, "D": D, "iters": args.iters}
+    I = args.iters
+
+    pools = jax.jit(
+        lambda m, ca: T._build_pools(m, cfg, ca, K, D)
+    )(m, ca)
+    kp, ks, dest_pool, lp, lsl = pools
+
+    # vary an input per iteration (add i*0) so XLA cannot hoist the body
+    def grid_body(i, carry):
+        m_, acc = carry
+        g = move_grid_scores(m_, cfg, ca, kp + i * 0, ks, dest_pool)
+        return m_, acc + g[0, 0]
+
+    res["grid_ms"] = round(
+        bench_loop(grid_body, I, m, jnp.float32(0)) * 1e3, 2)
+
+    def grid_top_body(i, carry):
+        m_, acc = carry
+        g = move_grid_scores(m_, cfg, ca, kp + i * 0, ks, dest_pool)
+        neg_best, best_i = jax.lax.top_k(-g, T.DESTS_PER_SOURCE)
+        return m_, acc + neg_best[0, 0]
+
+    res["grid_top8_ms"] = round(
+        bench_loop(grid_top_body, I, m, jnp.float32(0)) * 1e3, 2)
+
+    def lead_body(i, carry):
+        m_, acc = carry
+        s, _ = T._score_candidates(
+            m_, cfg, ca, jnp.ones_like(lp), lp + i * 0, lsl,
+            jnp.zeros_like(lp))
+        return m_, acc + s[0]
+
+    res["lead_rescore_ms"] = round(
+        bench_loop(lead_body, I, m, jnp.float32(0)) * 1e3, 2)
+
+    def pools_body(i, carry):
+        m_, acc = carry
+        kp_, ks_, dp_, lp_, lsl_ = T._build_pools(m_, cfg, ca, K, D)
+        return m_, acc + kp_[0].astype(jnp.float32) + i * 0
+
+    res["build_pools_ms"] = round(
+        bench_loop(pools_body, max(4, I // 8), m, jnp.float32(0)) * 1e3, 2)
+
+    # matcher on representative shapes
+    Q = max(1, cfg.moves_per_src)
+    N = (Q + 1) * B
+    R = T.DESTS_PER_SOURCE
+    rng = np.random.default_rng(0)
+    cand_score = jnp.asarray(-rng.random((N, R)).astype(np.float32))
+    cand_dst = jnp.asarray(rng.integers(0, B, (N, R)).astype(np.int32))
+    cand_src = jnp.asarray(rng.integers(0, B, N).astype(np.int32))
+    cand_p = jnp.asarray(rng.integers(0, P, N).astype(np.int32))
+    move_vec = jnp.asarray(rng.random((N, 6)).astype(np.float32))
+    src_b = jnp.asarray(rng.random((B, 6)).astype(np.float32) * 3)
+    dst_b = jnp.asarray(rng.random((B, 6)).astype(np.float32) * 3)
+    qual = jnp.asarray(rng.random(N) < 0.5)
+
+    def match_body(i, carry):
+        sc, acc = carry
+        take, ws, wd = T._match_batch(
+            sc + i * 0, cand_dst, cand_src, cand_p, -1e-4, B, P,
+            move_vec=move_vec, src_budget=src_b, dst_budget=dst_b,
+            qualified=qual)
+        return sc, acc + ws[0]
+
+    res["match_ms"] = round(
+        bench_loop(match_body, I, cand_score, jnp.float32(0)) * 1e3, 2)
+
+    def topm_body(i, carry):
+        sc, acc = carry
+        vals, order = jax.lax.top_k(-(sc[:, 0] + i * 0), 1024)
+        return sc, acc - vals[0]
+
+    res["topM_ms"] = round(
+        bench_loop(topm_body, I, cand_score, jnp.float32(0)) * 1e3, 2)
+
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

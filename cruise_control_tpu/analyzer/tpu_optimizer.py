@@ -127,6 +127,14 @@ class TpuSearchConfig:
     #: up to this many actions instead of one.  0 = auto (scales with broker
     #: count: B//4 clamped to [32, 1024])
     device_batch_per_step: int = 0
+    #: move candidates offered per source broker per step.  The budgeted
+    #: auction can commit several moves from one overloaded broker in a
+    #: single step as long as the cumulative moved load keeps the source
+    #: above and the destination below the average utilization (the
+    #: water-filling guard: within those budgets every move individually
+    #: improves the convex cost regardless of what else the batch commits).
+    #: 1 restores strict one-move-per-source batches
+    moves_per_src: int = 4
     #: anytime budget: stop starting new search rounds once this many
     #: seconds have elapsed (0 = unlimited).  Hard-goal work (offline-
     #: replica evacuation) always runs to completion — only soft-goal
@@ -629,31 +637,94 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         since_pool = jnp.where(need_pool, 0, since_pool)
         P, S = m.assignment.shape
         B = m.capacity.shape[0]
-        M_ = min(M, 2 * B)
+        Q = max(1, cfg.moves_per_src)
+        NROW = (Q + 1) * B
+        M_ = min(M, NROW)
         grid_fn = move_grid_scores_pallas if use_pallas else move_grid_scores
-        kp, ks, _row_scores, brow, b_scores, best_d, lp, lsl, l_scores = (
+        kp, ks, row_scores, _brow, _b_scores, best_d, lp, lsl, l_scores = (
             _reduced_candidates(m, cfg, ca, K, D, grid_fn, pools=pools)
         )
         bl_score, bl_p, bl_s, bl_dst = _reduce_leadership_per_src(
             m, lp, lsl, l_scores
         )
-        R = b_scores.shape[1]
-        # matcher input: rows [0, B) = per-src-broker best move with R
-        # alternate dests; rows [B, 2B) = per-leader-broker best transfer
-        inf_pad = jnp.full((B, R - 1), jnp.inf, b_scores.dtype)
+        R = row_scores.shape[1]
+        Kn = kp.shape[0]
+        # matcher input: rows [0, Q·B) = the q-th best move candidate of
+        # each src broker with R alternate dests; rows [Q·B, (Q+1)·B) =
+        # per-leader-broker best transfer
+        sb = jnp.clip(m.assignment[kp, ks], 0)
+        rows_q = _topq_rows_per_src(sb, row_scores[:, 0], B, Q).reshape(-1)
+        valid_q = rows_q < Kn
+        mrow = jnp.clip(rows_q, 0, Kn - 1)
+        m_scores = jnp.where(valid_q[:, None], row_scores[mrow], jnp.inf)
+        inf_pad = jnp.full((B, R - 1), jnp.inf, m_scores.dtype)
         cand_score = jnp.concatenate(
-            [b_scores, jnp.concatenate([bl_score[:, None], inf_pad], axis=1)]
-        )                                                 # [2B, R]
+            [m_scores,
+             jnp.concatenate([bl_score[:, None], inf_pad], axis=1)]
+        )                                                 # [NROW, R]
         cand_dst = jnp.concatenate(
-            [best_d[brow], jnp.broadcast_to(bl_dst[:, None], (B, R))]
+            [best_d[mrow], jnp.broadcast_to(bl_dst[:, None], (B, R))]
         )
         arange_b = jnp.arange(B, dtype=jnp.int32)
-        cand_src = jnp.concatenate([arange_b, arange_b])
-        cand_p = jnp.concatenate([kp[brow], bl_p])
-        cand_s = jnp.concatenate([ks[brow], bl_s])
-        is_move_row = jnp.arange(2 * B) < B
+        cand_src = jnp.concatenate([sb[mrow], arange_b])
+        cand_p = jnp.concatenate([kp[mrow], bl_p])
+        cand_s = jnp.concatenate([ks[mrow], bl_s])
+        is_move_row = jnp.arange(NROW) < Q * B
+        # water-filling budgets: follower moves that fit ride the budgeted
+        # fast path (several commits per broker per step); leader moves and
+        # out-of-budget candidates use the strict disjoint path
+        leader_now_q = m.leader_slot[cand_p] == cand_s
+        ml = jnp.where(
+            (leader_now_q[:, None] & is_move_row[:, None]),
+            m.leader_load[cand_p],
+            m.follower_load[cand_p],
+        )
+        # leadership rows are never budget-QUALIFIED, but their wins still
+        # add the (clamped-nonnegative) leader-load delta to the
+        # destination, so they must draw down its deficit — otherwise a
+        # later qualified move could pass the fits check against a stale
+        # remainder, overshoot the water-filling target, and bounce off the
+        # host recheck (forcing a full device resync)
+        lead_vec = jnp.maximum(
+            m.leader_load[cand_p] - m.follower_load[cand_p], 0.0
+        )
+        ml = jnp.where(is_move_row[:, None], ml, lead_vec)
+        move_vec = jnp.concatenate(
+            [
+                ml,
+                jnp.where(is_move_row, 1.0, 0.0)[:, None],
+                jnp.where(
+                    is_move_row, m.leader_load[cand_p, Resource.NW_OUT], 0.0
+                )[:, None],
+            ],
+            axis=1,
+        )
+        src_budget, dst_budget = _step_budgets(m, ca)
+        qualified = (
+            is_move_row
+            & ~leader_now_q
+            & jnp.concatenate([valid_q, jnp.zeros(B, bool)])
+        )
+        # compact to the best C rows before matching: the auction's
+        # scatter/gather cost scales with its row count, and rows outside
+        # the top few thousand essentially never win a step (committed
+        # batches top out in the hundreds) — matching 50k mostly-infeasible
+        # rows cost more than every other step component combined
+        C = min(4096, NROW)
+        _, crow = jax.lax.top_k(-cand_score[:, 0], C)
+        cand_score = cand_score[crow]
+        cand_dst = cand_dst[crow]
+        cand_src = cand_src[crow]
+        cand_p = cand_p[crow]
+        cand_s = cand_s[crow]
+        is_move_row = is_move_row[crow]
+        move_vec = move_vec[crow]
+        qualified = qualified[crow]
+        M_ = min(M_, C)
         take, win_score, win_dst = _match_batch(
-            cand_score, cand_dst, cand_src, cand_p, cfg.improvement_tol, B, P
+            cand_score, cand_dst, cand_src, cand_p, cfg.improvement_tol, B,
+            P, move_vec=move_vec, src_budget=src_budget,
+            dst_budget=dst_budget, qualified=qualified,
         )
         # cap to the M_ best matches; commit order = score order.  The sort
         # puts accepted entries (finite scores) first, so the step's batch
@@ -661,7 +732,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         vals, order = jax.lax.top_k(-jnp.where(take, win_score, jnp.inf), M_)
         vals = -vals
         sel_ok = jnp.isfinite(vals)
-        take_f = jnp.zeros(2 * B, bool).at[order].max(sel_ok)
+        take_f = jnp.zeros(C, bool).at[order].max(sel_ok)
         c_step = jnp.sum(sel_ok.astype(jnp.int32))
         m = _apply_batch_on_device(
             m, take_f, is_move_row, cand_p, cand_s, win_dst,
@@ -697,7 +768,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
 
     def run(m: DeviceModel, ca):
         B = m.capacity.shape[0]
-        M_ = min(M, 2 * B)
+        M_ = min(M, (max(1, cfg.moves_per_src) + 1) * B)
         # slot budget bounds memory like the pre-repool layout did (T and
         # repool_steps were the same number then); commits beyond it simply
         # end the call and the host issues another
@@ -1311,8 +1382,83 @@ def _reduce_leadership_per_src(m: DeviceModel, lp, lsl, l_scores):
     return score, p, s, jnp.clip(m.assignment[p, s], 0)
 
 
+def _topq_rows_per_src(sb, row_best, B: int, Q: int):
+    """Top-Q candidate rows per source broker by score.
+
+    sb [K] = source broker of each row; row_best [K] = the row's best-dest
+    score.  → int32 [Q, B]: the q-th best row index of each broker, K where
+    a broker has fewer than q+1 rows.  Q sequential scatter-min passes — Q
+    is small and each pass is O(K)."""
+    K = sb.shape[0]
+    cur = row_best
+    idx = jnp.arange(K, dtype=jnp.int32)
+    outs = []
+    for _ in range(Q):
+        seg = jnp.full(B, jnp.inf).at[sb].min(cur)
+        r = jnp.full(B, K, jnp.int32).at[sb].min(
+            jnp.where(
+                jnp.isfinite(cur) & (cur <= seg[sb]), idx, K
+            )
+        )
+        outs.append(r)
+        # knock the chosen rows out for the next pass (r == K drops)
+        cur = cur.at[r].set(jnp.inf, mode="drop")
+    return jnp.stack(outs)
+
+
+def _step_budgets(m: DeviceModel, ca) -> Tuple[jax.Array, jax.Array]:
+    """Per-broker move budgets for the water-filling fast path.
+
+    → (src_budget, dst_budget), both f32 [B, R+2] over dims
+    (resources..., replica count, potential NW-out).  A follower move whose
+    (load, 1, pot) vector fits the remaining source surplus AND destination
+    deficit keeps the source above and the destination below the average
+    utilization (and count / potential-out analogues), so on the convex
+    per-broker cost each such move is an improvement independent of
+    whatever else the batch commits — the auction may take many per broker
+    per step without staleness risk.  Leadership transfers and out-of-
+    budget moves stay on the strict disjoint path."""
+    B = m.capacity.shape[0]
+    alive_cap = jnp.where(m.alive[:, None], m.capacity, 0.0)
+    avg_u = jnp.sum(m.broker_load, axis=0) / jnp.maximum(
+        jnp.sum(alive_cap, axis=0), 1e-9
+    )
+    target = avg_u[None, :] * m.capacity                    # [B, R]
+    src_res = jnp.maximum(m.broker_load - target, 0.0)
+    # dead/excluded destinations get zero deficit: nothing qualifies into
+    # them (their feasibility is separately masked anyway)
+    dst_res = jnp.where(
+        m.dest_ok[:, None], jnp.maximum(target - m.broker_load, 0.0), 0.0
+    )
+    src_rc = jnp.maximum(m.rcount - ca["avg_rcount"], 0.0)
+    dst_rc = jnp.maximum(ca["avg_rcount"] - m.rcount, 0.0)
+    # potential-NW-out cost is max(pot_u - thr, 0): ZERO below the
+    # threshold and LINEAR above it.  Batched adds are snapshot-exact in
+    # the linear region (constant slope), so a destination already above
+    # threshold takes unlimited pot; below it, the budget keeps the term
+    # at zero.  Only kink-crossing (which would overstate scored deltas)
+    # is excluded — without this, clusters whose replication factor puts
+    # every broker's potential above threshold (the common case) would
+    # never qualify a single move
+    thr_pot = (
+        ca["cap_threshold"][Resource.NW_OUT] * m.capacity[:, Resource.NW_OUT]
+    )
+    dst_pot = jnp.where(
+        m.pot_nwout >= thr_pot, jnp.inf, thr_pot - m.pot_nwout
+    )
+    inf_col = jnp.full((B, 1), jnp.inf)
+    src_budget = jnp.concatenate(
+        [src_res, src_rc[:, None], inf_col], axis=1
+    )
+    dst_budget = jnp.concatenate(
+        [dst_res, dst_rc[:, None], dst_pot[:, None]], axis=1
+    )
+    return src_budget, dst_budget
+
+
 def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
-                 P: int):
+                 P: int, move_vec=None, src_budget=None, dst_budget=None,
+                 qualified=None):
     """Parallel auction matching candidates to disjoint broker/partition sets.
 
     Each candidate is one src broker's best action with A alternate
@@ -1324,18 +1470,41 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
     ops replace the sequential conflict walk, and the match size approaches
     the number of free destinations instead of collapsing to a handful.
 
-    cand_score/cand_dst [N, A]; cand_src/cand_p [N]
+    cand_score/cand_dst [N, A]; cand_src/cand_p [N].
+
+    Budgeted fast path (all four trailing args together, else pure
+    disjoint): move_vec [N, NB] is each candidate's budget-space load,
+    src_budget/dst_budget [B, NB] the per-broker surplus/deficit
+    (:func:`_step_budgets`), qualified [N] marks candidates eligible for
+    it.  A qualified candidate whose vector fits BOTH remaining budgets
+    bypasses the src/dst used-sets — the water-filling guard makes it an
+    independent improvement — and every winner (either path) draws down
+    the budgets so later qualifications see the true remainder.  Partition
+    disjointness always holds.
+
     → (take [N] bool, win_score [N], win_dst [N])
     """
     N, A = cand_score.shape
     idx_n = jnp.arange(N, dtype=jnp.int32)
     p_c = jnp.clip(cand_p, 0)
+    budgeted = move_vec is not None
+    if not budgeted:
+        move_vec = jnp.zeros((N, 1))
+        src_budget = jnp.zeros((B, 1))
+        dst_budget = jnp.zeros((B, 1))
+        qualified = jnp.zeros(N, bool)
 
     def round_fn(carry, _):
-        take, used_dst, used_p, used_src, ptr, win_score, win_dst = carry
+        (take, used_dst, used_p, used_src, ptr, win_score, win_dst,
+         rem_src, rem_dst) = carry
         pa = jnp.clip(ptr, 0, A - 1)
         cur_s = cand_score[idx_n, pa]
         cur_d = jnp.clip(cand_dst[idx_n, pa], 0)
+        fits = (
+            qualified
+            & jnp.all(move_vec <= rem_src[cand_src] + 1e-9, axis=1)
+            & jnp.all(move_vec <= rem_dst[cur_d] + 1e-9, axis=1)
+        )
         # src and dst conflict sets are deliberately SEPARATE: a broker may
         # be one action's dest and another's src in the same batch.  Every
         # per-broker cost term is convex in the broker's aggregates, so a
@@ -1344,12 +1513,14 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
         # higher base / addition to a relieved base beats its pre-batch
         # score for convex f) — pre-batch scores understate, never
         # overstate, and the improvement gate stays sound.  Same-dst and
-        # same-src overlaps (where scores could overstate) stay excluded.
+        # same-src overlaps (where scores could overstate) are excluded
+        # UNLESS the candidate fits the water-filling budgets, which bound
+        # the overlap inside the strictly-improving region.
         active = (
-            ~take & (ptr < A) & (cur_s < tol)
-            & ~used_src[cand_src] & ~used_p[p_c]
+            ~take & (ptr < A) & (cur_s < tol) & ~used_p[p_c]
+            & (fits | ~used_src[cand_src])
         )
-        prop = active & ~used_dst[cur_d]
+        prop = active & (fits | ~used_dst[cur_d])
         best = jnp.full(B, jnp.inf).at[cur_d].min(
             jnp.where(prop, cur_s, jnp.inf)
         )
@@ -1360,24 +1531,46 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
             )
             win = win & (idx_n == fmin[ids])
         take = take | win
+        # budget drawdown for EVERY winner (disjoint ones too): later
+        # qualification checks must see the true remainder.  win is unique
+        # per src and per dst within a round, so plain scatter-add is exact
+        dec = jnp.where(win[:, None], move_vec, 0.0)
+        rem_src = rem_src.at[cand_src].add(-dec)
+        rem_dst = rem_dst.at[cur_d].add(-dec)
+        # ALL winners mark the used-sets: the disjoint path's stale-score
+        # argument only tolerates src-of-one/dst-of-another overlap, so a
+        # broker touched by ANY winner (budgeted included) is off-limits to
+        # later disjoint candidates; budget-path candidates bypass the sets
+        # but see the drawn-down budgets
         used_dst = used_dst.at[cur_d].max(win)
         used_src = used_src.at[cand_src].max(win)
         used_p = used_p.at[p_c].max(win)
         win_score = jnp.where(win, cur_s, win_score)
         win_dst = jnp.where(win, cur_d, win_dst)
-        # advance only candidates whose current destination is actually used
-        # now (their loss is permanent); a loser whose provisional winner was
-        # itself eliminated by the src/partition tie-breaks keeps its alt —
-        # the destination is still free and stays its best option
-        ptr = ptr + (active & ~win & used_dst[cur_d]).astype(jnp.int32)
-        return (take, used_dst, used_p, used_src, ptr, win_score, win_dst), None
+        # advancing on loss: budget-path losers ALWAYS advance to their
+        # next alternate — their best destinations concentrate on the same
+        # few coldest brokers (every row's argmin), and only one proposal
+        # per destination wins a round, so retrying the same destination
+        # would serialize the whole qualified cohort behind one winner per
+        # round.  Spreading to alternates costs little (alternates are
+        # near-equivalent by construction) and parallelizes the batch.
+        # Disjoint-path losers advance only when the destination is
+        # actually used (their loss is permanent); one whose provisional
+        # winner was itself eliminated by the tie-breaks keeps its
+        # alternate — the destination is still free and stays its best
+        # option
+        lost_dst = jnp.where(fits, True, used_dst[cur_d])
+        ptr = ptr + (active & ~win & lost_dst).astype(jnp.int32)
+        return (take, used_dst, used_p, used_src, ptr, win_score, win_dst,
+                rem_src, rem_dst), None
 
     init = (
         jnp.zeros(N, bool), jnp.zeros(B, bool), jnp.zeros(P, bool),
         jnp.zeros(B, bool), jnp.zeros(N, jnp.int32),
         jnp.full(N, jnp.inf), jnp.zeros(N, jnp.int32),
+        src_budget, dst_budget,
     )
-    (take, _, _, _, _, win_score, win_dst), _ = jax.lax.scan(
+    (take, _, _, _, _, win_score, win_dst, _, _), _ = jax.lax.scan(
         round_fn, init, None, length=A
     )
     return take, win_score, win_dst

@@ -129,9 +129,17 @@ class Executor:
         """
         ongoing = set(self.backend.ongoing_reassignments())
         if ongoing and stop:
-            try:
-                self.backend.cancel_reassignments(ongoing)
-            except (NotImplementedError, AttributeError):
+            # probe support first so a method that EXISTS but raises (a real
+            # backend bug, possibly AttributeError internally) still
+            # propagates instead of being mistaken for "unsupported"
+            cancel = getattr(self.backend, "cancel_reassignments", None)
+            unsupported = cancel is None
+            if not unsupported:
+                try:
+                    cancel(ongoing)
+                except NotImplementedError:
+                    unsupported = True
+            if unsupported:
                 # a minimal adapter may not support cancellation; leave the
                 # reassignments to finish under the cluster's own control
                 self.adopted_at_startup = ongoing
