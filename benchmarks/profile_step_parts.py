@@ -101,8 +101,10 @@ def main() -> None:
 
     def pools_body(i, carry):
         m_, acc = carry
-        kp_, ks_, dp_, lp_, lsl_ = T._build_pools(m_, cfg, ca, K, D)
-        return m_, acc + kp_[0].astype(jnp.float32) + i * 0
+        import dataclasses
+        m_i = dataclasses.replace(m_, broker_load=m_.broker_load + i * 0)
+        kp_, ks_, dp_, lp_, lsl_ = T._build_pools(m_i, cfg, ca, K, D)
+        return m_, acc + kp_[0].astype(jnp.float32)
 
     res["build_pools_ms"] = round(
         bench_loop(pools_body, max(4, I // 8), m, jnp.float32(0)) * 1e3, 2)
@@ -124,13 +126,20 @@ def main() -> None:
     def match_body(i, carry):
         sc, acc = carry
         take, ws, wd = T._match_batch(
-            sc + i * 0, cand_dst, cand_src, cand_p, -1e-4, B, P,
-            move_vec=move_vec, src_budget=src_b, dst_budget=dst_b,
-            qualified=qual)
+            sc + i * 0, cand_dst, cand_src, cand_p, -1e-4, B, P)
         return sc, acc + ws[0]
+
+    def cohort_body(i, carry):
+        sc, acc = carry
+        dok = T._seg_prefix_fits(
+            cand_dst[:, 0], move_vec + i * 0, dst_b, qual)
+        acc_b = T._seg_prefix_fits(cand_src, move_vec, src_b, dok)
+        return sc, acc + acc_b[0].astype(jnp.float32)
 
     res["match_ms"] = round(
         bench_loop(match_body, I, cand_score, jnp.float32(0)) * 1e3, 2)
+    res["cohort_ms"] = round(
+        bench_loop(cohort_body, I, cand_score, jnp.float32(0)) * 1e3, 2)
 
     def topm_body(i, carry):
         sc, acc = carry

@@ -121,11 +121,9 @@ class TpuSearchConfig:
     #: membership drifts negligibly while scoring stays live.  A step that
     #: commits nothing right after a repool ends the call (converged)
     repool_steps: int = 64
-    #: conflict-free actions committed per device step: the top candidates
-    #: are greedily filtered to disjoint (src broker, dst broker, partition)
-    #: sets, whose deltas are exactly independent — one rescore then commits
-    #: up to this many actions instead of one.  0 = auto (scales with broker
-    #: count: B//4 clamped to [32, 1024])
+    #: actions committed per device step: budgeted-cohort commits plus
+    #: disjoint auction winners, capped to this many best-scored actions.
+    #: 0 = auto (scales with broker count: B//2 clamped to [32, 2048])
     device_batch_per_step: int = 0
     #: move candidates offered per source broker per step.  The budgeted
     #: auction can commit several moves from one overloaded broker in a
@@ -406,7 +404,22 @@ def _build_round_pools(
     )
     size = jnp.sum(rload / jnp.mean(cap, axis=0), axis=2)        # [P, S]
     src_b = jnp.clip(m.assignment, 0)
-    prio = overage[src_b] * 10.0 + size
+    # mid-search recall: once few brokers are over their balance BOUND,
+    # `overage` is zero almost everywhere and ranking by raw size floods
+    # the pool with the largest replicas — exactly the moves that overshoot
+    # and score infeasible/worthless, starving the fine-balancing moves the
+    # tail actually commits.  Rank instead by above-AVERAGE stress plus a
+    # surplus-matched size term (peaked where moving the replica brings its
+    # broker to target; a replica larger than the surplus scores down) —
+    # the same water-filling shape the budgeted matcher commits on.
+    alive_cap = jnp.where(m.alive[:, None], m.capacity, 0.0)
+    avg_u = jnp.sum(m.broker_load, axis=0) / jnp.maximum(
+        jnp.sum(alive_cap, axis=0), 1e-9
+    )
+    stress = jnp.sum(jnp.maximum(util - avg_u[None, :], 0.0), axis=1)  # [B]
+    surplus = stress[src_b]                                  # [P, S]
+    fit = surplus - jnp.abs(size - surplus)
+    prio = overage[src_b] * 10.0 + surplus * 2.0 + fit
     # rack-violating replicas (lower-indexed slot of same partition shares
     # the rack) must enter the source pool for repair
     racks = jnp.where(slot_exists, m.rack[src_b], -1)              # [P, S]
@@ -641,7 +654,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         NROW = (Q + 1) * B
         M_ = min(M, NROW)
         grid_fn = move_grid_scores_pallas if use_pallas else move_grid_scores
-        kp, ks, row_scores, _brow, _b_scores, best_d, lp, lsl, l_scores = (
+        kp, ks, row_scores, best_d, lp, lsl, l_scores = (
             _reduced_candidates(m, cfg, ca, K, D, grid_fn, pools=pools)
         )
         bl_score, bl_p, bl_s, bl_dst = _reduce_leadership_per_src(
@@ -679,16 +692,11 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
             m.leader_load[cand_p],
             m.follower_load[cand_p],
         )
-        # leadership rows are never budget-QUALIFIED, but their wins still
-        # add the (clamped-nonnegative) leader-load delta to the
-        # destination, so they must draw down its deficit — otherwise a
-        # later qualified move could pass the fits check against a stale
-        # remainder, overshoot the water-filling target, and bounce off the
-        # host recheck (forcing a full device resync)
-        lead_vec = jnp.maximum(
-            m.leader_load[cand_p] - m.follower_load[cand_p], 0.0
-        )
-        ml = jnp.where(is_move_row[:, None], ml, lead_vec)
+        # leadership rows carry a zero budget vector: they are never
+        # budget-eligible, and the disjoint auction marks their brokers in
+        # the used-sets, which the cohort already excluded — so they cannot
+        # interleave with budgeted commits at the same brokers
+        ml = jnp.where(is_move_row[:, None], ml, 0.0)
         move_vec = jnp.concatenate(
             [
                 ml,
@@ -721,11 +729,46 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         move_vec = move_vec[crow]
         qualified = qualified[crow]
         M_ = min(M_, C)
-        take, win_score, win_dst = _match_batch(
-            cand_score, cand_dst, cand_src, cand_p, cfg.improvement_tol, B,
-            P, move_vec=move_vec, src_budget=src_budget,
-            dst_budget=dst_budget, qualified=qualified,
+        # ---- budget cohort: multi-accept by segmented budget prefixes ----
+        # Every row's best destinations concentrate on the same few coldest
+        # brokers, and the round-based auction crowns ONE winner per
+        # destination per round — so commits/step used to be bounded by the
+        # handful of distinct destinations in play, not by the available
+        # work.  Here the water-filling budgets resolve that contention
+        # directly: walking rows best-first, a qualified move to its best
+        # destination is accepted iff its inclusive prefix still fits the
+        # destination's deficit and the source's surplus (vectorized as
+        # segmented prefix sums) — one cold broker absorbs as many moves
+        # per step as its deficit allows.
+        ci = jnp.arange(C, dtype=jnp.int32)
+        p_cc = jnp.clip(cand_p, 0)
+        improving = cand_score[:, 0] < cfg.improvement_tol
+        qual = qualified & improving
+        # one row per partition (best first — rows are in score order)
+        fminp = jnp.full(P, C, jnp.int32).at[p_cc].min(
+            jnp.where(qual, ci, C)
         )
+        qual = qual & (ci == fminp[p_cc])
+        d0 = jnp.clip(cand_dst[:, 0], 0)
+        dok = _seg_prefix_fits(d0, move_vec, dst_budget, qual)
+        acc_b = _seg_prefix_fits(
+            jnp.clip(cand_src, 0), move_vec, src_budget, dok
+        )
+        # ---- disjoint auction for everything else (leads, out-of-budget),
+        # excluded from brokers/partitions the cohort already touched ----
+        used0 = (
+            jnp.zeros(B, bool).at[jnp.clip(cand_src, 0)].max(acc_b),
+            jnp.zeros(B, bool).at[d0].max(acc_b),
+            jnp.zeros(P, bool).at[p_cc].max(acc_b),
+        )
+        take_d, win_score_d, win_dst_d = _match_batch(
+            jnp.where(acc_b[:, None], jnp.inf, cand_score),
+            cand_dst, cand_src, cand_p, cfg.improvement_tol, B, P,
+            init_used=used0,
+        )
+        take = acc_b | take_d
+        win_score = jnp.where(acc_b, cand_score[:, 0], win_score_d)
+        win_dst = jnp.where(acc_b, d0, win_dst_d)
         # cap to the M_ best matches; commit order = score order.  The sort
         # puts accepted entries (finite scores) first, so the step's batch
         # is valid-prefix-contiguous and can compact at the running offset
@@ -1286,27 +1329,22 @@ def _build_pools(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int):
 
 def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
                         D: int, grid_fn, pools=None):
-    """Per-src-broker move candidates + pruned leadership candidates.
+    """Pruned, per-row-reduced move candidates + leadership candidates.
 
-    The disjoint batch commit takes at most ONE move per src broker per
-    step, so the only move candidates worth ranking are each src broker's
-    best (replica, dest): the raw K×D grid's global top-k concentrates on a
-    few hot brokers × many near-equivalent candidates, all conflicting, and
-    collapses commits per rescore to a handful.  The grid is reduced in two
-    stages: best ``DESTS_PER_SOURCE`` dests per source row (top-k over D),
-    then best row per src broker (scatter-min over rows).
+    The raw K×D grid is reduced to each source row's best
+    ``DESTS_PER_SOURCE`` destinations (top-k over D) — the alternates the
+    commit machinery actually consumes: the scan step picks its per-broker
+    top-``moves_per_src`` rows from ``row_scores[:, 0]``
+    (:func:`_topq_rows_per_src`) and feeds the budgeted cohort + disjoint
+    auction; the score-only path ranks the per-source rows directly.
 
-    Returns (kp, ks, row_scores [K, R], brow [B], b_scores [B, R],
-    best_d [K, R], lp, lsl, l_scores); ``b_scores`` carries +inf rows for
-    brokers with no candidate.
+    Returns (kp, ks, row_scores [K, R], best_d [K, R], lp, lsl, l_scores).
 
     ``pools`` (from :func:`_build_pools`) may be passed in so the P·S-scale
     pool construction is hoisted out of a multi-step device loop — pool
     membership is a pruning heuristic that drifts negligibly across a few
     dozen committed actions, while the scoring here stays live.
     """
-    P, S = m.assignment.shape
-    B = m.capacity.shape[0]
     R = min(DESTS_PER_SOURCE, D)
     kp, ks, dest_pool, lp, lsl = pools if pools is not None else _build_pools(
         m, cfg, ca, K, D
@@ -1314,25 +1352,11 @@ def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
     g = grid_fn(m, cfg, ca, kp, ks, dest_pool)          # [K, D]
     neg_best, best_i = jax.lax.top_k(-g, R)             # [K, R]
     best_d = dest_pool[best_i]                          # [K, R] broker ids
-    row_best = -neg_best[:, 0]                          # [K]
-    sb = jnp.clip(m.assignment[kp, ks], 0)              # [K] src broker/row
-    seg_best = jnp.full(B, jnp.inf).at[sb].min(row_best)
-    # lowest row index among each broker's min-score rows (deterministic)
-    brow = jnp.full(B, K, jnp.int32).at[sb].min(
-        jnp.where(
-            row_best <= seg_best[sb], jnp.arange(K, dtype=jnp.int32), K
-        )
-    )
-    valid = brow < K
-    brow = jnp.clip(brow, 0, K - 1)
-    b_scores = jnp.where(
-        valid[:, None], -neg_best[brow], jnp.inf
-    )                                                   # [B, R]
     L = lp.shape[0]
     l_scores, _ = _score_candidates(
         m, cfg, ca, jnp.ones(L, jnp.int32), lp, lsl, jnp.zeros(L, jnp.int32)
     )
-    return kp, ks, -neg_best, brow, b_scores, best_d, lp, lsl, l_scores
+    return kp, ks, -neg_best, best_d, lp, lsl, l_scores
 
 
 def _merged_scores(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
@@ -1349,7 +1373,7 @@ def _merged_scores(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
     best_d[i//R, i%R]); i >= K·R is leadership transfer (lp[i-K·R],
     ls[i-K·R]).  Keep the decode (:func:`_decode_flat_idx`) in lockstep.
     """
-    kp, ks, row_scores, brow, b_scores, best_d, lp, lsl, l_scores = (
+    kp, ks, row_scores, best_d, lp, lsl, l_scores = (
         _reduced_candidates(m, cfg, ca, K, D, grid_fn)
     )
     return (
@@ -1446,9 +1470,15 @@ def _step_budgets(m: DeviceModel, ca) -> Tuple[jax.Array, jax.Array]:
     dst_pot = jnp.where(
         m.pot_nwout >= thr_pot, jnp.inf, thr_pot - m.pot_nwout
     )
-    inf_col = jnp.full((B, 1), jnp.inf)
+    # source side mirrors it: ABOVE the kink, removal relief is linear and
+    # snapshot-exact only while the source stays above — budget = distance
+    # to the kink; BELOW it, removal has zero effect on the term (exact),
+    # so the budget is unlimited
+    src_pot = jnp.where(
+        m.pot_nwout >= thr_pot, m.pot_nwout - thr_pot, jnp.inf
+    )
     src_budget = jnp.concatenate(
-        [src_res, src_rc[:, None], inf_col], axis=1
+        [src_res, src_rc[:, None], src_pot[:, None]], axis=1
     )
     dst_budget = jnp.concatenate(
         [dst_res, dst_rc[:, None], dst_pot[:, None]], axis=1
@@ -1456,55 +1486,70 @@ def _step_budgets(m: DeviceModel, ca) -> Tuple[jax.Array, jax.Array]:
     return src_budget, dst_budget
 
 
+def _seg_prefix_fits(ids, vec, budget, eligible):
+    """Budget acceptance by segmented prefix sums, in caller row order.
+
+    Rows arrive best-score-first.  Within each id segment (a broker), the
+    inclusive running sum of eligible rows' ``vec`` is compared against the
+    broker's budget: a row fits iff ALL dims of its inclusive prefix fit.
+    Every accepted set prefix therefore respects the budget jointly — the
+    vectorized equivalent of walking the rows in score order and drawing
+    the budget down row by row (ineligible rows contribute zero).
+
+    ids [C] int32, vec [C, NB], budget [Bmax, NB], eligible [C] bool
+    → fits [C] bool (False wherever not eligible).
+    """
+    C = ids.shape[0]
+    rank = jnp.arange(C, dtype=jnp.int32)
+    order = jnp.argsort(ids * C + rank)      # segments contiguous, score order
+    sv = jnp.where(eligible[:, None], vec, 0.0)[order]
+    sid = ids[order]
+    cs = jnp.cumsum(sv, axis=0)
+    first = jnp.concatenate([jnp.ones(1, bool), sid[1:] != sid[:-1]])
+    # index of each row's segment start, propagated by cumulative max
+    start_idx = jax.lax.cummax(jnp.where(first, rank, -1))
+    offset = cs[start_idx] - sv[start_idx]   # exclusive prefix at seg start
+    incl = cs - offset
+    ok = jnp.all(incl <= budget[sid] + 1e-9, axis=1)
+    out = jnp.zeros(C, bool).at[order].set(ok)
+    return out & eligible
+
+
 def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
-                 P: int, move_vec=None, src_budget=None, dst_budget=None,
-                 qualified=None):
+                 P: int, init_used=None):
     """Parallel auction matching candidates to disjoint broker/partition sets.
 
-    Each candidate is one src broker's best action with A alternate
-    destinations, best-first.  Per round, every unmatched candidate proposes
-    its current alternate; the lowest-score proposal per destination wins
-    (ties to the lowest candidate index); a loser advances to its next
-    alternate only once the destination it lost is actually used — so the
-    advance never skips a still-free destination.  A rounds of [N]-vector
-    ops replace the sequential conflict walk, and the match size approaches
-    the number of free destinations instead of collapsing to a handful.
+    Each candidate is one action with A alternate destinations, best-first.
+    Per round, every unmatched candidate proposes its current alternate;
+    the lowest-score proposal per destination wins (ties to the lowest
+    candidate index); a loser advances to its next alternate only once the
+    destination it lost is actually used — so the advance never skips a
+    still-free destination.  A rounds of [N]-vector ops replace the
+    sequential conflict walk, and the match size approaches the number of
+    free destinations instead of collapsing to a handful.
+
+    ``init_used`` (used_src [B], used_dst [B], used_p [P]) pre-marks
+    brokers/partitions already claimed outside the auction — the budgeted
+    cohort (:func:`_seg_prefix_fits` acceptance in the scan step) passes
+    its footprint here so auction winners stay disjoint from it.
 
     cand_score/cand_dst [N, A]; cand_src/cand_p [N].
-
-    Budgeted fast path (all four trailing args together, else pure
-    disjoint): move_vec [N, NB] is each candidate's budget-space load,
-    src_budget/dst_budget [B, NB] the per-broker surplus/deficit
-    (:func:`_step_budgets`), qualified [N] marks candidates eligible for
-    it.  A qualified candidate whose vector fits BOTH remaining budgets
-    bypasses the src/dst used-sets — the water-filling guard makes it an
-    independent improvement — and every winner (either path) draws down
-    the budgets so later qualifications see the true remainder.  Partition
-    disjointness always holds.
-
     → (take [N] bool, win_score [N], win_dst [N])
     """
     N, A = cand_score.shape
     idx_n = jnp.arange(N, dtype=jnp.int32)
     p_c = jnp.clip(cand_p, 0)
-    budgeted = move_vec is not None
-    if not budgeted:
-        move_vec = jnp.zeros((N, 1))
-        src_budget = jnp.zeros((B, 1))
-        dst_budget = jnp.zeros((B, 1))
-        qualified = jnp.zeros(N, bool)
+    if init_used is None:
+        init_used = (
+            jnp.zeros(B, bool), jnp.zeros(B, bool), jnp.zeros(P, bool)
+        )
+    init_used_src, init_used_dst, init_used_p = init_used
 
     def round_fn(carry, _):
-        (take, used_dst, used_p, used_src, ptr, win_score, win_dst,
-         rem_src, rem_dst) = carry
+        take, used_dst, used_p, used_src, ptr, win_score, win_dst = carry
         pa = jnp.clip(ptr, 0, A - 1)
         cur_s = cand_score[idx_n, pa]
         cur_d = jnp.clip(cand_dst[idx_n, pa], 0)
-        fits = (
-            qualified
-            & jnp.all(move_vec <= rem_src[cand_src] + 1e-9, axis=1)
-            & jnp.all(move_vec <= rem_dst[cur_d] + 1e-9, axis=1)
-        )
         # src and dst conflict sets are deliberately SEPARATE: a broker may
         # be one action's dest and another's src in the same batch.  Every
         # per-broker cost term is convex in the broker's aggregates, so a
@@ -1513,14 +1558,12 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
         # higher base / addition to a relieved base beats its pre-batch
         # score for convex f) — pre-batch scores understate, never
         # overstate, and the improvement gate stays sound.  Same-dst and
-        # same-src overlaps (where scores could overstate) are excluded
-        # UNLESS the candidate fits the water-filling budgets, which bound
-        # the overlap inside the strictly-improving region.
+        # same-src overlaps (where scores could overstate) stay excluded.
         active = (
-            ~take & (ptr < A) & (cur_s < tol) & ~used_p[p_c]
-            & (fits | ~used_src[cand_src])
+            ~take & (ptr < A) & (cur_s < tol)
+            & ~used_src[cand_src] & ~used_p[p_c]
         )
-        prop = active & (fits | ~used_dst[cur_d])
+        prop = active & ~used_dst[cur_d]
         best = jnp.full(B, jnp.inf).at[cur_d].min(
             jnp.where(prop, cur_s, jnp.inf)
         )
@@ -1531,46 +1574,25 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
             )
             win = win & (idx_n == fmin[ids])
         take = take | win
-        # budget drawdown for EVERY winner (disjoint ones too): later
-        # qualification checks must see the true remainder.  win is unique
-        # per src and per dst within a round, so plain scatter-add is exact
-        dec = jnp.where(win[:, None], move_vec, 0.0)
-        rem_src = rem_src.at[cand_src].add(-dec)
-        rem_dst = rem_dst.at[cur_d].add(-dec)
-        # ALL winners mark the used-sets: the disjoint path's stale-score
-        # argument only tolerates src-of-one/dst-of-another overlap, so a
-        # broker touched by ANY winner (budgeted included) is off-limits to
-        # later disjoint candidates; budget-path candidates bypass the sets
-        # but see the drawn-down budgets
         used_dst = used_dst.at[cur_d].max(win)
         used_src = used_src.at[cand_src].max(win)
         used_p = used_p.at[p_c].max(win)
         win_score = jnp.where(win, cur_s, win_score)
         win_dst = jnp.where(win, cur_d, win_dst)
-        # advancing on loss: budget-path losers ALWAYS advance to their
-        # next alternate — their best destinations concentrate on the same
-        # few coldest brokers (every row's argmin), and only one proposal
-        # per destination wins a round, so retrying the same destination
-        # would serialize the whole qualified cohort behind one winner per
-        # round.  Spreading to alternates costs little (alternates are
-        # near-equivalent by construction) and parallelizes the batch.
-        # Disjoint-path losers advance only when the destination is
-        # actually used (their loss is permanent); one whose provisional
-        # winner was itself eliminated by the tie-breaks keeps its
-        # alternate — the destination is still free and stays its best
-        # option
-        lost_dst = jnp.where(fits, True, used_dst[cur_d])
-        ptr = ptr + (active & ~win & lost_dst).astype(jnp.int32)
-        return (take, used_dst, used_p, used_src, ptr, win_score, win_dst,
-                rem_src, rem_dst), None
+        # advance only candidates whose current destination is actually used
+        # now (their loss is permanent); a loser whose provisional winner was
+        # itself eliminated by the src/partition tie-breaks keeps its alt —
+        # the destination is still free and stays its best option
+        ptr = ptr + (active & ~win & used_dst[cur_d]).astype(jnp.int32)
+        return (take, used_dst, used_p, used_src, ptr, win_score,
+                win_dst), None
 
     init = (
-        jnp.zeros(N, bool), jnp.zeros(B, bool), jnp.zeros(P, bool),
-        jnp.zeros(B, bool), jnp.zeros(N, jnp.int32),
+        jnp.zeros(N, bool), init_used_dst, init_used_p,
+        init_used_src, jnp.zeros(N, jnp.int32),
         jnp.full(N, jnp.inf), jnp.zeros(N, jnp.int32),
-        src_budget, dst_budget,
     )
-    (take, _, _, _, _, win_score, win_dst, _, _), _ = jax.lax.scan(
+    (take, _, _, _, _, win_score, win_dst), _ = jax.lax.scan(
         round_fn, init, None, length=A
     )
     return take, win_score, win_dst
@@ -1906,7 +1928,7 @@ class TpuGoalOptimizer:
                 # keep (rescores per committed action) low, small clusters
                 # can't fill them
                 cfg = dataclasses.replace(
-                    cfg, device_batch_per_step=int(np.clip(B // 4, 32, 1024))
+                    cfg, device_batch_per_step=int(np.clip(B // 2, 32, 2048))
                 )
             scan_fn = _cached_scan_fn(cfg, K, D, cfg.steps_per_call)
             # convergence exits via the device done flag / no-progress break;

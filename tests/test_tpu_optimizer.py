@@ -355,71 +355,24 @@ def test_commit_batch_matches_sequential_replay():
     ctx_b.recompute_check()
 
 
-def test_match_batch_budgeted_multi_commit():
-    """With water-filling budgets, several in-budget moves from ONE source
-    broker (and into one destination) may win in a single matching pass,
-    while budget exhaustion falls back to the strict disjoint rules."""
+def test_seg_prefix_fits():
+    """Segmented budget-prefix acceptance: rows in score order, per-id
+    cumulative load gated by the id's budget, ineligible rows contribute
+    nothing."""
     import jax.numpy as jnp
 
-    from cruise_control_tpu.analyzer.tpu_optimizer import _match_batch
+    from cruise_control_tpu.analyzer.tpu_optimizer import _seg_prefix_fits
 
-    B, P, A = 8, 32, 2
-    # rows 0-2: same src broker 0, distinct partitions, all to dest 5
-    # row 3: src 1 -> dest 5, NOT qualified (disjoint path)
-    cand_score = jnp.array([
-        [-10.0, -9.5],
-        [-9.0, -8.5],
-        [-8.0, -7.5],
-        [-7.0, jnp.inf],
-    ])
-    cand_dst = jnp.array([[5, 6], [5, 6], [5, 6], [5, 0]], dtype=jnp.int32)
-    cand_src = jnp.array([0, 0, 0, 1], dtype=jnp.int32)
-    cand_p = jnp.array([10, 11, 12, 13], dtype=jnp.int32)
-    # one budget dim; each move costs 1.0
-    move_vec = jnp.ones((4, 1))
-    src_budget = jnp.zeros((B, 1)).at[0, 0].set(2.0)   # src 0 fits TWO moves
-    dst_budget = jnp.zeros((B, 1)).at[5, 0].set(10.0).at[6, 0].set(10.0)
-    qualified = jnp.array([True, True, True, False])
+    ids = jnp.array([5, 5, 2, 5, 2], dtype=jnp.int32)
+    vec = jnp.array([[1.0], [1.0], [2.0], [1.0], [2.0]])
+    budget = jnp.zeros((8, 1)).at[5, 0].set(2.0).at[2, 0].set(3.0)
+    eligible = jnp.array([True, True, True, True, True])
+    fits = np.asarray(_seg_prefix_fits(ids, vec, budget, eligible))
+    # id 5: rows 0,1 fill the budget of 2; row 3 (third unit) is rejected
+    # id 2: row 2 fits (2 <= 3); row 4 would make 4 > 3 -> rejected
+    assert list(fits) == [True, True, True, False, False]
 
-    take, win_score, win_dst = _match_batch(
-        cand_score, cand_dst, cand_src, cand_p, -1e-4, B, P,
-        move_vec=move_vec, src_budget=src_budget, dst_budget=dst_budget,
-        qualified=qualified,
-    )
-    take = np.asarray(take)
-    win_dst = np.asarray(win_dst)
-    # round 1: row 0 wins dest 5; rows 1-2 lose and advance to alt dest 6.
-    # round 2: row 1 wins dest 6 (second move from src 0 — fits the budget
-    # of 2); row 2 would exceed src 0's budget and cannot fall back to the
-    # disjoint path (src 0 is used)
-    assert take[0] and win_dst[0] == 5
-    assert take[1] and win_dst[1] == 6
-    assert not take[2]
-    # row 3 (disjoint path) is blocked: dest 5 was taken by budgeted winners
-    assert not take[3]
-
-    # same candidates without budgets: strict disjointness — only row 0
-    take0, _, _ = _match_batch(
-        cand_score, cand_dst, cand_src, cand_p, -1e-4, B, P,
-    )
-    take0 = np.asarray(take0)
-    assert take0[0] and not take0[1] and not take0[2] and not take0[3]
-
-
-def test_topq_rows_per_src():
-    """Per-broker top-Q selection: ordered by score, K-padded when a broker
-    has fewer rows, infinite-score rows never selected."""
-    import jax.numpy as jnp
-
-    from cruise_control_tpu.analyzer.tpu_optimizer import _topq_rows_per_src
-
-    sb = jnp.array([0, 0, 0, 1, 1, 2], dtype=jnp.int32)
-    score = jnp.array([-5.0, -9.0, -7.0, -1.0, -2.0, jnp.inf])
-    K = 6
-    rows = np.asarray(_topq_rows_per_src(sb, score, B=4, Q=2))
-    # broker 0: rows 1 (-9) then 2 (-7); broker 1: rows 4 (-2) then 3 (-1);
-    # broker 2: only an inf row -> never selected; broker 3: no rows
-    assert rows[0, 0] == 1 and rows[1, 0] == 2
-    assert rows[0, 1] == 4 and rows[1, 1] == 3
-    assert rows[0, 2] == K and rows[1, 2] == K
-    assert rows[0, 3] == K and rows[1, 3] == K
+    # an ineligible better row must not consume budget
+    eligible2 = jnp.array([False, True, True, True, True])
+    fits2 = np.asarray(_seg_prefix_fits(ids, vec, budget, eligible2))
+    assert list(fits2) == [False, True, True, True, False]
