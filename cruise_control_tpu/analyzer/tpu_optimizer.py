@@ -692,10 +692,12 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
             m.leader_load[cand_p],
             m.follower_load[cand_p],
         )
-        # leadership rows carry a zero budget vector: they are never
-        # budget-eligible, and the disjoint auction marks their brokers in
-        # the used-sets, which the cohort already excluded — so they cannot
-        # interleave with budgeted commits at the same brokers
+        # leadership rows carry a zero budget vector and are never
+        # budget-eligible.  Safety of dropping their budget drawdown: the
+        # cohort is decided FIRST, and its footprint is passed to the
+        # auction as init_used — so a leadership (or any disjoint-path)
+        # winner can never land on a broker the cohort committed to, and
+        # cohort budgets never need to see auction-side load deltas
         ml = jnp.where(is_move_row[:, None], ml, 0.0)
         move_vec = jnp.concatenate(
             [
@@ -750,9 +752,9 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         )
         qual = qual & (ci == fminp[p_cc])
         d0 = jnp.clip(cand_dst[:, 0], 0)
-        dok = _seg_prefix_fits(d0, move_vec, dst_budget, qual)
-        acc_b = _seg_prefix_fits(
-            jnp.clip(cand_src, 0), move_vec, src_budget, dok
+        acc_b = _budget_accept(
+            d0, jnp.clip(cand_src, 0), move_vec, dst_budget, src_budget,
+            qual,
         )
         # ---- disjoint auction for everything else (leads, out-of-budget),
         # excluded from brokers/partitions the cohort already touched ----
@@ -1492,9 +1494,13 @@ def _seg_prefix_fits(ids, vec, budget, eligible):
     Rows arrive best-score-first.  Within each id segment (a broker), the
     inclusive running sum of eligible rows' ``vec`` is compared against the
     broker's budget: a row fits iff ALL dims of its inclusive prefix fit.
-    Every accepted set prefix therefore respects the budget jointly — the
-    vectorized equivalent of walking the rows in score order and drawing
-    the budget down row by row (ineligible rows contribute zero).
+    Every accepted set prefix therefore respects the budget jointly.
+
+    CONSERVATIVE, not the exact sequential walk: a rejected eligible row
+    still counts in later rows' prefixes, so one oversized best-scored row
+    can starve the rest of its segment this pass.  The caller
+    (:func:`_budget_accept`) recovers most of that by re-running with
+    accepted rows drawn down and individually-unfittable rows dropped.
 
     ids [C] int32, vec [C, NB], budget [Bmax, NB], eligible [C] bool
     → fits [C] bool (False wherever not eligible).
@@ -1513,6 +1519,37 @@ def _seg_prefix_fits(ids, vec, budget, eligible):
     ok = jnp.all(incl <= budget[sid] + 1e-9, axis=1)
     out = jnp.zeros(C, bool).at[order].set(ok)
     return out & eligible
+
+
+def _budget_accept(dst_ids, src_ids, vec, dst_budget, src_budget, eligible,
+                   rounds: int = 2):
+    """Budgeted cohort acceptance across both endpoints, in caller order.
+
+    Each round: destination-prefix filter, then source-prefix filter over
+    its survivors (so destination budget is never consumed by rows the
+    source stage rejects — the single-pass composition had that leak);
+    accepted rows draw both budgets down exactly, and rows that no longer
+    fit the REMAINING budgets on their own drop out of eligibility, so an
+    oversized best-scored row cannot keep starving its whole segment the
+    way one conservative pass allows.  Every per-round acceptance is
+    conservative (prefixes over-count by the rows later stages reject),
+    so the union never overshoots a budget.
+    """
+    acc = jnp.zeros_like(eligible)
+    elig = eligible
+    for _ in range(rounds):
+        dok = _seg_prefix_fits(dst_ids, vec, dst_budget, elig)
+        a = _seg_prefix_fits(src_ids, vec, src_budget, dok)
+        acc = acc | a
+        dec = jnp.where(a[:, None], vec, 0.0)
+        dst_budget = dst_budget.at[dst_ids].add(-dec)
+        src_budget = src_budget.at[src_ids].add(-dec)
+        elig = (
+            elig & ~a
+            & jnp.all(vec <= dst_budget[dst_ids] + 1e-9, axis=1)
+            & jnp.all(vec <= src_budget[src_ids] + 1e-9, axis=1)
+        )
+    return acc
 
 
 def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
