@@ -428,3 +428,37 @@ def test_budget_accept_recovers_starved_segment():
     acc = np.asarray(_budget_accept(dst, src, vec, dstb, srcb,
                                     jnp.ones(3, bool)))
     assert list(acc) == [False, True, True]
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303, 404])
+def test_fuzz_engine_invariants(seed):
+    """Randomized cross-engine invariants: for varied topologies
+    (replication factors, rack counts, dead brokers, exclusions, skewed
+    loads), the TPU engine must produce a verifiable plan (hard goals
+    hold, proposals consistent) whose violation score is within tolerance
+    of the greedy oracle's."""
+    rng = np.random.default_rng(seed)
+    num_brokers = int(rng.integers(8, 24))
+    state = random_cluster(
+        seed=seed,
+        num_brokers=num_brokers,
+        num_racks=int(rng.integers(3, 6)),
+        num_partitions=int(rng.integers(60, 240)),
+        num_topics=int(rng.integers(2, 6)),
+        dead_brokers=int(rng.integers(0, 2)),
+        replication_factor=int(rng.integers(2, 4)),
+        distribution=rng.choice(list(Distribution)),
+        mean_utilization=float(rng.uniform(0.25, 0.5)),
+    )
+    options = OptimizationOptions(
+        excluded_topics=(
+            {int(rng.integers(2))} if rng.random() < 0.5 else set()
+        )
+    )
+    goals = make_goals()
+    tpu = TpuGoalOptimizer(config=FAST).optimize(state, options)
+    verify_result(state, tpu, goals, options)
+    greedy = GoalOptimizer(goals).optimize(state, options)
+    g = violation_score(greedy.final_state, goals)
+    t = violation_score(tpu.final_state, goals)
+    assert t <= g + max(3, g // 10), (seed, g, t)
