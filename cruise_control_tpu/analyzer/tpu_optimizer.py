@@ -24,8 +24,12 @@ hot path ★/★★) with a fully vectorized search:
   segment-sum.  Dependent move *sequences* emerge across rounds (hybrid
   device-score / host-commit, SURVEY.md §7 hard-part #3).
 * **Sharding**: the candidate axis shards across a device mesh via
-  ``shard_map`` — each device scores its slice and returns a local top-k,
-  merged by concatenation over ICI.
+  ``shard_map`` on BOTH search paths: the device-resident while_loop
+  shards its per-step K×D rescore + leadership scoring (reduced rows
+  reassembled with one small ``all_gather`` per step; selection and
+  batch-apply replicated in lockstep), and the score-only round path
+  shards its columnar scoring with per-device top-k merged by
+  concatenation over ICI.
 
 Same OptimizerResult contract as the greedy baseline: executor/REST/
 self-healing are engine-agnostic, and ``verify_result``/``violation_score``
@@ -79,9 +83,10 @@ class TpuSearchConfig:
     #: the per-step rescore cost scales linearly with the budget.
     candidate_budget: int = 1 << 23
     max_source_replicas: int = 8192
-    #: destination-pool cap (D ≤ min(B, this)).  The auction commits at most
-    #: one move per destination per step and typical step batches are tens
-    #: of actions, so D far above the commit rate only buys rescore cost
+    #: destination-pool cap (D ≤ min(B, this)).  The budgeted cohort lets a
+    #: destination absorb as many moves per step as its deficit allows, so
+    #: commits concentrate on the active cold set; D above that set only
+    #: buys rescore cost
     max_dest_brokers: int = 1024
     #: top-k candidates returned from device per round; the host exact-recheck
     #: commits as many of them as still improve, so this bounds the
@@ -602,10 +607,21 @@ def _apply_on_device(
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
+def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
+                    mesh=None):
     """Compiled device-resident search: up to T (rescore → select-disjoint →
     batch-apply) steps per call, each committing ≤ device_batch_per_step
     conflict-free actions, exiting early on convergence (lax.while_loop).
+
+    ``mesh`` shards the per-step rescore — the K×D move grid and the
+    leadership pool, the dominant FLOPs — across the mesh axis inside the
+    while_loop (see :func:`_reduced_candidates`): the whole loop runs under
+    ``shard_map`` with the model replicated, each device scores its slice,
+    and the reduced rows ride one small ``all_gather`` per step; the
+    budgeted-cohort/auction selection and the batch apply are replicated
+    (tiny, deterministic — devices stay in lockstep).  With K divisible by
+    the mesh size the sharded program is arithmetically identical to the
+    single-device one; the host exact-recheck consumes both the same way.
 
     Returns (packed [4, slots + T + 2] f32, updated model) with
     slots = min(T, repool_steps)·M.  Columns [0, slots): committed
@@ -633,11 +649,13 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
     case)."""
     from cruise_control_tpu.ops.grid import move_grid_scores
 
-    use_pallas = _resolve_scoring(cfg, None) == "pallas"
+    use_pallas = _resolve_scoring(cfg, mesh) == "pallas"
     if use_pallas:
         from cruise_control_tpu.ops.pallas_grid import move_grid_scores_pallas
     M = cfg.device_batch_per_step
     repool = max(1, cfg.repool_steps)
+    axis = mesh.axis_names[0] if mesh is not None else None
+    n_dev = mesh.shape[axis] if mesh is not None else 1
 
     def step(carry):
         m, ca, done, t, count, out, counts, pools, since_pool = carry
@@ -655,7 +673,8 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         M_ = min(M, NROW)
         grid_fn = move_grid_scores_pallas if use_pallas else move_grid_scores
         kp, ks, row_scores, best_d, lp, lsl, l_scores = (
-            _reduced_candidates(m, cfg, ca, K, D, grid_fn, pools=pools)
+            _reduced_candidates(m, cfg, ca, K, D, grid_fn, pools=pools,
+                                axis=axis, n_dev=n_dev)
         )
         bl_score, bl_p, bl_s, bl_dst = _reduce_leadership_per_src(
             m, lp, lsl, l_scores
@@ -838,7 +857,18 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int):
         meta = meta.at[0, T + 1].set(jnp.where(done, 1.0, 0.0))
         return jnp.concatenate([out, meta], axis=1), m
 
-    return jax.jit(run)
+    if mesh is None:
+        return jax.jit(run)
+
+    from jax.sharding import PartitionSpec
+
+    from cruise_control_tpu.parallel.mesh import shard_map_norep
+
+    # model + constraints replicated in, results replicated out; the
+    # sharding happens inside the loop (see _reduced_candidates)
+    rep = PartitionSpec()
+    return jax.jit(shard_map_norep(run, mesh, in_specs=(rep, rep),
+                                   out_specs=(rep, rep)))
 
 
 def _fetch_scan_result(packed, T: int):
@@ -1035,15 +1065,19 @@ class _HostEvaluator:
     def commit_batch(self, kind, p, s, d) -> Tuple[List[BalancingAction], int]:
         """Vectorized evaluate + apply of ONE device step's batch.
 
-        The device selected these actions with partitions, src brokers, and
-        dst brokers each pairwise-distinct (_match_batch) — but a broker MAY
-        be one action's dest and another action's src in the same batch
-        (the matcher allows it on purpose; see its conflict-set comment).
-        Evaluating the whole batch against the step-start snapshot therefore
-        matches the device's own acceptance semantics exactly, and by the
-        convexity argument in _match_batch any src/dst overlap only
-        *improves* realized deltas, so batch acceptance is the conservative
-        side of the gate the sequential replay applied.  The batched apply
+        The device selected these actions on two paths: the budgeted cohort
+        (many moves may SHARE a source or destination broker, each fitting
+        the water-filling budgets — see _step_budgets) plus the disjoint
+        auction (partitions/src/dst pairwise-distinct, _match_batch).
+        Partitions are always distinct.  Evaluating the whole batch against
+        the step-start snapshot matches the device's own acceptance
+        semantics; for shared-endpoint cohort rows the budgets guarantee
+        each move individually improves the convex cost regardless of the
+        rest of the batch, and the cumulative per-destination trim below
+        re-checks the hard-capacity headroom that improvement alone does
+        not bound.  For src/dst overlaps across the two paths the convexity
+        argument in _match_batch applies: realized deltas only improve on
+        the snapshot scores.  The batched apply
         stays exact under that overlap ONLY because every aggregate update
         uses unbuffered accumulation (np.add.at) — do not "simplify" those
         to fancy-index assignment, which drops one of two updates to a
@@ -1167,6 +1201,45 @@ class _HostEvaluator:
 
         acc = feasible & (delta < cfg.improvement_tol)
         idx = np.nonzero(acc)[0]
+        if idx.size > 1:
+            # cumulative per-destination recheck (advisor round-1 medium):
+            # cohort batches may land many moves on one destination, and
+            # cap_ok above is per-action against the snapshot — a breach of
+            # capacity-threshold/max-replicas *within* the batch would only
+            # surface later as an OptimizationFailure from _finalize.
+            # Segmented inclusive prefixes (batch rows are in device score
+            # order) against the snapshot headroom trim breaching rows now,
+            # as action-level rejections.  Conservative: a trimmed row
+            # still counts in later rows' prefixes.
+            ds = dst[idx]
+            o = np.argsort(ds, kind="stable")
+            dso = ds[o]
+            # clip to the positive components: leadership rows may carry a
+            # negative delta in some resource (follower load can exceed
+            # leader load), and a trimmed row's negative component must not
+            # loosen later rows' prefixes — positive-only prefixes keep the
+            # trim conservative in every case
+            dlo = np.maximum(dload[idx][o], 0.0)
+            rco = r_delta[idx][o]
+            cs = np.cumsum(dlo, axis=0)
+            csr = np.cumsum(rco)
+            firsts = np.ones(dso.size, bool)
+            firsts[1:] = dso[1:] != dso[:-1]
+            start = np.maximum.accumulate(
+                np.where(firsts, np.arange(dso.size), -1)
+            )
+            incl = cs - (cs[start] - dlo[start])
+            inclr = csr - (csr[start] - rco[start])
+            head = (
+                ctx.broker_capacity[dso] * can["cap_threshold"]
+                - ctx.broker_load[dso]
+            )
+            ok = (incl <= head + 1e-6).all(axis=1) & (
+                ctx.broker_replica_count[dso] + inclr <= can["max_replicas"]
+            )
+            if not ok.all():
+                acc[idx[o[~ok]]] = False
+                idx = np.nonzero(acc)[0]
         n_rej = n - idx.size
         if not idx.size:
             return [], n_rej
@@ -1330,7 +1403,7 @@ def _build_pools(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int):
 
 
 def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
-                        D: int, grid_fn, pools=None):
+                        D: int, grid_fn, pools=None, axis=None, n_dev=1):
     """Pruned, per-row-reduced move candidates + leadership candidates.
 
     The raw K×D grid is reduced to each source row's best
@@ -1340,25 +1413,61 @@ def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
     (:func:`_topq_rows_per_src`) and feeds the budgeted cohort + disjoint
     auction; the score-only path ranks the per-source rows directly.
 
-    Returns (kp, ks, row_scores [K, R], best_d [K, R], lp, lsl, l_scores).
+    Returns (kp, ks, row_scores [Kn, R], best_d [Kn, R], lp, lsl, l_scores).
 
     ``pools`` (from :func:`_build_pools`) may be passed in so the P·S-scale
     pool construction is hoisted out of a multi-step device loop — pool
     membership is a pruning heuristic that drifts negligibly across a few
     dozen committed actions, while the scoring here stays live.
+
+    ``axis``/``n_dev`` (inside :func:`shard_map <parallel.shard_map_norep>`
+    only): the K×D grid rescore and the leadership scoring — the per-step
+    FLOPs — shard over the mesh axis.  Each device scores a ceil(K/n) row
+    slice (edge slices clamp, so trailing rows may duplicate row K-1 —
+    harmless: downstream selection dedups per partition) and the reduced
+    [Kl, R] rows are reassembled with ``all_gather`` over ICI, ~K·R f32 per
+    step.  The returned pools are the gathered *effective* ones (length
+    n·ceil(K/n) ≥ K) so callers stay shape-consistent; with n | K they are
+    exactly the input pools and the result is arithmetically identical to
+    the single-device path.
     """
     R = min(DESTS_PER_SOURCE, D)
     kp, ks, dest_pool, lp, lsl = pools if pools is not None else _build_pools(
         m, cfg, ca, K, D
     )
-    g = grid_fn(m, cfg, ca, kp, ks, dest_pool)          # [K, D]
-    neg_best, best_i = jax.lax.top_k(-g, R)             # [K, R]
-    best_d = dest_pool[best_i]                          # [K, R] broker ids
     L = lp.shape[0]
-    l_scores, _ = _score_candidates(
-        m, cfg, ca, jnp.ones(L, jnp.int32), lp, lsl, jnp.zeros(L, jnp.int32)
+    if axis is None:
+        g = grid_fn(m, cfg, ca, kp, ks, dest_pool)      # [K, D]
+        neg_best, best_i = jax.lax.top_k(-g, R)         # [K, R]
+        best_d = dest_pool[best_i]                      # [K, R] broker ids
+        l_scores, _ = _score_candidates(
+            m, cfg, ca, jnp.ones(L, jnp.int32), lp, lsl,
+            jnp.zeros(L, jnp.int32)
+        )
+        return kp, ks, -neg_best, best_d, lp, lsl, l_scores
+
+    ai = jax.lax.axis_index(axis)
+    Kl = -(-K // n_dev)
+    rows = jnp.clip(ai * Kl + jnp.arange(Kl, dtype=jnp.int32), 0, K - 1)
+    kp_l, ks_l = kp[rows], ks[rows]
+    g = grid_fn(m, cfg, ca, kp_l, ks_l, dest_pool)      # [Kl, D]
+    neg_best, best_i = jax.lax.top_k(-g, R)             # [Kl, R]
+    best_d_l = dest_pool[best_i]
+
+    def gather(x):
+        return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+    Ll = -(-L // n_dev)
+    lrows = jnp.clip(ai * Ll + jnp.arange(Ll, dtype=jnp.int32), 0, L - 1)
+    lp_l, lsl_l = lp[lrows], lsl[lrows]
+    l_sc_l, _ = _score_candidates(
+        m, cfg, ca, jnp.ones(Ll, jnp.int32), lp_l, lsl_l,
+        jnp.zeros(Ll, jnp.int32)
     )
-    return kp, ks, -neg_best, best_d, lp, lsl, l_scores
+    return (
+        gather(kp_l), gather(ks_l), gather(-neg_best), gather(best_d_l),
+        gather(lp_l), gather(lsl_l), gather(l_sc_l),
+    )
 
 
 def _merged_scores(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
@@ -1438,23 +1547,48 @@ def _step_budgets(m: DeviceModel, ca) -> Tuple[jax.Array, jax.Array]:
     → (src_budget, dst_budget), both f32 [B, R+2] over dims
     (resources..., replica count, potential NW-out).  A follower move whose
     (load, 1, pot) vector fits the remaining source surplus AND destination
-    deficit keeps the source above and the destination below the average
-    utilization (and count / potential-out analogues), so on the convex
-    per-broker cost each such move is an improvement independent of
-    whatever else the batch commits — the auction may take many per broker
-    per step without staleness risk.  Leadership transfers and out-of-
-    budget moves stay on the strict disjoint path."""
+    deficit improves the convex per-broker cost independent of whatever
+    else the batch commits, so the cohort may take many moves per broker
+    per step without staleness risk.  Two conditions per resource, and the
+    budget is their pointwise min:
+
+    * **bound terms** (piecewise-linear in utilization): source stays above
+      and destination below the average utilization, so the linear
+      over/under-bound terms never move the wrong way;
+    * **util² term** (quadratic in load/capacity): a src→dst unit improves
+      iff ``L_s/c_s² > L_d/c_d²``.  A broker-independent pivot
+      ``p_r = avg_u_r · Σc / Σc²`` (capacity-weighted) makes that pairwise
+      condition transitive: source budget keeps ``L_s ≥ p_r c_s²`` and
+      destination budget keeps ``L_d ≤ p_r c_d²``, so every in-budget pair
+      satisfies it.  For homogeneous capacities ``p_r c² = avg_u_r · c`` —
+      exactly the bound-term target, so this tightens nothing there; with
+      heterogeneous capacities it is the guard that makes the
+      independence claim true (advisor round-1 medium finding).
+
+    Leadership transfers and out-of-budget moves stay on the strict
+    disjoint path."""
     B = m.capacity.shape[0]
     alive_cap = jnp.where(m.alive[:, None], m.capacity, 0.0)
     avg_u = jnp.sum(m.broker_load, axis=0) / jnp.maximum(
         jnp.sum(alive_cap, axis=0), 1e-9
     )
     target = avg_u[None, :] * m.capacity                    # [B, R]
-    src_res = jnp.maximum(m.broker_load - target, 0.0)
+    # pivot target for the quadratic term: p_r · c_b² with
+    # p_r = avg_u_r · Σc / Σc² (alive brokers); == target when capacities
+    # are homogeneous
+    pivot = avg_u * jnp.sum(alive_cap, axis=0) / jnp.maximum(
+        jnp.sum(alive_cap * alive_cap, axis=0), 1e-9
+    )                                                       # [R]
+    quad_target = pivot[None, :] * m.capacity * m.capacity  # [B, R]
+    src_res = jnp.maximum(
+        m.broker_load - jnp.maximum(target, quad_target), 0.0
+    )
     # dead/excluded destinations get zero deficit: nothing qualifies into
     # them (their feasibility is separately masked anyway)
     dst_res = jnp.where(
-        m.dest_ok[:, None], jnp.maximum(target - m.broker_load, 0.0), 0.0
+        m.dest_ok[:, None],
+        jnp.maximum(jnp.minimum(target, quad_target) - m.broker_load, 0.0),
+        0.0,
     )
     src_rc = jnp.maximum(m.rcount - ca["avg_rcount"], 0.0)
     dst_rc = jnp.maximum(ca["avg_rcount"] - m.rcount, 0.0)
@@ -1948,10 +2082,9 @@ class TpuGoalOptimizer:
 
         if (
             cfg.steps_per_call
-            and self.mesh is None
             # an explicit "columnar" choice means the K·D columnar scorer,
             # which only the score-only round path runs
-            and _resolve_scoring(cfg, None) != "columnar"
+            and _resolve_scoring(cfg, self.mesh) != "columnar"
         ):
             # Device-resident search: the device commits steps_per_call
             # actions per call (scan); the host replays them through the
@@ -1967,7 +2100,8 @@ class TpuGoalOptimizer:
                 cfg = dataclasses.replace(
                     cfg, device_batch_per_step=int(np.clip(B // 2, 32, 2048))
                 )
-            scan_fn = _cached_scan_fn(cfg, K, D, cfg.steps_per_call)
+            scan_fn = _cached_scan_fn(cfg, K, D, cfg.steps_per_call,
+                                      self.mesh)
             # convergence exits via the device done flag / no-progress break;
             # the bound preserves the score-only path's total action budget
             # counted in *steps* (evacuations commit one per step), so

@@ -74,6 +74,7 @@ def random_cluster(
 
     # topology: brokers round-robin across racks
     broker_rack = np.arange(num_brokers, dtype=np.int32) % num_racks
+    # capacity may be [R] (homogeneous) or [B, R] (heterogeneous brokers)
     broker_capacity = np.broadcast_to(cap, (num_brokers, NUM_RESOURCES)).copy()
 
     # placement: per-partition random RF-subset of brokers, vectorized
@@ -115,7 +116,7 @@ def random_cluster(
     shape = shape / shape.mean()
 
     # per-resource leader load, scaled to hit the target mean broker utilization:
-    # sum_p load[p] * contribution ≈ B * mean_util * cap[r]
+    # sum_p load[p] * contribution ≈ mean_util * sum_b capacity[b, r]
     leader_load = np.empty((num_partitions, NUM_RESOURCES), np.float32)
     noise = rng.uniform(0.8, 1.2, size=(num_partitions, NUM_RESOURCES))
     for r in Resource:
@@ -126,7 +127,7 @@ def random_cluster(
             contrib = 1.0 + FOLLOWER_CPU_RATIO * (rf - 1)
         else:
             contrib = float(rf)  # disk/nw_in replicated to all
-        total = num_brokers * mean_utilization * cap[r]
+        total = mean_utilization * float(broker_capacity[:, r].sum())
         leader_load[:, r] = shape * noise[:, r] * total / (num_partitions * contrib)
 
     follower_load = leader_load.copy()

@@ -17,16 +17,24 @@ import os
 def enable(cache_dir: str | None = None) -> None:
     import jax
 
+    # default to a user-writable location: the package tree may be a
+    # read-only installed copy, and enable() is called unconditionally by
+    # the bench entry points — an unwritable dir must degrade to uncached,
+    # never crash
     cache_dir = cache_dir or os.environ.get(
-        "CRUISE_JIT_CACHE", os.path.join(os.path.dirname(__file__),
-                                         "..", "..", ".jax_cache")
+        "CRUISE_JIT_CACHE",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "cruise_control_tpu", "jax",
+        ),
     )
     cache_dir = os.path.abspath(cache_dir)
-    os.makedirs(cache_dir, exist_ok=True)
     try:
+        os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache everything, however small/fast-compiling
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
-        pass  # unknown flags on an older jax: keep going uncached
+        pass  # unwritable dir / unknown flags: keep going uncached

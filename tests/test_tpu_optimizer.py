@@ -53,7 +53,10 @@ def test_tpu_engine_excluded_topics():
 
 
 def test_tpu_engine_sharded_mesh():
-    """Candidate axis sharded over the 8-device CPU mesh via shard_map."""
+    """Device-resident search sharded over the 8-device CPU mesh: the
+    rescore shards inside the while_loop (not the score-only fallback), and
+    the plan matches single-device tightly — with K divisible by the mesh
+    size the two programs are arithmetically identical."""
     from jax.sharding import Mesh
 
     devices = np.array(jax.devices()[:8])
@@ -65,11 +68,40 @@ def test_tpu_engine_sharded_mesh():
     goals = make_goals()
     res = TpuGoalOptimizer(config=FAST, mesh=mesh).optimize(state)
     verify_result(state, res, goals)
-    # sharded and unsharded engines find comparable plans
     res_1 = TpuGoalOptimizer(config=FAST).optimize(state)
     s_mesh = violation_score(res.final_state, goals)
     s_one = violation_score(res_1.final_state, goals)
-    assert abs(s_mesh - s_one) <= max(3, int(0.2 * max(s_mesh, s_one)))
+    assert abs(s_mesh - s_one) <= max(1, int(0.02 * max(s_mesh, s_one)))
+
+
+def test_tpu_engine_sharded_mesh_at_scale():
+    """VERDICT round-1 item #1's done-bar: the device-RESIDENT path (not a
+    fallback) runs under the mesh at 1k brokers / 20k partitions on the
+    virtual 8-CPU mesh, with a tight quality tolerance vs single-device.
+
+    The search config is the production default (steps_per_call > 0 ⇒
+    resident while_loop engine); plan equality is expected because the
+    sharded rescore is arithmetically identical when the mesh size divides
+    K, so the tolerance only allows for XLA reduction-order drift."""
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, axis_names=("search",))
+    state = random_cluster(
+        seed=42, num_brokers=1000, num_racks=20, num_partitions=20000,
+        mean_utilization=0.4,
+    )
+    goals = make_goals()
+    cfg = TpuSearchConfig()
+    assert cfg.steps_per_call > 0  # resident engine, not score-only rounds
+    res_m = TpuGoalOptimizer(config=cfg, mesh=mesh).optimize(state)
+    verify_result(state, res_m, goals)
+    res_1 = TpuGoalOptimizer(config=cfg).optimize(state)
+    s_mesh = violation_score(res_m.final_state, goals)
+    s_one = violation_score(res_1.final_state, goals)
+    assert abs(s_mesh - s_one) <= max(2, int(0.02 * max(s_mesh, s_one))), (
+        s_mesh, s_one,
+    )
 
 
 def test_graft_entry_single_chip():
@@ -114,6 +146,74 @@ def test_tpu_engine_evacuates_excluded_topic_offline_replicas():
     verify_result(state, res, goals, options)
     fa = np.array(res.final_state.assignment)
     assert not (fa == 9).any()
+
+
+def test_tpu_engine_heterogeneous_capacity():
+    """Budgeted-cohort safety under heterogeneous broker capacities
+    (advisor round-1 medium finding: the water-filling budgets must use
+    the capacity-normalized pivot condition, or same-destination cohorts
+    can commit a net-worsening batch that both the device score and the
+    snapshot recheck accept)."""
+    from cruise_control_tpu.models.generators import DEFAULT_CAPACITY
+
+    B = 24
+    rng = np.random.default_rng(11)
+    scale = rng.uniform(0.4, 2.5, size=(B, 1)).astype(np.float32)
+    cap = (DEFAULT_CAPACITY[None, :] * scale).astype(np.float32)
+    state = random_cluster(
+        seed=11, num_brokers=B, num_racks=6, num_partitions=320,
+        capacity=cap, mean_utilization=0.4,
+        distribution=Distribution.EXPONENTIAL,
+    )
+    goals = make_goals()
+    greedy = GoalOptimizer(goals).optimize(state)
+    tpu = TpuGoalOptimizer(config=FAST).optimize(state)
+    verify_result(state, tpu, goals)
+    g_score = violation_score(greedy.final_state, goals)
+    t_score = violation_score(tpu.final_state, goals)
+    assert t_score <= g_score + 2, (g_score, t_score)
+
+
+def test_commit_batch_trims_cumulative_destination_breach():
+    """A cohort batch whose per-action checks pass but whose cumulative
+    per-destination load breaches the capacity threshold must be trimmed in
+    commit_batch, not explode later in _finalize (advisor round-1 medium)."""
+    from cruise_control_tpu.analyzer.context import AnalyzerContext
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        KIND_MOVE,
+        _HostEvaluator,
+    )
+    from cruise_control_tpu.models.builder import ClusterModelBuilder
+    from cruise_control_tpu.common.resources import Resource
+
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+           Resource.DISK: 100.0}
+    # four source brokers each hold one 30-DISK partition; one destination
+    # broker with 100 DISK capacity (threshold 0.8 → 80 headroom): any two
+    # moves fit individually and cumulatively, three breach cumulatively
+    racks = ["r0", "r1", "r2", "r3", "r4"]
+    for r in racks:
+        b.add_broker(r, cap)
+    load = {Resource.CPU: 1.0, Resource.NW_IN: 1.0, Resource.NW_OUT: 1.0,
+            Resource.DISK: 30.0}
+    for src in range(4):
+        b.add_partition(f"T{src}", [src], load)
+    state = b.build()
+    ctx = AnalyzerContext(state)
+    opt = TpuGoalOptimizer(config=FAST)
+    can = opt._constraint_arrays_np(ctx)
+    ev = _HostEvaluator(ctx, opt.config, can)
+    kind = np.full(4, KIND_MOVE, np.int32)
+    p = np.arange(4, dtype=np.int32)
+    s = np.zeros(4, np.int32)
+    d = np.full(4, 4, np.int32)          # all into broker 4
+    acts, n_rej = ev.commit_batch(kind, p, s, d)
+    thr = float(can["cap_threshold"][Resource.DISK])
+    assert ctx.broker_load[4, Resource.DISK] <= 100.0 * thr + 1e-6
+    # every accepted action fits; at least one was trimmed
+    assert len(acts) + n_rej == 4
+    assert n_rej >= 1
 
 
 def test_host_device_cost_parity():
