@@ -56,6 +56,20 @@ class AnalyzerContext:
         self.leader_load = np.array(state.leader_load, np.float32)
         self.follower_load = np.array(state.follower_load, np.float32)
         self.partition_topic = np.array(state.partition_topic, np.int32)
+        # capacity-estimation loads (upstream model/Load.java window series:
+        # percentile over windows when the state carries them and a
+        # capacity_percentile is set; otherwise aliases of the mean loads,
+        # so capacity and balance semantics coincide — round-1 behavior)
+        from cruise_control_tpu.models.cluster_state import capacity_loads
+
+        lcap, fcap = capacity_loads(state)
+        self.cap_distinct = lcap is not state.leader_load
+        if self.cap_distinct:
+            self.leader_cap_load = np.array(lcap, np.float32)
+            self.follower_cap_load = np.array(fcap, np.float32)
+        else:
+            self.leader_cap_load = self.leader_load
+            self.follower_cap_load = self.follower_load
         # broker data
         self.broker_capacity = np.array(state.broker_capacity, np.float32)
         self.broker_rack = np.array(state.broker_rack, np.int32)
@@ -205,6 +219,23 @@ class AnalyzerContext:
         self.broker_topic_leader_count[:] = np.bincount(
             lb * T + self.partition_topic.astype(np.int64), minlength=B * T
         ).reshape(B, T)
+        # capacity-estimate broker loads: a distinct roll-up only when the
+        # model carries a window series + percentile; otherwise an alias of
+        # broker_load (apply() keeps the pair in sync via cap_distinct)
+        if self.cap_distinct:
+            crload = np.where(
+                is_leader[:, :, None],
+                self.leader_cap_load[:, None, :],
+                self.follower_cap_load[:, None, :],
+            ).astype(np.float64)
+            cfload = crload[exists]
+            self.broker_cap_load = np.zeros((B, NUM_RESOURCES), np.float64)
+            for r in range(NUM_RESOURCES):
+                self.broker_cap_load[:, r] = np.bincount(
+                    fb, weights=cfload[:, r], minlength=B
+                )
+        else:
+            self.broker_cap_load = self.broker_load
 
     def leader_broker(self, p: int) -> int:
         return int(self.assignment[p, self.leader_slot[p]])
@@ -217,6 +248,13 @@ class AnalyzerContext:
         if self.is_leader(p, s):
             return self.leader_load[p].astype(np.float64)
         return self.follower_load[p].astype(np.float64)
+
+    def replica_cap_load_vec(self, p: int, s: int) -> np.ndarray:
+        """f64 [R] — the capacity-estimate load of replica (p, s) (== the
+        mean load unless a window series + percentile is configured)."""
+        if self.is_leader(p, s):
+            return self.leader_cap_load[p].astype(np.float64)
+        return self.follower_cap_load[p].astype(np.float64)
 
     def disk_alive_mask(self, b: int) -> np.ndarray:
         """bool [D] — existing, non-failed disks of broker b."""
@@ -269,6 +307,10 @@ class AnalyzerContext:
             s, src, dst = action.slot, action.source_broker, action.dest_broker
             assert self.assignment[p, s] == src, "stale action"
             load = self.replica_load_vec(p, s)
+            if self.cap_distinct:
+                capl = self.replica_cap_load_vec(p, s)
+                self.broker_cap_load[src] -= capl
+                self.broker_cap_load[dst] += capl
             pot = self.leader_load[p, Resource.NW_OUT]
             if self.disk_load is not None:
                 # leave the source disk; land on the destination's
@@ -304,6 +346,12 @@ class AnalyzerContext:
             dst = int(self.assignment[p, new_slot])
             assert src == action.source_broker and dst == action.dest_broker
             delta = (self.leader_load[p] - self.follower_load[p]).astype(np.float64)
+            if self.cap_distinct:
+                cdelta = (
+                    self.leader_cap_load[p] - self.follower_cap_load[p]
+                ).astype(np.float64)
+                self.broker_cap_load[src] -= cdelta
+                self.broker_cap_load[dst] += cdelta
             self.leader_slot[p] = new_slot
             self.broker_load[src] -= delta
             self.broker_load[dst] += delta

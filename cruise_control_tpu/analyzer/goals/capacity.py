@@ -71,7 +71,14 @@ class ReplicaCapacityGoal(Goal):
 
 
 class CapacityGoal(Goal):
-    """Resource capacity goal (hard); subclasses pin ``resource``."""
+    """Resource capacity goal (hard); subclasses pin ``resource``.
+
+    All checks run on the context's CAPACITY-ESTIMATE loads
+    (``broker_cap_load`` / ``replica_cap_load_vec``): the percentile over
+    the model's window series when ``ClusterState.capacity_percentile`` is
+    set (upstream ``model/Load.java`` window semantics — provision for
+    peak, not mean), and exactly the mean loads otherwise.
+    """
 
     resource: Resource
     is_hard = True
@@ -84,25 +91,27 @@ class CapacityGoal(Goal):
         )
 
     def _moved_load(self, ctx: AnalyzerContext, p: int, s: int) -> float:
-        return float(ctx.replica_load_vec(p, s)[self.resource])
+        return float(ctx.replica_cap_load_vec(p, s)[self.resource])
 
     def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
         delta = self._moved_load(ctx, p, s)
-        return ctx.broker_load[:, self.resource] + delta <= self._limits(ctx)
+        return ctx.broker_cap_load[:, self.resource] + delta <= self._limits(ctx)
 
     def accept_leadership(self, ctx: AnalyzerContext, p: int, new_slot: int) -> bool:
         if self.resource not in (Resource.NW_OUT, Resource.CPU):
             return True
         delta = float(
-            ctx.leader_load[p, self.resource] - ctx.follower_load[p, self.resource]
+            ctx.leader_cap_load[p, self.resource]
+            - ctx.follower_cap_load[p, self.resource]
         )
         dst = ctx.assignment[p, new_slot]
         return bool(
-            ctx.broker_load[dst, self.resource] + delta <= self._limits(ctx)[dst]
+            ctx.broker_cap_load[dst, self.resource] + delta
+            <= self._limits(ctx)[dst]
         )
 
     def violations(self, ctx: AnalyzerContext) -> int:
-        over = ctx.broker_load[:, self.resource] > self._limits(ctx) * (1 + 1e-9)
+        over = ctx.broker_cap_load[:, self.resource] > self._limits(ctx) * (1 + 1e-9)
         return int((over & ctx.broker_alive).sum())
 
     def optimize(self, ctx: AnalyzerContext, optimized: Sequence[Goal]) -> None:
@@ -114,16 +123,19 @@ class CapacityGoal(Goal):
         limits = self._limits(ctx)
         r = self.resource
         over_brokers = np.nonzero(
-            (ctx.broker_load[:, r] > limits) & ctx.broker_alive
+            (ctx.broker_cap_load[:, r] > limits) & ctx.broker_alive
         )[0]
         # most-overloaded first
-        order = np.argsort(-(ctx.broker_load[over_brokers, r] - limits[over_brokers]))
+        order = np.argsort(
+            -(ctx.broker_cap_load[over_brokers, r] - limits[over_brokers])
+        )
         for b in over_brokers[order].tolist():
             self._shed(ctx, b, optimized)
-            if ctx.broker_load[b, r] > self._limits(ctx)[b] * (1 + 1e-9):
+            if ctx.broker_cap_load[b, r] > self._limits(ctx)[b] * (1 + 1e-9):
                 raise OptimizationFailure(
                     f"{self.name}: broker {b} stuck over capacity "
-                    f"({ctx.broker_load[b, r]:.1f} > {self._limits(ctx)[b]:.1f})"
+                    f"({ctx.broker_cap_load[b, r]:.1f} > "
+                    f"{self._limits(ctx)[b]:.1f})"
                 )
 
     def _shed(self, ctx: AnalyzerContext, b: int, optimized: Sequence[Goal]) -> None:
@@ -133,7 +145,7 @@ class CapacityGoal(Goal):
         # biggest contribution first
         replicas.sort(key=lambda ps: -self._moved_load(ctx, *ps))
         for p, s in replicas:
-            if ctx.broker_load[b, r] <= limit:
+            if ctx.broker_cap_load[b, r] <= limit:
                 return
             if ctx.partition_excluded(p):
                 continue

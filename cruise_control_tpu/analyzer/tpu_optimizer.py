@@ -187,6 +187,13 @@ class DeviceModel:
     pot_nwout: jax.Array       # f32 [B]
     rcount: jax.Array          # f32 [B]
     lcount: jax.Array          # f32 [B]
+    # capacity-estimate loads (percentile over the model's window series;
+    # upstream model/Load.java semantics).  None = percentile off: every
+    # consumer branches at TRACE time to reuse the mean-load expressions,
+    # so the default compiled program is unchanged
+    leader_cload: Optional[jax.Array] = None    # f32 [P, R]
+    follower_cload: Optional[jax.Array] = None  # f32 [P, R]
+    broker_cload: Optional[jax.Array] = None    # f32 [B, R]
 
     def tree_flatten(self):
         # NOT dataclasses.astuple: that deep-copies every device array on each
@@ -229,6 +236,17 @@ def _recompute_aggregates(m: DeviceModel) -> DeviceModel:
     )[:B]
     pot = jnp.where(slot_exists, m.leader_load[:, Resource.NW_OUT][:, None], 0.0)
     pot_nwout = jax.ops.segment_sum(pot.reshape(-1), ids, num_segments=B + 1)[:B]
+    broker_cload = None
+    if m.leader_cload is not None:
+        crload = jnp.where(
+            is_leader[:, :, None],
+            m.leader_cload[:, None, :],
+            m.follower_cload[:, None, :],
+        )
+        crload = jnp.where(slot_exists[:, :, None], crload, 0.0)
+        broker_cload = jax.ops.segment_sum(
+            crload.reshape(-1, NUM_RESOURCES), ids, num_segments=B + 1
+        )[:B]
     return dataclasses.replace(
         m,
         broker_load=broker_load,
@@ -236,6 +254,7 @@ def _recompute_aggregates(m: DeviceModel) -> DeviceModel:
         pot_nwout=pot_nwout,
         rcount=rcount,
         lcount=lcount,
+        broker_cload=broker_cload,
     )
 
 
@@ -249,10 +268,12 @@ def _broker_cost(
     rcount: jax.Array,      # f32 [...]
     lcount: jax.Array,      # f32 [...]
     b: jax.Array,           # int32 [...] broker index (capacity lookup)
+    cload: Optional[jax.Array] = None,  # f32 [..., R] capacity-estimate load
 ) -> jax.Array:
     """Per-broker soft-goal cost at broker index ``b`` (ops.cost.broker_cost)."""
     return broker_cost(
-        cfg, ca, m.capacity[b], load, leader_nwin, pot_nwout, rcount, lcount
+        cfg, ca, m.capacity[b], load, leader_nwin, pot_nwout, rcount, lcount,
+        cload=cload,
     )
 
 
@@ -292,6 +313,18 @@ def _score_candidates(
     )
     lead_delta = m.leader_load[cp] - m.follower_load[cp]
     delta_load = jnp.where(is_lead[:, None], lead_delta, move_load)
+    # capacity-estimate twin (trace-time branch; == delta_load when off)
+    has_cap = m.leader_cload is not None
+    if has_cap:
+        cmove_load = jnp.where(
+            leader_now[:, None], m.leader_cload[cp], m.follower_cload[cp]
+        )
+        clead_delta = m.leader_cload[cp] - m.follower_cload[cp]
+        cdelta_load = jnp.where(is_lead[:, None], clead_delta, cmove_load)
+        b_cload = m.broker_cload
+    else:
+        cdelta_load = delta_load
+        b_cload = m.broker_load
 
     # ---- feasibility (fused hard-goal mask) -----------------------------------
     slot_exists = slot_broker != EMPTY_SLOT
@@ -304,9 +337,9 @@ def _score_candidates(
         -1,
     )
     rack_clash = jnp.any(other_racks == cand_rack[:, None], axis=1)
-    dst_load_after = m.broker_load[dst_c] + delta_load
+    dst_cload_after = b_cload[dst_c] + cdelta_load
     cap_ok = jnp.all(
-        dst_load_after
+        dst_cload_after
         <= m.capacity[dst_c] * ca["cap_threshold"][None, :] + 1e-6,
         axis=1,
     )
@@ -349,6 +382,7 @@ def _score_candidates(
     f_src_old = cost(
         m.broker_load[src_c], m.leader_nwin[src_c], m.pot_nwout[src_c],
         m.rcount[src_c], m.lcount[src_c], src_c,
+        cload=b_cload[src_c] if has_cap else None,
     )
     f_src_new = cost(
         m.broker_load[src_c] - delta_load,
@@ -357,10 +391,12 @@ def _score_candidates(
         m.rcount[src_c] - r_delta,
         m.lcount[src_c] - l_delta,
         src_c,
+        cload=(b_cload[src_c] - cdelta_load) if has_cap else None,
     )
     f_dst_old = cost(
         m.broker_load[dst_c], m.leader_nwin[dst_c], m.pot_nwout[dst_c],
         m.rcount[dst_c], m.lcount[dst_c], dst_c,
+        cload=b_cload[dst_c] if has_cap else None,
     )
     f_dst_new = cost(
         m.broker_load[dst_c] + delta_load,
@@ -369,6 +405,7 @@ def _score_candidates(
         m.rcount[dst_c] + r_delta,
         m.lcount[dst_c] + l_delta,
         dst_c,
+        cload=dst_cload_after if has_cap else None,
     )
     delta = (f_src_new - f_src_old) + (f_dst_new - f_dst_old)
     friction = (
@@ -402,6 +439,14 @@ def _build_round_pools(
     cap = jnp.maximum(m.capacity, 1e-9)
     util = m.broker_load / cap                           # [B, R]
     overage = jnp.sum(jnp.maximum(util - ca["util_upper"], 0.0), axis=1)  # [B]
+    if m.broker_cload is not None:
+        # percentile-capacity overage is a hard-goal repair driver: brokers
+        # over their capacity-estimate limit must shed even when their mean
+        # utilization looks balanced
+        cutil = m.broker_cload / cap
+        overage = overage + 10.0 * jnp.sum(
+            jnp.maximum(cutil - ca["cap_threshold"], 0.0), axis=1
+        )
     # replica priority [P, S]
     is_leader = jnp.arange(S)[None, :] == m.leader_slot[:, None]
     rload = jnp.where(
@@ -530,6 +575,16 @@ def _apply_batch_on_device(
     load_delta = seg(
         jnp.concatenate([-dload, dload], axis=0)
     )
+    broker_cload = m.broker_cload
+    if m.leader_cload is not None:
+        cmove = jnp.where(
+            leader_now[:, None], m.leader_cload[p], m.follower_cload[p]
+        )
+        clead = m.leader_cload[p] - m.follower_cload[p]
+        dcload = jnp.where(is_move[:, None], cmove, clead) * gate[:, None]
+        broker_cload = m.broker_cload + seg(
+            jnp.concatenate([-dcload, dcload], axis=0)
+        )
     # placement scatters: unselected rows target row P (dropped)
     pm = jnp.where(take & is_move, p, P)
     pl = jnp.where(take & ~is_move, p, P)
@@ -543,6 +598,7 @@ def _apply_batch_on_device(
         pot_nwout=m.pot_nwout + seg(jnp.concatenate([-dpot, dpot])),
         rcount=m.rcount + seg(jnp.concatenate([-drc, drc])),
         lcount=m.lcount + seg(jnp.concatenate([-dlc, dlc])),
+        broker_cload=broker_cload,
     )
 
 
@@ -593,6 +649,14 @@ def _apply_on_device(
         jnp.where(apply_lead, s, lslot).astype(m.leader_slot.dtype)
     )
     new_must = m.must_move.at[p, s].set(m.must_move[p, s] & ~apply_move)
+    broker_cload = m.broker_cload
+    if m.leader_cload is not None:
+        cmove = jnp.where(leader_now, m.leader_cload[p], m.follower_cload[p])
+        clead = m.leader_cload[p] - m.follower_cload[p]
+        dcload = jnp.where(is_move, cmove, clead) * gate
+        broker_cload = (
+            m.broker_cload.at[src_c].add(-dcload).at[dst_c].add(dcload)
+        )
     return dataclasses.replace(
         m,
         assignment=new_assign,
@@ -603,6 +667,7 @@ def _apply_on_device(
         pot_nwout=m.pot_nwout.at[src_c].add(-dpot).at[dst_c].add(dpot),
         rcount=m.rcount.at[src_c].add(-drc).at[dst_c].add(drc),
         lcount=m.lcount.at[src_c].add(-dlc).at[dst_c].add(dlc),
+        broker_cload=broker_cload,
     )
 
 
@@ -728,6 +793,17 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             ],
             axis=1,
         )
+        if m.leader_cload is not None:
+            # capacity-estimate move vector, matching _step_budgets' extra
+            # headroom dims
+            mlc = jnp.where(
+                (leader_now_q[:, None] & is_move_row[:, None]),
+                m.leader_cload[cand_p],
+                m.follower_cload[cand_p],
+            )
+            move_vec = jnp.concatenate(
+                [move_vec, jnp.where(is_move_row[:, None], mlc, 0.0)], axis=1
+            )
         src_budget, dst_budget = _step_budgets(m, ca)
         qualified = (
             is_move_row
@@ -902,7 +978,8 @@ def _fetch_scan_result(packed, T: int):
 # Host-side exact commit validation (numpy twin of _broker_cost / the mask)
 # ---------------------------------------------------------------------------------
 
-def _np_broker_cost(cfg: TpuSearchConfig, can, cap, load, lnwin, pot, rc, lc):
+def _np_broker_cost(cfg: TpuSearchConfig, can, cap, load, lnwin, pot, rc, lc,
+                    cload=None):
     """Numpy mirror of :func:`_broker_cost` for one broker (exact, host-side).
 
     The device scores a whole candidate batch against a *snapshot* of the
@@ -920,24 +997,28 @@ def _np_broker_cost(cfg: TpuSearchConfig, can, cap, load, lnwin, pot, rc, lc):
             np.asarray(cap)[None], np.asarray(load)[None],
             np.asarray([lnwin]), np.asarray([pot]),
             np.asarray([rc], np.float64), np.asarray([lc], np.float64),
+            cload=None if cload is None else np.asarray(cload)[None],
         )[0]
     )
 
 
 def _np_broker_cost_batch(cfg: TpuSearchConfig, can, cap, load, lnwin, pot,
-                          rc, lc):
+                          rc, lc, cload=None):
     """Per-broker soft-goal cost, batch form: cap/load [n, R], rest [n].
 
     The single source of the host-side cost math — the scalar
     :func:`_np_broker_cost` delegates here (batch-vs-scalar replay parity is
-    additionally covered in tests/test_tpu_optimizer.py)."""
+    additionally covered in tests/test_tpu_optimizer.py).  ``cload`` mirrors
+    :func:`ops.cost.broker_cost`: the capacity-overrun repair term runs on
+    the capacity-estimate loads when they are distinct."""
     cap = np.maximum(cap, 1e-9)
     util = load / cap
     c = np.sum(util * util, axis=1) * cfg.w_util_var
     over = np.maximum(util - can["util_upper"], 0.0)
     under = np.maximum(can["util_lower"] - util, 0.0)
     c += np.sum(over + under, axis=1) * cfg.w_bound
-    c += np.sum(np.maximum(util - can["cap_threshold"], 0.0), axis=1) * 1000.0
+    cutil = util if cload is None else cload / cap
+    c += np.sum(np.maximum(cutil - can["cap_threshold"], 0.0), axis=1) * 1000.0
     c += (rc / can["avg_rcount"] - 1.0) ** 2 * cfg.w_count
     c += (lc / can["avg_lcount"] - 1.0) ** 2 * cfg.w_leader_count
     c += (
@@ -970,7 +1051,8 @@ class _HostEvaluator:
         self.lead_ok = ctx.leadership_candidates()
         self.excluded = ctx.excluded_partition_mask()
 
-    def _cost(self, b: int, dload=0.0, dlnwin=0.0, dpot=0.0, drc=0.0, dlc=0.0):
+    def _cost(self, b: int, dload=0.0, dlnwin=0.0, dpot=0.0, drc=0.0, dlc=0.0,
+              dcload=0.0):
         ctx = self.ctx
         return _np_broker_cost(
             self.cfg,
@@ -981,6 +1063,9 @@ class _HostEvaluator:
             ctx.broker_potential_nw_out[b] + dpot,
             float(ctx.broker_replica_count[b]) + drc,
             float(ctx.broker_leader_count[b]) + dlc,
+            cload=(
+                ctx.broker_cap_load[b] + dcload if ctx.cap_distinct else None
+            ),
         )
 
     def evaluate(self, kind: int, p: int, s: int, d: int):
@@ -1006,7 +1091,8 @@ class _HostEvaluator:
             if (ctx.broker_rack[others] == ctx.broker_rack[dst]).any():
                 return None, np.inf
             move_load = ctx.replica_load_vec(p, s)
-            dst_after = ctx.broker_load[dst] + move_load
+            move_cap = ctx.replica_cap_load_vec(p, s)
+            dst_after = ctx.broker_cap_load[dst] + move_cap
             if (dst_after > ctx.broker_capacity[dst] * cap_thr + 1e-6).any():
                 return None, np.inf
             if ctx.broker_replica_count[dst] + 1 > can["max_replicas"]:
@@ -1019,9 +1105,11 @@ class _HostEvaluator:
             lnwin_delta = ctx.leader_load[p, Resource.NW_IN] if leader_now else 0.0
             pot_delta = ctx.leader_load[p, Resource.NW_OUT]
             delta = (
-                self._cost(src, -move_load, -lnwin_delta, -pot_delta, -1.0, -l_delta)
+                self._cost(src, -move_load, -lnwin_delta, -pot_delta, -1.0,
+                           -l_delta, dcload=-move_cap)
                 - self._cost(src)
-                + self._cost(dst, move_load, lnwin_delta, pot_delta, 1.0, l_delta)
+                + self._cost(dst, move_load, lnwin_delta, pot_delta, 1.0,
+                             l_delta, dcload=move_cap)
                 - self._cost(dst)
             )
             delta += (
@@ -1046,14 +1134,19 @@ class _HostEvaluator:
         if leader_now or not self.lead_ok[dst] or must_move or self.excluded[p]:
             return None, np.inf
         lead_delta = (ctx.leader_load[p] - ctx.follower_load[p]).astype(np.float64)
-        dst_after = ctx.broker_load[dst] + lead_delta
+        lead_cap_delta = (
+            ctx.leader_cap_load[p] - ctx.follower_cap_load[p]
+        ).astype(np.float64)
+        dst_after = ctx.broker_cap_load[dst] + lead_cap_delta
         if (dst_after > ctx.broker_capacity[dst] * cap_thr + 1e-6).any():
             return None, np.inf
         lnwin = ctx.leader_load[p, Resource.NW_IN]
         delta = (
-            self._cost(src, -lead_delta, -lnwin, 0.0, 0.0, -1.0)
+            self._cost(src, -lead_delta, -lnwin, 0.0, 0.0, -1.0,
+                       dcload=-lead_cap_delta)
             - self._cost(src)
-            + self._cost(dst, lead_delta, lnwin, 0.0, 0.0, 1.0)
+            + self._cost(dst, lead_delta, lnwin, 0.0, 0.0, 1.0,
+                         dcload=lead_cap_delta)
             - self._cost(dst)
         )
         action = BalancingAction(
@@ -1129,11 +1222,22 @@ class _HostEvaluator:
             np.float64
         )
         dload = np.where(is_lead[:, None], lead_delta, move_load)
+        if ctx.cap_distinct:
+            cmove = np.where(
+                leader_now[:, None],
+                ctx.leader_cap_load[p], ctx.follower_cap_load[p],
+            ).astype(np.float64)
+            clead = (
+                ctx.leader_cap_load[p] - ctx.follower_cap_load[p]
+            ).astype(np.float64)
+            dcload = np.where(is_lead[:, None], clead, cmove)
+        else:
+            dcload = dload
 
         dst_c = np.clip(dst, 0, B - 1)
         src_c = np.clip(src, 0, B - 1)
         cap_ok = (
-            ctx.broker_load[dst_c] + dload
+            ctx.broker_cap_load[dst_c] + dcload
             <= ctx.broker_capacity[dst_c] * can["cap_threshold"] + 1e-6
         ).all(axis=1)
 
@@ -1171,7 +1275,7 @@ class _HostEvaluator:
             is_lead, 0.0, ctx.leader_load[p, Resource.NW_OUT]
         ).astype(np.float64)
 
-        def cost(b, dl, dlnw, dpot, drc, dlc):
+        def cost(b, dl, dlnw, dpot, drc, dlc, dcl):
             return _np_broker_cost_batch(
                 cfg, can, ctx.broker_capacity[b],
                 ctx.broker_load[b] + dl,
@@ -1179,15 +1283,20 @@ class _HostEvaluator:
                 ctx.broker_potential_nw_out[b] + dpot,
                 ctx.broker_replica_count[b].astype(np.float64) + drc,
                 ctx.broker_leader_count[b].astype(np.float64) + dlc,
+                cload=(
+                    ctx.broker_cap_load[b] + dcl if ctx.cap_distinct else None
+                ),
             )
 
         z1 = np.zeros(n)
         zR = np.zeros((n, NUM_RESOURCES))
         delta = (
-            cost(src_c, -dload, -lnwin_delta, -pot_delta, -r_delta, -l_delta)
-            - cost(src_c, zR, z1, z1, z1, z1)
-            + cost(dst_c, dload, lnwin_delta, pot_delta, r_delta, l_delta)
-            - cost(dst_c, zR, z1, z1, z1, z1)
+            cost(src_c, -dload, -lnwin_delta, -pot_delta, -r_delta, -l_delta,
+                 -dcload)
+            - cost(src_c, zR, z1, z1, z1, z1, zR)
+            + cost(dst_c, dload, lnwin_delta, pot_delta, r_delta, l_delta,
+                   dcload)
+            - cost(dst_c, zR, z1, z1, z1, z1, zR)
         )
         delta += np.where(
             is_lead, 0.0,
@@ -1219,7 +1328,7 @@ class _HostEvaluator:
             # leader load), and a trimmed row's negative component must not
             # loosen later rows' prefixes — positive-only prefixes keep the
             # trim conservative in every case
-            dlo = np.maximum(dload[idx][o], 0.0)
+            dlo = np.maximum(dcload[idx][o], 0.0)
             rco = r_delta[idx][o]
             cs = np.cumsum(dlo, axis=0)
             csr = np.cumsum(rco)
@@ -1232,7 +1341,7 @@ class _HostEvaluator:
             inclr = csr - (csr[start] - rco[start])
             head = (
                 ctx.broker_capacity[dso] * can["cap_threshold"]
-                - ctx.broker_load[dso]
+                - ctx.broker_cap_load[dso]
             )
             ok = (incl <= head + 1e-6).all(axis=1) & (
                 ctx.broker_replica_count[dso] + inclr <= can["max_replicas"]
@@ -1255,6 +1364,10 @@ class _HostEvaluator:
         ctx.leader_slot[pm[~mv]] = sm[~mv]
         np.add.at(ctx.broker_load, srcs, -dl)
         np.add.at(ctx.broker_load, dsts, dl)
+        if ctx.cap_distinct:
+            dcl = dcload[idx]
+            np.add.at(ctx.broker_cap_load, srcs, -dcl)
+            np.add.at(ctx.broker_cap_load, dsts, dcl)
         one = np.ones(int(mv.sum()), np.int64)
         np.add.at(ctx.broker_replica_count, srcs[mv], -one)
         np.add.at(ctx.broker_replica_count, dsts[mv], one)
@@ -1619,6 +1732,17 @@ def _step_budgets(m: DeviceModel, ca) -> Tuple[jax.Array, jax.Array]:
     dst_budget = jnp.concatenate(
         [dst_res, dst_rc[:, None], dst_pot[:, None]], axis=1
     )
+    if m.broker_cload is not None:
+        # percentile-capacity headroom dims: a cohort's cumulative
+        # capacity-estimate load into one destination must fit the hard
+        # threshold (removals only relieve the source — unlimited there)
+        cap_head = jnp.maximum(
+            ca["cap_threshold"][None, :] * m.capacity - m.broker_cload, 0.0
+        )
+        src_budget = jnp.concatenate(
+            [src_budget, jnp.full((B, m.capacity.shape[1]), jnp.inf)], axis=1
+        )
+        dst_budget = jnp.concatenate([dst_budget, cap_head], axis=1)
     return src_budget, dst_budget
 
 
@@ -2005,6 +2129,16 @@ class TpuGoalOptimizer:
             pot_nwout=jnp.zeros(ctx.num_brokers, jnp.float32),
             rcount=jnp.zeros(ctx.num_brokers, jnp.float32),
             lcount=jnp.zeros(ctx.num_brokers, jnp.float32),
+            # percentile capacity estimation: distinct capacity-estimate
+            # loads only when the model carries them (None keeps the
+            # compiled programs identical to the mean-only path)
+            leader_cload=(
+                jnp.asarray(ctx.leader_cap_load) if ctx.cap_distinct else None
+            ),
+            follower_cload=(
+                jnp.asarray(ctx.follower_cap_load) if ctx.cap_distinct
+                else None
+            ),
         )
         return _recompute_aggregates(m)
 

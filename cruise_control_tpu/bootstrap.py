@@ -154,6 +154,9 @@ def build_app(
         max_allowed_extrapolations=cfg.get_int(
             "max.allowed.extrapolations.per.partition"
         ),
+        capacity_estimation_percentile=cfg.get_double(
+            "capacity.estimation.percentile"
+        ),
     )
     executor = Executor(
         backend,

@@ -233,6 +233,13 @@ def default_config_def() -> ConfigDef:
     d.define("min.valid.partition.ratio", ConfigType.DOUBLE, 0.95,
              Importance.HIGH, "Monitored-partition ratio for a usable model.",
              between(0, 1), G)
+    d.define("capacity.estimation.percentile", ConfigType.DOUBLE, 0.0,
+             Importance.MEDIUM,
+             "Percentile over the per-window load series used by capacity "
+             "goals (0 disables: capacity checks use mean loads). When set, "
+             "models carry the window series and capacity goals provision "
+             "for peak while balance goals keep optimizing the mean.",
+             between(0, 100), G)
     d.define("max.allowed.extrapolations.per.partition", ConfigType.INT, 5,
              Importance.LOW, "Extrapolated windows tolerated per partition.",
              at_least(0), G)

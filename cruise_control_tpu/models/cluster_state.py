@@ -77,6 +77,24 @@ class ClusterState:
     #: DeviceModel arrays, not ClusterState, so this never hits a jit cache key
     #: on the scale-critical path.
     partition_ids: tuple = struct.field(pytree_node=False, default=())
+    # ---- per-window load series (upstream model/Load.java carries
+    # resource × window time series into the model; SURVEY.md §2.4) --------
+    #: f32 [P, W, R] leader load per aggregation window; None = the monitor
+    #: collapsed windows (or the state was built without series).  The
+    #: ``leader_load``/``follower_load`` fields above remain the expected
+    #: (mean) loads that balance goals optimize; the window series feeds
+    #: percentile-based capacity estimation (:func:`capacity_loads`).
+    leader_load_windows: Optional[jax.Array] = None
+    #: f32 [P, W, R] follower twin of ``leader_load_windows``
+    follower_load_windows: Optional[jax.Array] = None
+    #: capacity-estimation percentile over the window axis (upstream
+    #: ``capacity.estimation``-style semantics): 0 = disabled (capacity
+    #: goals use the mean loads — round-1 behavior); e.g. 95 makes every
+    #: capacity goal check peak (p95-over-windows) loads while balance
+    #: goals keep optimizing the mean.  Carried on the state (set by the
+    #: monitor from config) so every consumer — greedy goals, TPU engine
+    #: host gates, verifier — derives identical capacity loads.
+    capacity_percentile: float = struct.field(pytree_node=False, default=0.0)
     # ---- JBOD (upstream model/Disk.java); None = no per-disk modeling -------
     #: int32 [P, S] disk index (within hosting broker) of each replica; -1 =
     #: unknown/none
@@ -147,6 +165,30 @@ class ClusterState:
 # ---------------------------------------------------------------------------------
 # Derived loads (upstream Load roll-ups, model/Load.java + ClusterModel caches)
 # ---------------------------------------------------------------------------------
+
+def capacity_loads(state: ClusterState):
+    """(leader_cap_load, follower_cap_load) — f32 [P, R] loads capacity goals
+    must budget for.
+
+    With a window series and ``capacity_percentile`` > 0: the per-partition
+    percentile over the window axis (host numpy — this feeds context
+    construction, not the jitted hot path).  Per-partition percentile then
+    summed per broker is the conservative side of the per-broker-sum
+    percentile (subadditivity of upper quantiles in the bursty regimes that
+    matter), matching the provision-for-peak intent of upstream
+    ``model/Load.java``'s window series.  Otherwise: the mean loads —
+    capacity and balance semantics coincide (round-1 behavior).
+    """
+    if state.leader_load_windows is None or state.capacity_percentile <= 0:
+        return state.leader_load, state.follower_load
+    q = float(state.capacity_percentile)
+    lw = np.asarray(state.leader_load_windows, np.float32)
+    fw = np.asarray(state.follower_load_windows, np.float32)
+    return (
+        np.percentile(lw, q, axis=1).astype(np.float32),
+        np.percentile(fw, q, axis=1).astype(np.float32),
+    )
+
 
 def replica_load(state: ClusterState) -> jax.Array:
     """f32 [P, S, R] — load each replica slot puts on its broker.

@@ -171,6 +171,7 @@ class LoadMonitor:
         num_windows: int = 5,
         min_samples_per_window: int = 1,
         max_allowed_extrapolations: int = 5,
+        capacity_estimation_percentile: float = 0.0,
     ):
         self.metadata = metadata
         self.sampler = sampler
@@ -181,6 +182,10 @@ class LoadMonitor:
         self.sample_store = sample_store or NoopSampleStore()
         self.window_ms = window_ms
         self.max_allowed_extrapolations = max_allowed_extrapolations
+        #: > 0 ⇒ built models carry the per-window load series and capacity
+        #: goals estimate at this percentile over windows (upstream
+        #: model/Load.java window semantics; 0 keeps mean-only models)
+        self.capacity_estimation_percentile = capacity_estimation_percentile
         self.state = LoadMonitorState.NOT_STARTED
         self._model_semaphore = threading.Semaphore(1)
         self._last_sample_ms = 0
@@ -371,7 +376,35 @@ class LoadMonitor:
                 offline=[b in off_brokers for b in replicas],
                 disks=disks,
             )
-        return builder.build()
+        state = builder.build()
+        if self.capacity_estimation_percentile > 0 and wsel.size:
+            # carry the per-window series into the model (upstream
+            # model/Load.java): [P, W, R] in the state's dense partition
+            # order, follower series derived the same way as the mean
+            vals = agg.values[:, wsel, :]                    # [E, W, M]
+            if vals.shape[0] < max_pid:
+                vals = np.concatenate(
+                    [vals, np.zeros((max_pid - vals.shape[0],) + vals.shape[1:])],
+                    axis=0,
+                )
+            W = vals.shape[1]
+            P = state.num_partitions
+            lw = np.zeros((P, W, NUM_RESOURCES), np.float32)
+            ext = state.partition_ids or tuple(range(P))
+            v = vals[np.asarray(ext, int)]                   # [P, W, M]
+            lw[:, :, Resource.CPU] = v[:, :, P_CPU]
+            lw[:, :, Resource.NW_IN] = v[:, :, P_NW_IN]
+            lw[:, :, Resource.NW_OUT] = v[:, :, P_NW_OUT]
+            lw[:, :, Resource.DISK] = v[:, :, P_DISK]
+            fw = lw.copy()
+            fw[:, :, Resource.NW_OUT] = 0.0
+            fw[:, :, Resource.CPU] *= FOLLOWER_CPU_RATIO
+            state = state.replace(
+                leader_load_windows=lw,
+                follower_load_windows=fw,
+                capacity_percentile=self.capacity_estimation_percentile,
+            )
+        return state
 
     # ---- observability ----------------------------------------------------------
     def state_summary(self) -> dict:

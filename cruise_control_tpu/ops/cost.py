@@ -31,15 +31,25 @@ def broker_cost(
     pot_nwout: jax.Array,   # f32 [...]
     rcount: jax.Array,      # f32 [...]
     lcount: jax.Array,      # f32 [...]
+    cload: jax.Array = None,  # f32 [..., R] capacity-estimate load (None = load)
 ) -> jax.Array:
-    """Per-broker contribution to the global soft-goal cost (see module doc)."""
+    """Per-broker contribution to the global soft-goal cost (see module doc).
+
+    ``cload`` is the capacity-estimation load (percentile-over-windows when
+    the model carries a window series): the heavy capacity-overrun repair
+    term uses it, while the balance terms use the mean ``load``.  Callers
+    that pass the *same* traced array for both (percentile off — the
+    default) compile to the identical program as before: the duplicated
+    utilization expression CSEs away.
+    """
     cap = jnp.maximum(cap, 1e-9)
     util = load / cap
     c_var = jnp.sum(util * util, axis=-1) * cfg.w_util_var
     over = jnp.maximum(util - ca["util_upper"], 0.0)
     under = jnp.maximum(ca["util_lower"] - util, 0.0)
     c_bound = jnp.sum(over + under, axis=-1) * cfg.w_bound
-    cap_over = jnp.maximum(util - ca["cap_threshold"], 0.0)
+    cutil = util if cload is None else cload / cap
+    cap_over = jnp.maximum(cutil - ca["cap_threshold"], 0.0)
     c_cap = jnp.sum(cap_over, axis=-1) * 1000.0
     c_rc = ((rcount / ca["avg_rcount"] - 1.0) ** 2) * cfg.w_count
     c_lc = ((lcount / ca["avg_lcount"] - 1.0) ** 2) * cfg.w_leader_count

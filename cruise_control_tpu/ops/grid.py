@@ -59,21 +59,32 @@ def move_grid_terms(
     move_load = jnp.where(
         leader_now[:, None], m.leader_load[kp], m.follower_load[kp]
     )                                                     # [K, R]
+    # capacity-estimate twin (trace-time branch: None = percentile off,
+    # capacity checks run on the mean loads — zero extra work compiled)
+    cmove_load = (
+        move_load if m.leader_cload is None
+        else jnp.where(
+            leader_now[:, None], m.leader_cload[kp], m.follower_cload[kp]
+        )
+    )                                                     # [K, R]
     must_move = m.must_move[kp, jnp.clip(ks, 0, S - 1)]
     excluded = m.excluded[kp] & ~must_move
     l_delta = jnp.where(leader_now, 1.0, 0.0)
     lnwin_delta = jnp.where(leader_now, m.leader_load[kp, Resource.NW_IN], 0.0)
     pot_delta = m.leader_load[kp, Resource.NW_OUT]
 
+    has_cap = m.broker_cload is not None
     f_src_old = broker_cost(
         cfg, ca, m.capacity[src_c], m.broker_load[src_c],
         m.leader_nwin[src_c], m.pot_nwout[src_c], m.rcount[src_c],
         m.lcount[src_c],
+        cload=m.broker_cload[src_c] if has_cap else None,
     )
     f_src_new = broker_cost(
         cfg, ca, m.capacity[src_c], m.broker_load[src_c] - move_load,
         m.leader_nwin[src_c] - lnwin_delta, m.pot_nwout[src_c] - pot_delta,
         m.rcount[src_c] - 1.0, m.lcount[src_c] - l_delta,
+        cload=(m.broker_cload[src_c] - cmove_load) if has_cap else None,
     )
     friction = move_load[:, Resource.DISK] / ca["avg_disk_cap"] * cfg.w_move_size
     evac = jnp.where(must_move, -1e6, 0.0)
@@ -90,6 +101,7 @@ def move_grid_terms(
         "excluded": excluded,
         "must_move": must_move,
         "move_load": move_load,
+        "cmove_load": cmove_load,
         "l_delta": l_delta,
         "lnwin_delta": lnwin_delta,
         "pot_delta": pot_delta,
@@ -108,9 +120,11 @@ def move_grid_scores(
     """Scores [K, D] for every (source replica, destination) move; +inf where
     infeasible.  Exact same mask + delta as the columnar scorer."""
     t = move_grid_terms(m, cfg, ca, kp, ks)
+    has_cap = m.broker_cload is not None
     d_c = jnp.clip(dest_pool, 0)
     d_cap = m.capacity[d_c]                               # [D, R]
     d_load = m.broker_load[d_c]                           # [D, R]
+    d_cload = m.broker_cload[d_c] if has_cap else d_load  # [D, R]
     d_rack = m.rack[d_c]                                  # [D]
 
     # ---- feasibility [K, D] --------------------------------------------------
@@ -122,8 +136,14 @@ def move_grid_scores(
         t["other_racks"][:, :, None] == d_rack[None, None, :], axis=1
     )
     load_after = d_load[None, :, :] + t["move_load"][:, None, :]  # [K, D, R]
+    # hard-capacity feasibility on the capacity-estimate loads (== load_after
+    # when percentile is off — same traced expression, no extra work)
+    cload_after = (
+        load_after if not has_cap
+        else d_cload[None, :, :] + t["cmove_load"][:, None, :]
+    )
     cap_ok = jnp.all(
-        load_after <= d_cap[None] * ca["cap_threshold"][None, None, :] + 1e-6,
+        cload_after <= d_cap[None] * ca["cap_threshold"][None, None, :] + 1e-6,
         axis=2,
     )
     feasible = (
@@ -143,6 +163,7 @@ def move_grid_scores(
     f_dst_old = broker_cost(
         cfg, ca, d_cap, d_load, m.leader_nwin[d_c], m.pot_nwout[d_c],
         m.rcount[d_c], m.lcount[d_c],
+        cload=d_cload if has_cap else None,
     )                                                     # [D]
     f_dst_new = broker_cost(
         cfg, ca,
@@ -152,6 +173,7 @@ def move_grid_scores(
         m.pot_nwout[d_c][None, :] + t["pot_delta"][:, None],
         m.rcount[d_c][None, :] + 1.0,
         m.lcount[d_c][None, :] + t["l_delta"][:, None],
+        cload=cload_after if has_cap else None,
     )                                                     # [K, D]
     delta = t["src_term"][:, None] + (f_dst_new - f_dst_old[None, :])
     return jnp.where(feasible, delta, jnp.inf)

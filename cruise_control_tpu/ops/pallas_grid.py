@@ -123,6 +123,13 @@ def move_grid_scores_pallas(
     interpret: bool | None = None,
 ) -> jax.Array:
     """Pallas twin of ops.grid.move_grid_scores → f32 [K, D]."""
+    if m.broker_cload is not None:
+        # the fused kernel bakes mean-load capacity semantics; percentile
+        # capacity estimation (distinct cload arrays) falls back to the jnp
+        # grid, which carries the capacity-estimate feasibility terms
+        from cruise_control_tpu.ops.grid import move_grid_scores
+
+        return move_grid_scores(m, cfg, ca, kp, ks, dest_pool)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     K = kp.shape[0]
