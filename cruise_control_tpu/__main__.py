@@ -22,12 +22,19 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     props = load_properties(argv[0]) if argv else {}
     port = int(argv[1]) if len(argv) > 1 else None
-    app = build_app(CruiseControlConfig(props), port=port)
+    cfg = CruiseControlConfig(props)
+    from cruise_control_tpu.utils.logging import configure
+
+    configure(cfg.get("logging.level"), cfg.get("logging.file"))
+    app = build_app(cfg, port=port)
 
     app.server.start()
     app.fetcher_manager.start()
     app.detector_manager.start()
-    app.cruise_control.start_proposal_precomputation()
+    app.cruise_control.start_proposal_precomputation(
+        interval_s=app.config.get("proposal.precompute.interval.ms") / 1000,
+        engine=app.config.get("proposal.precompute.engine"),
+    )
     # the simulated brokers report on the sampling cadence (a real cluster's
     # reporters push to __CruiseControlMetrics on their own schedule)
     stop = threading.Event()

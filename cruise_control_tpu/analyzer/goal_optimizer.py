@@ -58,6 +58,9 @@ from cruise_control_tpu.analyzer.goals.rack import (
     RackAwareGoal,
 )
 from cruise_control_tpu.models.cluster_state import ClusterState
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("analyzer")
 from cruise_control_tpu.models.stats import cluster_stats, stats_summary
 
 #: Upstream default.goals order (cruisecontrol.properties default.goals).
@@ -124,9 +127,19 @@ KAFKA_ASSIGNER_GOAL_ORDER = [
 def make_goals(
     names: Optional[Sequence[str]] = None,
     constraint: Optional[BalancingConstraint] = None,
+    hard_names: Optional[Sequence[str]] = None,
 ) -> List[Goal]:
+    """Instantiate goals by name (upstream getConfiguredInstances over the
+    `default.goals` list).  ``hard_names`` overrides which goals are treated
+    as hard for this instance (upstream `hard.goals`); None keeps each
+    class's intrinsic hardness."""
     constraint = constraint or BalancingConstraint()
-    return [GOAL_CLASSES[n](constraint) for n in (names or DEFAULT_GOAL_ORDER)]
+    goals = [GOAL_CLASSES[n](constraint) for n in (names or DEFAULT_GOAL_ORDER)]
+    if hard_names is not None:
+        hard = set(hard_names)
+        for g in goals:
+            g.is_hard = g.name in hard
+    return goals
 
 
 @dataclasses.dataclass(frozen=True)
@@ -301,10 +314,21 @@ class GoalOptimizer:
         stats_before = stats_summary(cluster_stats(state))
         violations_before = {g.name: g.violations(ctx) for g in self.goals}
 
+        import logging as _logging
+
         optimized: List[Goal] = []
         for goal in self.goals:
+            n_before = len(ctx.actions)
             goal.optimize(ctx, optimized)
+            if LOG.isEnabledFor(_logging.DEBUG):  # violations() is real work
+                LOG.debug(
+                    "%s: %d actions (violations %d -> %d)", goal.name,
+                    len(ctx.actions) - n_before, violations_before[goal.name],
+                    goal.violations(ctx),
+                )
             if goal.is_hard and goal.violations(ctx) > 0:
+                LOG.error("hard goal %s still violated after optimization",
+                          goal.name)
                 raise OptimizationFailure(
                     f"{goal.name} still violated after optimization"
                 )

@@ -62,6 +62,9 @@ from cruise_control_tpu.analyzer.goals.base import BALANCE_MARGIN, BalancingCons
 from cruise_control_tpu.models.cluster_state import ClusterState
 from cruise_control_tpu.models.stats import cluster_stats, stats_summary
 from cruise_control_tpu.ops.cost import broker_cost
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("engine")
 
 KIND_MOVE = 0
 KIND_LEADERSHIP = 1
@@ -2246,10 +2249,16 @@ class TpuGoalOptimizer:
                 -(cfg.max_rounds * cfg.max_moves_per_round)
                 // -cfg.steps_per_call,
             )
+            n_calls = n_committed = n_rejected = 0
             for _ in range(calls_budget):
                 if budget_exhausted():
+                    LOG.info(
+                        "anytime budget (%.1fs) exhausted after %d calls",
+                        cfg.time_budget_s, n_calls,
+                    )
                     break
                 packed, m_new = scan_fn(m, ca)
+                n_calls += 1
                 k_all, p_all, s_all, d_all, step_counts, device_done = (
                     _fetch_scan_result(packed, cfg.steps_per_call)
                 )
@@ -2271,7 +2280,11 @@ class TpuGoalOptimizer:
                     actions.extend(acts)
                     batch += len(acts)
                     rejected += n_rej
+                n_committed += batch
+                n_rejected += rejected
                 if not batch:
+                    LOG.debug("device call %d: nothing validated — stopping",
+                              n_calls)
                     break  # nothing validated — no further progress possible
                 if not rejected:
                     m = m_new
@@ -2282,9 +2295,18 @@ class TpuGoalOptimizer:
                     if device_done:
                         break
                 else:
+                    LOG.debug(
+                        "device call %d: %d committed, %d rejected by host "
+                        "recheck — resyncing device model", n_calls, batch,
+                        rejected,
+                    )
                     # device state includes skipped actions — rebuild from
                     # the live context before the next call
                     m = _resync_device_model(m, ctx)
+            LOG.info(
+                "resident search: %d device calls, %d actions committed, "
+                "%d rejected", n_calls, n_committed, n_rejected,
+            )
             # polish: fall through to the score-only loop.  The device scan
             # batches per-src-broker candidates, whose coarser granularity
             # converges a few percent short of sequential search; the score-
@@ -2348,14 +2370,28 @@ class TpuGoalOptimizer:
 
         for g in goals:
             if g.is_hard and violations_after[g.name] > 0:
+                LOG.error(
+                    "hard goal %s still violated after TPU search: %d "
+                    "(before: %d)", g.name, violations_after[g.name],
+                    violations_before[g.name],
+                )
                 raise OptimizationFailure(
                     f"{g.name} still violated after TPU search "
                     f"({violations_after[g.name]} violations)"
                 )
         if ctx.replica_offline.any():
+            LOG.error(
+                "%d offline replicas could not be evacuated",
+                int(ctx.replica_offline.sum()),
+            )
             raise OptimizationFailure(
                 "offline replicas could not be evacuated by TPU search"
             )
+        LOG.info(
+            "TPU search done: %d actions, violations %d -> %d, %.2fs",
+            len(actions), sum(violations_before.values()),
+            sum(violations_after.values()), time.perf_counter() - t0,
+        )
         final_state = ctx.to_state(state)
         stats_after = stats_summary(cluster_stats(final_state))
         from cruise_control_tpu.analyzer.provision import (

@@ -87,6 +87,173 @@ def _synthetic_workload(cfg: CruiseControlConfig) -> Tuple[WorkloadModel, set]:
     return w, set(range(B))
 
 
+def _balancing_constraint(cfg: CruiseControlConfig):
+    """BalancingConstraint from the analyzer key group (upstream
+    AnalyzerConfig thresholds)."""
+    from cruise_control_tpu.analyzer.goals.base import BalancingConstraint
+    from cruise_control_tpu.common.resources import Resource
+
+    return BalancingConstraint(
+        capacity_threshold={
+            Resource.CPU: cfg.get_double("cpu.capacity.threshold"),
+            Resource.DISK: cfg.get_double("disk.capacity.threshold"),
+            Resource.NW_IN: cfg.get_double(
+                "network.inbound.capacity.threshold"),
+            Resource.NW_OUT: cfg.get_double(
+                "network.outbound.capacity.threshold"),
+        },
+        balance_threshold={
+            Resource.CPU: cfg.get_double("cpu.balance.threshold"),
+            Resource.DISK: cfg.get_double("disk.balance.threshold"),
+            Resource.NW_IN: cfg.get_double(
+                "network.inbound.balance.threshold"),
+            Resource.NW_OUT: cfg.get_double(
+                "network.outbound.balance.threshold"),
+        },
+        low_utilization_threshold={
+            Resource.CPU: cfg.get_double("cpu.low.utilization.threshold"),
+            Resource.DISK: cfg.get_double("disk.low.utilization.threshold"),
+            Resource.NW_IN: cfg.get_double(
+                "network.inbound.low.utilization.threshold"),
+            Resource.NW_OUT: cfg.get_double(
+                "network.outbound.low.utilization.threshold"),
+        },
+        replica_balance_threshold=cfg.get_double(
+            "replica.count.balance.threshold"),
+        leader_replica_balance_threshold=cfg.get_double(
+            "leader.replica.count.balance.threshold"),
+        topic_replica_balance_threshold=cfg.get_double(
+            "topic.replica.count.balance.threshold"),
+        max_replicas_per_broker=cfg.get_int("max.replicas.per.broker"),
+        min_topic_leaders_per_broker=cfg.get_int(
+            "min.topic.leaders.per.broker"),
+        broker_sets=_load_broker_sets(cfg),
+    )
+
+
+def _load_broker_sets(cfg: CruiseControlConfig):
+    """brokerset.config.file: JSON {topic name: [broker ids]} — resolved to
+    topic-id keys lazily by the facade (names here, ids per model)."""
+    path = cfg.get("brokerset.config.file")
+    if not path:
+        return {}
+    import json
+
+    with open(path) as f:
+        raw = json.load(f)
+    # the constraint's broker_sets is keyed by topic id; the facade resolves
+    # names per model.  Store under a name key the facade rewrites.
+    return {name: set(map(int, brokers)) for name, brokers in raw.items()}
+
+
+def _tpu_search_config(cfg: CruiseControlConfig):
+    """TpuSearchConfig from the tpu.engine key group."""
+    from cruise_control_tpu.analyzer.tpu_optimizer import TpuSearchConfig
+
+    return TpuSearchConfig(
+        max_rounds=cfg.get_int("tpu.search.max.rounds"),
+        candidate_budget=cfg.get_int("tpu.search.candidate.budget"),
+        max_source_replicas=cfg.get_int("tpu.search.max.source.replicas"),
+        max_dest_brokers=cfg.get_int("tpu.search.max.dest.brokers"),
+        topk_per_round=cfg.get_int("tpu.search.topk.per.round"),
+        max_moves_per_round=cfg.get_int("tpu.search.max.moves.per.round"),
+        improvement_tol=cfg.get_double("tpu.search.improvement.tolerance"),
+        w_util_var=cfg.get_double("tpu.search.weight.util.variance"),
+        w_bound=cfg.get_double("tpu.search.weight.balance.bound"),
+        w_count=cfg.get_double("tpu.search.weight.replica.count"),
+        w_leader_count=cfg.get_double("tpu.search.weight.leader.count"),
+        w_leader_nwin=cfg.get_double("tpu.search.weight.leader.nwin"),
+        w_pot_nwout=cfg.get_double("tpu.search.weight.potential.nwout"),
+        w_move_size=cfg.get_double("tpu.search.weight.move.size"),
+        scoring=cfg.get("tpu.search.scoring"),
+        steps_per_call=cfg.get_int("tpu.search.steps.per.call"),
+        repool_steps=cfg.get_int("tpu.search.repool.steps"),
+        device_batch_per_step=cfg.get_int(
+            "tpu.search.device.batch.per.step"),
+        moves_per_src=cfg.get_int("tpu.search.moves.per.src"),
+        time_budget_s=cfg.get_double("tpu.search.time.budget.s"),
+        profiler_trace_dir=cfg.get("tpu.search.profiler.trace.dir"),
+        polish_rounds=cfg.get_int("tpu.search.polish.rounds"),
+    )
+
+
+def _security_provider(cfg: CruiseControlConfig):
+    """SecurityProvider from the webserver.security.* keys."""
+    if not cfg.get_boolean("webserver.security.enable"):
+        return None
+    from cruise_control_tpu.server import security as sec
+
+    explicit = cfg.get("webserver.security.provider")
+    if explicit:
+        from cruise_control_tpu.config.cruise_control_config import (
+            resolve_class,
+        )
+
+        cls = resolve_class(explicit)
+        if cls is sec.JwtSecurityProvider:
+            with open(cfg.get("webserver.security.jwt.secret.file"), "rb") as f:
+                secret = f.read().strip()
+            return sec.JwtSecurityProvider(
+                secret, audience=cfg.get("webserver.security.jwt.audience")
+            )
+        if cls is sec.TrustedProxySecurityProvider:
+            return sec.TrustedProxySecurityProvider(
+                cfg.get_list("trusted.proxy.ip.addresses"),
+                user_header=cfg.get("trusted.proxy.user.header"),
+            )
+        if cls is sec.SpnegoSecurityProvider:
+            return sec.SpnegoSecurityProvider(
+                principal=cfg.get("spnego.principal"),
+                keytab=cfg.get("spnego.keytab.file"),
+            )
+        return cls()
+    creds_file = cfg.get("basic.auth.credentials.file")
+    users = {}
+    if creds_file:
+        with open(creds_file) as f:
+            for line in f:
+                line = line.strip()
+                if line and ":" in line:
+                    u, _, p = line.partition(":")
+                    users[u.strip()] = p.strip()
+    return sec.BasicSecurityProvider(users)
+
+
+def _per_type_detector_intervals(cfg: CruiseControlConfig):
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+
+    keys = {
+        AnomalyType.GOAL_VIOLATION: "goal.violation.detection.interval.ms",
+        AnomalyType.BROKER_FAILURE: "broker.failure.detection.interval.ms",
+        AnomalyType.METRIC_ANOMALY: "metric.anomaly.detection.interval.ms",
+        AnomalyType.DISK_FAILURE: "disk.failure.detection.interval.ms",
+        AnomalyType.TOPIC_ANOMALY: "topic.anomaly.detection.interval.ms",
+    }
+    return {
+        t: int(cfg.get(k)) for t, k in keys.items() if cfg.get(k) is not None
+    }
+
+
+def _self_healing_enables(cfg: CruiseControlConfig):
+    """Per-type enables defaulting to the master switch."""
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+
+    master = cfg.get_boolean("self.healing.enabled")
+    keys = {
+        AnomalyType.BROKER_FAILURE: "self.healing.broker.failure.enabled",
+        AnomalyType.GOAL_VIOLATION: "self.healing.goal.violation.enabled",
+        AnomalyType.DISK_FAILURE: "self.healing.disk.failure.enabled",
+        AnomalyType.METRIC_ANOMALY: "self.healing.metric.anomaly.enabled",
+        AnomalyType.TOPIC_ANOMALY: "self.healing.topic.anomaly.enabled",
+        AnomalyType.MAINTENANCE_EVENT:
+            "self.healing.maintenance.event.enabled",
+    }
+    return {
+        t: (master if cfg.get(k) is None else bool(cfg.get(k)))
+        for t, k in keys.items()
+    }
+
+
 def _capacity_for(w: WorkloadModel, num_brokers: int,
                   target_mean_util: float = 0.45):
     """Size per-broker capacities so the simulated cluster is feasible by
@@ -123,12 +290,21 @@ def build_app(
     backend = SimulatedClusterBackend(
         workload.assignment, workload.leaders, brokers=brokers
     )
-    topic = MetricsTopic()
-    reporter = SimulatedMetricsReporter(workload, topic)
+    topic = MetricsTopic(name=cfg.get("metric.reporter.topic"))
+    reporter = SimulatedMetricsReporter(
+        workload, topic,
+        noise_std=cfg.get_double("simulation.workload.noise.std"),
+        seed=cfg.get_int("simulation.seed"),
+    )
     num_racks = cfg.get_int("simulation.num.racks")
+    num_topics = cfg.get_int("simulation.num.topics")
     metadata = BackendMetadataClient(
         backend,
         broker_rack={b: f"rack_{b % num_racks}" for b in brokers},
+        partition_topic={
+            p: f"topic_{p % num_topics}" for p in workload.assignment
+        },
+        max_age_ms=cfg.get_int("metadata.max.age.ms"),
     )
     capacity_file = cfg.get("capacity.config.file")
     if capacity_file:
@@ -140,12 +316,24 @@ def build_app(
     else:
         # no file configured: size capacities so the simulated cluster is
         # feasible by construction
-        capacity_resolver = _capacity_for(workload, len(brokers))
+        capacity_resolver = _capacity_for(
+            workload, len(brokers),
+            target_mean_util=cfg.get_double(
+                "simulation.target.mean.utilization"
+            ),
+        )
+    sample_store = None
+    store_path = cfg.get("sample.store.path")
+    if store_path:
+        sample_store = cfg.get_configured_instance(
+            "sample.store.class", store_path
+        )
     window_ms = cfg.get("partition.metrics.window.ms")
     monitor = LoadMonitor(
         metadata,
-        MetricsReporterSampler(topic),
+        _make_sampler(cfg, topic),
         capacity_resolver=capacity_resolver,
+        sample_store=sample_store,
         window_ms=window_ms,
         num_windows=cfg.get_int("num.partition.metrics.windows"),
         min_samples_per_window=cfg.get_int(
@@ -157,6 +345,7 @@ def build_app(
         capacity_estimation_percentile=cfg.get_double(
             "capacity.estimation.percentile"
         ),
+        skip_loading_samples=cfg.get_boolean("skip.loading.samples"),
     )
     executor = Executor(
         backend,
@@ -164,69 +353,210 @@ def build_app(
             num_concurrent_partition_movements_per_broker=cfg.get_int(
                 "num.concurrent.partition.movements.per.broker"
             ),
+            num_concurrent_intra_broker_partition_movements=cfg.get_int(
+                "num.concurrent.intra.broker.partition.movements"
+            ),
             num_concurrent_leader_movements=cfg.get_int(
                 "num.concurrent.leader.movements"
             ),
+            task_timeout_ticks=cfg.get_int("execution.task.timeout.ticks"),
             replication_throttle=cfg.get("default.replication.throttle"),
+            concurrency_adjuster_enabled=cfg.get_boolean(
+                "concurrency.adjuster.enabled"
+            ),
+            concurrency_adjuster_min_cap=cfg.get_int(
+                "concurrency.adjuster.min.partition.movements.per.broker"
+            ),
+            concurrency_adjuster_max_cap=(
+                None
+                if cfg.get(
+                    "concurrency.adjuster.max.partition.movements.per.broker"
+                ) is None
+                else cfg.get_int(
+                    "concurrency.adjuster.max.partition.movements.per.broker"
+                )
+            ),
+            concurrency_adjuster_healthy_ticks=cfg.get_int(
+                "concurrency.adjuster.healthy.ticks"
+            ),
+            concurrency_adjuster_urp_threshold=cfg.get_int(
+                "concurrency.adjuster.urp.threshold"
+            ),
+            max_inter_broker_moves=cfg.get_int("max.num.cluster.movements"),
+            progress_check_interval_ms=cfg.get_int(
+                "execution.progress.check.interval.ms"
+            ),
         ),
+        notifier=cfg.get_configured_instance("executor.notifier.class"),
+        default_strategy=_movement_strategy(cfg),
     )
     # upstream executor recovery: surface (and optionally stop) reassignments
     # a previous instance left in flight
     executor.detect_ongoing_at_startup(
         stop=cfg.get_boolean("stop.ongoing.execution.at.startup")
     )
+    mesh = None
+    if cfg.get_int("tpu.mesh.devices") > 1:
+        from cruise_control_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(cfg.get_int("tpu.mesh.devices"))
+    use_tpu = cfg.get_boolean("use.tpu.optimizer")
+    if use_tpu:
+        from cruise_control_tpu.utils import jit_cache
+
+        jit_cache.enable(cfg.get("tpu.persistent.compilation.cache.dir"))
     cc = CruiseControl(
         monitor,
         executor,
-        engine="tpu" if cfg.get_boolean("use.tpu.optimizer") else "greedy",
+        constraint=_balancing_constraint(cfg),
+        engine="tpu" if use_tpu else "greedy",
+        mesh=mesh,
         proposal_ttl_s=cfg.get("proposal.expiration.ms") / 1000,
+        tpu_config=_tpu_search_config(cfg) if use_tpu else None,
+        excluded_topics_regex=cfg.get(
+            "topics.excluded.from.partition.movement"
+        ),
+        min_leaders_topics_regex=cfg.get(
+            "topics.with.min.leaders.per.broker"
+        ),
+        allowed_goals=cfg.get_list("goals"),
+        default_goal_names=cfg.get_list("default.goals"),
+        hard_goal_names=cfg.get_list("hard.goals"),
     )
     fetchers = MetricFetcherManager(
-        monitor, sampling_interval_ms=cfg.get("metric.sampling.interval.ms")
+        monitor,
+        sampling_interval_ms=cfg.get("metric.sampling.interval.ms"),
+        num_fetchers=cfg.get_int("num.metric.fetchers"),
+        # each fetcher needs its own sampler (offset cursor); without a
+        # factory the manager silently collapses to one fetcher
+        sampler_factory=(
+            (lambda: _make_sampler(cfg, topic))
+            if cfg.get_int("num.metric.fetchers") > 1 else None
+        ),
+        assignor=cfg.get_configured_instance(
+            "metric.sampler.partition.assignor.class"
+        ),
     )
-    from cruise_control_tpu.detector.anomalies import AnomalyType
     from cruise_control_tpu.detector.notifier import SelfHealingNotifier
 
-    healing = cfg.get_boolean("self.healing.enabled")
-    notifier = SelfHealingNotifier(
-        enabled={t: healing for t in AnomalyType},
-        broker_failure_alert_threshold_ms=cfg.get(
-            "broker.failure.alert.threshold.ms"
-        ),
-        broker_failure_self_healing_threshold_ms=cfg.get(
-            "broker.failure.self.healing.threshold.ms"
-        ),
-    )
+    notifier = cfg.get_configured_instance("anomaly.notifier.class")
+    if notifier is None:
+        notifier = SelfHealingNotifier(
+            enabled=_self_healing_enables(cfg),
+            broker_failure_alert_threshold_ms=cfg.get(
+                "broker.failure.alert.threshold.ms"
+            ),
+            broker_failure_self_healing_threshold_ms=cfg.get(
+                "broker.failure.self.healing.threshold.ms"
+            ),
+        )
     cluster_configs_file = cfg.get("cluster.configs.file")
-    target_rf = None
-    if cluster_configs_file:
+    target_rf = cfg.get("self.healing.target.topic.replication.factor")
+    if target_rf is None and cluster_configs_file:
         import json
 
         with open(cluster_configs_file) as f:
             cluster_configs = json.load(f)
         rf = cluster_configs.get("replication.factor")
         target_rf = int(rf) if rf is not None else None
+    from cruise_control_tpu.detector.detectors import (
+        PercentileMetricAnomalyFinder,
+    )
+
+    finder_cls = cfg.get("metric.anomaly.finder.class")
+    metric_finder = None
+    if finder_cls:
+        from cruise_control_tpu.config.cruise_control_config import (
+            resolve_class,
+        )
+
+        cls = resolve_class(finder_cls)
+        if cls is PercentileMetricAnomalyFinder:
+            metric_finder = cls(
+                upper_percentile=cfg.get_double(
+                    "metric.anomaly.percentile.upper.threshold"
+                ),
+                margin=cfg.get_double("metric.anomaly.percentile.margin"),
+                min_windows=cfg.get_int("metric.anomaly.min.windows"),
+            )
+        else:
+            metric_finder = cls()
+    healing_goals = cfg.get_list("self.healing.goals")
     detector = make_detector_manager(
         cc,
         backend=backend,
         notifier=notifier,
-        target_rf=target_rf,
+        target_rf=int(target_rf) if target_rf is not None else None,
+        maintenance_reader=cfg.get_configured_instance(
+            "maintenance.event.reader.class"
+        ),
         broker_failure_persist_path=cfg.get(
             "broker.failures.persistence.path"
         ),
+        detection_goal_names=cfg.get_list("anomaly.detection.goals") or None,
+        self_healing_goal_names=healing_goals or None,
+        metric_finder=metric_finder,
         detection_interval_ms=cfg.get("anomaly.detection.interval.ms"),
+        per_type_interval_ms=_per_type_detector_intervals(cfg),
         fix_cooldown_ms=cfg.get("self.healing.cooldown.ms"),
+        history_size=cfg.get_int("anomaly.detector.history.size"),
     )
     tasks = UserTaskManager(
         max_active_tasks=cfg.get_int("max.active.user.tasks"),
         completed_task_ttl_s=(
             cfg.get("completed.user.task.retention.time.ms") / 1000
         ),
+        max_workers=cfg.get_int("user.task.executor.threads"),
+        max_cached_completed=cfg.get_int("max.cached.completed.user.tasks"),
     )
     server = CruiseControlHttpServer(
         cc,
         host=cfg.get("webserver.http.address"),
         port=port if port is not None else cfg.get_int("webserver.http.port"),
+        security_provider=_security_provider(cfg),
+        two_step_verification=cfg.get_boolean("two.step.verification.enabled"),
         user_task_manager=tasks,
+        api_prefix=cfg.get("webserver.api.urlprefix"),
+        cors_enabled=cfg.get_boolean("webserver.http.cors.enabled"),
+        cors_origin=cfg.get("webserver.http.cors.origin"),
+        access_log=cfg.get_boolean("webserver.accesslog.enabled"),
+        purgatory_retention_s=(
+            cfg.get("two.step.purgatory.retention.time.ms") / 1000
+        ),
+        ui_path=cfg.get("webserver.ui.path"),
     )
     return App(cfg, backend, reporter, cc, fetchers, server, detector)
+
+
+def _movement_strategy(cfg: CruiseControlConfig):
+    """default.replica.movement.strategies: a chain, earlier dominates."""
+    from cruise_control_tpu.executor.tasks import (
+        ChainedReplicaMovementStrategy,
+    )
+
+    strategies = cfg.get_configured_instances(
+        "default.replica.movement.strategies"
+    )
+    if not strategies:
+        return None
+    if len(strategies) == 1:
+        return strategies[0]
+    return ChainedReplicaMovementStrategy(strategies)
+
+
+def _make_sampler(cfg: CruiseControlConfig, topic: MetricsTopic):
+    """metric.sampler.class, constructed with whatever its kind needs."""
+    from cruise_control_tpu.config.cruise_control_config import resolve_class
+    from cruise_control_tpu.monitor.prometheus import PrometheusMetricSampler
+
+    cls = resolve_class(cfg.get("metric.sampler.class"))
+    if cls is MetricsReporterSampler:
+        return MetricsReporterSampler(topic)
+    if cls is PrometheusMetricSampler:
+        import urllib.request
+
+        return PrometheusMetricSampler(
+            http_get=lambda url: urllib.request.urlopen(url).read().decode(),
+            endpoint=cfg.get("prometheus.server.endpoint"),
+        )
+    return cls()

@@ -207,8 +207,58 @@ _HARD_GOALS = (
 
 
 def default_config_def() -> ConfigDef:
+    """The full key surface.
+
+    Upstream names are kept wherever the concept exists (``config/constants/
+    {Monitor,Analyzer,Executor,AnomalyDetector,WebServer,UserTaskManager}
+    Config.java``); framework-specific keys (the ``tpu.*`` engine group, the
+    ``simulation.*`` cluster group) are documented as such.  Every key is
+    consumed by a constructor — ``bootstrap.build_app`` is the single wiring
+    point, and ``tests/test_config.py`` boots the server from a properties
+    file overriding one key per subsystem to prove reachability.
+    """
     d = ConfigDef()
     G = "monitor"
+    d.define("bootstrap.servers", ConfigType.STRING, None,
+             Importance.HIGH,
+             "Kafka bootstrap servers for a real-cluster deployment "
+             "(consumed by the kafka adapter wiring); None runs the "
+             "built-in simulated cluster (simulation.* keys).",
+             None, G)
+    d.define("num.metric.fetchers", ConfigType.INT, 1,
+             Importance.MEDIUM, "Parallel metric fetchers; the partition "
+             "universe is split across them.", at_least(1), G)
+    d.define("metric.sampler.partition.assignor.class", ConfigType.CLASS,
+             "cruise_control_tpu.monitor.fetcher.MetricSamplerPartitionAssignor",
+             Importance.LOW, "Partition-to-fetcher assignor.", None, G)
+    d.define("prometheus.server.endpoint", ConfigType.STRING,
+             "http://localhost:9090/metrics", Importance.LOW,
+             "Prometheus endpoint for the PrometheusMetricSampler.", None, G)
+    d.define("skip.loading.samples", ConfigType.BOOLEAN, False,
+             Importance.LOW, "Skip sample-store replay at startup (no "
+             "LOADING phase).", None, G)
+    d.define("metadata.max.age.ms", ConfigType.LONG, 300_000,
+             Importance.LOW, "Cluster-metadata cache age before a forced "
+             "refresh.", at_least(0), G)
+    d.define("topics.excluded.from.partition.movement", ConfigType.STRING, "",
+             Importance.MEDIUM, "Regex of topic names excluded from replica "
+             "movement in every optimization.", None, G)
+    d.define("metric.reporter.topic", ConfigType.STRING,
+             "__CruiseControlMetrics", Importance.LOW,
+             "Topic the broker-side metrics reporter produces to.", None, G)
+    d.define("partition.metric.sample.store.topic", ConfigType.STRING,
+             "__KafkaCruiseControlPartitionMetricSamples", Importance.LOW,
+             "Kafka-backed sample store topic for partition samples.",
+             None, G)
+    d.define("broker.metric.sample.store.topic", ConfigType.STRING,
+             "__KafkaCruiseControlModelTrainingSamples", Importance.LOW,
+             "Kafka-backed sample store topic for broker samples.", None, G)
+    d.define("sample.store.topic.replication.factor", ConfigType.INT, 2,
+             Importance.LOW, "RF for auto-created sample-store topics.",
+             at_least(1), G)
+    d.define("num.sample.loading.threads", ConfigType.INT, 8,
+             Importance.LOW, "Parallelism for sample-store replay.",
+             at_least(1), G)
     d.define("metric.sampling.interval.ms", ConfigType.LONG, 120_000,
              Importance.HIGH, "Interval between metric sampling runs.",
              at_least(1), G)
@@ -270,10 +320,49 @@ def default_config_def() -> ConfigDef:
              Importance.HIGH, "MetricSampler implementation.", None, G)
 
     G = "analyzer"
+    d.define("goals", ConfigType.LIST, _DEFAULT_GOALS,
+             Importance.HIGH, "All goals this instance may run; REST "
+             "requests naming other goals are rejected.", None, G)
     d.define("default.goals", ConfigType.LIST, _DEFAULT_GOALS,
              Importance.HIGH, "Goal stack in priority order.", None, G)
     d.define("hard.goals", ConfigType.LIST, _HARD_GOALS,
              Importance.HIGH, "Goals that must never be violated.", None, G)
+    d.define("replica.count.balance.threshold", ConfigType.DOUBLE, 1.1,
+             Importance.MEDIUM, "Max/avg replica-count ratio considered "
+             "balanced.", at_least(1), G)
+    d.define("leader.replica.count.balance.threshold", ConfigType.DOUBLE, 1.1,
+             Importance.MEDIUM, "Max/avg leader-count ratio considered "
+             "balanced.", at_least(1), G)
+    d.define("topic.replica.count.balance.threshold", ConfigType.DOUBLE, 3.0,
+             Importance.LOW, "Max/avg per-topic replica-count ratio "
+             "considered balanced.", at_least(1), G)
+    d.define("cpu.low.utilization.threshold", ConfigType.DOUBLE, 0.0,
+             Importance.LOW, "Below this average CPU utilization the "
+             "distribution goal stands down.", between(0, 1), G)
+    d.define("disk.low.utilization.threshold", ConfigType.DOUBLE, 0.0,
+             Importance.LOW, "Below this average disk utilization the "
+             "distribution goal stands down.", between(0, 1), G)
+    d.define("network.inbound.low.utilization.threshold", ConfigType.DOUBLE,
+             0.0, Importance.LOW, "Below this average NW-in utilization the "
+             "distribution goal stands down.", between(0, 1), G)
+    d.define("network.outbound.low.utilization.threshold", ConfigType.DOUBLE,
+             0.0, Importance.LOW, "Below this average NW-out utilization "
+             "the distribution goal stands down.", between(0, 1), G)
+    d.define("min.topic.leaders.per.broker", ConfigType.INT, 0,
+             Importance.LOW, "MinTopicLeadersPerBrokerGoal floor (0 "
+             "disables).", at_least(0), G)
+    d.define("topics.with.min.leaders.per.broker", ConfigType.STRING, "",
+             Importance.LOW, "Regex of topic names subject to "
+             "min.topic.leaders.per.broker.", None, G)
+    d.define("brokerset.config.file", ConfigType.STRING, None,
+             Importance.LOW, "JSON file mapping topic name to allowed "
+             "broker ids (BrokerSetAwareGoal).", None, G)
+    d.define("proposal.precompute.interval.ms", ConfigType.LONG, 30_000,
+             Importance.LOW, "Background proposal-precompute period.",
+             at_least(1), G)
+    d.define("proposal.precompute.engine", ConfigType.STRING, None,
+             Importance.LOW, "Engine for precomputed proposals (tpu/greedy); "
+             "None = the instance default.", None, G)
     d.define("cpu.balance.threshold", ConfigType.DOUBLE, 1.1,
              Importance.MEDIUM, "Max/avg CPU ratio considered balanced.",
              at_least(1), G)
@@ -311,11 +400,21 @@ def default_config_def() -> ConfigDef:
     d.define("num.concurrent.partition.movements.per.broker", ConfigType.INT, 5,
              Importance.HIGH, "Per-broker in-flight replica-move cap.",
              at_least(1), G)
+    d.define("num.concurrent.intra.broker.partition.movements", ConfigType.INT,
+             2, Importance.MEDIUM,
+             "Per-broker in-flight disk-to-disk move cap.", at_least(1), G)
     d.define("num.concurrent.leader.movements", ConfigType.INT, 1000,
              Importance.HIGH, "Leadership-election batch cap.", at_least(1), G)
+    d.define("max.num.cluster.movements", ConfigType.INT, 1 << 30,
+             Importance.MEDIUM, "Safety ceiling on one execution's total "
+             "inter-broker moves.", at_least(1), G)
     d.define("execution.progress.check.interval.ms", ConfigType.LONG, 10_000,
-             Importance.MEDIUM, "Metadata poll interval during execution.",
-             at_least(1), G)
+             Importance.MEDIUM, "Metadata poll interval during execution "
+             "(real-backend executions; the simulated backend is "
+             "tick-driven).", at_least(1), G)
+    d.define("execution.task.timeout.ticks", ConfigType.INT, 100,
+             Importance.LOW, "Progress checks an in-flight move may take "
+             "before being declared DEAD.", at_least(1), G)
     d.define("default.replication.throttle", ConfigType.DOUBLE, None,
              Importance.MEDIUM, "Replication throttle (bytes/s); None = off.",
              None, G)
@@ -323,16 +422,71 @@ def default_config_def() -> ConfigDef:
              "cruise_control_tpu.executor.tasks.ReplicaMovementStrategy",
              Importance.MEDIUM, "Replica-move ordering strategy chain.",
              None, G)
+    d.define("executor.notifier.class", ConfigType.CLASS, None,
+             Importance.LOW, "ExecutorNotifier implementation invoked on "
+             "execution finish/abort.", None, G)
+    d.define("concurrency.adjuster.enabled", ConfigType.BOOLEAN, False,
+             Importance.MEDIUM, "Adapt movement concurrency to live broker "
+             "health (AIMD).", None, G)
+    d.define("concurrency.adjuster.min.partition.movements.per.broker",
+             ConfigType.INT, 1, Importance.LOW,
+             "Adjuster floor for the per-broker move cap.", at_least(1), G)
+    d.define("concurrency.adjuster.max.partition.movements.per.broker",
+             ConfigType.INT, None, Importance.LOW,
+             "Adjuster ceiling; None = 2x the configured cap.", None, G)
+    d.define("concurrency.adjuster.healthy.ticks", ConfigType.INT, 3,
+             Importance.LOW, "Consecutive healthy progress checks before the "
+             "adjuster raises concurrency.", at_least(1), G)
+    d.define("concurrency.adjuster.urp.threshold", ConfigType.INT, 1 << 30,
+             Importance.LOW, "Halve concurrency when external "
+             "under-replicated partitions exceed this.", at_least(0), G)
 
     G = "anomaly.detector"
     d.define("anomaly.detection.interval.ms", ConfigType.LONG, 300_000,
-             Importance.HIGH, "Detector scheduling interval.", at_least(1), G)
+             Importance.HIGH, "Default detector scheduling interval.",
+             at_least(1), G)
+    d.define("goal.violation.detection.interval.ms", ConfigType.LONG, None,
+             Importance.LOW, "Override for the goal-violation detector; "
+             "None inherits anomaly.detection.interval.ms.", None, G)
+    d.define("broker.failure.detection.interval.ms", ConfigType.LONG, None,
+             Importance.LOW, "Override for the broker-failure detector.",
+             None, G)
+    d.define("metric.anomaly.detection.interval.ms", ConfigType.LONG, None,
+             Importance.LOW, "Override for the metric-anomaly detector.",
+             None, G)
+    d.define("disk.failure.detection.interval.ms", ConfigType.LONG, None,
+             Importance.LOW, "Override for the disk-failure detector.",
+             None, G)
+    d.define("topic.anomaly.detection.interval.ms", ConfigType.LONG, None,
+             Importance.LOW, "Override for the topic-anomaly detector.",
+             None, G)
     d.define("anomaly.detection.goals", ConfigType.LIST, _HARD_GOALS,
              Importance.HIGH, "Goals watched by GoalViolationDetector.",
              None, G)
+    d.define("self.healing.goals", ConfigType.LIST, "",
+             Importance.MEDIUM, "Goals used when self-healing fixes run; "
+             "empty = the default goal stack.", None, G)
     d.define("self.healing.enabled", ConfigType.BOOLEAN, False,
              Importance.HIGH, "Master switch for automatic anomaly fixes.",
              None, G)
+    d.define("self.healing.broker.failure.enabled", ConfigType.BOOLEAN, None,
+             Importance.MEDIUM, "Per-type override of self.healing.enabled.",
+             None, G)
+    d.define("self.healing.goal.violation.enabled", ConfigType.BOOLEAN, None,
+             Importance.MEDIUM, "Per-type override of self.healing.enabled.",
+             None, G)
+    d.define("self.healing.disk.failure.enabled", ConfigType.BOOLEAN, None,
+             Importance.MEDIUM, "Per-type override of self.healing.enabled.",
+             None, G)
+    d.define("self.healing.metric.anomaly.enabled", ConfigType.BOOLEAN, None,
+             Importance.MEDIUM, "Per-type override of self.healing.enabled.",
+             None, G)
+    d.define("self.healing.topic.anomaly.enabled", ConfigType.BOOLEAN, None,
+             Importance.MEDIUM, "Per-type override of self.healing.enabled.",
+             None, G)
+    d.define("self.healing.maintenance.event.enabled", ConfigType.BOOLEAN,
+             None, Importance.MEDIUM,
+             "Per-type override of self.healing.enabled.", None, G)
     d.define("broker.failure.alert.threshold.ms", ConfigType.LONG, 900_000,
              Importance.MEDIUM, "Broker-down time before alerting.",
              at_least(0), G)
@@ -345,6 +499,27 @@ def default_config_def() -> ConfigDef:
     d.define("anomaly.notifier.class", ConfigType.CLASS, None,
              Importance.MEDIUM, "AnomalyNotifier implementation; None keeps "
              "the built-in SelfHealingNotifier.", None, G)
+    d.define("metric.anomaly.finder.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.detectors.PercentileMetricAnomalyFinder",
+             Importance.LOW, "MetricAnomalyFinder implementation.", None, G)
+    d.define("metric.anomaly.percentile.upper.threshold", ConfigType.DOUBLE,
+             95.0, Importance.LOW, "History percentile a latest-window "
+             "metric must exceed to be anomalous.", between(0, 100), G)
+    d.define("metric.anomaly.percentile.margin", ConfigType.DOUBLE, 1.5,
+             Importance.LOW, "Multiplier over the history percentile before "
+             "flagging.", at_least(1), G)
+    d.define("metric.anomaly.min.windows", ConfigType.INT, 3,
+             Importance.LOW, "Minimum windows of history before metric "
+             "anomalies are considered.", at_least(1), G)
+    d.define("self.healing.target.topic.replication.factor", ConfigType.INT,
+             None, Importance.LOW, "Target RF for the topic-anomaly "
+             "detector; None reads cluster.configs.file.", None, G)
+    d.define("maintenance.event.reader.class", ConfigType.CLASS, None,
+             Importance.LOW, "MaintenanceEventReader implementation.",
+             None, G)
+    d.define("anomaly.detector.history.size", ConfigType.INT, 100,
+             Importance.LOW, "Recent anomalies retained in state().",
+             at_least(1), G)
     d.define("broker.failures.persistence.path", ConfigType.STRING, None,
              Importance.LOW, "File persisting first-seen failure times.",
              None, G)
@@ -357,11 +532,137 @@ def default_config_def() -> ConfigDef:
     d.define("webserver.api.urlprefix", ConfigType.STRING,
              "/kafkacruisecontrol", Importance.LOW, "API path prefix.",
              None, G)
+    d.define("webserver.http.cors.enabled", ConfigType.BOOLEAN, False,
+             Importance.LOW, "Emit CORS headers on REST responses.", None, G)
+    d.define("webserver.http.cors.origin", ConfigType.STRING, "*",
+             Importance.LOW, "Access-Control-Allow-Origin value when CORS "
+             "is enabled.", None, G)
+    d.define("webserver.accesslog.enabled", ConfigType.BOOLEAN, True,
+             Importance.LOW, "Log one line per HTTP request.", None, G)
+    d.define("webserver.security.enable", ConfigType.BOOLEAN, False,
+             Importance.HIGH, "Require authentication on REST requests.",
+             None, G)
+    d.define("webserver.security.provider", ConfigType.CLASS, None,
+             Importance.MEDIUM, "SecurityProvider implementation; None with "
+             "security enabled selects HTTP Basic from the credentials "
+             "file.", None, G)
+    d.define("basic.auth.credentials.file", ConfigType.STRING, None,
+             Importance.MEDIUM, "user:password lines for HTTP Basic auth.",
+             None, G)
+    d.define("webserver.security.jwt.secret.file", ConfigType.STRING, None,
+             Importance.LOW, "HS256 secret file for the JWT provider.",
+             None, G)
+    d.define("webserver.security.jwt.audience", ConfigType.STRING, None,
+             Importance.LOW, "Required JWT audience claim; None skips the "
+             "check.", None, G)
+    d.define("trusted.proxy.ip.addresses", ConfigType.LIST, "",
+             Importance.LOW, "IPs allowed to assert identity via the "
+             "trusted-proxy provider.", None, G)
+    d.define("trusted.proxy.user.header", ConfigType.STRING,
+             "X-Forwarded-User", Importance.LOW,
+             "Header carrying the proxied identity.", None, G)
+    d.define("spnego.principal", ConfigType.STRING, None,
+             Importance.LOW, "SPNEGO service principal (provider is an "
+             "explicit stub in this build — no Kerberos stack).", None, G)
+    d.define("spnego.keytab.file", ConfigType.STRING, None,
+             Importance.LOW, "SPNEGO keytab path (stub provider).", None, G)
+    d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False,
+             Importance.MEDIUM, "Route mutating endpoints through the "
+             "review purgatory.", None, G)
+    d.define("two.step.purgatory.retention.time.ms", ConfigType.LONG,
+             86_400_000, Importance.LOW,
+             "Retention of pending/finished review requests.",
+             at_least(0), G)
+    d.define("webserver.ui.path", ConfigType.STRING, None,
+             Importance.LOW, "Directory or HTML file served at /ui; None "
+             "serves the built-in dashboard.", None, G)
     d.define("max.active.user.tasks", ConfigType.INT, 25,
              Importance.MEDIUM, "Concurrent async user tasks.", at_least(1), G)
     d.define("completed.user.task.retention.time.ms", ConfigType.LONG,
              86_400_000, Importance.LOW,
              "TTL of finished task results.", at_least(0), G)
+    d.define("max.cached.completed.user.tasks", ConfigType.INT, 100,
+             Importance.LOW, "Completed tasks kept regardless of TTL.",
+             at_least(0), G)
+    d.define("user.task.executor.threads", ConfigType.INT, 4,
+             Importance.LOW, "Worker threads running async user tasks.",
+             at_least(1), G)
+
+    # framework-specific: the TPU search engine (no upstream equivalent —
+    # replaces AnalyzerConfig's greedy-recursion knobs)
+    G = "tpu.engine"
+    d.define("tpu.mesh.devices", ConfigType.INT, 0,
+             Importance.MEDIUM, "Shard the search over this many devices "
+             "(0 = single device; requires that many jax.devices()).",
+             at_least(0), G)
+    d.define("tpu.persistent.compilation.cache.dir", ConfigType.STRING, None,
+             Importance.LOW, "XLA persistent compilation cache directory "
+             "(None = ~/.cache/cruise_control_tpu/jax).", None, G)
+    d.define("tpu.search.max.rounds", ConfigType.INT, 150,
+             Importance.MEDIUM, "Score-only search round budget.",
+             at_least(1), G)
+    d.define("tpu.search.candidate.budget", ConfigType.INT, 1 << 23,
+             Importance.MEDIUM, "K x D candidate budget per round.",
+             at_least(1), G)
+    d.define("tpu.search.max.source.replicas", ConfigType.INT, 8192,
+             Importance.MEDIUM, "Source-pool cap K.", at_least(1), G)
+    d.define("tpu.search.max.dest.brokers", ConfigType.INT, 1024,
+             Importance.MEDIUM, "Destination-pool cap D.", at_least(1), G)
+    d.define("tpu.search.topk.per.round", ConfigType.INT, 2048,
+             Importance.LOW, "Candidates returned per score-only round.",
+             at_least(1), G)
+    d.define("tpu.search.max.moves.per.round", ConfigType.INT, 4096,
+             Importance.LOW, "Host-commit cap per score-only round.",
+             at_least(1), G)
+    d.define("tpu.search.improvement.tolerance", ConfigType.DOUBLE, -1e-4,
+             Importance.LOW, "Per-action commit threshold (negative delta).",
+             None, G)
+    d.define("tpu.search.weight.util.variance", ConfigType.DOUBLE, 1.0,
+             Importance.LOW, "Soft-cost weight: utilization spread.",
+             at_least(0), G)
+    d.define("tpu.search.weight.balance.bound", ConfigType.DOUBLE, 8.0,
+             Importance.LOW, "Soft-cost weight: balance-bound overruns.",
+             at_least(0), G)
+    d.define("tpu.search.weight.replica.count", ConfigType.DOUBLE, 0.25,
+             Importance.LOW, "Soft-cost weight: replica-count balance.",
+             at_least(0), G)
+    d.define("tpu.search.weight.leader.count", ConfigType.DOUBLE, 0.25,
+             Importance.LOW, "Soft-cost weight: leader-count balance.",
+             at_least(0), G)
+    d.define("tpu.search.weight.leader.nwin", ConfigType.DOUBLE, 0.5,
+             Importance.LOW, "Soft-cost weight: leader bytes-in balance.",
+             at_least(0), G)
+    d.define("tpu.search.weight.potential.nwout", ConfigType.DOUBLE, 1.0,
+             Importance.LOW, "Soft-cost weight: potential NW-out overrun.",
+             at_least(0), G)
+    d.define("tpu.search.weight.move.size", ConfigType.DOUBLE, 1e-3,
+             Importance.LOW, "Movement friction per normalized disk MB.",
+             at_least(0), G)
+    d.define("tpu.search.scoring", ConfigType.STRING, "auto",
+             Importance.LOW, "Move scorer: auto/grid/columnar/pallas.",
+             None, G)
+    d.define("tpu.search.steps.per.call", ConfigType.INT, 512,
+             Importance.MEDIUM, "Device-resident steps per call (0 = "
+             "score-only rounds).", at_least(0), G)
+    d.define("tpu.search.repool.steps", ConfigType.INT, 64,
+             Importance.LOW, "Steps between on-device candidate-pool "
+             "rebuilds.", at_least(1), G)
+    d.define("tpu.search.device.batch.per.step", ConfigType.INT, 0,
+             Importance.LOW, "Actions committed per device step (0 = "
+             "auto-scale with broker count).", at_least(0), G)
+    d.define("tpu.search.moves.per.src", ConfigType.INT, 4,
+             Importance.LOW, "Move candidates offered per source broker "
+             "per step.", at_least(1), G)
+    d.define("tpu.search.time.budget.s", ConfigType.DOUBLE, 0.0,
+             Importance.MEDIUM, "Anytime budget: stop soft-goal refinement "
+             "after this many seconds (0 = unlimited; hard-goal repair "
+             "always completes).", at_least(0), G)
+    d.define("tpu.search.profiler.trace.dir", ConfigType.STRING, "",
+             Importance.LOW, "Wrap searches in jax.profiler.trace to this "
+             "directory.", None, G)
+    d.define("tpu.search.polish.rounds", ConfigType.INT, 0,
+             Importance.LOW, "Score-only polish rounds after the resident "
+             "search converges.", at_least(0), G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a
@@ -377,6 +678,22 @@ def default_config_def() -> ConfigDef:
              Importance.LOW, "Simulated rack count.", at_least(1), G)
     d.define("simulation.seed", ConfigType.INT, 42,
              Importance.LOW, "Workload RNG seed.", None, G)
+    d.define("simulation.num.topics", ConfigType.INT, 4,
+             Importance.LOW, "Simulated topic count.", at_least(1), G)
+    d.define("simulation.workload.noise.std", ConfigType.DOUBLE, 0.0,
+             Importance.LOW, "Relative noise on reported samples.",
+             at_least(0), G)
+    d.define("simulation.target.mean.utilization", ConfigType.DOUBLE, 0.45,
+             Importance.LOW, "Auto-sized broker capacities aim for this "
+             "mean utilization.", between(0.01, 1), G)
+
+    G = "logging"
+    d.define("logging.level", ConfigType.STRING, "INFO",
+             Importance.MEDIUM, "Root log level "
+             "(DEBUG/INFO/WARNING/ERROR).", None, G)
+    d.define("logging.file", ConfigType.STRING, None,
+             Importance.MEDIUM, "Log file path; None logs to stderr.",
+             None, G)
     return d
 
 

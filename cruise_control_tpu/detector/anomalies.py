@@ -65,17 +65,21 @@ class GoalViolations(Anomaly):
     anomaly_type = AnomalyType.GOAL_VIOLATION
 
     def __init__(self, detected_ms: int, violated_goals: Dict[str, int],
-                 fixable_goals: Optional[Sequence[str]] = None):
+                 fixable_goals: Optional[Sequence[str]] = None,
+                 fix_goal_names: Optional[Sequence[str]] = None):
         super().__init__(
             detected_ms,
             f"goals violated: {sorted(violated_goals)}",
         )
         self.violated_goals = violated_goals
         self.fixable_goals = list(fixable_goals or violated_goals)
+        #: self.healing.goals config: goal subset the fix rebalance uses
+        #: (None = the instance's default stack)
+        self.fix_goal_names = list(fix_goal_names) if fix_goal_names else None
 
     def fix(self, cruise_control, progress=None):
         self.fix_result = cruise_control.rebalance(
-            dryrun=False, progress=progress
+            goals=self.fix_goal_names, dryrun=False, progress=progress
         )
         return self.fix_result
 

@@ -35,9 +35,13 @@ class GoalViolationDetector:
     (upstream ``GoalViolationDetector``: optimize-on-clone; here the goals
     expose ``violations()`` directly, so no clone mutation is needed)."""
 
-    def __init__(self, cruise_control, goal_names: Optional[Sequence[str]] = None):
+    def __init__(self, cruise_control, goal_names: Optional[Sequence[str]] = None,
+                 fix_goal_names: Optional[Sequence[str]] = None):
         self.cc = cruise_control
         self.goal_names = list(goal_names) if goal_names else None
+        #: self.healing.goals: goal subset the FIX runs with (None = the
+        #: instance's full default stack)
+        self.fix_goal_names = list(fix_goal_names) if fix_goal_names else None
 
     def detect(self, now_ms: int) -> List[Anomaly]:
         try:
@@ -52,7 +56,8 @@ class GoalViolationDetector:
         }
         if not violated:
             return []
-        return [GoalViolations(now_ms, violated)]
+        return [GoalViolations(now_ms, violated,
+                               fix_goal_names=self.fix_goal_names)]
 
 
 class BrokerFailureDetector:

@@ -35,6 +35,9 @@ from cruise_control_tpu.detector.notifier import (
 )
 from cruise_control_tpu.executor.executor import OngoingExecutionError
 from cruise_control_tpu.server.progress import OperationProgress
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("detector")
 
 
 class AnomalyDetectorManager:
@@ -46,11 +49,15 @@ class AnomalyDetectorManager:
         detection_interval_ms: int = 300_000,
         fix_cooldown_ms: int = 600_000,
         history_size: int = 100,
+        per_type_interval_ms: Optional[Dict[AnomalyType, int]] = None,
     ):
         self.cc = cruise_control
         self.detectors = dict(detectors or {})
         self.notifier = notifier or SelfHealingNotifier()
         self.detection_interval_ms = detection_interval_ms
+        #: per-detector interval overrides (upstream
+        #: <type>.detection.interval.ms keys); fall back to the default
+        self.per_type_interval_ms = dict(per_type_interval_ms or {})
         self.fix_cooldown_ms = fix_cooldown_ms
         self._last_run_ms: Dict[AnomalyType, int] = {}
         self._last_fix_ms: Optional[int] = None
@@ -73,12 +80,20 @@ class AnomalyDetectorManager:
         queue, self._pending_fixes = list(self._pending_fixes), deque()
         for atype, det in self.detectors.items():
             last = self._last_run_ms.get(atype)
-            if last is not None and now_ms - last < self.detection_interval_ms:
+            interval = self.per_type_interval_ms.get(
+                atype, self.detection_interval_ms
+            )
+            if last is not None and now_ms - last < interval:
                 continue
             self._last_run_ms[atype] = now_ms
             try:
-                queue.extend(det.detect(now_ms))
+                found = det.detect(now_ms)
+                if found:
+                    LOG.info("%s detected %d anomaly(ies): %s", atype.value,
+                             len(found), [a.description for a in found])
+                queue.extend(found)
             except Exception as e:  # a broken detector must not kill the loop
+                LOG.exception("%s detector failed", atype.value)
                 self._history.append({
                     "detector": atype.value,
                     "action": "DETECT_FAILED",
@@ -115,13 +130,19 @@ class AnomalyDetectorManager:
                     f"SELF_HEAL_{anomaly.anomaly_type.value}"
                 )
                 try:
+                    LOG.info("self-healing fix starting: %s",
+                             anomaly.description)
                     anomaly.fix(self.cc, progress)
                     record["fixStarted"] = True
                     self._last_fix_ms = now_ms
+                    LOG.info("self-healing fix finished: %s",
+                             anomaly.anomaly_type.value)
                 except OngoingExecutionError:
                     record["action"] = "FIX_DELAYED_ONGOING_EXECUTION"
                     self._pending_fixes.append(anomaly)
                 except Exception as e:  # fix failures must not kill the loop
+                    LOG.exception("self-healing fix failed: %s",
+                                  anomaly.description)
                     record["action"] = "FIX_FAILED"
                     record["error"] = repr(e)
         final = record["action"]
@@ -169,6 +190,9 @@ def make_detector_manager(
     maintenance_reader=None,
     broker_failure_persist_path: Optional[str] = None,
     notifier: Optional[AnomalyNotifier] = None,
+    detection_goal_names=None,
+    self_healing_goal_names=None,
+    metric_finder=None,
     **kwargs,
 ) -> AnomalyDetectorManager:
     """Assemble the full upstream detector set for a facade instance."""
@@ -182,11 +206,16 @@ def make_detector_manager(
     )
 
     detectors: Dict[AnomalyType, object] = {
-        AnomalyType.GOAL_VIOLATION: GoalViolationDetector(cruise_control),
+        AnomalyType.GOAL_VIOLATION: GoalViolationDetector(
+            cruise_control, goal_names=detection_goal_names,
+            fix_goal_names=self_healing_goal_names,
+        ),
         AnomalyType.BROKER_FAILURE: BrokerFailureDetector(
             cruise_control, broker_failure_persist_path
         ),
-        AnomalyType.METRIC_ANOMALY: MetricAnomalyDetector(cruise_control),
+        AnomalyType.METRIC_ANOMALY: MetricAnomalyDetector(
+            cruise_control, finder=metric_finder
+        ),
         AnomalyType.MAINTENANCE_EVENT: MaintenanceEventDetector(
             cruise_control, maintenance_reader
         ),

@@ -75,6 +75,13 @@ class SelfHealingNotifier(AnomalyNotifier):
         if key == self._last_alert_key:
             return
         self._last_alert_key = key
+        from cruise_control_tpu.utils.logging import get_logger
+
+        get_logger("detector").warning(
+            "ALERT %s: %s (auto-fix %s)", anomaly.anomaly_type.value,
+            anomaly.description,
+            "triggered" if auto_fix_triggered else "not triggered",
+        )
         self.alerts.append({
             "anomalyId": anomaly.anomaly_id,
             "type": anomaly.anomaly_type.value,

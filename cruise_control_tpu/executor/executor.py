@@ -28,6 +28,9 @@ from cruise_control_tpu.executor.tasks import (
     TaskType,
 )
 from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("executor")
 
 
 class ExecutorStateValue(enum.Enum):
@@ -68,6 +71,9 @@ class ExecutorConfig:
     concurrency_adjuster_urp_threshold: int = 1 << 30
     #: safety ceiling for one execution's total moves
     max_inter_broker_moves: int = 1 << 30
+    #: wall-clock between progress checks for real (non-simulated) backends;
+    #: the simulated backend advances per tick and ignores it
+    progress_check_interval_ms: int = 10_000
 
 
 @dataclasses.dataclass
@@ -95,10 +101,14 @@ class Executor:
         backend: ClusterBackend,
         config: Optional[ExecutorConfig] = None,
         notifier=None,
+        default_strategy: Optional[ReplicaMovementStrategy] = None,
     ):
         self.backend = backend
         self.config = config or ExecutorConfig()
         self.notifier = notifier
+        #: default.replica.movement.strategies: ordering used when the caller
+        #: passes no explicit strategy
+        self.default_strategy = default_strategy
         self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
         self._stop_requested = False
         self.planner: Optional[ExecutionTaskPlanner] = None
@@ -175,8 +185,15 @@ class Executor:
         self.state = ExecutorStateValue.STARTING_EXECUTION
         self._stop_requested = False
         sizes = partition_sizes or {}
-        planner = ExecutionTaskPlanner(strategy)
+        planner = ExecutionTaskPlanner(strategy or self.default_strategy)
         planner.add_proposals(proposals)
+        LOG.info(
+            "execution starting: %d proposals -> %d replica / %d leadership "
+            "/ %d intra-broker tasks (strategy=%s)",
+            len(proposals), len(planner.replica_tasks),
+            len(planner.leader_tasks), len(planner.intra_tasks),
+            planner.strategy.name,
+        )
         self.planner = planner
         # safety ceiling: replica moves beyond the cap are aborted up front
         # (in strategy order, so the cap keeps the highest-priority moves),
@@ -238,6 +255,12 @@ class Executor:
             )
             self.history.append(result)
             self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
+            log = LOG.warning if (dead or result.stopped) else LOG.info
+            log(
+                "execution finished: %d completed / %d dead / %d aborted in "
+                "%d ticks%s", completed, dead, aborted, ticks,
+                " (STOPPED)" if result.stopped else "",
+            )
             self._notify(result)
         return result
 
@@ -313,6 +336,12 @@ class Executor:
                 t = in_flight.pop(p)
                 st = self.backend.partition_state(p)
                 ok = list(st.replicas) == list(t.proposal.new_replicas)
+                if not ok:
+                    LOG.warning(
+                        "task %d (partition %d) DEAD: replicas %s != planned "
+                        "%s", t.task_id, p, list(st.replicas),
+                        list(t.proposal.new_replicas),
+                    )
                 t.transition(TaskState.COMPLETED if ok else TaskState.DEAD)
                 t.finished_tick = ticks
                 for b in t.participating_brokers:
@@ -320,6 +349,11 @@ class Executor:
             # time out stuck moves (upstream: mark DEAD, leave reassignment)
             for p, t in list(in_flight.items()):
                 if ticks - t.started_tick > self.config.task_timeout_ticks:
+                    LOG.warning(
+                        "task %d (partition %d) DEAD: no progress in %d "
+                        "ticks", t.task_id, p,
+                        self.config.task_timeout_ticks,
+                    )
                     t.transition(TaskState.DEAD)
                     t.finished_tick = ticks
                     in_flight.pop(p)

@@ -72,13 +72,23 @@ class ExecutionTask:
 # ---------------------------------------------------------------------------------
 
 class ReplicaMovementStrategy:
-    """Orders pending inter-broker tasks; chainable like upstream."""
+    """Orders pending inter-broker tasks; chainable like upstream.
+
+    ``rank()`` is the strategy's discriminating key alone; ``sort_key()``
+    appends the task-id tie-break.  Chains concatenate ranks so a later
+    strategy genuinely breaks the earlier one's ties (the id would otherwise
+    make every component key unique and the rest of the chain dead).
+    """
 
     name = "BaseReplicaMovementStrategy"
 
+    def rank(self, task: ExecutionTask, sizes: Dict[int, float],
+             urp: Set[int]) -> tuple:
+        return ()
+
     def sort_key(self, task: ExecutionTask, sizes: Dict[int, float],
                  urp: Set[int]) -> tuple:
-        return (task.task_id,)
+        return self.rank(task, sizes, urp) + (task.task_id,)
 
     def order(
         self,
@@ -92,15 +102,15 @@ class ReplicaMovementStrategy:
 class PrioritizeLargeReplicaMovementStrategy(ReplicaMovementStrategy):
     name = "PrioritizeLargeReplicaMovementStrategy"
 
-    def sort_key(self, task, sizes, urp):
-        return (-sizes.get(task.proposal.partition, 0.0), task.task_id)
+    def rank(self, task, sizes, urp):
+        return (-sizes.get(task.proposal.partition, 0.0),)
 
 
 class PrioritizeSmallReplicaMovementStrategy(ReplicaMovementStrategy):
     name = "PrioritizeSmallReplicaMovementStrategy"
 
-    def sort_key(self, task, sizes, urp):
-        return (sizes.get(task.proposal.partition, 0.0), task.task_id)
+    def rank(self, task, sizes, urp):
+        return (sizes.get(task.proposal.partition, 0.0),)
 
 
 class PostponeUrpReplicaMovementStrategy(ReplicaMovementStrategy):
@@ -108,8 +118,8 @@ class PostponeUrpReplicaMovementStrategy(ReplicaMovementStrategy):
 
     name = "PostponeUrpReplicaMovementStrategy"
 
-    def sort_key(self, task, sizes, urp):
-        return (task.proposal.partition in urp, task.task_id)
+    def rank(self, task, sizes, urp):
+        return (task.proposal.partition in urp,)
 
 
 class PrioritizeMinIsrWithOfflineReplicasStrategy(ReplicaMovementStrategy):
@@ -117,8 +127,22 @@ class PrioritizeMinIsrWithOfflineReplicasStrategy(ReplicaMovementStrategy):
 
     name = "PrioritizeMinIsrWithOfflineReplicasStrategy"
 
-    def sort_key(self, task, sizes, urp):
-        return (task.proposal.partition not in urp, task.task_id)
+    def rank(self, task, sizes, urp):
+        return (task.proposal.partition not in urp,)
+
+
+class ChainedReplicaMovementStrategy(ReplicaMovementStrategy):
+    """Chain strategies: earlier strategies dominate, later ones break ties
+    (upstream ``chain(...)`` on ReplicaMovementStrategy)."""
+
+    def __init__(self, strategies: Sequence[ReplicaMovementStrategy]):
+        self.strategies = list(strategies)
+        self.name = "+".join(s.name for s in self.strategies)
+
+    def rank(self, task, sizes, urp):
+        return tuple(
+            k for s in self.strategies for k in s.rank(task, sizes, urp)
+        )
 
 
 # ---------------------------------------------------------------------------------

@@ -43,7 +43,10 @@ from cruise_control_tpu.monitor.load_monitor import (
     ModelCompletenessRequirements,
 )
 from cruise_control_tpu.server.progress import OperationProgress
+from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.utils.metrics import DEFAULT_REGISTRY, MetricRegistry
+
+LOG = get_logger("facade")
 
 
 @dataclasses.dataclass
@@ -72,6 +75,12 @@ class CruiseControl:
         mesh=None,
         proposal_ttl_s: float = 300.0,
         registry: Optional[MetricRegistry] = None,
+        tpu_config=None,
+        excluded_topics_regex: str = "",
+        min_leaders_topics_regex: str = "",
+        allowed_goals: Optional[Sequence[str]] = None,
+        default_goal_names: Optional[Sequence[str]] = None,
+        hard_goal_names: Optional[Sequence[str]] = None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -79,6 +88,39 @@ class CruiseControl:
         self.constraint = constraint or BalancingConstraint()
         self.default_engine = engine
         self.mesh = mesh
+        #: TpuSearchConfig for the TPU engine (None = engine defaults)
+        self.tpu_config = tpu_config
+        #: topics.excluded.from.partition.movement: name regex resolved
+        #: against each built model's topic names
+        self.excluded_topics_regex = excluded_topics_regex
+        #: topics.with.min.leaders.per.broker (resolved per model into the
+        #: constraint's topic-id set)
+        self.min_leaders_topics_regex = min_leaders_topics_regex
+        #: `goals` config key: goal names REST requests may use (None = all)
+        self.allowed_goals = set(allowed_goals) if allowed_goals else None
+        #: default.goals / hard.goals config: the greedy engine's default
+        #: stack and the hardness override (the TPU engine fuses the full
+        #: stack; its hard set is intrinsic)
+        self.default_goal_names = (
+            list(default_goal_names) if default_goal_names else None
+        )
+        self.hard_goal_names = (
+            list(hard_goal_names) if hard_goal_names else None
+        )
+        #: brokerset.config.file entries arrive keyed by topic NAME (ids are
+        #: assigned per model build); split them out for per-model resolution.
+        #: The id-keyed remainder is the static part — _apply_topic_regexes
+        #: rebuilds broker_sets from it each model so entries resolved
+        #: against an older build's topic-id mapping never go stale.
+        self._broker_sets_by_name = {
+            k: v for k, v in self.constraint.broker_sets.items()
+            if isinstance(k, str)
+        }
+        self._broker_sets_static = {
+            k: v for k, v in self.constraint.broker_sets.items()
+            if not isinstance(k, str)
+        }
+        self.constraint.broker_sets = dict(self._broker_sets_static)
         self.anomaly_detector = None  # attached by AnomalyDetectorManager
         self.proposal_precomputer = None  # started on demand (§3.5)
         self._start_time = time.time()
@@ -92,10 +134,49 @@ class CruiseControl:
     def _make_engine(self, engine: Optional[str]):
         name = engine or self.default_engine
         if name == "tpu":
-            return TpuGoalOptimizer(constraint=self.constraint, mesh=self.mesh)
+            return TpuGoalOptimizer(
+                constraint=self.constraint, mesh=self.mesh,
+                config=self.tpu_config,
+            )
         if name == "greedy":
-            return GoalOptimizer(constraint=self.constraint)
+            return GoalOptimizer(
+                goals=make_goals(
+                    self.default_goal_names, self.constraint,
+                    hard_names=self.hard_goal_names,
+                ),
+                constraint=self.constraint,
+            )
         raise ValueError(f"unknown analyzer engine {name!r}")
+
+    def _apply_topic_regexes(self, state, options: OptimizationOptions) -> None:
+        """Resolve name-regex-scoped config against the built model's topic
+        names (ids are assigned per build): default topic exclusions and the
+        MinTopicLeadersPerBrokerGoal topic set."""
+        import re
+
+        names = state.topic_names
+        if self.excluded_topics_regex and names:
+            pat = re.compile(self.excluded_topics_regex)
+            options.excluded_topics.update(
+                i for i, n in enumerate(names) if pat.fullmatch(n)
+            )
+        if self.min_leaders_topics_regex and names:
+            pat = re.compile(self.min_leaders_topics_regex)
+            self.constraint.min_topic_leaders_topics = {
+                i for i, n in enumerate(names) if pat.fullmatch(n)
+            }
+        if self._broker_sets_by_name and names:
+            # rebuild from the static part: ids are per-build, so entries
+            # resolved for a previous model must not leak into this one.
+            # Topic-id assignment is deterministic for a given topology
+            # (builder walks partitions in sorted order), so concurrent
+            # resolutions from the same topology agree.
+            resolved = dict(self._broker_sets_static)
+            name_to_id = {n: i for i, n in enumerate(names)}
+            for name, brokers in self._broker_sets_by_name.items():
+                if name in name_to_id:
+                    resolved[name_to_id[name]] = brokers
+            self.constraint.broker_sets = resolved
 
     # ---- model plumbing ---------------------------------------------------------
     def _model(
@@ -186,6 +267,13 @@ class CruiseControl:
         progress: OperationProgress,
         strategy: Optional[ReplicaMovementStrategy] = None,
     ) -> OptimizerResult:
+        self._apply_topic_regexes(state, options)
+        if goals is not None and self.allowed_goals is not None:
+            bad = set(goals) - self.allowed_goals
+            if bad:
+                raise ValueError(
+                    f"goals not permitted by the `goals` config: {sorted(bad)}"
+                )
         # brokers whose every log dir is offline stay alive in the model (their
         # partitions need evacuating) but must not receive new replicas
         topo = self.load_monitor.metadata.refresh()
@@ -205,10 +293,24 @@ class CruiseControl:
             )
         else:
             opt = self._make_engine(engine)
+        LOG.info(
+            "%s starting: %d brokers / %d partitions, engine=%s, dryrun=%s",
+            operation, state.num_brokers, state.num_partitions,
+            opt.__class__.__name__, dryrun,
+        )
         with progress.step(f"Optimizing ({opt.__class__.__name__})"):
             # upstream GoalOptimizer's "proposal-computation-timer"
             with self.registry.timer("proposal-computation-timer"):
-                result = opt.optimize(state, options)
+                try:
+                    result = opt.optimize(state, options)
+                except Exception:
+                    LOG.exception("%s optimization failed", operation)
+                    raise
+        LOG.info(
+            "%s optimized: %d actions, %d proposals, %.2fs",
+            operation, len(result.actions), len(result.proposals),
+            result.duration_s,
+        )
         self.registry.meter(f"operation.{operation.lower()}").mark()
         # the proposals leaving the facade always speak external (Kafka) ids —
         # dryrun consumers (REST, operators) act on them too, not just the
@@ -224,8 +326,19 @@ class CruiseControl:
                         result.proposals, strategy=strategy,
                         partition_sizes=sizes,
                     )
-            # the cluster just changed; cached proposals describe a stale world
+            ex = result.execution
+            LOG.info(
+                "%s executed: %d completed / %d dead / %d aborted in "
+                "%d ticks%s", operation, ex.completed, ex.dead, ex.aborted,
+                ex.ticks, " (STOPPED)" if ex.stopped else "",
+            )
+            # the cluster just changed; cached proposals and cached metadata
+            # both describe a stale world
             self.invalidate_proposal_cache()
+            invalidate = getattr(self.load_monitor.metadata, "invalidate",
+                                 None)
+            if invalidate is not None:
+                invalidate()
         progress.finish()
         return result
 

@@ -235,6 +235,7 @@ class ClusterModelBuilder:
             ),
             replica_offline=np.asarray(offline),
             num_topics=max(len(self._topics), 1),
+            topic_names=tuple(self._topics),
             broker_ids=tuple(self._broker_ids),
             partition_ids=tuple(self._partition_ids),
             replica_disk=(

@@ -77,6 +77,10 @@ class ClusterState:
     #: DeviceModel arrays, not ClusterState, so this never hits a jit cache key
     #: on the scale-critical path.
     partition_ids: tuple = struct.field(pytree_node=False, default=())
+    #: Topic name per dense topic id (() = unnamed); lets the facade resolve
+    #: name/regex-scoped options (topics.excluded.from.partition.movement,
+    #: topics.with.min.leaders.per.broker) against the built model.
+    topic_names: tuple = struct.field(pytree_node=False, default=())
     # ---- per-window load series (upstream model/Load.java carries
     # resource × window time series into the model; SURVEY.md §2.4) --------
     #: f32 [P, W, R] leader load per aggregation window; None = the monitor

@@ -45,6 +45,9 @@ from cruise_control_tpu.monitor.sampling import (
     MetricSampler,
 )
 from cruise_control_tpu.monitor.sample_store import NoopSampleStore, SampleStore
+from cruise_control_tpu.utils.logging import get_logger
+
+_LOG = get_logger("monitor")
 
 
 class LoadMonitorState(enum.Enum):
@@ -129,12 +132,38 @@ class BackendMetadataClient(MetadataClient):
     or a real admin adapter), so monitor and executor see one world."""
 
     def __init__(self, backend, broker_rack: Dict[int, int],
-                 partition_topic: Optional[Dict[int, str]] = None):
+                 partition_topic: Optional[Dict[int, str]] = None,
+                 max_age_ms: int = 0):
         self.backend = backend
         self.broker_rack = broker_rack
         self.partition_topic = partition_topic or {}
+        #: metadata.max.age.ms: cache refresh() results this long (0 = no
+        #: caching — every call hits the backend)
+        self.max_age_ms = max_age_ms
+        self._cached: Optional[ClusterTopology] = None
+        self._cached_at_ms = 0
+
+    def invalidate(self) -> None:
+        """Drop the cached topology (the facade calls this after every
+        execution — post-move reads must see the new placement, upstream
+        metadata refresh-on-change)."""
+        self._cached = None
 
     def refresh(self) -> ClusterTopology:
+        if self.max_age_ms > 0 and self._cached is not None:
+            import time as _time
+
+            if _time.time() * 1000 - self._cached_at_ms < self.max_age_ms:
+                return self._cached
+        topo = self._refresh()
+        if self.max_age_ms > 0:
+            import time as _time
+
+            self._cached = topo
+            self._cached_at_ms = int(_time.time() * 1000)
+        return topo
+
+    def _refresh(self) -> ClusterTopology:
         assignment = {
             p: list(st.replicas) for p, st in self.backend.partitions.items()
         }
@@ -172,6 +201,7 @@ class LoadMonitor:
         min_samples_per_window: int = 1,
         max_allowed_extrapolations: int = 5,
         capacity_estimation_percentile: float = 0.0,
+        skip_loading_samples: bool = False,
     ):
         self.metadata = metadata
         self.sampler = sampler
@@ -200,7 +230,8 @@ class LoadMonitor:
         self.broker_aggregator = MetricSampleAggregator(
             BROKER_DEF, num_b, window_ms, num_windows, min_samples_per_window,
         )
-        self._startup_load()
+        if not skip_loading_samples:
+            self._startup_load()
         self.state = LoadMonitorState.RUNNING
 
     # ---- lifecycle --------------------------------------------------------------
@@ -224,12 +255,19 @@ class LoadMonitor:
             self._last_sample_ms = max(
                 [s.time_ms for s in psamples] + [s.time_ms for s in bsamples]
             )
+            _LOG.info(
+                "sample-store replay: %d partition / %d broker samples "
+                "(latest %d ms)", len(psamples), len(bsamples),
+                self._last_sample_ms,
+            )
 
     def pause_sampling(self) -> None:
+        _LOG.info("sampling paused")
         self.state = LoadMonitorState.PAUSED
 
     def resume_sampling(self) -> None:
         if self.state == LoadMonitorState.PAUSED:
+            _LOG.info("sampling resumed")
             self.state = LoadMonitorState.RUNNING
 
     def ingest_samples(self, psamples, bsamples, now_ms: int) -> int:

@@ -72,7 +72,8 @@ class MetricsTopic:
     """In-memory ``__CruiseControlMetrics``: append-only log with offset-based
     consumption so multiple samplers can tail it independently."""
 
-    def __init__(self) -> None:
+    def __init__(self, name: str = "__CruiseControlMetrics") -> None:
+        self.name = name
         self._records: List[CruiseControlMetric] = []
 
     def produce(self, records: Iterable[CruiseControlMetric]) -> None:

@@ -29,6 +29,7 @@ import numpy as np
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
 from cruise_control_tpu.server.purgatory import Purgatory
+from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.server.security import (  # re-exported (legacy import site)
     BasicSecurityProvider,
     SecurityProvider,
@@ -67,6 +68,12 @@ class CruiseControlHttpServer:
         security_provider: Optional[BasicSecurityProvider] = None,
         two_step_verification: bool = False,
         user_task_manager: Optional[UserTaskManager] = None,
+        api_prefix: str = PREFIX,
+        cors_enabled: bool = False,
+        cors_origin: str = "*",
+        access_log: bool = True,
+        purgatory_retention_s: float = 86_400.0,
+        ui_path: Optional[str] = None,
     ):
         self.cc = cruise_control
         self.host = host
@@ -74,9 +81,15 @@ class CruiseControlHttpServer:
         self.security = security_provider
         self.two_step = two_step_verification
         self.tasks = user_task_manager or UserTaskManager()
-        self.purgatory = Purgatory()
+        self.prefix = api_prefix.rstrip("/") or PREFIX
+        self.cors_enabled = cors_enabled
+        self.cors_origin = cors_origin
+        self.access_log = access_log
+        self.ui_path = ui_path
+        self.purgatory = Purgatory(retention_s=purgatory_retention_s)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._log = get_logger("server")
 
     # ---- lifecycle --------------------------------------------------------------
     def start(self) -> None:
@@ -108,7 +121,7 @@ class CruiseControlHttpServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}{PREFIX}"
+        return f"http://{self.host}:{self.port}{self.prefix}"
 
     # ---- dispatch ---------------------------------------------------------------
     def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
@@ -116,9 +129,9 @@ class CruiseControlHttpServer:
             parsed = urlparse(handler.path)
             if method == "GET" and parsed.path.rstrip("/") in ("/ui", ""):
                 return self._serve_ui(handler)
-            if not parsed.path.startswith(PREFIX + "/"):
+            if not parsed.path.startswith(self.prefix + "/"):
                 return self._send(handler, 404, {"errorMessage": "not found"})
-            endpoint = parsed.path[len(PREFIX) + 1:].strip("/").lower()
+            endpoint = parsed.path[len(self.prefix) + 1:].strip("/").lower()
             registry = getattr(self.cc, "registry", None)
             if registry is not None:  # servlet request rates (§5.1)
                 registry.meter(f"http.{method}.{endpoint or 'root'}").mark()
@@ -140,10 +153,13 @@ class CruiseControlHttpServer:
                 "errorMessage": f"unknown endpoint {method} {endpoint!r}"
             })
         except (ValueError, KeyError) as e:
+            self._log.warning("%s %s -> 400: %s", method, handler.path, e)
             self._send(handler, 400, {"errorMessage": str(e)})
         except NotEnoughValidWindowsError as e:
+            self._log.info("%s %s -> 503: %s", method, handler.path, e)
             self._send(handler, 503, {"errorMessage": str(e)})
         except Exception as e:
+            self._log.exception("%s %s -> 500", method, handler.path)
             self._send(handler, 500, {"errorMessage": repr(e)})
 
     def _authenticated(self, handler) -> bool:
@@ -157,11 +173,18 @@ class CruiseControlHttpServer:
         )
 
     def _serve_ui(self, handler) -> None:
-        """Serve the single-file dashboard (upstream serves the Vue UI's
-        dist/ at /ui; SURVEY.md §2.9)."""
+        """Serve the dashboard: webserver.ui.path when configured (a file, or
+        a directory's index.html — e.g. the upstream Vue app's dist/),
+        otherwise the built-in single-file dashboard (upstream serves the
+        Vue UI's dist/ at /ui; SURVEY.md §2.9)."""
         import pathlib
 
-        ui = pathlib.Path(__file__).with_name("ui.html")
+        if self.ui_path:
+            ui = pathlib.Path(self.ui_path)
+            if ui.is_dir():
+                ui = ui / "index.html"
+        else:
+            ui = pathlib.Path(__file__).with_name("ui.html")
         body = ui.read_bytes()
         handler.send_response(200)
         handler.send_header("Content-Type", "text/html; charset=utf-8")
@@ -169,13 +192,19 @@ class CruiseControlHttpServer:
         handler.end_headers()
         handler.wfile.write(body)
 
-    @staticmethod
-    def _send(handler, code: int, body: dict,
+    def _send(self, handler, code: int, body: dict,
               headers: Optional[Dict[str, str]] = None) -> None:
+        if self.access_log:
+            self._log.info(
+                "%s %s %d", handler.command, handler.path, code
+            )
         data = json.dumps(body, default=str).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(data)))
+        if self.cors_enabled:
+            handler.send_header("Access-Control-Allow-Origin",
+                                self.cors_origin)
         for k, v in (headers or {}).items():
             handler.send_header(k, v)
         handler.end_headers()

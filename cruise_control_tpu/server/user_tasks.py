@@ -57,9 +57,12 @@ class UserTask:
 class UserTaskManager:
     def __init__(self, max_active_tasks: int = 25,
                  completed_task_ttl_s: float = 3600.0,
-                 max_workers: int = 4):
+                 max_workers: int = 4,
+                 max_cached_completed: int = 100):
         self.max_active_tasks = max_active_tasks
         self.completed_task_ttl_s = completed_task_ttl_s
+        #: completed tasks kept at most, oldest evicted first (on top of TTL)
+        self.max_cached_completed = max_cached_completed
         self._tasks: Dict[str, UserTask] = {}
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
@@ -116,6 +119,14 @@ class UserTaskManager:
                     and now - t.completed_s > self.completed_task_ttl_s
                 ):
                     del self._tasks[tid]
+            done = sorted(
+                (
+                    (t.completed_s, tid) for tid, t in self._tasks.items()
+                    if t.completed_s is not None
+                ),
+            )
+            for _, tid in done[: max(0, len(done) - self.max_cached_completed)]:
+                del self._tasks[tid]
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)

@@ -1,0 +1,44 @@
+"""Logging setup (upstream ships log4j config — ``config/log4j.properties``,
+SURVEY.md §5.5; here the stdlib ``logging`` tree rooted at
+``cruise_control_tpu``).
+
+Subsystems log under ``cruise_control_tpu.<area>`` (engine, analyzer,
+executor, detector, monitor, server), so operators can tune per-area levels
+the way upstream's log4j categories allow.  ``configure()`` is called by the
+server bootstrap from the ``logging.level`` / ``logging.file`` config keys;
+library use (tests, notebooks) inherits whatever the host application set up
+— we never call ``basicConfig`` on import.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+#: every in-package logger hangs off this root
+ROOT = "cruise_control_tpu"
+
+_FORMAT = "%(asctime)s %(levelname)-5s [%(name)s] %(message)s"
+
+
+def get_logger(area: str) -> logging.Logger:
+    """Logger for a subsystem area (e.g. ``engine``, ``executor``)."""
+    return logging.getLogger(f"{ROOT}.{area}")
+
+
+def configure(level: str = "INFO", file: Optional[str] = None) -> None:
+    """Install handlers on the package root (idempotent: replaces any
+    handlers a previous configure() installed)."""
+    root = logging.getLogger(ROOT)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler: logging.Handler
+    if file:
+        handler = logging.FileHandler(file)
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root.addHandler(handler)
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    root.propagate = False
