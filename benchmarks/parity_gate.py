@@ -1,0 +1,104 @@
+"""Continuous mid-scale parity gate (VERDICT round-1 item #4).
+
+Runs the faithful greedy analyzer and the TPU engine on the same
+200-broker / 5000-partition RandomCluster fixture and enforces the two
+claims BASELINE.md makes at scale:
+
+* quality: TPU violation score <= greedy's, and
+* speed: TPU wall-clock < greedy / 10 (on an accelerator; pass
+  ``--ratio`` to relax when profiling on CPU).
+
+Persists the measurement as ``PARITY_GATE.json`` at the repo root (next to
+the driver's ``BENCH_r*.json``) so the 552x/35%-better class of claims is
+regression-tested, not folklore.  Exit code 0 = both gates hold.
+
+Usage: python benchmarks/parity_gate.py [--brokers 200] [--partitions 5000]
+       [--ratio 10] [--out PARITY_GATE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def run(num_brokers: int = 200, num_partitions: int = 5000,
+        min_speedup: float = 10.0, seed: int = 42, out: str | None = None):
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+
+    _jc()
+    from cruise_control_tpu.analyzer.goal_optimizer import (
+        GoalOptimizer,
+        make_goals,
+    )
+    from cruise_control_tpu.analyzer.tpu_optimizer import TpuGoalOptimizer
+    from cruise_control_tpu.analyzer.verifier import (
+        verify_result,
+        violation_score,
+    )
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(
+        seed=seed, num_brokers=num_brokers,
+        num_racks=max(4, num_brokers // 10),
+        num_partitions=num_partitions, mean_utilization=0.4,
+    )
+    goals = make_goals()
+
+    t0 = time.perf_counter()
+    greedy = GoalOptimizer(goals).optimize(state)
+    t_greedy = time.perf_counter() - t0
+    s_greedy = violation_score(greedy.final_state, goals)
+
+    tpu_opt = TpuGoalOptimizer()
+    # warm-up on a distinct seed so compile time never pollutes the gate
+    tpu_opt.optimize(random_cluster(
+        seed=seed + 1, num_brokers=num_brokers,
+        num_racks=max(4, num_brokers // 10),
+        num_partitions=num_partitions, mean_utilization=0.4,
+    ))
+    t0 = time.perf_counter()
+    tpu = tpu_opt.optimize(state)
+    t_tpu = time.perf_counter() - t0
+    verify_result(state, tpu, goals)
+    s_tpu = violation_score(tpu.final_state, goals)
+
+    result = {
+        "fixture": {"brokers": num_brokers, "partitions": num_partitions,
+                    "seed": seed},
+        "greedy": {"wallclock_s": round(t_greedy, 2),
+                   "violation_score": s_greedy},
+        "tpu": {"wallclock_s": round(t_tpu, 2), "violation_score": s_tpu},
+        "speedup": round(t_greedy / max(t_tpu, 1e-9), 1),
+        "quality_gate": bool(s_tpu <= s_greedy),
+        "speed_gate": bool(t_tpu * min_speedup < t_greedy),
+        "min_speedup": min_speedup,
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--brokers", type=int, default=200)
+    ap.add_argument("--partitions", type=int, default=5000)
+    ap.add_argument("--ratio", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(__file__), "..",
+                             "PARITY_GATE.json"),
+    )
+    args = ap.parse_args()
+    result = run(args.brokers, args.partitions, args.ratio, args.seed,
+                 os.path.abspath(args.out))
+    print(json.dumps(result))
+    return 0 if (result["quality_gate"] and result["speed_gate"]) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -191,7 +191,17 @@ def _security_provider(cfg: CruiseControlConfig):
 
         cls = resolve_class(explicit)
         if cls is sec.JwtSecurityProvider:
-            with open(cfg.get("webserver.security.jwt.secret.file"), "rb") as f:
+            secret_file = cfg.get("webserver.security.jwt.secret.file")
+            if not secret_file:
+                from cruise_control_tpu.config.cruise_control_config import (
+                    ConfigException,
+                )
+
+                raise ConfigException(
+                    "webserver.security.jwt.secret.file must be set when "
+                    "the JWT security provider is selected"
+                )
+            with open(secret_file, "rb") as f:
                 secret = f.read().strip()
             return sec.JwtSecurityProvider(
                 secret, audience=cfg.get("webserver.security.jwt.audience")

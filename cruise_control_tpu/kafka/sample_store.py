@@ -1,0 +1,64 @@
+"""Kafka-backed sample store (upstream
+``monitor/sampling/KafkaSampleStore.java``): samples persist to two internal
+topics and replay from offset 0 at startup, so the workload model survives
+restarts (the LOADING state, SURVEY.md §5.4)."""
+
+from __future__ import annotations
+
+import json
+from typing import List, Tuple
+
+from cruise_control_tpu.kafka.wire import KafkaWire
+from cruise_control_tpu.monitor.sample_store import SampleStore
+from cruise_control_tpu.monitor.sampling import (
+    BrokerMetricSample,
+    PartitionMetricSample,
+)
+
+PARTITION_SAMPLES_TOPIC = "__KafkaCruiseControlPartitionMetricSamples"
+BROKER_SAMPLES_TOPIC = "__KafkaCruiseControlModelTrainingSamples"
+
+
+class KafkaSampleStore(SampleStore):
+    def __init__(
+        self,
+        wire: KafkaWire,
+        partition_topic: str = PARTITION_SAMPLES_TOPIC,
+        broker_topic: str = BROKER_SAMPLES_TOPIC,
+        topic_replication_factor: int = 2,
+    ):
+        self.wire = wire
+        self.partition_topic = partition_topic
+        self.broker_topic = broker_topic
+        for t in (partition_topic, broker_topic):
+            wire.create_topic(
+                t, replication_factor=topic_replication_factor,
+                configs={"cleanup.policy": "compact"},
+            )
+
+    def store_samples(self, partition_samples, broker_samples) -> None:
+        if partition_samples:
+            self.wire.produce(self.partition_topic, [
+                json.dumps([s.partition, s.time_ms, list(s.values)]).encode()
+                for s in partition_samples
+            ])
+        if broker_samples:
+            self.wire.produce(self.broker_topic, [
+                json.dumps([s.broker_id, s.time_ms, list(s.values)]).encode()
+                for s in broker_samples
+            ])
+
+    def load_samples(
+        self,
+    ) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
+        praw, _ = self.wire.consume(self.partition_topic, 0)
+        braw, _ = self.wire.consume(self.broker_topic, 0)
+        psamples = [
+            PartitionMetricSample(p, t, tuple(v))
+            for p, t, v in (json.loads(r) for r in praw)
+        ]
+        bsamples = [
+            BrokerMetricSample(b, t, tuple(v))
+            for b, t, v in (json.loads(r) for r in braw)
+        ]
+        return psamples, bsamples

@@ -1,0 +1,83 @@
+"""Kafka-backed metrics path: the ``__CruiseControlMetrics`` producer twin
+and the consumer-side sampler (upstream
+``cruise-control-metrics-reporter/.../CruiseControlMetricsReporter.java`` +
+``monitor/sampling/CruiseControlMetricsReporterSampler.java``).
+
+Records cross the wire as compact JSON rows ``[type, time_ms, broker,
+value, partition]`` (upstream uses its own binary envelope; the format is
+private to reporter+sampler, so JSON keeps the seam inspectable without a
+schema registry).  Processing reuses the exact
+:class:`~cruise_control_tpu.monitor.sampling.MetricsProcessor` pipeline —
+including the per-partition CPU estimation — so Kafka-fed and simulated
+models are built by identical code.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from cruise_control_tpu.kafka.wire import KafkaWire
+from cruise_control_tpu.monitor.sampling import (
+    CruiseControlMetric,
+    MetricSampler,
+    MetricsProcessor,
+    RawMetricType,
+)
+
+DEFAULT_METRICS_TOPIC = "__CruiseControlMetrics"
+
+
+def encode_metric(m: CruiseControlMetric) -> bytes:
+    return json.dumps(
+        [m.metric_type.value, m.time_ms, m.broker_id, m.value, m.partition]
+    ).encode()
+
+
+def decode_metric(raw: bytes) -> CruiseControlMetric:
+    t, time_ms, broker, value, partition = json.loads(raw)
+    return CruiseControlMetric(
+        RawMetricType(t), int(time_ms), int(broker), float(value),
+        int(partition),
+    )
+
+
+class KafkaMetricsReporter:
+    """Producer side (what the broker plugin does): serialize raw metrics to
+    the metrics topic, auto-creating it first (upstream
+    ``CruiseControlMetricsUtils`` topic management)."""
+
+    def __init__(self, wire: KafkaWire, topic: str = DEFAULT_METRICS_TOPIC,
+                 topic_replication_factor: int = 2):
+        self.wire = wire
+        self.topic = topic
+        wire.create_topic(
+            topic, replication_factor=topic_replication_factor,
+            configs={"retention.ms": str(60 * 60 * 1000)},
+        )
+
+    def report(self, records: Sequence[CruiseControlMetric]) -> None:
+        self.wire.produce(self.topic, [encode_metric(m) for m in records])
+
+
+class KafkaMetricsReporterSampler(MetricSampler):
+    """Consumer side: tail the metrics topic from the last consumed offset
+    and run the shared processor.  Records timestamped at/after a poll's
+    ``end_ms`` are held for the next poll (same late-record semantics as the
+    in-process sampler, which the aggregator's window accounting relies
+    on)."""
+
+    def __init__(self, wire: KafkaWire, topic: str = DEFAULT_METRICS_TOPIC,
+                 processor: Optional[MetricsProcessor] = None):
+        self.wire = wire
+        self.topic = topic
+        self.processor = processor or MetricsProcessor()
+        self._offset = 0
+        self._pending: List[CruiseControlMetric] = []
+
+    def get_samples(self, start_ms: int, end_ms: int):
+        raw, self._offset = self.wire.consume(self.topic, self._offset)
+        records = self._pending + [decode_metric(r) for r in raw]
+        ready = [r for r in records if r.time_ms < end_ms]
+        self._pending = [r for r in records if r.time_ms >= end_ms]
+        return self.processor.process(ready)

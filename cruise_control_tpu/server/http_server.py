@@ -402,6 +402,17 @@ class CruiseControlHttpServer:
         dryrun = _flag(params, "dryrun", default=True)
         goals = params.get("goals")
         goal_list = goals.split(",") if goals else None
+        # `goals` config key: REST-supplied goal names are validated here,
+        # at the request boundary — internal operations pin their own
+        # subsets (demote, rebalance_disk, kafka_assigner) unrestricted
+        allowed = getattr(cc, "allowed_goals", None)
+        if goal_list and allowed is not None:
+            bad = set(goal_list) - allowed
+            if bad:
+                raise ValueError(
+                    f"goals not permitted by the `goals` config: "
+                    f"{sorted(bad)}"
+                )
         engine = params.get("engine")
 
         if endpoint == "rebalance":
@@ -432,8 +443,9 @@ class CruiseControlHttpServer:
             )
         if endpoint == "topic_configuration":
             rf = int(params["replication_factor"])
+            topic = params.get("topic")  # optional name regex (upstream)
             return lambda progress: cc.fix_topic_replication_factor(
-                rf, dryrun=dryrun, progress=progress
+                rf, dryrun=dryrun, progress=progress, topic_regex=topic
             )
         if endpoint == "rightsize":
             return lambda progress: cc.rightsize(progress=progress)

@@ -165,7 +165,7 @@ def test_boot_from_properties_overriding_each_subsystem(tmp_path):
         with app.cruise_control.load_monitor.acquire_for_model_generation():
             state = app.cruise_control.load_monitor.cluster_model()
         opts = OptimizationOptions()
-        app.cruise_control._apply_topic_regexes(state, opts)
+        app.cruise_control._resolved_constraint(state, opts)
         assert opts.excluded_topics == {
             i for i, n in enumerate(state.topic_names) if n == "topic_0"}
         # simulation

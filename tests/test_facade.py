@@ -248,3 +248,67 @@ class TestProposalPrecompute:
         pre = ProposalPrecomputingExecutor(Boom(), interval_s=999)
         assert pre.refresh_once() is False
         assert pre.errors == 1 and "model not ready" in pre.last_error
+
+
+def test_rf_increase_respects_capacity_goals():
+    """VERDICT round-1 item #9's done-bar: an RF-increase that would
+    overflow a broker picks a different destination via the goal chain
+    (upstream TopicConfigurationRunnable routes through the optimizer)."""
+    import contextlib
+
+    from cruise_control_tpu.analyzer.goals.base import BalancingConstraint
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.models.builder import ClusterModelBuilder
+
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 1e4, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+           Resource.DISK: 100.0}
+    b.add_broker("r0", cap)   # hosts X
+    b.add_broker("r1", cap)   # nearly full: naive count-based pick
+    b.add_broker("r2", cap)   # roomy but higher replica count
+    tiny = {Resource.CPU: 1.0, Resource.NW_IN: 1.0, Resource.NW_OUT: 1.0,
+            Resource.DISK: 5.0}
+    b.add_partition("X", [0], {Resource.CPU: 1.0, Resource.NW_IN: 1.0,
+                               Resource.NW_OUT: 1.0, Resource.DISK: 10.0})
+    b.add_partition("BIG", [1], {Resource.CPU: 1.0, Resource.NW_IN: 1.0,
+                                 Resource.NW_OUT: 1.0, Resource.DISK: 75.0})
+    b.add_partition("S1", [2], tiny)
+    b.add_partition("S2", [2], tiny)
+    state = b.build()
+
+    class StubMonitor:
+        metadata = object()
+
+        def acquire_for_model_generation(self):
+            return contextlib.nullcontext()
+
+        def cluster_model(self, requirements=None):
+            return state
+
+    backend = SimulatedClusterBackend(
+        {0: [0], 1: [1], 2: [2], 3: [2]}, {0: 0, 1: 1, 2: 2, 3: 2},
+        brokers={0, 1, 2},
+    )
+    cc = CruiseControl(StubMonitor(), Executor(backend),
+                       constraint=BalancingConstraint())
+    result = cc.fix_topic_replication_factor(2, dryrun=True, topic_regex="X")
+    by_p = {pr.partition: pr for pr in result.proposals}
+    assert 0 in by_p, result.proposals
+    # broker 1 would breach disk capacity (75 + 10 > 80): the goal chain
+    # must place X's new replica on broker 2 despite its higher count
+    assert set(by_p[0].new_replicas) == {0, 2}
+    assert set(by_p[0].old_replicas) == {0}
+
+
+def test_rf_decrease_emits_removal_proposals():
+    """RF decreases must produce executable removal proposals (code-review
+    regression: pre-applied removals were silently dropped)."""
+    cc, backend, _ = full_stack(rf=2)
+    result = cc.fix_topic_replication_factor(1, dryrun=False)
+    assert result.proposals, "no removal proposals emitted"
+    assert result.execution is not None and result.execution.succeeded
+    for p, st in backend.partitions.items():
+        assert len(set(st.replicas)) == 1, (p, st)

@@ -1,0 +1,243 @@
+"""Kafka adapter (VERDICT round-1 item #2): the executor/monitor/detector
+stack runs against KafkaClusterBackend over a scripted FakeKafkaWire with
+the same assertions as the simulated backend, and the metrics/sample-store
+paths round-trip through wire topics."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
+from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.kafka import (
+    FakeKafkaWire,
+    KafkaClusterBackend,
+    KafkaMetadataClient,
+    KafkaMetricsReporter,
+    KafkaMetricsReporterSampler,
+    KafkaSampleStore,
+)
+from cruise_control_tpu.kafka.backend import (
+    FOLLOWER_RATE,
+    LEADER_RATE,
+    LEADER_REPLICAS,
+)
+from cruise_control_tpu.monitor.sampling import (
+    CruiseControlMetric,
+    RawMetricType,
+)
+
+TOPIC = "t0"
+
+
+def make_backend(kind: str, n_partitions: int = 6, rf: int = 2,
+                 brokers=(0, 1, 2, 3), failed=None):
+    """Same initial placement on both backend kinds: partition p on brokers
+    (p % B, (p+1) % B), leader first."""
+    B = len(brokers)
+    assign = {
+        p: [brokers[p % B], brokers[(p + 1) % B]] for p in range(n_partitions)
+    }
+    leaders = {p: a[0] for p, a in assign.items()}
+    if kind == "simulated":
+        return SimulatedClusterBackend(
+            assign, leaders, brokers=set(brokers),
+            failed_brokers=set(failed or ()),
+        )
+    wire = FakeKafkaWire(
+        assignment={(TOPIC, p): reps for p, reps in assign.items()},
+        leaders={(TOPIC, p): l for p, l in leaders.items()},
+        broker_racks={b: f"rack_{b % 2}" for b in brokers},
+        failed_brokers=set(failed or ()),
+    )
+    return KafkaClusterBackend(wire)
+
+
+@pytest.mark.parametrize("kind", ["simulated", "kafka"])
+def test_executor_moves_and_leadership(kind):
+    """The core executor integration assertions, identical on both backends:
+    replica moves land, leadership lands, ongoing set drains."""
+    backend = make_backend(kind)
+    ex = Executor(backend, ExecutorConfig(
+        num_concurrent_partition_movements_per_broker=2,
+    ))
+    proposals = [
+        # move p0's follower 1 -> 3 and hand p1's leadership to its follower
+        ExecutionProposal(0, 0, old_leader=0, new_leader=0,
+                          old_replicas=(0, 1), new_replicas=(0, 3)),
+        ExecutionProposal(1, 0, old_leader=1, new_leader=2,
+                          old_replicas=(1, 2), new_replicas=(2, 1)),
+    ]
+    result = ex.execute_proposals(proposals)
+    assert result.succeeded, result
+    st0 = backend.partition_state(0)
+    assert sorted(st0.replicas) == [0, 3]
+    st1 = backend.partition_state(1)
+    assert st1.leader == 2
+    assert backend.ongoing_reassignments() == set()
+
+
+@pytest.mark.parametrize("kind", ["simulated", "kafka"])
+def test_executor_throttle_set_and_cleared(kind):
+    backend = make_backend(kind)
+    ex = Executor(backend, ExecutorConfig(replication_throttle=12_500.0))
+    proposals = [ExecutionProposal(0, 0, 0, 0, (0, 1), (0, 2))]
+    result = ex.execute_proposals(proposals)
+    assert result.succeeded
+    # throttles must be gone after execution on either backend
+    if kind == "kafka":
+        for b in backend.alive_brokers():
+            cfg = backend.describe_config("broker", b)
+            assert LEADER_RATE not in cfg and FOLLOWER_RATE not in cfg
+        assert LEADER_REPLICAS not in backend.describe_config("topic", TOPIC)
+    else:
+        assert backend.throttle_rate is None
+        assert ("set", 12_500.0) in backend.throttle_history
+
+
+def test_kafka_throttle_preserves_user_configs():
+    """User-set dynamic configs survive the throttle set/clear cycle (the
+    upstream ReplicationThrottleHelper contract)."""
+    backend = make_backend("kafka")
+    backend.wire.incremental_alter_configs(
+        "broker", "0", {"log.cleaner.threads": "4"}
+    )
+    ex = Executor(backend, ExecutorConfig(replication_throttle=1000.0))
+    ex.execute_proposals([ExecutionProposal(0, 0, 0, 0, (0, 1), (0, 2))])
+    assert backend.describe_config("broker", "0") == {
+        "log.cleaner.threads": "4"
+    }
+
+
+@pytest.mark.parametrize("kind", ["simulated", "kafka"])
+def test_executor_dead_task_on_failed_broker(kind):
+    """A destination that never catches up times out -> DEAD, not success
+    (same observable behavior over the wire as in the simulation)."""
+    backend = make_backend(kind, failed=(3,))
+    ex = Executor(backend, ExecutorConfig(task_timeout_ticks=5))
+    proposals = [ExecutionProposal(0, 0, 0, 0, (0, 1), (0, 3))]
+    result = ex.execute_proposals(proposals, max_ticks=50)
+    assert result.dead == 1 and not result.succeeded
+
+
+@pytest.mark.parametrize("kind", ["simulated", "kafka"])
+def test_executor_startup_recovery_detects_ongoing(kind):
+    backend = make_backend(kind)
+    backend.alter_partition_reassignments({0: [0, 3]})
+    ex = Executor(backend)
+    ongoing = ex.detect_ongoing_at_startup(stop=True)
+    assert ongoing == {0}
+    assert backend.ongoing_reassignments() == set()
+
+
+def test_kafka_metrics_roundtrip_through_wire_topic():
+    """Reporter -> __CruiseControlMetrics -> sampler -> processed samples,
+    byte-identical processing to the in-process path."""
+    backend = make_backend("kafka")
+    wire = backend.wire
+    reporter = KafkaMetricsReporter(wire)
+    reporter.report([
+        CruiseControlMetric(RawMetricType.BROKER_CPU_UTIL, 500, 0, 42.0),
+        CruiseControlMetric(RawMetricType.PARTITION_BYTES_IN, 500, 0, 100.0,
+                            partition=0),
+        CruiseControlMetric(RawMetricType.PARTITION_BYTES_OUT, 500, 0, 50.0,
+                            partition=0),
+        CruiseControlMetric(RawMetricType.PARTITION_SIZE, 500, 0, 900.0,
+                            partition=0),
+    ])
+    assert wire.logs["__CruiseControlMetrics"]
+    sampler = KafkaMetricsReporterSampler(wire)
+    psamples, bsamples = sampler.get_samples(0, 1000)
+    assert len(psamples) == 1 and psamples[0].partition == 0
+    assert len(bsamples) == 1 and bsamples[0].broker_id == 0
+    # offset-tracked: a second poll returns nothing new
+    p2, b2 = sampler.get_samples(1000, 2000)
+    assert not p2 and not b2
+
+
+def test_kafka_sample_store_replay():
+    backend = make_backend("kafka")
+    store = KafkaSampleStore(backend.wire)
+    from cruise_control_tpu.monitor.sampling import (
+        BrokerMetricSample,
+        PartitionMetricSample,
+    )
+
+    ps = [PartitionMetricSample(3, 500, (1.0, 2.0, 3.0, 4.0))]
+    bs = [BrokerMetricSample(1, 500, (9.0, 8.0, 7.0, 6.0))]
+    store.store_samples(ps, bs)
+    # a fresh store instance (fresh process) replays everything
+    p2, b2 = KafkaSampleStore(backend.wire).load_samples()
+    assert p2 == ps and b2 == bs
+
+
+def test_kafka_metadata_topology():
+    backend = make_backend("kafka")
+    topo = KafkaMetadataClient(backend).refresh()
+    assert topo.num_partitions == 6
+    assert set(topo.broker_rack) == {0, 1, 2, 3}
+    assert topo.partition_topic[0] == TOPIC
+    assert topo.alive_brokers == {0, 1, 2, 3}
+
+
+def test_end_to_end_rebalance_over_fake_kafka():
+    """Full slice on the Kafka stack: wire metrics feed the monitor, the
+    TPU engine plans, the executor lands the plan back on the wire."""
+    from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+
+    rng = np.random.default_rng(7)
+    P, B = 40, 6
+    wire = FakeKafkaWire(
+        assignment={
+            (TOPIC, p): [p % B, (p + 1) % B] for p in range(P)
+        },
+        broker_racks={b: f"rack_{b % 3}" for b in range(B)},
+    )
+    backend = KafkaClusterBackend(wire)
+    reporter = KafkaMetricsReporter(wire)
+    # skewed workload: brokers 0/1 lead the hot partitions
+    WINDOW = 3_600_000
+    for w in range(3):
+        records = []
+        t = w * WINDOW + 500
+        for p in range(P):
+            rate = 300.0 if p % B in (0, 1) else 20.0
+            records += [
+                CruiseControlMetric(RawMetricType.PARTITION_BYTES_IN, t,
+                                    p % B, rate, partition=p),
+                CruiseControlMetric(RawMetricType.PARTITION_BYTES_OUT, t,
+                                    p % B, rate / 2, partition=p),
+                CruiseControlMetric(RawMetricType.PARTITION_SIZE, t, p % B,
+                                    rate * 3, partition=p),
+            ]
+        for b in range(B):
+            records.append(CruiseControlMetric(
+                RawMetricType.BROKER_CPU_UTIL, t, b, 30.0))
+        reporter.report(records)
+    monitor = LoadMonitor(
+        KafkaMetadataClient(backend),
+        KafkaMetricsReporterSampler(wire),
+        capacity_resolver=StaticCapacityResolver({
+            Resource.CPU: 1e3, Resource.NW_IN: 1e4, Resource.NW_OUT: 1e4,
+            Resource.DISK: 1e6,
+        }),
+        window_ms=WINDOW, num_windows=5,
+    )
+    for w in range(3):
+        monitor.run_sampling_iteration((w + 1) * WINDOW)
+    cc = CruiseControl(monitor, Executor(backend, ExecutorConfig()),
+                       engine="tpu")
+    result = cc.rebalance(dryrun=False)
+    assert result.execution is not None and result.execution.succeeded
+    # the plan landed on the WIRE: placement differs from the original
+    moved = sum(
+        1 for p in range(P)
+        if sorted(backend.partition_state(p).replicas) != sorted(
+            [p % B, (p + 1) % B])
+    )
+    assert moved > 0
+    assert backend.ongoing_reassignments() == set()

@@ -562,3 +562,21 @@ def test_fuzz_engine_invariants(seed):
     g = violation_score(greedy.final_state, goals)
     t = violation_score(tpu.final_state, goals)
     assert t <= g + max(3, g // 10), (seed, g, t)
+
+
+def test_parity_gate_midscale():
+    """The continuous parity harness at in-suite scale (VERDICT round-1
+    item #4): TPU violation score <= greedy on a 100-broker/2000-partition
+    fixture, via the same benchmarks/parity_gate.py entry the driver can
+    run at 200/5000 on real hardware (where it also enforces the 10x
+    wall-clock gate; CPU test rigs only assert quality + faster-than)."""
+    import sys
+
+    sys.path.insert(0, "benchmarks")
+    try:
+        from parity_gate import run
+    finally:
+        sys.path.pop(0)
+    result = run(num_brokers=100, num_partitions=2000, min_speedup=1.0)
+    assert result["quality_gate"], result
+    assert result["speed_gate"], result  # at least faster than greedy
