@@ -86,8 +86,8 @@ def main() -> None:
     orig_scan = T._cached_scan_fn
 
     @functools.lru_cache(maxsize=64)
-    def scan_wrap(cfg, K, D, Tn):
-        fn = orig_scan(cfg, K, D, Tn)
+    def scan_wrap(cfg, K, D, Tn, mesh=None):
+        fn = orig_scan(cfg, K, D, Tn, mesh)
 
         def run(m, ca):
             t0 = time.perf_counter()

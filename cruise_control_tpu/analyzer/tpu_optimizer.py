@@ -110,9 +110,12 @@ class TpuSearchConfig:
     #: movement friction: prefer smaller data moves on near-ties
     w_move_size: float = 1e-3
     #: move-candidate scoring path: "columnar" materializes K·D candidate
-    #: rows (gather-bound at scale); "grid" scores the K×D grid by broadcast
-    #: (ops.grid); "pallas" runs the fused VMEM kernel (ops.pallas_grid);
-    #: "auto" picks pallas on TPU (single-device), grid elsewhere
+    #: rows (gather-bound at scale); "grid" scores the K×D grid by
+    #: broadcast (ops.grid); "auto" = grid.  A hand-written Pallas kernel
+    #: for this op was measured on v5e (round 2, 8192x1024) and REMOVED:
+    #: its raw [K, D] pass ran 0.89x the XLA grid's time, but the XLA grid
+    #: fuses into the consuming top-k (no [K, D] materialization) and beat
+    #: the kernel 4x end-to-end — hand-scheduling loses to XLA fusion here
     scoring: str = "auto"
     #: device-resident search: run up to this many (rescore → select →
     #: apply) steps per device call inside a lax.while_loop, so host↔device
@@ -717,9 +720,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
     case)."""
     from cruise_control_tpu.ops.grid import move_grid_scores
 
-    use_pallas = _resolve_scoring(cfg, mesh) == "pallas"
-    if use_pallas:
-        from cruise_control_tpu.ops.pallas_grid import move_grid_scores_pallas
+    _resolve_scoring(cfg, mesh)  # validates the scoring choice
     M = cfg.device_batch_per_step
     repool = max(1, cfg.repool_steps)
     axis = mesh.axis_names[0] if mesh is not None else None
@@ -739,7 +740,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         Q = max(1, cfg.moves_per_src)
         NROW = (Q + 1) * B
         M_ = min(M, NROW)
-        grid_fn = move_grid_scores_pallas if use_pallas else move_grid_scores
+        grid_fn = move_grid_scores
         kp, ks, row_scores, best_d, lp, lsl, l_scores = (
             _reduced_candidates(m, cfg, ca, K, D, grid_fn, pools=pools,
                                 axis=axis, n_dev=n_dev)
@@ -817,9 +818,15 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # scatter/gather cost scales with its row count, and rows outside
         # the top few thousand essentially never win a step (committed
         # batches top out in the hundreds) — matching 50k mostly-infeasible
-        # rows cost more than every other step component combined
+        # rows cost more than every other step component combined.  A full
+        # sort beats top_k here: lax.top_k with k in the thousands is a
+        # selection network far slower than one bitonic sort of the row
+        # keys (measured on v5e)
         C = min(4096, NROW)
-        _, crow = jax.lax.top_k(-cand_score[:, 0], C)
+        _, crow_all = jax.lax.sort_key_val(
+            cand_score[:, 0], jnp.arange(NROW, dtype=jnp.int32)
+        )
+        crow = crow_all[:C]
         cand_score = cand_score[crow]
         cand_dst = cand_dst[crow]
         cand_src = cand_src[crow]
@@ -841,14 +848,28 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # segmented prefix sums) — one cold broker absorbs as many moves
         # per step as its deficit allows.
         ci = jnp.arange(C, dtype=jnp.int32)
-        p_cc = jnp.clip(cand_p, 0)
+        # Compact partition-conflict ids: rows sharing a partition map to
+        # one representative row index, so ALL partition-disjointness
+        # bookkeeping (dedup, cohort footprint, auction conflict sets)
+        # runs on [C]-sized arrays.  The [P]-sized fills/scatters this
+        # replaces dominated the step at the 1M-partition scale — 8 auction
+        # rounds each touched a [P] bitmap for a 4096-row problem.
+        order_pc = jnp.argsort(cand_p)
+        sorted_p = cand_p[order_pc]
+        firstp = jnp.concatenate(
+            [jnp.ones(1, bool), sorted_p[1:] != sorted_p[:-1]]
+        )
+        start_pos = jax.lax.cummax(jnp.where(firstp, ci, -1))
+        rep = jnp.zeros(C, jnp.int32).at[order_pc].set(
+            order_pc[start_pos]
+        )
         improving = cand_score[:, 0] < cfg.improvement_tol
         qual = qualified & improving
         # one row per partition (best first — rows are in score order)
-        fminp = jnp.full(P, C, jnp.int32).at[p_cc].min(
+        fminp = jnp.full(C, C, jnp.int32).at[rep].min(
             jnp.where(qual, ci, C)
         )
-        qual = qual & (ci == fminp[p_cc])
+        qual = qual & (ci == fminp[rep])
         d0 = jnp.clip(cand_dst[:, 0], 0)
         acc_b = _budget_accept(
             d0, jnp.clip(cand_src, 0), move_vec, dst_budget, src_budget,
@@ -859,11 +880,11 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         used0 = (
             jnp.zeros(B, bool).at[jnp.clip(cand_src, 0)].max(acc_b),
             jnp.zeros(B, bool).at[d0].max(acc_b),
-            jnp.zeros(P, bool).at[p_cc].max(acc_b),
+            jnp.zeros(C, bool).at[rep].max(acc_b),
         )
         take_d, win_score_d, win_dst_d = _match_batch(
             jnp.where(acc_b[:, None], jnp.inf, cand_score),
-            cand_dst, cand_src, cand_p, cfg.improvement_tol, B, P,
+            cand_dst, cand_src, rep, cfg.improvement_tol, B, C,
             init_used=used0,
         )
         take = acc_b | take_d
@@ -871,9 +892,13 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         win_dst = jnp.where(acc_b, d0, win_dst_d)
         # cap to the M_ best matches; commit order = score order.  The sort
         # puts accepted entries (finite scores) first, so the step's batch
-        # is valid-prefix-contiguous and can compact at the running offset
-        vals, order = jax.lax.top_k(-jnp.where(take, win_score, jnp.inf), M_)
-        vals = -vals
+        # is valid-prefix-contiguous and can compact at the running offset.
+        # (one bitonic sort of C keys — top_k with k ~ C/2 is far slower)
+        vals_all, order_all = jax.lax.sort_key_val(
+            jnp.where(take, win_score, jnp.inf), ci
+        )
+        vals = vals_all[:M_]
+        order = order_all[:M_]
         sel_ok = jnp.isfinite(vals)
         take_f = jnp.zeros(C, bool).at[order].max(sel_ok)
         c_step = jnp.sum(sel_ok.astype(jnp.int32))
@@ -1448,12 +1473,16 @@ def _resync_device_model(m: DeviceModel, ctx: AnalyzerContext) -> DeviceModel:
 
 
 def _resolve_scoring(cfg: TpuSearchConfig, mesh) -> str:
-    if cfg.scoring != "auto":
-        return cfg.scoring
-    # XLA's fused grid beats the hand-written Pallas kernel at the current
-    # K×D shapes (measured 14.4ms vs 16.5ms at 8192×1024 on v5e) — auto
-    # picks the jnp grid everywhere; "pallas" stays selectable and tested
-    return "grid"
+    # "pallas" was removed in round 2: the hand kernel's raw [K, D] pass
+    # measured 0.89x the XLA grid on v5e (8192x1024), but XLA fuses the
+    # grid into the consuming top-k (never materializing [K, D]) and beat
+    # the kernel 4x end-to-end — the brief's own rule applies: don't
+    # hand-schedule what the compiler already fuses
+    if cfg.scoring not in ("auto", "grid", "columnar"):
+        raise ValueError(
+            f"unknown scoring {cfg.scoring!r} (auto/grid/columnar)"
+        )
+    return "grid" if cfg.scoring == "auto" else cfg.scoring
 
 
 def _leadership_pool_size(P: int, S: int, K: int) -> int:
@@ -1831,6 +1860,11 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
     cohort (:func:`_seg_prefix_fits` acceptance in the scan step) passes
     its footprint here so auction winners stay disjoint from it.
 
+    ``cand_p``/``P`` need only be CONFLICT ids: any labeling where two
+    rows share a label iff they must not both win.  The scan step passes
+    compact representative row indices (P = N) so the per-round conflict
+    bitmaps stay [N]-sized instead of [num_partitions]-sized.
+
     cand_score/cand_dst [N, A]; cand_src/cand_p [N].
     → (take [N] bool, win_score [N], win_dst [N])
     """
@@ -1936,19 +1970,11 @@ def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
     else:
         from cruise_control_tpu.ops.grid import move_grid_scores
 
-        if scoring == "pallas":
-            from cruise_control_tpu.ops.pallas_grid import (
-                move_grid_scores_pallas as _grid_fn,
-            )
-        else:
-            _grid_fn = None
-
         def round_fn(m: DeviceModel, ca):
             # moves scored on the K×D grid (no per-candidate gathers),
             # leaderships columnar (pruned pool); merged top-k
-            grid_fn = _grid_fn if _grid_fn is not None else move_grid_scores
             scores, kp, ks, best_d, lp, lsl = _merged_scores(
-                m, cfg, ca, K, D, grid_fn
+                m, cfg, ca, K, D, move_grid_scores
             )
             k = min(cfg.topk_per_round, scores.shape[0])
             vals, idx = jax.lax.top_k(-scores, k)
@@ -1980,15 +2006,8 @@ def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
     else:
         from cruise_control_tpu.ops.grid import move_grid_scores
 
-        if scoring == "pallas":  # explicit request — auto never picks it here
-            from cruise_control_tpu.ops.pallas_grid import (
-                move_grid_scores_pallas as _shard_grid_fn,
-            )
-        else:
-            _shard_grid_fn = move_grid_scores
-
         def score_move_shard(m, ca, dest_pool, kp, ks):
-            g = _shard_grid_fn(m, cfg, ca, kp, ks, dest_pool)
+            g = move_grid_scores(m, cfg, ca, kp, ks, dest_pool)
             flat = g.reshape(-1)
             k = min(cfg.topk_per_round, flat.shape[0])
             vals, idx = jax.lax.top_k(-flat, k)

@@ -649,7 +649,7 @@ def default_config_def() -> ConfigDef:
              Importance.LOW, "Movement friction per normalized disk MB.",
              at_least(0), G)
     d.define("tpu.search.scoring", ConfigType.STRING, "auto",
-             Importance.LOW, "Move scorer: auto/grid/columnar/pallas.",
+             Importance.LOW, "Move scorer: auto/grid/columnar.",
              None, G)
     d.define("tpu.search.steps.per.call", ConfigType.INT, 512,
              Importance.MEDIUM, "Device-resident steps per call (0 = "

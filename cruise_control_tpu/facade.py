@@ -538,24 +538,32 @@ class CruiseControl:
             shrink = scoped & (rf > target_rf)
             racks = np.array(state.broker_rack)
             lslot = np.array(state.leader_slot)
+            orig_assignment = np.array(state.assignment)
             shrink_old: Dict[int, tuple] = {}
             for p in np.nonzero(shrink)[0]:
                 pre = tuple(
-                    int(b) for b in np.array(state.assignment)[p]
-                    if b != EMPTY_SLOT
+                    int(b) for b in orig_assignment[p] if b != EMPTY_SLOT
                 )
+                # greedy keep-selection with a LIVE rack set: rack-new slots
+                # are taken as they are found, so duplicate-rack followers
+                # are dropped before rack-distinct ones (keeping a replica
+                # that already hosts the data is a zero-copy removal; the
+                # alternative forces the goal chain to re-add the data on a
+                # fresh broker)
                 keep = [int(lslot[p])]
                 seen_racks = {int(racks[a[p, lslot[p]]])}
-                slots = [
+                rest = [
                     s for s in range(S_new)
                     if s != lslot[p] and a[p, s] != EMPTY_SLOT
+                    and a[p, s] < B
                 ]
-                # rack-diverse slots first, then the rest
-                slots.sort(key=lambda s: racks[a[p, s]] in seen_racks)
-                for s in slots:
-                    if len(keep) < target_rf and a[p, s] < B:
-                        keep.append(s)
-                        seen_racks.add(int(racks[a[p, s]]))
+                for rack_new in (True, False):
+                    for s in rest:
+                        if len(keep) >= target_rf or s in keep:
+                            continue
+                        if (int(racks[a[p, s]]) not in seen_racks) == rack_new:
+                            keep.append(s)
+                            seen_racks.add(int(racks[a[p, s]]))
                 for s in range(S_new):
                     if s not in keep and a[p, s] != EMPTY_SLOT:
                         a[p, s] = EMPTY_SLOT
@@ -614,12 +622,13 @@ class CruiseControl:
                 cleaned.append(dataclasses.replace(pr, old_replicas=old))
             fa = np.array(result.final_state.assignment)
             fls = np.array(result.final_state.leader_slot)
+            ptopic = np.array(widened.partition_topic)
             for p, pre in shrink_old.items():  # pure removals
                 new = tuple(int(b) for b in fa[p] if b != EMPTY_SLOT)
                 leader = int(fa[p, fls[p]])
                 cleaned.append(ExecutionProposal(
                     partition=p,
-                    topic=int(np.array(widened.partition_topic)[p]),
+                    topic=int(ptopic[p]),
                     old_leader=leader, new_leader=leader,
                     old_replicas=pre,
                     new_replicas=tuple(

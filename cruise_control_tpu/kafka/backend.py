@@ -37,11 +37,24 @@ class KafkaClusterBackend(ClusterBackend):
         self.progress_check_interval_ms = progress_check_interval_ms
         self._key_of: Dict[TopicPartition, int] = {}
         self._tp_of: List[TopicPartition] = []
+        #: one describe_topics snapshot per progress-check interval — the
+        #: executor reads partition state once per in-flight task per tick,
+        #: which must not become one full-cluster metadata RPC each
+        self._topo: Dict[str, List[dict]] = None
         self.refresh_mapping()
+
+    def _describe(self) -> Dict[str, List[dict]]:
+        if self._topo is None:
+            self._topo = self.wire.describe_topics()
+        return self._topo
+
+    def _dirty(self) -> None:
+        self._topo = None
 
     # ---- id mapping ------------------------------------------------------------
     def refresh_mapping(self) -> None:
-        for topic, rows in sorted(self.wire.describe_topics().items()):
+        self._dirty()
+        for topic, rows in sorted(self._describe().items()):
             for row in rows:
                 tp = (topic, row["partition"])
                 if tp not in self._key_of:
@@ -60,7 +73,7 @@ class KafkaClusterBackend(ClusterBackend):
     @property
     def partitions(self) -> Dict[int, PartitionState]:
         out: Dict[int, PartitionState] = {}
-        for topic, rows in self.wire.describe_topics().items():
+        for topic, rows in self._describe().items():
             for row in rows:
                 k = self.key((topic, row["partition"]))
                 out[k] = PartitionState(
@@ -85,7 +98,7 @@ class KafkaClusterBackend(ClusterBackend):
     def partition_state(self, partition: int) -> PartitionState:
         topic, p = self.tp(partition)
         row = next(
-            r for r in self.wire.describe_topics()[topic]
+            r for r in self._describe()[topic]
             if r["partition"] == p
         )
         return PartitionState(
@@ -96,7 +109,7 @@ class KafkaClusterBackend(ClusterBackend):
 
     def under_replicated_partitions(self) -> Set[int]:
         out = set()
-        for topic, rows in self.wire.describe_topics().items():
+        for topic, rows in self._describe().items():
             for row in rows:
                 if set(row["isr"]) != set(row["replicas"]):
                     out.add(self.key((topic, row["partition"])))
@@ -106,6 +119,7 @@ class KafkaClusterBackend(ClusterBackend):
     def alter_partition_reassignments(
         self, reassignments: Dict[int, Sequence[int]]
     ) -> None:
+        self._dirty()
         self.wire.alter_partition_reassignments(
             {self.tp(k): list(v) for k, v in reassignments.items()}
         )
@@ -128,6 +142,7 @@ class KafkaClusterBackend(ClusterBackend):
         if reorders:
             self.wire.alter_partition_reassignments(reorders)
         self.wire.elect_leaders([self.tp(k) for k in partitions])
+        self._dirty()
 
     def ongoing_reassignments(self) -> Set[int]:
         return {
@@ -136,6 +151,7 @@ class KafkaClusterBackend(ClusterBackend):
         }
 
     def cancel_reassignments(self, partitions: Sequence[int]) -> None:
+        self._dirty()
         self.wire.alter_partition_reassignments(
             {self.tp(k): None for k in partitions}
         )
@@ -150,6 +166,7 @@ class KafkaClusterBackend(ClusterBackend):
             for b, d in by_broker.items():
                 flat[(t, p, b)] = d
         self.wire.alter_replica_log_dirs(flat)
+        self._dirty()
 
     def replica_log_dir(self, partition: int, broker: int) -> Optional[str]:
         t, p = self.tp(partition)
@@ -214,6 +231,7 @@ class KafkaClusterBackend(ClusterBackend):
         Over a scripted wire, advance its clock; over a real cluster, wait
         ``execution.progress.check.interval.ms`` of wall time (upstream's
         metadata poll cadence)."""
+        self._dirty()
         advance = getattr(self.wire, "advance", None)
         if advance is not None:
             advance()

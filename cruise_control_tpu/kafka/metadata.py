@@ -3,43 +3,24 @@
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 from cruise_control_tpu.kafka.backend import KafkaClusterBackend
 from cruise_control_tpu.monitor.load_monitor import (
+    CachingMetadataClient,
     ClusterTopology,
-    MetadataClient,
 )
 
 
-class KafkaMetadataClient(MetadataClient):
+class KafkaMetadataClient(CachingMetadataClient):
     """Builds :class:`ClusterTopology` (dense int partition keys) from the
     backend's live metadata.  Rack strings map to dense rack ids; JBOD dirs
     and offline replicas come from describeLogDirs the way the disk-failure
     detector expects."""
 
     def __init__(self, backend: KafkaClusterBackend, max_age_ms: int = 0):
+        super().__init__(max_age_ms=max_age_ms)
         self.backend = backend
-        self.max_age_ms = max_age_ms
-        self._cached: Optional[ClusterTopology] = None
-        self._cached_at_ms = 0
-
-    def invalidate(self) -> None:
-        self._cached = None
-
-    def refresh(self) -> ClusterTopology:
-        if self.max_age_ms > 0 and self._cached is not None:
-            import time
-
-            if time.time() * 1000 - self._cached_at_ms < self.max_age_ms:
-                return self._cached
-        topo = self._refresh()
-        if self.max_age_ms > 0:
-            import time
-
-            self._cached = topo
-            self._cached_at_ms = int(time.time() * 1000)
-        return topo
 
     def _refresh(self) -> ClusterTopology:
         b = self.backend
@@ -54,14 +35,16 @@ class KafkaMetadataClient(MetadataClient):
         offline_dirs = b.offline_log_dirs()
         replica_dirs = {}
         offline_replicas: Dict[int, list] = {}
-        if offline_dirs:
-            for broker, dirs in b.wire.describe_log_dirs().items():
-                for d, meta in dirs.items():
-                    for tp in meta["replicas"]:
-                        k = b.key(tuple(tp))
-                        replica_dirs[(k, broker)] = d
-                        if meta["offline"]:
-                            offline_replicas.setdefault(k, []).append(broker)
+        # replica->dir mapping must be populated whenever JBOD dirs exist
+        # (healthy clusters included), or intra-broker disk goals see every
+        # replica on an unknown disk until something fails
+        for broker, dirs in b.wire.describe_log_dirs().items():
+            for d, meta in dirs.items():
+                for tp in meta["replicas"]:
+                    k = b.key(tuple(tp))
+                    replica_dirs[(k, broker)] = d
+                    if meta["offline"]:
+                        offline_replicas.setdefault(k, []).append(broker)
         return ClusterTopology(
             assignment={k: list(st.replicas) for k, st in parts.items()},
             leaders={k: st.leader for k, st in parts.items()},

@@ -42,6 +42,9 @@ class _Broker:
     state: BrokerState = BrokerState.ALIVE
     #: JBOD: (name, capacity MB, offline) per disk; empty = no disk modeling
     disks: List[tuple] = dataclasses.field(default_factory=list)
+    #: host id (upstream model/Host.java: rack → host → broker); -1 = the
+    #: broker is its own host
+    host: int = -1
 
 
 @dataclasses.dataclass
@@ -65,30 +68,53 @@ class ClusterModelBuilder:
         self._partition_ids: List[int] = []
         self._topics: Dict[str, int] = {}
         self._racks: Dict[str, int] = {}
+        self._hosts: Dict[str, int] = {}
 
     # ---- topology ---------------------------------------------------------------
     def add_rack(self, name: str) -> int:
         return self._racks.setdefault(name, len(self._racks))
 
+    def add_host(self, name: str) -> int:
+        return self._hosts.setdefault(name, len(self._hosts))
+
     def add_broker(
         self,
-        rack: str | int,
+        rack: str | int | None,
         capacity: Dict[Resource, float] | Sequence[float],
         state: BrokerState = BrokerState.ALIVE,
         broker_id: Optional[int] = None,
         disks: Optional[Sequence[tuple]] = None,
+        host: str | int | None = None,
     ) -> int:
         """``broker_id`` is the *external* (Kafka) id; defaults to the dense
         internal index.  ``disks`` (JBOD): sequence of ``(name, capacity_mb)``
-        or ``(name, capacity_mb, offline)``.  Returns the internal index."""
-        rack_id = self.add_rack(rack) if isinstance(rack, str) else int(rack)
+        or ``(name, capacity_mb, offline)``.  ``host`` places the broker on
+        a physical host (upstream rack → host → broker topology,
+        ``model/Host.java``); when ``rack`` is None the host stands in as
+        the rack — upstream's exact fallback, so co-hosted brokers without
+        rack info never share a partition's replicas.  Returns the internal
+        index."""
+        if rack is None:
+            if host is None:
+                raise ValueError("add_broker needs a rack or a host")
+            rack_id = self.add_rack(f"host:{host}")
+        else:
+            rack_id = (
+                self.add_rack(rack) if isinstance(rack, str) else int(rack)
+            )
+        host_id = -1
+        if host is not None:
+            host_id = (
+                self.add_host(host) if isinstance(host, str) else int(host)
+            )
         internal = len(self._brokers)
         disk_list = [
             (d[0], float(d[1]), bool(d[2]) if len(d) > 2 else False)
             for d in (disks or [])
         ]
         self._brokers.append(
-            _Broker(rack_id, _resource_vec(capacity), state, disk_list)
+            _Broker(rack_id, _resource_vec(capacity), state, disk_list,
+                    host=host_id)
         )
         self._broker_ids.append(internal if broker_id is None else int(broker_id))
         return internal
@@ -232,6 +258,10 @@ class ClusterModelBuilder:
             ),
             broker_state=np.asarray(
                 np.array([int(b.state) for b in self._brokers], np.int8)
+            ),
+            broker_host=(
+                np.array([b.host for b in self._brokers], np.int32)
+                if any(b.host >= 0 for b in self._brokers) else None
             ),
             replica_offline=np.asarray(offline),
             num_topics=max(len(self._topics), 1),

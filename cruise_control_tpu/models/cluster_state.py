@@ -66,6 +66,13 @@ class ClusterState:
     broker_rack: jax.Array     # int32 [B]
     broker_state: jax.Array    # int8  [B]
     replica_offline: jax.Array # bool  [P, S]
+    #: int32 [B] physical host per broker (upstream model/Host.java: the
+    #: rack → host → broker level); None = one broker per host.  When a
+    #: broker has no rack info the builder substitutes its host as the
+    #: rack (upstream's fallback), so rack-aware goals already enforce
+    #: host-disjoint placement for rackless topologies; host ids here keep
+    #: the level addressable for stats and host-scoped operations.
+    broker_host: Optional[jax.Array] = None
     num_topics: int = struct.field(pytree_node=False, default=0)
     #: External (Kafka) broker id per internal index; () = identity.  Kafka
     #: broker ids need not be contiguous (e.g. 1001..1050), but every tensor

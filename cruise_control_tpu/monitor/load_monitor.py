@@ -119,6 +119,35 @@ class MetadataClient:
         raise NotImplementedError
 
 
+class CachingMetadataClient(MetadataClient):
+    """Shared metadata.max.age.ms caching: subclasses implement
+    ``_refresh()``; ``invalidate()`` drops the cache (the facade calls it
+    after every execution so post-move reads see the new placement)."""
+
+    def __init__(self, max_age_ms: int = 0):
+        self.max_age_ms = max_age_ms
+        self._cached: Optional[ClusterTopology] = None
+        self._cached_at_ms = 0
+
+    def invalidate(self) -> None:
+        self._cached = None
+
+    def refresh(self) -> ClusterTopology:
+        import time as _time
+
+        if self.max_age_ms > 0 and self._cached is not None:
+            if _time.time() * 1000 - self._cached_at_ms < self.max_age_ms:
+                return self._cached
+        topo = self._refresh()
+        if self.max_age_ms > 0:
+            self._cached = topo
+            self._cached_at_ms = int(_time.time() * 1000)
+        return topo
+
+    def _refresh(self) -> ClusterTopology:
+        raise NotImplementedError
+
+
 class StaticMetadataClient(MetadataClient):
     def __init__(self, topology: ClusterTopology):
         self.topology = topology
@@ -127,41 +156,17 @@ class StaticMetadataClient(MetadataClient):
         return self.topology
 
 
-class BackendMetadataClient(MetadataClient):
+class BackendMetadataClient(CachingMetadataClient):
     """Reads topology straight from a cluster backend (the simulated cluster
     or a real admin adapter), so monitor and executor see one world."""
 
     def __init__(self, backend, broker_rack: Dict[int, int],
                  partition_topic: Optional[Dict[int, str]] = None,
                  max_age_ms: int = 0):
+        super().__init__(max_age_ms=max_age_ms)
         self.backend = backend
         self.broker_rack = broker_rack
         self.partition_topic = partition_topic or {}
-        #: metadata.max.age.ms: cache refresh() results this long (0 = no
-        #: caching — every call hits the backend)
-        self.max_age_ms = max_age_ms
-        self._cached: Optional[ClusterTopology] = None
-        self._cached_at_ms = 0
-
-    def invalidate(self) -> None:
-        """Drop the cached topology (the facade calls this after every
-        execution — post-move reads must see the new placement, upstream
-        metadata refresh-on-change)."""
-        self._cached = None
-
-    def refresh(self) -> ClusterTopology:
-        if self.max_age_ms > 0 and self._cached is not None:
-            import time as _time
-
-            if _time.time() * 1000 - self._cached_at_ms < self.max_age_ms:
-                return self._cached
-        topo = self._refresh()
-        if self.max_age_ms > 0:
-            import time as _time
-
-            self._cached = topo
-            self._cached_at_ms = int(_time.time() * 1000)
-        return topo
 
     def _refresh(self) -> ClusterTopology:
         assignment = {

@@ -185,7 +185,9 @@ class CruiseControlHttpServer:
                 ui = ui / "index.html"
         else:
             ui = pathlib.Path(__file__).with_name("ui.html")
-        body = ui.read_bytes()
+        body = ui.read_bytes().replace(
+            b"__API_PREFIX__", self.prefix.encode()
+        )
         handler.send_response(200)
         handler.send_header("Content-Type", "text/html; charset=utf-8")
         handler.send_header("Content-Length", str(len(body)))

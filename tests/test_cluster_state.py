@@ -189,3 +189,41 @@ def test_stats_exclude_dead_brokers(small):
     np.testing.assert_allclose(
         np.asarray(stats.resource_mean), bl[:2].mean(0), rtol=1e-5
     )
+
+
+def test_host_level_topology():
+    """Upstream rack -> host -> broker (model/Host.java): hosts are
+    addressable on the model, and for rackless brokers the host stands in
+    as the rack so co-hosted brokers never share a partition's replicas."""
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.models.builder import ClusterModelBuilder
+
+    cap = {r: 1e6 for r in Resource}
+    b = ClusterModelBuilder()
+    b.add_broker(None, cap, host="h0")
+    b.add_broker(None, cap, host="h0")   # co-hosted with broker 0
+    b.add_broker(None, cap, host="h1")
+    b.add_partition("T", [0, 2], {Resource.DISK: 1.0})
+    state = b.build()
+    assert state.broker_host is not None
+    hosts = list(np.asarray(state.broker_host))
+    assert hosts[0] == hosts[1] != hosts[2]
+    # host-as-rack fallback: co-hosted brokers share a rack id
+    racks = list(np.asarray(state.broker_rack))
+    assert racks[0] == racks[1] != racks[2]
+
+    # rack-aware placement therefore refuses the co-hosted pair
+    from cruise_control_tpu.analyzer.context import AnalyzerContext
+    from cruise_control_tpu.analyzer.goals.rack import RackAwareGoal
+
+    ctx = AnalyzerContext(state)
+    ok = RackAwareGoal().accept_move(ctx, 0, 1)  # move T's replica on b2
+    assert not ok[1]   # broker 1 shares broker 0's host
+    # explicit rack + host coexist: rack wins for placement, host recorded
+    b2 = ClusterModelBuilder()
+    b2.add_broker("r0", cap, host="hA")
+    b2.add_broker("r1", cap, host="hA")
+    s2 = b2.add_partition("T", [0, 1], {Resource.DISK: 1.0})
+    st2 = b2.build()
+    assert list(np.asarray(st2.broker_rack)) == [0, 1]
+    assert list(np.asarray(st2.broker_host)) == [0, 0]

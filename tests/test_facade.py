@@ -312,3 +312,45 @@ def test_rf_decrease_emits_removal_proposals():
     assert result.execution is not None and result.execution.succeeded
     for p, st in backend.partitions.items():
         assert len(set(st.replicas)) == 1, (p, st)
+
+
+def test_rf_decrease_keeps_data_hosting_rack_diverse_replicas():
+    """Code-review regression: the keep-selection must update its rack set
+    live — duplicate-rack followers are dropped before rack-distinct ones,
+    so an RF decrease never forces a data copy to a fresh broker."""
+    import contextlib
+
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.models.builder import ClusterModelBuilder
+
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 1e4, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+           Resource.DISK: 1e4}
+    for r in ("r0", "r1", "r1", "r2", "r2"):
+        b.add_broker(r, cap)
+    # leader on r0; followers r1, r1, r2 — RF 4 -> 3 must drop one of the
+    # r1 twins and KEEP broker 3 (r2), never re-copy onto broker 4
+    b.add_partition("T", [0, 1, 2, 3], {Resource.DISK: 10.0})
+    state = b.build()
+
+    class StubMonitor:
+        metadata = object()
+
+        def acquire_for_model_generation(self):
+            return contextlib.nullcontext()
+
+        def cluster_model(self, requirements=None):
+            return state
+
+    backend = SimulatedClusterBackend({0: [0, 1, 2, 3]}, {0: 0})
+    cc = CruiseControl(StubMonitor(), Executor(backend))
+    result = cc.fix_topic_replication_factor(3, dryrun=True)
+    (pr,) = result.proposals
+    assert set(pr.old_replicas) == {0, 1, 2, 3}
+    kept = set(pr.new_replicas)
+    assert 0 in kept and 3 in kept          # leader + the rack-distinct r2
+    assert len(kept & {1, 2}) == 1          # exactly one r1 twin dropped
+    assert 4 not in kept                    # no data copy to a fresh broker

@@ -258,7 +258,7 @@ def test_host_device_cost_parity():
         assert abs(dev - host) <= 1e-3 * max(1.0, abs(dev)), (b, dev, host)
 
 
-@pytest.mark.parametrize("scoring", ["columnar", "grid", "pallas"])
+@pytest.mark.parametrize("scoring", ["columnar", "grid"])
 def test_engine_scoring_paths_agree(scoring):
     """All three scoring paths must produce verifiable plans of equal quality
     (same scores → same committed actions, modulo f32 tie-breaks)."""
