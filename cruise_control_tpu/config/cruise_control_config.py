@@ -40,6 +40,15 @@ def at_least(lo: float) -> Callable[[str, Any], None]:
     return check
 
 
+def one_of(*allowed: str) -> Callable[[str, Any], None]:
+    def check(name: str, v: Any) -> None:
+        if v not in allowed:
+            raise ConfigException(
+                f"{name}={v!r} must be one of {sorted(allowed)}"
+            )
+    return check
+
+
 def between(lo: float, hi: float) -> Callable[[str, Any], None]:
     def check(name: str, v: Any) -> None:
         if not (lo <= v <= hi):
@@ -650,7 +659,7 @@ def default_config_def() -> ConfigDef:
              at_least(0), G)
     d.define("tpu.search.scoring", ConfigType.STRING, "auto",
              Importance.LOW, "Move scorer: auto/grid/columnar.",
-             None, G)
+             one_of("auto", "grid", "columnar"), G)
     d.define("tpu.search.steps.per.call", ConfigType.INT, 512,
              Importance.MEDIUM, "Device-resident steps per call (0 = "
              "score-only rounds).", at_least(0), G)

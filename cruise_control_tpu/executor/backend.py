@@ -210,8 +210,19 @@ class SimulatedClusterBackend(ClusterBackend):
         return set(self._target)
 
     def cancel_reassignments(self, partitions: Sequence[int]) -> None:
+        # Kafka cancellation reverts the in-flight adds (adding replicas
+        # leave the replica set); dropped-replica removal never happened
+        # yet, so the original set is restored
         for p in list(partitions):
-            self._target.pop(p, None)
+            tgt = self._target.pop(p, None)
+            self._progress.pop(p, None)
+            if tgt is None:
+                continue
+            st = self.partitions[p]
+            st.replicas = [b for b in st.replicas if b not in st.catching_up]
+            st.catching_up.clear()
+            if st.leader not in st.replicas and st.replicas:
+                st.leader = st.replicas[0]
 
     def partition_state(self, partition: int) -> PartitionState:
         return self.partitions[partition]

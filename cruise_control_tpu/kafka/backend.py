@@ -40,7 +40,7 @@ class KafkaClusterBackend(ClusterBackend):
         #: one describe_topics snapshot per progress-check interval — the
         #: executor reads partition state once per in-flight task per tick,
         #: which must not become one full-cluster metadata RPC each
-        self._topo: Dict[str, List[dict]] = None
+        self._topo: Optional[Dict[str, List[dict]]] = None
         self.refresh_mapping()
 
     def _describe(self) -> Dict[str, List[dict]]:

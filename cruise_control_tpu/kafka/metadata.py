@@ -32,13 +32,19 @@ class KafkaMetadataClient(CachingMetadataClient):
             broker: rack_ids.setdefault(r, len(rack_ids))
             for broker, r in sorted(racks.items())
         }
-        offline_dirs = b.offline_log_dirs()
+        # one describeLogDirs serves the whole refresh: the replica->dir
+        # mapping (needed on healthy JBOD clusters too, or intra-broker
+        # disk goals see every replica on an unknown disk), the offline-dir
+        # map, and the offline-replica set
+        log_dirs = b.wire.describe_log_dirs()
+        offline_dirs = {
+            broker: [d for d, meta in dirs.items() if meta["offline"]]
+            for broker, dirs in log_dirs.items()
+            if any(meta["offline"] for meta in dirs.values())
+        }
         replica_dirs = {}
         offline_replicas: Dict[int, list] = {}
-        # replica->dir mapping must be populated whenever JBOD dirs exist
-        # (healthy clusters included), or intra-broker disk goals see every
-        # replica on an unknown disk until something fails
-        for broker, dirs in b.wire.describe_log_dirs().items():
+        for broker, dirs in log_dirs.items():
             for d, meta in dirs.items():
                 for tp in meta["replicas"]:
                     k = b.key(tuple(tp))

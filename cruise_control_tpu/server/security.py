@@ -39,7 +39,11 @@ class BasicSecurityProvider(SecurityProvider):
             user, _, password = decoded.partition(":")
         except Exception:
             return False
-        return self.users.get(user) == password
+        # constant-time compare; unknown users burn the same comparison so
+        # user enumeration by timing stays closed
+        expected = self.users.get(user, "")
+        return hmac.compare_digest(expected.encode(), password.encode()) \
+            and user in self.users
 
     def authenticate_request(self, headers, client_address) -> bool:
         return self.authenticate(headers.get("Authorization"))

@@ -21,6 +21,7 @@ from cruise_control_tpu.executor.tasks import (
     ExecutionTaskPlanner,
     PostponeUrpReplicaMovementStrategy,
     PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeMinIsrWithOfflineReplicasStrategy,
     PrioritizeSmallReplicaMovementStrategy,
     TaskState,
     TaskType,
@@ -463,3 +464,87 @@ def test_executor_scales_to_large_plans():
     dt = time.perf_counter() - t0
     assert result.completed == len(props)
     assert dt < 30.0, f"executor took {dt:.1f}s for {len(props)} proposals"
+
+
+def test_broker_death_mid_execution_kills_tasks_then_self_heals():
+    """Soak: a destination broker dies MID-execution — its in-flight moves
+    go DEAD (not silently complete), the broker-failure detector sees the
+    death, and self-healing evacuates the broker end-to-end."""
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    from cruise_control_tpu.detector.manager import make_detector_manager
+    from tests.harness import full_stack
+    from tests.test_detector import healing_notifier
+
+    cc, backend, reporter = full_stack(
+        num_partitions=12, num_brokers=4, rf=2, extra_brokers=(4,),
+    )
+    # retarget the backend's moves to take a while, and kill broker 3 two
+    # ticks in: moves landing on 3 must die, the rest complete
+    backend.move_latency_ticks = 4
+    backend.kill_broker = 3
+    backend.kill_at_tick = 2
+    orig_tick = SimulatedClusterBackend.tick
+
+    def tick(self):
+        orig_tick(self)
+        if self.ticks == self.kill_at_tick:
+            self.failed_brokers.add(self.kill_broker)
+    backend.tick = tick.__get__(backend)
+    cc.executor.config.task_timeout_ticks = 6
+
+    proposals = [
+        # one move INTO the doomed broker, one into a healthy one
+        ExecutionProposal(0, 0, 0, 0, tuple(backend.partitions[0].replicas),
+                          (backend.partitions[0].replicas[0], 3)),
+        ExecutionProposal(1, 0, 1, 1, tuple(backend.partitions[1].replicas),
+                          (backend.partitions[1].replicas[0], 4)),
+    ]
+    result = cc.executor.execute_proposals(proposals, max_ticks=60)
+    assert result.dead == 1 and result.completed >= 1, result
+    assert 3 not in backend.partitions[1].replicas
+
+    # upstream semantics: a DEAD task's reassignment stays in flight on
+    # the cluster; cancel it (the stop/admin path) so the healing replan
+    # starts from a settled placement
+    backend.cancel_reassignments(list(backend.ongoing_reassignments()))
+    # detector sees the death and self-healing evacuates broker 3
+    mgr = make_detector_manager(
+        cc, backend=backend,
+        notifier=healing_notifier(broker_failure=True),
+    )
+    from tests.harness import WINDOW
+    reporter.report(time_ms=4 * WINDOW + 500)
+    cc.load_monitor.run_sampling_iteration(5 * WINDOW)
+    handled = mgr.run_detection_cycle(now_ms=10)
+    assert any(a.anomaly_type == AnomalyType.BROKER_FAILURE for a in handled)
+    for p, st in backend.partitions.items():
+        assert 3 not in st.replicas, (p, st)
+
+
+def test_min_isr_strategy_prioritizes_urp_fixes_end_to_end():
+    """PrioritizeMinIsrWithOfflineReplicas orders under-replicated fixes
+    first through the live planner (not just the sort key)."""
+    backend = SimulatedClusterBackend(
+        {p: [p % 3, (p + 1) % 3] for p in range(6)},
+        {p: p % 3 for p in range(6)},
+        brokers={0, 1, 2, 3},
+    )
+    # partition 5 is under-replicated (catching up)
+    backend.partitions[5].catching_up.add((5 + 1) % 3)
+    ex = Executor(backend, ExecutorConfig(
+        num_concurrent_partition_movements_per_broker=1,
+    ), default_strategy=PrioritizeMinIsrWithOfflineReplicasStrategy())
+    proposals = [
+        ExecutionProposal(p, 0, p % 3, p % 3,
+                          tuple(backend.partitions[p].replicas),
+                          (p % 3, 3))
+        for p in (2, 5)
+    ]
+    planner = ExecutionTaskPlanner(ex.default_strategy)
+    planner.add_proposals(proposals)
+    ordered = planner.strategy.order(
+        planner.replica_tasks, {}, backend.under_replicated_partitions()
+    )
+    assert ordered[0].proposal.partition == 5  # URP fix first
+    result = ex.execute_proposals(proposals, max_ticks=60)
+    assert result.succeeded
