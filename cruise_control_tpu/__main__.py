@@ -35,17 +35,18 @@ def main(argv=None) -> int:
         interval_s=app.config.get("proposal.precompute.interval.ms") / 1000,
         engine=app.config.get("proposal.precompute.engine"),
     )
-    # the simulated brokers report on the sampling cadence (a real cluster's
-    # reporters push to __CruiseControlMetrics on their own schedule)
+    # the simulated brokers report on the sampling cadence (a real
+    # cluster's broker-side reporters push to __CruiseControlMetrics on
+    # their own schedule — no loop needed in Kafka mode)
     stop = threading.Event()
+    if app.reporter is not None:
+        def report_loop() -> None:
+            interval = app.config.get("metric.sampling.interval.ms") / 1000
+            while not stop.wait(min(interval, 5.0)):
+                app.reporter.report(time_ms=int(time.time() * 1000))
 
-    def report_loop() -> None:
-        interval = app.config.get("metric.sampling.interval.ms") / 1000
-        while not stop.wait(min(interval, 5.0)):
-            app.reporter.report(time_ms=int(time.time() * 1000))
-
-    threading.Thread(target=report_loop, daemon=True,
-                     name="simulated-reporters").start()
+        threading.Thread(target=report_loop, daemon=True,
+                         name="simulated-reporters").start()
 
     print(f"cruise-control listening on {app.server.url} (UI at /ui)")
     done = threading.Event()

@@ -54,8 +54,11 @@ class App:
     """Everything ``main`` starts; ``shutdown`` stops it in reverse order."""
 
     config: CruiseControlConfig
-    backend: SimulatedClusterBackend
-    reporter: SimulatedMetricsReporter
+    #: SimulatedClusterBackend or kafka.KafkaClusterBackend
+    backend: object
+    #: the simulated in-process reporter; None in Kafka mode (real brokers
+    #: run the reporter plugin themselves)
+    reporter: Optional[SimulatedMetricsReporter]
     cruise_control: CruiseControl
     fetcher_manager: MetricFetcherManager
     server: CruiseControlHttpServer
@@ -294,28 +297,48 @@ def _capacity_for(w: WorkloadModel, num_brokers: int,
 def build_app(
     config: Optional[CruiseControlConfig] = None,
     port: Optional[int] = None,
+    kafka_wire=None,
 ) -> App:
+    """Assemble the server.
+
+    ``bootstrap.servers`` set (or an explicit ``kafka_wire``) boots the
+    real-Kafka stack from ``cruise_control_tpu.kafka``; otherwise the
+    deterministic simulated cluster (``simulation.*`` keys) is managed.
+    ``kafka_wire`` injects a wire (e.g. the scripted FakeKafkaWire) in
+    place of dialing ``bootstrap.servers`` — the test seam.
+    """
     cfg = config or CruiseControlConfig()
-    workload, brokers = _synthetic_workload(cfg)
-    backend = SimulatedClusterBackend(
-        workload.assignment, workload.leaders, brokers=brokers
-    )
-    topic = MetricsTopic(name=cfg.get("metric.reporter.topic"))
-    reporter = SimulatedMetricsReporter(
-        workload, topic,
-        noise_std=cfg.get_double("simulation.workload.noise.std"),
-        seed=cfg.get_int("simulation.seed"),
-    )
-    num_racks = cfg.get_int("simulation.num.racks")
-    num_topics = cfg.get_int("simulation.num.topics")
-    metadata = BackendMetadataClient(
-        backend,
-        broker_rack={b: f"rack_{b % num_racks}" for b in brokers},
-        partition_topic={
-            p: f"topic_{p % num_topics}" for p in workload.assignment
-        },
-        max_age_ms=cfg.get_int("metadata.max.age.ms"),
-    )
+    kafka_mode = kafka_wire is not None or bool(cfg.get("bootstrap.servers"))
+    if kafka_mode:
+        from cruise_control_tpu.kafka import build_kafka_stack
+
+        backend, metadata, kafka_sampler, kafka_store = build_kafka_stack(
+            cfg, wire=kafka_wire
+        )
+        topic = None
+        reporter = None
+        workload = None
+    else:
+        workload, brokers = _synthetic_workload(cfg)
+        backend = SimulatedClusterBackend(
+            workload.assignment, workload.leaders, brokers=brokers
+        )
+        topic = MetricsTopic(name=cfg.get("metric.reporter.topic"))
+        reporter = SimulatedMetricsReporter(
+            workload, topic,
+            noise_std=cfg.get_double("simulation.workload.noise.std"),
+            seed=cfg.get_int("simulation.seed"),
+        )
+        num_racks = cfg.get_int("simulation.num.racks")
+        num_topics = cfg.get_int("simulation.num.topics")
+        metadata = BackendMetadataClient(
+            backend,
+            broker_rack={b: f"rack_{b % num_racks}" for b in brokers},
+            partition_topic={
+                p: f"topic_{p % num_topics}" for p in workload.assignment
+            },
+            max_age_ms=cfg.get_int("metadata.max.age.ms"),
+        )
     capacity_file = cfg.get("capacity.config.file")
     if capacity_file:
         from cruise_control_tpu.monitor.capacity import (
@@ -323,6 +346,15 @@ def build_app(
         )
 
         capacity_resolver = BrokerCapacityConfigFileResolver(capacity_file)
+    elif kafka_mode:
+        from cruise_control_tpu.config.cruise_control_config import (
+            ConfigException,
+        )
+
+        raise ConfigException(
+            "capacity.config.file is required for a Kafka deployment "
+            "(broker capacities cannot be derived from a live cluster)"
+        )
     else:
         # no file configured: size capacities so the simulated cluster is
         # feasible by construction
@@ -338,10 +370,13 @@ def build_app(
         sample_store = cfg.get_configured_instance(
             "sample.store.class", store_path
         )
+    elif kafka_mode:
+        # default persistence on Kafka: the compacted sample-store topics
+        sample_store = kafka_store
     window_ms = cfg.get("partition.metrics.window.ms")
     monitor = LoadMonitor(
         metadata,
-        _make_sampler(cfg, topic),
+        kafka_sampler if kafka_mode else _make_sampler(cfg, topic),
         capacity_resolver=capacity_resolver,
         sample_store=sample_store,
         window_ms=window_ms,

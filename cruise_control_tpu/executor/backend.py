@@ -125,7 +125,7 @@ class SimulatedClusterBackend(ClusterBackend):
         self.move_latency_ticks = move_latency_ticks
         self.failed_brokers = failed_brokers or set()
         self.fail_partitions = fail_partitions or set()
-        self._target: Dict[int, Tuple[List[int], List[int]]] = {}  # p -> (new, old)
+        self._target: Dict[int, Tuple[List[int], List[int], List[int]]] = {}  # p -> (new, old, adds)
         self._progress: Dict[int, int] = {}
         self.throttle_rate: Optional[float] = None
         self.throttled_partitions: Set[int] = set()
@@ -182,7 +182,9 @@ class SimulatedClusterBackend(ClusterBackend):
             adds = [b for b in new if b not in st.replicas]
             st.replicas = list(dict.fromkeys(st.replicas + adds))
             st.catching_up.update(adds)
-            self._target[p] = (new, [b for b in st.replicas if b not in new])
+            self._target[p] = (
+                new, [b for b in st.replicas if b not in new], adds
+            )
             self._progress[p] = 0
 
     def elect_leaders(self, partitions: Dict[int, int]) -> None:
@@ -218,9 +220,13 @@ class SimulatedClusterBackend(ClusterBackend):
             self._progress.pop(p, None)
             if tgt is None:
                 continue
+            _, _, adds = tgt
             st = self.partitions[p]
-            st.replicas = [b for b in st.replicas if b not in st.catching_up]
-            st.catching_up.clear()
+            # strip only the replicas THIS reassignment added — an
+            # originally-assigned replica that happens to lag keeps its
+            # membership and its catching-up (URP) status
+            st.replicas = [b for b in st.replicas if b not in adds]
+            st.catching_up -= set(adds)
             if st.leader not in st.replicas and st.replicas:
                 st.leader = st.replicas[0]
 
@@ -262,7 +268,7 @@ class SimulatedClusterBackend(ClusterBackend):
     def tick(self) -> None:
         self.ticks += 1
         done: List[int] = []
-        for p, (new, dropped) in self._target.items():
+        for p, (new, dropped, _adds) in self._target.items():
             st = self.partitions[p]
             blocked = any(b in self.failed_brokers for b in st.catching_up)
             if blocked:

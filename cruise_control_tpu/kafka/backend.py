@@ -175,10 +175,16 @@ class KafkaClusterBackend(ClusterBackend):
                 return d
         return None
 
-    def offline_log_dirs(self) -> Dict[int, List[str]]:
+    def offline_log_dirs(
+        self, log_dirs: Optional[Dict[int, Dict[str, dict]]] = None
+    ) -> Dict[int, List[str]]:
+        dirs_by_broker = (
+            log_dirs if log_dirs is not None
+            else self.wire.describe_log_dirs()
+        )
         return {
             b: [d for d, meta in dirs.items() if meta["offline"]]
-            for b, dirs in self.wire.describe_log_dirs().items()
+            for b, dirs in dirs_by_broker.items()
             if any(meta["offline"] for meta in dirs.values())
         }
 

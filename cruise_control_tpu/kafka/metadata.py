@@ -37,11 +37,7 @@ class KafkaMetadataClient(CachingMetadataClient):
         # disk goals see every replica on an unknown disk), the offline-dir
         # map, and the offline-replica set
         log_dirs = b.wire.describe_log_dirs()
-        offline_dirs = {
-            broker: [d for d, meta in dirs.items() if meta["offline"]]
-            for broker, dirs in log_dirs.items()
-            if any(meta["offline"] for meta in dirs.values())
-        }
+        offline_dirs = b.offline_log_dirs(log_dirs)
         replica_dirs = {}
         offline_replicas: Dict[int, list] = {}
         for broker, dirs in log_dirs.items():

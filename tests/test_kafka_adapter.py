@@ -241,3 +241,84 @@ def test_end_to_end_rebalance_over_fake_kafka():
     )
     assert moved > 0
     assert backend.ongoing_reassignments() == set()
+
+
+def test_build_app_boots_on_kafka_stack(tmp_path):
+    """bootstrap.servers / an injected wire switches the WHOLE server onto
+    the Kafka stack: metadata, sampler, and sample store come from the
+    wire, and a REST-path rebalance lands its plan back on the wire."""
+    import json
+    import urllib.request
+
+    from cruise_control_tpu.bootstrap import build_app
+    from cruise_control_tpu.config.cruise_control_config import (
+        ConfigException,
+        CruiseControlConfig,
+    )
+
+    P, B = 24, 4
+    wire = FakeKafkaWire(
+        assignment={("t0", p): [p % B, (p + 1) % B] for p in range(P)},
+        broker_racks={b: f"rack_{b % 2}" for b in range(B)},
+    )
+    cap_file = tmp_path / "capacity.json"
+    cap_file.write_text(json.dumps({
+        "brokerCapacities": [{
+            "brokerId": "-1", "capacity": {
+                "CPU": "1000", "DISK": "100000",
+                "NW_IN": "100000", "NW_OUT": "100000"},
+        }],
+    }))
+    # capacity file is mandatory on Kafka
+    with pytest.raises(ConfigException, match="capacity.config.file"):
+        build_app(CruiseControlConfig({}), port=0, kafka_wire=wire)
+
+    cfg = CruiseControlConfig({
+        "capacity.config.file": str(cap_file),
+        "use.tpu.optimizer": "false",
+    })
+    app = build_app(cfg, port=0, kafka_wire=wire)
+    try:
+        assert app.reporter is None            # real brokers report
+        assert isinstance(app.backend, KafkaClusterBackend)
+        # broker-side reporter twin feeds the wire topic; monitor samples it
+        reporter = KafkaMetricsReporter(wire)
+        records = []
+        for p in range(P):
+            records += [
+                CruiseControlMetric(RawMetricType.PARTITION_BYTES_IN, 500,
+                                    p % B, 200.0 if p % B == 0 else 20.0,
+                                    partition=p),
+                CruiseControlMetric(RawMetricType.PARTITION_BYTES_OUT, 500,
+                                    p % B, 50.0, partition=p),
+                CruiseControlMetric(RawMetricType.PARTITION_SIZE, 500,
+                                    p % B, 500.0, partition=p),
+            ]
+        reporter.report(records)
+        app.cruise_control.load_monitor.run_sampling_iteration(3_600_000)
+        app.server.start()
+        req = urllib.request.Request(
+            app.server.url + "/rebalance?dryrun=false", method="POST")
+        tid = urllib.request.urlopen(req).headers["User-Task-ID"]
+        import time as _t
+        for _ in range(120):
+            body = json.loads(urllib.request.urlopen(
+                app.server.url + "/user_tasks").read())
+            mine = [t for t in body["userTasks"]
+                    if t["UserTaskId"] == tid]
+            if mine and mine[0]["Status"] != "Active":
+                break
+            _t.sleep(0.25)
+        assert mine and mine[0]["Status"] == "Completed", mine
+        # the plan LANDED ON THE WIRE
+        moved = sum(
+            1 for p in range(P)
+            if sorted(app.backend.partition_state(p).replicas)
+            != sorted([p % B, (p + 1) % B])
+        )
+        assert moved > 0
+        # samples persisted to the wire-backed store topics
+        assert wire.logs.get(
+            "__KafkaCruiseControlPartitionMetricSamples")
+    finally:
+        app.shutdown()
