@@ -34,6 +34,9 @@ from cruise_control_tpu.monitor.sampling import (
 )
 from cruise_control_tpu.server.http_server import CruiseControlHttpServer
 from cruise_control_tpu.server.user_tasks import UserTaskManager
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("bootstrap")
 
 
 def load_properties(path: str) -> Dict[str, str]:
@@ -310,10 +313,13 @@ def build_app(
     cfg = config or CruiseControlConfig()
     kafka_mode = kafka_wire is not None or bool(cfg.get("bootstrap.servers"))
     if kafka_mode:
-        from cruise_control_tpu.kafka import build_kafka_stack
+        from cruise_control_tpu.kafka import (
+            KafkaMetricsReporterSampler,
+            build_kafka_stack,
+        )
 
-        backend, metadata, kafka_sampler, kafka_store = build_kafka_stack(
-            cfg, wire=kafka_wire
+        backend, metadata, kafka_sampler, kafka_store, kafka_wire = (
+            build_kafka_stack(cfg, wire=kafka_wire)
         )
         topic = None
         reporter = None
@@ -367,8 +373,26 @@ def build_app(
     sample_store = None
     store_path = cfg.get("sample.store.path")
     if store_path:
+        import inspect
+
+        from cruise_control_tpu.config.cruise_control_config import (
+            resolve_class,
+        )
+
+        store_params = inspect.signature(
+            resolve_class(cfg.get("sample.store.class")).__init__
+        ).parameters
+        store_kwargs = {}
+        # custom stores may predate the loading_threads contract
+        if "loading_threads" in store_params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in store_params.values()
+        ):
+            store_kwargs["loading_threads"] = cfg.get_int(
+                "num.sample.loading.threads"
+            )
         sample_store = cfg.get_configured_instance(
-            "sample.store.class", store_path
+            "sample.store.class", store_path, **store_kwargs
         )
     elif kafka_mode:
         # default persistence on Kafka: the compacted sample-store topics
@@ -468,15 +492,32 @@ def build_app(
         default_goal_names=cfg.get_list("default.goals"),
         hard_goal_names=cfg.get_list("hard.goals"),
     )
+    if kafka_mode and cfg.get_int("num.metric.fetchers") > 1:
+        # each per-fetcher consumer reads the WHOLE reporter topic (the
+        # wire seam has no partition-scoped consume), so N fetchers
+        # multiply broker-side consumer load for wall-clock overlap only
+        LOG.warning(
+            "num.metric.fetchers=%d on the Kafka stack: each fetcher "
+            "consumes the full %s topic (N× broker read load); consider 1",
+            cfg.get_int("num.metric.fetchers"),
+            cfg.get("metric.reporter.topic"),
+        )
     fetchers = MetricFetcherManager(
         monitor,
         sampling_interval_ms=cfg.get("metric.sampling.interval.ms"),
         num_fetchers=cfg.get_int("num.metric.fetchers"),
         # each fetcher needs its own sampler (offset cursor); without a
-        # factory the manager silently collapses to one fetcher
+        # factory the manager silently collapses to one fetcher.  In Kafka
+        # mode the per-fetcher sampler is a reporter-topic consumer over the
+        # shared wire (each with its own offset cursor), NOT _make_sampler —
+        # there is no in-process MetricsTopic to read.
         sampler_factory=(
-            (lambda: _make_sampler(cfg, topic))
-            if cfg.get_int("num.metric.fetchers") > 1 else None
+            None if cfg.get_int("num.metric.fetchers") <= 1
+            else (
+                (lambda: KafkaMetricsReporterSampler(
+                    kafka_wire, topic=cfg.get("metric.reporter.topic")))
+                if kafka_mode else (lambda: _make_sampler(cfg, topic))
+            )
         ),
         assignor=cfg.get_configured_instance(
             "metric.sampler.partition.assignor.class"

@@ -269,7 +269,9 @@ def default_config_def() -> ConfigDef:
              Importance.LOW, "RF for auto-created sample-store topics.",
              at_least(1), G)
     d.define("num.sample.loading.threads", ConfigType.INT, 8,
-             Importance.LOW, "Parallelism for sample-store replay.",
+             Importance.LOW,
+             "Parallelism for sample-store replay; capped by the number of "
+             "independent sample streams (2: partition + broker).",
              at_least(1), G)
     d.define("metric.sampling.interval.ms", ConfigType.LONG, 120_000,
              Importance.HIGH, "Interval between metric sampling runs.",

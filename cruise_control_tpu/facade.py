@@ -122,7 +122,11 @@ class CruiseControl:
             k: v for k, v in self.constraint.broker_sets.items()
             if not isinstance(k, str)
         }
-        self.constraint.broker_sets = dict(self._broker_sets_static)
+        # keep the caller-supplied constraint intact (it may be shared);
+        # this instance works on a copy holding only the static part
+        self.constraint = dataclasses.replace(
+            self.constraint, broker_sets=dict(self._broker_sets_static)
+        )
         self.anomaly_detector = None  # attached by AnomalyDetectorManager
         self.proposal_precomputer = None  # started on demand (§3.5)
         self._start_time = time.time()
@@ -497,10 +501,19 @@ class CruiseControl:
         state = self._model(None, progress)
         pat = re.compile(topic_regex) if topic_regex else None
         topic_ok = np.ones(max(state.num_topics, 1), bool)
-        if pat is not None and state.topic_names:
+        if pat is not None:
+            if not state.topic_names:
+                raise ValueError(
+                    "topic_regex given but the model carries no topic "
+                    "names — a scoped RF change must never widen silently"
+                )
             topic_ok = np.array([
                 bool(pat.fullmatch(n)) for n in state.topic_names
             ])
+            if not topic_ok.any():
+                raise ValueError(
+                    f"topic_regex {topic_regex!r} matches no topic"
+                )
 
         with progress.step("Widening model to the target RF"):
             a = np.array(state.assignment)

@@ -19,14 +19,20 @@ from cruise_control_tpu.kafka.wire import FakeKafkaWire, KafkaWire, real_wire
 
 
 def build_kafka_stack(cfg, wire=None):
-    """(backend, metadata, sampler, sample_store) for a Kafka deployment.
+    """(backend, metadata, sampler, sample_store, wire) for a Kafka
+    deployment.
 
     Consumes the Kafka-facing config keys: ``bootstrap.servers`` (used to
     dial a real wire when none is supplied), ``metric.reporter.topic``,
     ``partition.metric.sample.store.topic``,
     ``broker.metric.sample.store.topic``,
     ``sample.store.topic.replication.factor``,
+    ``num.sample.loading.threads``,
     ``execution.progress.check.interval.ms``, ``metadata.max.age.ms``.
+
+    The wire is returned so callers needing per-consumer state (e.g. one
+    sampler per metric fetcher, each with its own offset cursor) can build
+    more clients over the same connection.
     """
     if wire is None:
         wire = real_wire(cfg.get("bootstrap.servers"))
@@ -49,5 +55,6 @@ def build_kafka_stack(cfg, wire=None):
         topic_replication_factor=cfg.get_int(
             "sample.store.topic.replication.factor"
         ),
+        loading_threads=cfg.get_int("num.sample.loading.threads"),
     )
-    return backend, metadata, sampler, store
+    return backend, metadata, sampler, store, wire

@@ -141,8 +141,40 @@ class KafkaClusterBackend(ClusterBackend):
                 ]
         if reorders:
             self.wire.alter_partition_reassignments(reorders)
+            # A real wire applies the metadata-only reorder asynchronously;
+            # electing before the new head is visible would promote the OLD
+            # preferred leader.  Poll until every reorder has settled (same
+            # replica set ⇒ no data movement, so this converges in one
+            # metadata round on a real cluster; FakeKafkaWire is synchronous
+            # and passes the first check).
+            self._await_replica_order(reorders)
         self.wire.elect_leaders([self.tp(k) for k in partitions])
         self._dirty()
+
+    def _await_replica_order(
+        self, desired: Dict[TopicPartition, List[int]],
+        timeout_s: float = 30.0,
+    ) -> None:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self._dirty()
+            topo = self._describe()
+            in_flight = set(self.wire.list_partition_reassignments())
+            settled = all(
+                tp not in in_flight and next(
+                    (r["replicas"] for r in topo.get(tp[0], ())
+                     if r["partition"] == tp[1]), None
+                ) == order
+                for tp, order in desired.items()
+            )
+            if settled:
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    "replica-order staging for preferred-leader election "
+                    f"did not settle within {timeout_s}s: {desired}"
+                )
+            time.sleep(0.1)
 
     def ongoing_reassignments(self) -> Set[int]:
         return {

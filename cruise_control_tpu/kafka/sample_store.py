@@ -26,10 +26,14 @@ class KafkaSampleStore(SampleStore):
         partition_topic: str = PARTITION_SAMPLES_TOPIC,
         broker_topic: str = BROKER_SAMPLES_TOPIC,
         topic_replication_factor: int = 2,
+        loading_threads: int = 1,
     ):
         self.wire = wire
         self.partition_topic = partition_topic
         self.broker_topic = broker_topic
+        #: num.sample.loading.threads — replay the two store topics on
+        #: concurrent consumers when > 1 (network-bound on a real wire)
+        self.loading_threads = loading_threads
         for t in (partition_topic, broker_topic):
             wire.create_topic(
                 t, replication_factor=topic_replication_factor,
@@ -48,17 +52,25 @@ class KafkaSampleStore(SampleStore):
                 for s in broker_samples
             ])
 
-    def load_samples(
-        self,
-    ) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
+    def _load_partition_samples(self) -> List[PartitionMetricSample]:
         praw, _ = self.wire.consume(self.partition_topic, 0)
-        braw, _ = self.wire.consume(self.broker_topic, 0)
-        psamples = [
+        return [
             PartitionMetricSample(p, t, tuple(v))
             for p, t, v in (json.loads(r) for r in praw)
         ]
-        bsamples = [
+
+    def _load_broker_samples(self) -> List[BrokerMetricSample]:
+        braw, _ = self.wire.consume(self.broker_topic, 0)
+        return [
             BrokerMetricSample(b, t, tuple(v))
             for b, t, v in (json.loads(r) for r in braw)
         ]
+
+    def load_samples(
+        self,
+    ) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
+        psamples, bsamples = self._replay_parallel(
+            [self._load_partition_samples, self._load_broker_samples],
+            self.loading_threads,
+        )
         return psamples, bsamples

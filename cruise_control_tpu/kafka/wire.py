@@ -84,7 +84,14 @@ class KafkaWire:
         raise NotImplementedError
 
     def consume(self, topic: str, offset: int) -> Tuple[List[bytes], int]:
-        """Records from ``offset`` on → (records, next offset)."""
+        """Records from ``offset`` on → (records, next offset).
+
+        THREAD-SAFETY CONTRACT: callers issue concurrent ``consume`` calls
+        (the sample-store replay reads its two topics in parallel; the
+        fetcher pool pulls on N threads).  An implementation over a client
+        library whose consumers are not thread-safe must create one
+        consumer per call (the call is stateless — seek to ``offset``,
+        drain, close) rather than share one."""
         raise NotImplementedError
 
 

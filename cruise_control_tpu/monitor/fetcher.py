@@ -45,13 +45,20 @@ class SamplingFetcher:
         self.include_broker_samples = include_broker_samples
         self._last_ms = 0
 
-    def fetch(self, now_ms: int, assigned: Set[int]) -> int:
+    def fetch(self, now_ms: int, assigned: Set[int],
+              ingest_lock: Optional[threading.Lock] = None) -> int:
+        """Pull + filter (safe to run concurrently across fetchers — each
+        owns its sampler), then ingest under ``ingest_lock`` when given (the
+        monitor's aggregators are a single shared mutable sink)."""
         psamples, bsamples = self.sampler.get_samples(self._last_ms, now_ms)
         self._last_ms = now_ms
         psamples = [s for s in psamples if s.partition in assigned]
         if not self.include_broker_samples:
             bsamples = []
-        return self.monitor.ingest_samples(psamples, bsamples, now_ms)
+        if ingest_lock is None:
+            return self.monitor.ingest_samples(psamples, bsamples, now_ms)
+        with ingest_lock:
+            return self.monitor.ingest_samples(psamples, bsamples, now_ms)
 
 
 class MetricFetcherManager:
@@ -81,16 +88,36 @@ class MetricFetcherManager:
         ]
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._ingest_lock = threading.Lock()
         self.fetch_count = 0
 
     def fetch_once(self, now_ms: Optional[int] = None) -> int:
-        """One full sampling interval across all fetchers → #samples."""
+        """One full sampling interval across all fetchers → #samples.
+
+        Fetchers run CONCURRENTLY (the point of ``num.metric.fetchers`` > 1
+        is parallel network pulls; upstream's SamplingFetchers run on an
+        executor); ingestion into the shared aggregators is serialized by
+        ``_ingest_lock``.  Note the topic-transport samplers (reporter-topic
+        consumers) each read the whole metrics topic and keep only their
+        assigned partitions — the wire seam has no per-partition consume —
+        so >1 fetcher buys wall-clock overlap, not less total decode work.
+        """
         now_ms = int(self.time_fn() * 1000) if now_ms is None else now_ms
         universe = sorted(self.monitor.metadata.refresh().assignment)
         assigned = self.assignor.assign(universe, len(self.fetchers))
-        total = 0
-        for fetcher, mine in zip(self.fetchers, assigned):
-            total += fetcher.fetch(now_ms, mine)
+        if len(self.fetchers) == 1:
+            total = self.fetchers[0].fetch(now_ms, assigned[0])
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=len(self.fetchers)
+            ) as pool:
+                futures = [
+                    pool.submit(f.fetch, now_ms, mine, self._ingest_lock)
+                    for f, mine in zip(self.fetchers, assigned)
+                ]
+                total = sum(f.result() for f in futures)
         self.fetch_count += 1
         return total
 

@@ -354,3 +354,30 @@ def test_rf_decrease_keeps_data_hosting_rack_diverse_replicas():
     assert 0 in kept and 3 in kept          # leader + the rack-distinct r2
     assert len(kept & {1, 2}) == 1          # exactly one r1 twin dropped
     assert 4 not in kept                    # no data copy to a fresh broker
+
+
+def test_shared_constraint_not_mutated_by_facade():
+    """Advisor round-2: CruiseControl.__init__ must not strip the caller's
+    name-keyed broker-set entries from a shared BalancingConstraint — it
+    works on a copy."""
+    from cruise_control_tpu.analyzer.goals.base import BalancingConstraint
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.facade import CruiseControl
+
+    original = {"by-name": {0, 1}, 7: {2}}
+    shared = BalancingConstraint(broker_sets=dict(original))
+    backend = SimulatedClusterBackend({0: [0]}, {0: 0}, brokers={0, 1, 2})
+    cc = CruiseControl(object(), Executor(backend), constraint=shared)
+    assert shared.broker_sets == original
+    assert cc.constraint is not shared
+    assert cc.constraint.broker_sets == {7: {2}}
+
+
+def test_rf_change_topic_regex_never_widens_silently():
+    """Advisor round-2: a topic_regex matching no topic raises instead of
+    silently applying the RF change to every topic."""
+    cc, _, _ = full_stack()
+    with pytest.raises(ValueError, match="matches no topic"):
+        cc.fix_topic_replication_factor(2, dryrun=True,
+                                        topic_regex="no-such-topic")
