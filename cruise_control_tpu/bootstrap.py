@@ -395,7 +395,7 @@ def build_app(
             "sample.store.class", store_path, **store_kwargs
         )
     elif kafka_mode:
-        # default persistence on Kafka: the compacted sample-store topics
+        # default persistence on Kafka: the retention-bounded store topics
         sample_store = kafka_store
     window_ms = cfg.get("partition.metrics.window.ms")
     monitor = LoadMonitor(

@@ -15,7 +15,16 @@ from cruise_control_tpu.kafka.sampler import (
     KafkaMetricsReporter,
     KafkaMetricsReporterSampler,
 )
-from cruise_control_tpu.kafka.wire import FakeKafkaWire, KafkaWire, real_wire
+from cruise_control_tpu.kafka.wire import (
+    FakeKafkaWire,
+    FatalWireError,
+    KafkaWire,
+    RetriableWireError,
+    UnsupportedRpcError,
+    WireError,
+    WireTimeoutError,
+    real_wire,
+)
 
 
 def build_kafka_stack(cfg, wire=None):
@@ -48,6 +57,14 @@ def build_kafka_stack(cfg, wire=None):
     sampler = KafkaMetricsReporterSampler(
         wire, topic=cfg.get("metric.reporter.topic")
     )
+    # store-topic retention must cover the window history the aggregators
+    # keep (+1 window of slack), or replay after restart comes up short;
+    # anything longer only grows the topics and the startup replay
+    window_ms = cfg.get("partition.metrics.window.ms")
+    num_windows = max(
+        cfg.get_int("num.partition.metrics.windows"),
+        cfg.get_int("num.broker.metrics.windows"),
+    )
     store = KafkaSampleStore(
         wire,
         partition_topic=cfg.get("partition.metric.sample.store.topic"),
@@ -56,5 +73,6 @@ def build_kafka_stack(cfg, wire=None):
             "sample.store.topic.replication.factor"
         ),
         loading_threads=cfg.get_int("num.sample.loading.threads"),
+        retention_ms=int(window_ms) * (num_windows + 1),
     )
     return backend, metadata, sampler, store, wire

@@ -156,6 +156,7 @@ class KafkaClusterBackend(ClusterBackend):
         timeout_s: float = 30.0,
     ) -> None:
         deadline = time.monotonic() + timeout_s
+        delay = 0.1
         while True:
             self._dirty()
             topo = self._describe()
@@ -174,7 +175,10 @@ class KafkaClusterBackend(ClusterBackend):
                     "replica-order staging for preferred-leader election "
                     f"did not settle within {timeout_s}s: {desired}"
                 )
-            time.sleep(0.1)
+            # each poll is a full-cluster describe: back off so a slow
+            # settle costs a handful of metadata rounds, not hundreds
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
 
     def ongoing_reassignments(self) -> Set[int]:
         return {

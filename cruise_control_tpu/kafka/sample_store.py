@@ -1,7 +1,13 @@
 """Kafka-backed sample store (upstream
 ``monitor/sampling/KafkaSampleStore.java``): samples persist to two internal
 topics and replay from offset 0 at startup, so the workload model survives
-restarts (the LOADING state, SURVEY.md §5.4)."""
+restarts (the LOADING state, SURVEY.md §5.4).
+
+The store topics are RETENTION-bounded (``cleanup.policy=delete`` with
+``retention.ms`` sized to the aggregators' window history): every sample is
+unique per (entity, window), so compaction could never delete anything —
+time-based retention is what bounds the topics and the startup replay
+(upstream sizes its sample-store retention the same way)."""
 
 from __future__ import annotations
 
@@ -27,6 +33,7 @@ class KafkaSampleStore(SampleStore):
         broker_topic: str = BROKER_SAMPLES_TOPIC,
         topic_replication_factor: int = 2,
         loading_threads: int = 1,
+        retention_ms: int = 24 * 60 * 60 * 1000,
     ):
         self.wire = wire
         self.partition_topic = partition_topic
@@ -37,20 +44,43 @@ class KafkaSampleStore(SampleStore):
         for t in (partition_topic, broker_topic):
             wire.create_topic(
                 t, replication_factor=topic_replication_factor,
-                configs={"cleanup.policy": "compact"},
+                configs={
+                    "cleanup.policy": "delete",
+                    "retention.ms": str(retention_ms),
+                },
             )
 
     def store_samples(self, partition_samples, broker_samples) -> None:
+        # records are keyed by entity (partition affinity on the real
+        # broker keeps one entity's samples ordered within a partition)
         if partition_samples:
-            self.wire.produce(self.partition_topic, [
-                json.dumps([s.partition, s.time_ms, list(s.values)]).encode()
-                for s in partition_samples
-            ])
+            self.wire.produce(
+                self.partition_topic,
+                [
+                    json.dumps(
+                        [s.partition, s.time_ms, list(s.values)]
+                    ).encode()
+                    for s in partition_samples
+                ],
+                keys=[
+                    str(s.partition).encode()
+                    for s in partition_samples
+                ],
+            )
         if broker_samples:
-            self.wire.produce(self.broker_topic, [
-                json.dumps([s.broker_id, s.time_ms, list(s.values)]).encode()
-                for s in broker_samples
-            ])
+            self.wire.produce(
+                self.broker_topic,
+                [
+                    json.dumps(
+                        [s.broker_id, s.time_ms, list(s.values)]
+                    ).encode()
+                    for s in broker_samples
+                ],
+                keys=[
+                    str(s.broker_id).encode()
+                    for s in broker_samples
+                ],
+            )
 
     def _load_partition_samples(self) -> List[PartitionMetricSample]:
         praw, _ = self.wire.consume(self.partition_topic, 0)

@@ -1,7 +1,7 @@
 """Sample persistence + replay (upstream ``monitor/sampling/SampleStore.java``
 / ``KafkaSampleStore.java``; SURVEY.md §5.4).
 
-Upstream persists every sample to two compacted internal Kafka topics and
+Upstream persists every sample to two retention-bounded internal Kafka topics and
 replays them on startup so the workload model survives restarts.  With no
 Kafka in this environment, the store is an append-only JSONL pair on local
 disk with the same contract: ``store_samples`` on every fetch,

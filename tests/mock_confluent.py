@@ -1,0 +1,464 @@
+"""A mock ``confluent_kafka`` module (+ ``.admin``) for unit-testing the
+production wire without the client library or a network.
+
+Mimics the client's future-based API shapes the wire uses: synchronous
+futures over a shared in-memory broker, ``KafkaError`` objects with
+``code()/retriable()/fatal()``, metadata objects with the real attribute
+names (``isrs``, ``adding_replicas``), and scriptable per-RPC failures
+(``broker.fail_next[...]``).  Install with :func:`install` (returns the
+broker handle) and remove with :func:`uninstall`.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+
+def _done(value=None, exc=None) -> Future:
+    f = Future()
+    if exc is not None:
+        f.set_exception(exc)
+    else:
+        f.set_result(value)
+    return f
+
+
+class MockKafkaError:
+    def __init__(self, code, msg="", retriable=False, fatal=False):
+        self._code, self._msg = code, msg
+        self._retriable, self._fatal = retriable, fatal
+
+    def code(self):
+        return self._code
+
+    def str(self):
+        return self._msg
+
+    def retriable(self):
+        return self._retriable
+
+    def fatal(self):
+        return self._fatal
+
+    def __repr__(self):
+        return f"MockKafkaError({self._code}, {self._msg!r})"
+
+
+class MockKafkaException(Exception):
+    pass
+
+
+class MockTopicPartition:
+    def __init__(self, topic, partition=-1, offset=-1001):
+        self.topic, self.partition, self.offset = topic, partition, offset
+
+    def __hash__(self):
+        return hash((self.topic, self.partition))
+
+    def __eq__(self, other):
+        return (self.topic, self.partition) == (other.topic, other.partition)
+
+    def __repr__(self):
+        return f"MockTopicPartition({self.topic}, {self.partition})"
+
+
+class MockBroker:
+    """Shared in-memory cluster state, keyed by bootstrap.servers."""
+
+    def __init__(self):
+        self.nodes = {0: "r0", 1: "r1", 2: None}       # id → rack
+        self.topics = {}      # name → {pid: {"leader","replicas","isrs"}}
+        self.logs = {}        # name → {pid: [bytes]}
+        self.log_bases = {}   # (name, pid) → earliest offset (retention)
+        self.topic_configs = {}
+        self.configs = {}     # (rtype, name) → {key: value}
+        self.reassignments = {}  # (t, p) → {"replicas","adding","removing"}
+        self.log_dirs = {}    # broker → {dir: {"error","replicas":[(t,p)]}}
+        self.calls = []       # (rpc, payload) log
+        self.fail_next = {}   # rpc name → MockKafkaError (one-shot)
+        #: True = reassignments complete instantly (a fast cluster);
+        #: False = they stay listed in-flight until completed by the test
+        self.auto_complete = False
+
+    def add_topic(self, name, partitions=1, leader=0, replicas=(0, 1)):
+        self.topics[name] = {
+            p: {"leader": leader, "replicas": list(replicas),
+                "isrs": list(replicas)}
+            for p in range(partitions)
+        }
+        self.logs[name] = {p: [] for p in range(partitions)}
+
+    def trim(self, topic, pid, new_earliest):
+        """Retention: the broker deletes records below ``new_earliest``."""
+        base = self.log_bases.get((topic, pid), 0)
+        drop = max(0, new_earliest - base)
+        del self.logs[topic][pid][:drop]
+        self.log_bases[(topic, pid)] = base + drop
+
+    def _fail(self, rpc):
+        err = self.fail_next.pop(rpc, None)
+        if err is not None:
+            return MockKafkaException(err)
+        return None
+
+
+_BROKERS = {}
+
+
+def broker_for(servers: str) -> MockBroker:
+    return _BROKERS.setdefault(servers, MockBroker())
+
+
+class MockAdminClient:
+    def __init__(self, conf):
+        self.conf = conf
+        self.b = broker_for(conf["bootstrap.servers"])
+
+    # -- metadata --
+    def describe_cluster(self, request_timeout=None):
+        exc = self.b._fail("describe_cluster")
+        if exc:
+            return _done(exc=exc)
+        nodes = [SimpleNamespace(id=i, rack=r) for i, r in self.b.nodes.items()]
+        return _done(SimpleNamespace(nodes=nodes))
+
+    def list_topics(self, topic=None, timeout=None):
+        exc = self.b._fail("list_topics")
+        if exc:
+            raise exc
+        topics = {}
+        names = [topic] if topic is not None else list(self.b.topics)
+        for name in names:
+            parts = self.b.topics.get(name)
+            if parts is None:
+                continue
+            topics[name] = SimpleNamespace(
+                error=None,
+                partitions={
+                    p: SimpleNamespace(
+                        id=p, leader=row["leader"],
+                        replicas=list(row["replicas"]),
+                        isrs=list(row["isrs"]), error=None,
+                    )
+                    for p, row in parts.items()
+                },
+            )
+        return SimpleNamespace(
+            brokers={i: SimpleNamespace(id=i) for i in self.b.nodes},
+            topics=topics,
+        )
+
+    # -- reassignment --
+    def alter_partition_reassignments(self, req):
+        self.b.calls.append(("alter_partition_reassignments", {
+            (tp.topic, tp.partition): (None if new is None else list(new))
+            for tp, new in req.items()
+        }))
+        exc = self.b._fail("alter_partition_reassignments")
+        out = {}
+        for tp, new in req.items():
+            if exc:
+                out[tp] = _done(exc=exc)
+                continue
+            key = (tp.topic, tp.partition)
+            if new is None:
+                self.b.reassignments.pop(key, None)
+            elif self.b.auto_complete:
+                row = self.b.topics[tp.topic][tp.partition]
+                row["replicas"] = list(new)
+                row["isrs"] = list(new)
+                if row["leader"] not in new:
+                    row["leader"] = new[0]
+            else:
+                row = self.b.topics[tp.topic][tp.partition]
+                adding = [x for x in new if x not in row["replicas"]]
+                removing = [x for x in row["replicas"] if x not in new]
+                self.b.reassignments[key] = {
+                    "replicas": list(dict.fromkeys(row["replicas"] + adding)),
+                    "adding": adding, "removing": removing,
+                }
+            out[tp] = _done(None)
+        return out
+
+    def list_partition_reassignments(self, request_timeout=None):
+        exc = self.b._fail("list_partition_reassignments")
+        if exc:
+            return _done(exc=exc)
+        return _done({
+            MockTopicPartition(t, p): SimpleNamespace(
+                replicas=list(st["replicas"]),
+                adding_replicas=list(st["adding"]),
+                removing_replicas=list(st["removing"]),
+            )
+            for (t, p), st in self.b.reassignments.items()
+        })
+
+    def elect_leaders(self, election_type, partitions):
+        self.b.calls.append(("elect_leaders", election_type, [
+            (tp.topic, tp.partition) for tp in partitions
+        ]))
+        exc = self.b._fail("elect_leaders")
+        if exc:
+            return _done(exc=exc)
+        result = {}
+        for tp in partitions:
+            row = self.b.topics[tp.topic][tp.partition]
+            if row["leader"] == row["replicas"][0]:
+                # the real client wraps per-partition errors in
+                # KafkaException — callers must unwrap
+                result[tp] = MockKafkaException(
+                    MockKafkaError(84, "ELECTION_NOT_NEEDED"))
+            else:
+                row["leader"] = row["replicas"][0]
+                result[tp] = None
+        return _done(result)
+
+    # -- configs --
+    def describe_configs(self, resources):
+        out = {}
+        for res in resources:
+            exc = self.b._fail("describe_configs")
+            if exc:
+                out[res] = _done(exc=exc)
+                continue
+            cfg = self.b.configs.get((res.rtype_name, res.name), {})
+            out[res] = _done({
+                k: SimpleNamespace(name=k, value=v) for k, v in cfg.items()
+            })
+        return out
+
+    def incremental_alter_configs(self, resources):
+        out = {}
+        for res in resources:
+            self.b.calls.append(("incremental_alter_configs",
+                                 res.rtype_name, res.name, [
+                                     (e.name, e.value, e.incremental_operation)
+                                     for e in res.incremental_configs
+                                 ]))
+            exc = self.b._fail("incremental_alter_configs")
+            if exc:
+                out[res] = _done(exc=exc)
+                continue
+            cfg = self.b.configs.setdefault((res.rtype_name, res.name), {})
+            for e in res.incremental_configs:
+                if e.incremental_operation == MockAlterConfigOpType.DELETE:
+                    cfg.pop(e.name, None)
+                else:
+                    cfg[e.name] = e.value
+            out[res] = _done(None)
+        return out
+
+    # -- log dirs --
+    def alter_replica_log_dirs(self, req):
+        self.b.calls.append(("alter_replica_log_dirs", dict(req)))
+        out = {}
+        for (t, p, broker), d in req.items():
+            exc = self.b._fail("alter_replica_log_dirs")
+            if exc:
+                out[(t, p, broker)] = _done(exc=exc)
+                continue
+            dirs = self.b.log_dirs.setdefault(broker, {})
+            for info in dirs.values():
+                info["replicas"] = [
+                    x for x in info["replicas"] if x != (t, p)
+                ]
+            dirs.setdefault(d, {"error": None, "replicas": []})
+            dirs[d]["replicas"].append((t, p))
+            out[(t, p, broker)] = _done(None)
+        return out
+
+    def describe_log_dirs(self, brokers, request_timeout=None):
+        out = {}
+        for broker in brokers:
+            exc = self.b._fail("describe_log_dirs")
+            if exc:
+                out[broker] = _done(exc=exc)
+                continue
+            out[broker] = _done({
+                d: SimpleNamespace(
+                    error=info["error"],
+                    replicas=[
+                        MockTopicPartition(t, p) for t, p in info["replicas"]
+                    ],
+                )
+                for d, info in self.b.log_dirs.get(broker, {}).items()
+            })
+        return out
+
+    # -- topics --
+    def create_topics(self, new_topics):
+        out = {}
+        for nt in new_topics:
+            self.b.calls.append(("create_topics", nt.topic,
+                                 nt.num_partitions, nt.replication_factor,
+                                 dict(nt.config)))
+            exc = self.b._fail("create_topics")
+            if exc:
+                out[nt.topic] = _done(exc=exc)
+                continue
+            if nt.topic in self.b.topics:
+                out[nt.topic] = _done(exc=MockKafkaException(
+                    MockKafkaError(36, "TOPIC_ALREADY_EXISTS")))
+                continue
+            self.b.add_topic(nt.topic, partitions=nt.num_partitions)
+            self.b.topic_configs[nt.topic] = dict(nt.config)
+            out[nt.topic] = _done(None)
+        return out
+
+
+class MockProducer:
+    def __init__(self, conf):
+        self.b = broker_for(conf["bootstrap.servers"])
+        self._pending = []
+
+    def produce(self, topic, value=None, key=None, on_delivery=None):
+        self._pending.append((topic, key, value, on_delivery))
+
+    def flush(self, timeout=None):
+        import zlib
+
+        err = self.b.fail_next.pop("produce", None)
+        for topic, key, value, cb in self._pending:
+            if err is not None:
+                if cb:
+                    cb(err, None)
+                continue
+            # real-broker behavior: compacted topics reject keyless records
+            if key is None and self.b.topic_configs.get(topic, {}).get(
+                    "cleanup.policy") == "compact":
+                if cb:
+                    cb(MockKafkaError(
+                        87, "INVALID_RECORD: compacted topic requires key",
+                    ), None)
+                continue
+            if topic not in self.b.logs:
+                self.b.add_topic(topic)
+            parts = self.b.logs[topic]
+            if key is not None:
+                target = zlib.crc32(key) % len(parts)
+            else:
+                target = min(parts, key=lambda p: len(parts[p]))
+            parts[target].append(value)
+            if cb:
+                cb(None, SimpleNamespace(topic=topic))
+        self._pending = []
+        return 0
+
+
+class _MockMessage:
+    def __init__(self, topic, partition, offset, value):
+        self._t, self._p, self._o, self._v = topic, partition, offset, value
+
+    def error(self):
+        return None
+
+    def topic(self):
+        return self._t
+
+    def partition(self):
+        return self._p
+
+    def offset(self):
+        return self._o
+
+    def value(self):
+        return self._v
+
+
+class MockConsumer:
+    def __init__(self, conf):
+        self._servers = conf["bootstrap.servers"]
+        self.b = broker_for(self._servers)
+        self._queue = []
+        self._closed = False
+
+    def list_topics(self, topic=None, timeout=None):
+        return MockAdminClient(
+            {"bootstrap.servers": self._servers}
+        ).list_topics(topic=topic, timeout=timeout)
+
+    def get_watermark_offsets(self, tp, timeout=None):
+        log = self.b.logs.get(tp.topic, {}).get(tp.partition, [])
+        base = self.b.log_bases.get((tp.topic, tp.partition), 0)
+        return base, base + len(log)
+
+    def assign(self, tps):
+        for tp in tps:
+            log = self.b.logs.get(tp.topic, {}).get(tp.partition, [])
+            base = self.b.log_bases.get((tp.topic, tp.partition), 0)
+            for idx in range(max(tp.offset, base) - base, len(log)):
+                self._queue.append(
+                    _MockMessage(tp.topic, tp.partition, base + idx, log[idx])
+                )
+
+    def poll(self, timeout=None):
+        assert not self._closed
+        return self._queue.pop(0) if self._queue else None
+
+    def close(self):
+        self._closed = True
+
+
+class MockConfigResource:
+    class Type:
+        TOPIC = "topic"
+        BROKER = "broker"
+
+    def __init__(self, restype, name, incremental_configs=None):
+        self.rtype_name = restype
+        self.name = name
+        self.incremental_configs = incremental_configs or []
+
+    def __hash__(self):
+        return hash((self.rtype_name, self.name))
+
+
+class MockConfigEntry:
+    def __init__(self, name, value, incremental_operation=None):
+        self.name, self.value = name, value
+        self.incremental_operation = incremental_operation
+
+
+class MockAlterConfigOpType:
+    SET = "SET"
+    DELETE = "DELETE"
+
+
+class MockNewTopic:
+    def __init__(self, topic, num_partitions=1, replication_factor=1,
+                 config=None):
+        self.topic = topic
+        self.num_partitions = num_partitions
+        self.replication_factor = replication_factor
+        self.config = config or {}
+
+
+def install() -> MockBroker:
+    """Inject the mock modules into sys.modules → the shared broker."""
+    _BROKERS.clear()
+    mod = types.ModuleType("confluent_kafka")
+    mod.Producer = MockProducer
+    mod.Consumer = MockConsumer
+    mod.TopicPartition = MockTopicPartition
+    mod.KafkaException = MockKafkaException
+    mod.KafkaError = MockKafkaError
+    mod.ElectionType = SimpleNamespace(PREFERRED="preferred")
+    admin = types.ModuleType("confluent_kafka.admin")
+    admin.AdminClient = MockAdminClient
+    admin.NewTopic = MockNewTopic
+    admin.ConfigResource = MockConfigResource
+    admin.ConfigEntry = MockConfigEntry
+    admin.AlterConfigOpType = MockAlterConfigOpType
+    mod.admin = admin
+    sys.modules["confluent_kafka"] = mod
+    sys.modules["confluent_kafka.admin"] = admin
+    return broker_for("mock:9092")
+
+
+def uninstall() -> None:
+    sys.modules.pop("confluent_kafka", None)
+    sys.modules.pop("confluent_kafka.admin", None)
+    _BROKERS.clear()

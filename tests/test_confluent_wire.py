@@ -1,0 +1,308 @@
+"""Production-wire unit tests (VERDICT round-2 item #3): every RPC
+translation of :class:`ConfluentKafkaWire` exercised against a mocked
+``confluent_kafka`` injected in ``sys.modules`` (future-based API, real
+attribute names), plus the error-mapping contract."""
+
+import pytest
+
+import mock_confluent
+from mock_confluent import MockKafkaError
+
+from cruise_control_tpu.kafka.wire import (
+    FatalWireError,
+    RetriableWireError,
+    UnsupportedRpcError,
+    WireError,
+    WireTimeoutError,
+    real_wire,
+)
+
+SERVERS = "mock:9092"
+
+
+@pytest.fixture
+def broker():
+    b = mock_confluent.install()
+    yield b
+    mock_confluent.uninstall()
+
+
+@pytest.fixture
+def wire(broker):
+    from cruise_control_tpu.kafka.confluent_wire import ConfluentKafkaWire
+
+    return ConfluentKafkaWire(SERVERS, timeout_s=2.0)
+
+
+def test_real_wire_returns_confluent_wire_when_lib_importable(broker):
+    from cruise_control_tpu.kafka.confluent_wire import ConfluentKafkaWire
+
+    w = real_wire(SERVERS)
+    assert isinstance(w, ConfluentKafkaWire)
+
+
+def test_real_wire_raises_without_client_lib():
+    with pytest.raises(RuntimeError, match="no Kafka client library"):
+        real_wire("srv:9092")
+
+
+def test_describe_cluster_maps_nodes_and_null_racks(broker, wire):
+    assert wire.describe_cluster() == {
+        0: {"rack": "r0"}, 1: {"rack": "r1"}, 2: {"rack": ""},
+    }
+
+
+def test_describe_topics_maps_partition_rows(broker, wire):
+    broker.add_topic("t", partitions=2, leader=1, replicas=(1, 0))
+    rows = wire.describe_topics()["t"]
+    assert rows == [
+        {"partition": 0, "leader": 1, "replicas": [1, 0], "isr": [1, 0]},
+        {"partition": 1, "leader": 1, "replicas": [1, 0], "isr": [1, 0]},
+    ]
+
+
+def test_alter_and_list_partition_reassignments(broker, wire):
+    broker.add_topic("t", partitions=2, replicas=(0, 1))
+    wire.alter_partition_reassignments({("t", 0): [1, 2], ("t", 1): None})
+    rpc, payload = broker.calls[-1]
+    assert rpc == "alter_partition_reassignments"
+    assert payload == {("t", 0): [1, 2], ("t", 1): None}
+    listing = wire.list_partition_reassignments()
+    assert listing == {("t", 0): {
+        "replicas": [0, 1, 2], "adding": [2], "removing": [0],
+    }}
+    # cancel drops it from the in-flight listing
+    wire.alter_partition_reassignments({("t", 0): None})
+    assert wire.list_partition_reassignments() == {}
+
+
+def test_elect_leaders_preferred_and_election_not_needed(broker, wire):
+    broker.add_topic("t", partitions=2, leader=1, replicas=(0, 1))
+    wire.elect_leaders([("t", 0)])
+    assert broker.calls[-1] == ("elect_leaders", "preferred", [("t", 0)])
+    assert broker.topics["t"][0]["leader"] == 0
+    # already-preferred → per-partition ELECTION_NOT_NEEDED is success
+    wire.elect_leaders([("t", 0)])
+
+
+def test_config_roundtrip_set_and_delete(broker, wire):
+    wire.incremental_alter_configs(
+        "broker", "7", {"leader.replication.throttled.rate": "1000"})
+    assert wire.describe_configs("broker", "7") == {
+        "leader.replication.throttled.rate": "1000"}
+    wire.incremental_alter_configs(
+        "broker", "7", {"leader.replication.throttled.rate": None})
+    assert wire.describe_configs("broker", "7") == {}
+    # op types crossed the seam as SET / DELETE
+    ops = [c for c in broker.calls if c[0] == "incremental_alter_configs"]
+    assert ops[0][3] == [("leader.replication.throttled.rate", "1000", "SET")]
+    assert ops[1][3][0][2] == "DELETE"
+
+
+def test_log_dir_rpcs(broker, wire):
+    broker.add_topic("t", partitions=1, replicas=(0, 1))
+    broker.log_dirs[0] = {"/d1": {"error": None, "replicas": [("t", 0)]}}
+    wire.alter_replica_log_dirs({("t", 0, 0): "/d2"})
+    dirs = wire.describe_log_dirs()
+    assert dirs[0]["/d2"]["replicas"] == [("t", 0)]
+    assert dirs[0]["/d1"]["replicas"] == []
+    assert not dirs[0]["/d2"]["offline"]
+
+
+def test_create_topic_is_idempotent(broker, wire):
+    wire.create_topic("logs", replication_factor=2,
+                      configs={"cleanup.policy": "compact"})
+    assert broker.topic_configs["logs"] == {"cleanup.policy": "compact"}
+    wire.create_topic("logs")  # TOPIC_ALREADY_EXISTS swallowed
+    creates = [c for c in broker.calls if c[0] == "create_topics"]
+    assert len(creates) == 2
+
+
+def test_produce_consume_roundtrip_with_cursor_resume(broker, wire):
+    wire.create_topic("m")
+    wire.produce("m", [b"a", b"b"])
+    records, nxt = wire.consume("m", 0)
+    assert records == [b"a", b"b"] and nxt == 2
+    records, nxt2 = wire.consume("m", nxt)
+    assert records == [] and nxt2 == 2
+    wire.produce("m", [b"c"])
+    records, nxt3 = wire.consume("m", nxt2)
+    assert records == [b"c"] and nxt3 == 3
+    # restart semantics: offset 0 re-reads everything
+    records, _ = wire.consume("m", 0)
+    assert records == [b"a", b"b", b"c"]
+
+
+def test_consume_foreign_cursor_skips_prefix(broker, wire):
+    """A cursor from a previous process (unknown to this wire) re-reads
+    from earliest and drops the first `offset` records."""
+    wire.create_topic("m")
+    wire.produce("m", [b"a", b"b", b"c"])
+    records, nxt = wire.consume("m", 2)
+    assert records == [b"c"] and nxt == 3
+
+
+def test_consume_multi_partition_drains_all(broker, wire):
+    broker.add_topic("mp", partitions=3)
+    wire.produce("mp", [b"r0", b"r1", b"r2", b"r3", b"r4", b"r5"])
+    records, nxt = wire.consume("mp", 0)
+    assert sorted(records) == [b"r0", b"r1", b"r2", b"r3", b"r4", b"r5"]
+    assert nxt == 6
+    wire.produce("mp", [b"r6"])
+    records, nxt = wire.consume("mp", nxt)
+    assert records == [b"r6"] and nxt == 7
+
+
+def test_consume_missing_topic_is_empty(broker, wire):
+    assert wire.consume("nope", 0) == ([], 0)
+
+
+# ---- error mapping ---------------------------------------------------------
+
+
+def test_timeout_code_maps_to_wire_timeout(broker, wire):
+    broker.add_topic("t")
+    broker.fail_next["alter_partition_reassignments"] = MockKafkaError(
+        7, "REQUEST_TIMED_OUT", retriable=True)
+    with pytest.raises(WireTimeoutError):
+        wire.alter_partition_reassignments({("t", 0): [1, 2]})
+
+
+def test_retriable_maps_to_retriable(broker, wire):
+    broker.fail_next["describe_cluster"] = MockKafkaError(
+        9, "REPLICA_NOT_AVAILABLE", retriable=True)
+    with pytest.raises(RetriableWireError):
+        wire.describe_cluster()
+
+
+def test_fatal_maps_to_fatal(broker, wire):
+    broker.add_topic("t")
+    broker.fail_next["elect_leaders"] = MockKafkaError(
+        87, "fenced", fatal=True)
+    with pytest.raises(FatalWireError):
+        wire.elect_leaders([("t", 0)])
+
+
+def test_unknown_error_maps_to_base_wire_error(broker, wire):
+    broker.fail_next["create_topics"] = MockKafkaError(
+        29, "TOPIC_AUTHORIZATION_FAILED")
+    with pytest.raises(WireError) as ei:
+        wire.create_topic("secret")
+    assert type(ei.value) is WireError
+
+
+def test_missing_client_method_raises_unsupported(broker, wire):
+    del mock_confluent.MockAdminClient.alter_partition_reassignments
+    try:
+        with pytest.raises(UnsupportedRpcError, match="KIP"):
+            wire.alter_partition_reassignments({("t", 0): [1]})
+    finally:
+        mock_confluent.MockAdminClient.alter_partition_reassignments = (
+            MockAdminClientAlter)
+
+
+MockAdminClientAlter = mock_confluent.MockAdminClient.alter_partition_reassignments
+
+
+# ---- adapter stack over the production wire --------------------------------
+
+
+def test_metrics_reporter_and_sampler_over_production_wire(broker, wire):
+    """The reporter twin and the consumer-side sampler run unchanged over
+    the production wire (same code path a real cluster would use)."""
+    from cruise_control_tpu.kafka.sampler import (
+        KafkaMetricsReporter,
+        KafkaMetricsReporterSampler,
+    )
+    from cruise_control_tpu.monitor.sampling import (
+        CruiseControlMetric,
+        RawMetricType,
+    )
+
+    reporter = KafkaMetricsReporter(wire)
+    sampler = KafkaMetricsReporterSampler(wire)
+    reporter.report([
+        CruiseControlMetric(RawMetricType.PARTITION_BYTES_IN, 500, 0, 9.0,
+                            partition=3),
+        CruiseControlMetric(RawMetricType.PARTITION_SIZE, 500, 0, 70.0,
+                            partition=3),
+    ])
+    psamples, _ = sampler.get_samples(0, 1000)
+    assert len(psamples) == 1 and psamples[0].partition == 3
+    # incremental: nothing new on the next poll
+    assert sampler.get_samples(1000, 2000) == ([], [])
+
+
+def test_sample_store_over_production_wire(broker, wire):
+    from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+    from cruise_control_tpu.monitor.sampling import PartitionMetricSample
+
+    store = KafkaSampleStore(wire, loading_threads=4)
+    samples = [PartitionMetricSample(p, 10 * p, (1.0, 2.0, 3.0, 4.0))
+               for p in range(5)]
+    store.store_samples(samples, [])
+    psamples, bsamples = store.load_samples()
+    assert psamples == samples and bsamples == []
+
+
+def test_compacted_topic_requires_keys(broker, wire):
+    """Real brokers reject keyless writes to compacted topics; the sample
+    store must key its records (code-review round-3 finding)."""
+    wire.create_topic("compacted", configs={"cleanup.policy": "compact"})
+    with pytest.raises(WireError, match="INVALID_RECORD"):
+        wire.produce("compacted", [b"v"])
+    wire.produce("compacted", [b"v"], keys=[b"k"])
+    assert wire.consume("compacted", 0)[0] == [b"v"]
+
+
+def test_concurrent_samplers_resume_independent_cursors(broker, wire):
+    """Snapshot-keyed cursors: two independent consumers of one topic each
+    resume exactly from the cursor they were handed."""
+    wire.create_topic("m")
+    wire.produce("m", [b"a", b"b"])
+    _, c1 = wire.consume("m", 0)     # consumer 1 caught up at 2
+    wire.produce("m", [b"c"])
+    _, c2 = wire.consume("m", 0)     # consumer 2 catches up at 3
+    assert (c1, c2) == (2, 3)
+    wire.produce("m", [b"d"])
+    r1, _ = wire.consume("m", c1)    # consumer 1 resumes its own snapshot
+    r2, _ = wire.consume("m", c2)
+    assert r1 == [b"c", b"d"]
+    assert r2 == [b"d"]
+
+
+def test_foreign_cursor_on_trimmed_topic_does_not_double_drop(broker, wire):
+    """Restart-with-cursor on a retention-trimmed topic: records the broker
+    deleted count toward the cursor, so live records are not skipped."""
+    broker.add_topic("m", partitions=1)
+    wire.produce("m", [b"a", b"b", b"c", b"d"])
+    broker.trim("m", 0, 2)  # retention deleted a, b: earliest offset = 2
+    records, nxt = wire.consume("m", 2)
+    assert records == [b"c", b"d"] and nxt == 4
+    # and a cursor pointing below the trim point skips nothing live
+    records, _ = wire.consume("m", 1)
+    assert records == [b"c", b"d"]
+
+
+def test_list_reassignments_degrades_when_client_lacks_rpc(broker, wire):
+    """Startup recovery calls list_partition_reassignments unconditionally;
+    a client without KIP-455 support must degrade to 'none in flight'
+    (warn once), not crash the boot — while an actual MOVE stays loud."""
+    saved = mock_confluent.MockAdminClient.list_partition_reassignments
+    del mock_confluent.MockAdminClient.list_partition_reassignments
+    try:
+        assert wire.list_partition_reassignments() == {}
+        assert wire.list_partition_reassignments() == {}  # warns once only
+    finally:
+        mock_confluent.MockAdminClient.list_partition_reassignments = saved
+
+
+def test_store_topics_are_retention_bounded(broker, wire):
+    """Sample-store topics use delete+retention.ms (unique samples would
+    defeat compaction — the topics and startup replay must stay bounded)."""
+    from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+
+    KafkaSampleStore(wire, retention_ms=7_200_000)
+    cfgs = broker.topic_configs["__KafkaCruiseControlPartitionMetricSamples"]
+    assert cfgs["cleanup.policy"] == "delete"
+    assert cfgs["retention.ms"] == "7200000"

