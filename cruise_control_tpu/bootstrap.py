@@ -515,7 +515,8 @@ def build_app(
             None if cfg.get_int("num.metric.fetchers") <= 1
             else (
                 (lambda: KafkaMetricsReporterSampler(
-                    kafka_wire, topic=cfg.get("metric.reporter.topic")))
+                    kafka_wire, topic=cfg.get("metric.reporter.topic"),
+                    metadata=backend))
                 if kafka_mode else (lambda: _make_sampler(cfg, topic))
             )
         ),

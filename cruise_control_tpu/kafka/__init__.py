@@ -55,15 +55,21 @@ def build_kafka_stack(cfg, wire=None):
         backend, max_age_ms=cfg.get_int("metadata.max.age.ms")
     )
     sampler = KafkaMetricsReporterSampler(
-        wire, topic=cfg.get("metric.reporter.topic")
+        wire, topic=cfg.get("metric.reporter.topic"),
+        # the backend resolves envelope (topic, partition) addresses to
+        # dense ids and provides leadership for topic-rate distribution
+        metadata=backend,
     )
     # store-topic retention must cover the window history the aggregators
     # keep (+1 window of slack), or replay after restart comes up short;
-    # anything longer only grows the topics and the startup replay
-    window_ms = cfg.get("partition.metrics.window.ms")
-    num_windows = max(
-        cfg.get_int("num.partition.metrics.windows"),
-        cfg.get_int("num.broker.metrics.windows"),
+    # anything longer only grows the topics and the startup replay.  The
+    # partition and broker aggregators have independent window spans —
+    # cover whichever history is longer.
+    retention_ms = max(
+        int(cfg.get("partition.metrics.window.ms"))
+        * (cfg.get_int("num.partition.metrics.windows") + 1),
+        int(cfg.get("broker.metrics.window.ms"))
+        * (cfg.get_int("num.broker.metrics.windows") + 1),
     )
     store = KafkaSampleStore(
         wire,
@@ -73,6 +79,6 @@ def build_kafka_stack(cfg, wire=None):
             "sample.store.topic.replication.factor"
         ),
         loading_threads=cfg.get_int("num.sample.loading.threads"),
-        retention_ms=int(window_ms) * (num_windows + 1),
+        retention_ms=retention_ms,
     )
     return backend, metadata, sampler, store, wire

@@ -300,8 +300,15 @@ class ConfluentKafkaWire(KafkaWire):
                     (tp.topic, tp.partition) if hasattr(tp, "topic") else tp
                     for tp in getattr(info, "replicas", ())
                 ]
+                # clients may attach a KafkaError with code 0 (NO_ERROR)
+                # to healthy dirs — truthiness would mark everything
+                # offline and trip cluster-wide disk self-healing
+                err = getattr(info, "error", None)
+                offline = err is not None and (
+                    getattr(err, "code", lambda: 1)() != 0
+                )
                 out[broker][d] = {
-                    "offline": bool(getattr(info, "error", None)),
+                    "offline": offline,
                     "replicas": replicas,
                 }
         return out
@@ -333,11 +340,24 @@ class ConfluentKafkaWire(KafkaWire):
                 errors.append(err)
 
         for i, rec in enumerate(records):
-            self._producer.produce(
-                topic, value=rec,
-                key=keys[i] if keys is not None else None,
-                on_delivery=on_delivery,
-            )
+            key = keys[i] if keys is not None else None
+            try:
+                self._producer.produce(
+                    topic, value=rec, key=key, on_delivery=on_delivery,
+                )
+            except BufferError:
+                # local queue full (batches > queue.buffering.max.messages):
+                # service the delivery queue to drain, then retry once
+                self._producer.poll(self.timeout_s)
+                try:
+                    self._producer.produce(
+                        topic, value=rec, key=key, on_delivery=on_delivery,
+                    )
+                except BufferError as e:
+                    raise RetriableWireError(
+                        f"produce[{topic}]: local queue still full after "
+                        f"drain ({i}/{len(records)} enqueued)"
+                    ) from e
         remaining = self._producer.flush(self.timeout_s)
         if remaining:
             raise WireTimeoutError(

@@ -51,8 +51,10 @@ class KafkaSampleStore(SampleStore):
             )
 
     def store_samples(self, partition_samples, broker_samples) -> None:
-        # records are keyed by entity (partition affinity on the real
-        # broker keeps one entity's samples ordered within a partition)
+        # records are keyed by (entity, window): unique per sample, so even
+        # a PRE-EXISTING topic stuck on cleanup.policy=compact (created by
+        # an older version; create_topic is idempotent and won't re-config)
+        # can never compact the window history away
         if partition_samples:
             self.wire.produce(
                 self.partition_topic,
@@ -63,7 +65,7 @@ class KafkaSampleStore(SampleStore):
                     for s in partition_samples
                 ],
                 keys=[
-                    str(s.partition).encode()
+                    f"{s.partition}:{s.time_ms}".encode()
                     for s in partition_samples
                 ],
             )
@@ -77,7 +79,7 @@ class KafkaSampleStore(SampleStore):
                     for s in broker_samples
                 ],
                 keys=[
-                    str(s.broker_id).encode()
+                    f"{s.broker_id}:{s.time_ms}".encode()
                     for s in broker_samples
                 ],
             )

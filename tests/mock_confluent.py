@@ -315,7 +315,15 @@ class MockProducer:
         self._pending = []
 
     def produce(self, topic, value=None, key=None, on_delivery=None):
+        limit = getattr(self.b, "produce_buffer_limit", None)
+        if limit is not None and len(self._pending) >= limit:
+            raise BufferError("Local: Queue full")
         self._pending.append((topic, key, value, on_delivery))
+
+    def poll(self, timeout=None):
+        n = len(self._pending)
+        self.flush(timeout)
+        return n
 
     def flush(self, timeout=None):
         import zlib

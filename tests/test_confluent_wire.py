@@ -306,3 +306,13 @@ def test_store_topics_are_retention_bounded(broker, wire):
     cfgs = broker.topic_configs["__KafkaCruiseControlPartitionMetricSamples"]
     assert cfgs["cleanup.policy"] == "delete"
     assert cfgs["retention.ms"] == "7200000"
+
+
+def test_produce_drains_on_local_queue_full(broker, wire):
+    """Batches larger than the client's local queue drain via poll() and
+    retry instead of leaking BufferError past the typed hierarchy."""
+    broker.produce_buffer_limit = 10
+    wire.create_topic("m")
+    wire.produce("m", [bytes([i]) for i in range(25)])
+    records, _ = wire.consume("m", 0)
+    assert len(records) == 25
