@@ -1,0 +1,199 @@
+"""Multi-host validation (round-2 VERDICT missing #3 / next-round #5).
+
+``parallel/mesh.py`` claims multi-host pods need no extra engine code
+because ``jax.devices()`` spans hosts under ``jax.distributed`` and the
+search's collectives ride the mesh axis.  This script turns that claim
+into evidence without TPU pod hardware: it launches N real OS processes,
+each a separate JAX controller with its own 4-device virtual CPU platform,
+joins them with ``jax.distributed.initialize`` (process 0 is the
+coordinator), and runs the device-RESIDENT sharded search over the GLOBAL
+2×4-device mesh — cross-process collectives and all.  Every process must
+produce the identical plan, and that plan must equal the single-process
+8-virtual-device run of the same fixture.
+
+Usage:
+  python benchmarks/multihost_dryrun.py               # parent: orchestrates
+  (the parent re-invokes itself with --child for each process)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SEED = 23
+BROKERS = 48
+RACKS = 6
+PARTITIONS = 768
+DEVICES_PER_PROC = 4
+
+
+def _plan(mesh) -> dict:
+    """Run the resident sharded search on the shared fixture → plan dict."""
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(
+        seed=SEED, num_brokers=BROKERS, num_racks=RACKS,
+        num_partitions=PARTITIONS, mean_utilization=0.45,
+    )
+    cfg = TpuSearchConfig(max_rounds=60, topk_per_round=32,
+                          max_moves_per_round=8)
+    assert cfg.steps_per_call > 0  # resident path, not a fallback
+    opt = TpuGoalOptimizer(config=cfg, mesh=mesh)
+    result = opt.optimize(state)
+    return {
+        "actions": sorted(
+            [a.action_type.name, int(a.partition), int(a.slot),
+             int(a.source_broker), int(a.dest_broker), int(a.dest_slot)]
+            for a in result.actions
+        ),
+        "violation_score": float(result.violation_score_after),
+    }
+
+
+def run_child(process_id: int, num_processes: int, coordinator: str,
+              out_path: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.parallel.mesh import initialize_multihost
+
+    initialize_multihost(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    n_global = num_processes * DEVICES_PER_PROC
+    assert len(jax.devices()) == n_global, (
+        f"global device view: {len(jax.devices())} != {n_global}"
+    )
+    assert len(jax.local_devices()) == DEVICES_PER_PROC
+    from cruise_control_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_global)  # global mesh spanning both processes
+    plan = _plan(mesh)
+    with open(out_path, "w") as f:
+        json.dump({"process_id": process_id,
+                   "num_devices": n_global, **plan}, f)
+
+
+def run_single(out_path: str, n_devices: int) -> None:
+    """Single-process n-virtual-device oracle for the same fixture."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cruise_control_tpu.parallel.mesh import make_mesh
+
+    plan = _plan(make_mesh(n_devices))
+    with open(out_path, "w") as f:
+        json.dump({"process_id": -1, **plan}, f)
+
+
+def _spawn(args, n_devices: int):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""   # never dial the TPU relay
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), *args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def run_parent(num_processes: int = 2, port: int = 0) -> dict:
+    import socket
+
+    if port == 0:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    tmp = tempfile.mkdtemp(prefix="multihost_dryrun_")
+    outs = [os.path.join(tmp, f"plan_{i}.json")
+            for i in range(num_processes)]
+    single_out = os.path.join(tmp, "plan_single.json")
+
+    n_global = num_processes * DEVICES_PER_PROC
+    children = [
+        _spawn(["--child", str(i), "--num-processes", str(num_processes),
+                "--coordinator", coordinator, "--out", outs[i]],
+               DEVICES_PER_PROC)
+        for i in range(num_processes)
+    ]
+    single = _spawn(
+        ["--single", "--devices", str(n_global), "--out", single_out],
+        n_global,
+    )
+    procs = children + [single]
+    failures = []
+    try:
+        for i, c in enumerate(children):
+            out, _ = c.communicate(timeout=900)
+            if c.returncode != 0:
+                failures.append((f"child {i}", out.decode()[-4000:]))
+        out, _ = single.communicate(timeout=900)
+        if single.returncode != 0:
+            failures.append(("single", out.decode()[-4000:]))
+    finally:
+        # one deadlocked child (e.g. a peer died mid-collective) must not
+        # leak the rest of the fleet; these are plain CPU subprocesses
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if failures:
+        raise RuntimeError(
+            "multihost dryrun process failures:\n" + "\n\n".join(
+                f"--- {name} ---\n{log}" for name, log in failures)
+        )
+
+    plans = [json.load(open(p)) for p in outs]
+    oracle = json.load(open(single_out))
+    for p in plans:
+        assert p["num_devices"] == num_processes * DEVICES_PER_PROC
+        assert p["actions"] == oracle["actions"], (
+            f"process {p['process_id']} plan diverged from single-process: "
+            f"{len(p['actions'])} vs {len(oracle['actions'])} actions"
+        )
+        assert p["violation_score"] == oracle["violation_score"]
+    return {
+        "num_processes": num_processes,
+        "devices_per_process": DEVICES_PER_PROC,
+        "actions": len(oracle["actions"]),
+        "violation_score": oracle["violation_score"],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", type=int, default=None)
+    ap.add_argument("--single", action="store_true")
+    ap.add_argument("--num-processes", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=2 * DEVICES_PER_PROC)
+    ap.add_argument("--coordinator", default="127.0.0.1:43219")
+    ap.add_argument("--out", default="multihost_plan.json")
+    args = ap.parse_args()
+    if args.child is not None:
+        run_child(args.child, args.num_processes, args.coordinator, args.out)
+    elif args.single:
+        run_single(args.out, args.devices)
+    else:
+        summary = run_parent(args.num_processes)
+        print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

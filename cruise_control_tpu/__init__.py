@@ -23,18 +23,18 @@ def _enable_persistent_compilation_cache() -> None:
         return
     if _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return  # the host application already configured a cache; respect it
-    cache_dir = _os.environ.get(
-        "CC_TPU_COMPILATION_CACHE_DIR",
-        _os.path.join(_os.path.expanduser("~"), ".cache", "cruise_control_tpu_xla"),
-    )
     try:
         import jax
 
         if jax.config.jax_compilation_cache_dir is not None:
             return  # ditto for in-process configuration
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # enable() owns the dir resolution (one canonical location for
+        # import-time, bootstrap, and bench paths) and keys it by a host
+        # fingerprint so a shared home dir can never serve an AOT blob
+        # compiled on another machine (the round-2 bench-tail error wall)
+        from cruise_control_tpu.utils.jit_cache import enable
+
+        enable()
     except Exception:  # pragma: no cover - older jax or restricted fs
         pass
 

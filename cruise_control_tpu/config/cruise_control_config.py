@@ -618,7 +618,7 @@ def default_config_def() -> ConfigDef:
              at_least(0), G)
     d.define("tpu.persistent.compilation.cache.dir", ConfigType.STRING, None,
              Importance.LOW, "XLA persistent compilation cache directory "
-             "(None = ~/.cache/cruise_control_tpu/jax).", None, G)
+             "(None = ~/.cache/cruise_control_tpu_xla, host-fingerprinted).", None, G)
     d.define("tpu.search.max.rounds", ConfigType.INT, 150,
              Importance.MEDIUM, "Score-only search round budget.",
              at_least(1), G)

@@ -66,6 +66,17 @@ class KafkaClusterBackend(ClusterBackend):
             self.refresh_mapping()
         return self._key_of[tp]
 
+    def try_key(self, tp: TopicPartition,
+                refresh: bool = True) -> Optional[int]:
+        """``key`` without the exception — and with the metadata refresh
+        under the CALLER's control, so a batch decoding thousands of
+        records for a stale topic refreshes once, not per record."""
+        k = self._key_of.get(tp)
+        if k is None and refresh:
+            self.refresh_mapping()
+            k = self._key_of.get(tp)
+        return k
+
     def tp(self, key: int) -> TopicPartition:
         return self._tp_of[key]
 

@@ -148,9 +148,15 @@ class KafkaMetricsReporterSampler(MetricSampler):
         self.metadata = metadata
         self._offset = 0
         self._pending: List[CruiseControlMetric] = []
-        #: records dropped because they could not be decoded / resolved
+        #: records dropped because they could not be decoded / resolved —
+        #: genuine problems worth a warning
         self.skipped = 0
+        #: well-formed records whose type id this framework does not model
+        #: (a real Java reporter emits dozens of request-time metrics we
+        #: don't consume) — expected on a real cluster, debug-level only
+        self.unmodeled = 0
         self._warned_at = 0
+        self._batch_refreshed = False
 
     # ---- envelope → framework records --------------------------------------
     def _dense_key(self, topic: str, partition: int) -> Optional[int]:
@@ -158,8 +164,18 @@ class KafkaMetricsReporterSampler(MetricSampler):
             return partition  # private dense addressing (reporter twin)
         if self.metadata is None:
             return None
+        tp = (topic, partition)
+        try_key = getattr(self.metadata, "try_key", None)
+        if try_key is not None:
+            # refresh the metadata mapping at most ONCE per batch: a topic
+            # full of stale records must not become one full-cluster
+            # describe RPC per record
+            k = try_key(tp, refresh=not self._batch_refreshed)
+            if k is None:
+                self._batch_refreshed = True
+            return k
         try:
-            return self.metadata.key((topic, partition))
+            return self.metadata.key(tp)
         except KeyError:
             return None
 
@@ -174,13 +190,16 @@ class KafkaMetricsReporterSampler(MetricSampler):
         for r in envelopes:
             if r.metric_class == MetricClassId.BROKER:
                 if r.metric_type is None:
-                    self.skipped += 1
+                    self.unmodeled += 1
                     continue
                 out.append(CruiseControlMetric(
                     r.metric_type, r.time_ms, r.broker_id, r.value))
             elif r.metric_class == MetricClassId.PARTITION:
+                if r.metric_type is None:
+                    self.unmodeled += 1
+                    continue
                 dense = self._dense_key(r.topic, r.partition)
-                if dense is None or r.metric_type is None:
+                if dense is None:
                     self.skipped += 1
                     continue
                 if r.metric_type == RawMetricType.PARTITION_SIZE:
@@ -191,7 +210,7 @@ class KafkaMetricsReporterSampler(MetricSampler):
                 if r.type_id in (TOPIC_BYTES_IN_ID, TOPIC_BYTES_OUT_ID):
                     topic_rates.append(r)
                 else:
-                    self.skipped += 1
+                    self.unmodeled += 1
         out.extend(self._distribute_topic_rates(topic_rates, sizes))
         return out
 
@@ -240,6 +259,7 @@ class KafkaMetricsReporterSampler(MetricSampler):
     # ---- sampling ----------------------------------------------------------
     def get_samples(self, start_ms: int, end_ms: int):
         raw, self._offset = self.wire.consume(self.topic, self._offset)
+        self._batch_refreshed = False
         envelopes: List[EnvelopeRecord] = []
         records: List[CruiseControlMetric] = list(self._pending)
         for r in raw:
@@ -248,9 +268,12 @@ class KafkaMetricsReporterSampler(MetricSampler):
                     envelopes.append(decode_record(r))
                 else:
                     records.append(decode_metric_json(r))
-            except (EnvelopeError, ValueError, KeyError):
+            except (EnvelopeError, ValueError, KeyError, TypeError):
                 self.skipped += 1
         records.extend(self._convert(envelopes))
+        if self.unmodeled:
+            LOG.debug("metrics sampler: %d records of unmodeled type ids "
+                      "so far (expected on a real cluster)", self.unmodeled)
         if self.skipped > self._warned_at:
             # surfacing matters: a topic full of undecodable records
             # otherwise looks like "no metrics" and the monitor never

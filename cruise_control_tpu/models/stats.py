@@ -84,7 +84,11 @@ def cluster_stats(state: ClusterState) -> ClusterStats:
     if any(isinstance(a, jax.Array) for a in args):
         return _cluster_stats_jit(*args, state.num_topics)
     try:
-        cpu = jax.devices("cpu")[0]
+        # local_devices, not devices: under a multi-controller deployment
+        # (jax.distributed) global device 0 belongs to process 0 only —
+        # pinning to it would make every other process's stats output
+        # unfetchable ("not fully addressable")
+        cpu = jax.local_devices(backend="cpu")[0]
     except RuntimeError:  # CPU backend disabled (e.g. JAX_PLATFORMS=tpu)
         return _cluster_stats_jit(*args, state.num_topics)
     with jax.default_device(cpu):

@@ -8,9 +8,16 @@ the proposal-precompute thread pool.  The TPU-native equivalent is a
 batch, with per-device top-k merged over ICI by concatenation — no psum
 needed because top-k-of-concatenated-top-ks is exact.
 
-Multi-host pods need no extra code: `jax.devices()` already spans hosts
-under `jax.distributed`, and shard_map's collectives ride ICI within a pod
-slice (DCN only across slices).  On CPU test rigs,
+Multi-host pods: initialize each controller with :func:`initialize_multihost`
+(a thin wrapper over ``jax.distributed.initialize`` that also pins the
+process's default device to a LOCAL one — without that, jit on uncommitted
+host inputs targets global device 0, which only process 0 owns, and every
+other process dies with "Cannot reshard an input that is not fully
+addressable").  After that, `jax.devices()` spans hosts, :func:`make_mesh`
+builds the global mesh, and shard_map's collectives ride ICI within a pod
+slice (DCN only across slices).  Demonstrated end to end by
+``benchmarks/multihost_dryrun.py`` (2 OS processes × 4 virtual CPU devices,
+identical plans).  On single-process CPU test rigs,
 ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` fakes the mesh.
 """
 
@@ -37,6 +44,30 @@ _NO_REP_CHECK = (
 )
 
 SEARCH_AXIS = "search"
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join this process to a multi-controller deployment.
+
+    Wraps ``jax.distributed.initialize`` (args may be None on platforms
+    with an environment-provided cluster spec, e.g. TPU pods) and pins the
+    process default device to its first LOCAL device: uncommitted
+    single-controller computations (host-side stats, model staging) then
+    stay process-local, while mesh-annotated computations span the global
+    device set.  Call before any other jax computation."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)
+    jax.config.update("jax_default_device", jax.local_devices()[0])
 
 
 def shard_map_norep(fn, mesh: Mesh, in_specs, out_specs):

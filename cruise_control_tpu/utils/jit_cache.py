@@ -40,6 +40,22 @@ def host_fingerprint() -> str:
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
+def default_cache_dir() -> str:
+    """The ONE place the cache location is resolved (package import,
+    bootstrap, and the bench entry points all route here): explicit
+    argument → ``CC_TPU_COMPILATION_CACHE_DIR`` / ``CRUISE_JIT_CACHE``
+    env → ``~/.cache/cruise_control_tpu_xla``."""
+    return (
+        os.environ.get("CC_TPU_COMPILATION_CACHE_DIR")
+        or os.environ.get("CRUISE_JIT_CACHE")
+        or os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "cruise_control_tpu_xla",
+        )
+    )
+
+
 def enable(cache_dir: str | None = None) -> None:
     import jax
 
@@ -47,14 +63,7 @@ def enable(cache_dir: str | None = None) -> None:
     # read-only installed copy, and enable() is called unconditionally by
     # the bench entry points — an unwritable dir must degrade to uncached,
     # never crash
-    cache_dir = cache_dir or os.environ.get(
-        "CRUISE_JIT_CACHE",
-        os.path.join(
-            os.environ.get("XDG_CACHE_HOME")
-            or os.path.join(os.path.expanduser("~"), ".cache"),
-            "cruise_control_tpu", "jax",
-        ),
-    )
+    cache_dir = cache_dir or default_cache_dir()
     # host-keyed subdirectory: a shared/home-mounted cache dir can never
     # serve an AOT blob compiled on a different machine
     cache_dir = os.path.join(os.path.abspath(cache_dir), host_fingerprint())
