@@ -40,6 +40,9 @@ def timed(name, fn):
 
 
 def main() -> None:
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
     from cruise_control_tpu.utils.jit_cache import enable as _jc
     _jc()
     ap = argparse.ArgumentParser()
@@ -47,6 +50,8 @@ def main() -> None:
     ap.add_argument("--partitions", type=int, default=1000000)
     ap.add_argument("--racks", type=int, default=200)
     ap.add_argument("--budget", type=float, default=0.0)
+    ap.add_argument("--slack", type=float, default=1.0,
+                    help="cohort budget slack factor")
     ap.add_argument("--warm", action="store_true",
                     help="run optimize twice; report the second (compile "
                          "amortized) with phase timers reset")
@@ -70,6 +75,7 @@ def main() -> None:
         "upload", T.TpuGoalOptimizer._device_model
     )
     step_counts_log = []
+    diag_log = []
     orig_fetch = T._fetch_scan_result
 
     def fetch_wrap(packed, Tn):
@@ -78,6 +84,8 @@ def main() -> None:
         TIMES["fetch"] += time.perf_counter() - t0
         COUNTS["fetch"] += 1
         step_counts_log.append(out[4].copy())
+        if isinstance(out[-1], dict):
+            diag_log.append(out[-1])
         return out
 
     T._fetch_scan_result = fetch_wrap
@@ -100,13 +108,15 @@ def main() -> None:
 
     T._cached_scan_fn = scan_wrap
 
-    cfg = T.TpuSearchConfig(time_budget_s=args.budget)
+    cfg = T.TpuSearchConfig(time_budget_s=args.budget,
+                            cohort_budget_slack=args.slack)
     opt = T.TpuGoalOptimizer(config=cfg)
     if args.warm:
         opt.optimize(state)
         TIMES.clear()
         COUNTS.clear()
         step_counts_log.clear()
+        diag_log.clear()
     t0 = time.perf_counter()
     result = opt.optimize(state)
     total = time.perf_counter() - t0
@@ -140,6 +150,25 @@ def main() -> None:
             "p90": int(np.percentile(ex, 90)),
             "max": int(ex.max()),
         }
+        if diag_log:
+            # executed-step availability: how much improving work each
+            # snapshot exposed, and which mechanism admitted commits
+            n_ex = [len(e) for e in executed]
+            imp = np.concatenate([
+                d["improving"][:n] for d, n in zip(diag_log, n_ex)
+            ])
+            coh = np.concatenate([
+                d["cohort"][:n] for d, n in zip(diag_log, n_ex)
+            ])
+            auc = np.concatenate([
+                d["auction"][:n] for d, n in zip(diag_log, n_ex)
+            ])
+            out["availability"] = {
+                "improving_mean": round(float(imp.mean()), 1),
+                "improving_p50": int(np.percentile(imp, 50)),
+                "cohort_mean": round(float(coh.mean()), 1),
+                "auction_mean": round(float(auc.mean()), 1),
+            }
     print(json.dumps(out, indent=1))
 
 

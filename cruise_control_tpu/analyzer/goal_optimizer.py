@@ -216,6 +216,28 @@ class OptimizerResult:
                 "aborted": self.execution.aborted,
                 "succeeded": self.execution.succeeded,
             }
+        # upstream OptimizationResult movement accounting (the numbers the
+        # proposals UI/clients render): replica moves = replicas gaining a
+        # new broker, dataToMoveMB = their disk footprint
+        n_replica_moves = n_leader_moves = n_disk_moves = 0
+        data_mb = 0.0
+        disk = None
+        if self.final_state is not None:
+            import numpy as np
+
+            from cruise_control_tpu.common.resources import Resource
+
+            leader_disk = np.asarray(
+                self.final_state.leader_load[:, Resource.DISK]
+            )
+            disk = leader_disk
+        for p in self.proposals:
+            added = set(p.new_replicas) - set(p.old_replicas)
+            n_replica_moves += len(added)
+            n_leader_moves += int(p.has_leader_change)
+            n_disk_moves += len(p.disk_moves)
+            if disk is not None and added and p.partition < len(disk):
+                data_mb += float(disk[p.partition]) * len(added)
         return {
             "engine": self.engine,
             "execution": exec_summary,
@@ -224,6 +246,10 @@ class OptimizerResult:
             ),
             "numProposals": len(self.proposals),
             "numActions": len(self.actions),
+            "numReplicaMovements": n_replica_moves,
+            "numLeaderMovements": n_leader_moves,
+            "numIntraBrokerReplicaMovements": n_disk_moves,
+            "dataToMoveMB": round(data_mb, 3),
             "violationsBefore": self.violations_before,
             "violationsAfter": self.violations_after,
             "violationScoreBefore": self.violation_score_before,

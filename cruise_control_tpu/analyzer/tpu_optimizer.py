@@ -144,6 +144,56 @@ class TpuSearchConfig:
     #: improves the convex cost regardless of what else the batch commits).
     #: 1 restores strict one-move-per-source batches
     moves_per_src: int = 4
+    #: incremental rescore between repools (round-3 VERDICT item #1) —
+    #: OFF by default, on measurement.  The move grid decomposes as
+    #: score(k, d) = src_term(k) + destterm(k, d) (ops.grid), and a
+    #: committed batch only changes terms whose broker aggregates or
+    #: partition rows it touched — so the carry can store each row's top-R
+    #: *destterms*, recompute the O(K) source columns per step (absorbing
+    #: source-broker staleness with no grid work: a uniform per-row shift
+    #: preserves the destination ranking), rescore touched destination
+    #: COLUMNS across all rows, and rescore partition-touched ROWS
+    #: full-width.  Measured on the real v5e at north-star shapes
+    #: (round 3), this did NOT pay: per-step device time was unchanged
+    #: within noise (27.5–27.6 vs 28.1 ms) because the step is dominated
+    #: by the O(K) term gathers, the leadership scoring, and the
+    #: selection/cohort machinery — not by the K×D broadcast the patch
+    #: avoids (XLA already streams that fused into top-k) — while the one
+    #: approximation (an unchanged destination ranked below the stored
+    #: top-R cannot re-enter until refresh) thinned per-step commit
+    #: availability enough to ADD 7–21% more steps (2 069–2 360 vs 1 858
+    #: even with the refresh cadence below).  Kept as an option because
+    #: the patch is exact per entry and near-free at mid scale; the
+    #: default stays the full per-step rescore.
+    incremental_rescore: bool = False
+    #: staleness budgets (partition-touched rows / destination columns /
+    #: leadership entries rescored per step before falling back to a full
+    #: rescore)
+    rescore_rows_budget: int = 512
+    rescore_cols_budget: int = 128
+    rescore_lead_budget: int = 2048
+    #: force a full rescore every this many steps regardless of staleness.
+    #: Bounds the alternate-depth thinning: as commits warm the cold
+    #: destination set, each row's true next-best alternates come from
+    #: unchanged destinations ranked below the stored top-R, which patching
+    #: cannot re-admit — measured at the north-star scale, unbounded
+    #: patching thinned availability enough to ADD ~20% more steps, costing
+    #: more than the rescore saved.  Small cadences keep ~7/8 of the
+    #: patch's per-step win while restoring full alternate depth before
+    #: drift compounds (0 = never force)
+    rescore_refresh_steps: int = 8
+    #: budgeted-cohort slack: multiply the water-filling surplus/deficit
+    #: budgets (soft dims only — the percentile hard-capacity headroom is
+    #: never relaxed) by this factor.  1.0 keeps the strict guarantee that
+    #: every cohort member improves regardless of batch composition;
+    #: larger values trade that certainty for per-step availability — the
+    #: host exact-recheck filters any over-admitted action and the device
+    #: model resyncs, so correctness is unaffected, only wasted work is
+    #: possible.  Measured on the north-star fixture the strict budgets
+    #: admitted only ~4 of ~250 steady-state improving candidates per
+    #: step (the disjoint auction carried ~36), leaving the run
+    #: availability-limited.
+    cohort_budget_slack: float = 1.0
     #: anytime budget: stop starting new search rounds once this many
     #: seconds have elapsed (0 = unlimited).  Hard-goal work (offline-
     #: replica evacuation) always runs to completion — only soft-goal
@@ -718,7 +768,10 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
     round-trip.  The host replays the sequence through the exact evaluator
     and reuses the returned model when every action validates (the common
     case)."""
-    from cruise_control_tpu.ops.grid import move_grid_scores
+    from cruise_control_tpu.ops.grid import (
+        move_grid_scores,
+        move_grid_terms,
+    )
 
     _resolve_scoring(cfg, mesh)  # validates the scoring choice
     M = cfg.device_batch_per_step
@@ -727,7 +780,8 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
     n_dev = mesh.shape[axis] if mesh is not None else 1
 
     def step(carry):
-        m, ca, done, t, count, out, counts, pools, since_pool = carry
+        (m, ca, done, t, count, out, counts, pools, since_pool, sc, tb,
+         tpm, n_ovf, since_full) = carry
         need_pool = since_pool >= repool
         pools = jax.lax.cond(
             need_pool,
@@ -741,10 +795,133 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         NROW = (Q + 1) * B
         M_ = min(M, NROW)
         grid_fn = move_grid_scores
-        kp, ks, row_scores, best_d, lp, lsl, l_scores = (
-            _reduced_candidates(m, cfg, ca, K, D, grid_fn, pools=pools,
-                                axis=axis, n_dev=n_dev)
-        )
+        kp_p, ks_p, dest_pool, lp_p, lsl_p = pools
+        L = lp_p.shape[0]
+        R = min(DESTS_PER_SOURCE, D)
+        # this device's row slices (whole pools when unsharded; see
+        # _reduced_candidates for the clamp-duplication note)
+        if axis is None:
+            kp_l, ks_l, lp_l, lsl_l = kp_p, ks_p, lp_p, lsl_p
+            Kl, Ll = K, L
+        else:
+            ai = jax.lax.axis_index(axis)
+            Kl = -(-K // n_dev)
+            rows = jnp.clip(ai * Kl + jnp.arange(Kl, dtype=jnp.int32), 0,
+                            K - 1)
+            kp_l, ks_l = kp_p[rows], ks_p[rows]
+            Ll = -(-L // n_dev)
+            lrows = jnp.clip(ai * Ll + jnp.arange(Ll, dtype=jnp.int32), 0,
+                             L - 1)
+            lp_l, lsl_l = lp_p[lrows], lsl_p[lrows]
+        dt_l, bd_l, ls_l = sc
+        # the [K]-column source terms are recomputed EVERY step (O(K), the
+        # cheap axis); the stored per-row top-R carries only the
+        # destination-side part of each score.  The grid decomposes as
+        # score(k, d) = src_term(k) + destterm(k, d) (ops.grid), so a
+        # committed batch that touches a SOURCE broker shifts its rows
+        # uniformly — the stored per-row destination ranking stays valid
+        # and no grid work is needed; only partition-touched rows and
+        # touched destination columns ever rescore.
+        terms_l = move_grid_terms(m, cfg, ca, kp_l, ks_l)
+        src_term_l = terms_l["src_term"]
+
+        def full_rescore(_):
+            g = grid_fn(m, cfg, ca, kp_l, ks_l, dest_pool,
+                        terms=terms_l)                      # [Kl, D]
+            neg, bi = jax.lax.top_k(-g, R)
+            ls, _ = _score_candidates(
+                m, cfg, ca, jnp.ones(Ll, jnp.int32), lp_l, lsl_l,
+                jnp.zeros(Ll, jnp.int32),
+            )
+            return -neg - src_term_l[:, None], dest_pool[bi], ls
+
+        if cfg.incremental_rescore:
+            RB = min(Kl, cfg.rescore_rows_budget)
+            CB = min(D, cfg.rescore_cols_budget)
+            LB = min(Ll, cfg.rescore_lead_budget)
+            row_stale = tpm[kp_l]          # partition changed: full row
+            col_stale = (dest_pool >= 0) & tb[jnp.clip(dest_pool, 0)]
+            lb_l = jnp.clip(jnp.take_along_axis(
+                m.assignment[lp_l], m.leader_slot[lp_l][:, None], axis=1
+            )[:, 0], 0)
+            slb_l = jnp.clip(m.assignment[lp_l, lsl_l], 0)
+            l_stale = tpm[lp_l] | tb[lb_l] | tb[slb_l]
+            overflow = (
+                (jnp.sum(row_stale) > RB)
+                | (jnp.sum(col_stale) > CB)
+                | (jnp.sum(l_stale) > LB)
+            )
+            refresh_due = (
+                cfg.rescore_refresh_steps > 0
+            ) and (since_full >= cfg.rescore_refresh_steps)
+            fresh = need_pool | overflow | refresh_due
+            n_ovf = n_ovf + jnp.where(overflow & ~need_pool, 1, 0)
+
+            def patch_rescore(_):
+                # (a) stale destination columns, all rows (padding scores
+                # +inf via the grid's dest >= 0 mask)
+                corder = jnp.argsort(~col_stale)
+                cidx = corder[:CB]
+                dp_c = jnp.where(col_stale[cidx], dest_pool[cidx], -1)
+                g_c = grid_fn(m, cfg, ca, kp_l, ks_l, dp_c,
+                              terms=terms_l)                # [Kl, CB]
+                dt_c = g_c - src_term_l[:, None]            # inf stays inf
+                # merge by destterm (src_term is common per row, so the
+                # ranking is the same): stored top-R with stale-destination
+                # entries invalidated (their fresh values are in dt_c) ∪ (a)
+                stored = jnp.where(tb[jnp.clip(bd_l, 0)], jnp.inf, dt_l)
+                merged_s = jnp.concatenate([stored, dt_c], axis=1)
+                merged_d = jnp.concatenate(
+                    [bd_l, jnp.broadcast_to(dp_c[None, :], (Kl, CB))],
+                    axis=1,
+                )
+                negm, mi = jax.lax.top_k(-merged_s, R)
+                new_dt = -negm
+                new_bd = jnp.take_along_axis(merged_d, mi, axis=1)
+                # (b) partition-touched rows: full destination width
+                rorder = jnp.argsort(~row_stale)       # stable: stale first
+                ridx = rorder[:RB]
+                rok = row_stale[ridx]
+                g_r = grid_fn(m, cfg, ca, kp_l[ridx], ks_l[ridx], dest_pool)
+                negr, bir = jax.lax.top_k(-g_r, R)
+                dt_r = -negr - src_term_l[ridx][:, None]
+                new_dt = new_dt.at[ridx].set(
+                    jnp.where(rok[:, None], dt_r, new_dt[ridx])
+                )
+                new_bd = new_bd.at[ridx].set(
+                    jnp.where(rok[:, None], dest_pool[bir], new_bd[ridx])
+                )
+                # leadership entries rescored in place (exact)
+                lorder = jnp.argsort(~l_stale)
+                lidx = lorder[:LB]
+                lok = l_stale[lidx]
+                ls_f, _ = _score_candidates(
+                    m, cfg, ca, jnp.ones(LB, jnp.int32), lp_l[lidx],
+                    lsl_l[lidx], jnp.zeros(LB, jnp.int32),
+                )
+                new_ls = ls_l.at[lidx].set(
+                    jnp.where(lok, ls_f, ls_l[lidx])
+                )
+                return new_dt, new_bd, new_ls
+
+            dt_l, bd_l, ls_l = jax.lax.cond(
+                fresh, full_rescore, patch_rescore, None
+            )
+            since_full = jnp.where(fresh, 0, since_full + 1)
+        else:
+            dt_l, bd_l, ls_l = full_rescore(None)
+        sc = (dt_l, bd_l, ls_l)
+        rs_l = src_term_l[:, None] + dt_l
+        if axis is None:
+            kp, ks, row_scores, best_d = kp_p, ks_p, rs_l, bd_l
+            lp, lsl, l_scores = lp_p, lsl_p, ls_l
+        else:
+            def gather(x):
+                return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+            kp, ks = gather(kp_l), gather(ks_l)
+            row_scores, best_d = gather(rs_l), gather(bd_l)
+            lp, lsl, l_scores = gather(lp_l), gather(lsl_l), gather(ls_l)
         bl_score, bl_p, bl_s, bl_dst = _reduce_leadership_per_src(
             m, lp, lsl, l_scores
         )
@@ -809,6 +986,13 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
                 [move_vec, jnp.where(is_move_row[:, None], mlc, 0.0)], axis=1
             )
         src_budget, dst_budget = _step_budgets(m, ca)
+        if cfg.cohort_budget_slack != 1.0:
+            # relax the soft dims only; trailing percentile-capacity
+            # headroom dims (hard goal) stay exact
+            soft = NUM_RESOURCES + 2
+            s_ = jnp.float32(cfg.cohort_budget_slack)
+            src_budget = src_budget.at[:, :soft].multiply(s_)
+            dst_budget = dst_budget.at[:, :soft].multiply(s_)
         qualified = (
             is_move_row
             & ~leader_now_q
@@ -920,21 +1104,40 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # step overwrites this one's invalid tail.  The loop condition
         # guarantees count ≤ slots - M_ on entry, so the slice never clamps
         out = jax.lax.dynamic_update_slice(out, batch, (0, count))
-        counts = counts.at[t].set(c_step)
+        # availability diagnostics (meta rows 1-3): how much improving
+        # work each snapshot exposed and which mechanism admitted it —
+        # the steps-not-step-cost analysis lives on these numbers
+        counts = counts.at[0, t].set(c_step)
+        counts = counts.at[1, t].set(jnp.sum(improving.astype(jnp.int32)))
+        counts = counts.at[2, t].set(jnp.sum(acc_b.astype(jnp.int32)))
+        counts = counts.at[3, t].set(
+            jnp.sum((take & ~acc_b).astype(jnp.int32))
+        )
+        # staleness footprint of this step's committed batch, consumed by
+        # the next step's incremental rescore: the brokers whose aggregates
+        # moved (sources + destinations) and the partitions whose rows
+        # changed
+        tb = (
+            jnp.zeros(B, bool)
+            .at[jnp.clip(cand_src, 0)].max(take_f)
+            .at[jnp.clip(win_dst, 0)].max(take_f)
+        )
+        tpm = jnp.zeros(P, bool).at[jnp.clip(cand_p, 0)].max(take_f)
         # zero commits on fresh pools = converged; on stale pools = force a
         # repool next step and keep going
         done = done | ((c_step == 0) & (since_pool == 0))
         since_pool = jnp.where(c_step == 0, repool, since_pool + 1)
         return (m, ca, done, t + 1, count + c_step, out, counts, pools,
-                since_pool)
+                since_pool, sc, tb, tpm, n_ovf, since_full)
 
     def cond_fn(slots):
         def cond(carry):
-            _, _, done, t, count, _, _, _, _ = carry
+            done, t, count = carry[2], carry[3], carry[4]
             return (~done) & (t < T) & (count <= slots)
         return cond
 
     def run(m: DeviceModel, ca):
+        P, S = m.assignment.shape
         B = m.capacity.shape[0]
         M_ = min(M, (max(1, cfg.moves_per_src) + 1) * B)
         # slot budget bounds memory like the pre-repool layout did (T and
@@ -942,23 +1145,37 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # end the call and the host issues another
         slots = min(T, repool) * M_
         out0 = jnp.full((4, slots), -1.0, jnp.float32)
+        L = _leadership_pool_size(P, S, K)
         pools0 = (
             jnp.zeros(K, jnp.int32), jnp.zeros(K, jnp.int32),
             jnp.zeros(D, jnp.int32),
-            jnp.zeros(_leadership_pool_size(*m.assignment.shape, K),
-                      jnp.int32),
-            jnp.zeros(_leadership_pool_size(*m.assignment.shape, K),
-                      jnp.int32),
+            jnp.zeros(L, jnp.int32),
+            jnp.zeros(L, jnp.int32),
         )
-        m, _, done, _, count, out, counts, _, _ = jax.lax.while_loop(
+        Kl = K if axis is None else -(-K // n_dev)
+        Ll = L if axis is None else -(-L // n_dev)
+        R = min(DESTS_PER_SOURCE, D)
+        sc0 = (
+            jnp.full((Kl, R), jnp.inf, jnp.float32),
+            jnp.full((Kl, R), -1, jnp.int32),
+            jnp.full((Ll,), jnp.inf, jnp.float32),
+        )
+        carry = jax.lax.while_loop(
             cond_fn(slots - M_), step,
             (m, ca, jnp.bool_(False), jnp.int32(0), jnp.int32(0), out0,
-             jnp.zeros(T, jnp.int32), pools0, jnp.int32(repool)),
+             jnp.zeros((4, T), jnp.int32), pools0, jnp.int32(repool), sc0,
+             jnp.zeros(B, bool), jnp.zeros(P, bool), jnp.int32(0),
+             jnp.int32(0)),
+        )
+        m, done, count, out, counts, n_ovf = (
+            carry[0], carry[2], carry[4], carry[5], carry[6], carry[12]
         )
         meta = jnp.zeros((4, T + 2), jnp.float32)
-        meta = meta.at[0, :T].set(counts.astype(jnp.float32))
+        meta = meta.at[:, :T].set(counts.astype(jnp.float32))
         meta = meta.at[0, T].set(count.astype(jnp.float32))
         meta = meta.at[0, T + 1].set(jnp.where(done, 1.0, 0.0))
+        # row 1 tail: full-rescore fallbacks forced by staleness overflow
+        meta = meta.at[1, T].set(n_ovf.astype(jnp.float32))
         return jnp.concatenate([out, meta], axis=1), m
 
     if mesh is None:
@@ -998,8 +1215,14 @@ def _fetch_scan_result(packed, T: int):
     counts = meta[0, :T].astype(np.int64)
     n = int(meta[0, T])
     done = bool(meta[0, T + 1] > 0)
+    diag = {
+        "n_overflow": int(meta[1, T]),
+        "improving": meta[1, :T].astype(np.int64),
+        "cohort": meta[2, :T].astype(np.int64),
+        "auction": meta[3, :T].astype(np.int64),
+    }
     kind, p, s, d = (body[i, :n].astype(np.int32) for i in range(4))
-    return kind, p, s, d, counts, done
+    return kind, p, s, d, counts, done, diag
 
 
 # ---------------------------------------------------------------------------------
@@ -2278,9 +2501,13 @@ class TpuGoalOptimizer:
                     break
                 packed, m_new = scan_fn(m, ca)
                 n_calls += 1
-                k_all, p_all, s_all, d_all, step_counts, device_done = (
-                    _fetch_scan_result(packed, cfg.steps_per_call)
-                )
+                (k_all, p_all, s_all, d_all, step_counts, device_done,
+                 diag) = _fetch_scan_result(packed, cfg.steps_per_call)
+                if diag["n_overflow"]:
+                    LOG.debug(
+                        "device call %d: %d staleness-overflow full "
+                        "rescores", n_calls, diag["n_overflow"],
+                    )
                 batch, rejected = 0, 0
                 off = 0
                 for c in step_counts:

@@ -174,6 +174,11 @@ def _tpu_search_config(cfg: CruiseControlConfig):
         scoring=cfg.get("tpu.search.scoring"),
         steps_per_call=cfg.get_int("tpu.search.steps.per.call"),
         repool_steps=cfg.get_int("tpu.search.repool.steps"),
+        incremental_rescore=cfg.get_boolean(
+            "tpu.search.incremental.rescore"),
+        rescore_rows_budget=cfg.get_int("tpu.search.rescore.rows.budget"),
+        rescore_cols_budget=cfg.get_int("tpu.search.rescore.cols.budget"),
+        rescore_lead_budget=cfg.get_int("tpu.search.rescore.lead.budget"),
         device_batch_per_step=cfg.get_int(
             "tpu.search.device.batch.per.step"),
         moves_per_src=cfg.get_int("tpu.search.moves.per.src"),

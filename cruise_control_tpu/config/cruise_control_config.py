@@ -668,6 +668,21 @@ def default_config_def() -> ConfigDef:
     d.define("tpu.search.repool.steps", ConfigType.INT, 64,
              Importance.LOW, "Steps between on-device candidate-pool "
              "rebuilds.", at_least(1), G)
+    d.define("tpu.search.incremental.rescore", ConfigType.BOOLEAN, False,
+             Importance.LOW,
+             "Patch only staleness-touched grid entries between repools "
+             "instead of full per-step rescores (off by default: measured "
+             "step-cost-neutral at north-star scale and thins per-step "
+             "commit availability).", None, G)
+    d.define("tpu.search.rescore.rows.budget", ConfigType.INT, 512,
+             Importance.LOW, "Partition-touched rows rescored per step "
+             "before falling back to a full rescore.", at_least(1), G)
+    d.define("tpu.search.rescore.cols.budget", ConfigType.INT, 128,
+             Importance.LOW, "Stale destination columns rescored per step "
+             "before falling back to a full rescore.", at_least(1), G)
+    d.define("tpu.search.rescore.lead.budget", ConfigType.INT, 2048,
+             Importance.LOW, "Stale leadership entries rescored per step "
+             "before falling back to a full rescore.", at_least(1), G)
     d.define("tpu.search.device.batch.per.step", ConfigType.INT, 0,
              Importance.LOW, "Actions committed per device step (0 = "
              "auto-scale with broker count).", at_least(0), G)

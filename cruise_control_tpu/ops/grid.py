@@ -120,10 +120,15 @@ def move_grid_scores(
     kp: jax.Array,
     ks: jax.Array,
     dest_pool: jax.Array,  # int32 [D] (may contain -1 shard padding)
+    terms: Dict[str, jax.Array] = None,
 ) -> jax.Array:
     """Scores [K, D] for every (source replica, destination) move; +inf where
-    infeasible.  Exact same mask + delta as the columnar scorer."""
-    t = move_grid_terms(m, cfg, ca, kp, ks)
+    infeasible.  Exact same mask + delta as the columnar scorer.
+
+    ``terms`` may pass in precomputed :func:`move_grid_terms` output (the
+    incremental rescore computes the [K] source columns once per step and
+    scores several destination subsets against them)."""
+    t = terms if terms is not None else move_grid_terms(m, cfg, ca, kp, ks)
     has_cap = m.broker_cload is not None
     d_c = jnp.clip(dest_pool, 0)
     d_cap = m.capacity[d_c]                               # [D, R]

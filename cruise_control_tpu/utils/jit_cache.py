@@ -25,10 +25,29 @@ def host_fingerprint() -> str:
     directory served blobs compiled elsewhere — round-2 VERDICT weak #5).
     Keying the cache dir by platform + CPU features + jax version makes a
     cross-machine hit impossible.
+
+    The JAX platform config is part of the key too: an accelerator plugin
+    (e.g. the axon TPU backend) sets XLA:CPU compile options that are
+    recorded as pseudo target features (+prefer-no-scatter/…), so CPU
+    blobs compiled inside an accelerator-attached process are rejected by
+    plain-CPU processes on the SAME host — the two flavors must not share
+    a directory.  Caveat: the flavor comes from ``jax.config.jax_platforms``
+    / ``JAX_PLATFORMS`` (reading the initialized backend here would force
+    backend init at import time — on a TPU host that dials the chip);
+    processes that set NEITHER share the "default" flavor, which is only a
+    problem when autodetection picks different backends for different
+    processes on one host — set JAX_PLATFORMS explicitly in that setup.
     """
+    import os as _os
+
     import jax
 
-    parts = [platform.system(), platform.machine(), jax.__version__]
+    flavor = str(
+        getattr(jax.config, "jax_platforms", None)
+        or _os.environ.get("JAX_PLATFORMS", "")
+        or "default"
+    )
+    parts = [platform.system(), platform.machine(), jax.__version__, flavor]
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:

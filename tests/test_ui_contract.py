@@ -1,0 +1,254 @@
+"""Operator-UI contract tests (round-2 VERDICT weak #4 / next-round #7).
+
+The dashboard's in-page JS is a thin fetch layer (``jget`` / ``post`` /
+``opQuery`` / ``opForm`` / ``review``) over the REST API.  These tests pin
+the CONTRACT that layer relies on, server-side, exactly as the browser
+exercises it (raw HTTP, no long-poll client):
+
+* every GET the page renders returns the keys the JS dereferences;
+* every mutating form's endpoint+params round-trip through the async
+  202 + ``User-Task-ID`` + ``user_tasks`` poll loop the page implements;
+* errors surface as JSON the page can render (the commit-4b6f814 class of
+  silently-swallowed review errors cannot recur);
+* the review-board two-step flow works end to end;
+* a vocabulary scan of ``ui.html`` fails this file when the page grows a
+  fetch call whose endpoint has no contract coverage here.
+"""
+
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from cruise_control_tpu.server import CruiseControlHttpServer
+
+from harness import full_stack
+
+UI_HTML = (
+    Path(__file__).resolve().parent.parent
+    / "cruise_control_tpu" / "server" / "ui.html"
+)
+
+#: endpoint vocabulary the dashboard uses (kept in lockstep with ui.html by
+#: test_ui_vocabulary_is_covered)
+UI_GET_ENDPOINTS = {
+    "state", "load", "user_tasks", "kafka_cluster_state",
+    "partition_load", "proposals", "review_board",
+}
+UI_POST_ENDPOINTS = {
+    "rebalance", "add_broker", "remove_broker", "demote_broker",
+    "topic_configuration", "fix_offline_replicas", "rightsize",
+    "pause_sampling", "resume_sampling", "stop_proposal_execution",
+    "review",
+}
+
+
+@pytest.fixture
+def server():
+    cc, backend, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    yield srv, cc, backend
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(f"{srv.url}/{path}") as r:
+        return json.loads(r.read()), r.status, dict(r.headers)
+
+
+def _post(srv, path):
+    req = urllib.request.Request(f"{srv.url}/{path}", method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read()), r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read()), e.code, dict(e.headers)
+
+
+def _poll_task(srv, task_id, timeout_s=30.0):
+    """The page's opQuery loop: poll user_tasks?user_task_ids=ID."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        body, status, _ = _get(srv, f"user_tasks?user_task_ids={task_id}")
+        tasks = body.get("userTasks", [])
+        if tasks and tasks[0]["Status"] != "Active":
+            return tasks[0]
+        time.sleep(0.1)
+    raise AssertionError(f"task {task_id} never completed")
+
+
+def test_ui_vocabulary_is_covered():
+    """Every endpoint ui.html's JS fetches must appear in the contract
+    tables above — adding a UI call without contract coverage fails here."""
+    js = UI_HTML.read_text()
+    gets = set(re.findall(r"jget\(\s*[`\"']([a-z_]+)", js))
+    # endpoints routed through post()/op()/opForm()/topicConfig in markup
+    posts = set(re.findall(r"(?:post|op|opForm)\('([a-z_]+)'", js))
+    posts |= set(re.findall(r"opQuery\(\"([a-z_]+)\"", js))
+    # raw fetch calls that bypass the helpers (e.g. review's own fetch)
+    posts |= set(re.findall(r"fetch\(`\$\{API\}/([a-z_]+)[?`]", js))
+    assert gets <= UI_GET_ENDPOINTS, gets - UI_GET_ENDPOINTS
+    assert posts <= UI_POST_ENDPOINTS, posts - UI_POST_ENDPOINTS
+    assert "review" in posts  # the raw-fetch scan actually fires
+
+
+def test_state_keys_the_overview_renders(server):
+    srv, _, _ = server
+    st, status, _ = _get(srv, "state")
+    assert status == 200
+    # rendered RAW by the page (undefined would show literally)
+    assert "upTimeSeconds" in st
+    assert "state" in st["MonitorState"]
+    assert "state" in st["ExecutorState"]
+    # tolerant reads (?.): key may be absent, but when present must have
+    # the shape the page dereferences
+    if "AnomalyDetectorState" in st:
+        assert isinstance(
+            st["AnomalyDetectorState"].get("recentAnomalies", []), list
+        )
+
+
+def test_load_keys_the_bars_render(server):
+    srv, _, _ = server
+    body, _, _ = _get(srv, "load")
+    brokers = body["brokers"]
+    assert brokers
+    for key in ("Broker", "BrokerState", "Rack", "CpuPct", "DiskMB",
+                "DiskCapacityMB", "NwInRate", "NwOutRate"):
+        assert key in brokers[0], (key, sorted(brokers[0]))
+
+
+def test_kafka_cluster_state_keys(server):
+    srv, _, _ = server
+    k, _, _ = _get(srv, "kafka_cluster_state")
+    parts = k["KafkaPartitionState"]["partitions"]
+    assert parts and {"topic", "partition", "leader", "replicas",
+                      "in-sync"} <= set(parts[0])
+    assert k["KafkaBrokerState"]["Brokers"]
+    assert "AliveBrokers" in k["KafkaBrokerState"]
+
+
+@pytest.mark.parametrize("resource,field", [
+    ("DISK", "disk"), ("CPU", "cpu"),
+    ("NW_IN", "networkInbound"), ("NW_OUT", "networkOutbound"),
+])
+def test_partition_load_field_per_resource(server, resource, field):
+    """The page's PL_FIELD mapping: each resource's records carry the field
+    the table reads."""
+    srv, _, _ = server
+    body, _, _ = _get(srv, f"partition_load?resource={resource}&entries=25")
+    recs = body["records"]
+    assert recs and field in recs[0], (resource, sorted(recs[0]))
+
+
+def test_proposals_keys_the_tab_renders(server):
+    """The proposals tab reads movement stats top-level and the proposal
+    rows' partition/oldReplicas/newReplicas (this test originally caught
+    the tab reading a non-existent `summary` sub-object and rendering
+    blanks — the server now carries the upstream movement stats)."""
+    srv, _, _ = server
+    body, _, _ = _get(srv, "proposals")
+    for key in ("numReplicaMovements", "numLeaderMovements",
+                "dataToMoveMB", "engine", "violationsAfter", "proposals"):
+        assert key in body, (key, sorted(body))
+    assert body["numReplicaMovements"] > 0
+    assert body["dataToMoveMB"] > 0
+    pr = body["proposals"][0]
+    assert {"partition", "oldReplicas", "newReplicas"} <= set(pr)
+    body2, _, _ = _get(srv, "proposals?ignore_proposal_cache=true")
+    assert "proposals" in body2
+
+
+def test_opquery_async_protocol_rebalance_form(server):
+    """The rebalance form: POST → 202 + User-Task-ID → poll to completion
+    with a result — the exact loop opQuery implements."""
+    srv, _, _ = server
+    body, status, headers = _post(
+        srv, "rebalance?dryrun=true&goals=ReplicaDistributionGoal"
+        "&engine=greedy")
+    assert status == 202, body
+    tid = headers.get("User-Task-ID")
+    assert tid
+    task = _poll_task(srv, tid)
+    assert task["Status"] == "Completed"
+    assert task.get("result", {}).get("numProposals", 0) >= 0
+
+
+@pytest.mark.parametrize("query", [
+    "add_broker?dryrun=true&brokerid=9",
+    "remove_broker?dryrun=true&brokerid=3",
+    "demote_broker?dryrun=true&brokerid=0",
+    "topic_configuration?dryrun=true&replication_factor=2",
+    "fix_offline_replicas?dryrun=true",
+    "rightsize?dryrun=true",
+])
+def test_every_mutating_form_completes(query):
+    """Each operations-tab form issues its endpoint+params and the async
+    loop reaches a terminal state (Completed or a rendered error)."""
+    cc, backend, _ = full_stack(extra_brokers=(9,))
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    try:
+        body, status, headers = _post(srv, query)
+        if status == 202:
+            task = _poll_task(srv, headers["User-Task-ID"])
+            assert task["Status"] in ("Completed", "CompletedWithError")
+        else:
+            assert status == 200, (query, status, body)
+    finally:
+        srv.stop()
+
+
+def test_simple_posts_return_json(server):
+    srv, _, _ = server
+    for ep in ("pause_sampling", "resume_sampling",
+               "stop_proposal_execution"):
+        body, status, _ = _post(srv, ep)
+        assert status == 200 and isinstance(body, dict), (ep, status)
+
+
+def test_review_two_step_flow_and_error_surfacing():
+    """The review tab end to end: submit → board lists it → approve →
+    execute with review_id; a bad review id surfaces a JSON error the page
+    renders (the commit-4b6f814 regression class)."""
+    cc, _, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0, two_step_verification=True)
+    srv.start()
+    try:
+        body, status, _ = _post(srv, "rebalance?dryrun=true")
+        assert "reviewId" in body, (status, body)
+        rid = body["reviewId"]
+        board, _, _ = _get(srv, "review_board")
+        reqs = board["requestInfo"]
+        mine = [r for r in reqs if r.get("Id", r.get("review_id")) == rid]
+        assert mine and mine[0]["Status"] == "PENDING_REVIEW"
+        # bad id → JSON error with a message, not a silent 200
+        err, code, _ = _post(srv, "review?approve=99999")
+        assert code >= 400 and isinstance(err, dict) and err, (code, err)
+        # approve + execute
+        ok, code, _ = _post(srv, f"review?approve={rid}&reason=lgtm")
+        assert code == 200, ok
+        body, status, headers = _post(
+            srv, f"rebalance?dryrun=true&review_id={rid}")
+        if status == 202:
+            task = _poll_task(srv, headers["User-Task-ID"])
+            assert task["Status"] == "Completed"
+        else:
+            assert status == 200
+    finally:
+        srv.stop()
+
+
+def test_ui_page_served_with_api_prefix(server):
+    srv, _, _ = server
+    req = urllib.request.Request(srv.url.rsplit("/kafkacruisecontrol", 1)[0]
+                                 + "/ui")
+    with urllib.request.urlopen(req) as r:
+        page = r.read().decode()
+    assert "__API_PREFIX__" not in page  # prefix substituted
+    assert "opQuery" in page
