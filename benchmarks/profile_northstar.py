@@ -52,6 +52,12 @@ def main() -> None:
     ap.add_argument("--budget", type=float, default=0.0)
     ap.add_argument("--slack", type=float, default=1.0,
                     help="cohort budget slack factor")
+    ap.add_argument("--dest-cap", type=int, default=1,
+                    help="auction winners per destination per step")
+    ap.add_argument("--src-cap", type=int, default=1,
+                    help="auction winners per source per step")
+    ap.add_argument("--diag", action="store_true",
+                    help="per-step availability diagnostics (~1 ms/step)")
     ap.add_argument("--warm", action="store_true",
                     help="run optimize twice; report the second (compile "
                          "amortized) with phase timers reset")
@@ -84,7 +90,9 @@ def main() -> None:
         TIMES["fetch"] += time.perf_counter() - t0
         COUNTS["fetch"] += 1
         step_counts_log.append(out[4].copy())
-        if isinstance(out[-1], dict):
+        if args.diag and isinstance(out[-1], dict):
+            # only meaningful when the scan computed the counters —
+            # without --diag the meta rows are zeros, not measurements
             diag_log.append(out[-1])
         return out
 
@@ -109,7 +117,10 @@ def main() -> None:
     T._cached_scan_fn = scan_wrap
 
     cfg = T.TpuSearchConfig(time_budget_s=args.budget,
-                            cohort_budget_slack=args.slack)
+                            cohort_budget_slack=args.slack,
+                            auction_dest_cap=args.dest_cap,
+                            auction_src_cap=args.src_cap,
+                            step_diagnostics=args.diag)
     opt = T.TpuGoalOptimizer(config=cfg)
     if args.warm:
         opt.optimize(state)

@@ -194,6 +194,31 @@ class TpuSearchConfig:
     #: step (the disjoint auction carried ~36), leaving the run
     #: availability-limited.
     cohort_budget_slack: float = 1.0
+    #: auction occupancy caps: winners one broker may host per step as a
+    #: destination / source (see _match_batch).  1 = strict snapshot
+    #: exactness; > 1 trades it for per-step availability with the host
+    #: exact-recheck as the guard
+    auction_dest_cap: int = 1
+    auction_src_cap: int = 1
+    #: stacking guard for caps > 1: a second/third winner on an occupied
+    #: broker must score at least this fraction of that broker's FIRST
+    #: winner this step.  Scale-free damping — without it, stacking admits
+    #: arbitrarily marginal moves whose pre-batch scores overstate
+    #: (measured: 300× plan bloat of micro-actions at small scale); with
+    #: it, only comparably-strong work (bulk drains, wide imbalances)
+    #: stacks
+    auction_stack_ratio: float = 0.5
+    #: auction rounds (0 = one per alternate destination, the default).
+    #: More rounds let tie-break losers re-propose after their blockers
+    #: resolve — raises matches per step when the auction is
+    #: round-dynamics-bound rather than destination-bound (measured NOT
+    #: the case at 200b/5k: 24 rounds matched the default's plan)
+    auction_rounds: int = 0
+    #: per-step availability diagnostics in the scan meta (improving /
+    #: cohort / auction counts — benchmarks/profile_northstar.py reports
+    #: them).  Off by default: the extra reductions cost ~1 ms/step at
+    #: north-star shapes
+    step_diagnostics: bool = False
     #: anytime budget: stop starting new search rounds once this many
     #: seconds have elapsed (0 = unlimited).  Hard-goal work (offline-
     #: replica evacuation) always runs to completion — only soft-goal
@@ -798,8 +823,12 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         kp_p, ks_p, dest_pool, lp_p, lsl_p = pools
         L = lp_p.shape[0]
         R = min(DESTS_PER_SOURCE, D)
-        # this device's row slices (whole pools when unsharded; see
-        # _reduced_candidates for the clamp-duplication note)
+        # this device's row slices (whole pools when unsharded).  NOTE:
+        # this slice/clamp/all_gather layout is the twin of
+        # _reduced_candidates' sharded path (the score-only rounds still
+        # call that helper) — a change to either copy's slicing or
+        # clamp-duplication handling must be mirrored in the other, or
+        # the two paths' shardings silently diverge
         if axis is None:
             kp_l, ks_l, lp_l, lsl_l = kp_p, ks_p, lp_p, lsl_p
             Kl, Ll = K, L
@@ -1069,7 +1098,10 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         take_d, win_score_d, win_dst_d = _match_batch(
             jnp.where(acc_b[:, None], jnp.inf, cand_score),
             cand_dst, cand_src, rep, cfg.improvement_tol, B, C,
-            init_used=used0,
+            init_used=used0, dest_cap=cfg.auction_dest_cap,
+            src_cap=cfg.auction_src_cap,
+            stack_ratio=cfg.auction_stack_ratio,
+            rounds=cfg.auction_rounds,
         )
         take = acc_b | take_d
         win_score = jnp.where(acc_b, cand_score[:, 0], win_score_d)
@@ -1104,15 +1136,17 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # step overwrites this one's invalid tail.  The loop condition
         # guarantees count ≤ slots - M_ on entry, so the slice never clamps
         out = jax.lax.dynamic_update_slice(out, batch, (0, count))
-        # availability diagnostics (meta rows 1-3): how much improving
-        # work each snapshot exposed and which mechanism admitted it —
-        # the steps-not-step-cost analysis lives on these numbers
         counts = counts.at[0, t].set(c_step)
-        counts = counts.at[1, t].set(jnp.sum(improving.astype(jnp.int32)))
-        counts = counts.at[2, t].set(jnp.sum(acc_b.astype(jnp.int32)))
-        counts = counts.at[3, t].set(
-            jnp.sum((take & ~acc_b).astype(jnp.int32))
-        )
+        if cfg.step_diagnostics:
+            # availability diagnostics (meta rows 1-3): how much improving
+            # work each snapshot exposed and which mechanism admitted it —
+            # the steps-not-step-cost analysis lives on these numbers
+            counts = counts.at[1, t].set(
+                jnp.sum(improving.astype(jnp.int32)))
+            counts = counts.at[2, t].set(jnp.sum(acc_b.astype(jnp.int32)))
+            counts = counts.at[3, t].set(
+                jnp.sum((take & ~acc_b).astype(jnp.int32))
+            )
         # staleness footprint of this step's committed batch, consumed by
         # the next step's incremental rescore: the brokers whose aggregates
         # moved (sources + destinations) and the partitions whose rows
@@ -2066,19 +2100,34 @@ def _budget_accept(dst_ids, src_ids, vec, dst_budget, src_budget, eligible,
 
 
 def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
-                 P: int, init_used=None):
+                 P: int, init_used=None, dest_cap: int = 1,
+                 src_cap: int = 1, stack_ratio: float = 0.5,
+                 rounds: int = 0):
     """Parallel auction matching candidates to disjoint broker/partition sets.
 
     Each candidate is one action with A alternate destinations, best-first.
     Per round, every unmatched candidate proposes its current alternate;
     the lowest-score proposal per destination wins (ties to the lowest
     candidate index); a loser advances to its next alternate only once the
-    destination it lost is actually used — so the advance never skips a
+    destination it lost is actually full — so the advance never skips a
     still-free destination.  A rounds of [N]-vector ops replace the
     sequential conflict walk, and the match size approaches the number of
     free destinations instead of collapsing to a handful.
 
-    ``init_used`` (used_src [B], used_dst [B], used_p [P]) pre-marks
+    ``dest_cap``/``src_cap`` allow a broker to take part in up to that
+    many winning actions per step (one per round, best-first, so the
+    stacked actions are the step's strongest).  1 keeps the strict
+    snapshot-exactness: same-dst/same-src overlaps can OVERSTATE a
+    pre-batch score for convex per-broker costs (the second add lands on
+    a warmer base; the second removal relieves a cooler one).  Caps > 1
+    trade that certainty for per-step availability — measured at the
+    north-star scale the step commits were bounded by the ~3 dozen
+    distinct destinations in active play, not by improving work (~250
+    improving candidates/step steady-state) — and rely on the HOST
+    exact-recheck to drop any over-admitted action (the device model
+    resyncs after a call with rejections, so correctness is unaffected).
+
+    ``init_used`` (used_src [B], used_dst [B], used_p [P] — bool) pre-marks
     brokers/partitions already claimed outside the auction — the budgeted
     cohort (:func:`_seg_prefix_fits` acceptance in the scan step) passes
     its footprint here so auction winners stay disjoint from it.
@@ -2099,9 +2148,16 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
             jnp.zeros(B, bool), jnp.zeros(B, bool), jnp.zeros(P, bool)
         )
     init_used_src, init_used_dst, init_used_p = init_used
+    # occupancy counts; a cohort-claimed broker starts at its cap (the
+    # cohort already spent that broker's budget — stacking on top of it
+    # would double-spend)
+    dst_n = jnp.where(init_used_dst, dest_cap, 0).astype(jnp.int32)
+    src_n = jnp.where(init_used_src, src_cap, 0).astype(jnp.int32)
+    best0 = jnp.zeros(B, jnp.float32)  # first winner's score per broker
 
     def round_fn(carry, _):
-        take, used_dst, used_p, used_src, ptr, win_score, win_dst = carry
+        (take, dst_n, used_p, src_n, ptr, win_score, win_dst,
+         dbest, sbest) = carry
         pa = jnp.clip(ptr, 0, A - 1)
         cur_s = cand_score[idx_n, pa]
         cur_d = jnp.clip(cand_dst[idx_n, pa], 0)
@@ -2113,12 +2169,22 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
         # higher base / addition to a relieved base beats its pre-batch
         # score for convex f) — pre-batch scores understate, never
         # overstate, and the improvement gate stays sound.  Same-dst and
-        # same-src overlaps (where scores could overstate) stay excluded.
+        # same-src overlaps (where scores could overstate) are bounded by
+        # dest_cap/src_cap (strictly excluded at cap 1).
+        # stacking guard: onto an occupied broker only with a score at
+        # least stack_ratio of that broker's first winner (scores are
+        # negative; both conditions vacuous at caps of 1)
+        ok_src_stack = (src_n[cand_src] == 0) | (
+            cur_s <= stack_ratio * sbest[cand_src]
+        )
+        ok_dst_stack = (dst_n[cur_d] == 0) | (
+            cur_s <= stack_ratio * dbest[cur_d]
+        )
         active = (
             ~take & (ptr < A) & (cur_s < tol)
-            & ~used_src[cand_src] & ~used_p[p_c]
+            & (src_n[cand_src] < src_cap) & ok_src_stack & ~used_p[p_c]
         )
-        prop = active & ~used_dst[cur_d]
+        prop = active & (dst_n[cur_d] < dest_cap) & ok_dst_stack
         best = jnp.full(B, jnp.inf).at[cur_d].min(
             jnp.where(prop, cur_s, jnp.inf)
         )
@@ -2129,26 +2195,45 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
             )
             win = win & (idx_n == fmin[ids])
         take = take | win
-        used_dst = used_dst.at[cur_d].max(win)
-        used_src = used_src.at[cand_src].max(win)
+        # record the FIRST winner's score per broker (the stacking bar)
+        dbest = jnp.where(
+            dst_n == 0,
+            jnp.full(B, 0.0).at[cur_d].min(jnp.where(win, cur_s, 0.0)),
+            dbest,
+        )
+        sbest = jnp.where(
+            src_n == 0,
+            jnp.full(B, 0.0).at[cand_src].min(jnp.where(win, cur_s, 0.0)),
+            sbest,
+        )
+        dst_n = dst_n.at[cur_d].add(win.astype(jnp.int32))
+        src_n = src_n.at[cand_src].add(win.astype(jnp.int32))
         used_p = used_p.at[p_c].max(win)
         win_score = jnp.where(win, cur_s, win_score)
         win_dst = jnp.where(win, cur_d, win_dst)
-        # advance only candidates whose current destination is actually used
-        # now (their loss is permanent); a loser whose provisional winner was
-        # itself eliminated by the src/partition tie-breaks keeps its alt —
-        # the destination is still free and stays its best option
-        ptr = ptr + (active & ~win & used_dst[cur_d]).astype(jnp.int32)
-        return (take, used_dst, used_p, used_src, ptr, win_score,
-                win_dst), None
+        # advance candidates whose current destination is full OR whose
+        # stacking bar it cannot clear (their loss there is permanent —
+        # the bar only stands until the next repool's fresh scores); a
+        # loser whose provisional winner was itself eliminated by the
+        # src/partition tie-breaks keeps its alt — the destination is
+        # still open and stays its best option
+        ptr = ptr + (
+            active & ~win
+            & ((dst_n[cur_d] >= dest_cap)
+               | ((dst_n[cur_d] > 0)
+                  & (cur_s > stack_ratio * dbest[cur_d])))
+        ).astype(jnp.int32)
+        return (take, dst_n, used_p, src_n, ptr, win_score,
+                win_dst, dbest, sbest), None
 
     init = (
-        jnp.zeros(N, bool), init_used_dst, init_used_p,
-        init_used_src, jnp.zeros(N, jnp.int32),
+        jnp.zeros(N, bool), dst_n, init_used_p,
+        src_n, jnp.zeros(N, jnp.int32),
         jnp.full(N, jnp.inf), jnp.zeros(N, jnp.int32),
+        best0, best0,
     )
-    (take, _, _, _, _, win_score, win_dst), _ = jax.lax.scan(
-        round_fn, init, None, length=A
+    (take, _, _, _, _, win_score, win_dst, _, _), _ = jax.lax.scan(
+        round_fn, init, None, length=rounds or A
     )
     return take, win_score, win_dst
 

@@ -179,6 +179,8 @@ def _tpu_search_config(cfg: CruiseControlConfig):
         rescore_rows_budget=cfg.get_int("tpu.search.rescore.rows.budget"),
         rescore_cols_budget=cfg.get_int("tpu.search.rescore.cols.budget"),
         rescore_lead_budget=cfg.get_int("tpu.search.rescore.lead.budget"),
+        rescore_refresh_steps=cfg.get_int(
+            "tpu.search.rescore.refresh.steps"),
         device_batch_per_step=cfg.get_int(
             "tpu.search.device.batch.per.step"),
         moves_per_src=cfg.get_int("tpu.search.moves.per.src"),

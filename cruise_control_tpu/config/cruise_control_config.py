@@ -683,6 +683,11 @@ def default_config_def() -> ConfigDef:
     d.define("tpu.search.rescore.lead.budget", ConfigType.INT, 2048,
              Importance.LOW, "Stale leadership entries rescored per step "
              "before falling back to a full rescore.", at_least(1), G)
+    d.define("tpu.search.rescore.refresh.steps", ConfigType.INT, 8,
+             Importance.LOW,
+             "Force a full rescore every this many steps when incremental "
+             "rescore is on (bounds alternate-depth thinning; 0 = never).",
+             at_least(0), G)
     d.define("tpu.search.device.batch.per.step", ConfigType.INT, 0,
              Importance.LOW, "Actions committed per device step (0 = "
              "auto-scale with broker count).", at_least(0), G)
