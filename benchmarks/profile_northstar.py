@@ -58,6 +58,8 @@ def main() -> None:
                     help="auction winners per source per step")
     ap.add_argument("--diag", action="store_true",
                     help="per-step availability diagnostics (~1 ms/step)")
+    ap.add_argument("--cohort-mode", default="budget",
+                    choices=("budget", "corrected"))
     ap.add_argument("--warm", action="store_true",
                     help="run optimize twice; report the second (compile "
                          "amortized) with phase timers reset")
@@ -120,7 +122,8 @@ def main() -> None:
                             cohort_budget_slack=args.slack,
                             auction_dest_cap=args.dest_cap,
                             auction_src_cap=args.src_cap,
-                            step_diagnostics=args.diag)
+                            step_diagnostics=args.diag,
+                            cohort_mode=args.cohort_mode)
     opt = T.TpuGoalOptimizer(config=cfg)
     if args.warm:
         opt.optimize(state)
@@ -135,6 +138,7 @@ def main() -> None:
     out = {
         "total_s": round(total, 2),
         "actions": len(result.actions),
+        "violation_score": result.violation_score_after,
         "phases": {k: round(v, 2) for k, v in sorted(TIMES.items())},
         "counts": dict(COUNTS),
     }

@@ -194,6 +194,19 @@ class TpuSearchConfig:
     #: step (the disjoint auction carried ~36), leaving the run
     #: availability-limited.
     cohort_budget_slack: float = 1.0
+    #: cohort acceptance rule: "budget" = water-filling sufficient
+    #: conditions (round 2); "corrected" = exact-conservative stacked
+    #: evaluation at segment-prefix state (round 3) — strictly more
+    #: admissive (budgets prove a special case) at four extra [C]-sized
+    #: cost evaluations per step.  North-star measurement: cohort accepts
+    #: 4.3 → 14.5/step and steps 1 858 → 1 764 (−5%), but device time was
+    #: unchanged within link noise, the final violation score was 0.3%
+    #: WORSE (10 295 vs 10 267 — eager stacking trades commit ordering),
+    #: and the action log grew 15%.  At 200b/5k it was ~15% faster at an
+    #: equal score.  Default stays "budget"; the corrected rule is the
+    #: right foundation if per-step availability ever becomes the bound
+    #: again (e.g. wider pools).
+    cohort_mode: str = "budget"
     #: auction occupancy caps: winners one broker may host per step as a
     #: destination / source (see _match_batch).  1 = strict snapshot
     #: exactness; > 1 trades it for per-step availability with the host
@@ -1084,10 +1097,16 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         )
         qual = qual & (ci == fminp[rep])
         d0 = jnp.clip(cand_dst[:, 0], 0)
-        acc_b = _budget_accept(
-            d0, jnp.clip(cand_src, 0), move_vec, dst_budget, src_budget,
-            qual,
-        )
+        if cfg.cohort_mode == "corrected":
+            acc_b = _corrected_accept(
+                m, cfg, ca, cand_p, cand_s, cand_src, d0, move_vec, qual,
+                cfg.improvement_tol,
+            )
+        else:
+            acc_b = _budget_accept(
+                d0, jnp.clip(cand_src, 0), move_vec, dst_budget,
+                src_budget, qual,
+            )
         # ---- disjoint auction for everything else (leads, out-of-budget),
         # excluded from brokers/partitions the cohort already touched ----
         used0 = (
@@ -2035,6 +2054,117 @@ def _step_budgets(m: DeviceModel, ca) -> Tuple[jax.Array, jax.Array]:
     return src_budget, dst_budget
 
 
+def _seg_excl_prefix(ids, vec, eligible):
+    """Per-row EXCLUSIVE prefix sum of ``vec`` within each id segment,
+    rows in caller (score) order — the cumulative footprint every earlier
+    qualified row of the same broker would deposit before this one.
+
+    ids [C] int32, vec [C, NB], eligible [C] bool → [C, NB] f32."""
+    C = ids.shape[0]
+    rank = jnp.arange(C, dtype=jnp.int32)
+    order = jnp.argsort(ids * C + rank)      # segments contiguous, score order
+    sv = jnp.where(eligible[:, None], vec, 0.0)[order]
+    sid = ids[order]
+    cs = jnp.cumsum(sv, axis=0)
+    first = jnp.concatenate([jnp.ones(1, bool), sid[1:] != sid[:-1]])
+    start_idx = jax.lax.cummax(jnp.where(first, rank, -1))
+    offset = cs[start_idx] - sv[start_idx]   # exclusive prefix at seg start
+    excl = cs - offset - sv
+    return jnp.zeros_like(vec).at[order].set(excl)
+
+
+def _corrected_accept(m, cfg, ca, cand_p, cand_s, cand_src, d0, move_vec,
+                      qual, tol):
+    """Exact-conservative stacked cohort (round-3 availability work).
+
+    Accept a qualified follower move iff its delta, re-evaluated at its
+    destination's and source's SEGMENT-PREFIX state (every earlier
+    qualified row of the same broker assumed committed), still clears the
+    improvement tolerance — four [C]-sized ``broker_cost`` evaluations.
+    The per-broker cost is separable and convex, so if the actually
+    accepted set is any subset of the assumed one, each accepted row's
+    realized delta can only be BETTER than its corrected score: fewer
+    prior adds leave the destination cooler, fewer prior removals leave
+    the source hotter.  That makes the batch snapshot-exact with
+    unlimited same-broker stacking — the thing the water-filling budgets
+    (sufficient conditions around the mean) could not admit in steady
+    state, and naive occupancy caps admitted unsoundly (overshoot churn).
+    Hard capacity and replica-count ceilings are enforced on the stacked
+    (prefix-inclusive) state explicitly.
+
+    Rows must be in score order (best first); returns accept [C] bool.
+    """
+    S = m.assignment.shape[1]
+    R = m.capacity.shape[1]
+    has_cap = m.broker_cload is not None
+    src_c = jnp.clip(cand_src, 0)
+    L = move_vec[:, :R]
+    n1 = move_vec[:, R:R + 1]
+    pot1 = move_vec[:, R + 1]
+    Lc = move_vec[:, R + 2:] if has_cap else L
+
+    Xd = _seg_excl_prefix(d0, move_vec, qual)
+    Ys = _seg_excl_prefix(src_c, move_vec, qual)
+    XdL, Xdn, Xdp = Xd[:, :R], Xd[:, R], Xd[:, R + 1]
+    XdC = Xd[:, R + 2:] if has_cap else XdL
+    YsL, Ysn, Ysp = Ys[:, :R], Ys[:, R], Ys[:, R + 1]
+    YsC = Ys[:, R + 2:] if has_cap else YsL
+
+    cost = functools.partial(_broker_cost, m, cfg, ca)
+    bl, rc, po, lnw, lc = (
+        m.broker_load, m.rcount, m.pot_nwout, m.leader_nwin, m.lcount
+    )
+    bcl = m.broker_cload if has_cap else None
+
+    # destination: prefix state, then prefix+this row
+    d_lo = cost(
+        bl[d0] + XdL, lnw[d0], po[d0] + Xdp, rc[d0] + Xdn, lc[d0], d0,
+        cload=(bcl[d0] + XdC) if has_cap else None,
+    )
+    d_hi = cost(
+        bl[d0] + XdL + L, lnw[d0], po[d0] + Xdp + pot1,
+        rc[d0] + Xdn + n1[:, 0], lc[d0], d0,
+        cload=(bcl[d0] + XdC + Lc) if has_cap else None,
+    )
+    s_lo = cost(
+        bl[src_c] - YsL, lnw[src_c], po[src_c] - Ysp, rc[src_c] - Ysn,
+        lc[src_c], src_c,
+        cload=(bcl[src_c] - YsC) if has_cap else None,
+    )
+    s_hi = cost(
+        bl[src_c] - YsL - L, lnw[src_c], po[src_c] - Ysp - pot1,
+        rc[src_c] - Ysn - n1[:, 0], lc[src_c], src_c,
+        cload=(bcl[src_c] - YsC - Lc) if has_cap else None,
+    )
+    # row terms (friction / hard-goal repair pressure), as _score_candidates
+    cs_c = jnp.clip(cand_s, 0, S - 1)
+    row = m.assignment[cand_p]
+    slot_racks = jnp.where(row != EMPTY_SLOT, m.rack[jnp.clip(row, 0)], -1)
+    my_rack = jnp.take_along_axis(slot_racks, cs_c[:, None], axis=1)[:, 0]
+    lower = jnp.arange(S)[None, :] < cs_c[:, None]
+    rack_viol_here = jnp.any(
+        lower & (slot_racks == my_rack[:, None]) & (row != EMPTY_SLOT),
+        axis=1,
+    )
+    must_move_here = m.must_move[cand_p, cs_c]
+    extra = (
+        L[:, Resource.DISK] / ca["avg_disk_cap"] * cfg.w_move_size
+        + jnp.where(must_move_here, -1e6, 0.0)
+        + jnp.where(rack_viol_here, -1e4, 0.0)
+    )
+    corrected = (d_hi - d_lo) + (s_hi - s_lo) + extra
+    # hard ceilings on the STACKED state (the scored row only checked the
+    # snapshot): capacity-estimate load and replica count
+    dst_cload_stack = (bcl[d0] + XdC + Lc) if has_cap else (bl[d0] + XdL + L)
+    cap_ok = jnp.all(
+        dst_cload_stack
+        <= m.capacity[d0] * ca["cap_threshold"][None, :] + 1e-6,
+        axis=1,
+    )
+    rcount_ok = rc[d0] + Xdn + 1.0 <= ca["max_replicas"]
+    return qual & (corrected < tol) & cap_ok & rcount_ok
+
+
 def _seg_prefix_fits(ids, vec, budget, eligible):
     """Budget acceptance by segmented prefix sums, in caller row order.
 
@@ -2052,20 +2182,10 @@ def _seg_prefix_fits(ids, vec, budget, eligible):
     ids [C] int32, vec [C, NB], budget [Bmax, NB], eligible [C] bool
     → fits [C] bool (False wherever not eligible).
     """
-    C = ids.shape[0]
-    rank = jnp.arange(C, dtype=jnp.int32)
-    order = jnp.argsort(ids * C + rank)      # segments contiguous, score order
-    sv = jnp.where(eligible[:, None], vec, 0.0)[order]
-    sid = ids[order]
-    cs = jnp.cumsum(sv, axis=0)
-    first = jnp.concatenate([jnp.ones(1, bool), sid[1:] != sid[:-1]])
-    # index of each row's segment start, propagated by cumulative max
-    start_idx = jax.lax.cummax(jnp.where(first, rank, -1))
-    offset = cs[start_idx] - sv[start_idx]   # exclusive prefix at seg start
-    incl = cs - offset
-    ok = jnp.all(incl <= budget[sid] + 1e-9, axis=1)
-    out = jnp.zeros(C, bool).at[order].set(ok)
-    return out & eligible
+    ev = jnp.where(eligible[:, None], vec, 0.0)
+    incl = _seg_excl_prefix(ids, vec, eligible) + ev
+    ok = jnp.all(incl <= budget[ids] + 1e-9, axis=1)
+    return ok & eligible
 
 
 def _budget_accept(dst_ids, src_ids, vec, dst_budget, src_budget, eligible,
@@ -2154,6 +2274,10 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
     dst_n = jnp.where(init_used_dst, dest_cap, 0).astype(jnp.int32)
     src_n = jnp.where(init_used_src, src_cap, 0).astype(jnp.int32)
     best0 = jnp.zeros(B, jnp.float32)  # first winner's score per broker
+    # stacking bookkeeping only exists in the compiled program when a cap
+    # actually allows stacking — the default program is identical to the
+    # strict one
+    track_bars = dest_cap > 1 or src_cap > 1
 
     def round_fn(carry, _):
         (take, dst_n, used_p, src_n, ptr, win_score, win_dst,
@@ -2173,13 +2297,16 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
         # dest_cap/src_cap (strictly excluded at cap 1).
         # stacking guard: onto an occupied broker only with a score at
         # least stack_ratio of that broker's first winner (scores are
-        # negative; both conditions vacuous at caps of 1)
-        ok_src_stack = (src_n[cand_src] == 0) | (
-            cur_s <= stack_ratio * sbest[cand_src]
-        )
-        ok_dst_stack = (dst_n[cur_d] == 0) | (
-            cur_s <= stack_ratio * dbest[cur_d]
-        )
+        # negative; vacuous — and compiled out — at caps of 1)
+        if track_bars:
+            ok_src_stack = (src_n[cand_src] == 0) | (
+                cur_s <= stack_ratio * sbest[cand_src]
+            )
+            ok_dst_stack = (dst_n[cur_d] == 0) | (
+                cur_s <= stack_ratio * dbest[cur_d]
+            )
+        else:
+            ok_src_stack = ok_dst_stack = True
         active = (
             ~take & (ptr < A) & (cur_s < tol)
             & (src_n[cand_src] < src_cap) & ok_src_stack & ~used_p[p_c]
@@ -2195,17 +2322,19 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
             )
             win = win & (idx_n == fmin[ids])
         take = take | win
-        # record the FIRST winner's score per broker (the stacking bar)
-        dbest = jnp.where(
-            dst_n == 0,
-            jnp.full(B, 0.0).at[cur_d].min(jnp.where(win, cur_s, 0.0)),
-            dbest,
-        )
-        sbest = jnp.where(
-            src_n == 0,
-            jnp.full(B, 0.0).at[cand_src].min(jnp.where(win, cur_s, 0.0)),
-            sbest,
-        )
+        if track_bars:
+            # record the FIRST winner's score per broker (the stacking bar)
+            dbest = jnp.where(
+                dst_n == 0,
+                jnp.full(B, 0.0).at[cur_d].min(jnp.where(win, cur_s, 0.0)),
+                dbest,
+            )
+            sbest = jnp.where(
+                src_n == 0,
+                jnp.full(B, 0.0).at[cand_src].min(
+                    jnp.where(win, cur_s, 0.0)),
+                sbest,
+            )
         dst_n = dst_n.at[cur_d].add(win.astype(jnp.int32))
         src_n = src_n.at[cand_src].add(win.astype(jnp.int32))
         used_p = used_p.at[p_c].max(win)
@@ -2217,12 +2346,12 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
         # loser whose provisional winner was itself eliminated by the
         # src/partition tie-breaks keeps its alt — the destination is
         # still open and stays its best option
-        ptr = ptr + (
-            active & ~win
-            & ((dst_n[cur_d] >= dest_cap)
-               | ((dst_n[cur_d] > 0)
-                  & (cur_s > stack_ratio * dbest[cur_d])))
-        ).astype(jnp.int32)
+        blocked = dst_n[cur_d] >= dest_cap
+        if track_bars:
+            blocked = blocked | (
+                (dst_n[cur_d] > 0) & (cur_s > stack_ratio * dbest[cur_d])
+            )
+        ptr = ptr + (active & ~win & blocked).astype(jnp.int32)
         return (take, dst_n, used_p, src_n, ptr, win_score,
                 win_dst, dbest, sbest), None
 

@@ -181,6 +181,7 @@ def _tpu_search_config(cfg: CruiseControlConfig):
         rescore_lead_budget=cfg.get_int("tpu.search.rescore.lead.budget"),
         rescore_refresh_steps=cfg.get_int(
             "tpu.search.rescore.refresh.steps"),
+        cohort_mode=cfg.get("tpu.search.cohort.mode"),
         device_batch_per_step=cfg.get_int(
             "tpu.search.device.batch.per.step"),
         moves_per_src=cfg.get_int("tpu.search.moves.per.src"),

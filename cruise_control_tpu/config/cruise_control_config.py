@@ -688,6 +688,11 @@ def default_config_def() -> ConfigDef:
              "Force a full rescore every this many steps when incremental "
              "rescore is on (bounds alternate-depth thinning; 0 = never).",
              at_least(0), G)
+    d.define("tpu.search.cohort.mode", ConfigType.STRING, "budget",
+             Importance.LOW,
+             "Multi-accept cohort rule: water-filling budgets or "
+             "exact-conservative corrected stacking.",
+             one_of("budget", "corrected"), G)
     d.define("tpu.search.device.batch.per.step", ConfigType.INT, 0,
              Importance.LOW, "Actions committed per device step (0 = "
              "auto-scale with broker count).", at_least(0), G)

@@ -580,3 +580,29 @@ def test_parity_gate_midscale():
     result = run(num_brokers=100, num_partitions=2000, min_speedup=1.0)
     assert result["quality_gate"], result
     assert result["speed_gate"], result  # at least faster than greedy
+
+
+def test_corrected_cohort_mode_beats_or_matches_greedy():
+    """The round-3 exact-conservative stacked cohort
+    (tpu.search.cohort.mode=corrected) must hold the same quality bar as
+    the default: violation score <= greedy on the same input."""
+    from cruise_control_tpu.analyzer.goal_optimizer import (
+        GoalOptimizer,
+        make_goals,
+    )
+    from cruise_control_tpu.analyzer.verifier import (
+        verify_result,
+        violation_score,
+    )
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(seed=21, num_brokers=60, num_racks=6,
+                           num_partitions=1200)
+    goals = make_goals()
+    greedy = GoalOptimizer(goals).optimize(state)
+    tpu = TpuGoalOptimizer(
+        config=TpuSearchConfig(cohort_mode="corrected")
+    ).optimize(state)
+    verify_result(state, tpu, goals)
+    assert violation_score(tpu.final_state, goals) <= violation_score(
+        greedy.final_state, goals)
