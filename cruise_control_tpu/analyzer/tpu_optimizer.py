@@ -61,7 +61,11 @@ from cruise_control_tpu.analyzer.goal_optimizer import (
 from cruise_control_tpu.analyzer.goals.base import BALANCE_MARGIN, BalancingConstraint
 from cruise_control_tpu.models.cluster_state import ClusterState
 from cruise_control_tpu.models.stats import cluster_stats, stats_summary
-from cruise_control_tpu.ops.cost import broker_cost
+from cruise_control_tpu.ops.cost import (
+    EVAC_BONUS,
+    RACK_FIX_BONUS,
+    broker_cost,
+)
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("engine")
@@ -509,8 +513,8 @@ def _score_candidates(
     # hard-goal repair pressure: offline replicas leave regardless of cost;
     # rack-violating replicas get a large (but smaller) bonus for moving to a
     # clean rack (the mask already guarantees the destination is clean)
-    evac = jnp.where(must_move_here & ~is_lead, -1e6, 0.0)
-    rack_fix = jnp.where(rack_viol_here & ~is_lead, -1e4, 0.0)
+    evac = jnp.where(must_move_here & ~is_lead, EVAC_BONUS, 0.0)
+    rack_fix = jnp.where(rack_viol_here & ~is_lead, RACK_FIX_BONUS, 0.0)
     delta = delta + friction + evac + rack_fix
     return jnp.where(feasible, delta, jnp.inf), feasible
 
@@ -2149,8 +2153,8 @@ def _corrected_accept(m, cfg, ca, cand_p, cand_s, cand_src, d0, move_vec,
     must_move_here = m.must_move[cand_p, cs_c]
     extra = (
         L[:, Resource.DISK] / ca["avg_disk_cap"] * cfg.w_move_size
-        + jnp.where(must_move_here, -1e6, 0.0)
-        + jnp.where(rack_viol_here, -1e4, 0.0)
+        + jnp.where(must_move_here, EVAC_BONUS, 0.0)
+        + jnp.where(rack_viol_here, RACK_FIX_BONUS, 0.0)
     )
     corrected = (d_hi - d_lo) + (s_hi - s_lo) + extra
     # hard ceilings on the STACKED state (the scored row only checked the

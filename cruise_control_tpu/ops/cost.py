@@ -21,6 +21,13 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.common.resources import Resource
 
+#: hard-goal repair pressure added to per-candidate scores — shared by ALL
+#: scoring paths (_score_candidates, ops.grid.move_grid_terms,
+#: _corrected_accept); change here, nowhere else, or the cohort's corrected
+#: deltas drift from the scores the rest of the step ranks by
+EVAC_BONUS = -1e6       # offline replicas leave regardless of cost
+RACK_FIX_BONUS = -1e4   # rack-violating replicas prefer a clean rack
+
 
 def broker_cost(
     cfg,

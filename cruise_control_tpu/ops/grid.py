@@ -27,7 +27,11 @@ import jax
 import jax.numpy as jnp
 
 from cruise_control_tpu.common.resources import EMPTY_SLOT, Resource
-from cruise_control_tpu.ops.cost import broker_cost
+from cruise_control_tpu.ops.cost import (
+    EVAC_BONUS,
+    RACK_FIX_BONUS,
+    broker_cost,
+)
 
 
 def move_grid_terms(
@@ -91,8 +95,8 @@ def move_grid_terms(
         cload=(m.broker_cload[src_c] - cmove_load) if has_cap else None,
     )
     friction = move_load[:, Resource.DISK] / ca["avg_disk_cap"] * cfg.w_move_size
-    evac = jnp.where(must_move, -1e6, 0.0)
-    rack_fix = jnp.where(rack_viol_here, -1e4, 0.0)
+    evac = jnp.where(must_move, EVAC_BONUS, 0.0)
+    rack_fix = jnp.where(rack_viol_here, RACK_FIX_BONUS, 0.0)
     src_term = (f_src_new - f_src_old) + friction + evac + rack_fix
 
     return {

@@ -252,3 +252,29 @@ def test_ui_page_served_with_api_prefix(server):
         page = r.read().decode()
     assert "__API_PREFIX__" not in page  # prefix substituted
     assert "opQuery" in page
+
+
+def test_expanded_dashboard_structure_and_data():
+    """Round-3 UI expansion: the utilization rollup + sparkline, topic
+    summary, and task drill-down exist in the page, and the endpoints they
+    read carry the keys their JS dereferences."""
+    js = UI_HTML.read_text()
+    for needle in ("renderClusterUtil", "taskDetail", 'id="cluster-util"',
+                   'id="spark"', 'id="topics"', 'id="task-steps"'):
+        assert needle in js, needle
+    cc, _, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    try:
+        # task drill-down reads operationProgress[].{step,timeInMs,completed}
+        body, status, headers = _post(srv, "rebalance?dryrun=true")
+        assert status == 202
+        task = _poll_task(srv, headers["User-Task-ID"])
+        steps = task["operationProgress"]
+        assert steps and {"step", "timeInMs", "completed"} <= set(steps[0])
+        # topic rollup reads partitions[].{topic,replicas,in-sync}
+        k, _, _ = _get(srv, "kafka_cluster_state")
+        p0 = k["KafkaPartitionState"]["partitions"][0]
+        assert {"topic", "replicas", "in-sync"} <= set(p0)
+    finally:
+        srv.stop()
