@@ -264,9 +264,33 @@ def diff_proposals(
     ctx: AnalyzerContext,
     initial_replica_disk: Optional[np.ndarray] = None,
 ) -> List[ExecutionProposal]:
-    """Placement diff → proposals (upstream AnalyzerUtils.getDiff)."""
+    """Placement diff → proposals (upstream AnalyzerUtils.getDiff).
+
+    The changed-partition detection is vectorized: the Python loop below
+    touches only partitions whose row/leader/disk actually changed — at
+    the 1M-partition scale a full-universe Python walk was most of the
+    post-search finalize time for a plan touching a few percent of
+    partitions."""
     out: List[ExecutionProposal] = []
-    for p in range(ctx.num_partitions):
+    old_leaders = np.take_along_axis(
+        initial_assignment, initial_leader_slot[:, None], axis=1
+    )[:, 0]
+    new_leaders = np.take_along_axis(
+        ctx.assignment, ctx.leader_slot[:, None], axis=1
+    )[:, 0]
+    changed = np.any(initial_assignment != ctx.assignment, axis=1) | (
+        old_leaders != new_leaders
+    )
+    if initial_replica_disk is not None:
+        changed = changed | np.any(
+            (initial_assignment != EMPTY_SLOT)
+            & (initial_assignment == ctx.assignment)
+            & (initial_replica_disk != ctx.replica_disk)
+            & (ctx.replica_disk >= 0),
+            axis=1,
+        )
+    for p in np.nonzero(changed)[0]:
+        p = int(p)
         old_row = initial_assignment[p]
         new_row = ctx.assignment[p]
         old_leader = int(old_row[initial_leader_slot[p]])
