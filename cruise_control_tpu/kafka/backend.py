@@ -14,6 +14,7 @@ stable for the life of the backend (new partitions append).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -41,40 +42,86 @@ class KafkaClusterBackend(ClusterBackend):
         #: executor reads partition state once per in-flight task per tick,
         #: which must not become one full-cluster metadata RPC each
         self._topo: Optional[Dict[str, List[dict]]] = None
+        #: bumped by _dirty(): a describe RPC only memoizes its result if
+        #: no invalidation happened while it was in flight, so a mutation
+        #: (reassignment, leader election) can never be papered over by a
+        #: pre-mutation snapshot that finishes late
+        self._topo_gen = 0
+        #: The dense-id mapping is reachable from N fetcher threads
+        #: (MetricFetcherManager runs samplers on a pool, and in Kafka mode
+        #: every sampler shares this backend as metadata): an unguarded
+        #: check-then-append could hand one dense id to two different
+        #: TopicPartitions, desynchronizing _tp_of from _key_of — and dense
+        #: ids feed tp(key), which the executor uses to issue reassignments.
+        #: Resolved lookups stay lock-free (GIL-atomic dict reads); only
+        #: mapping/topology WRITES take the lock, and the describe RPC runs
+        #: outside it, so a slow refresh never stalls other threads.
+        self._lock = threading.RLock()
         self.refresh_mapping()
 
     def _describe(self) -> Dict[str, List[dict]]:
-        if self._topo is None:
-            self._topo = self.wire.describe_topics()
-        return self._topo
+        topo = self._topo
+        if topo is None:
+            with self._lock:
+                gen = self._topo_gen
+            # the describe RPC (up to timeout_s) runs OUTSIDE the lock so a
+            # refresh never stalls other threads' already-resolved lookups;
+            # two racing refreshes cost a duplicate RPC, which is fine
+            fresh = self.wire.describe_topics()
+            with self._lock:
+                if self._topo is None and self._topo_gen == gen:
+                    self._topo = fresh
+            # always return OUR OWN fetch, never a racer's memoized result
+            # (which may predate the _dirty() that sent us here)
+            return fresh
+        return topo
 
     def _dirty(self) -> None:
-        self._topo = None
+        with self._lock:
+            self._topo = None
+            self._topo_gen += 1
 
     # ---- id mapping ------------------------------------------------------------
     def refresh_mapping(self) -> None:
         self._dirty()
-        for topic, rows in sorted(self._describe().items()):
-            for row in rows:
-                tp = (topic, row["partition"])
-                if tp not in self._key_of:
-                    self._key_of[tp] = len(self._tp_of)
-                    self._tp_of.append(tp)
+        # fetch OUR OWN snapshot, initiated after the dirty point — going
+        # through _describe could hand us a concurrent reader's OLDER
+        # in-flight describe that won the memoization race, and a mapping
+        # built from that stale topology would miss the very partition
+        # whose lookup triggered this refresh
+        with self._lock:
+            gen = self._topo_gen
+        topo = self.wire.describe_topics()  # RPC outside the lock
+        with self._lock:
+            if self._topo is None and self._topo_gen == gen:
+                self._topo = topo
+            for topic, rows in sorted(topo.items()):
+                for row in rows:
+                    tp = (topic, row["partition"])
+                    if tp not in self._key_of:
+                        # append FIRST: a lock-free reader that sees the
+                        # _key_of entry must be able to resolve tp(key)
+                        self._tp_of.append(tp)
+                        self._key_of[tp] = len(self._tp_of) - 1
 
     def key(self, tp: TopicPartition) -> int:
-        if tp not in self._key_of:
+        k = self._key_of.get(tp)  # lock-free fast path (GIL-atomic read)
+        if k is None:
             self.refresh_mapping()
-        return self._key_of[tp]
+            with self._lock:
+                k = self._key_of[tp]
+        return k
 
     def try_key(self, tp: TopicPartition,
                 refresh: bool = True) -> Optional[int]:
         """``key`` without the exception — and with the metadata refresh
         under the CALLER's control, so a batch decoding thousands of
         records for a stale topic refreshes once, not per record."""
-        k = self._key_of.get(tp)
+        k = self._key_of.get(tp)  # lock-free fast path
         if k is None and refresh:
             self.refresh_mapping()
-            k = self._key_of.get(tp)
+            with self._lock:
+                k = self._key_of.get(tp)
         return k
 
     def tp(self, key: int) -> TopicPartition:

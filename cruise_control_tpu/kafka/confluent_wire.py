@@ -78,6 +78,26 @@ def translate_error(exc, rpc: str) -> WireError:
     return WireError(msg)
 
 
+class VirtualOffset(int):
+    """A consume cursor: the ``int`` the :class:`KafkaWire` seam promises
+    (count of records from the log origin up to the consumer's
+    per-partition positions) that ALSO carries those positions.  Passing
+    it back to :meth:`ConfluentKafkaWire.consume` resumes this consumer's
+    exact positions with no shared-snapshot lookup — so two concurrent
+    consumers that happen to land on the same virtual offset with
+    different per-partition positions (a produce racing their drains on a
+    multi-partition topic) can never clobber each other's resume point.
+    A plain int (a cursor persisted by a previous process) falls back to
+    the snapshot table, then to the count-based skip."""
+
+    starts: Dict[int, int]
+
+    def __new__(cls, value: int, starts: Dict[int, int]):
+        self = super().__new__(cls, value)
+        self.starts = dict(starts)
+        return self
+
+
 class ConfluentKafkaWire(KafkaWire):
     """See module docstring.  One instance per cluster; admin + producer are
     shared (both are thread-safe in the client), consumers are created per
@@ -392,10 +412,17 @@ class ConfluentKafkaWire(KafkaWire):
         and reads to the high watermarks captured at entry, so a
         concurrent producer cannot stall the drain.
         """
-        with self._cursor_lock:
-            snapshot = self._cursors.get((topic, offset))
-            resume = snapshot is not None and offset != 0
-            starts = dict(snapshot) if resume else {}
+        own = getattr(offset, "starts", None) if offset != 0 else None
+        if own is not None:
+            # the caller handed back a VirtualOffset we returned: resume
+            # its exact per-partition positions, immune to any other
+            # consumer's snapshots
+            resume, starts = True, dict(own)
+        else:
+            with self._cursor_lock:
+                snapshot = self._cursors.get((topic, int(offset)))
+                resume = snapshot is not None and offset != 0
+                starts = dict(snapshot) if resume else {}
         consumer = self._ck.Consumer({
             **self._conf,
             "group.id": f"cruise-control-wire-{uuid.uuid4().hex}",
@@ -447,19 +474,43 @@ class ConfluentKafkaWire(KafkaWire):
                     done.add(p)
         finally:
             consumer.close()
-        total_read = len(records)
-        if resume:
-            next_virtual = offset + total_read
-        else:
+        if not resume:
             # re-read from earliest: virtual position counts from the log
             # origin, so records below the earliest watermark are already
             # "behind" the caller's cursor — only skip what is still
             # readable past it
-            skip = max(0, offset - trimmed)
-            records = records[skip:]
-            next_virtual = trimmed + total_read
+            records = records[max(0, offset - trimmed):]
+        # The virtual offset is DEFINED as the sum of per-partition
+        # positions measured from the log origin.  This equals the old
+        # offset+records arithmetic whenever the resume snapshot summed to
+        # ``offset`` (the normal case), and stays truthful when it did not
+        # (a min-merged collision snapshot sums below its key): a re-read
+        # must not inflate the cursor past the count of records ever
+        # produced, or a later restart's count-based skip would drop live
+        # records.
+        next_virtual = sum(starts.values())
         with self._cursor_lock:
-            self._cursors[(topic, next_virtual)] = starts
+            # Two concurrent consumers can end at the SAME virtual offset
+            # with DIFFERENT per-partition positions (a produce racing the
+            # drains on a multi-partition topic).  Overwriting would make
+            # one consumer's next resume skip records it never read; merge
+            # with per-partition minimums instead — a re-read is tolerable
+            # (records carry their own timestamps), a skip is data loss.
+            prior = self._cursors.pop((topic, next_virtual), None)
+            snap = starts
+            if prior is not None and prior != starts:
+                # a partition absent from one side means that consumer
+                # never read it (e.g. added after its drain): the only
+                # conservative position for it is 0 → resume falls back to
+                # the earliest offset, a re-read — never the OTHER
+                # consumer's position, which would skip records
+                snap = {
+                    p: min(starts.get(p, 0), prior.get(p, 0))
+                    for p in set(starts) | set(prior)
+                }
+            self._cursors[(topic, next_virtual)] = snap
             while len(self._cursors) > self._max_cursor_snapshots:
                 self._cursors.pop(next(iter(self._cursors)))
-        return records, next_virtual
+        # the returned cursor carries THIS consumer's exact positions even
+        # when the shared snapshot above was min-merged with a collision
+        return records, VirtualOffset(next_virtual, starts)

@@ -260,6 +260,8 @@ class KafkaMetricsReporterSampler(MetricSampler):
     def get_samples(self, start_ms: int, end_ms: int):
         raw, self._offset = self.wire.consume(self.topic, self._offset)
         self._batch_refreshed = False
+        skipped_before = self.skipped
+        decode_failed = 0
         envelopes: List[EnvelopeRecord] = []
         records: List[CruiseControlMetric] = list(self._pending)
         for r in raw:
@@ -270,7 +272,28 @@ class KafkaMetricsReporterSampler(MetricSampler):
                     records.append(decode_metric_json(r))
             except (EnvelopeError, ValueError, KeyError, TypeError):
                 self.skipped += 1
+                decode_failed += 1
         records.extend(self._convert(envelopes))
+        if raw and self.skipped - skipped_before >= len(raw):
+            # every record of a non-empty batch was dropped: that is not
+            # noise — without this the monitor sits in LOADING forever
+            # behind a rate-limited warning.  Name the actual cause: a
+            # batch that failed to DECODE points at the wire format; a
+            # batch that decoded but could not be RESOLVED points at
+            # missing/stale metadata.
+            cause = (
+                "likely envelope-format divergence between the reporter "
+                "and this sampler"
+                if decode_failed >= len(raw) else
+                "records decoded but their partitions could not be "
+                "resolved (metadata missing or stale)"
+            )
+            LOG.error(
+                "metrics sampler dropped the ENTIRE batch (%d records) "
+                "from topic %r — %s; the load monitor will make no "
+                "progress until this is resolved",
+                len(raw), self.topic, cause,
+            )
         if self.unmodeled:
             LOG.debug("metrics sampler: %d records of unmodeled type ids "
                       "so far (expected on a real cluster)", self.unmodeled)

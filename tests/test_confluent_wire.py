@@ -271,6 +271,45 @@ def test_concurrent_samplers_resume_independent_cursors(broker, wire):
     assert r2 == [b"d"]
 
 
+def test_cursor_collision_merges_conservatively(broker, wire):
+    """Two consumers can land on the SAME virtual offset with DIFFERENT
+    per-partition positions (a produce racing the drains on a
+    multi-partition topic).  The snapshot store must not let the later
+    insert silently clobber the earlier one: on collision the positions
+    merge per-partition-minimum, so the worst outcome is a re-read
+    (records carry timestamps), never a skip."""
+    broker.add_topic("mp", partitions=2)
+    wire.produce("mp", [b"a", b"b", b"c", b"d"])  # keyless: 2 per partition
+    _, nxt = wire.consume("mp", 0)
+    assert nxt == 4
+    assert wire._cursors[("mp", 4)] == {0: 2, 1: 2}
+    # simulate the racing consumer's snapshot already stored at virtual 4:
+    # it had read 1 from p0 and 3 from p1
+    wire._cursors[("mp", 4)] = {0: 1, 1: 3}
+    # a foreign-cursor consume that also lands at virtual 4 collides with it
+    records, nxt2 = wire.consume("mp", 1)
+    assert nxt2 == 4 and len(records) == 3
+    # merged per-partition minimum: neither consumer's unread data is lost
+    assert wire._cursors[("mp", 4)] == {0: 1, 1: 2}
+    # resuming from the merged snapshot via a PLAIN int re-reads p0's
+    # record rather than skipping it — and the returned cursor does NOT
+    # inflate past the count of records ever produced (4), or a later
+    # restart's count-based skip would drop live records
+    records, nxt3 = wire.consume("mp", 4)
+    assert len(records) == 1 and nxt3 == 4
+    # the returned cursor carries this consumer's exact positions, so its
+    # own resume is exact (no repeat of the conservative re-read)
+    assert nxt3.starts == {0: 2, 1: 2}
+    records, nxt4 = wire.consume("mp", nxt3)
+    assert records == [] and nxt4 == 4
+    # a partition absent from one colliding snapshot (added after that
+    # consumer's drain) merges to 0 — resume re-reads it from earliest —
+    # never to the other consumer's position, which would skip records
+    wire._cursors[("mp", 4)] = {0: 1}
+    records, _ = wire.consume("mp", 1)
+    assert wire._cursors[("mp", 4)] == {0: 1, 1: 0}
+
+
 def test_foreign_cursor_on_trimmed_topic_does_not_double_drop(broker, wire):
     """Restart-with-cursor on a retention-trimmed topic: records the broker
     deleted count toward the cursor, so live records are not skipped."""

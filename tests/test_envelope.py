@@ -251,3 +251,31 @@ def test_topic_rate_for_stale_partition_skipped_not_crash():
         EnvelopeRecord(MetricClassId.TOPIC, 2, 500, 0, 10.0, "gone"))])
     assert sampler.get_samples(0, 1000) == ([], [])
     assert sampler.skipped == 1
+
+
+def test_entire_batch_dropped_is_loud(caplog):
+    """A non-empty batch in which EVERY record is dropped is the signature
+    of a wire-format divergence (one-byte layout drift would do it): the
+    sampler must log at ERROR, not hide behind the rate-limited warning,
+    or the monitor sits in LOADING forever with no visible cause."""
+    import logging
+
+    wire = FakeKafkaWire(assignment={("a", 0): [0, 1]})
+    sampler = KafkaMetricsReporterSampler(wire)
+    wire.create_topic("__CruiseControlMetrics")
+    bad = bytearray(encode_record(GOLDEN[0][0]))
+    bad[1] = 9  # future version byte -> undecodable
+    wire.produce("__CruiseControlMetrics", [bytes(bad), bytes(bad)])
+    with caplog.at_level(logging.ERROR):
+        assert sampler.get_samples(0, 10_000) == ([], [])
+    assert any(
+        "ENTIRE batch" in r.message for r in caplog.records
+        if r.levelno >= logging.ERROR
+    )
+    # a batch with at least one usable record stays quiet at ERROR
+    caplog.clear()
+    wire.produce("__CruiseControlMetrics",
+                 [bytes(bad), encode_record(GOLDEN[0][0])])
+    with caplog.at_level(logging.ERROR):
+        psamples, bsamples = sampler.get_samples(0, 10_000)
+    assert not [r for r in caplog.records if r.levelno >= logging.ERROR]
