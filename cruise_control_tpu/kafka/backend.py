@@ -84,17 +84,12 @@ class KafkaClusterBackend(ClusterBackend):
     # ---- id mapping ------------------------------------------------------------
     def refresh_mapping(self) -> None:
         self._dirty()
-        # fetch OUR OWN snapshot, initiated after the dirty point — going
-        # through _describe could hand us a concurrent reader's OLDER
-        # in-flight describe that won the memoization race, and a mapping
-        # built from that stale topology would miss the very partition
-        # whose lookup triggered this refresh
+        # post-dirty, _describe can only hand back a snapshot fetched
+        # after the generation bump (the gen guard rejects older in-flight
+        # memoizations), so it necessarily reflects the partition whose
+        # lookup triggered this refresh
+        topo = self._describe()
         with self._lock:
-            gen = self._topo_gen
-        topo = self.wire.describe_topics()  # RPC outside the lock
-        with self._lock:
-            if self._topo is None and self._topo_gen == gen:
-                self._topo = topo
             for topic, rows in sorted(topo.items()):
                 for row in rows:
                     tp = (topic, row["partition"])

@@ -97,6 +97,12 @@ class VirtualOffset(int):
         self.starts = dict(starts)
         return self
 
+    def __getnewargs__(self):
+        # int's default pickle/deepcopy protocol passes (int(self),) to
+        # __new__, which would crash on the missing ``starts`` — carry it,
+        # so a persisted cursor round-trips with its exact positions
+        return (int(self), self.starts)
+
 
 class ConfluentKafkaWire(KafkaWire):
     """See module docstring.  One instance per cluster; admin + producer are

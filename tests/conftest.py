@@ -17,6 +17,11 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
+# Tests keep persistent caching of XLA:CPU executables (suite is ~2× faster
+# with it).  Driver entry points (bench.py, __graft_entry__) leave this
+# unset, so their artifacts never contain the spurious cpu_aot_loader
+# feature-mismatch error wall — see utils/jit_cache._exclude_cpu_executables.
+os.environ.setdefault("CC_TPU_CACHE_CPU_EXECUTABLES", "1")
 
 import jax
 

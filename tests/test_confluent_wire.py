@@ -308,6 +308,14 @@ def test_cursor_collision_merges_conservatively(broker, wire):
     wire._cursors[("mp", 4)] = {0: 1}
     records, _ = wire.consume("mp", 1)
     assert wire._cursors[("mp", 4)] == {0: 1, 1: 0}
+    # a persisted cursor round-trips with its exact positions (int's
+    # default __getnewargs__ would crash VirtualOffset.__new__)
+    import copy
+    import pickle
+
+    thawed = pickle.loads(pickle.dumps(nxt3))
+    assert thawed == 4 and thawed.starts == nxt3.starts
+    assert copy.deepcopy(nxt3).starts == nxt3.starts
 
 
 def test_foreign_cursor_on_trimmed_topic_does_not_double_drop(broker, wire):
