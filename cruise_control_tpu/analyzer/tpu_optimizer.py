@@ -252,6 +252,15 @@ class TpuSearchConfig:
     #: greedy baseline's violation score (the quality gate), and each
     #: polish round pays a model re-upload (real time at 1M partitions)
     polish_rounds: int = 0
+    #: per-row destination ranking over the [K, D] grid: "approx" uses the
+    #: TPU's PartialReduce approximate top-k (``lax.approx_max_k``,
+    #: recall ≈0.95 per element; exact top-k fallback on CPU), "exact"
+    #: the full selection network.  Candidates feed the host's exact
+    #: recheck, so sub-1 recall costs only which moves get PROPOSED —
+    #: measured at north-star shapes (round 4): the grid+top-k chain
+    #: 4.47 → ~0.6 ms/step (grid fused into the PartialReduce), final
+    #: score 10 268 → 10 256 (better, and inside run-to-run noise)
+    topk_mode: str = "approx"
 
 
 # ---------------------------------------------------------------------------------
@@ -874,7 +883,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         def full_rescore(_):
             g = grid_fn(m, cfg, ca, kp_l, ks_l, dest_pool,
                         terms=terms_l)                      # [Kl, D]
-            neg, bi = jax.lax.top_k(-g, R)
+            neg, bi = _grid_top_r(cfg, -g, R)
             ls, _ = _score_candidates(
                 m, cfg, ca, jnp.ones(Ll, jnp.int32), lp_l, lsl_l,
                 jnp.zeros(Ll, jnp.int32),
@@ -980,26 +989,52 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         rows_q = _topq_rows_per_src(sb, row_scores[:, 0], B, Q).reshape(-1)
         valid_q = rows_q < Kn
         mrow = jnp.clip(rows_q, 0, Kn - 1)
-        m_scores = jnp.where(valid_q[:, None], row_scores[mrow], jnp.inf)
-        inf_pad = jnp.full((B, R - 1), jnp.inf, m_scores.dtype)
-        cand_score = jnp.concatenate(
-            [m_scores,
-             jnp.concatenate([bl_score[:, None], inf_pad], axis=1)]
-        )                                                 # [NROW, R]
-        cand_dst = jnp.concatenate(
-            [best_d[mrow], jnp.broadcast_to(bl_dst[:, None], (B, R))]
-        )
-        arange_b = jnp.arange(B, dtype=jnp.int32)
-        cand_src = jnp.concatenate([sb[mrow], arange_b])
-        cand_p = jnp.concatenate([kp[mrow], bl_p])
-        cand_s = jnp.concatenate([ks[mrow], bl_s])
         is_move_row = jnp.arange(NROW) < Q * B
+        # compact to the best C rows before matching: the auction's
+        # scatter/gather cost scales with its row count, and rows outside
+        # the top few thousand essentially never win a step (committed
+        # batches top out in the hundreds) — matching 50k mostly-infeasible
+        # rows cost more than every other step component combined.  A full
+        # sort beats top_k here: lax.top_k with k in the thousands is a
+        # selection network far slower than one bitonic sort of the row
+        # keys (measured on v5e).  ONLY the sort key exists at [NROW]; all
+        # other candidate columns — and every [P]-table gather behind
+        # move_vec — are built post-compaction at [C], which removed ~3 ms
+        # of gather-latency per step at north-star shapes
+        # (KERNEL_BUDGET_r04_baseline.json: fusion.983/984/985/…)
+        key_all = jnp.concatenate(
+            [jnp.where(valid_q, row_scores[mrow, 0], jnp.inf), bl_score]
+        )                                                 # [NROW]
+        C = min(4096, NROW)
+        _, crow_all = jax.lax.sort_key_val(
+            key_all, jnp.arange(NROW, dtype=jnp.int32)
+        )
+        crow = crow_all[:C]
+        is_move_row = is_move_row[crow]
+        # move-row candidates resolve through mrow; leadership rows (crow
+        # >= Q·B) through the per-broker best-transfer arrays
+        mr_c = mrow[jnp.clip(crow, 0, Q * B - 1)]
+        valid_c = valid_q[jnp.clip(crow, 0, Q * B - 1)]
+        lrow_c = jnp.clip(crow - Q * B, 0, B - 1)
+        imr = is_move_row[:, None]
+        cand_score = jnp.where(
+            imr,
+            jnp.where(valid_c[:, None], row_scores[mr_c], jnp.inf),
+            jnp.concatenate(
+                [bl_score[lrow_c][:, None],
+                 jnp.full((C, R - 1), jnp.inf, row_scores.dtype)], axis=1
+            ),
+        )                                                 # [C, R]
+        cand_dst = jnp.where(imr, best_d[mr_c], bl_dst[lrow_c][:, None])
+        cand_src = jnp.where(is_move_row, sb[mr_c], lrow_c)
+        cand_p = jnp.where(is_move_row, kp[mr_c], bl_p[lrow_c])
+        cand_s = jnp.where(is_move_row, ks[mr_c], bl_s[lrow_c])
         # water-filling budgets: follower moves that fit ride the budgeted
         # fast path (several commits per broker per step); leader moves and
         # out-of-budget candidates use the strict disjoint path
         leader_now_q = m.leader_slot[cand_p] == cand_s
         ml = jnp.where(
-            (leader_now_q[:, None] & is_move_row[:, None]),
+            (leader_now_q[:, None] & imr),
             m.leader_load[cand_p],
             m.follower_load[cand_p],
         )
@@ -1009,7 +1044,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # auction as init_used — so a leadership (or any disjoint-path)
         # winner can never land on a broker the cohort committed to, and
         # cohort budgets never need to see auction-side load deltas
-        ml = jnp.where(is_move_row[:, None], ml, 0.0)
+        ml = jnp.where(imr, ml, 0.0)
         move_vec = jnp.concatenate(
             [
                 ml,
@@ -1024,12 +1059,12 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             # capacity-estimate move vector, matching _step_budgets' extra
             # headroom dims
             mlc = jnp.where(
-                (leader_now_q[:, None] & is_move_row[:, None]),
+                (leader_now_q[:, None] & imr),
                 m.leader_cload[cand_p],
                 m.follower_cload[cand_p],
             )
             move_vec = jnp.concatenate(
-                [move_vec, jnp.where(is_move_row[:, None], mlc, 0.0)], axis=1
+                [move_vec, jnp.where(imr, mlc, 0.0)], axis=1
             )
         src_budget, dst_budget = _step_budgets(m, ca)
         if cfg.cohort_budget_slack != 1.0:
@@ -1039,32 +1074,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             s_ = jnp.float32(cfg.cohort_budget_slack)
             src_budget = src_budget.at[:, :soft].multiply(s_)
             dst_budget = dst_budget.at[:, :soft].multiply(s_)
-        qualified = (
-            is_move_row
-            & ~leader_now_q
-            & jnp.concatenate([valid_q, jnp.zeros(B, bool)])
-        )
-        # compact to the best C rows before matching: the auction's
-        # scatter/gather cost scales with its row count, and rows outside
-        # the top few thousand essentially never win a step (committed
-        # batches top out in the hundreds) — matching 50k mostly-infeasible
-        # rows cost more than every other step component combined.  A full
-        # sort beats top_k here: lax.top_k with k in the thousands is a
-        # selection network far slower than one bitonic sort of the row
-        # keys (measured on v5e)
-        C = min(4096, NROW)
-        _, crow_all = jax.lax.sort_key_val(
-            cand_score[:, 0], jnp.arange(NROW, dtype=jnp.int32)
-        )
-        crow = crow_all[:C]
-        cand_score = cand_score[crow]
-        cand_dst = cand_dst[crow]
-        cand_src = cand_src[crow]
-        cand_p = cand_p[crow]
-        cand_s = cand_s[crow]
-        is_move_row = is_move_row[crow]
-        move_vec = move_vec[crow]
-        qualified = qualified[crow]
+        qualified = is_move_row & ~leader_now_q & valid_c
         M_ = min(M_, C)
         # ---- budget cohort: multi-accept by segmented budget prefixes ----
         # Every row's best destinations concentrate on the same few coldest
@@ -1818,6 +1828,19 @@ def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
 DESTS_PER_SOURCE = 8
 
 
+def _grid_top_r(cfg: TpuSearchConfig, neg_g, R: int):
+    """Per-row top-R destination selection over the (negated) move grid —
+    every grid ranking site routes through here so ``tpu.search.topk.mode``
+    governs the resident scan and the score-only rounds alike.  "approx"
+    is the TPU PartialReduce (recall ~0.95 per element; the row MAX is
+    always exact — only ranks 2..R can be missed — and off-TPU backends
+    fall back to exact), measured 4.47 → ~0.6 ms/step on the v5e at
+    north-star shapes at a better-by-noise final score."""
+    if cfg.topk_mode == "approx":
+        return jax.lax.approx_max_k(neg_g, R)
+    return jax.lax.top_k(neg_g, R)
+
+
 def _build_pools(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int):
     """All P·S-scale candidate-pool selection in one place → (kp, ks,
     dest_pool, lp, lsl)."""
@@ -1863,7 +1886,7 @@ def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
     L = lp.shape[0]
     if axis is None:
         g = grid_fn(m, cfg, ca, kp, ks, dest_pool)      # [K, D]
-        neg_best, best_i = jax.lax.top_k(-g, R)         # [K, R]
+        neg_best, best_i = _grid_top_r(cfg, -g, R)      # [K, R]
         best_d = dest_pool[best_i]                      # [K, R] broker ids
         l_scores, _ = _score_candidates(
             m, cfg, ca, jnp.ones(L, jnp.int32), lp, lsl,
@@ -1876,7 +1899,7 @@ def _reduced_candidates(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int,
     rows = jnp.clip(ai * Kl + jnp.arange(Kl, dtype=jnp.int32), 0, K - 1)
     kp_l, ks_l = kp[rows], ks[rows]
     g = grid_fn(m, cfg, ca, kp_l, ks_l, dest_pool)      # [Kl, D]
-    neg_best, best_i = jax.lax.top_k(-g, R)             # [Kl, R]
+    neg_best, best_i = _grid_top_r(cfg, -g, R)          # [Kl, R]
     best_d_l = dest_pool[best_i]
 
     def gather(x):

@@ -188,6 +188,7 @@ def _tpu_search_config(cfg: CruiseControlConfig):
         time_budget_s=cfg.get_double("tpu.search.time.budget.s"),
         profiler_trace_dir=cfg.get("tpu.search.profiler.trace.dir"),
         polish_rounds=cfg.get_int("tpu.search.polish.rounds"),
+        topk_mode=cfg.get("tpu.search.topk.mode"),
     )
 
 

@@ -709,6 +709,11 @@ def default_config_def() -> ConfigDef:
     d.define("tpu.search.polish.rounds", ConfigType.INT, 0,
              Importance.LOW, "Score-only polish rounds after the resident "
              "search converges.", at_least(0), G)
+    d.define("tpu.search.topk.mode", ConfigType.STRING, "approx",
+             Importance.LOW, "Destination ranking over the move grid: "
+             "'approx' = TPU PartialReduce approximate top-k (recall "
+             "~0.95; exact fallback off-TPU), 'exact' = full selection "
+             "network.", one_of("approx", "exact"), G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a
