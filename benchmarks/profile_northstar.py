@@ -52,6 +52,14 @@ def main() -> None:
     ap.add_argument("--budget", type=float, default=0.0)
     ap.add_argument("--slack", type=float, default=1.0,
                     help="cohort budget slack factor")
+    def _nonneg(v):
+        v = int(v)
+        if v < 0:
+            raise argparse.ArgumentTypeError("rounds must be >= 0")
+        return v
+
+    ap.add_argument("--rounds", type=_nonneg, default=0,
+                    help="auction rounds (0 = alternates width)")
     ap.add_argument("--dest-cap", type=int, default=1,
                     help="auction winners per destination per step")
     ap.add_argument("--src-cap", type=int, default=1,
@@ -122,6 +130,7 @@ def main() -> None:
                             cohort_budget_slack=args.slack,
                             auction_dest_cap=args.dest_cap,
                             auction_src_cap=args.src_cap,
+                            auction_rounds=args.rounds,
                             step_diagnostics=args.diag,
                             cohort_mode=args.cohort_mode)
     opt = T.TpuGoalOptimizer(config=cfg)

@@ -930,6 +930,10 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
                     [bd_l, jnp.broadcast_to(dp_c[None, :], (Kl, CB))],
                     axis=1,
                 )
+                # exact on purpose, not via _grid_top_r: R+CB ≈ 136-wide
+                # rows are far below the PartialReduce's useful width, and
+                # the merge's correctness story leans on keeping every
+                # stored entry rankable
                 negm, mi = jax.lax.top_k(-merged_s, R)
                 new_dt = -negm
                 new_bd = jnp.take_along_axis(merged_d, mi, axis=1)
@@ -938,7 +942,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
                 ridx = rorder[:RB]
                 rok = row_stale[ridx]
                 g_r = grid_fn(m, cfg, ca, kp_l[ridx], ks_l[ridx], dest_pool)
-                negr, bir = jax.lax.top_k(-g_r, R)
+                negr, bir = _grid_top_r(cfg, -g_r, R)
                 dt_r = -negr - src_term_l[ridx][:, None]
                 new_dt = new_dt.at[ridx].set(
                     jnp.where(rok[:, None], dt_r, new_dt[ridx])
@@ -1830,8 +1834,10 @@ DESTS_PER_SOURCE = 8
 
 def _grid_top_r(cfg: TpuSearchConfig, neg_g, R: int):
     """Per-row top-R destination selection over the (negated) move grid —
-    every grid ranking site routes through here so ``tpu.search.topk.mode``
-    governs the resident scan and the score-only rounds alike.  "approx"
+    every FULL-WIDTH grid ranking site routes through here (resident scan,
+    incremental patch rows, score-only rounds) so ``tpu.search.topk.mode``
+    governs them alike; the incremental merge's narrow re-rank stays exact
+    by design.  "approx"
     is the TPU PartialReduce (recall ~0.95 per element; the row MAX is
     always exact — only ranks 2..R can be missed — and off-TPU backends
     fall back to exact), measured 4.47 → ~0.6 ms/step on the v5e at
@@ -2388,8 +2394,29 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
         jnp.full(N, jnp.inf), jnp.zeros(N, jnp.int32),
         best0, best0,
     )
-    (take, _, _, _, _, win_score, win_dst, _, _), _ = jax.lax.scan(
-        round_fn, init, None, length=rounds or A
+    n_rounds = rounds or A
+
+    # A round that wins nothing AND advances no pointer is a fixed point:
+    # every later round recomputes the identical proposals and no-ops.
+    # Run rounds under a while_loop that exits there — exact.  Measured
+    # (r4, north star): no wall change at the default 8 rounds — the
+    # auction genuinely progresses most rounds there — but pathological
+    # round counts (e.g. rounds=24 diagnostics) no longer pay for their
+    # no-op tail, at two [N]-reduces per round of cost
+    def w_cond(wc):
+        r, progressed, _ = wc
+        return (r < n_rounds) & progressed
+
+    def w_body(wc):
+        r, _, carry = wc
+        new_carry, _ = round_fn(carry, None)
+        progressed = jnp.any(new_carry[0] != carry[0]) | jnp.any(
+            new_carry[4] != carry[4]
+        )
+        return r + 1, progressed, new_carry
+
+    _, _, (take, _, _, _, _, win_score, win_dst, _, _) = jax.lax.while_loop(
+        w_cond, w_body, (jnp.int32(0), jnp.bool_(True), init)
     )
     return take, win_score, win_dst
 
