@@ -27,14 +27,24 @@ import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-SEED = 23
-BROKERS = 48
-RACKS = 6
-PARTITIONS = 768
 DEVICES_PER_PROC = 4
 
+#: "smoke" proves the cross-process machinery cheaply (the in-suite
+#: test); "gate" is the parity-gate scale `dryrun_multichip` graduated to
+#: in round 3 — big enough that the sharded rescore does real work
+#: (round-3 VERDICT weak #5).  NO time budget in either: a wall-clock
+#: budget is per-process host state, and processes disagreeing on when to
+#: stop would diverge (or deadlock a collective); determinism across
+#: controllers requires step-count/convergence termination only.
+SCALES = {
+    "smoke": dict(seed=23, num_brokers=48, num_racks=6,
+                  num_partitions=768),
+    "gate": dict(seed=13, num_brokers=200, num_racks=8,
+                 num_partitions=5_000),
+}
 
-def _plan(mesh) -> dict:
+
+def _plan(mesh, scale: str) -> dict:
     """Run the resident sharded search on the shared fixture → plan dict."""
     from cruise_control_tpu.analyzer.tpu_optimizer import (
         TpuGoalOptimizer,
@@ -42,13 +52,14 @@ def _plan(mesh) -> dict:
     )
     from cruise_control_tpu.models.generators import random_cluster
 
-    state = random_cluster(
-        seed=SEED, num_brokers=BROKERS, num_racks=RACKS,
-        num_partitions=PARTITIONS, mean_utilization=0.45,
+    state = random_cluster(mean_utilization=0.45, **SCALES[scale])
+    cfg = (
+        TpuSearchConfig(max_rounds=60, topk_per_round=32,
+                        max_moves_per_round=8)
+        if scale == "smoke" else TpuSearchConfig()
     )
-    cfg = TpuSearchConfig(max_rounds=60, topk_per_round=32,
-                          max_moves_per_round=8)
     assert cfg.steps_per_call > 0  # resident path, not a fallback
+    assert cfg.time_budget_s == 0  # see SCALES note: determinism
     opt = TpuGoalOptimizer(config=cfg, mesh=mesh)
     result = opt.optimize(state)
     return {
@@ -62,7 +73,7 @@ def _plan(mesh) -> dict:
 
 
 def run_child(process_id: int, num_processes: int, coordinator: str,
-              out_path: str) -> None:
+              out_path: str, scale: str) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -81,20 +92,20 @@ def run_child(process_id: int, num_processes: int, coordinator: str,
     from cruise_control_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(n_global)  # global mesh spanning both processes
-    plan = _plan(mesh)
+    plan = _plan(mesh, scale)
     with open(out_path, "w") as f:
         json.dump({"process_id": process_id,
                    "num_devices": n_global, **plan}, f)
 
 
-def run_single(out_path: str, n_devices: int) -> None:
+def run_single(out_path: str, n_devices: int, scale: str) -> None:
     """Single-process n-virtual-device oracle for the same fixture."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     from cruise_control_tpu.parallel.mesh import make_mesh
 
-    plan = _plan(make_mesh(n_devices))
+    plan = _plan(make_mesh(n_devices), scale)
     with open(out_path, "w") as f:
         json.dump({"process_id": -1, **plan}, f)
 
@@ -114,7 +125,8 @@ def _spawn(args, n_devices: int):
     )
 
 
-def run_parent(num_processes: int = 2, port: int = 0) -> dict:
+def run_parent(num_processes: int = 2, port: int = 0,
+               scale: str = "smoke") -> dict:
     import socket
 
     if port == 0:
@@ -130,12 +142,14 @@ def run_parent(num_processes: int = 2, port: int = 0) -> dict:
     n_global = num_processes * DEVICES_PER_PROC
     children = [
         _spawn(["--child", str(i), "--num-processes", str(num_processes),
-                "--coordinator", coordinator, "--out", outs[i]],
+                "--coordinator", coordinator, "--out", outs[i],
+                "--scale", scale],
                DEVICES_PER_PROC)
         for i in range(num_processes)
     ]
     single = _spawn(
-        ["--single", "--devices", str(n_global), "--out", single_out],
+        ["--single", "--devices", str(n_global), "--out", single_out,
+         "--scale", scale],
         n_global,
     )
     procs = children + [single]
@@ -170,10 +184,13 @@ def run_parent(num_processes: int = 2, port: int = 0) -> dict:
         )
         assert p["violation_score"] == oracle["violation_score"]
     return {
+        "scale": scale,
+        "fixture": SCALES[scale],
         "num_processes": num_processes,
         "devices_per_process": DEVICES_PER_PROC,
         "actions": len(oracle["actions"]),
         "violation_score": oracle["violation_score"],
+        "plan_parity": "all processes == single-process oracle",
     }
 
 
@@ -185,14 +202,29 @@ def main() -> None:
     ap.add_argument("--devices", type=int, default=2 * DEVICES_PER_PROC)
     ap.add_argument("--coordinator", default="127.0.0.1:43219")
     ap.add_argument("--out", default="multihost_plan.json")
+    ap.add_argument("--scale", default="gate", choices=sorted(SCALES))
+    ap.add_argument("--artifact", default="",
+                    help="also write a driver-style JSON artifact here")
     args = ap.parse_args()
     if args.child is not None:
-        run_child(args.child, args.num_processes, args.coordinator, args.out)
+        run_child(args.child, args.num_processes, args.coordinator,
+                  args.out, args.scale)
     elif args.single:
-        run_single(args.out, args.devices)
+        run_single(args.out, args.devices, args.scale)
     else:
-        summary = run_parent(args.num_processes)
-        print(json.dumps(summary))
+        import time
+
+        t0 = time.perf_counter()
+        summary = run_parent(args.num_processes, scale=args.scale)
+        summary["wall_s"] = round(time.perf_counter() - t0, 1)
+        line = json.dumps(summary)
+        if args.artifact:
+            with open(args.artifact, "w") as f:
+                json.dump(
+                    {"cmd": "python benchmarks/multihost_dryrun.py "
+                            f"--scale {args.scale}",
+                     "rc": 0, "parsed": summary}, f, indent=1)
+        print(line)
 
 
 if __name__ == "__main__":
