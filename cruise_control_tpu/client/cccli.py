@@ -49,7 +49,10 @@ class CruiseControlClient:
         if task_id:
             req.add_header(USER_TASK_HEADER, task_id)
         try:
-            with urllib.request.urlopen(req) as resp:
+            # a socket timeout bounds EVERY request: without it a wedged
+            # server blocks the caller forever — timeout_s otherwise only
+            # bounds the 202 poll loop
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 body = json.loads(resp.read().decode() or "{}")
                 return resp.status, body, resp.headers.get(USER_TASK_HEADER, "")
         except urllib.error.HTTPError as e:

@@ -1,0 +1,359 @@
+"""Full-server soak with fault injection (round-3 VERDICT #7; SURVEY.md §4
+tier-3, upstream ``CCKafkaIntegrationTestHarness`` semantics).
+
+Everything the server runs in production runs here CONCURRENTLY against
+the simulated cluster — REST traffic over a real loopback socket,
+background proposal precompute, the anomaly-detector thread with
+self-healing enabled, the metrics pipeline, and the executor — through a
+compressed schedule of injected faults:
+
+  A. a broker dies MID-execution (its in-flight moves go DEAD),
+  B. a JBOD log dir goes offline on another broker,
+  C. an operator stops a running evacuation.
+
+Asserts: no deadlock (every wait is bounded and every thread joins), the
+server answers throughout, the executor recovers after each injected
+kill, user tasks do not leak past their TTL, and the terminal state is
+hard-goal clean (no replica on the dead broker or an offline dir, full
+replication, live leaders).
+
+Wall-clock budget ~60-120 s — slow, deliberately: this is the one test
+that runs the WHOLE server at once.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.client.cccli import (
+    CruiseControlClient,
+    CruiseControlError,
+)
+from cruise_control_tpu.detector.manager import make_detector_manager
+from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+from cruise_control_tpu.server import CruiseControlHttpServer
+from cruise_control_tpu.server.user_tasks import UserTaskManager
+
+from harness import WINDOW, full_stack
+from test_detector import healing_notifier
+
+DEAD_BROKER = 3
+DISK_BROKER = 2
+EVAC_BROKER = 5
+
+
+def _wait(predicate, timeout_s: float, what: str) -> None:
+    """Bounded wait — a soak must never hang; it fails loudly instead."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"soak timed out after {timeout_s}s waiting for: "
+                         f"{what}")
+
+
+def _post_retry(client, endpoint: str, timeout_s: float = 60.0, **params):
+    """Admin mutating POST that tolerates losing the ongoing-execution
+    race against a concurrent self-healing fix — the operator retries,
+    bounded."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            return client.post(endpoint, **params)
+        except CruiseControlError as e:
+            retriable = "OngoingExecution" in str(e) or e.code == 429
+            if not retriable or time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+class _Traffic(threading.Thread):
+    """Continuous REST reads + periodic dryrun rebalances.  Server-side
+    errors (model not ready, ongoing execution, task-cap 429s) are part
+    of a healthy soak; transport failures are not."""
+
+    def __init__(self, url: str, stop: threading.Event, name: str):
+        super().__init__(name=name, daemon=True)
+        self.client = CruiseControlClient(url)
+        # below the teardown join timeout (20 s): an in-flight long-poll
+        # must expire before the join does, or a healthy run trips the
+        # deadlock assertion
+        self.client.timeout_s = 10
+        self.stop_event = stop
+        self.ok = 0
+        self.rejected = 0
+        self.fatal: Exception | None = None
+
+    def run(self) -> None:
+        ops = ("state", "load", "proposals", "kafka_cluster_state",
+               "user_tasks", "partition_load")
+        i = 0
+        while not self.stop_event.is_set():
+            try:
+                if i % 11 == 10:
+                    self.client.post("rebalance", dryrun="true")
+                else:
+                    self.client.get(ops[i % len(ops)])
+                self.ok += 1
+            except CruiseControlError:
+                self.rejected += 1  # server answered: still alive
+            except Exception as e:  # noqa: BLE001 - transport failure
+                self.fatal = e
+                return
+            i += 1
+            time.sleep(0.02)
+
+
+class _Sampler(threading.Thread):
+    """Keeps metric windows flowing so the monitor stays model-ready."""
+
+    def __init__(self, reporter, monitor, stop: threading.Event,
+                 first_window: int):
+        super().__init__(name="soak-sampler", daemon=True)
+        self.reporter, self.monitor = reporter, monitor
+        self.stop_event = stop
+        self.w = first_window
+        self.fatal: Exception | None = None
+
+    def run(self) -> None:
+        while not self.stop_event.is_set():
+            try:
+                self.reporter.report(time_ms=self.w * WINDOW + 500)
+                self.monitor.run_sampling_iteration((self.w + 1) * WINDOW)
+            except Exception as e:  # noqa: BLE001
+                self.fatal = e
+                return
+            self.w += 1
+            time.sleep(0.1)
+
+
+def test_full_server_soak_with_fault_injection():
+    cc, backend, reporter = full_stack(
+        num_partitions=32, num_brokers=6, rf=2, extra_brokers=(6,),
+        jbod_disks={"/d1": 50_000.0, "/d2": 50_000.0},
+    )
+    # slow the simulated cluster down to human speed so executions are
+    # RUNNING when faults land (each tick = one progress-check interval)
+    orig_tick = SimulatedClusterBackend.tick
+
+    def slow_tick(self):
+        time.sleep(0.02)
+        orig_tick(self)
+
+    backend.tick = slow_tick.__get__(backend)
+    backend.move_latency_ticks = 3
+    cc.executor.config.task_timeout_ticks = 10
+
+    # TTL must sit comfortably above the clients' 0.2 s poll gap (a GIL
+    # stall during a first-shape compile can stretch one gap to seconds;
+    # an expired-but-successful task would 404 the poller)
+    utm = UserTaskManager(max_active_tasks=8, completed_task_ttl_s=5.0,
+                          max_workers=4, max_cached_completed=50)
+    srv = CruiseControlHttpServer(cc, port=0, user_task_manager=utm,
+                                  access_log=False)
+    srv.start()
+    # goal-violation healing joins only for the churn phase (enabled via
+    # the admin endpoint below): with it off, the only execution phases
+    # A-C can observe is the one THEY started — the latches are specific
+    mgr = make_detector_manager(
+        cc, backend=backend,
+        notifier=healing_notifier(broker_failure=True, disk_failure=True),
+        detection_interval_ms=200,
+        fix_cooldown_ms=200,
+        per_type_interval_ms={},
+    )
+
+    def pause_detector():
+        # operator-style quiesce: bounded stop of the detector thread so
+        # an admin cancel can't strip a fix that started a moment ago
+        mgr.stop()
+
+    def resume_detector():
+        mgr.start(tick_s=0.25)
+    stop = threading.Event()
+    threads = [
+        _Traffic(srv.url, stop, "soak-traffic-1"),
+        _Traffic(srv.url, stop, "soak-traffic-2"),
+        _Sampler(reporter, cc.load_monitor, stop, first_window=3),
+    ]
+    admin = CruiseControlClient(srv.url)
+    admin.timeout_s = 60
+    try:
+        for t in threads:
+            t.start()
+        mgr.start(tick_s=0.25)
+        cc.start_proposal_precomputation(interval_s=0.5)
+
+        # ---- phase A: broker death mid-execution --------------------------
+        exec_err: list = []
+
+        def run_rebalance():
+            try:
+                admin_a = CruiseControlClient(srv.url)
+                admin_a.timeout_s = 60
+                _post_retry(admin_a, "rebalance", dryrun="false")
+            except (CruiseControlError, TimeoutError) as e:
+                exec_err.append(e)  # dead-broker moves may fail the op
+
+        reb = threading.Thread(target=run_rebalance, daemon=True)
+        reb.start()
+        _wait(lambda: cc.executor.has_ongoing_execution, 30,
+              "phase A execution to start")
+        backend.failed_brokers.add(DEAD_BROKER)
+        reb.join(timeout=60)
+        assert not reb.is_alive(), "phase A rebalance thread hung"
+        assert not exec_err, f"phase A rebalance never ran: {exec_err}"
+        _wait(lambda: not cc.executor.has_ongoing_execution, 40,
+              "executor recovery after broker death")
+        # operator settles the dead tasks' in-flight reassignments (the
+        # admin path the broker-death soak in test_executor documents) —
+        # detector quiesced so the cancel can't strip a racing fix's adds
+        pause_detector()
+        backend.cancel_reassignments(list(backend.ongoing_reassignments()))
+        resume_detector()
+        # self-healing (detector thread) evacuates the dead broker
+        _wait(lambda: all(
+            DEAD_BROKER not in st.replicas
+            for st in backend.partitions.values()
+        ), 60, "self-healing evacuation of the dead broker")
+
+        # ---- phase B: JBOD dir failure ------------------------------------
+        backend.offline_dirs[DISK_BROKER] = ["/d1"]
+        _wait(lambda: not backend.offline_replicas(), 60,
+              "self-healing to clear replicas off the offline dir")
+        _wait(lambda: not cc.executor.has_ongoing_execution, 40,
+              "executor recovery after disk healing")
+
+        # ---- phase C: operator stop of a running evacuation ---------------
+        evac_err: list = []
+
+        def run_evac():
+            try:
+                admin_c = CruiseControlClient(srv.url)
+                admin_c.timeout_s = 60
+                _post_retry(admin_c, "remove_broker",
+                            brokerid=str(EVAC_BROKER), dryrun="false")
+            except (CruiseControlError, TimeoutError) as e:
+                evac_err.append(e)  # the stop may surface as an error
+
+        evac = threading.Thread(target=run_evac, daemon=True)
+        evac.start()
+        _wait(lambda: cc.executor.has_ongoing_execution, 30,
+              "phase C evacuation to start")
+        admin.post("stop_proposal_execution")
+        _wait(lambda: not cc.executor.has_ongoing_execution, 40,
+              "executor to honor the operator stop")
+        evac.join(timeout=60)
+        assert not evac.is_alive(), "phase C evacuation thread hung"
+        # an operator stop abandons the executor's tasks but leaves their
+        # reassignments in flight on the cluster (upstream semantics);
+        # the operator cancels them — same quiesced admin path as phase A
+        pause_detector()
+        backend.cancel_reassignments(list(backend.ongoing_reassignments()))
+        resume_detector()
+
+        # ---- goal-violation healing joins for the churn phase -------------
+        body = admin.post("admin",
+                          enable_self_healing_for="goal_violation")
+        assert body["selfHealingEnabledChanged"] == {
+            "GOAL_VIOLATION": True}
+
+        # ---- phase D: sustained churn -------------------------------------
+        # a compressed multi-hour schedule: repeated full evacuations and
+        # re-adds of a broker, executed through REST while the detector,
+        # precompute, and read traffic keep running concurrently
+        # (placement is not asserted mid-churn: goal-violation healing
+        # legitimately races these operations — the churn's job is
+        # sustained concurrent execution, the terminal drain asserts state)
+        for cycle in range(8):
+            _post_retry(admin, "remove_broker",
+                        brokerid=str(EVAC_BROKER), dryrun="false")
+            _wait(lambda: not cc.executor.has_ongoing_execution, 60,
+                  f"churn cycle {cycle}: evacuation to finish")
+            _post_retry(admin, "add_broker",
+                        brokerid=str(EVAC_BROKER), dryrun="false")
+            _wait(lambda: not cc.executor.has_ongoing_execution, 60,
+                  f"churn cycle {cycle}: re-add to finish")
+
+        # ---- drain: faults over, let healing settle the hard goals --------
+        # the skewed workload model never balances (the reporter replays
+        # it forever), so goal-violation healing would churn indefinitely;
+        # the operator turns it off — through the admin endpoint, which
+        # this also exercises — while broker/disk healing stays on
+        body = admin.post("admin",
+                          disable_self_healing_for="goal_violation")
+        assert body["selfHealingEnabledChanged"] == {
+            "GOAL_VIOLATION": False}
+
+        last_reason = ["unchecked"]
+
+        def hard_goal_clean() -> bool:
+            if cc.executor.has_ongoing_execution:
+                last_reason[0] = "execution still ongoing"
+                return False
+            if backend.offline_replicas():
+                last_reason[0] = (
+                    f"offline replicas: {backend.offline_replicas()}"
+                )
+                return False
+            for p, st in backend.partitions.items():
+                reps = st.replicas
+                if DEAD_BROKER in reps or len(reps) != len(set(reps)):
+                    last_reason[0] = f"p{p} on dead broker/dup: {reps}"
+                    return False
+                if len(reps) != 2 or st.leader not in reps:
+                    last_reason[0] = (
+                        f"p{p} rf/leader broken: {reps} leader {st.leader}"
+                    )
+                    return False
+                if st.leader in backend.failed_brokers:
+                    last_reason[0] = f"p{p} leader dead: {st.leader}"
+                    return False
+                if st.catching_up:
+                    last_reason[0] = f"p{p} catching up: {st.catching_up}"
+                    return False
+            return True
+
+        try:
+            _wait(hard_goal_clean, 90, "hard-goal-clean terminal state")
+        except AssertionError as e:
+            raise AssertionError(f"{e} (last reason: {last_reason[0]})")
+
+        # the server is still fully responsive after everything it went
+        # through (checked before teardown stops it)
+        state = admin.get("state")
+        assert "MonitorState" in state and "ExecutorState" in state
+    finally:
+        stop.set()
+        cc.stop_proposal_precomputation()
+        mgr.stop()
+        for t in threads:
+            t.join(timeout=20)
+        alive = [t.name for t in threads if t.is_alive()]
+        srv.stop()
+        assert not alive, f"soak threads failed to stop (deadlock?): {alive}"
+
+    # ---- post-mortem assertions -------------------------------------------
+    for t in threads:
+        assert t.fatal is None, f"{t.name} transport failure: {t.fatal!r}"
+    for t in threads[:2]:
+        assert t.ok >= 50, (
+            f"{t.name} starved: {t.ok} ok / {t.rejected} rejected"
+        )
+
+    # no user-task leak: everything completes and expires past its TTL
+    from cruise_control_tpu.server.user_tasks import UserTaskState
+
+    _wait(lambda: not any(
+        t.state == UserTaskState.ACTIVE for t in utm.tasks()
+    ), 30, "active user tasks to drain")
+    time.sleep(5.5)  # > completed_task_ttl_s
+    listing = utm.tasks()  # tasks() expires TTL-passed entries first
+    active = [t.task_id for t in listing
+              if t.state == UserTaskState.ACTIVE]
+    assert not active, f"leaked active tasks: {active}"
+    assert not listing, (
+        f"completed tasks survived their TTL: {len(listing)}"
+    )
