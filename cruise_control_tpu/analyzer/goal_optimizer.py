@@ -231,13 +231,46 @@ class OptimizerResult:
                 self.final_state.leader_load[:, Resource.DISK]
             )
             disk = leader_disk
+        # per-broker before→after deltas (the UI's proposal-diff view, the
+        # per-broker slice of upstream's loadBeforeOptimization/
+        # loadAfterOptimization): replicas, leadership, and disk bytes each
+        # broker gains or sheds if this plan executes
+        bdiff: Dict[int, dict] = {}
+
+        def _ent(b: int) -> dict:
+            return bdiff.setdefault(int(b), {
+                "broker": int(b), "replicaDelta": 0, "leaderDelta": 0,
+                "diskDeltaMB": 0.0,
+            })
+
         for p in self.proposals:
             added = set(p.new_replicas) - set(p.old_replicas)
+            removed = set(p.old_replicas) - set(p.new_replicas)
             n_replica_moves += len(added)
             n_leader_moves += int(p.has_leader_change)
             n_disk_moves += len(p.disk_moves)
-            if disk is not None and added and p.partition < len(disk):
-                data_mb += float(disk[p.partition]) * len(added)
+            size = (
+                float(disk[p.partition])
+                if disk is not None and p.partition < len(disk) else 0.0
+            )
+            if added:
+                data_mb += size * len(added)
+            for b in added:
+                e = _ent(b)
+                e["replicaDelta"] += 1
+                e["diskDeltaMB"] += size
+            for b in removed:
+                e = _ent(b)
+                e["replicaDelta"] -= 1
+                e["diskDeltaMB"] -= size
+            if p.has_leader_change:
+                _ent(p.new_leader)["leaderDelta"] += 1
+                _ent(p.old_leader)["leaderDelta"] -= 1
+        broker_diff = sorted(
+            bdiff.values(), key=lambda e: -abs(e["diskDeltaMB"])
+        )[:60]
+        for e in broker_diff:
+            e["diskDeltaMB"] = round(e["diskDeltaMB"], 2)
         return {
             "engine": self.engine,
             "execution": exec_summary,
@@ -250,6 +283,7 @@ class OptimizerResult:
             "numLeaderMovements": n_leader_moves,
             "numIntraBrokerReplicaMovements": n_disk_moves,
             "dataToMoveMB": round(data_mb, 3),
+            "brokerLoadDiff": broker_diff,
             "violationsBefore": self.violations_before,
             "violationsAfter": self.violations_after,
             "violationScoreBefore": self.violation_score_before,

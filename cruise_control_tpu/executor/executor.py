@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import time
 from typing import Dict, List, Optional, Sequence, Set
 
 from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
@@ -113,6 +114,13 @@ class Executor:
         self._stop_requested = False
         self.planner: Optional[ExecutionTaskPlanner] = None
         self.history: List[ExecutionResult] = []
+        #: bounded per-execution task log (the UI's execution-history
+        #: drill-in: every move's terminal state; upstream exposes the same
+        #: via ExecutorState verbose substates).  A plain LIST on purpose:
+        #: state_summary() slices it from HTTP worker threads while the
+        #: executor appends — list append/del/slice are single C-level ops
+        #: under the GIL, where iterating a deque mid-append raises
+        self.execution_log: List[dict] = []
         self.adopted_at_startup: Set[int] = set()
         self.adjuster: Optional[ConcurrencyAdjuster] = None
         self.throttle_helper: Optional[ReplicationThrottleHelper] = None
@@ -254,6 +262,29 @@ class Executor:
                 stopped=self._stop_requested,
             )
             self.history.append(result)
+            self.execution_log.append({
+                "executionId": len(self.history),
+                "endedS": round(time.time(), 1),
+                "strategy": planner.strategy.name,
+                "numProposals": len(proposals),
+                **dataclasses.asdict(result),
+                # per-move drill-in, bounded: terminal state of each task
+                "tasks": [
+                    {
+                        "taskId": t.task_id,
+                        "type": t.task_type.value,
+                        "partition": t.proposal.partition,
+                        "state": t.state.value,
+                        "from": sorted(t.removed_brokers),
+                        "to": sorted(t.added_brokers),
+                        "startedTick": t.started_tick,
+                        "finishedTick": t.finished_tick,
+                    }
+                    for t in planner.all_tasks[:200]
+                ],
+            })
+            if len(self.execution_log) > 8:
+                del self.execution_log[0]
             self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
             log = LOG.warning if (dead or result.stopped) else LOG.info
             log(
@@ -457,6 +488,8 @@ class Executor:
         return {
             "state": self.state.value,
             "taskCounts": by_state,
+            "numFinishedMovements": sum(r.completed for r in self.history),
             "stopRequested": self._stop_requested,
             "adoptedAtStartup": sorted(self.adopted_at_startup),
+            "recentExecutions": self.execution_log[-8:],
         }

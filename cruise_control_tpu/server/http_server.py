@@ -262,6 +262,11 @@ class CruiseControlHttpServer:
                 "NwOutRate": round(float(load[i, Resource.NW_OUT]), 3),
                 "DiskMB": round(float(load[i, Resource.DISK]), 3),
                 "DiskCapacityMB": float(cap[i, Resource.DISK]),
+                # per-resource capacities so clients can chart UTILIZATION
+                # for every resource, not just disk (the UI's history view)
+                "CpuCapacityPct": float(cap[i, Resource.CPU]),
+                "NwInCapacity": float(cap[i, Resource.NW_IN]),
+                "NwOutCapacity": float(cap[i, Resource.NW_OUT]),
             })
         return {"brokers": brokers}
 

@@ -254,6 +254,73 @@ def test_ui_page_served_with_api_prefix(server):
     assert "opQuery" in page
 
 
+def test_history_charts_read_per_resource_capacities(server):
+    """The history tab charts utilization % for EVERY resource — the load
+    response must carry each resource's capacity, not just disk's."""
+    srv, _, _ = server
+    body, _, _ = _get(srv, "load")
+    b0 = body["brokers"][0]
+    for key in ("CpuCapacityPct", "NwInCapacity", "NwOutCapacity",
+                "DiskCapacityMB"):
+        assert key in b0 and b0[key] > 0, (key, sorted(b0))
+    js = UI_HTML.read_text()
+    for needle in ("pushHistory", "renderHistory", 'id="ch-disk"',
+                   'id="ch-cpu"', 'id="ch-nwin"', 'id="ch-nwout"',
+                   "tab-history"):
+        assert needle in js, needle
+
+
+def test_executor_history_drill_in_contract(server):
+    """The tasks tab's executor-history card: after an execution,
+    ExecutorState.recentExecutions carries the summary row and the
+    per-move drill-in rows the JS dereferences."""
+    srv, cc, _ = server
+    body, status, headers = _post(srv, "rebalance?dryrun=false")
+    if status == 202:
+        task = _poll_task(srv, headers["User-Task-ID"])
+        assert task["Status"] == "Completed", task
+    st, _, _ = _get(srv, "state")
+    execs = st["ExecutorState"]["recentExecutions"]
+    assert execs, "no execution recorded"
+    e = execs[-1]
+    for key in ("executionId", "strategy", "numProposals", "completed",
+                "dead", "aborted", "ticks", "stopped", "tasks"):
+        assert key in e, (key, sorted(e))
+    assert e["completed"] > 0 and e["tasks"]
+    t0 = e["tasks"][0]
+    for key in ("taskId", "type", "partition", "state", "from", "to",
+                "startedTick", "finishedTick"):
+        assert key in t0, (key, sorted(t0))
+    assert "numFinishedMovements" in st["ExecutorState"]
+    js = UI_HTML.read_text()
+    for needle in ("renderExecHistory", "execDetail", 'id="exec-list"',
+                   'id="exec-moves"'):
+        assert needle in js, needle
+
+
+def test_proposal_diff_view_contract(server):
+    """The proposals tab's broker-load-diff card: per-broker before→after
+    deltas with the keys the JS dereferences, consistent with the plan's
+    own movement accounting."""
+    srv, _, _ = server
+    body, _, _ = _get(srv, "proposals")
+    diff = body["brokerLoadDiff"]
+    assert diff, "plan moves replicas but brokerLoadDiff is empty"
+    for key in ("broker", "replicaDelta", "leaderDelta", "diskDeltaMB"):
+        assert key in diff[0], (key, sorted(diff[0]))
+    # conservation: every replica/leader/byte added somewhere is removed
+    # somewhere (no truncation at this fixture's broker count)
+    assert sum(d["replicaDelta"] for d in diff) == 0
+    assert sum(d["leaderDelta"] for d in diff) == 0
+    assert sum(d["diskDeltaMB"] for d in diff) == pytest.approx(0, abs=1.0)
+    # per-broker NET gains are bounded by the plan's GROSS data movement
+    # (a broker that both gains and sheds nets below its gross adds)
+    gains = sum(d["diskDeltaMB"] for d in diff if d["diskDeltaMB"] > 0)
+    assert 0 < gains <= body["dataToMoveMB"] * 1.001
+    js = UI_HTML.read_text()
+    assert 'id="prop-diff"' in js and "brokerLoadDiff" in js
+
+
 def test_expanded_dashboard_structure_and_data():
     """Round-3 UI expansion: the utilization rollup + sparkline, topic
     summary, and task drill-down exist in the page, and the endpoints they
