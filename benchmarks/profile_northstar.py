@@ -68,6 +68,9 @@ def main() -> None:
                     help="per-step availability diagnostics (~1 ms/step)")
     ap.add_argument("--cohort-mode", default="budget",
                     choices=("budget", "corrected"))
+    ap.add_argument("--stack-tol", type=float, default=1.0,
+                    help="corrected-cohort commit-ordering guard "
+                         "(>=1 disables)")
     ap.add_argument("--warm", action="store_true",
                     help="run optimize twice; report the second (compile "
                          "amortized) with phase timers reset")
@@ -132,7 +135,8 @@ def main() -> None:
                             auction_src_cap=args.src_cap,
                             auction_rounds=args.rounds,
                             step_diagnostics=args.diag,
-                            cohort_mode=args.cohort_mode)
+                            cohort_mode=args.cohort_mode,
+                            cohort_stack_tol=args.stack_tol)
     opt = T.TpuGoalOptimizer(config=cfg)
     if args.warm:
         opt.optimize(state)

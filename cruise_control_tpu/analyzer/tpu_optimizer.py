@@ -207,10 +207,35 @@ class TpuSearchConfig:
     #: unchanged within link noise, the final violation score was 0.3%
     #: WORSE (10 295 vs 10 267 — eager stacking trades commit ordering),
     #: and the action log grew 15%.  At 200b/5k it was ~15% faster at an
-    #: equal score.  Default stays "budget"; the corrected rule is the
-    #: right foundation if per-step availability ever becomes the bound
-    #: again (e.g. wider pools).
+    #: equal score.  ROUND-4 REMEASURE under the approx-top-k engine:
+    #: corrected LOST its steps win too — 1 904 steps / score 10 308 /
+    #: 86.3k actions vs budget's 1 869 / 10 256 / 74.7k — stacking
+    #: amplifies the approximate ranking's rank-2+ misses into plan
+    #: churn.  Default stays "budget" (now dominant on every axis);
+    #: corrected remains for exact-top-k or availability-bound setups
+    #: (its 200b/5k win was measured under exact ranking).
     cohort_mode: str = "budget"
+    #: commit-ordering guard for the corrected cohort (round-4 stacking
+    #: v2): a STACKED row — one whose segment prefix is non-empty — is
+    #: accepted only if the convexity gap it pays for stacking (its
+    #: prefix-corrected delta minus its snapshot delta, ≥ 0 by convexity)
+    #: consumes at most this fraction of its own snapshot gain, i.e.
+    #: ``corrected ≤ score · (1 − tol)``.  This is the computable bound on
+    #: "the stacked set's joint delta vs the best sequential alternative":
+    #: committing the same set over later steps can only see better
+    #: per-move deltas (separable convexity), and the gap is exactly what
+    #: stacking sacrifices for the step saved.  0 = stack only
+    #: degradation-free rows; ≥ 1 disables the guard (round-3 eager
+    #: corrected mode).  North-star measurement (round 4): the guard
+    #: bounds what it claims but does NOT recover corrected mode's
+    #: quality loss — at 0.25 it DEFERRED stacks into +13% steps at the
+    #: same score (10 307 vs eager's 10 308); the loss channel is plan
+    #: bloat from stacking over approximate rankings, not per-row
+    #: degradation.  Relevant only when cohort_mode="corrected"; the
+    #: default keeps the guard OFF so cohort_mode=corrected alone
+    #: reproduces the round-3 measured configuration — 0.25 is the
+    #: documented experimental setting.
+    cohort_stack_tol: float = 1.0
     #: auction occupancy caps: winners one broker may host per step as a
     #: destination / source (see _match_batch).  1 = strict snapshot
     #: exactness; > 1 trades it for per-step availability with the host
@@ -1118,7 +1143,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         if cfg.cohort_mode == "corrected":
             acc_b = _corrected_accept(
                 m, cfg, ca, cand_p, cand_s, cand_src, d0, move_vec, qual,
-                cfg.improvement_tol,
+                cfg.improvement_tol, snap_score=cand_score[:, 0],
             )
         else:
             acc_b = _budget_accept(
@@ -2107,7 +2132,7 @@ def _seg_excl_prefix(ids, vec, eligible):
 
 
 def _corrected_accept(m, cfg, ca, cand_p, cand_s, cand_src, d0, move_vec,
-                      qual, tol):
+                      qual, tol, snap_score=None):
     """Exact-conservative stacked cohort (round-3 availability work).
 
     Accept a qualified follower move iff its delta, re-evaluated at its
@@ -2195,7 +2220,23 @@ def _corrected_accept(m, cfg, ca, cand_p, cand_s, cand_src, d0, move_vec,
         axis=1,
     )
     rcount_ok = rc[d0] + Xdn + 1.0 <= ca["max_replicas"]
-    return qual & (corrected < tol) & cap_ok & rcount_ok
+    acc = qual & (corrected < tol) & cap_ok & rcount_ok
+    if snap_score is not None and cfg.cohort_stack_tol < 1.0:
+        # commit-ordering guard (cohort_stack_tol): the convexity gap a
+        # stacked row pays (corrected − snapshot score, ≥ 0) may consume
+        # at most that fraction of the row's own gain — deferring the row
+        # to a later step recovers the full gap (separable convexity), so
+        # this bounds exactly what stacking sacrifices for the steps
+        # saved.  Gated to rows with a NON-EMPTY segment prefix: a
+        # first-in-segment row is not stacking, and float drift between
+        # the recomputed corrected delta and the grid-path snapshot score
+        # must not evict it at small tolerances.  Scores are negative.
+        stacked = (Xdn + Ysn) > 0
+        acc = acc & (
+            ~stacked
+            | (corrected <= snap_score * (1.0 - cfg.cohort_stack_tol))
+        )
+    return acc
 
 
 def _seg_prefix_fits(ids, vec, budget, eligible):

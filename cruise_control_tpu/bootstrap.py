@@ -182,6 +182,8 @@ def _tpu_search_config(cfg: CruiseControlConfig):
         rescore_refresh_steps=cfg.get_int(
             "tpu.search.rescore.refresh.steps"),
         cohort_mode=cfg.get("tpu.search.cohort.mode"),
+        cohort_stack_tol=cfg.get_double(
+            "tpu.search.cohort.stack.tolerance"),
         device_batch_per_step=cfg.get_int(
             "tpu.search.device.batch.per.step"),
         moves_per_src=cfg.get_int("tpu.search.moves.per.src"),

@@ -709,6 +709,11 @@ def default_config_def() -> ConfigDef:
     d.define("tpu.search.polish.rounds", ConfigType.INT, 0,
              Importance.LOW, "Score-only polish rounds after the resident "
              "search converges.", at_least(0), G)
+    d.define("tpu.search.cohort.stack.tolerance", ConfigType.DOUBLE, 1.0,
+             Importance.LOW, "Corrected-cohort commit-ordering guard: max "
+             "fraction of a stacked row's own gain its stacking "
+             "(convexity) gap may consume; >=1 (default) disables the "
+             "guard.", at_least(0.0), G)
     d.define("tpu.search.topk.mode", ConfigType.STRING, "approx",
              Importance.LOW, "Destination ranking over the move grid: "
              "'approx' = TPU PartialReduce approximate top-k (recall "
