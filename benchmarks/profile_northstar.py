@@ -71,6 +71,8 @@ def main() -> None:
     ap.add_argument("--stack-tol", type=float, default=1.0,
                     help="corrected-cohort commit-ordering guard "
                          "(>=1 disables)")
+    ap.add_argument("--sel-rows", type=int, default=1024,
+                    help="post-compaction selection problem size C")
     ap.add_argument("--warm", action="store_true",
                     help="run optimize twice; report the second (compile "
                          "amortized) with phase timers reset")
@@ -136,7 +138,8 @@ def main() -> None:
                             auction_rounds=args.rounds,
                             step_diagnostics=args.diag,
                             cohort_mode=args.cohort_mode,
-                            cohort_stack_tol=args.stack_tol)
+                            cohort_stack_tol=args.stack_tol,
+                            selection_rows=args.sel_rows)
     opt = T.TpuGoalOptimizer(config=cfg)
     if args.warm:
         opt.optimize(state)

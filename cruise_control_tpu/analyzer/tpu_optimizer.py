@@ -236,6 +236,18 @@ class TpuSearchConfig:
     #: reproduces the round-3 measured configuration — 0.25 is the
     #: documented experimental setting.
     cohort_stack_tol: float = 1.0
+    #: candidate rows kept after the per-step compaction (the matcher's
+    #: problem size C): the selection machinery's scatter/gather chain
+    #: costs ~C elements per auction round on the scalar unit, so this
+    #: knob is ~1/4 of step device time at north-star shapes.  Rows
+    #: outside the top ~thousand essentially never win a step (commits
+    #: top out in the hundreds).  North-star sweep (round 4, warm):
+    #: 4096 → 41–46 s / score 10 256 / 1 869 steps; 2048 → 36.8 s /
+    #: 10 259 / 1 950; **1024 → 35.3 s / 10 255 / 2 088** (cheaper steps
+    #: beat the extra count); 512 → 38.3 s (step growth wins).  Mid-scale
+    #: fixtures sit at or below NROW anyway; commits per step stay capped
+    #: by device_batch_per_step.
+    selection_rows: int = 1024
     #: auction occupancy caps: winners one broker may host per step as a
     #: destination / source (see _match_batch).  1 = strict snapshot
     #: exactness; > 1 trades it for per-step availability with the host
@@ -1034,7 +1046,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         key_all = jnp.concatenate(
             [jnp.where(valid_q, row_scores[mrow, 0], jnp.inf), bl_score]
         )                                                 # [NROW]
-        C = min(4096, NROW)
+        C = min(cfg.selection_rows, NROW)
         _, crow_all = jax.lax.sort_key_val(
             key_all, jnp.arange(NROW, dtype=jnp.int32)
         )
@@ -2342,11 +2354,22 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
             jnp.zeros(B, bool), jnp.zeros(B, bool), jnp.zeros(P, bool)
         )
     init_used_src, init_used_dst, init_used_p = init_used
-    # occupancy counts; a cohort-claimed broker starts at its cap (the
-    # cohort already spent that broker's budget — stacking on top of it
-    # would double-spend)
-    dst_n = jnp.where(init_used_dst, dest_cap, 0).astype(jnp.int32)
-    src_n = jnp.where(init_used_src, src_cap, 0).astype(jnp.int32)
+    # The three conflict tables — destination occupancy [B], source
+    # occupancy [B], partition claims [P] — live PACKED in one
+    # [2B+P]-sized count vector: every round then pays ONE gather and ONE
+    # scatter for all three instead of three of each (the auction is
+    # ~1/4 of the step's device time and entirely these small ops —
+    # KERNEL_BUDGET_r04.md).  Layout: [0,B) dst, [B,2B) src, [2B,2B+P)
+    # partition claims (cap 1).  A cohort-claimed broker starts at its
+    # cap (the cohort already spent that broker's budget — stacking on
+    # top of it would double-spend).
+    occ0 = jnp.concatenate([
+        jnp.where(init_used_dst, dest_cap, 0),
+        jnp.where(init_used_src, src_cap, 0),
+        jnp.where(init_used_p, 1, 0),
+    ]).astype(jnp.int32)
+    ids_src = B + cand_src          # row-fixed packed indices
+    ids_p = 2 * B + p_c
     best0 = jnp.zeros(B, jnp.float32)  # first winner's score per broker
     # stacking bookkeeping only exists in the compiled program when a cap
     # actually allows stacking — the default program is identical to the
@@ -2354,11 +2377,12 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
     track_bars = dest_cap > 1 or src_cap > 1
 
     def round_fn(carry, _):
-        (take, dst_n, used_p, src_n, ptr, win_score, win_dst,
-         dbest, sbest) = carry
+        (take, occ, ptr, win_score, win_dst, dbest, sbest) = carry
         pa = jnp.clip(ptr, 0, A - 1)
         cur_s = cand_score[idx_n, pa]
         cur_d = jnp.clip(cand_dst[idx_n, pa], 0)
+        ids3 = jnp.concatenate([cur_d, ids_src, ids_p])
+        occ_d, occ_s, occ_p = jnp.split(occ[ids3], 3)  # one packed gather
         # src and dst conflict sets are deliberately SEPARATE: a broker may
         # be one action's dest and another's src in the same batch.  Every
         # per-broker cost term is convex in the broker's aggregates, so a
@@ -2373,45 +2397,55 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
         # least stack_ratio of that broker's first winner (scores are
         # negative; vacuous — and compiled out — at caps of 1)
         if track_bars:
-            ok_src_stack = (src_n[cand_src] == 0) | (
+            ok_src_stack = (occ_s == 0) | (
                 cur_s <= stack_ratio * sbest[cand_src]
             )
-            ok_dst_stack = (dst_n[cur_d] == 0) | (
+            ok_dst_stack = (occ_d == 0) | (
                 cur_s <= stack_ratio * dbest[cur_d]
             )
         else:
             ok_src_stack = ok_dst_stack = True
         active = (
             ~take & (ptr < A) & (cur_s < tol)
-            & (src_n[cand_src] < src_cap) & ok_src_stack & ~used_p[p_c]
+            & (occ_s < src_cap) & ok_src_stack & (occ_p < 1)
         )
-        prop = active & (dst_n[cur_d] < dest_cap) & ok_dst_stack
+        prop = active & (occ_d < dest_cap) & ok_dst_stack
         best = jnp.full(B, jnp.inf).at[cur_d].min(
             jnp.where(prop, cur_s, jnp.inf)
         )
         win = prop & (cur_s <= best[cur_d])
-        for ids, size in ((cur_d, B), (cand_src, B), (p_c, P)):
-            fmin = jnp.full(size, N, jnp.int32).at[ids].min(
-                jnp.where(win, idx_n, N)
-            )
-            win = win & (idx_n == fmin[ids])
+        # Lowest-row-index tie-break on all three tables at once: one
+        # packed scatter-min + one packed gather.  SIMULTANEOUS, not the
+        # pre-r4 sequential chain: a row eliminated on one table still
+        # claims its slots on the others for this round, so a
+        # sequentially-winnable candidate can be deferred one round
+        # (never admitted unsafely — winning still requires surviving
+        # ALL tables).  The fixed-point round loop retries it; measured
+        # at north star the final score was unchanged (10 255 vs 10 256)
+        # for a third of the auction's kernels.
+        widx = jnp.where(win, idx_n, N)
+        fmin = jnp.full(2 * B + P, N, jnp.int32).at[ids3].min(
+            jnp.concatenate([widx, widx, widx])
+        )
+        f_d, f_s, f_p = jnp.split(fmin[ids3], 3)
+        win = win & (idx_n == f_d) & (idx_n == f_s) & (idx_n == f_p)
         take = take | win
         if track_bars:
-            # record the FIRST winner's score per broker (the stacking bar)
+            # record the FIRST winner's score per broker (the stacking
+            # bar); pre-update occupancy slices of the packed table
             dbest = jnp.where(
-                dst_n == 0,
+                occ[:B] == 0,
                 jnp.full(B, 0.0).at[cur_d].min(jnp.where(win, cur_s, 0.0)),
                 dbest,
             )
             sbest = jnp.where(
-                src_n == 0,
+                occ[B:2 * B] == 0,
                 jnp.full(B, 0.0).at[cand_src].min(
                     jnp.where(win, cur_s, 0.0)),
                 sbest,
             )
-        dst_n = dst_n.at[cur_d].add(win.astype(jnp.int32))
-        src_n = src_n.at[cand_src].add(win.astype(jnp.int32))
-        used_p = used_p.at[p_c].max(win)
+        wi = win.astype(jnp.int32)
+        occ = occ.at[ids3].add(jnp.concatenate([wi, wi, wi]))
         win_score = jnp.where(win, cur_s, win_score)
         win_dst = jnp.where(win, cur_d, win_dst)
         # advance candidates whose current destination is full OR whose
@@ -2419,19 +2453,18 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
         # the bar only stands until the next repool's fresh scores); a
         # loser whose provisional winner was itself eliminated by the
         # src/partition tie-breaks keeps its alt — the destination is
-        # still open and stays its best option
-        blocked = dst_n[cur_d] >= dest_cap
+        # still open and stays its best option.  POST-update occupancy on
+        # purpose: "someone proposed d" does not imply d filled.
+        blocked = occ[cur_d] >= dest_cap
         if track_bars:
             blocked = blocked | (
-                (dst_n[cur_d] > 0) & (cur_s > stack_ratio * dbest[cur_d])
+                (occ[cur_d] > 0) & (cur_s > stack_ratio * dbest[cur_d])
             )
         ptr = ptr + (active & ~win & blocked).astype(jnp.int32)
-        return (take, dst_n, used_p, src_n, ptr, win_score,
-                win_dst, dbest, sbest), None
+        return (take, occ, ptr, win_score, win_dst, dbest, sbest), None
 
     init = (
-        jnp.zeros(N, bool), dst_n, init_used_p,
-        src_n, jnp.zeros(N, jnp.int32),
+        jnp.zeros(N, bool), occ0, jnp.zeros(N, jnp.int32),
         jnp.full(N, jnp.inf), jnp.zeros(N, jnp.int32),
         best0, best0,
     )
@@ -2451,12 +2484,13 @@ def _match_batch(cand_score, cand_dst, cand_src, cand_p, tol: float, B: int,
     def w_body(wc):
         r, _, carry = wc
         new_carry, _ = round_fn(carry, None)
+        # carry layout: (take, occ, ptr, win_score, win_dst, dbest, sbest)
         progressed = jnp.any(new_carry[0] != carry[0]) | jnp.any(
-            new_carry[4] != carry[4]
+            new_carry[2] != carry[2]
         )
         return r + 1, progressed, new_carry
 
-    _, _, (take, _, _, _, _, win_score, win_dst, _, _) = jax.lax.while_loop(
+    _, _, (take, _, _, win_score, win_dst, _, _) = jax.lax.while_loop(
         w_cond, w_body, (jnp.int32(0), jnp.bool_(True), init)
     )
     return take, win_score, win_dst

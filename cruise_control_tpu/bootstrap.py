@@ -191,6 +191,7 @@ def _tpu_search_config(cfg: CruiseControlConfig):
         profiler_trace_dir=cfg.get("tpu.search.profiler.trace.dir"),
         polish_rounds=cfg.get_int("tpu.search.polish.rounds"),
         topk_mode=cfg.get("tpu.search.topk.mode"),
+        selection_rows=cfg.get_int("tpu.search.selection.rows"),
     )
 
 

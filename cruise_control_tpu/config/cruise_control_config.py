@@ -714,6 +714,10 @@ def default_config_def() -> ConfigDef:
              "fraction of a stacked row's own gain its stacking "
              "(convexity) gap may consume; >=1 (default) disables the "
              "guard.", at_least(0.0), G)
+    d.define("tpu.search.selection.rows", ConfigType.INT, 1024,
+             Importance.LOW, "Candidate rows kept after the per-step "
+             "compaction (the cohort/auction problem size).",
+             at_least(256), G)
     d.define("tpu.search.topk.mode", ConfigType.STRING, "approx",
              Importance.LOW, "Destination ranking over the move grid: "
              "'approx' = TPU PartialReduce approximate top-k (recall "
