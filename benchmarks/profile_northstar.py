@@ -73,6 +73,12 @@ def main() -> None:
                          "(>=1 disables)")
     ap.add_argument("--sel-rows", type=int, default=1024,
                     help="post-compaction selection problem size C")
+    # defaults mirror TpuSearchConfig so a bare --warm run measures the
+    # shipped configuration
+    ap.add_argument("--repool", type=int, default=128,
+                    help="device pool rebuild cadence (steps)")
+    ap.add_argument("--q", type=int, default=4,
+                    help="move candidates offered per source broker")
     ap.add_argument("--warm", action="store_true",
                     help="run optimize twice; report the second (compile "
                          "amortized) with phase timers reset")
@@ -139,7 +145,9 @@ def main() -> None:
                             step_diagnostics=args.diag,
                             cohort_mode=args.cohort_mode,
                             cohort_stack_tol=args.stack_tol,
-                            selection_rows=args.sel_rows)
+                            selection_rows=args.sel_rows,
+                            repool_steps=args.repool,
+                            moves_per_src=args.q)
     opt = T.TpuGoalOptimizer(config=cfg)
     if args.warm:
         opt.optimize(state)

@@ -134,8 +134,14 @@ class TpuSearchConfig:
     #: Pool builds are P·S-scale — the priority scan over every replica —
     #: so they are amortized across a window of steps; within a window the
     #: membership drifts negligibly while scoring stays live.  A step that
-    #: commits nothing right after a repool ends the call (converged)
-    repool_steps: int = 64
+    #: commits nothing right after a repool ends the call (converged).
+    #: r4 remeasure after the step got 2× cheaper: the ~140 ms rebuild was
+    #: ~22% of step cost at 64; 128 measured 34.8–36.3 s / score 10 252
+    #: (two runs) vs 64's 33.8–35.3 / 10 255 — wall inside link noise,
+    #: score a hair better, and the rebuild's fixed cost mechanically
+    #: halves; membership drift over ~4k changed partitions of 1M is
+    #: negligible
+    repool_steps: int = 128
     #: actions committed per device step: budgeted-cohort commits plus
     #: disjoint auction winners, capped to this many best-scored actions.
     #: 0 = auto (scales with broker count: B//2 clamped to [32, 2048])
@@ -146,7 +152,11 @@ class TpuSearchConfig:
     #: above and the destination below the average utilization (the
     #: water-filling guard: within those budgets every move individually
     #: improves the convex cost regardless of what else the batch commits).
-    #: 1 restores strict one-move-per-source batches
+    #: 1 restores strict one-move-per-source batches.  r4 sweep (north
+    #: star, healthy-link runs): Q=2 and Q=4 land within the ±1.5 s link
+    #: noise of each other (33.8–37.2 s across the Q×repool grid) at
+    #: scores 10 249–10 262 — no measurable win either way, so Q=4 keeps
+    #: the wider per-source choice that drain/heal workloads use
     moves_per_src: int = 4
     #: incremental rescore between repools (round-3 VERDICT item #1) —
     #: OFF by default, on measurement.  The move grid decomposes as

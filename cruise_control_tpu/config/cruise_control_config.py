@@ -665,7 +665,7 @@ def default_config_def() -> ConfigDef:
     d.define("tpu.search.steps.per.call", ConfigType.INT, 512,
              Importance.MEDIUM, "Device-resident steps per call (0 = "
              "score-only rounds).", at_least(0), G)
-    d.define("tpu.search.repool.steps", ConfigType.INT, 64,
+    d.define("tpu.search.repool.steps", ConfigType.INT, 128,
              Importance.LOW, "Steps between on-device candidate-pool "
              "rebuilds.", at_least(1), G)
     d.define("tpu.search.incremental.rescore", ConfigType.BOOLEAN, False,

@@ -582,56 +582,30 @@ def test_parity_gate_midscale():
     assert result["speed_gate"], result  # at least faster than greedy
 
 
-def test_corrected_cohort_stack_guard_and_narrow_selection():
-    """Non-default engine knobs must compile and hold the quality bar:
-    the round-4 commit-ordering guard (cohort.stack.tolerance < 1 — the
-    only path that traces the stacked/guard branch) and a narrowed
-    selection problem size (selection.rows below the row count)."""
-    from cruise_control_tpu.analyzer.goal_optimizer import (
-        GoalOptimizer,
-        make_goals,
-    )
-    from cruise_control_tpu.analyzer.verifier import (
-        verify_result,
-        violation_score,
-    )
-    from cruise_control_tpu.models.generators import random_cluster
-
+@pytest.fixture(scope="module")
+def greedy_60b_baseline():
+    """One greedy oracle on the shared 60b/1200p fixture for every
+    non-default-engine-knob quality-bar test (multi-second CPU cost)."""
     state = random_cluster(seed=21, num_brokers=60, num_racks=6,
                            num_partitions=1200)
     goals = make_goals()
     greedy = GoalOptimizer(goals).optimize(state)
-    for cfg in (
-        TpuSearchConfig(cohort_mode="corrected", cohort_stack_tol=0.25),
-        TpuSearchConfig(selection_rows=64),  # < (Q+1)*B = 300 rows
-    ):
-        tpu = TpuGoalOptimizer(config=cfg).optimize(state)
-        verify_result(state, tpu, goals)
-        assert violation_score(tpu.final_state, goals) <= violation_score(
-            greedy.final_state, goals), cfg
+    return state, goals, violation_score(greedy.final_state, goals)
 
 
-def test_corrected_cohort_mode_beats_or_matches_greedy():
-    """The round-3 exact-conservative stacked cohort
-    (tpu.search.cohort.mode=corrected) must hold the same quality bar as
-    the default: violation score <= greedy on the same input."""
-    from cruise_control_tpu.analyzer.goal_optimizer import (
-        GoalOptimizer,
-        make_goals,
-    )
-    from cruise_control_tpu.analyzer.verifier import (
-        verify_result,
-        violation_score,
-    )
-    from cruise_control_tpu.models.generators import random_cluster
-
-    state = random_cluster(seed=21, num_brokers=60, num_racks=6,
-                           num_partitions=1200)
-    goals = make_goals()
-    greedy = GoalOptimizer(goals).optimize(state)
-    tpu = TpuGoalOptimizer(
-        config=TpuSearchConfig(cohort_mode="corrected")
-    ).optimize(state)
+@pytest.mark.parametrize("cfg", [
+    # the round-3 exact-conservative stacked cohort
+    TpuSearchConfig(cohort_mode="corrected"),
+    # round-4 commit-ordering guard (the only path tracing the
+    # stacked/guard branch)
+    TpuSearchConfig(cohort_mode="corrected", cohort_stack_tol=0.25),
+    # narrowed selection problem size (< (Q+1)*B = 300 rows)
+    TpuSearchConfig(selection_rows=64),
+])
+def test_non_default_engine_knobs_hold_quality_bar(cfg, greedy_60b_baseline):
+    """Non-default engine knobs must compile and hold the same quality
+    bar as the default: violation score <= greedy on the same input."""
+    state, goals, greedy_score = greedy_60b_baseline
+    tpu = TpuGoalOptimizer(config=cfg).optimize(state)
     verify_result(state, tpu, goals)
-    assert violation_score(tpu.final_state, goals) <= violation_score(
-        greedy.final_state, goals)
+    assert violation_score(tpu.final_state, goals) <= greedy_score, cfg
