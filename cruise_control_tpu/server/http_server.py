@@ -207,6 +207,11 @@ class CruiseControlHttpServer:
         if self.cors_enabled:
             handler.send_header("Access-Control-Allow-Origin",
                                 self.cors_origin)
+            # browsers only expose safelisted headers cross-origin: without
+            # this the async 202 protocol's task id is unreadable from a
+            # remote UI and its poll loop silently never starts
+            handler.send_header("Access-Control-Expose-Headers",
+                                "User-Task-ID")
         for k, v in (headers or {}).items():
             handler.send_header(k, v)
         handler.end_headers()

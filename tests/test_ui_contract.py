@@ -91,7 +91,7 @@ def test_ui_vocabulary_is_covered():
     posts = set(re.findall(r"(?:post|op|opForm)\('([a-z_]+)'", js))
     posts |= set(re.findall(r"opQuery\(\"([a-z_]+)\"", js))
     # raw fetch calls that bypass the helpers (e.g. review's own fetch)
-    posts |= set(re.findall(r"fetch\(`\$\{API\}/([a-z_]+)[?`]", js))
+    posts |= set(re.findall(r"fetch\(`\$\{apiBase\(\)\}/([a-z_]+)[?`]", js))
     assert gets <= UI_GET_ENDPOINTS, gets - UI_GET_ENDPOINTS
     assert posts <= UI_POST_ENDPOINTS, posts - UI_POST_ENDPOINTS
     assert "review" in posts  # the raw-fetch scan actually fires
@@ -319,6 +319,41 @@ def test_proposal_diff_view_contract(server):
     assert 0 < gains <= body["dataToMoveMB"] * 1.001
     js = UI_HTML.read_text()
     assert 'id="prop-diff"' in js and "brokerLoadDiff" in js
+
+
+def test_multi_cluster_switcher_and_cors():
+    """Upstream-UI parity: the dashboard can switch between Cruise
+    Control servers.  The switcher is client-side (localStorage), every
+    fetch routes through apiBase(), and a cross-origin target works when
+    that server enables CORS — pin both halves."""
+    js = UI_HTML.read_text()
+    for needle in ('id="cluster-sel"', "switchCluster", "addCluster",
+                   "removeCluster", "cc_clusters", "apiBase"):
+        assert needle in js, needle
+    # every fetch goes through the switchable base, none bypass it
+    assert "${API}/" not in js
+    assert js.count("${apiBase()}/") >= 4
+    # the server side of cross-origin: CORS headers when enabled
+    cc, _, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0, cors_enabled=True,
+                                  cors_origin="https://ops.example")
+    srv.start()
+    try:
+        _, status, headers = _get(srv, "state")
+        assert status == 200
+        assert headers.get("Access-Control-Allow-Origin") == \
+            "https://ops.example"
+        # without exposing it, the async 202 protocol's task id is
+        # unreadable cross-origin and the remote poll loop never starts
+        assert "User-Task-ID" in headers.get(
+            "Access-Control-Expose-Headers", "")
+        body, status, headers = _post(srv, "rebalance?dryrun=true")
+        assert status == 202 and headers.get("User-Task-ID")
+        assert "User-Task-ID" in headers.get(
+            "Access-Control-Expose-Headers", "")
+        _poll_task(srv, headers["User-Task-ID"])
+    finally:
+        srv.stop()
 
 
 def test_expanded_dashboard_structure_and_data():
