@@ -935,7 +935,11 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
                 m, cfg, ca, jnp.ones(Ll, jnp.int32), lp_l, lsl_l,
                 jnp.zeros(Ll, jnp.int32),
             )
-            return -neg - src_term_l[:, None], dest_pool[bi], ls
+            # the carry stores POOL indices, not broker ids: translating
+            # all [Kl, R] entries through dest_pool every step was the
+            # single largest 1/step kernel (~0.35 ms); only the C
+            # compacted rows translate (see move_dst below)
+            return -neg - src_term_l[:, None], bi.astype(jnp.int32), ls
 
         if cfg.incremental_rescore:
             RB = min(Kl, cfg.rescore_rows_budget)
@@ -971,10 +975,18 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
                 # merge by destterm (src_term is common per row, so the
                 # ranking is the same): stored top-R with stale-destination
                 # entries invalidated (their fresh values are in dt_c) ∪ (a)
-                stored = jnp.where(tb[jnp.clip(bd_l, 0)], jnp.inf, dt_l)
+                # bd_l holds pool indices: resolve to broker ids only for
+                # the staleness lookup (patch path only)
+                stored_bid = dest_pool[jnp.clip(bd_l, 0)]
+                stored = jnp.where(
+                    tb[jnp.clip(stored_bid, 0)], jnp.inf, dt_l
+                )
                 merged_s = jnp.concatenate([stored, dt_c], axis=1)
+                cidx_m = jnp.where(
+                    col_stale[cidx], cidx.astype(jnp.int32), -1
+                )
                 merged_d = jnp.concatenate(
-                    [bd_l, jnp.broadcast_to(dp_c[None, :], (Kl, CB))],
+                    [bd_l, jnp.broadcast_to(cidx_m[None, :], (Kl, CB))],
                     axis=1,
                 )
                 # exact on purpose, not via _grid_top_r: R+CB ≈ 136-wide
@@ -995,7 +1007,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
                     jnp.where(rok[:, None], dt_r, new_dt[ridx])
                 )
                 new_bd = new_bd.at[ridx].set(
-                    jnp.where(rok[:, None], dest_pool[bir], new_bd[ridx])
+                    jnp.where(rok[:, None], bir, new_bd[ridx])
                 )
                 # leadership entries rescored in place (exact)
                 lorder = jnp.argsort(~l_stale)
@@ -1037,7 +1049,8 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # each src broker with R alternate dests; rows [Q·B, (Q+1)·B) =
         # per-leader-broker best transfer
         sb = jnp.clip(m.assignment[kp, ks], 0)
-        rows_q = _topq_rows_per_src(sb, row_scores[:, 0], B, Q).reshape(-1)
+        rows_q2, q_scores = _topq_rows_per_src(sb, row_scores[:, 0], B, Q)
+        rows_q = rows_q2.reshape(-1)
         valid_q = rows_q < Kn
         mrow = jnp.clip(rows_q, 0, Kn - 1)
         is_move_row = jnp.arange(NROW) < Q * B
@@ -1053,8 +1066,10 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # move_vec — are built post-compaction at [C], which removed ~3 ms
         # of gather-latency per step at north-star shapes
         # (KERNEL_BUDGET_r04_baseline.json: fusion.983/984/985/…)
+        # q_scores already carries inf for invalid (q, src) slots — no
+        # [Q·B]-row re-gather of row_scores needed for the key
         key_all = jnp.concatenate(
-            [jnp.where(valid_q, row_scores[mrow, 0], jnp.inf), bl_score]
+            [q_scores.reshape(-1), bl_score]
         )                                                 # [NROW]
         C = min(cfg.selection_rows, NROW)
         _, crow_all = jax.lax.sort_key_val(
@@ -1076,7 +1091,13 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
                  jnp.full((C, R - 1), jnp.inf, row_scores.dtype)], axis=1
             ),
         )                                                 # [C, R]
-        cand_dst = jnp.where(imr, best_d[mr_c], bl_dst[lrow_c][:, None])
+        # best_d carries POOL indices; translate only the C compacted
+        # rows to broker ids (invalid/-1 entries stay -1)
+        bd_c = best_d[mr_c]
+        move_dst = jnp.where(
+            bd_c >= 0, dest_pool[jnp.clip(bd_c, 0)], -1
+        )
+        cand_dst = jnp.where(imr, move_dst, bl_dst[lrow_c][:, None])
         cand_src = jnp.where(is_move_row, sb[mr_c], lrow_c)
         cand_p = jnp.where(is_move_row, kp[mr_c], bl_p[lrow_c])
         cand_s = jnp.where(is_move_row, ks[mr_c], bl_s[lrow_c])
@@ -2022,13 +2043,18 @@ def _topq_rows_per_src(sb, row_best, B: int, Q: int):
     """Top-Q candidate rows per source broker by score.
 
     sb [K] = source broker of each row; row_best [K] = the row's best-dest
-    score.  → int32 [Q, B]: the q-th best row index of each broker, K where
-    a broker has fewer than q+1 rows.  Q sequential scatter-min passes — Q
-    is small and each pass is O(K)."""
+    score.  → (rows int32 [Q, B], scores f32 [Q, B]): the q-th best row
+    index of each broker (K where a broker has fewer than q+1 rows) and
+    that row's score (inf where invalid) — returned directly because the
+    selection pass already holds it in ``seg``, where re-gathering it
+    through the [Q·B]-row index vector cost ~0.3 ms/step at north-star
+    shapes.  Q sequential scatter-min passes — Q is small and each pass
+    is O(K)."""
     K = sb.shape[0]
     cur = row_best
     idx = jnp.arange(K, dtype=jnp.int32)
     outs = []
+    out_scores = []
     for _ in range(Q):
         seg = jnp.full(B, jnp.inf).at[sb].min(cur)
         r = jnp.full(B, K, jnp.int32).at[sb].min(
@@ -2037,9 +2063,10 @@ def _topq_rows_per_src(sb, row_best, B: int, Q: int):
             )
         )
         outs.append(r)
+        out_scores.append(jnp.where(r < K, seg, jnp.inf))
         # knock the chosen rows out for the next pass (r == K drops)
         cur = cur.at[r].set(jnp.inf, mode="drop")
-    return jnp.stack(outs)
+    return jnp.stack(outs), jnp.stack(out_scores)
 
 
 def _step_budgets(m: DeviceModel, ca) -> Tuple[jax.Array, jax.Array]:

@@ -501,7 +501,17 @@ def test_topq_rows_per_src():
     sb = jnp.array([0, 0, 0, 1, 1, 2], dtype=jnp.int32)
     score = jnp.array([-5.0, -9.0, -7.0, -1.0, -2.0, jnp.inf])
     K = 6
-    rows = np.asarray(_topq_rows_per_src(sb, score, B=4, Q=2))
+    rows, scores = _topq_rows_per_src(sb, score, B=4, Q=2)
+    rows = np.asarray(rows)
+    # the returned scores are exactly the selected rows' scores (inf at
+    # invalid slots) — callers use them as the sort key without re-gather
+    sc = np.asarray(scores)
+    for q in range(2):
+        for b in range(4):
+            if rows[q, b] < len(np.asarray(score)):
+                assert sc[q, b] == np.asarray(score)[rows[q, b]]
+            else:
+                assert np.isinf(sc[q, b])
     # broker 0: rows 1 (-9) then 2 (-7); broker 1: rows 4 (-2) then 3 (-1);
     # broker 2: only an inf row -> never selected; broker 3: no rows
     assert rows[0, 0] == 1 and rows[1, 0] == 2

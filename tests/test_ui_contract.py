@@ -330,9 +330,13 @@ def test_multi_cluster_switcher_and_cors():
     for needle in ('id="cluster-sel"', "switchCluster", "addCluster",
                    "removeCluster", "cc_clusters", "apiBase"):
         assert needle in js, needle
-    # every fetch goes through the switchable base, none bypass it
+    # every fetch goes through the switchable base — live, or pinned at
+    # task submission (opQuery's poll must not retarget mid-flight) —
+    # none bypass it with the raw same-origin prefix
     assert "${API}/" not in js
-    assert js.count("${apiBase()}/") >= 4
+    routed = (js.count("${apiBase()}/") + js.count("${base}/")
+              + js.count("${base ?? apiBase()}/"))
+    assert routed >= 4, routed
     # the server side of cross-origin: CORS headers when enabled
     cc, _, _ = full_stack()
     srv = CruiseControlHttpServer(cc, port=0, cors_enabled=True,
