@@ -621,12 +621,23 @@ def _build_round_pools(
         jnp.sum(alive_cap, axis=0), 1e-9
     )
     stress = jnp.sum(jnp.maximum(util - avg_u[None, :], 0.0), axis=1)  # [B]
-    surplus = stress[src_b]                                  # [P, S]
+    # ONE [P, S, 3] row-gather for all three broker-table lookups
+    # (overage/stress/rack): three separate scalar gathers over the P·S
+    # axis were ~60 ms of the ~140 ms rebuild on the scalar unit — row
+    # gathers amortize the per-index cost across the row.  Rack ids are
+    # < 2^24, so the f32 round trip is exact.
+    btab = jnp.stack(
+        [overage, stress, m.rack.astype(jnp.float32)], axis=1
+    )                                                        # [B, 3]
+    g3 = btab[src_b]                                         # [P, S, 3]
+    surplus = g3[..., 1]
     fit = surplus - jnp.abs(size - surplus)
-    prio = overage[src_b] * 10.0 + surplus * 2.0 + fit
+    prio = g3[..., 0] * 10.0 + surplus * 2.0 + fit
     # rack-violating replicas (lower-indexed slot of same partition shares
     # the rack) must enter the source pool for repair
-    racks = jnp.where(slot_exists, m.rack[src_b], -1)              # [P, S]
+    racks = jnp.where(
+        slot_exists, g3[..., 2].astype(jnp.int32), -1
+    )                                                        # [P, S]
     same_rack = racks[:, :, None] == racks[:, None, :]             # [P, s, k]
     k_lt_s = jnp.arange(S)[:, None] > jnp.arange(S)[None, :]       # [s, k]: k < s
     rack_dup = (
@@ -1876,8 +1887,15 @@ def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
     stress = (
         jnp.max(util, axis=1) + m.leader_nwin / cap[:, Resource.NW_IN] + lc_over
     )
-    # src relief (current leader's broker) + dst need (slot's broker)
-    prio = stress[lb_c][:, None] + lc_need[jnp.clip(m.assignment, 0)]  # [P, S]
+    # src relief (current leader's broker) + dst need (slot's broker).
+    # lc_need and lead_ok ride ONE [P, S, 2] row-gather — the same
+    # scalar-gather amortization as _build_round_pools' btab (the two
+    # separate per-slot gathers were ~40 ms of the rebuild)
+    ltab = jnp.stack(
+        [lc_need, m.lead_ok.astype(jnp.float32)], axis=1
+    )                                                        # [B, 2]
+    g2 = ltab[jnp.clip(m.assignment, 0)]                     # [P, S, 2]
+    prio = stress[lb_c][:, None] + g2[..., 0]                # [P, S]
     # mirror lead_feasible's static terms (_score_candidates) so the pruned
     # pool never fills with always-infeasible candidates, starving feasible
     # transfers that the full grid would have scored
@@ -1886,7 +1904,7 @@ def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
         & (jnp.arange(S)[None, :] != m.leader_slot[:, None])
         & ~m.excluded[:, None]
         & ~m.must_move
-        & m.lead_ok[jnp.clip(m.assignment, 0)]
+        & (g2[..., 1] > 0.0)
     )
     flat = jnp.where(valid, prio, -jnp.inf).reshape(-1)
     # approximate pool selection — see the note in _build_round_pools

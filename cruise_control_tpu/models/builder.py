@@ -102,6 +102,15 @@ class ClusterModelBuilder:
             rack_id = (
                 self.add_rack(rack) if isinstance(rack, str) else int(rack)
             )
+            if rack_id >= 1 << 24:
+                # raw int rack ids must stay f32-exact: the device engine
+                # rides rack ids through an f32 row-gather (pool-priority
+                # fusion), where ids ≥ 2^24 would silently collide.  Use
+                # string rack names (densified) for hashed/sparse ids.
+                raise ValueError(
+                    f"integer rack id {rack_id} >= 2^24; pass rack as a "
+                    "string (names are densified to small ids)"
+                )
         host_id = -1
         if host is not None:
             host_id = (
