@@ -266,8 +266,12 @@ class OptimizerResult:
             if p.has_leader_change:
                 _ent(p.new_leader)["leaderDelta"] += 1
                 _ent(p.old_leader)["leaderDelta"] -= 1
+        # secondary sort keys keep leader-only brokers (diskDeltaMB == 0)
+        # from sorting last and silently falling off the truncation
         broker_diff = sorted(
-            bdiff.values(), key=lambda e: -abs(e["diskDeltaMB"])
+            bdiff.values(),
+            key=lambda e: (-abs(e["diskDeltaMB"]), -abs(e["leaderDelta"]),
+                           -abs(e["replicaDelta"]), e["broker"]),
         )[:60]
         for e in broker_diff:
             e["diskDeltaMB"] = round(e["diskDeltaMB"], 2)
@@ -284,6 +288,9 @@ class OptimizerResult:
             "numIntraBrokerReplicaMovements": n_disk_moves,
             "dataToMoveMB": round(data_mb, 3),
             "brokerLoadDiff": broker_diff,
+            # truncation indicator: the UI labels the table partial when
+            # numBrokersChanged > len(brokerLoadDiff)
+            "numBrokersChanged": len(bdiff),
             "violationsBefore": self.violations_before,
             "violationsAfter": self.violations_after,
             "violationScoreBefore": self.violation_score_before,

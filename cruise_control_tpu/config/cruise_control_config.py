@@ -252,6 +252,28 @@ def default_config_def() -> ConfigDef:
              "Caching trades admin-call volume for detection latency: "
              "broker failures surface up to this many ms late.",
              at_least(0), G)
+    d.define("default.api.timeout.ms", ConfigType.LONG, 30000,
+             Importance.LOW, "Consolidated timeout for every Kafka RPC the "
+             "production wire issues (admin futures, produce flush, "
+             "consume drain); the per-RPC *.timeout.ms keys below "
+             "override it per RPC class.", at_least(1), G)
+    # upstream's per-RPC timeout family (CONFIG_DELTA §1): 0 = inherit
+    # default.api.timeout.ms.  Key names follow upstream where upstream
+    # has one; produce/consume cover this wire's two data-path RPCs.
+    for _tkey, _tdoc in (
+        ("describe.cluster.timeout.ms",
+         "describe-cluster / broker-list RPCs"),
+        ("list.partition.reassignments.timeout.ms",
+         "reassignment alter/list RPCs"),
+        ("logdir.response.timeout.ms", "JBOD log-dir describe RPCs"),
+        ("metadata.timeout.ms", "topic-metadata RPCs"),
+        ("produce.timeout.ms", "producer queue drain + delivery flush"),
+        ("consume.timeout.ms",
+         "per-call consumer metadata/watermark/poll"),
+    ):
+        d.define(_tkey, ConfigType.LONG, 0, Importance.LOW,
+                 f"Timeout override for {_tdoc}; 0 inherits "
+                 "default.api.timeout.ms.", at_least(0), G)
     d.define("topics.excluded.from.partition.movement", ConfigType.STRING, "",
              Importance.MEDIUM, "Regex of topic names excluded from replica "
              "movement in every optimization.", None, G)

@@ -121,6 +121,9 @@ class Executor:
         #: executor appends — list append/del/slice are single C-level ops
         #: under the GIL, where iterating a deque mid-append raises
         self.execution_log: List[dict] = []
+        #: running completed-movements total — /state must not re-scan the
+        #: unbounded history list on every 5 s UI poll
+        self._finished_movements = 0
         self.adopted_at_startup: Set[int] = set()
         self.adjuster: Optional[ConcurrencyAdjuster] = None
         self.throttle_helper: Optional[ReplicationThrottleHelper] = None
@@ -262,6 +265,7 @@ class Executor:
                 stopped=self._stop_requested,
             )
             self.history.append(result)
+            self._finished_movements += completed
             self.execution_log.append({
                 "executionId": len(self.history),
                 "endedS": round(time.time(), 1),
@@ -480,16 +484,26 @@ class Executor:
                 tick()
 
     # ---- observability ----------------------------------------------------------
-    def state_summary(self) -> dict:
+    def state_summary(self, verbose: bool = False) -> dict:
+        """Summary for ``/state``.  The per-move ``tasks`` arrays (up to
+        8 executions × 200 task dicts) are only embedded when ``verbose``
+        — the UI polls /state every 5 s and opens the drill-in rarely, so
+        the default payload stays proportional to the execution count,
+        not the move count."""
         tasks = self.planner.all_tasks if self.planner else []
         by_state: Dict[str, int] = {}
         for t in tasks:
             by_state[t.state.value] = by_state.get(t.state.value, 0) + 1
+        recent = self.execution_log[-8:]
+        if not verbose:
+            recent = [
+                {k: v for k, v in e.items() if k != "tasks"} for e in recent
+            ]
         return {
             "state": self.state.value,
             "taskCounts": by_state,
-            "numFinishedMovements": sum(r.completed for r in self.history),
+            "numFinishedMovements": self._finished_movements,
             "stopRequested": self._stop_requested,
             "adoptedAtStartup": sorted(self.adopted_at_startup),
-            "recentExecutions": self.execution_log[-8:],
+            "recentExecutions": recent,
         }

@@ -740,12 +740,12 @@ class CruiseControl:
         self.load_monitor.resume_sampling()
 
     # ---- state aggregate (upstream GET /state, §5.5) ----------------------------
-    def state(self) -> dict:
+    def state(self, verbose: bool = False) -> dict:
         out = {
             "version": 1,
             "upTimeSeconds": round(time.time() - self._start_time, 1),
             "MonitorState": self.load_monitor.state_summary(),
-            "ExecutorState": self.executor.state_summary(),
+            "ExecutorState": self.executor.state_summary(verbose=verbose),
             "AnalyzerState": {
                 "engine": self.default_engine,
                 "isProposalReady": self._cached_proposals is not None,

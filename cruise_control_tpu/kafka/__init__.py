@@ -27,12 +27,37 @@ from cruise_control_tpu.kafka.wire import (
 )
 
 
+#: ``*.timeout.ms`` config key → wire RPC class (CONFIG_DELTA §1: the
+#: upstream per-RPC timeout family, mapped onto the wire's surface)
+RPC_TIMEOUT_KEYS = {
+    "describe.cluster.timeout.ms": "describe_cluster",
+    "list.partition.reassignments.timeout.ms": "reassignment",
+    "logdir.response.timeout.ms": "logdirs",
+    "metadata.timeout.ms": "metadata",
+    "produce.timeout.ms": "produce",
+    "consume.timeout.ms": "consume",
+}
+
+
+def rpc_timeouts_from_config(cfg):
+    """Per-RPC-class timeout overrides (seconds) from the ``*.timeout.ms``
+    keys; a key left at 0 inherits ``default.api.timeout.ms``."""
+    out = {}
+    for key, rpc_class in RPC_TIMEOUT_KEYS.items():
+        ms = cfg.get_int(key)
+        if ms > 0:
+            out[rpc_class] = ms / 1000.0
+    return out
+
+
 def build_kafka_stack(cfg, wire=None):
     """(backend, metadata, sampler, sample_store, wire) for a Kafka
     deployment.
 
     Consumes the Kafka-facing config keys: ``bootstrap.servers`` (used to
-    dial a real wire when none is supplied), ``metric.reporter.topic``,
+    dial a real wire when none is supplied), ``default.api.timeout.ms``
+    plus the per-RPC ``*.timeout.ms`` family (:data:`RPC_TIMEOUT_KEYS`),
+    ``metric.reporter.topic``,
     ``partition.metric.sample.store.topic``,
     ``broker.metric.sample.store.topic``,
     ``sample.store.topic.replication.factor``,
@@ -44,7 +69,11 @@ def build_kafka_stack(cfg, wire=None):
     more clients over the same connection.
     """
     if wire is None:
-        wire = real_wire(cfg.get("bootstrap.servers"))
+        wire = real_wire(
+            cfg.get("bootstrap.servers"),
+            timeout_s=cfg.get_int("default.api.timeout.ms") / 1000.0,
+            timeouts=rpc_timeouts_from_config(cfg),
+        )
     backend = KafkaClusterBackend(
         wire,
         progress_check_interval_ms=cfg.get_int(

@@ -109,11 +109,22 @@ class ConfluentKafkaWire(KafkaWire):
     shared (both are thread-safe in the client), consumers are created per
     ``consume`` call (the seam's concurrent-consume contract)."""
 
+    #: RPC classes accepted in the ``timeouts`` override map — the
+    #: upstream ``*.timeout.ms`` family mapped onto this wire's surface
+    #: (upstream: ``describe.cluster.timeout.ms``,
+    #: ``list.partition.reassignments.timeout.ms``,
+    #: ``logdir.response.timeout.ms``; SURVEY.md §5.6 / CONFIG_DELTA §1)
+    TIMEOUT_CLASSES = (
+        "describe_cluster", "metadata", "reassignment", "logdirs",
+        "produce", "consume",
+    )
+
     def __init__(
         self,
         bootstrap_servers: str,
         client_config: Optional[Dict[str, object]] = None,
         timeout_s: float = 30.0,
+        timeouts: Optional[Dict[str, float]] = None,
     ):
         import confluent_kafka
         from confluent_kafka.admin import AdminClient
@@ -123,6 +134,15 @@ class ConfluentKafkaWire(KafkaWire):
             "confluent_kafka.admin", fromlist=["admin"]
         )
         self.timeout_s = timeout_s
+        unknown = set(timeouts or ()) - set(self.TIMEOUT_CLASSES)
+        if unknown:
+            raise ValueError(
+                f"unknown RPC timeout class(es) {sorted(unknown)}; "
+                f"valid: {list(self.TIMEOUT_CLASSES)}"
+            )
+        #: per-RPC-class overrides (seconds); anything absent falls back
+        #: to the consolidated ``timeout_s``
+        self.timeouts: Dict[str, float] = dict(timeouts or {})
         self._conf: Dict[str, object] = {
             "bootstrap.servers": bootstrap_servers,
             **(client_config or {}),
@@ -150,9 +170,15 @@ class ConfluentKafkaWire(KafkaWire):
             )
         return fn
 
-    def _result(self, future, rpc: str):
+    def _t(self, rpc_class: str) -> float:
+        """Effective timeout (seconds) for an RPC class — the per-class
+        override when configured, else the consolidated default."""
+        return self.timeouts.get(rpc_class, self.timeout_s)
+
+    def _result(self, future, rpc: str, timeout: Optional[float] = None):
         try:
-            return future.result(timeout=self.timeout_s)
+            return future.result(
+                timeout=self.timeout_s if timeout is None else timeout)
         except self._ck.KafkaException as e:  # noqa: B904
             raise translate_error(e, rpc) from e
         except Exception as e:  # future timeout / cancellation
@@ -160,8 +186,10 @@ class ConfluentKafkaWire(KafkaWire):
                 raise WireTimeoutError(f"{rpc}: {e!r}") from e
             raise
 
-    def _each_result(self, futures: Dict, rpc: str) -> Dict:
-        return {k: self._result(f, f"{rpc}[{k}]") for k, f in futures.items()}
+    def _each_result(self, futures: Dict, rpc: str,
+                     timeout: Optional[float] = None) -> Dict:
+        return {k: self._result(f, f"{rpc}[{k}]", timeout=timeout)
+                for k, f in futures.items()}
 
     def _tp(self, topic: str, partition: int):
         return self._ck.TopicPartition(topic, partition)
@@ -171,20 +199,21 @@ class ConfluentKafkaWire(KafkaWire):
         if getattr(self._admin, "describe_cluster", None) is not None:
             desc = self._result(
                 self._admin.describe_cluster(
-                    request_timeout=self.timeout_s
+                    request_timeout=self._t("describe_cluster")
                 ),
                 "describe_cluster",
+                timeout=self._t("describe_cluster"),
             )
             return {
                 n.id: {"rack": getattr(n, "rack", None) or ""}
                 for n in desc.nodes
             }
         # older clients: broker list via metadata (no rack information)
-        md = self._admin.list_topics(timeout=self.timeout_s)
+        md = self._admin.list_topics(timeout=self._t("describe_cluster"))
         return {b: {"rack": ""} for b in md.brokers}
 
     def describe_topics(self) -> Dict[str, List[dict]]:
-        md = self._admin.list_topics(timeout=self.timeout_s)
+        md = self._admin.list_topics(timeout=self._t("metadata"))
         out: Dict[str, List[dict]] = {}
         for name, tmd in md.topics.items():
             rows = []
@@ -210,7 +239,11 @@ class ConfluentKafkaWire(KafkaWire):
             self._tp(t, p): (None if new is None else list(new))
             for (t, p), new in targets.items()
         }
-        self._each_result(fn(req), "alter_partition_reassignments")
+        self._each_result(
+            fn(req, request_timeout=self._t("reassignment")),
+            "alter_partition_reassignments",
+            timeout=self._t("reassignment"),
+        )
 
     def list_partition_reassignments(self) -> Dict[TopicPartition, dict]:
         # READ probe: degrade to empty when the client lacks the RPC —
@@ -229,8 +262,9 @@ class ConfluentKafkaWire(KafkaWire):
                 )
             return {}
         listing = self._result(
-            fn(request_timeout=self.timeout_s),
+            fn(request_timeout=self._t("reassignment")),
             "list_partition_reassignments",
+            timeout=self._t("reassignment"),
         )
         out: Dict[TopicPartition, dict] = {}
         for tp, st in listing.items():
@@ -313,10 +347,11 @@ class ConfluentKafkaWire(KafkaWire):
 
     def describe_log_dirs(self) -> Dict[int, Dict[str, dict]]:
         fn = self._rpc("describe_log_dirs")
-        md = self._admin.list_topics(timeout=self.timeout_s)
+        md = self._admin.list_topics(timeout=self._t("metadata"))
         brokers = list(md.brokers)
         listing = self._each_result(
-            fn(brokers, request_timeout=self.timeout_s), "describe_log_dirs"
+            fn(brokers, request_timeout=self._t("logdirs")),
+            "describe_log_dirs", timeout=self._t("logdirs"),
         )
         out: Dict[int, Dict[str, dict]] = {}
         for broker, dirs in listing.items():
@@ -374,7 +409,7 @@ class ConfluentKafkaWire(KafkaWire):
             except BufferError:
                 # local queue full (batches > queue.buffering.max.messages):
                 # service the delivery queue to drain, then retry once
-                self._producer.poll(self.timeout_s)
+                self._producer.poll(self._t("produce"))
                 try:
                     self._producer.produce(
                         topic, value=rec, key=key, on_delivery=on_delivery,
@@ -384,11 +419,11 @@ class ConfluentKafkaWire(KafkaWire):
                         f"produce[{topic}]: local queue still full after "
                         f"drain ({i}/{len(records)} enqueued)"
                     ) from e
-        remaining = self._producer.flush(self.timeout_s)
+        remaining = self._producer.flush(self._t("produce"))
         if remaining:
             raise WireTimeoutError(
                 f"produce[{topic}]: {remaining} records undelivered after "
-                f"{self.timeout_s}s"
+                f"{self._t('produce')}s"
             )
         if errors:
             raise translate_error(
@@ -439,7 +474,7 @@ class ConfluentKafkaWire(KafkaWire):
         ends: Dict[int, int] = {}
         trimmed = 0
         try:
-            md = consumer.list_topics(topic, timeout=self.timeout_s)
+            md = consumer.list_topics(topic, timeout=self._t("consume"))
             tmd = md.topics.get(topic)
             if tmd is None or getattr(tmd, "error", None):
                 return [], offset
@@ -447,7 +482,7 @@ class ConfluentKafkaWire(KafkaWire):
             assignment = []
             for p in parts:
                 lo, hi = consumer.get_watermark_offsets(
-                    self._tp(topic, p), timeout=self.timeout_s
+                    self._tp(topic, p), timeout=self._t("consume")
                 )
                 trimmed += lo
                 start = max(starts.get(p, lo), lo)
@@ -461,7 +496,7 @@ class ConfluentKafkaWire(KafkaWire):
                 consumer.assign(assignment)
             done = {p for p in parts if starts[p] >= ends[p]}
             while len(done) < len(parts):
-                msg = consumer.poll(timeout=self.timeout_s)
+                msg = consumer.poll(timeout=self._t("consume"))
                 if msg is None:
                     break  # drained what the broker would give us
                 err = msg.error()

@@ -117,7 +117,19 @@ class KafkaWire:
         fetcher pool pulls on N threads).  An implementation over a client
         library whose consumers are not thread-safe must create one
         consumer per call (the call is stateless — seek to ``offset``,
-        drain, close) rather than share one."""
+        drain, close) rather than share one.
+
+        CURSOR CONTRACT: the returned "next offset" is an OPAQUE resume
+        token — pass it back to ``consume`` unmodified.  Implementations
+        may return an ``int`` subclass carrying extra resume state (e.g.
+        ``ConfluentKafkaWire``'s ``VirtualOffset`` holds exact
+        per-partition positions for multi-partition topics); arithmetic
+        on it (``offset + n``) or a JSON/DB round-trip strips that state
+        and silently degrades resume precision to the implementation's
+        fallback.  Callers that must persist a cursor should treat the
+        loss as implementation-defined, and alternative wire
+        implementations must tolerate receiving a plain ``int`` from such
+        a round-trip."""
         raise NotImplementedError
 
 
@@ -336,7 +348,8 @@ class FakeKafkaWire(KafkaWire):
 
 
 def real_wire(bootstrap_servers: str,
-              client_config=None, timeout_s: float = 30.0) -> KafkaWire:
+              client_config=None, timeout_s: float = 30.0,
+              timeouts=None) -> KafkaWire:
     """The production wire: :class:`~.confluent_wire.ConfluentKafkaWire`
     over ``confluent_kafka`` when the client library is importable.
 
@@ -357,5 +370,6 @@ def real_wire(bootstrap_servers: str,
     from cruise_control_tpu.kafka.confluent_wire import ConfluentKafkaWire
 
     return ConfluentKafkaWire(
-        bootstrap_servers, client_config=client_config, timeout_s=timeout_s
+        bootstrap_servers, client_config=client_config, timeout_s=timeout_s,
+        timeouts=timeouts,
     )

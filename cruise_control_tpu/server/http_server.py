@@ -220,7 +220,10 @@ class CruiseControlHttpServer:
     # ---- GET endpoints ----------------------------------------------------------
     def _handle_get(self, handler, endpoint: str, params: dict) -> None:
         if endpoint == "state":
-            return self._send(handler, 200, self.cc.state())
+            # verbose embeds the per-move task arrays in
+            # ExecutorState.recentExecutions (upstream: verbose substates)
+            return self._send(
+                handler, 200, self.cc.state(verbose=_flag(params, "verbose")))
         if endpoint == "load":
             return self._send(handler, 200, self._load_response())
         if endpoint == "partition_load":

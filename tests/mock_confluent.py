@@ -152,7 +152,7 @@ class MockAdminClient:
         )
 
     # -- reassignment --
-    def alter_partition_reassignments(self, req):
+    def alter_partition_reassignments(self, req, request_timeout=None):
         self.b.calls.append(("alter_partition_reassignments", {
             (tp.topic, tp.partition): (None if new is None else list(new))
             for tp, new in req.items()

@@ -363,3 +363,68 @@ def test_produce_drains_on_local_queue_full(broker, wire):
     wire.produce("m", [bytes([i]) for i in range(25)])
     records, _ = wire.consume("m", 0)
     assert len(records) == 25
+
+
+def test_per_rpc_timeout_overrides_reach_the_client(broker, wire):
+    """CONFIG_DELTA §1 closure: the per-RPC *.timeout.ms family — an
+    override steers only its RPC class; everything else keeps the
+    consolidated default."""
+    from cruise_control_tpu.kafka.confluent_wire import ConfluentKafkaWire
+
+    w = ConfluentKafkaWire(
+        SERVERS, timeout_s=2.0,
+        timeouts={"describe_cluster": 7.0, "logdirs": 9.0},
+    )
+    captured = {}
+    orig = w._admin.describe_cluster
+
+    def recording(request_timeout=None):
+        captured["describe_cluster"] = request_timeout
+        return orig(request_timeout=request_timeout)
+
+    w._admin.describe_cluster = recording
+    w.describe_cluster()
+    assert captured["describe_cluster"] == 7.0
+    assert w._t("logdirs") == 9.0
+    assert w._t("metadata") == 2.0  # un-overridden class: default
+
+
+def test_unknown_timeout_class_rejected(broker):
+    from cruise_control_tpu.kafka.confluent_wire import ConfluentKafkaWire
+
+    with pytest.raises(ValueError, match="unknown RPC timeout class"):
+        ConfluentKafkaWire(SERVERS, timeouts={"bogus": 1.0})
+
+
+def test_rpc_timeouts_from_config_keys(broker):
+    """The ConfigDef keys feed the wire: 0 inherits the consolidated
+    default, a positive value becomes a per-class override in seconds."""
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.kafka import rpc_timeouts_from_config
+
+    cfg = CruiseControlConfig({
+        "logdir.response.timeout.ms": 45000,
+        "consume.timeout.ms": 1500,
+    })
+    assert rpc_timeouts_from_config(cfg) == {
+        "logdirs": 45.0, "consume": 1.5,
+    }
+    w = real_wire(
+        SERVERS,
+        timeout_s=cfg.get_int("default.api.timeout.ms") / 1000.0,
+        timeouts=rpc_timeouts_from_config(cfg),
+    )
+    assert w._t("logdirs") == 45.0 and w._t("reassignment") == 30.0
+
+
+def test_timeout_class_registries_agree(broker):
+    """RPC_TIMEOUT_KEYS (config side) and TIMEOUT_CLASSES (wire side) are
+    two views of the same vocabulary — drift would only surface at
+    runtime when a key is first configured."""
+    from cruise_control_tpu.kafka import RPC_TIMEOUT_KEYS
+    from cruise_control_tpu.kafka.confluent_wire import ConfluentKafkaWire
+
+    assert set(RPC_TIMEOUT_KEYS.values()) == set(
+        ConfluentKafkaWire.TIMEOUT_CLASSES)

@@ -282,10 +282,15 @@ def test_executor_history_drill_in_contract(server):
     st, _, _ = _get(srv, "state")
     execs = st["ExecutorState"]["recentExecutions"]
     assert execs, "no execution recorded"
-    e = execs[-1]
+    # the 5s-poll payload carries summaries ONLY — no per-move arrays
+    assert all("tasks" not in e for e in execs)
     for key in ("executionId", "strategy", "numProposals", "completed",
-                "dead", "aborted", "ticks", "stopped", "tasks"):
-        assert key in e, (key, sorted(e))
+                "dead", "aborted", "ticks", "stopped"):
+        assert key in execs[-1], (key, sorted(execs[-1]))
+    # the drill-in fetches state?verbose=true for the task arrays
+    st, _, _ = _get(srv, "state?verbose=true")
+    execs = st["ExecutorState"]["recentExecutions"]
+    e = execs[-1]
     assert e["completed"] > 0 and e["tasks"]
     t0 = e["tasks"][0]
     for key in ("taskId", "type", "partition", "state", "from", "to",
@@ -294,7 +299,7 @@ def test_executor_history_drill_in_contract(server):
     assert "numFinishedMovements" in st["ExecutorState"]
     js = UI_HTML.read_text()
     for needle in ("renderExecHistory", "execDetail", 'id="exec-list"',
-                   'id="exec-moves"'):
+                   'id="exec-moves"', "state?verbose=true"):
         assert needle in js, needle
 
 
@@ -308,6 +313,8 @@ def test_proposal_diff_view_contract(server):
     assert diff, "plan moves replicas but brokerLoadDiff is empty"
     for key in ("broker", "replicaDelta", "leaderDelta", "diskDeltaMB"):
         assert key in diff[0], (key, sorted(diff[0]))
+    # truncation indicator: totals let the UI label the table partial
+    assert body["numBrokersChanged"] == len(diff)  # no truncation here
     # conservation: every replica/leader/byte added somewhere is removed
     # somewhere (no truncation at this fixture's broker count)
     assert sum(d["replicaDelta"] for d in diff) == 0
