@@ -1,0 +1,113 @@
+"""Sharded-path parity at ADVERTISED shapes (round-5 item #6).
+
+The multichip gate (``__graft_entry__.dryrun_multichip``, 200b/5k) proves
+the sharded program compiles and matches single-device at small shapes;
+this run proves it at ~1k brokers / 50k partitions — large enough that
+per-device pool shards exercise the same padding/gather layouts as the
+north star (K=8192 over 8 devices → 1024-row shards, D≈1000).  Real
+multi-chip hardware is unavailable in this environment; the virtual
+8-device CPU mesh is the prescribed substitute (SURVEY.md §4 test
+strategy).
+
+Runs the full device-resident search twice — single-device CPU, then
+shard_map over an 8-device mesh — and requires the two PLANS to be
+identical action for action (K divisible by the mesh → arithmetically
+identical programs), then verifies the plan against the goal stack.
+
+Usage (fresh process; forces the virtual CPU platform):
+    PYTHONPATH=. python benchmarks/sharded_large_dryrun.py \
+        [--devices 8] [--brokers 1000] [--partitions 50000] \
+        [--out SHARDED_DRYRUN_r05.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--brokers", type=int, default=1000)
+    ap.add_argument("--partitions", type=int, default=50_000)
+    ap.add_argument("--racks", type=int, default=40)
+    ap.add_argument("--seed", type=int, default=13)
+    ap.add_argument("--out", default="SHARDED_DRYRUN_r05.json")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.devices}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from cruise_control_tpu.utils.jit_cache import enable as enable_cache
+
+    enable_cache()
+    from cruise_control_tpu.analyzer.goal_optimizer import make_goals
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.analyzer.verifier import (
+        verify_result,
+        violation_score,
+    )
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(
+        seed=args.seed, num_brokers=args.brokers, num_racks=args.racks,
+        num_partitions=args.partitions, mean_utilization=0.45,
+    )
+    cfg = TpuSearchConfig()
+    goals = make_goals()
+
+    def plan(result):
+        return [
+            (a.action_type.name, a.partition, a.slot, a.source_broker,
+             a.dest_broker) for a in result.actions
+        ]
+
+    t0 = time.perf_counter()
+    single = TpuGoalOptimizer(config=cfg).optimize(state)
+    t_single = time.perf_counter() - t0
+    verify_result(state, single, goals)
+
+    mesh = Mesh(np.array(jax.devices()[: args.devices]), ("search",))
+    t0 = time.perf_counter()
+    sharded = TpuGoalOptimizer(config=cfg, mesh=mesh).optimize(state)
+    t_sharded = time.perf_counter() - t0
+    verify_result(state, sharded, goals)
+
+    p1, p2 = plan(single), plan(sharded)
+    out = {
+        "fixture": {
+            "seed": args.seed, "brokers": args.brokers,
+            "partitions": args.partitions, "racks": args.racks,
+        },
+        "devices": args.devices,
+        "actions_single": len(p1),
+        "actions_sharded": len(p2),
+        "plan_identical": p1 == p2,
+        "score_single": violation_score(single.final_state, goals),
+        "score_sharded": violation_score(sharded.final_state, goals),
+        "wall_single_s": round(t_single, 1),
+        "wall_sharded_s": round(t_sharded, 1),
+        "ok": bool(p1 == p2),
+    }
+    print(json.dumps(out, indent=1))
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    if not out["ok"]:
+        raise SystemExit("sharded plan diverged from single-device plan")
+
+
+if __name__ == "__main__":
+    main()

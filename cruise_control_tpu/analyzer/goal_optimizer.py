@@ -293,6 +293,10 @@ class OptimizerResult:
             "numBrokersChanged": len(bdiff),
             "violationsBefore": self.violations_before,
             "violationsAfter": self.violations_after,
+            # reference-UI parity: per-goal before/after + ClusterModelStats
+            # deltas backing the proposals tab's goal-stats card
+            "statsBefore": self.stats_before,
+            "statsAfter": self.stats_after,
             "violationScoreBefore": self.violation_score_before,
             "violationScoreAfter": self.violation_score_after,
             "durationSeconds": self.duration_s,

@@ -101,6 +101,25 @@ class Goal:
         this goal satisfied?"""
         return True
 
+    def accept_swap(
+        self, ctx: AnalyzerContext, p1: int, s1: int, p2: int, s2: int
+    ) -> bool:
+        """Would swapping replicas (p1, s1) ↔ (p2, s2) between their brokers
+        keep this goal satisfied?  (Upstream ``actionAcceptance`` with
+        ``INTER_BROKER_REPLICA_SWAP``.)
+
+        Default: both legs must be individually acceptable single moves —
+        exact for goals whose invariant depends only on final placement
+        (rack, broker-set, topic counts), conservative for aggregate-bound
+        goals, which override with the NET effect (the whole point of a
+        swap is that the net fits where a single move does not)."""
+        b1 = int(ctx.assignment[p1, s1])
+        b2 = int(ctx.assignment[p2, s2])
+        return bool(
+            self.accept_move(ctx, p1, s1)[b2]
+            and self.accept_move(ctx, p2, s2)[b1]
+        )
+
     # ---- optimization -----------------------------------------------------------
     def optimize(
         self,
@@ -171,6 +190,55 @@ def accepted_leadership(
     if not current.accept_leadership(ctx, p, new_slot):
         return False
     return all(g.accept_leadership(ctx, p, new_slot) for g in optimized)
+
+
+def accepted_swap(
+    ctx: AnalyzerContext,
+    p1: int, s1: int, p2: int, s2: int,
+    current: Goal,
+    optimized: Sequence[Goal],
+) -> bool:
+    """Legality + current-goal + chained acceptance for an inter-broker
+    replica swap (upstream ``ResourceDistributionGoal`` swap fallback's
+    acceptance path).  Legality is the two-way twin of
+    :func:`legal_move_dests`: both brokers eligible destinations, neither
+    partition already resident on (or offline-originated from) the other
+    broker, leadership only landing on leadership-eligible brokers."""
+    b1 = int(ctx.assignment[p1, s1])
+    b2 = int(ctx.assignment[p2, s2])
+    if p1 == p2 or b1 == b2 or b1 == EMPTY_SLOT or b2 == EMPTY_SLOT:
+        return False
+    if ctx.partition_excluded(p1) or ctx.partition_excluded(p2):
+        return False
+    # offline replicas are evacuated (one-way), never swapped
+    if ctx.replica_offline[p1, s1] or ctx.replica_offline[p2, s2]:
+        return False
+    dest_ok = ctx.dest_candidates()
+    if not (dest_ok[b1] and dest_ok[b2]):
+        return False
+    row1, row2 = ctx.assignment[p1], ctx.assignment[p2]
+    if b2 in row1 or b1 in row2:
+        return False
+    if b2 in ctx.offline_origin[p1] or b1 in ctx.offline_origin[p2]:
+        return False
+    lead_ok = ctx.leadership_candidates()
+    if ctx.is_leader(p1, s1) and not lead_ok[b2]:
+        return False
+    if ctx.is_leader(p2, s2) and not lead_ok[b1]:
+        return False
+    if not current.accept_swap(ctx, p1, s1, p2, s2):
+        return False
+    return all(g.accept_swap(ctx, p1, s1, p2, s2) for g in optimized)
+
+
+def swap_action(
+    ctx: AnalyzerContext, p1: int, s1: int, p2: int, s2: int
+) -> BalancingAction:
+    return BalancingAction(
+        ActionType.INTER_BROKER_REPLICA_SWAP,
+        p1, s1, int(ctx.assignment[p1, s1]), int(ctx.assignment[p2, s2]),
+        swap_partition=int(p2), swap_slot=int(s2),
+    )
 
 
 def move_action(ctx: AnalyzerContext, p: int, s: int, dest: int) -> BalancingAction:

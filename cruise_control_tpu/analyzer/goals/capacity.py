@@ -21,10 +21,12 @@ from cruise_control_tpu.analyzer.goals.base import (
     OptimizationFailure,
     accepted_leadership,
     accepted_move_dests,
+    accepted_swap,
     broker_replicas,
     evacuate_offline_replicas,
     leadership_action,
     move_action,
+    swap_action,
 )
 
 
@@ -39,6 +41,15 @@ class ReplicaCapacityGoal(Goal):
 
     def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
         return ctx.broker_replica_count + 1 <= self._limit()
+
+    def accept_swap(
+        self, ctx: AnalyzerContext, p1: int, s1: int, p2: int, s2: int
+    ) -> bool:
+        # a swap preserves both brokers' replica counts — the key unlock on
+        # count-saturated clusters, where accept_move rejects every
+        # destination and only swaps can still rebalance (upstream
+        # ReplicaCapacityGoal actionAcceptance for REPLICA_SWAP)
+        return True
 
     def violations(self, ctx: AnalyzerContext) -> int:
         over = ctx.broker_replica_count > self._limit()
@@ -110,6 +121,19 @@ class CapacityGoal(Goal):
             <= self._limits(ctx)[dst]
         )
 
+    def accept_swap(
+        self, ctx: AnalyzerContext, p1: int, s1: int, p2: int, s2: int
+    ) -> bool:
+        # NET capacity check: b1 sheds l1 and absorbs l2, b2 the reverse —
+        # acceptable when both stay under their limit even if either single
+        # move alone would overflow (upstream CapacityGoal swap acceptance)
+        d = self._moved_load(ctx, p1, s1) - self._moved_load(ctx, p2, s2)
+        b1 = int(ctx.assignment[p1, s1])
+        b2 = int(ctx.assignment[p2, s2])
+        lim = self._limits(ctx)
+        cl = ctx.broker_cap_load[:, self.resource]
+        return bool(cl[b1] - d <= lim[b1] and cl[b2] + d <= lim[b2])
+
     def violations(self, ctx: AnalyzerContext) -> int:
         over = ctx.broker_cap_load[:, self.resource] > self._limits(ctx) * (1 + 1e-9)
         return int((over & ctx.broker_alive).sum())
@@ -164,9 +188,43 @@ class CapacityGoal(Goal):
                     continue
             ok = accepted_move_dests(ctx, p, s, self, optimized)
             if not ok.any():
+                # upstream swap fallback: on count- or capacity-saturated
+                # clusters a one-way move overflows every destination, but
+                # trading this replica for a smaller one still sheds load
+                self._try_swap_shed(ctx, p, s, optimized)
                 continue
             util = ctx.broker_load[:, r] / np.maximum(ctx.broker_capacity[:, r], 1e-9)
             ctx.apply(move_action(ctx, p, s, int(np.argmin(np.where(ok, util, np.inf)))))
+
+    #: partner brokers examined per swap attempt (least-utilized first)
+    SWAP_PARTNER_BROKERS = 16
+
+    def _try_swap_shed(
+        self, ctx: AnalyzerContext, p: int, s: int, optimized: Sequence[Goal]
+    ) -> bool:
+        """Swap (p, s) off its over-capacity broker for a smaller replica of
+        a low-utilization broker; chained NET acceptance (hard-goal twin of
+        the ResourceDistributionGoal fallback)."""
+        r = self.resource
+        l1 = self._moved_load(ctx, p, s)
+        util = ctx.broker_cap_load[:, r] / np.maximum(
+            ctx.broker_capacity[:, r], 1e-9
+        )
+        order = np.argsort(
+            np.where(ctx.broker_alive & ctx.dest_candidates(), util, np.inf)
+        )
+        for b2 in order[: self.SWAP_PARTNER_BROKERS].tolist():
+            if not ctx.broker_alive[b2] or not ctx.dest_candidates()[b2]:
+                continue
+            partners = broker_replicas(ctx, b2)
+            partners.sort(key=lambda ps: self._moved_load(ctx, *ps))
+            for p2, s2 in partners:
+                if self._moved_load(ctx, p2, s2) >= l1:
+                    break  # ascending: no net shed remains
+                if accepted_swap(ctx, p, s, p2, s2, self, optimized):
+                    ctx.apply(swap_action(ctx, p, s, p2, s2))
+                    return True
+        return False
 
 
 class DiskCapacityGoal(CapacityGoal):

@@ -23,10 +23,12 @@ from cruise_control_tpu.analyzer.goals.base import (
     OptimizationFailure,
     accepted_leadership,
     accepted_move_dests,
+    accepted_swap,
     broker_replicas,
     evacuate_offline_replicas,
     leadership_action,
     move_action,
+    swap_action,
 )
 
 
@@ -77,6 +79,24 @@ class ResourceDistributionGoal(Goal):
         dst = int(ctx.assignment[p, new_slot])
         m = self._metric(ctx)
         return bool(m[dst] + delta <= up[dst] and m[src] - delta >= lo[src])
+
+    def accept_swap(
+        self, ctx: AnalyzerContext, p1: int, s1: int, p2: int, s2: int
+    ) -> bool:
+        # NET effect (upstream swap acceptance): b1 sheds l1 and gains l2,
+        # b2 the reverse — a swap is acceptable exactly when the net keeps
+        # both within bounds, even where either single move alone would not
+        lo, up = self._bounds(ctx)
+        d = self._moved(ctx, p1, s1) - self._moved(ctx, p2, s2)
+        b1 = int(ctx.assignment[p1, s1])
+        b2 = int(ctx.assignment[p2, s2])
+        m = self._metric(ctx)
+        # mirror the single-move asymmetry: the net-losing broker must not
+        # drop below lower, the net-gaining broker must not exceed upper
+        # (a broker already out of bounds may still improve)
+        if d >= 0:  # b1 sheds d, b2 gains d
+            return bool(m[b1] - d >= lo[b1] and m[b2] + d <= up[b2])
+        return bool(m[b2] + d >= lo[b2] and m[b1] - d <= up[b1])
 
     # ---- scoring ----------------------------------------------------------------
     def violations(self, ctx: AnalyzerContext) -> int:
@@ -132,9 +152,44 @@ class ResourceDistributionGoal(Goal):
             ok = accepted_move_dests(ctx, p, s, self, optimized)
             # prefer under-loaded destinations
             if not ok.any():
+                # upstream swap fallback: when no single move is accepted
+                # (count-full / bound-tight destinations), trade this
+                # replica for a smaller one elsewhere — net sheds load
+                # while replica counts stay put
+                self._try_swap_shed(ctx, p, s, optimized)
                 continue
             m = self._metric(ctx) / np.maximum(ctx.broker_capacity[:, r], 1e-9)
             ctx.apply(move_action(ctx, p, s, int(np.argmin(np.where(ok, m, np.inf)))))
+
+    #: partner brokers examined per swap attempt (coldest first) — bounds
+    #: the fallback's cost on large clusters; upstream walks its sorted
+    #: candidate list the same way
+    SWAP_PARTNER_BROKERS = 16
+
+    def _try_swap_shed(
+        self, ctx: AnalyzerContext, p: int, s: int, optimized: Sequence[Goal]
+    ) -> bool:
+        """Swap replica (p, s) with a smaller replica of a cold broker
+        (upstream ``ResourceDistributionGoal`` INTER_BROKER_REPLICA_SWAP
+        fallback).  Partner replicas are tried smallest-first (largest net
+        shed first); acceptance is the chained NET check."""
+        l1 = self._moved(ctx, p, s)
+        m = self._metric(ctx)
+        cold_order = np.argsort(
+            np.where(ctx.broker_alive & ctx.dest_candidates(), m, np.inf)
+        )
+        for b2 in cold_order[: self.SWAP_PARTNER_BROKERS].tolist():
+            if not ctx.broker_alive[b2] or not ctx.dest_candidates()[b2]:
+                continue
+            partners = broker_replicas(ctx, b2)
+            partners.sort(key=lambda ps: self._moved(ctx, *ps))
+            for p2, s2 in partners:
+                if self._moved(ctx, p2, s2) >= l1:
+                    break  # ascending: nothing smaller remains
+                if accepted_swap(ctx, p, s, p2, s2, self, optimized):
+                    ctx.apply(swap_action(ctx, p, s, p2, s2))
+                    return True
+        return False
 
     def _pull(self, ctx: AnalyzerContext, b: int, optimized: Sequence[Goal]) -> None:
         """Move replicas from the most-loaded brokers onto under-loaded b."""
@@ -215,6 +270,11 @@ class ReplicaDistributionGoal(Goal):
             return np.zeros(ctx.num_brokers, bool)
         return self._counts(ctx) + 1 <= up
 
+    def accept_swap(
+        self, ctx: AnalyzerContext, p1: int, s1: int, p2: int, s2: int
+    ) -> bool:
+        return True  # a swap preserves both brokers' replica counts
+
     def violations(self, ctx: AnalyzerContext) -> int:
         lo, up = self._bounds(ctx)
         c = self._counts(ctx)
@@ -276,6 +336,25 @@ class LeaderReplicaDistributionGoal(Goal):
             ctx.broker_leader_count[dst] + 1 <= up
             and ctx.broker_leader_count[src] - 1 >= lo
         )
+
+    def accept_swap(
+        self, ctx: AnalyzerContext, p1: int, s1: int, p2: int, s2: int
+    ) -> bool:
+        # leadership travels with a swapped replica: the NET per-broker
+        # leader delta is −dl / +dl with dl ∈ {−1, 0, 1} (both-leaders or
+        # neither-leader swaps are count-neutral)
+        dl = int(ctx.is_leader(p1, s1)) - int(ctx.is_leader(p2, s2))
+        if dl == 0:
+            return True
+        lo, up = self._bounds(ctx)
+        b1 = int(ctx.assignment[p1, s1])
+        b2 = int(ctx.assignment[p2, s2])
+        c = ctx.broker_leader_count
+        # mirror the single-move asymmetry: the losing broker must not drop
+        # below lower, the gaining broker must not exceed upper (a broker
+        # already out of bounds may still improve)
+        loser, gainer = (b1, b2) if dl > 0 else (b2, b1)
+        return bool(c[loser] - 1 >= lo and c[gainer] + 1 <= up)
 
     def violations(self, ctx: AnalyzerContext) -> int:
         lo, up = self._bounds(ctx)

@@ -65,7 +65,9 @@ from cruise_control_tpu.ops.cost import (
     EVAC_BONUS,
     RACK_FIX_BONUS,
     broker_cost,
+    pack_pload,
 )
+from cruise_control_tpu.ops.grid import gather_pload as _gather_pload
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("engine")
@@ -348,6 +350,13 @@ class DeviceModel:
     leader_cload: Optional[jax.Array] = None    # f32 [P, R]
     follower_cload: Optional[jax.Array] = None  # f32 [P, R]
     broker_cload: Optional[jax.Array] = None    # f32 [B, R]
+    #: f32 [P, 2R+1 | 4R+1] packed IMMUTABLE per-partition scoring columns
+    #: (ops.cost.pack_pload): loads/excluded never change during a search,
+    #: so every per-step scoring site gathers ONE row of this instead of
+    #: ~6 separate [P]-tables — the round-4 row-gather amortization (~5×)
+    #: applied to the per-step [K]-gather cluster (round-5 item #1).
+    #: None only in hand-built test models; builders always pack it.
+    pload: Optional[jax.Array] = None
 
     def tree_flatten(self):
         # NOT dataclasses.astuple: that deep-copies every device array on each
@@ -446,6 +455,9 @@ def _score_candidates(
     is_lead = kind == KIND_LEADERSHIP
 
     row = m.assignment[cp]                              # [N, S]
+    # one row-gather of the packed immutable partition columns (ops.cost
+    # pack_pload) in place of ~6 separate [P]-table gathers
+    lead_cp, fol_cp, excl_cp, leadc_cp, folc_cp = _gather_pload(m, cp)
     slot_broker = jnp.take_along_axis(row, cs[:, None], axis=1)[:, 0]
     leader_broker = jnp.take_along_axis(row, m.leader_slot[cp][:, None], axis=1)[:, 0]
     src = jnp.where(is_lead, leader_broker, slot_broker)
@@ -462,18 +474,14 @@ def _score_candidates(
     rack_viol_here = jnp.any(
         lower & (slot_racks == my_rack[:, None]) & (row != EMPTY_SLOT), axis=1
     )
-    move_load = jnp.where(
-        leader_now[:, None], m.leader_load[cp], m.follower_load[cp]
-    )
-    lead_delta = m.leader_load[cp] - m.follower_load[cp]
+    move_load = jnp.where(leader_now[:, None], lead_cp, fol_cp)
+    lead_delta = lead_cp - fol_cp
     delta_load = jnp.where(is_lead[:, None], lead_delta, move_load)
     # capacity-estimate twin (trace-time branch; == delta_load when off)
     has_cap = m.leader_cload is not None
     if has_cap:
-        cmove_load = jnp.where(
-            leader_now[:, None], m.leader_cload[cp], m.follower_cload[cp]
-        )
-        clead_delta = m.leader_cload[cp] - m.follower_cload[cp]
+        cmove_load = jnp.where(leader_now[:, None], leadc_cp, folc_cp)
+        clead_delta = leadc_cp - folc_cp
         cdelta_load = jnp.where(is_lead[:, None], clead_delta, cmove_load)
         b_cload = m.broker_cload
     else:
@@ -498,7 +506,7 @@ def _score_candidates(
         axis=1,
     )
     rcount_ok = m.rcount[dst_c] + 1.0 <= ca["max_replicas"]
-    excluded = m.excluded[cp] & ~m.must_move[jnp.clip(cp, 0), jnp.clip(cs, 0)]
+    excluded = excl_cp & ~m.must_move[jnp.clip(cp, 0), jnp.clip(cs, 0)]
     must_move_here = m.must_move[cp, jnp.clip(cs, 0, S - 1)]
 
     move_ok = (
@@ -518,7 +526,7 @@ def _score_candidates(
         & ~leader_now
         & m.lead_ok[dst_c]
         & ~must_move_here
-        & ~m.excluded[cp]
+        & ~excl_cp
         & cap_ok
     )
     feasible = jnp.where(is_lead, lead_feasible, move_ok)
@@ -528,9 +536,9 @@ def _score_candidates(
     l_delta = jnp.where(is_lead | leader_now, 1.0, 0.0)
     r_delta = jnp.where(is_lead, 0.0, 1.0)
     lnwin_delta = jnp.where(
-        is_lead | leader_now, m.leader_load[cp, Resource.NW_IN], 0.0
+        is_lead | leader_now, lead_cp[:, Resource.NW_IN], 0.0
     )
-    pot_delta = jnp.where(is_lead, 0.0, m.leader_load[cp, Resource.NW_OUT])
+    pot_delta = jnp.where(is_lead, 0.0, lead_cp[:, Resource.NW_OUT])
 
     src_c = jnp.clip(src, 0)
     f_src_old = cost(
@@ -720,10 +728,11 @@ def _apply_batch_on_device(
     lslot = m.leader_slot[p]
     leader_now = lslot == s
 
-    lnwin_p = m.leader_load[p, Resource.NW_IN]
-    nwout_p = m.leader_load[p, Resource.NW_OUT]
-    move_load = jnp.where(leader_now[:, None], m.leader_load[p], m.follower_load[p])
-    lead_delta = m.leader_load[p] - m.follower_load[p]
+    lead_p, fol_p, _excl_p, leadc_p, folc_p = _gather_pload(m, p)
+    lnwin_p = lead_p[:, Resource.NW_IN]
+    nwout_p = lead_p[:, Resource.NW_OUT]
+    move_load = jnp.where(leader_now[:, None], lead_p, fol_p)
+    lead_delta = lead_p - fol_p
 
     gate = take.astype(jnp.float32)
     dload = jnp.where(is_move[:, None], move_load, lead_delta) * gate[:, None]
@@ -742,10 +751,8 @@ def _apply_batch_on_device(
     )
     broker_cload = m.broker_cload
     if m.leader_cload is not None:
-        cmove = jnp.where(
-            leader_now[:, None], m.leader_cload[p], m.follower_cload[p]
-        )
-        clead = m.leader_cload[p] - m.follower_cload[p]
+        cmove = jnp.where(leader_now[:, None], leadc_p, folc_p)
+        clead = leadc_p - folc_p
         dcload = jnp.where(is_move[:, None], cmove, clead) * gate[:, None]
         broker_cload = m.broker_cload + seg(
             jnp.concatenate([-dcload, dcload], axis=0)
@@ -890,7 +897,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
 
     def step(carry):
         (m, ca, done, t, count, out, counts, pools, since_pool, sc, tb,
-         tpm, n_ovf, since_full) = carry
+         tpm, n_ovf, since_full, t_cap) = carry
         need_pool = since_pool >= repool
         pools = jax.lax.cond(
             need_pool,
@@ -1116,11 +1123,8 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # fast path (several commits per broker per step); leader moves and
         # out-of-budget candidates use the strict disjoint path
         leader_now_q = m.leader_slot[cand_p] == cand_s
-        ml = jnp.where(
-            (leader_now_q[:, None] & imr),
-            m.leader_load[cand_p],
-            m.follower_load[cand_p],
-        )
+        lead_c, fol_c, _excl_c, leadc_c, folc_c = _gather_pload(m, cand_p)
+        ml = jnp.where((leader_now_q[:, None] & imr), lead_c, fol_c)
         # leadership rows carry a zero budget vector and are never
         # budget-eligible.  Safety of dropping their budget drawdown: the
         # cohort is decided FIRST, and its footprint is passed to the
@@ -1133,7 +1137,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
                 ml,
                 jnp.where(is_move_row, 1.0, 0.0)[:, None],
                 jnp.where(
-                    is_move_row, m.leader_load[cand_p, Resource.NW_OUT], 0.0
+                    is_move_row, lead_c[:, Resource.NW_OUT], 0.0
                 )[:, None],
             ],
             axis=1,
@@ -1141,11 +1145,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         if m.leader_cload is not None:
             # capacity-estimate move vector, matching _step_budgets' extra
             # headroom dims
-            mlc = jnp.where(
-                (leader_now_q[:, None] & imr),
-                m.leader_cload[cand_p],
-                m.follower_cload[cand_p],
-            )
+            mlc = jnp.where((leader_now_q[:, None] & imr), leadc_c, folc_c)
             move_vec = jnp.concatenate(
                 [move_vec, jnp.where(imr, mlc, 0.0)], axis=1
             )
@@ -1278,15 +1278,18 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         done = done | ((c_step == 0) & (since_pool == 0))
         since_pool = jnp.where(c_step == 0, repool, since_pool + 1)
         return (m, ca, done, t + 1, count + c_step, out, counts, pools,
-                since_pool, sc, tb, tpm, n_ovf, since_full)
+                since_pool, sc, tb, tpm, n_ovf, since_full, t_cap)
 
     def cond_fn(slots):
         def cond(carry):
             done, t, count = carry[2], carry[3], carry[4]
-            return (~done) & (t < T) & (count <= slots)
+            # carry[-1] = dynamic step cap (anytime deadline): the host
+            # passes steps-remaining-in-budget so `time_budget_s` binds at
+            # step granularity (~11 ms), not device-call granularity (~6 s)
+            return (~done) & (t < jnp.minimum(T, carry[-1])) & (count <= slots)
         return cond
 
-    def run(m: DeviceModel, ca):
+    def run_capped(m: DeviceModel, ca, t_cap):
         P, S = m.assignment.shape
         B = m.capacity.shape[0]
         M_ = min(M, (max(1, cfg.moves_per_src) + 1) * B)
@@ -1315,10 +1318,11 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             (m, ca, jnp.bool_(False), jnp.int32(0), jnp.int32(0), out0,
              jnp.zeros((4, T), jnp.int32), pools0, jnp.int32(repool), sc0,
              jnp.zeros(B, bool), jnp.zeros(P, bool), jnp.int32(0),
-             jnp.int32(0)),
+             jnp.int32(0), t_cap.astype(jnp.int32)),
         )
-        m, done, count, out, counts, n_ovf = (
-            carry[0], carry[2], carry[4], carry[5], carry[6], carry[12]
+        m, done, t_end, count, out, counts, n_ovf = (
+            carry[0], carry[2], carry[3], carry[4], carry[5], carry[6],
+            carry[12]
         )
         meta = jnp.zeros((4, T + 2), jnp.float32)
         meta = meta.at[:, :T].set(counts.astype(jnp.float32))
@@ -1326,7 +1330,18 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         meta = meta.at[0, T + 1].set(jnp.where(done, 1.0, 0.0))
         # row 1 tail: full-rescore fallbacks forced by staleness overflow
         meta = meta.at[1, T].set(n_ovf.astype(jnp.float32))
+        # row 2 tail: executed steps — the host's step-rate estimate for
+        # the anytime deadline reads this, robust to trailing zero-commit
+        # steps
+        meta = meta.at[2, T].set(t_end.astype(jnp.float32))
         return jnp.concatenate([out, meta], axis=1), m
+
+    def run(m: DeviceModel, ca, t_cap=None):
+        # t_cap omitted (benchmarks, unbudgeted runs) = uncapped; a jnp
+        # scalar binds by shape, so every capped call shares one executable
+        if t_cap is None:
+            t_cap = jnp.int32(T)
+        return run_capped(m, ca, t_cap)
 
     if mesh is None:
         return jax.jit(run)
@@ -1338,8 +1353,15 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
     # model + constraints replicated in, results replicated out; the
     # sharding happens inside the loop (see _reduced_candidates)
     rep = PartitionSpec()
-    return jax.jit(shard_map_norep(run, mesh, in_specs=(rep, rep),
-                                   out_specs=(rep, rep)))
+    sharded = shard_map_norep(run_capped, mesh, in_specs=(rep, rep, rep),
+                              out_specs=(rep, rep))
+
+    def run_sharded(m: DeviceModel, ca, t_cap=None):
+        if t_cap is None:
+            t_cap = jnp.int32(T)
+        return sharded(m, ca, t_cap)
+
+    return jax.jit(run_sharded)
 
 
 def _fetch_scan_result(packed, T: int):
@@ -1367,6 +1389,7 @@ def _fetch_scan_result(packed, T: int):
     done = bool(meta[0, T + 1] > 0)
     diag = {
         "n_overflow": int(meta[1, T]),
+        "steps_run": int(meta[2, T]),
         "improving": meta[1, :T].astype(np.int64),
         "cohort": meta[2, :T].astype(np.int64),
         "auction": meta[3, :T].astype(np.int64),
@@ -1689,16 +1712,23 @@ class _HostEvaluator:
                 ),
             )
 
+        # ONE stacked cost evaluation for (src_new, src_old, dst_new,
+        # dst_old): the recheck runs ~2k times per north-star search and
+        # was numpy-dispatch bound — 4 separate ~35-op cost calls per step
+        # were over half its time (round-5 item #4)
         z1 = np.zeros(n)
         zR = np.zeros((n, NUM_RESOURCES))
-        delta = (
-            cost(src_c, -dload, -lnwin_delta, -pot_delta, -r_delta, -l_delta,
-                 -dcload)
-            - cost(src_c, zR, z1, z1, z1, z1, zR)
-            + cost(dst_c, dload, lnwin_delta, pot_delta, r_delta, l_delta,
-                   dcload)
-            - cost(dst_c, zR, z1, z1, z1, z1, zR)
+        bb = np.concatenate([src_c, src_c, dst_c, dst_c])
+        c4 = cost(
+            bb,
+            np.concatenate([-dload, zR, dload, zR]),
+            np.concatenate([-lnwin_delta, z1, lnwin_delta, z1]),
+            np.concatenate([-pot_delta, z1, pot_delta, z1]),
+            np.concatenate([-r_delta, z1, r_delta, z1]),
+            np.concatenate([-l_delta, z1, l_delta, z1]),
+            np.concatenate([-dcload, zR, dcload, zR]),
         )
+        delta = c4[:n] - c4[n:2 * n] + c4[2 * n:3 * n] - c4[3 * n:]
         delta += np.where(
             is_lead, 0.0,
             move_load[:, Resource.DISK] / can["avg_disk_cap"] * cfg.w_move_size,
@@ -2783,6 +2813,16 @@ class TpuGoalOptimizer:
                 else None
             ),
         )
+        # packed on DEVICE from the already-transferred fields (one concat
+        # at build; packing on host would re-transfer every load table
+        # over the device link)
+        m = dataclasses.replace(
+            m,
+            pload=pack_pload(
+                m.leader_load, m.follower_load, m.excluded,
+                m.leader_cload, m.follower_cload,
+            ),
+        )
         return _recompute_aggregates(m)
 
     def _pool_sizes(self, P: int, S: int, B: int) -> Tuple[int, int]:
@@ -2890,6 +2930,9 @@ class TpuGoalOptimizer:
                 // -cfg.steps_per_call,
             )
             n_calls = n_committed = n_rejected = 0
+            #: measured seconds per executed step, incl. amortized per-call
+            #: dispatch/fetch overhead — the anytime deadline's rate model
+            step_rate: Optional[float] = None
             for _ in range(calls_budget):
                 if budget_exhausted():
                     LOG.info(
@@ -2897,10 +2940,38 @@ class TpuGoalOptimizer:
                         cfg.time_budget_s, n_calls,
                     )
                     break
-                packed, m_new = scan_fn(m, ca)
+                t_cap = None
+                if cfg.time_budget_s and not ctx.replica_offline.any() and \
+                        all(g.violations(ctx) == 0 for g in goals
+                            if g.is_hard):
+                    # per-step deadline: convert remaining budget to a step
+                    # cap at the measured rate; the first capped call is a
+                    # short probe that also calibrates the rate.  Until
+                    # hard goals hold the budget never truncates (same
+                    # contract as budget_exhausted).
+                    remaining = cfg.time_budget_s - (
+                        time.perf_counter() - t0)
+                    if step_rate:
+                        t_cap = int(np.clip(
+                            remaining / step_rate, 1, cfg.steps_per_call))
+                    else:
+                        t_cap = min(cfg.steps_per_call, 256)
+                call_t0 = time.perf_counter()
+                packed, m_new = (
+                    scan_fn(m, ca) if t_cap is None
+                    else scan_fn(m, ca, jnp.asarray(t_cap, jnp.int32))
+                )
                 n_calls += 1
                 (k_all, p_all, s_all, d_all, step_counts, device_done,
                  diag) = _fetch_scan_result(packed, cfg.steps_per_call)
+                if cfg.time_budget_s and diag.get("steps_run", 0) > 0:
+                    rate = (
+                        (time.perf_counter() - call_t0) / diag["steps_run"]
+                    )
+                    # EMA, biased fresh: per-call overhead amortizes
+                    # differently as caps shrink
+                    step_rate = rate if step_rate is None else (
+                        0.5 * step_rate + 0.5 * rate)
                 if diag["n_overflow"]:
                     LOG.debug(
                         "device call %d: %d staleness-overflow full "
@@ -2998,6 +3069,32 @@ class TpuGoalOptimizer:
                 break
             m = _resync_device_model(m, ctx)
 
+        # Host swap-repair pass: the device vocabulary is single moves +
+        # leadership, whose feasibility mask rejects every destination on
+        # count-/capacity-saturated clusters — exactly where upstream falls
+        # back to INTER_BROKER_REPLICA_SWAP.  When (and only when) hard
+        # violations survive the search, replay the greedy hard goals
+        # host-side in priority order; their optimize() now carries the
+        # same swap fallback, and the residual is a handful of constrained
+        # knots, not bulk work.  No-op on healthy fixtures (north star:
+        # zero hard violations after search).
+        if any(g.is_hard and g.violations(ctx) > 0 for g in goals):
+            n_before = len(ctx.actions)
+            repaired: List = []
+            for g in goals:
+                if not g.is_hard:
+                    continue  # repair is a hard-goal pass only
+                try:
+                    g.optimize(ctx, repaired)
+                except Exception as e:  # leave the verdict to _finalize
+                    LOG.warning("host swap-repair: %s: %s", g.name, e)
+                repaired.append(g)
+            new_actions = ctx.actions[n_before:]
+            actions.extend(new_actions)
+            LOG.info(
+                "host swap-repair pass committed %d actions for residual "
+                "hard violations", len(new_actions),
+            )
         return self._finalize(
             state, ctx, goals, actions, violations_before, stats_before,
             initial_assignment, initial_leader_slot, initial_replica_disk, t0,

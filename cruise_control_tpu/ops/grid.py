@@ -31,7 +31,24 @@ from cruise_control_tpu.ops.cost import (
     EVAC_BONUS,
     RACK_FIX_BONUS,
     broker_cost,
+    pack_pload,
+    pload_rows,
 )
+
+
+def gather_pload(m, idx):
+    """ONE row-gather of the packed immutable partition table for indices
+    ``idx`` → ``(leader_load, follower_load, excluded, leader_cload,
+    follower_cload)`` rows (cloads ``None`` when percentile is off).
+    Falls back to packing on the fly for hand-built models without
+    ``pload`` (numerically identical; builders always pack)."""
+    table = getattr(m, "pload", None)
+    if table is None:
+        table = pack_pload(
+            m.leader_load, m.follower_load, m.excluded,
+            m.leader_cload, m.follower_cload,
+        )
+    return pload_rows(table[idx])
 
 
 def move_grid_terms(
@@ -41,9 +58,16 @@ def move_grid_terms(
     kp: jax.Array,         # int32 [K] source partition
     ks: jax.Array,         # int32 [K] source slot
 ) -> Dict[str, jax.Array]:
-    """Per-source ([K]-shaped) terms feeding the grid scorer."""
+    """Per-source ([K]-shaped) terms feeding the grid scorer.
+
+    The per-partition load/excluded columns ride ONE row-gather of the
+    packed ``pload`` table (:func:`gather_pload`) instead of ~6 separate
+    [P]-table gathers — the round-4 row-gather amortization applied to
+    the per-step [K]-gather cluster (the biggest named chunk of the
+    one-per-step kernel tail, KERNEL_BUDGET_r04.md)."""
     S = m.assignment.shape[1]
     row = m.assignment[kp]                               # [K, S]
+    lead_kp, fol_kp, excl_kp, leadc_kp, folc_kp = gather_pload(m, kp)
     slot_broker = jnp.take_along_axis(row, ks[:, None], axis=1)[:, 0]
     src = slot_broker
     src_c = jnp.clip(src, 0)
@@ -64,22 +88,18 @@ def move_grid_terms(
         -1,
     )
 
-    move_load = jnp.where(
-        leader_now[:, None], m.leader_load[kp], m.follower_load[kp]
-    )                                                     # [K, R]
+    move_load = jnp.where(leader_now[:, None], lead_kp, fol_kp)  # [K, R]
     # capacity-estimate twin (trace-time branch: None = percentile off,
     # capacity checks run on the mean loads — zero extra work compiled)
     cmove_load = (
-        move_load if m.leader_cload is None
-        else jnp.where(
-            leader_now[:, None], m.leader_cload[kp], m.follower_cload[kp]
-        )
+        move_load if leadc_kp is None
+        else jnp.where(leader_now[:, None], leadc_kp, folc_kp)
     )                                                     # [K, R]
     must_move = m.must_move[kp, jnp.clip(ks, 0, S - 1)]
-    excluded = m.excluded[kp] & ~must_move
+    excluded = excl_kp & ~must_move
     l_delta = jnp.where(leader_now, 1.0, 0.0)
-    lnwin_delta = jnp.where(leader_now, m.leader_load[kp, Resource.NW_IN], 0.0)
-    pot_delta = m.leader_load[kp, Resource.NW_OUT]
+    lnwin_delta = jnp.where(leader_now, lead_kp[:, Resource.NW_IN], 0.0)
+    pot_delta = lead_kp[:, Resource.NW_OUT]
 
     has_cap = m.broker_cload is not None
     f_src_old = broker_cost(

