@@ -6,11 +6,19 @@ config #1; the reference publishes no numbers, so the greedy analyzer we
 implement IS the baseline — same goal stack, same semantics).
 
 Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "tracing_overhead_pct": N, "phases": {...}}
 
 ``vs_baseline`` is the speedup factor (greedy wall-clock / TPU wall-clock),
 reported only if the TPU engine's goal-violation score is <= greedy's
 (otherwise the run is a quality regression and vs_baseline is 0).
+
+``phases`` is the telemetry subsystem's per-phase breakdown of ONE traced
+end-to-end rebalance (model generation → TPU search → plan execution on the
+simulated backend) at the same 50b/1k scale, so a wall-clock regression in
+any future run is attributable from this artifact alone.
+``tracing_overhead_pct`` is the measured cost of tracing on the timed
+engine metric (spans enabled vs disabled) — the <=1% budget gate.
 """
 
 from __future__ import annotations
@@ -21,12 +29,94 @@ import time
 import numpy as np
 
 
+def _best_of(n: int, fn) -> float:
+    best = np.inf
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _full_path_phases() -> dict:
+    """One traced dryrun=False rebalance through the whole stack (monitor →
+    analyzer → executor) on a simulated 50b/1k cluster; returns the phase
+    breakdown keyed by the taxonomy's leaf names."""
+    from cruise_control_tpu.bootstrap import _capacity_for
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor.load_monitor import (
+        BackendMetadataClient,
+        LoadMonitor,
+    )
+    from cruise_control_tpu.monitor.sampling import (
+        MetricsReporterSampler,
+        MetricsTopic,
+        SimulatedMetricsReporter,
+        WorkloadModel,
+    )
+    from cruise_control_tpu.telemetry import profile, tracing
+
+    rng = np.random.default_rng(42)
+    P, B, rf = 1000, 50, 3
+    assignment = {p: [(p + i) % B for i in range(rf)] for p in range(P)}
+    leaders = {p: assignment[p][0] for p in range(P)}
+    w = WorkloadModel(
+        bytes_in=rng.uniform(50, 1500, P),
+        bytes_out=rng.uniform(50, 3000, P),
+        size_mb=rng.uniform(100, 2000, P),
+        assignment=assignment,
+        leaders=leaders,
+    )
+    backend = SimulatedClusterBackend(
+        {p: list(r) for p, r in assignment.items()}, dict(leaders),
+        brokers=set(range(B)),
+    )
+    topic = MetricsTopic()
+    reporter = SimulatedMetricsReporter(w, topic)
+    monitor = LoadMonitor(
+        BackendMetadataClient(backend, {b: b % 10 for b in range(B)}),
+        MetricsReporterSampler(topic),
+        capacity_resolver=_capacity_for(w, B),
+        window_ms=1000,
+        num_windows=5,
+    )
+    for wdx in range(3):
+        reporter.report(time_ms=wdx * 1000 + 500)
+        monitor.run_sampling_iteration((wdx + 1) * 1000)
+    cc = CruiseControl(
+        monitor, Executor(backend, ExecutorConfig()), engine="tpu"
+    )
+    tracing.reset()
+    t0 = time.perf_counter()
+    cc.rebalance(dryrun=False)
+    total = time.perf_counter() - t0
+    flat = profile.phase_breakdown()
+
+    def leaf(*names: str) -> float:
+        return round(sum(
+            v for k, v in flat.items() if k.rsplit("/", 1)[-1] in names
+        ), 3)
+
+    return {
+        "monitor": leaf("monitor.cluster_model"),
+        "analyzer-score": leaf("analyzer.scan", "analyzer.score"),
+        "analyzer-apply": leaf("analyzer.recheck", "analyzer.apply"),
+        "analyzer-upload": leaf("analyzer.upload", "analyzer.resync"),
+        "host-finalize": leaf("analyzer.ctx_init", "analyzer.finalize"),
+        "executor": leaf("executor.execute"),
+        "total": round(total, 3),
+    }
+
+
 def main() -> None:
     from cruise_control_tpu.utils.jit_cache import enable as _jc
     _jc()
-    from cruise_control_tpu.models.generators import random_cluster
     from cruise_control_tpu.analyzer.goal_optimizer import GoalOptimizer
     from cruise_control_tpu.analyzer.tpu_optimizer import TpuGoalOptimizer
+    from cruise_control_tpu.models.generators import random_cluster
+    from cruise_control_tpu.telemetry import tracing
 
     state = random_cluster(
         seed=42, num_brokers=50, num_racks=10, num_partitions=1000
@@ -43,18 +133,35 @@ def main() -> None:
 
     # best-of-3: the tunneled dev TPU adds seconds-scale transfer jitter a
     # single sample would fold into the steady-state number
-    greedy_s = np.inf
-    for _ in range(3):
-        t0 = time.perf_counter()
-        greedy = greedy_opt.optimize(state)
-        greedy_s = min(greedy_s, time.perf_counter() - t0)
+    tracing.configure(enabled=False)
+    greedy = [None]
+    greedy_s = _best_of(3, lambda: greedy.__setitem__(
+        0, greedy_opt.optimize(state)))
+    tpu = [None]
+    tpu_s = _best_of(3, lambda: tpu.__setitem__(0, tpu_opt.optimize(state)))
 
-    tpu_s = np.inf
-    for _ in range(3):
+    # the same engine metric with spans ON — the tracing-overhead gate.
+    # INTERLEAVED off/on pairs, best-of-each-side: the deltas being
+    # resolved are single-digit milliseconds on a ~quarter-second metric,
+    # and sequential A-then-B measurement folds allocator/GC drift into
+    # whichever side runs second (measured: ±2% either direction)
+    tracing.reset()
+    tpu_off_s = tpu_traced_s = np.inf
+    for _ in range(7):
+        tracing.configure(enabled=False)
         t0 = time.perf_counter()
-        tpu = tpu_opt.optimize(state)
-        tpu_s = min(tpu_s, time.perf_counter() - t0)
+        tpu_opt.optimize(state)
+        tpu_off_s = min(tpu_off_s, time.perf_counter() - t0)
+        tracing.configure(enabled=True)
+        t0 = time.perf_counter()
+        tpu_opt.optimize(state)
+        tpu_traced_s = min(tpu_traced_s, time.perf_counter() - t0)
+    overhead_pct = (tpu_traced_s / tpu_off_s - 1.0) * 100.0
 
+    phases = _full_path_phases()
+    tracing.configure(enabled=False)
+
+    greedy, tpu = greedy[0], tpu[0]
     quality_ok = tpu.violation_score_after <= greedy.violation_score_after
     print(
         json.dumps(
@@ -63,6 +170,8 @@ def main() -> None:
                 "value": round(tpu_s, 3),
                 "unit": "s",
                 "vs_baseline": round(greedy_s / tpu_s, 3) if quality_ok else 0,
+                "tracing_overhead_pct": round(overhead_pct, 2),
+                "phases": phases,
             }
         )
     )

@@ -106,6 +106,9 @@ def run(num_brokers: int = 200, num_partitions: int = 5000,
         "quality_gate": bool(s_tpu <= s_greedy),
         "speed_gate": bool(t_tpu * min_speedup < t_greedy),
         "min_speedup": min_speedup,
+        # which backend the TPU half actually ran on — a CPU-backend
+        # refresh must not masquerade as an accelerator measurement
+        "tpu_platform": jax.default_backend(),
     })
     if out:
         with open(out, "w") as f:

@@ -82,11 +82,21 @@ def main() -> None:
     ap.add_argument("--warm", action="store_true",
                     help="run optimize twice; report the second (compile "
                          "amortized) with phase timers reset")
+    ap.add_argument("--artifact", default="",
+                    help="write the telemetry phase-profile JSON artifact "
+                         "here (schema cc-tpu-phase-profile/1)")
     args = ap.parse_args()
 
     import cruise_control_tpu.analyzer.tpu_optimizer as T
     from cruise_control_tpu.analyzer import context as C
     from cruise_control_tpu.models.generators import random_cluster
+    from cruise_control_tpu.telemetry import profile as tele_profile
+    from cruise_control_tpu.telemetry import tracing
+
+    # span-level phases ride along with the monkeypatch timers: the spans
+    # are what production emits (bench.py / GET /metrics), the monkeypatch
+    # keeps the finer host_eval/host_apply split this script predates
+    tracing.configure(enabled=True)
 
     t0 = time.perf_counter()
     state = random_cluster(
@@ -126,9 +136,11 @@ def main() -> None:
     def scan_wrap(cfg, K, D, Tn, mesh=None):
         fn = orig_scan(cfg, K, D, Tn, mesh)
 
-        def run(m, ca):
+        def run(m, ca, t_cap=None):
             t0 = time.perf_counter()
-            packed, m_new = fn(m, ca)
+            packed, m_new = (
+                fn(m, ca) if t_cap is None else fn(m, ca, t_cap)
+            )
             packed.block_until_ready()
             TIMES["device"] += time.perf_counter() - t0
             COUNTS["device"] += 1
@@ -155,6 +167,7 @@ def main() -> None:
         COUNTS.clear()
         step_counts_log.clear()
         diag_log.clear()
+        tracing.reset()
     t0 = time.perf_counter()
     result = opt.optimize(state)
     total = time.perf_counter() - t0
@@ -165,7 +178,19 @@ def main() -> None:
         "violation_score": result.violation_score_after,
         "phases": {k: round(v, 2) for k, v in sorted(TIMES.items())},
         "counts": dict(COUNTS),
+        "telemetry_phases": {
+            k: round(v, 2) for k, v in tele_profile.phase_breakdown().items()
+        },
     }
+    if args.artifact:
+        tele_profile.write_artifact(args.artifact, extra={
+            "fixture": {"brokers": args.brokers,
+                        "partitions": args.partitions,
+                        "racks": args.racks},
+            "total_s": round(total, 2),
+            "actions": len(result.actions),
+            "violation_score": result.violation_score_after,
+        })
     out["phases"]["untracked"] = round(
         total - sum(v for k, v in TIMES.items() if k != "gen"), 2
     )

@@ -399,6 +399,16 @@ class GoalOptimizer:
         state: ClusterState,
         options: Optional[OptimizationOptions] = None,
     ) -> OptimizerResult:
+        from cruise_control_tpu.telemetry import tracing
+
+        with tracing.span("analyzer.greedy"):
+            return self._optimize(state, options)
+
+    def _optimize(
+        self,
+        state: ClusterState,
+        options: Optional[OptimizationOptions] = None,
+    ) -> OptimizerResult:
         t0 = time.perf_counter()
         ctx = AnalyzerContext(state, options)
         initial_assignment = ctx.assignment.copy()
@@ -411,10 +421,15 @@ class GoalOptimizer:
 
         import logging as _logging
 
+        from cruise_control_tpu.telemetry import tracing
+
         optimized: List[Goal] = []
         for goal in self.goals:
             n_before = len(ctx.actions)
-            goal.optimize(ctx, optimized)
+            # per-goal pass span (goal.name is a static class attribute —
+            # no formatting on the disabled path)
+            with tracing.span("analyzer.goal", sub=goal.name):
+                goal.optimize(ctx, optimized)
             if LOG.isEnabledFor(_logging.DEBUG):  # violations() is real work
                 LOG.debug(
                     "%s: %d actions (violations %d -> %d)", goal.name,

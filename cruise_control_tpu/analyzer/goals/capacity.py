@@ -126,12 +126,22 @@ class CapacityGoal(Goal):
     ) -> bool:
         # NET capacity check: b1 sheds l1 and absorbs l2, b2 the reverse —
         # acceptable when both stay under their limit even if either single
-        # move alone would overflow (upstream CapacityGoal swap acceptance)
+        # move alone would overflow (upstream CapacityGoal swap acceptance).
+        # Asymmetry for an already-over-limit shedding broker (upstream
+        # swap acceptance): a net-shedding swap that STRICTLY reduces its
+        # load is accepted even though one swap cannot get it under the
+        # limit — repeated swaps then converge instead of the goal raising
+        # OptimizationFailure on the first one.  The partner must stay
+        # within its limit either way.
         d = self._moved_load(ctx, p1, s1) - self._moved_load(ctx, p2, s2)
         b1 = int(ctx.assignment[p1, s1])
         b2 = int(ctx.assignment[p2, s2])
         lim = self._limits(ctx)
         cl = ctx.broker_cap_load[:, self.resource]
+        if d > 0 and cl[b1] > lim[b1]:  # b1 over limit, swap net-sheds it
+            return bool(cl[b2] + d <= lim[b2])
+        if d < 0 and cl[b2] > lim[b2]:  # b2 over limit, swap net-sheds it
+            return bool(cl[b1] - d <= lim[b1])
         return bool(cl[b1] - d <= lim[b1] and cl[b2] + d <= lim[b2])
 
     def violations(self, ctx: AnalyzerContext) -> int:
@@ -144,6 +154,7 @@ class CapacityGoal(Goal):
             raise OptimizationFailure(
                 f"{self.name}: {len(failed)} offline replicas could not be placed"
             )
+        self._swap_attempts = 0
         limits = self._limits(ctx)
         r = self.resource
         over_brokers = np.nonzero(
@@ -198,6 +209,11 @@ class CapacityGoal(Goal):
 
     #: partner brokers examined per swap attempt (least-utilized first)
     SWAP_PARTNER_BROKERS = 16
+    #: swap-fallback attempts per optimize() pass (hard-goal twin of the
+    #: distribution cap; higher because capacity repair MUST make progress
+    #: and a starved fallback turns into OptimizationFailure)
+    MAX_SWAP_ATTEMPTS_PER_PASS = 1024
+    _swap_attempts = 0
 
     def _try_swap_shed(
         self, ctx: AnalyzerContext, p: int, s: int, optimized: Sequence[Goal]
@@ -205,16 +221,20 @@ class CapacityGoal(Goal):
         """Swap (p, s) off its over-capacity broker for a smaller replica of
         a low-utilization broker; chained NET acceptance (hard-goal twin of
         the ResourceDistributionGoal fallback)."""
+        if self._swap_attempts >= self.MAX_SWAP_ATTEMPTS_PER_PASS:
+            return False
+        self._swap_attempts += 1
         r = self.resource
         l1 = self._moved_load(ctx, p, s)
         util = ctx.broker_cap_load[:, r] / np.maximum(
             ctx.broker_capacity[:, r], 1e-9
         )
-        order = np.argsort(
-            np.where(ctx.broker_alive & ctx.dest_candidates(), util, np.inf)
-        )
+        # hoisted out of the partner loop (round-5 swap-fallback slowdown):
+        # dest_candidates() rebuilds a [B] mask on every call
+        dest_ok = ctx.broker_alive & ctx.dest_candidates()
+        order = np.argsort(np.where(dest_ok, util, np.inf))
         for b2 in order[: self.SWAP_PARTNER_BROKERS].tolist():
-            if not ctx.broker_alive[b2] or not ctx.dest_candidates()[b2]:
+            if not dest_ok[b2]:
                 continue
             partners = broker_replicas(ctx, b2)
             partners.sort(key=lambda ps: self._moved_load(ctx, *ps))

@@ -108,6 +108,7 @@ class ResourceDistributionGoal(Goal):
     # ---- optimization -----------------------------------------------------------
     def optimize(self, ctx: AnalyzerContext, optimized: Sequence[Goal]) -> None:
         evacuate_offline_replicas(ctx, self, optimized)
+        self._swap_attempts = 0
         r = self.resource
         lo, up = self._bounds(ctx)
         m = self._metric(ctx)
@@ -165,6 +166,13 @@ class ResourceDistributionGoal(Goal):
     #: the fallback's cost on large clusters; upstream walks its sorted
     #: candidate list the same way
     SWAP_PARTNER_BROKERS = 16
+    #: swap-fallback attempts allowed per optimize() pass.  Each attempt is
+    #: O(partner brokers x partner replicas) of chained acceptance; on
+    #: bound-tight fixtures every stuck replica reaches the fallback, and
+    #: unbounded attempts made the greedy baseline ~9x slower (round-5
+    #: VERDICT next #2) for marginal extra shedding
+    MAX_SWAP_ATTEMPTS_PER_PASS = 256
+    _swap_attempts = 0
 
     def _try_swap_shed(
         self, ctx: AnalyzerContext, p: int, s: int, optimized: Sequence[Goal]
@@ -173,13 +181,18 @@ class ResourceDistributionGoal(Goal):
         (upstream ``ResourceDistributionGoal`` INTER_BROKER_REPLICA_SWAP
         fallback).  Partner replicas are tried smallest-first (largest net
         shed first); acceptance is the chained NET check."""
+        if self._swap_attempts >= self.MAX_SWAP_ATTEMPTS_PER_PASS:
+            return False
+        self._swap_attempts += 1
         l1 = self._moved(ctx, p, s)
         m = self._metric(ctx)
-        cold_order = np.argsort(
-            np.where(ctx.broker_alive & ctx.dest_candidates(), m, np.inf)
-        )
+        # hoisted out of the partner loop: dest_candidates() rebuilds a [B]
+        # mask per call and the argsort is O(B log B) — per-partner copies
+        # of both were the bulk of the fallback's cost (round-5 VERDICT)
+        dest_ok = ctx.broker_alive & ctx.dest_candidates()
+        cold_order = np.argsort(np.where(dest_ok, m, np.inf))
         for b2 in cold_order[: self.SWAP_PARTNER_BROKERS].tolist():
-            if not ctx.broker_alive[b2] or not ctx.dest_candidates()[b2]:
+            if not dest_ok[b2]:
                 continue
             partners = broker_replicas(ctx, b2)
             partners.sort(key=lambda ps: self._moved(ctx, *ps))

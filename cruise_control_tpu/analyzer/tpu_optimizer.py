@@ -68,6 +68,7 @@ from cruise_control_tpu.ops.cost import (
     pack_pload,
 )
 from cruise_control_tpu.ops.grid import gather_pload as _gather_pload
+from cruise_control_tpu.telemetry import tracing
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("engine")
@@ -2849,35 +2850,40 @@ class TpuGoalOptimizer:
 
         t0 = time.perf_counter()
         cfg = self.config
-        ctx = AnalyzerContext(state, options)
-        initial_assignment = ctx.assignment.copy()
-        initial_leader_slot = ctx.leader_slot.copy()
-        initial_replica_disk = (
-            ctx.replica_disk.copy() if ctx.replica_disk is not None else None
-        )
-        goals = make_goals(constraint=self.constraint)
-        violations_before = {g.name: g.violations(ctx) for g in goals}
-        stats_before = stats_summary(cluster_stats(state))
-
-        import contextlib
-
-        trace_ctx = (
-            jax.profiler.trace(cfg.profiler_trace_dir)
-            if cfg.profiler_trace_dir else contextlib.nullcontext()
-        )
-        with trace_ctx:
-            return self._search(
-                state, ctx, goals, violations_before, stats_before,
-                initial_assignment, initial_leader_slot, initial_replica_disk,
-                t0, cfg,
+        with tracing.span("analyzer.tpu"):
+            with tracing.span("analyzer.ctx_init"):
+                ctx = AnalyzerContext(state, options)
+            initial_assignment = ctx.assignment.copy()
+            initial_leader_slot = ctx.leader_slot.copy()
+            initial_replica_disk = (
+                ctx.replica_disk.copy() if ctx.replica_disk is not None
+                else None
             )
+            goals = make_goals(constraint=self.constraint)
+            violations_before = {g.name: g.violations(ctx) for g in goals}
+            stats_before = stats_summary(cluster_stats(state))
+
+            import contextlib
+
+            trace_ctx = (
+                jax.profiler.trace(cfg.profiler_trace_dir)
+                if cfg.profiler_trace_dir else contextlib.nullcontext()
+            )
+            with trace_ctx:
+                return self._search(
+                    state, ctx, goals, violations_before, stats_before,
+                    initial_assignment, initial_leader_slot,
+                    initial_replica_disk, t0, cfg,
+                )
 
     def _search(
         self, state, ctx, goals, violations_before, stats_before,
         initial_assignment, initial_leader_slot, initial_replica_disk, t0,
         cfg,
     ) -> OptimizerResult:
-        m = self._device_model(ctx)
+        with tracing.device_span("analyzer.upload") as dsp:
+            m = self._device_model(ctx)
+            dsp.block(m.broker_load)
         can = self._constraint_arrays_np(ctx)
         ca = {k: jnp.asarray(v) for k, v in can.items()}
         P, S, B = ctx.num_partitions, ctx.max_rf, ctx.num_brokers
@@ -2933,6 +2939,7 @@ class TpuGoalOptimizer:
             #: measured seconds per executed step, incl. amortized per-call
             #: dispatch/fetch overhead — the anytime deadline's rate model
             step_rate: Optional[float] = None
+            n_capped_calls = 0
             for _ in range(calls_budget):
                 if budget_exhausted():
                     LOG.info(
@@ -2946,9 +2953,8 @@ class TpuGoalOptimizer:
                             if g.is_hard):
                     # per-step deadline: convert remaining budget to a step
                     # cap at the measured rate; the first capped call is a
-                    # short probe that also calibrates the rate.  Until
-                    # hard goals hold the budget never truncates (same
-                    # contract as budget_exhausted).
+                    # short probe.  Until hard goals hold the budget never
+                    # truncates (same contract as budget_exhausted).
                     remaining = cfg.time_budget_s - (
                         time.perf_counter() - t0)
                     if step_rate:
@@ -2957,14 +2963,33 @@ class TpuGoalOptimizer:
                     else:
                         t_cap = min(cfg.steps_per_call, 256)
                 call_t0 = time.perf_counter()
-                packed, m_new = (
-                    scan_fn(m, ca) if t_cap is None
-                    else scan_fn(m, ca, jnp.asarray(t_cap, jnp.int32))
-                )
+                # ALWAYS pass t_cap (steps_per_call when uncapped): a
+                # scalar argument binds by shape, so capped and uncapped
+                # calls share ONE compiled executable instead of the 2-arg
+                # signature tracing its own variant.  np.int32, NOT
+                # jnp.asarray: a committed single-device array cannot be
+                # auto-replicated into a multi-process mesh (the multihost
+                # dryrun), while numpy inputs are treated as replicated
+                with tracing.device_span("analyzer.scan") as dsp:
+                    packed, m_new = scan_fn(
+                        m, ca,
+                        np.int32(
+                            cfg.steps_per_call if t_cap is None else t_cap
+                        ),
+                    )
+                    dsp.block(packed)
                 n_calls += 1
-                (k_all, p_all, s_all, d_all, step_counts, device_done,
-                 diag) = _fetch_scan_result(packed, cfg.steps_per_call)
-                if cfg.time_budget_s and diag.get("steps_run", 0) > 0:
+                if t_cap is not None:
+                    n_capped_calls += 1
+                with tracing.span("analyzer.fetch"):
+                    (k_all, p_all, s_all, d_all, step_counts, device_done,
+                     diag) = _fetch_scan_result(packed, cfg.steps_per_call)
+                if cfg.time_budget_s and diag.get("steps_run", 0) > 0 and \
+                        not (t_cap is not None and n_capped_calls == 1):
+                    # the FIRST capped call's sample is skipped: it follows
+                    # the mode switch (uncapped → probe), so its per-step
+                    # rate folds the one-off transition overhead into the
+                    # deadline model and over-truncates the next cap
                     rate = (
                         (time.perf_counter() - call_t0) / diag["steps_run"]
                     )
@@ -2979,22 +3004,24 @@ class TpuGoalOptimizer:
                     )
                 batch, rejected = 0, 0
                 off = 0
-                for c in step_counts:
-                    c = int(c)
-                    if c == 0:
-                        continue
-                    # one device step = one disjoint batch: vectorized
-                    # exact recheck + apply.  A rejection (f32 device math
-                    # vs the f64 recheck) skips just that action; later
-                    # steps still validate against the live context
-                    acts, n_rej = evaluator.commit_batch(
-                        k_all[off:off + c], p_all[off:off + c],
-                        s_all[off:off + c], d_all[off:off + c],
-                    )
-                    off += c
-                    actions.extend(acts)
-                    batch += len(acts)
-                    rejected += n_rej
+                with tracing.span("analyzer.recheck"):
+                    for c in step_counts:
+                        c = int(c)
+                        if c == 0:
+                            continue
+                        # one device step = one disjoint batch: vectorized
+                        # exact recheck + apply.  A rejection (f32 device
+                        # math vs the f64 recheck) skips just that action;
+                        # later steps still validate against the live
+                        # context
+                        acts, n_rej = evaluator.commit_batch(
+                            k_all[off:off + c], p_all[off:off + c],
+                            s_all[off:off + c], d_all[off:off + c],
+                        )
+                        off += c
+                        actions.extend(acts)
+                        batch += len(acts)
+                        rejected += n_rej
                 n_committed += batch
                 n_rejected += rejected
                 if not batch:
@@ -3017,7 +3044,8 @@ class TpuGoalOptimizer:
                     )
                     # device state includes skipped actions — rebuild from
                     # the live context before the next call
-                    m = _resync_device_model(m, ctx)
+                    with tracing.device_span("analyzer.resync") as dsp:
+                        m = dsp.block(_resync_device_model(m, ctx))
             LOG.info(
                 "resident search: %d device calls, %d actions committed, "
                 "%d rejected", n_calls, n_committed, n_rejected,
@@ -3039,9 +3067,10 @@ class TpuGoalOptimizer:
         for _ in range(rounds_budget):
             if budget_exhausted():
                 break
-            scores, k_top, p_top, s_top, d_top = _unpack_round_result(
-                np.asarray(round_fn(m, ca))
-            )
+            with tracing.device_span("analyzer.score") as dsp:
+                scores, k_top, p_top, s_top, d_top = _unpack_round_result(
+                    np.asarray(dsp.block(round_fn(m, ca)))
+                )
             order = np.argsort(scores, kind="stable")
             # Exact-recheck batch commit: the device proposes its top-k against
             # a snapshot of the aggregates; the host re-evaluates each proposal
@@ -3052,22 +3081,26 @@ class TpuGoalOptimizer:
             # surrogate decreases monotonically because every commit is
             # exact-checked, never stale.
             batch = 0
-            for i in order:
-                if scores[i] >= cfg.improvement_tol or not np.isfinite(scores[i]):
-                    break
-                action, delta = evaluator.evaluate(
-                    int(k_top[i]), int(p_top[i]), int(s_top[i]), int(d_top[i])
-                )
-                if action is None or delta >= cfg.improvement_tol:
-                    continue
-                ctx.apply(action)
-                actions.append(action)
-                batch += 1
-                if batch >= cfg.max_moves_per_round:
-                    break
+            with tracing.span("analyzer.apply"):
+                for i in order:
+                    if (scores[i] >= cfg.improvement_tol
+                            or not np.isfinite(scores[i])):
+                        break
+                    action, delta = evaluator.evaluate(
+                        int(k_top[i]), int(p_top[i]), int(s_top[i]),
+                        int(d_top[i])
+                    )
+                    if action is None or delta >= cfg.improvement_tol:
+                        continue
+                    ctx.apply(action)
+                    actions.append(action)
+                    batch += 1
+                    if batch >= cfg.max_moves_per_round:
+                        break
             if not batch:
                 break
-            m = _resync_device_model(m, ctx)
+            with tracing.device_span("analyzer.resync") as dsp:
+                m = dsp.block(_resync_device_model(m, ctx))
 
         # Host swap-repair pass: the device vocabulary is single moves +
         # leadership, whose feasibility mask rejects every destination on
@@ -3079,26 +3112,29 @@ class TpuGoalOptimizer:
         # knots, not bulk work.  No-op on healthy fixtures (north star:
         # zero hard violations after search).
         if any(g.is_hard and g.violations(ctx) > 0 for g in goals):
-            n_before = len(ctx.actions)
-            repaired: List = []
-            for g in goals:
-                if not g.is_hard:
-                    continue  # repair is a hard-goal pass only
-                try:
-                    g.optimize(ctx, repaired)
-                except Exception as e:  # leave the verdict to _finalize
-                    LOG.warning("host swap-repair: %s: %s", g.name, e)
-                repaired.append(g)
-            new_actions = ctx.actions[n_before:]
-            actions.extend(new_actions)
-            LOG.info(
-                "host swap-repair pass committed %d actions for residual "
-                "hard violations", len(new_actions),
+            with tracing.span("analyzer.swap_repair"):
+                n_before = len(ctx.actions)
+                repaired: List = []
+                for g in goals:
+                    if not g.is_hard:
+                        continue  # repair is a hard-goal pass only
+                    try:
+                        g.optimize(ctx, repaired)
+                    except Exception as e:  # leave the verdict to _finalize
+                        LOG.warning("host swap-repair: %s: %s", g.name, e)
+                    repaired.append(g)
+                new_actions = ctx.actions[n_before:]
+                actions.extend(new_actions)
+                LOG.info(
+                    "host swap-repair pass committed %d actions for residual "
+                    "hard violations", len(new_actions),
+                )
+        with tracing.span("analyzer.finalize"):
+            return self._finalize(
+                state, ctx, goals, actions, violations_before, stats_before,
+                initial_assignment, initial_leader_slot, initial_replica_disk,
+                t0,
             )
-        return self._finalize(
-            state, ctx, goals, actions, violations_before, stats_before,
-            initial_assignment, initial_leader_slot, initial_replica_disk, t0,
-        )
 
     def _finalize(
         self, state, ctx, goals, actions, violations_before, stats_before,

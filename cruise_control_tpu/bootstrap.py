@@ -323,6 +323,13 @@ def build_app(
     place of dialing ``bootstrap.servers`` — the test seam.
     """
     cfg = config or CruiseControlConfig()
+    from cruise_control_tpu.telemetry import tracing
+
+    tracing.configure(
+        enabled=cfg.get_boolean("telemetry.enabled"),
+        ring_size=cfg.get_int("telemetry.span.ring.size"),
+        slow_span_log_s=cfg.get_double("telemetry.slow.span.log.ms") / 1000,
+    )
     kafka_mode = kafka_wire is not None or bool(cfg.get("bootstrap.servers"))
     if kafka_mode:
         from cruise_control_tpu.kafka import (

@@ -746,6 +746,23 @@ def default_config_def() -> ConfigDef:
              "~0.95; exact fallback off-TPU), 'exact' = full selection "
              "network.", one_of("approx", "exact"), G)
 
+    # framework-specific: structured tracing spans + /metrics exposition
+    # (telemetry/).  The upstream analog is the always-on Dropwizard
+    # registry behind JMX; the registry here is always on too — these keys
+    # govern only the span layer.
+    G = "telemetry"
+    d.define("telemetry.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Record structured tracing spans through "
+             "the request path (request/operation/engine-phase timing, "
+             "GET /metrics phase timers, /state?verbose=true recent "
+             "spans).  Disabled spans cost one guarded call.", None, G)
+    d.define("telemetry.span.ring.size", ConfigType.INT, 256,
+             Importance.LOW, "Completed root spans retained for "
+             "/state?verbose=true.", at_least(1), G)
+    d.define("telemetry.slow.span.log.ms", ConfigType.DOUBLE, 0.0,
+             Importance.LOW, "Warn-log any span at least this slow "
+             "(0 = off).", at_least(0), G)
+
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a
     # real-Kafka deployment swaps the backend and ignores them

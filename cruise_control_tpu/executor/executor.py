@@ -239,13 +239,22 @@ class Executor:
                 ),
             )
 
+        from cruise_control_tpu.telemetry import tracing
+
         ticks = 0
         try:
-            ticks = self._drive_replica_moves(planner, sizes, max_ticks)
-            if not self._stop_requested:
-                self._drive_leader_moves(planner)
-            if not self._stop_requested:
-                self._drive_intra_moves(planner)
+            with tracing.span("executor.execute") as sp:
+                sp.set("proposals", len(proposals))
+                with tracing.span("executor.replica_moves"):
+                    ticks = self._drive_replica_moves(
+                        planner, sizes, max_ticks
+                    )
+                if not self._stop_requested:
+                    with tracing.span("executor.leader_moves"):
+                        self._drive_leader_moves(planner)
+                if not self._stop_requested:
+                    with tracing.span("executor.intra_moves"):
+                        self._drive_intra_moves(planner)
         finally:
             if self.throttle_helper is not None:
                 self.throttle_helper.clear_throttles()
@@ -348,16 +357,25 @@ class Executor:
                 self.backend.under_replicated_partitions(),
             )
             if batch:
-                reassignments = {
-                    t.proposal.partition: t.proposal.new_replicas for t in batch
-                }
-                self.backend.alter_partition_reassignments(reassignments)
-                for t in batch:
-                    t.transition(TaskState.IN_PROGRESS)
-                    t.started_tick = ticks
-                    in_flight[t.proposal.partition] = t
-                    for b in t.participating_brokers:
-                        in_flight_per_broker[b] = in_flight_per_broker.get(b, 0) + 1
+                from cruise_control_tpu.telemetry import tracing
+
+                # one span per dispatched batch (not per tick): batch count
+                # is bounded by the plan, tick count is not
+                with tracing.span("executor.batch") as sp:
+                    sp.set("moves", len(batch))
+                    reassignments = {
+                        t.proposal.partition: t.proposal.new_replicas
+                        for t in batch
+                    }
+                    self.backend.alter_partition_reassignments(reassignments)
+                    for t in batch:
+                        t.transition(TaskState.IN_PROGRESS)
+                        t.started_tick = ticks
+                        in_flight[t.proposal.partition] = t
+                        for b in t.participating_brokers:
+                            in_flight_per_broker[b] = (
+                                in_flight_per_broker.get(b, 0) + 1
+                            )
             if not in_flight:
                 break
             # advance the world one tick and harvest completions

@@ -44,6 +44,7 @@ from cruise_control_tpu.monitor.load_monitor import (
     ModelCompletenessRequirements,
 )
 from cruise_control_tpu.server.progress import OperationProgress
+from cruise_control_tpu.telemetry import tracing
 from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.utils.metrics import DEFAULT_REGISTRY, MetricRegistry
 
@@ -197,10 +198,11 @@ class CruiseControl:
         requirements: Optional[ModelCompletenessRequirements],
         progress: OperationProgress,
     ) -> ClusterState:
-        with progress.step("Acquiring model-generation semaphore"):
-            lock = self.load_monitor.acquire_for_model_generation()
-        with lock, progress.step("Generating cluster model"):
-            return self.load_monitor.cluster_model(requirements)
+        with tracing.span("facade.model"):
+            with progress.step("Acquiring model-generation semaphore"):
+                lock = self.load_monitor.acquire_for_model_generation()
+            with lock, progress.step("Generating cluster model"):
+                return self.load_monitor.cluster_model(requirements)
 
     @staticmethod
     def _to_internal(state: ClusterState, broker_ids: Sequence[int]) -> List[int]:
@@ -280,6 +282,28 @@ class CruiseControl:
         progress: OperationProgress,
         strategy: Optional[ReplicaMovementStrategy] = None,
     ) -> OptimizerResult:
+        if tracing.enabled():  # guard: no formatting on the disabled path
+            op_span = tracing.span("facade", sub=operation.lower())
+        else:
+            op_span = tracing.NOOP
+        with op_span as sp:
+            sp.set("dryrun", dryrun)
+            return self._goal_based_operation_traced(
+                operation, state, goals, options, dryrun, engine, progress,
+                strategy,
+            )
+
+    def _goal_based_operation_traced(
+        self,
+        operation: str,
+        state: ClusterState,
+        goals: Optional[Sequence[str]],
+        options: OptimizationOptions,
+        dryrun: bool,
+        engine: Optional[str],
+        progress: OperationProgress,
+        strategy: Optional[ReplicaMovementStrategy] = None,
+    ) -> OptimizerResult:
         constraint = self._resolved_constraint(state, options)
         # brokers whose every log dir is offline stay alive in the model (their
         # partitions need evacuating) but must not receive new replicas
@@ -307,7 +331,8 @@ class CruiseControl:
         )
         with progress.step(f"Optimizing ({opt.__class__.__name__})"):
             # upstream GoalOptimizer's "proposal-computation-timer"
-            with self.registry.timer("proposal-computation-timer"):
+            with self.registry.timer("proposal-computation-timer"), \
+                    tracing.span("facade.optimize"):
                 try:
                     result = opt.optimize(state, options)
                 except Exception:
@@ -328,7 +353,8 @@ class CruiseControl:
                 f"Executing {len(result.proposals)} proposals"
             ):
                 sizes = self._partition_sizes(state)
-                with self.registry.timer("execution-timer"):
+                with self.registry.timer("execution-timer"), \
+                        tracing.span("facade.execute"):
                     result.execution = self.executor.execute_proposals(
                         result.proposals, strategy=strategy,
                         partition_sizes=sizes,
@@ -761,4 +787,11 @@ class CruiseControl:
         if self.anomaly_detector is not None:
             out["AnomalyDetectorState"] = self.anomaly_detector.state_summary()
         out["Metrics"] = self.registry.snapshot()
+        if verbose:
+            # recent completed root spans (telemetry subsystem); the cheap
+            # always-on summary stays out of the 5s-poll payload
+            out["Telemetry"] = {
+                "enabled": tracing.enabled(),
+                "recentSpans": tracing.recent_roots(32),
+            }
         return out

@@ -324,6 +324,18 @@ class LoadMonitor:
         requirements: Optional[ModelCompletenessRequirements] = None,
     ) -> ClusterState:
         """Build a ClusterState from current topology + aggregated loads."""
+        from cruise_control_tpu.telemetry import tracing
+
+        with tracing.span("monitor.cluster_model") as sp:
+            state = self._cluster_model(requirements)
+            sp.set("brokers", state.num_brokers)
+            sp.set("partitions", state.num_partitions)
+            return state
+
+    def _cluster_model(
+        self,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+    ) -> ClusterState:
         req = requirements or ModelCompletenessRequirements()
         topo = self.metadata.refresh()
         # completeness is scored over the topology's partition universe, not

@@ -29,6 +29,7 @@ import numpy as np
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
 from cruise_control_tpu.server.purgatory import Purgatory
+from cruise_control_tpu.telemetry import tracing
 from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.server.security import (  # re-exported (legacy import site)
     BasicSecurityProvider,
@@ -44,7 +45,7 @@ USER_TASK_HEADER = "User-Task-ID"
 
 GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
-    "user_tasks", "review_board",
+    "user_tasks", "review_board", "metrics",
 }
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
@@ -143,12 +144,22 @@ class CruiseControlHttpServer:
                 handler.send_header("WWW-Authenticate", "Basic")
                 handler.end_headers()
                 return
-            if method == "GET" and endpoint in GET_ENDPOINTS:
-                return self._handle_get(handler, endpoint, params)
-            if method == "POST" and endpoint in ASYNC_POST_ENDPOINTS:
-                return self._handle_async_post(handler, endpoint, params)
-            if method == "POST" and endpoint in SYNC_POST_ENDPOINTS:
-                return self._handle_sync_post(handler, endpoint, params)
+            # request span, correlated with the async protocol's task id
+            # via _respond_task's annotate (guard before the f-string: the
+            # disabled path must not pay for formatting)
+            if tracing.enabled():
+                req_span = tracing.span(
+                    "http", sub=f"{method}.{endpoint or 'root'}"
+                )
+            else:
+                req_span = tracing.NOOP
+            with req_span:
+                if method == "GET" and endpoint in GET_ENDPOINTS:
+                    return self._handle_get(handler, endpoint, params)
+                if method == "POST" and endpoint in ASYNC_POST_ENDPOINTS:
+                    return self._handle_async_post(handler, endpoint, params)
+                if method == "POST" and endpoint in SYNC_POST_ENDPOINTS:
+                    return self._handle_sync_post(handler, endpoint, params)
             self._send(handler, 404, {
                 "errorMessage": f"unknown endpoint {method} {endpoint!r}"
             })
@@ -217,8 +228,38 @@ class CruiseControlHttpServer:
         handler.end_headers()
         handler.wfile.write(data)
 
+    def _send_text(self, handler, code: int, body: str,
+                   content_type: str) -> None:
+        if self.access_log:
+            self._log.info("%s %s %d", handler.command, handler.path, code)
+        data = body.encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(data)))
+        if self.cors_enabled:
+            handler.send_header("Access-Control-Allow-Origin",
+                                self.cors_origin)
+        handler.end_headers()
+        handler.wfile.write(data)
+
     # ---- GET endpoints ----------------------------------------------------------
     def _handle_get(self, handler, endpoint: str, params: dict) -> None:
+        if endpoint == "metrics":
+            # Prometheus text exposition of the shared registry + the
+            # span-derived phase timers (upstream: the JMX-exposed
+            # Dropwizard registry; scrapers speak this format instead)
+            from cruise_control_tpu.telemetry.exposition import (
+                CONTENT_TYPE,
+                render_prometheus,
+            )
+
+            registry = getattr(self.cc, "registry", None)
+            if registry is None:
+                return self._send(handler, 503, {
+                    "errorMessage": "no metric registry attached"
+                })
+            body = render_prometheus(registry, tracing.TELEMETRY)
+            return self._send_text(handler, 200, body, CONTENT_TYPE)
         if endpoint == "state":
             # verbose embeds the per-move task arrays in
             # ExecutorState.recentExecutions (upstream: verbose substates)
@@ -379,6 +420,8 @@ class CruiseControlHttpServer:
         return self._respond_task(handler, task, params)
 
     def _respond_task(self, handler, task, params: dict) -> None:
+        # the request span learns its task id only here, after submission
+        tracing.annotate("user_task_id", task.task_id)
         timeout_s = float(params.get("get_response_timeout_s", 0.0))
         if timeout_s:
             try:

@@ -1,0 +1,24 @@
+"""Telemetry subsystem: structured tracing spans (:mod:`tracing`),
+phase-tree profiling artifacts (:mod:`profile`), and Prometheus text
+exposition of the metric registry + span timers (:mod:`exposition`).
+
+The upstream analog is the Dropwizard ``MetricRegistry`` wired through
+every subsystem and exposed via JMX (SURVEY.md §5.1); this build keeps
+``utils/metrics.py`` as the counter/timer registry and adds the span
+layer on top so every perf claim ships with its own phase breakdown.
+"""
+
+from cruise_control_tpu.telemetry.tracing import (  # noqa: F401
+    NOOP,
+    TELEMETRY,
+    SpanRecord,
+    Telemetry,
+    annotate,
+    configure,
+    device_span,
+    enabled,
+    recent_roots,
+    reset,
+    span,
+    traced,
+)

@@ -1,0 +1,124 @@
+"""Prometheus text-format exposition of the metric registry + span-derived
+phase timers (upstream exposes its Dropwizard ``MetricRegistry`` through
+JMX; the operational analog here is ``GET /metrics`` in the format every
+scraper already speaks — text/plain; version=0.0.4).
+
+Rendering rules (one metric family per registry entry):
+
+* Counter  -> ``<name>_total`` counter
+* Meter    -> ``<name>_total`` counter + ``<name>_rate_per_s`` gauge
+* Timer    -> ``<name>_seconds`` summary (p50/p99 quantile samples,
+  ``_sum``/``_count``) + ``<name>_seconds_max`` gauge
+* Gauge    -> gauge (non-numeric callables are skipped — a broken gauge
+  must not corrupt the whole scrape)
+* Phases   -> ``cc_phase_seconds_total`` / ``cc_phase_self_seconds_total``
+  / ``cc_phase_count_total`` with a ``phase`` label per span path
+
+Registry names like ``proposal-computation-timer`` or ``http.GET.state``
+are sanitized to the ``[a-zA-Z_:][a-zA-Z0-9_:]*`` metric grammar and
+prefixed ``cc_`` so the scrape namespace is unambiguous.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from cruise_control_tpu.telemetry import profile
+from cruise_control_tpu.telemetry.tracing import Telemetry
+from cruise_control_tpu.utils.metrics import MetricRegistry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(raw: str, suffix: str = "") -> str:
+    name = _NAME_BAD.sub("_", raw)
+    if not re.match(r"[a-zA-Z_:]", name):
+        name = "_" + name
+    return f"cc_{name}{suffix}"
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _fmt(value: float) -> str:
+    # Prometheus accepts full-precision floats; repr keeps them exact and
+    # round-trippable
+    return repr(float(value))
+
+
+def render_prometheus(
+    registry: MetricRegistry,
+    telemetry: Optional[Telemetry] = None,
+) -> str:
+    """Render the registry (+ phase timers when ``telemetry`` is given) as
+    Prometheus text exposition format 0.0.4."""
+    snap = registry.snapshot()
+    lines: List[str] = []
+
+    for raw in sorted(snap["counters"]):
+        name = _metric_name(raw, "_total")
+        lines.append(f"# HELP {name} Counter {raw}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt(snap['counters'][raw]['count'])}")
+
+    for raw in sorted(snap["meters"]):
+        m = snap["meters"][raw]
+        name = _metric_name(raw, "_total")
+        lines.append(f"# HELP {name} Meter {raw}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt(m['count'])}")
+        rate = _metric_name(raw, "_rate_per_s")
+        lines.append(f"# HELP {rate} Lifetime mean rate of {raw}")
+        lines.append(f"# TYPE {rate} gauge")
+        lines.append(f"{rate} {_fmt(m['meanRatePerSec'])}")
+
+    for raw in sorted(snap["timers"]):
+        t = snap["timers"][raw]
+        name = _metric_name(raw, "_seconds")
+        lines.append(f"# HELP {name} Timer {raw}")
+        lines.append(f"# TYPE {name} summary")
+        lines.append(f'{name}{{quantile="0.5"}} {_fmt(t["p50Sec"])}')
+        lines.append(f'{name}{{quantile="0.99"}} {_fmt(t["p99Sec"])}')
+        lines.append(
+            f"{name}_sum {_fmt(t['meanSec'] * t['count'])}"
+        )
+        lines.append(f"{name}_count {_fmt(t['count'])}")
+        mx = _metric_name(raw, "_seconds_max")
+        lines.append(f"# HELP {mx} Max duration of {raw}")
+        lines.append(f"# TYPE {mx} gauge")
+        lines.append(f"{mx} {_fmt(t['maxSec'])}")
+
+    for raw in sorted(snap["gauges"]):
+        v = snap["gauges"][raw]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue  # error strings / non-numerics are unrepresentable
+        name = _metric_name(raw)
+        lines.append(f"# HELP {name} Gauge {raw}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(v)}")
+
+    if telemetry is not None:
+        tree = profile.phase_tree(telemetry)
+        if tree:
+            for metric, field, help_ in (
+                ("cc_phase_seconds_total", "total_s",
+                 "Cumulative wall-clock per traced phase"),
+                ("cc_phase_self_seconds_total", "self_s",
+                 "Cumulative wall-clock per traced phase excluding "
+                 "traced children"),
+                ("cc_phase_count_total", "count",
+                 "Completed spans per traced phase"),
+            ):
+                lines.append(f"# HELP {metric} {help_}")
+                lines.append(f"# TYPE {metric} counter")
+                for path, ent in tree.items():
+                    lines.append(
+                        f'{metric}{{phase="{_escape_label(path)}"}} '
+                        f"{_fmt(ent[field])}"
+                    )
+    return "\n".join(lines) + "\n"

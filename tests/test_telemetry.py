@@ -1,0 +1,274 @@
+"""Telemetry subsystem tests: span nesting + ring bounds, disabled-mode
+no-op behavior, phase-tree aggregation determinism, Prometheus exposition
+format, and the ``GET /metrics`` + ``/state?verbose`` server contracts
+(test_ui_contract.py style — raw HTTP, exactly as a scraper sees it)."""
+
+import json
+import re
+import time
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.server import CruiseControlHttpServer
+from cruise_control_tpu.telemetry import profile, tracing
+from cruise_control_tpu.telemetry.exposition import render_prometheus
+from cruise_control_tpu.utils.metrics import MetricRegistry
+
+from harness import full_stack
+
+
+@pytest.fixture
+def tel():
+    """Isolated Telemetry instance (the module singleton stays untouched)."""
+    return tracing.Telemetry(enabled=True, ring_size=4)
+
+
+@pytest.fixture
+def global_tracing():
+    """Enable the process-wide tracer for server-path tests; restore after."""
+    tracing.configure(enabled=True, ring_size=64)
+    yield tracing.TELEMETRY
+    tracing.configure(enabled=False)
+    tracing.reset()
+
+
+# ---- span mechanics -----------------------------------------------------------
+def test_span_nesting_and_paths(tel):
+    with tel.span("op") as root:
+        root.set("k", "v")
+        with tel.span("child", sub="x"):
+            pass
+        with tel.span("child", sub="y"):
+            pass
+    roots = tel.recent_roots()
+    assert len(roots) == 1
+    assert roots[0]["name"] == "op"
+    assert roots[0]["attrs"] == {"k": "v"}
+    assert [c["name"] for c in roots[0]["children"]] == ["child.x", "child.y"]
+    agg = tel.aggregates()
+    assert set(agg) == {"op", "op/child.x", "op/child.y"}
+    assert agg["op"][0] == 1
+
+
+def test_ring_buffer_is_bounded(tel):
+    for i in range(11):
+        with tel.span("root"):
+            pass
+    assert len(tel.recent_roots(100)) == tel.ring_size == 4
+    # aggregation still counts every completed span
+    assert tel.aggregates()["root"][0] == 11
+
+
+def test_nested_spans_roll_up_to_direct_parent_only(tel):
+    with tel.span("a"):
+        with tel.span("b"):
+            with tel.span("c"):
+                time.sleep(0.002)
+    tree = profile.phase_tree(tel)
+    assert set(tree) == {"a", "a/b", "a/b/c"}
+    # self time excludes only DIRECT children; c's time shows in b's
+    # children roll-up, not a's
+    assert tree["a/b"]["self_s"] <= tree["a/b"]["total_s"]
+    assert tree["a"]["total_s"] >= tree["a/b"]["total_s"]
+
+
+def test_disabled_mode_is_noop():
+    t = tracing.Telemetry(enabled=False)
+    s = t.span("never", sub="formatted")
+    assert s is tracing.NOOP
+    with s as sp:
+        sp.set("ignored", 1)
+        assert sp.block("value") == "value"
+    assert t.device_span("never") is tracing.NOOP
+    t.annotate("ignored", 2)
+    assert t.recent_roots() == []
+    assert t.aggregates() == {}
+
+
+def test_exception_inside_span_still_closes_and_tags(tel):
+    with pytest.raises(ValueError):
+        with tel.span("boom"):
+            raise ValueError("x")
+    roots = tel.recent_roots()
+    assert roots[0]["attrs"]["error"] == "ValueError"
+    # the stack is clean: the next span is a fresh root
+    with tel.span("after"):
+        pass
+    assert tel.recent_roots()[0]["name"] == "after"
+
+
+def test_phase_tree_aggregation_determinism(tel):
+    def workload(t):
+        for _ in range(3):
+            with t.span("req"):
+                with t.span("model"):
+                    pass
+                with t.span("optimize"):
+                    with t.span("score"):
+                        pass
+
+    workload(tel)
+    other = tracing.Telemetry(enabled=True)
+    workload(other)
+    t1, t2 = profile.phase_tree(tel), profile.phase_tree(other)
+    assert list(t1) == list(t2)  # sorted, identical structure
+    assert [v["count"] for v in t1.values()] == [
+        v["count"] for v in t2.values()
+    ]
+    assert t1["req"]["count"] == 3
+    assert t1["req/optimize/score"]["count"] == 3
+    for ent in t1.values():
+        assert 0.0 <= ent["self_s"] <= ent["total_s"]
+
+
+def test_artifact_schema(tel, tmp_path):
+    with tel.span("phase"):
+        pass
+    out = tmp_path / "profile.json"
+    written = profile.write_artifact(str(out), extra={"total_s": 1.0},
+                                     tel=tel)
+    loaded = json.loads(out.read_text())
+    assert loaded == written
+    assert loaded["schema"] == profile.SCHEMA
+    assert loaded["total_s"] == 1.0
+    assert loaded["phases"]["phase"]["count"] == 1
+
+
+# ---- Prometheus exposition ------------------------------------------------------
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                      # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'    # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r" [-+]?(\d+\.?\d*([eE][-+]?\d+)?|NaN|Inf)$"
+)
+_COMMENT_LINE = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+
+def _assert_valid_exposition(text: str) -> int:
+    """Validate every line against the text-format grammar; returns the
+    number of sample lines."""
+    samples = 0
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("#"):
+            assert _COMMENT_LINE.match(line), line
+        else:
+            assert _METRIC_LINE.match(line), line
+            samples += 1
+    return samples
+
+
+def test_prometheus_exposition_format(tel):
+    reg = MetricRegistry()
+    reg.counter("ops").inc(2)
+    reg.meter("http.GET.state").mark(3)
+    with reg.timer("proposal-computation-timer"):
+        pass
+    reg.gauge("up", lambda: 1.0)
+    reg.gauge("broken", lambda: "error: nope")  # must be skipped, not fatal
+    with tel.span('weird"phase\\name'):
+        pass
+    text = render_prometheus(reg, tel)
+    assert _assert_valid_exposition(text) >= 10
+    assert "cc_ops_total 2.0" in text
+    assert "cc_http_GET_state_total 3.0" in text
+    assert "cc_proposal_computation_timer_seconds_count 1.0" in text
+    assert 'quantile="0.99"' in text
+    assert "cc_up 1.0" in text
+    assert "broken" not in text
+    # label escaping keeps the scrape parseable
+    assert '\\"' in text and "\\\\" in text
+
+
+def test_exposition_without_telemetry_still_valid():
+    reg = MetricRegistry()
+    reg.counter("only").inc()
+    assert _assert_valid_exposition(render_prometheus(reg)) == 1
+
+
+# ---- server contract ------------------------------------------------------------
+@pytest.fixture
+def server(global_tracing):
+    cc, backend, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    yield srv, cc
+    srv.stop()
+
+
+def _get_raw(srv, path):
+    with urllib.request.urlopen(f"{srv.url}/{path}") as r:
+        return r.read().decode(), r.status, dict(r.headers)
+
+
+def test_metrics_endpoint_serves_prometheus_text(server):
+    srv, _ = server
+    # generate traffic so meters + request spans exist; the request span
+    # closes a hair after the response flushes, so poll for its phase line
+    _get_raw(srv, "state")
+    deadline = time.monotonic() + 10
+    body, status, headers = _get_raw(srv, "metrics")
+    while (time.monotonic() < deadline
+           and 'cc_phase_seconds_total{phase="http.GET.state"}' not in body):
+        time.sleep(0.05)
+        body, status, headers = _get_raw(srv, "metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert _assert_valid_exposition(body) > 0
+    # servlet request meter for the state hit
+    assert "cc_http_GET_state_total" in body
+    # span-derived phase timers with the request-span phase label
+    assert 'cc_phase_seconds_total{phase="http.GET.state"}' in body
+    # the shared registry's operation timer family is exposed once used
+    json.loads(urllib.request.urlopen(
+        f"{srv.url}/proposals").read())  # drives proposal-computation-timer
+    body2, _, _ = _get_raw(srv, "metrics")
+    assert "cc_proposal_computation_timer_seconds_count" in body2
+    # operation span nests under the request span in the phase path
+    assert "/facade.proposals/facade.optimize" in body2
+
+
+def test_state_verbose_exposes_recent_spans(server):
+    srv, _ = server
+    _get_raw(srv, "state")
+    # the request span closes a hair after the response flushes — poll
+    # instead of racing it
+    deadline = time.monotonic() + 10
+    names = []
+    while time.monotonic() < deadline:
+        body, _, _ = _get_raw(srv, "state?verbose=true")
+        st = json.loads(body)
+        tele = st["Telemetry"]
+        assert tele["enabled"] is True
+        names = [s["name"] for s in tele["recentSpans"]]
+        if any(n.startswith("http.GET.state") for n in names):
+            break
+        time.sleep(0.05)
+    assert any(n.startswith("http.GET.state") for n in names), names
+    # non-verbose stays lean: no span payload in the 5s-poll response
+    lean = json.loads(_get_raw(srv, "state")[0])
+    assert "Telemetry" not in lean
+
+
+def test_request_span_carries_user_task_id(server):
+    srv, _ = server
+    req = urllib.request.Request(
+        f"{srv.url}/rebalance?dryrun=true", method="POST"
+    )
+    with urllib.request.urlopen(req) as r:
+        task_id = r.headers.get("User-Task-ID")
+        json.loads(r.read())
+    assert task_id
+    deadline = time.monotonic() + 30
+    correlated = False
+    while time.monotonic() < deadline and not correlated:
+        spans = tracing.recent_roots(64)
+        correlated = any(
+            s["name"] == "http.POST.rebalance"
+            and s.get("attrs", {}).get("user_task_id") == task_id
+            for s in spans
+        )
+        if not correlated:
+            time.sleep(0.1)
+    assert correlated, "request span must carry the submitted User-Task-ID"

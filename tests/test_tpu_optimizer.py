@@ -655,38 +655,48 @@ def test_tpu_engine_count_saturated_swap_repair():
 
 
 def test_anytime_budget_per_step_deadline():
-    """`time_budget_s` binds at STEP granularity: a budgeted run returns
-    within budget + slack (not budget + a whole ~T-step device call) with
-    hard goals satisfied.  Run 1 warms the compile caches (including the
-    step-capped executable variant); run 2 is the timed contract."""
-    import time as _time
+    """`time_budget_s` binds at STEP granularity — asserted on the
+    deterministic ``diag["steps_run"]`` contract (round-5 VERDICT next #3:
+    the old wall-clock bound raced concurrent CPU load and flaked):
 
-    from cruise_control_tpu.analyzer.tpu_optimizer import TpuSearchConfig
+    * a device call invoked with step cap ``t_cap`` executes at most
+      ``t_cap`` steps;
+    * every cap value shares ONE compiled executable (the host always
+      passes ``t_cap`` as a traced scalar — a second capped variant would
+      pollute the probe call's step-rate sample with compile time);
+    * a budgeted end-to-end run still commits work with hard goals held.
+    """
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import tpu_optimizer as T
+    from cruise_control_tpu.analyzer.context import AnalyzerContext
 
     state = random_cluster(
-        seed=11, num_brokers=100, num_racks=10, num_partitions=2000,
+        seed=11, num_brokers=24, num_racks=6, num_partitions=300,
         distribution=Distribution.EXPONENTIAL, mean_utilization=0.45,
     )
-    goals = make_goals()
+    cfg = TpuSearchConfig(steps_per_call=48, device_batch_per_step=8)
+    opt = TpuGoalOptimizer(config=cfg)
+    ctx = AnalyzerContext(state)
+    m = opt._device_model(ctx)
+    ca = {
+        k: jnp.asarray(v) for k, v in opt._constraint_arrays_np(ctx).items()
+    }
+    K, D = opt._pool_sizes(ctx.num_partitions, ctx.max_rf, ctx.num_brokers)
+    scan_fn = T._cached_scan_fn(cfg, K, D, cfg.steps_per_call, None)
+    for cap in (1, 7, cfg.steps_per_call):
+        packed, _ = scan_fn(m, ca, jnp.asarray(cap, jnp.int32))
+        diag = T._fetch_scan_result(packed, cfg.steps_per_call)[-1]
+        assert 0 < diag["steps_run"] <= cap, (cap, diag["steps_run"])
+    cache_size = getattr(scan_fn, "_cache_size", None)
+    if cache_size is not None:  # jax-version tolerant
+        assert cache_size() == 1, "capped calls must share one executable"
 
-    def run(budget):
-        cfg = TpuSearchConfig(time_budget_s=budget)
-        t0 = _time.perf_counter()
-        res = TpuGoalOptimizer(config=cfg).optimize(state)
-        return _time.perf_counter() - t0, res
-
-    warm_wall, warm = run(3600.0)   # budget active but never truncating
-    budget = max(1.0, min(0.5 * warm_wall, 4.0))
-    wall, res = run(budget)
-    # hard goals hold even under truncation
-    for g in goals:
-        if g.is_hard:
-            assert g.violations(
-                __import__("cruise_control_tpu.analyzer.context",
-                           fromlist=["AnalyzerContext"]).AnalyzerContext(
-                    res.final_state)) == 0, g.name
-    # the contract: step-granular truncation — overshoot bounded by the
-    # probe-call remainder + per-call overhead, far below one full
-    # uncapped device call at CPU speeds
-    assert wall <= budget + max(2.0, 0.5 * budget), (wall, budget, warm_wall)
+    res = TpuGoalOptimizer(
+        config=TpuSearchConfig(time_budget_s=0.5, steps_per_call=48)
+    ).optimize(state)
     assert res.actions, "budgeted run must still commit work"
+    final_ctx = AnalyzerContext(res.final_state)
+    for g in make_goals():
+        if g.is_hard:
+            assert g.violations(final_ctx) == 0, g.name
