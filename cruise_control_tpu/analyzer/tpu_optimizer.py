@@ -68,7 +68,7 @@ from cruise_control_tpu.ops.cost import (
     pack_pload,
 )
 from cruise_control_tpu.ops.grid import gather_pload as _gather_pload
-from cruise_control_tpu.telemetry import tracing
+from cruise_control_tpu.telemetry import device_stats, tracing
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("engine")
@@ -1345,7 +1345,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         return run_capped(m, ca, t_cap)
 
     if mesh is None:
-        return jax.jit(run)
+        return device_stats.instrument("analyzer.scan_fn", jax.jit(run))
 
     from jax.sharding import PartitionSpec
 
@@ -1362,7 +1362,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             t_cap = jnp.int32(T)
         return sharded(m, ca, t_cap)
 
-    return jax.jit(run_sharded)
+    return device_stats.instrument("analyzer.scan_fn", jax.jit(run_sharded))
 
 
 def _fetch_scan_result(packed, T: int):
@@ -2635,7 +2635,8 @@ def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
             return _pack_round_result(-vals, kind, cp, cs, cd)
 
     if mesh is None:
-        return jax.jit(round_fn)
+        return device_stats.instrument("analyzer.round_fn",
+                                       jax.jit(round_fn))
 
     # Sharded variants: pools/candidates built once (replicated inputs), the
     # candidate axis sharded via parallel.sharded_columnar_topk; each device
@@ -2701,7 +2702,7 @@ def _cached_round_fn(cfg: TpuSearchConfig, K: int, D: int, mesh):
             )
             return jnp.concatenate([moves, leads], axis=1)
 
-    return jax.jit(sharded)
+    return device_stats.instrument("analyzer.round_fn", jax.jit(sharded))
 
 
 # ---------------------------------------------------------------------------------

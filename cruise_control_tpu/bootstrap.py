@@ -66,11 +66,15 @@ class App:
     fetcher_manager: MetricFetcherManager
     server: CruiseControlHttpServer
     detector_manager: object
+    #: telemetry/recorder.FlightRecorder; None when disabled
+    flight_recorder: object = None
 
     def shutdown(self) -> None:
         self.cruise_control.stop_proposal_precomputation()
         self.detector_manager.stop()
         self.fetcher_manager.stop()
+        if self.flight_recorder is not None:
+            self.flight_recorder.stop()
         self.server.stop()
 
 
@@ -323,12 +327,18 @@ def build_app(
     place of dialing ``bootstrap.servers`` — the test seam.
     """
     cfg = config or CruiseControlConfig()
-    from cruise_control_tpu.telemetry import tracing
+    from cruise_control_tpu.telemetry import device_stats, tracing
 
     tracing.configure(
         enabled=cfg.get_boolean("telemetry.enabled"),
         ring_size=cfg.get_int("telemetry.span.ring.size"),
         slow_span_log_s=cfg.get_double("telemetry.slow.span.log.ms") / 1000,
+    )
+    device_stats.configure(
+        enabled=cfg.get_boolean("telemetry.device.stats.enabled"),
+        retrace_threshold=cfg.get_int(
+            "telemetry.device.stats.retrace.threshold"
+        ),
     )
     kafka_mode = kafka_wire is not None or bool(cfg.get("bootstrap.servers"))
     if kafka_mode:
@@ -607,6 +617,29 @@ def build_app(
         fix_cooldown_ms=cfg.get("self.healing.cooldown.ms"),
         history_size=cfg.get_int("anomaly.detector.history.size"),
     )
+    if cfg.get_boolean("telemetry.device.stats.enabled"):
+        # live-buffer gauges ride the shared registry: GET /state JSON,
+        # /metrics gauge families, and the flight recorder's series
+        device_stats.install_gauges(cc.registry)
+    flight_recorder = None
+    if cfg.get_boolean("telemetry.recorder.enabled"):
+        from cruise_control_tpu.telemetry.recorder import FlightRecorder
+
+        flight_recorder = FlightRecorder(
+            cc.registry,
+            interval_s=cfg.get_double("telemetry.recorder.interval.ms")
+            / 1000,
+            retention=cfg.get_int("telemetry.recorder.retention.samples"),
+            journal_source=detector.journal,
+            extra_sources=(
+                [device_stats.MONITOR.totals]
+                if cfg.get_boolean("telemetry.device.stats.enabled") else ()
+            ),
+            dump_dir=cfg.get("telemetry.recorder.dump.dir"),
+            device_stats_source=device_stats.MONITOR.summary,
+        )
+        detector.flight_recorder = flight_recorder
+        flight_recorder.start()
     tasks = UserTaskManager(
         max_active_tasks=cfg.get_int("max.active.user.tasks"),
         completed_task_ttl_s=(
@@ -630,8 +663,10 @@ def build_app(
             cfg.get("two.step.purgatory.retention.time.ms") / 1000
         ),
         ui_path=cfg.get("webserver.ui.path"),
+        flight_recorder=flight_recorder,
     )
-    return App(cfg, backend, reporter, cc, fetchers, server, detector)
+    return App(cfg, backend, reporter, cc, fetchers, server, detector,
+               flight_recorder)
 
 
 def _movement_strategy(cfg: CruiseControlConfig):

@@ -762,6 +762,29 @@ def default_config_def() -> ConfigDef:
     d.define("telemetry.slow.span.log.ms", ConfigType.DOUBLE, 0.0,
              Importance.LOW, "Warn-log any span at least this slow "
              "(0 = off).", at_least(0), G)
+    d.define("telemetry.recorder.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Run the flight recorder: a background "
+             "thread sampling the metric registry into bounded time "
+             "series, served as the cc-tpu-flight-recorder/1 artifact on "
+             "GET /diagnostics and dumped to disk when a self-healing fix "
+             "fails.", None, G)
+    d.define("telemetry.recorder.interval.ms", ConfigType.DOUBLE, 5000.0,
+             Importance.LOW, "Flight-recorder sampling interval.",
+             at_least(10), G)
+    d.define("telemetry.recorder.retention.samples", ConfigType.INT, 720,
+             Importance.LOW, "Points retained per flight-recorder series "
+             "(720 x 5s = one hour).", at_least(2), G)
+    d.define("telemetry.recorder.dump.dir", ConfigType.STRING, None,
+             Importance.LOW, "Directory for incident artifacts (dumped on "
+             "anomaly FIX_FAILED); None disables dump-to-file.", None, G)
+    d.define("telemetry.device.stats.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "JAX compile observability: per-function "
+             "compile count/wall-time counters, the shape-churn retrace "
+             "detector, and live-buffer count/bytes gauges.", None, G)
+    d.define("telemetry.device.stats.retrace.threshold", ConfigType.INT, 8,
+             Importance.LOW, "Distinct compiled argument shapes per "
+             "logical function above which further compiles count as "
+             "retraces (shape churn) and warn.", at_least(2), G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a

@@ -50,6 +50,7 @@ class AnomalyDetectorManager:
         fix_cooldown_ms: int = 600_000,
         history_size: int = 100,
         per_type_interval_ms: Optional[Dict[AnomalyType, int]] = None,
+        flight_recorder=None,
     ):
         self.cc = cruise_control
         self.detectors = dict(detectors or {})
@@ -59,9 +60,17 @@ class AnomalyDetectorManager:
         #: <type>.detection.interval.ms keys); fall back to the default
         self.per_type_interval_ms = dict(per_type_interval_ms or {})
         self.fix_cooldown_ms = fix_cooldown_ms
+        #: telemetry.recorder hook: dump a flight-recorder artifact the
+        #: moment a self-healing fix FAILS (bootstrap wires it)
+        self.flight_recorder = flight_recorder
         self._last_run_ms: Dict[AnomalyType, int] = {}
         self._last_fix_ms: Optional[int] = None
-        self._history: deque = deque(maxlen=history_size)
+        #: bounded event journal (upstream AnomalyDetectorState history) —
+        #: the maxlen keeps a long-running server from leaking; readers go
+        #: through journal() under the lock (deque iteration during a
+        #: concurrent append from the scheduler thread raises)
+        self._history: deque = deque(maxlen=max(1, int(history_size)))
+        self._history_lock = threading.Lock()
         self._by_action: Dict[str, int] = {r.value: 0 for r in AnomalyNotificationResult}
         #: anomalies whose FIX was delayed (cooldown/ongoing execution) —
         #: retried next cycle.  Needed for maintenance events, which are
@@ -94,12 +103,13 @@ class AnomalyDetectorManager:
                 queue.extend(found)
             except Exception as e:  # a broken detector must not kill the loop
                 LOG.exception("%s detector failed", atype.value)
-                self._history.append({
-                    "detector": atype.value,
-                    "action": "DETECT_FAILED",
-                    "error": repr(e),
-                    "timeMs": now_ms,
-                })
+                with self._history_lock:
+                    self._history.append({
+                        "detector": atype.value,
+                        "action": "DETECT_FAILED",
+                        "error": repr(e),
+                        "timeMs": now_ms,
+                    })
         queue.sort(key=lambda a: (ANOMALY_PRIORITY[a.anomaly_type],
                                   a.detected_ms))
         for anomaly in queue:
@@ -146,8 +156,18 @@ class AnomalyDetectorManager:
                     record["action"] = "FIX_FAILED"
                     record["error"] = repr(e)
         final = record["action"]
-        self._by_action[final] = self._by_action.get(final, 0) + 1
-        self._history.append(record)
+        with self._history_lock:
+            self._by_action[final] = self._by_action.get(final, 0) + 1
+            self._history.append(record)
+        if final == "FIX_FAILED" and self.flight_recorder is not None:
+            # the crash-readable artifact, written at the exact moment an
+            # operator will want it; must never add a second failure
+            try:
+                self.flight_recorder.dump(
+                    f"FIX_FAILED:{anomaly.anomaly_type.value}"
+                )
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("flight-recorder dump on FIX_FAILED failed")
 
     # ---- background scheduling --------------------------------------------------
     def start(self, tick_s: float = 1.0) -> None:
@@ -170,14 +190,26 @@ class AnomalyDetectorManager:
             self._thread = None
 
     # ---- observability (upstream AnomalyDetectorState) --------------------------
+    def journal(self) -> List[dict]:
+        """The full bounded event journal, oldest first (the flight
+        recorder merges this into its timeline; /state shows the tail)."""
+        with self._history_lock:
+            return list(self._history)
+
+    def action_counts(self) -> Dict[str, int]:
+        """Cumulative per-action outcome counters
+        (``cc_anomaly_actions_total{action=...}`` on GET /metrics)."""
+        with self._history_lock:
+            return dict(self._by_action)
+
     def state_summary(self) -> dict:
         return {
             "selfHealingEnabled": {
                 t.value: on
                 for t, on in self.notifier.self_healing_enabled().items()
             },
-            "recentAnomalies": list(self._history)[-10:],
-            "metrics": dict(self._by_action),
+            "recentAnomalies": self.journal()[-10:],
+            "metrics": self.action_counts(),
             "lastFixMs": self._last_fix_ms,
             "detectors": [t.value for t in self.detectors],
         }

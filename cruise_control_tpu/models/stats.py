@@ -23,6 +23,7 @@ from cruise_control_tpu.models.cluster_state import (
     broker_replica_count,
     broker_topic_replica_count,
 )
+from cruise_control_tpu.telemetry import device_stats
 
 
 @struct.dataclass
@@ -154,6 +155,13 @@ def _cluster_stats_jit(
         potential_nw_out_std=pot_std,
         num_alive_brokers=jnp.sum(alive.astype(jnp.int32)),
     )
+
+
+# compile observability: stats recompile per (P, S, B, T) shape — exactly
+# the shape-churn the retrace detector exists to flag
+_cluster_stats_jit = device_stats.instrument(
+    "models.cluster_stats", _cluster_stats_jit
+)
 
 
 def stats_summary(stats: ClusterStats) -> dict:

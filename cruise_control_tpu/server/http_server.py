@@ -20,6 +20,7 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
@@ -45,7 +46,7 @@ USER_TASK_HEADER = "User-Task-ID"
 
 GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
-    "user_tasks", "review_board", "metrics",
+    "user_tasks", "review_board", "metrics", "diagnostics",
 }
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
@@ -75,6 +76,7 @@ class CruiseControlHttpServer:
         access_log: bool = True,
         purgatory_retention_s: float = 86_400.0,
         ui_path: Optional[str] = None,
+        flight_recorder=None,
     ):
         self.cc = cruise_control
         self.host = host
@@ -87,6 +89,8 @@ class CruiseControlHttpServer:
         self.cors_origin = cors_origin
         self.access_log = access_log
         self.ui_path = ui_path
+        #: telemetry/recorder.FlightRecorder serving GET /diagnostics
+        self.flight_recorder = flight_recorder
         self.purgatory = Purgatory(retention_s=purgatory_retention_s)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -153,13 +157,29 @@ class CruiseControlHttpServer:
                 )
             else:
                 req_span = tracing.NOOP
-            with req_span:
-                if method == "GET" and endpoint in GET_ENDPOINTS:
-                    return self._handle_get(handler, endpoint, params)
-                if method == "POST" and endpoint in ASYNC_POST_ENDPOINTS:
-                    return self._handle_async_post(handler, endpoint, params)
-                if method == "POST" and endpoint in SYNC_POST_ENDPOINTS:
-                    return self._handle_sync_post(handler, endpoint, params)
+            # request duration histogram — KNOWN endpoints only, so an URL
+            # scan cannot mint unbounded timer names in the registry
+            known = (
+                (method == "GET" and endpoint in GET_ENDPOINTS)
+                or (method == "POST" and endpoint in ASYNC_POST_ENDPOINTS)
+                or (method == "POST" and endpoint in SYNC_POST_ENDPOINTS)
+            )
+            t_req = time.perf_counter()
+            try:
+                with req_span:
+                    if method == "GET" and endpoint in GET_ENDPOINTS:
+                        return self._handle_get(handler, endpoint, params)
+                    if method == "POST" and endpoint in ASYNC_POST_ENDPOINTS:
+                        return self._handle_async_post(
+                            handler, endpoint, params)
+                    if method == "POST" and endpoint in SYNC_POST_ENDPOINTS:
+                        return self._handle_sync_post(
+                            handler, endpoint, params)
+            finally:
+                if known and registry is not None:
+                    registry.timer(f"http.{method}.{endpoint}").update(
+                        time.perf_counter() - t_req
+                    )
             self._send(handler, 404, {
                 "errorMessage": f"unknown endpoint {method} {endpoint!r}"
             })
@@ -242,6 +262,23 @@ class CruiseControlHttpServer:
         handler.end_headers()
         handler.wfile.write(data)
 
+    def _extra_metric_families(self):
+        """Labeled families the flat registry can't express: per-action
+        anomaly-handling outcome counters (upstream AnomalyDetectorState
+        metrics; ``cc_anomaly_actions_total{action="FIX"}``)."""
+        det = getattr(self.cc, "anomaly_detector", None)
+        counts_fn = getattr(det, "action_counts", None)
+        if counts_fn is None:
+            return []
+        rows = [({"action": action}, float(n))
+                for action, n in sorted(counts_fn().items())]
+        if not rows:
+            return []
+        return [(
+            "cc_anomaly_actions_total", "counter",
+            "Anomaly-handling outcomes by final action", rows,
+        )]
+
     # ---- GET endpoints ----------------------------------------------------------
     def _handle_get(self, handler, endpoint: str, params: dict) -> None:
         if endpoint == "metrics":
@@ -258,8 +295,21 @@ class CruiseControlHttpServer:
                 return self._send(handler, 503, {
                     "errorMessage": "no metric registry attached"
                 })
-            body = render_prometheus(registry, tracing.TELEMETRY)
+            body = render_prometheus(
+                registry, tracing.TELEMETRY,
+                extra_families=self._extra_metric_families(),
+            )
             return self._send_text(handler, 200, body, CONTENT_TYPE)
+        if endpoint == "diagnostics":
+            # flight-recorder artifact: retained time series + the merged
+            # anomaly journal (docs/OBSERVABILITY.md) — the crash-readable
+            # "what happened in the last ten minutes" surface
+            if self.flight_recorder is None:
+                return self._send(handler, 503, {
+                    "errorMessage": "no flight recorder attached "
+                                    "(telemetry.recorder.enabled=false?)"
+                })
+            return self._send(handler, 200, self.flight_recorder.artifact())
         if endpoint == "state":
             # verbose embeds the per-move task arrays in
             # ExecutorState.recentExecutions (upstream: verbose substates)

@@ -1,0 +1,316 @@
+"""JAX compile / retrace / live-buffer observability.
+
+``cruise_control_tpu/__init__.py`` names XLA compiles as the dominant
+cold-start cost, yet nothing attributed them: a 20s first rebalance was
+indistinguishable from a 20s search.  This module instruments the jit
+entry points (the cached scan/round programs in
+``analyzer/tpu_optimizer.py``, the cluster-stats program in
+``models/stats.py``) so every compile is counted and timed per LOGICAL
+function, persistent-cache traffic (``utils/jit_cache.py``) is visible,
+shape-churn retracing is detected, and device memory (live buffer
+count/bytes) is a scrapeable gauge.
+
+Design:
+
+* :func:`instrument` wraps a jitted callable.  Compiles are detected via
+  the pjit ``_cache_size()`` delta around each call (jax-version
+  tolerant: when the private API is missing it falls back to
+  first-call-per-argument-signature detection).  A compiling call's wall
+  clock — trace + lower + backend compile + the first execution — is
+  attributed to the logical function; that is exactly the cold-start cost
+  an operator experiences.
+* **Retrace detector.**  Each compile records the argument signature
+  (leaf shapes/dtypes).  More than ``retrace_threshold`` DISTINCT
+  signatures for one logical function is shape churn — the classic silent
+  TPU perf bug — surfaced as a warn log (anomaly-style, once per
+  crossing) and a monotone counter on ``GET /metrics``.
+* **Near-zero disabled path.**  A disabled monitor adds one attribute
+  check per call; instrumented functions otherwise pass straight through
+  (``__getattr__`` delegates, so ``_cache_size``/``lower`` etc. keep
+  working).
+
+Thread-safe: one small lock around the per-function tables; the wrapper's
+hot path takes it only when a compile actually happened.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("device_stats")
+
+_DEFAULT_RETRACE_THRESHOLD = 8
+
+
+def _call_signature(args: tuple, kwargs: dict) -> tuple:
+    """Hashable (shape, dtype) signature over the call's pytree leaves.
+
+    Static non-array leaves (ints, strings, None) participate by value —
+    they key separate executables in jax too."""
+    import jax
+
+    sig: List[tuple] = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            sig.append((tuple(shape), str(getattr(leaf, "dtype", ""))))
+        elif isinstance(leaf, (int, float, bool, str, bytes, type(None))):
+            sig.append((type(leaf).__name__, leaf))
+        else:
+            sig.append((type(leaf).__name__, None))
+    return tuple(sig)
+
+
+class FunctionCompileStats:
+    """Per-logical-function compile accounting."""
+
+    __slots__ = ("name", "compiles", "compile_s", "signatures",
+                 "retraces", "warned")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.signatures: set = set()
+        self.retraces = 0
+        self.warned = False
+
+    def to_json(self) -> dict:
+        return {
+            "compiles": self.compiles,
+            "compileSec": round(self.compile_s, 6),
+            "distinctShapes": len(self.signatures),
+            "retraces": self.retraces,
+        }
+
+
+class _InstrumentedJit:
+    """Transparent wrapper around one jitted callable (one jit instance —
+    an lru-cached factory reuses the same wrapper per cache key)."""
+
+    __slots__ = ("_fn", "_name", "_mon", "_seen")
+
+    def __init__(self, name: str, fn: Callable, monitor: "DeviceStatsMonitor"):
+        self._fn = fn
+        self._name = name
+        self._mon = monitor
+        self._seen: set = set()  # signature fallback when _cache_size is gone
+
+    def __call__(self, *args, **kwargs):
+        mon = self._mon
+        if not mon.enabled:
+            return self._fn(*args, **kwargs)
+        size_fn = getattr(self._fn, "_cache_size", None)
+        if size_fn is not None:
+            before = size_fn()
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            if size_fn() == before:
+                return out
+            dt = time.perf_counter() - t0
+        else:  # pragma: no cover - jax private-API drift
+            sig = _call_signature(args, kwargs)
+            if sig in self._seen:
+                return self._fn(*args, **kwargs)
+            self._seen.add(sig)
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+        mon.record_compile(self._name, dt, _call_signature(args, kwargs))
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+class DeviceStatsMonitor:
+    """Process-wide compile/retrace/live-buffer state (module singleton
+    below, reconfigured once by bootstrap — instrumentation sites are
+    module-level jit factories that never see a constructor)."""
+
+    def __init__(self, enabled: bool = True,
+                 retrace_threshold: int = _DEFAULT_RETRACE_THRESHOLD):
+        self.enabled = enabled
+        self.retrace_threshold = max(2, int(retrace_threshold))
+        self._lock = threading.Lock()
+        self._fns: Dict[str, FunctionCompileStats] = {}
+        self.persistent_cache_hits = 0
+        self.persistent_cache_misses = 0
+        self.persistent_cache_puts = 0
+
+    # ---- configuration ----------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  retrace_threshold: Optional[int] = None) -> None:
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if retrace_threshold is not None:
+            self.retrace_threshold = max(2, int(retrace_threshold))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._fns.clear()
+            self.persistent_cache_hits = 0
+            self.persistent_cache_misses = 0
+            self.persistent_cache_puts = 0
+
+    # ---- instrumentation --------------------------------------------------------
+    def instrument(self, name: str, fn: Callable) -> Callable:
+        return _InstrumentedJit(name, fn, self)
+
+    def record_compile(self, name: str, seconds: float,
+                       signature: tuple) -> None:
+        with self._lock:
+            st = self._fns.get(name)
+            if st is None:
+                st = self._fns[name] = FunctionCompileStats(name)
+            st.compiles += 1
+            st.compile_s += seconds
+            st.signatures.add(signature)
+            retrace = len(st.signatures) > self.retrace_threshold
+            if retrace:
+                st.retraces += 1
+            warn = retrace and not st.warned
+            if warn:
+                st.warned = True
+            distinct = len(st.signatures)
+        if warn:
+            LOG.warning(
+                "retrace churn: %s compiled for %d distinct shapes "
+                "(threshold %d) — callers are feeding varying shapes into "
+                "one jitted program; pad or bucket them "
+                "(cc_jit_retraces_total{fn=\"%s\"} is counting)",
+                name, distinct, self.retrace_threshold, name,
+            )
+
+    def note_persistent_get(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.persistent_cache_hits += 1
+            else:
+                self.persistent_cache_misses += 1
+
+    def note_persistent_put(self) -> None:
+        with self._lock:
+            self.persistent_cache_puts += 1
+
+    # ---- readers ----------------------------------------------------------------
+    def summary(self) -> dict:
+        """JSON view (flight-recorder artifact, diagnostics)."""
+        with self._lock:
+            fns = {n: st.to_json() for n, st in sorted(self._fns.items())}
+            hits, misses, puts = (self.persistent_cache_hits,
+                                  self.persistent_cache_misses,
+                                  self.persistent_cache_puts)
+        live_n, live_b = self.live_buffer_stats()
+        return {
+            "enabled": self.enabled,
+            "retraceThreshold": self.retrace_threshold,
+            "functions": fns,
+            "persistentCache": {"hits": hits, "misses": misses,
+                                "puts": puts},
+            "liveBuffers": live_n,
+            "liveBufferBytes": live_b,
+        }
+
+    def totals(self) -> Dict[str, float]:
+        """Cumulative counters for rate sampling (flight recorder)."""
+        with self._lock:
+            compiles = sum(st.compiles for st in self._fns.values())
+            compile_s = sum(st.compile_s for st in self._fns.values())
+            retraces = sum(st.retraces for st in self._fns.values())
+        return {
+            "jit.compiles": float(compiles),
+            "jit.compile.seconds": round(compile_s, 6),
+            "jit.retraces": float(retraces),
+        }
+
+    def per_function(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: st.to_json() for n, st in sorted(self._fns.items())}
+
+    def live_buffer_stats(self) -> Tuple[int, int]:
+        """(count, bytes) of live jax arrays on all devices; (0, 0) when
+        jax is unavailable or disabled."""
+        if not self.enabled:
+            return 0, 0
+        try:
+            import jax
+
+            arrs = jax.live_arrays()
+        except Exception:  # pragma: no cover - backend teardown races
+            return 0, 0
+        n = b = 0
+        for a in arrs:
+            n += 1
+            b += int(getattr(a, "nbytes", 0) or 0)
+        return n, b
+
+    def install_gauges(self, registry) -> None:
+        """Register live-buffer gauges on the shared registry (GET /state
+        JSON + /metrics gauge families + flight-recorder series)."""
+        registry.gauge("jax.live.buffers",
+                       lambda: float(self.live_buffer_stats()[0]))
+        registry.gauge("jax.live.buffer.bytes",
+                       lambda: float(self.live_buffer_stats()[1]))
+
+
+#: process-wide default (bootstrap reconfigures it from the
+#: telemetry.device.stats.* keys)
+MONITOR = DeviceStatsMonitor()
+
+
+# module-level conveniences bound to the default instance -------------------------
+def configure(enabled: Optional[bool] = None,
+              retrace_threshold: Optional[int] = None) -> None:
+    MONITOR.configure(enabled, retrace_threshold)
+
+
+def enabled() -> bool:
+    return MONITOR.enabled
+
+
+def instrument(name: str, fn: Callable) -> Callable:
+    """Wrap a jitted callable so its compiles are attributed to ``name``."""
+    return MONITOR.instrument(name, fn)
+
+
+def install_gauges(registry) -> None:
+    MONITOR.install_gauges(registry)
+
+
+def reset() -> None:
+    MONITOR.reset()
+
+
+def install_persistent_cache_probe() -> None:
+    """Count persistent-compilation-cache hits/misses/puts (composes with
+    the CPU-exclusion patch in ``utils/jit_cache.py`` — this wraps
+    whatever is installed at call time; idempotent)."""
+    try:
+        from jax._src import compilation_cache as cc
+    except Exception:  # pragma: no cover - future jax refactor
+        return
+    if getattr(cc, "_cc_tpu_stats_probe", False):
+        return
+    orig_get = getattr(cc, "get_executable_and_time", None)
+    orig_put = getattr(cc, "put_executable_and_time", None)
+    if orig_get is None or orig_put is None:  # pragma: no cover - rename
+        return
+
+    def get_executable_and_time(*args, **kwargs):
+        out = orig_get(*args, **kwargs)
+        executable = out[0] if isinstance(out, tuple) else out
+        MONITOR.note_persistent_get(hit=executable is not None)
+        return out
+
+    def put_executable_and_time(*args, **kwargs):
+        MONITOR.note_persistent_put()
+        return orig_put(*args, **kwargs)
+
+    cc.get_executable_and_time = get_executable_and_time
+    cc.put_executable_and_time = put_executable_and_time
+    cc._cc_tpu_stats_probe = True

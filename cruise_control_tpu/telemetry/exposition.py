@@ -5,14 +5,22 @@ scraper already speaks — text/plain; version=0.0.4).
 
 Rendering rules (one metric family per registry entry):
 
-* Counter  -> ``<name>_total`` counter
-* Meter    -> ``<name>_total`` counter + ``<name>_rate_per_s`` gauge
-* Timer    -> ``<name>_seconds`` summary (p50/p99 quantile samples,
-  ``_sum``/``_count``) + ``<name>_seconds_max`` gauge
-* Gauge    -> gauge (non-numeric callables are skipped — a broken gauge
+* Counter   -> ``<name>_total`` counter
+* Meter     -> ``<name>_total`` counter + ``<name>_rate_per_s`` gauge
+* Timer     -> ``<name>_seconds`` HISTOGRAM (log-spaced ``_bucket`` series
+  + ``_sum``/``_count``) + ``<name>_seconds_max`` gauge.  Histograms, not
+  quantile summaries: buckets aggregate across instances and admit
+  ``histogram_quantile()``; precomputed p50/p99 stay on the JSON surface.
+* Histogram -> ``<name>`` histogram (``_bucket``/``_sum``/``_count``)
+* Gauge     -> gauge (non-numeric callables are skipped — a broken gauge
   must not corrupt the whole scrape)
-* Phases   -> ``cc_phase_seconds_total`` / ``cc_phase_self_seconds_total``
+* Phases    -> ``cc_phase_seconds_total`` / ``cc_phase_self_seconds_total``
   / ``cc_phase_count_total`` with a ``phase`` label per span path
+* Device    -> ``cc_jit_compile_total`` / ``cc_jit_compile_seconds_total``
+  / ``cc_jit_retraces_total`` (``fn`` label per logical function + an
+  ``all`` aggregate) + persistent-compilation-cache counters, from
+  :mod:`telemetry.device_stats` (rendered whenever the span layer is —
+  i.e. on the server path)
 
 Registry names like ``proposal-computation-timer`` or ``http.GET.state``
 are sanitized to the ``[a-zA-Z_:][a-zA-Z0-9_:]*`` metric grammar and
@@ -22,15 +30,19 @@ prefixed ``cc_`` so the scrape namespace is unambiguous.
 from __future__ import annotations
 
 import re
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
-from cruise_control_tpu.telemetry import profile
+from cruise_control_tpu.telemetry import device_stats, profile
 from cruise_control_tpu.telemetry.tracing import Telemetry
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: (family_name, type, help, [(labels, value), ...]) — the shape callers
+#: (the HTTP server's anomaly-action counters) pass as ``extra_families``
+ExtraFamily = Tuple[str, str, str, Sequence[Tuple[Dict[str, str], float]]]
 
 
 def _metric_name(raw: str, suffix: str = "") -> str:
@@ -51,12 +63,81 @@ def _fmt(value: float) -> str:
     return repr(float(value))
 
 
+def _le(bound: float) -> str:
+    return "+Inf" if bound == float("inf") else repr(float(bound))
+
+
+def _histogram_lines(lines: List[str], name: str, help_: str,
+                     buckets, total: float, count: int) -> None:
+    """Emit one ``<name>`` histogram family from cumulative buckets."""
+    lines.append(f"# HELP {name} {help_}")
+    lines.append(f"# TYPE {name} histogram")
+    for bound, cum in buckets:
+        lines.append(f'{name}_bucket{{le="{_le(bound)}"}} {_fmt(cum)}')
+    lines.append(f"{name}_sum {_fmt(total)}")
+    lines.append(f"{name}_count {_fmt(count)}")
+
+
+def _labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _device_stats_lines(lines: List[str]) -> None:
+    mon = device_stats.MONITOR
+    per = mon.per_function()
+    for metric, field, help_ in (
+        ("cc_jit_compile_total", "compiles",
+         "XLA compiles per logical jitted function"),
+        ("cc_jit_compile_seconds_total", "compileSec",
+         "Wall-clock spent compiling (trace+lower+compile+first run) per "
+         "logical jitted function"),
+        ("cc_jit_retraces_total", "retraces",
+         "Compiles beyond the distinct-shape threshold (shape churn) per "
+         "logical jitted function"),
+    ):
+        lines.append(f"# HELP {metric} {help_}")
+        lines.append(f"# TYPE {metric} counter")
+        total = 0.0
+        for fn, st in per.items():
+            total += st[field]
+            lines.append(
+                f'{metric}{{fn="{_escape_label(fn)}"}} {_fmt(st[field])}'
+            )
+        lines.append(f'{metric}{{fn="all"}} {_fmt(total)}')
+    if per:
+        lines.append("# HELP cc_jit_distinct_shapes Distinct argument "
+                     "signatures compiled per logical jitted function")
+        lines.append("# TYPE cc_jit_distinct_shapes gauge")
+        for fn, st in per.items():
+            lines.append(
+                f'cc_jit_distinct_shapes{{fn="{_escape_label(fn)}"}} '
+                f"{_fmt(st['distinctShapes'])}"
+            )
+    for metric, value, help_ in (
+        ("cc_jit_persistent_cache_hits_total", mon.persistent_cache_hits,
+         "Persistent compilation cache hits"),
+        ("cc_jit_persistent_cache_misses_total", mon.persistent_cache_misses,
+         "Persistent compilation cache misses"),
+        ("cc_jit_persistent_cache_puts_total", mon.persistent_cache_puts,
+         "Persistent compilation cache writes"),
+    ):
+        lines.append(f"# HELP {metric} {help_}")
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_fmt(value)}")
+
+
 def render_prometheus(
     registry: MetricRegistry,
     telemetry: Optional[Telemetry] = None,
+    extra_families: Optional[Sequence[ExtraFamily]] = None,
 ) -> str:
-    """Render the registry (+ phase timers when ``telemetry`` is given) as
-    Prometheus text exposition format 0.0.4."""
+    """Render the registry (+ phase timers and device/compile stats when
+    ``telemetry`` is given) as Prometheus text exposition format 0.0.4."""
     snap = registry.snapshot()
     lines: List[str] = []
 
@@ -77,21 +158,23 @@ def render_prometheus(
         lines.append(f"# TYPE {rate} gauge")
         lines.append(f"{rate} {_fmt(m['meanRatePerSec'])}")
 
-    for raw in sorted(snap["timers"]):
-        t = snap["timers"][raw]
+    # live Timer/Histogram objects, not their JSON snapshots: the bucket
+    # emission needs the cumulative counts, which the JSON surface rounds
+    # into a {le: count} dict keyed by repr
+    for raw, timer in sorted(registry.timers().items()):
+        t = timer.snapshot()
         name = _metric_name(raw, "_seconds")
-        lines.append(f"# HELP {name} Timer {raw}")
-        lines.append(f"# TYPE {name} summary")
-        lines.append(f'{name}{{quantile="0.5"}} {_fmt(t["p50Sec"])}')
-        lines.append(f'{name}{{quantile="0.99"}} {_fmt(t["p99Sec"])}')
-        lines.append(
-            f"{name}_sum {_fmt(t['meanSec'] * t['count'])}"
-        )
-        lines.append(f"{name}_count {_fmt(t['count'])}")
+        _histogram_lines(lines, name, f"Timer {raw}",
+                         timer.cumulative_buckets(), t["sumSec"], t["count"])
         mx = _metric_name(raw, "_seconds_max")
         lines.append(f"# HELP {mx} Max duration of {raw}")
         lines.append(f"# TYPE {mx} gauge")
         lines.append(f"{mx} {_fmt(t['maxSec'])}")
+
+    for raw, hist in sorted(registry.histograms().items()):
+        h = hist.snapshot()
+        _histogram_lines(lines, _metric_name(raw), f"Histogram {raw}",
+                         hist.cumulative_buckets(), h["sum"], h["count"])
 
     for raw in sorted(snap["gauges"]):
         v = snap["gauges"][raw]
@@ -121,4 +204,12 @@ def render_prometheus(
                         f'{metric}{{phase="{_escape_label(path)}"}} '
                         f"{_fmt(ent[field])}"
                     )
+        _device_stats_lines(lines)
+
+    for fam_name, fam_type, fam_help, rows in (extra_families or ()):
+        lines.append(f"# HELP {fam_name} {fam_help}")
+        lines.append(f"# TYPE {fam_name} {fam_type}")
+        for labels, value in rows:
+            lines.append(f"{fam_name}{_labels(labels)} {_fmt(value)}")
+
     return "\n".join(lines) + "\n"

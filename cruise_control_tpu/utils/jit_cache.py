@@ -160,3 +160,10 @@ def enable(cache_dir: str | None = None) -> None:
     except Exception:
         pass  # unwritable dir / unknown flags: keep going uncached
     _exclude_cpu_executables()
+    # compile observability: count persistent-cache hits/misses/puts
+    # (wraps whatever get/put the exclusion patch installed above)
+    from cruise_control_tpu.telemetry.device_stats import (
+        install_persistent_cache_probe,
+    )
+
+    install_persistent_cache_probe()

@@ -1,0 +1,387 @@
+"""PR-2 observability layer tests: Prometheus histogram exposition
+contract, bounded O(1) Meter, flight-recorder sampling/retention/artifact
+schema, compile/retrace detection, the bounded anomaly journal, and the
+``GET /diagnostics`` server contract."""
+
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.detector.anomalies import AnomalyType
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.notifier import AnomalyNotificationResult
+from cruise_control_tpu.server import CruiseControlHttpServer
+from cruise_control_tpu.telemetry import device_stats, tracing
+from cruise_control_tpu.telemetry.exposition import render_prometheus
+from cruise_control_tpu.telemetry.recorder import SCHEMA, FlightRecorder
+from cruise_control_tpu.utils.metrics import (
+    DEFAULT_DURATION_BUCKETS,
+    Histogram,
+    Meter,
+    MetricRegistry,
+)
+
+from harness import full_stack
+
+
+# ---- histogram metric + exposition contract -------------------------------------
+def test_histogram_buckets_are_cumulative_and_exhaustive():
+    h = Histogram()
+    for v in (0.0005, 0.003, 0.003, 0.2, 50.0, 1e6):  # incl. out-of-range
+        h.update(v)
+    buckets = h.cumulative_buckets()
+    assert buckets[-1][0] == float("inf")
+    assert buckets[-1][1] == h.count == 6
+    cums = [c for _, c in buckets]
+    assert cums == sorted(cums), "cumulative counts must be monotone"
+    snap = h.snapshot()
+    assert snap["buckets"]["+Inf"] == 6
+    assert snap["count"] == 6
+    assert snap["sum"] == pytest.approx(1000050.2065, abs=1e-3)
+    assert snap["max"] == 1e6
+
+
+def test_histogram_bounds_are_fixed_and_log_spaced():
+    b = DEFAULT_DURATION_BUCKETS
+    assert b[0] == pytest.approx(0.001) and b[-1] == pytest.approx(100.0)
+    ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+    for r in ratios:  # 3 per decade => constant ratio 10^(1/3)
+        assert r == pytest.approx(10 ** (1 / 3), rel=1e-6)
+
+
+def test_prometheus_histogram_family_contract():
+    reg = MetricRegistry()
+    hist = reg.histogram("queue.wait.seconds")
+    for v in (0.002, 0.002, 0.3, 7.0):
+        hist.update(v)
+    text = render_prometheus(reg)
+    assert "# TYPE cc_queue_wait_seconds histogram" in text
+    assert 'cc_queue_wait_seconds_bucket{le="+Inf"} 4.0' in text
+    assert "cc_queue_wait_seconds_count 4.0" in text
+    assert "cc_queue_wait_seconds_sum" in text
+    # every bucket line's cumulative count is monotone in le order
+    pat = re.compile(r'cc_queue_wait_seconds_bucket\{le="([^"]+)"\} (\S+)')
+    rows = [(float("inf") if le == "+Inf" else float(le), float(v))
+            for le, v in pat.findall(text)]
+    assert rows == sorted(rows), rows
+    assert len(rows) == len(DEFAULT_DURATION_BUCKETS) + 1
+
+
+def test_timer_emits_buckets_and_max_gauge():
+    reg = MetricRegistry()
+    reg.timer("op").update(0.05)
+    reg.timer("op").update(2.0)
+    text = render_prometheus(reg)
+    assert "# TYPE cc_op_seconds histogram" in text
+    assert 'cc_op_seconds_bucket{le="+Inf"} 2.0' in text
+    assert "cc_op_seconds_count 2.0" in text
+    assert "cc_op_seconds_max 2.0" in text
+    snap = reg.snapshot()["timers"]["op"]
+    assert snap["sumSec"] == pytest.approx(2.05)
+    assert snap["p99Sec"] >= snap["p50Sec"]
+
+
+# ---- Meter: O(1) bounded recent window ------------------------------------------
+def test_meter_bursty_mark_is_bounded():
+    m = Meter()
+    for _ in range(50):
+        m.mark(10_000)  # 500k events, one wall-clock second
+    assert len(m._buckets) <= Meter._WINDOW_S
+    # all 500k events collapse into (at most a few) per-second buckets
+    assert len(m._buckets) <= 2
+    snap = m.snapshot()
+    assert snap["count"] == 500_000
+    assert snap["fiveMinCount"] == 500_000
+
+
+def test_meter_window_expires_old_seconds():
+    m = Meter()
+    m.mark(5)
+    # age the bucket beyond the window and add a fresh one
+    m._buckets[0][0] -= Meter._WINDOW_S + 10
+    m.mark(3)
+    snap = m.snapshot()
+    assert snap["count"] == 8
+    assert snap["fiveMinCount"] == 3
+
+
+# ---- gauge hardening ------------------------------------------------------------
+def test_snapshot_survives_raising_gauge():
+    reg = MetricRegistry()
+    reg.gauge("ok", lambda: 1.0)
+    reg.gauge("boom", lambda: 1 / 0)
+    snap = reg.snapshot()  # must not raise (GET /state JSON path)
+    assert snap["gauges"]["ok"] == 1.0
+    assert str(snap["gauges"]["boom"]).startswith("error:")
+    # the exposition path skips the broken gauge entirely
+    text = render_prometheus(reg)
+    assert "cc_ok 1.0" in text
+    assert "boom" not in text
+
+
+# ---- flight recorder ------------------------------------------------------------
+def test_recorder_samples_gauges_and_counter_rates():
+    reg = MetricRegistry()
+    reg.gauge("depth", lambda: 7.0)
+    c = reg.counter("events")
+    rec = FlightRecorder(reg, interval_s=1.0, retention=16)
+    rec.sample_once(now=1000.0)      # baseline
+    c.inc(30)
+    rec.sample_once(now=1010.0)      # 30 events / 10 s
+    series = rec.series_snapshot()
+    assert series["gauge:depth"]["points"] == [[1000.0, 7.0], [1010.0, 7.0]]
+    assert series["rate:events"]["points"] == [[1010.0, 3.0]]
+
+
+def test_recorder_retention_bounds_series():
+    reg = MetricRegistry()
+    reg.gauge("g", lambda: 1.0)
+    rec = FlightRecorder(reg, interval_s=1.0, retention=4)
+    for i in range(10):
+        rec.sample_once(now=float(i))
+    pts = rec.series_snapshot()["gauge:g"]["points"]
+    assert len(pts) == 4
+    assert pts[0][0] == 6.0  # oldest retained point
+
+
+def test_recorder_artifact_schema_and_journal_merge(tmp_path):
+    reg = MetricRegistry()
+    reg.gauge("g", lambda: 2.0)
+    journal = [
+        {"action": "IGNORE", "timeMs": 2000},
+        {"action": "FIX", "timeMs": 1000},
+    ]
+    rec = FlightRecorder(
+        reg, interval_s=1.0, retention=8,
+        journal_source=lambda: list(journal),
+        extra_sources=[lambda: {"jit.compiles": 5.0}],
+        dump_dir=str(tmp_path),
+        device_stats_source=lambda: {"enabled": True},
+    )
+    rec.sample_once(now=0.0)
+    art = rec.artifact()
+    assert art["schema"] == SCHEMA == "cc-tpu-flight-recorder/1"
+    assert art["interval_s"] == 1.0 and art["retention"] == 8
+    assert "gauge:g" in art["series"]
+    # journal is merged TIME-ORDERED regardless of source order
+    assert [e["timeMs"] for e in art["events"]] == [1000, 2000]
+    assert art["deviceStats"] == {"enabled": True}
+    json.dumps(art)  # crash-readable = JSON-serializable
+    # dump-to-file carries the reason and the same schema
+    path = rec.dump("FIX_FAILED:GOAL_VIOLATION")
+    assert path is not None
+    dumped = json.loads(open(path).read())
+    assert dumped["schema"] == SCHEMA
+    assert dumped["dumpReason"] == "FIX_FAILED:GOAL_VIOLATION"
+
+
+def test_recorder_background_thread_samples_and_restarts():
+    reg = MetricRegistry()
+    reg.gauge("g", lambda: 1.0)
+    rec = FlightRecorder(reg, interval_s=0.02, retention=64)
+    rec.start()
+    deadline = time.monotonic() + 5
+    while (time.monotonic() < deadline
+           and len(rec.series_snapshot().get("gauge:g", {})
+                   .get("points", [])) < 2):
+        time.sleep(0.02)
+    rec.stop()
+    n = len(rec.series_snapshot()["gauge:g"]["points"])
+    assert n >= 2
+    rec.start()  # bench interleaving restarts the same instance
+    rec.stop()
+
+
+# ---- compile / retrace detection ------------------------------------------------
+def test_retrace_detector_flags_shape_churn():
+    mon = device_stats.DeviceStatsMonitor(enabled=True, retrace_threshold=2)
+    import jax
+
+    fn = mon.instrument("test.fn", jax.jit(lambda x: x * 2))
+    for n in (1, 2, 3, 4):
+        np.testing.assert_allclose(
+            np.asarray(fn(jnp.ones(n))), 2 * np.ones(n))
+    st = mon.per_function()["test.fn"]
+    assert st["compiles"] == 4
+    assert st["distinctShapes"] == 4
+    # shapes 3 and 4 exceeded the threshold of 2
+    assert st["retraces"] == 2
+    assert st["compileSec"] > 0
+    # repeat shapes hit the jit cache: no new compile counted
+    fn(jnp.ones(2))
+    assert mon.per_function()["test.fn"]["compiles"] == 4
+    totals = mon.totals()
+    assert totals["jit.compiles"] == 4.0 and totals["jit.retraces"] == 2.0
+
+
+def test_disabled_monitor_passes_through():
+    mon = device_stats.DeviceStatsMonitor(enabled=False)
+    import jax
+
+    fn = mon.instrument("test.off", jax.jit(lambda x: x + 1))
+    fn(jnp.ones(3))
+    assert mon.per_function() == {}
+    assert mon.live_buffer_stats() == (0, 0)
+
+
+def test_instrumented_fn_delegates_attributes():
+    import jax
+
+    mon = device_stats.DeviceStatsMonitor(enabled=True)
+    fn = mon.instrument("test.attr", jax.jit(lambda x: x))
+    assert fn._cache_size() == 0  # pjit private API reachable through wrap
+    fn(jnp.ones(2))
+    assert fn._cache_size() == 1
+
+
+# ---- bounded anomaly journal ----------------------------------------------------
+class _StubAnomaly:
+    def __init__(self, ts, fail=False):
+        self.anomaly_type = AnomalyType.GOAL_VIOLATION
+        self.detected_ms = ts
+        self.description = f"stub@{ts}"
+        self._fail = fail
+
+    def to_json(self):
+        return {"description": self.description}
+
+    def fix(self, cc, progress):
+        if self._fail:
+            raise RuntimeError("fix exploded")
+
+
+class _StubNotifier:
+    def __init__(self, action):
+        self._action = action
+
+    def on_anomaly(self, anomaly, now_ms):
+        return self._action
+
+    def self_healing_enabled(self):
+        return {}
+
+
+class _StubExecutor:
+    has_ongoing_execution = False
+
+
+class _StubCC:
+    def __init__(self):
+        self.executor = _StubExecutor()
+
+
+def test_anomaly_journal_is_bounded_and_counts_actions():
+    mgr = AnomalyDetectorManager(
+        _StubCC(), detectors={},
+        notifier=_StubNotifier(AnomalyNotificationResult.IGNORE),
+        history_size=5,
+    )
+    for i in range(20):
+        mgr._handle(_StubAnomaly(i), now_ms=i)
+    journal = mgr.journal()
+    assert len(journal) == 5, "journal must stay bounded"
+    assert [e["timeMs"] for e in journal] == [15, 16, 17, 18, 19]
+    assert mgr.action_counts()["IGNORE"] == 20  # counters see every event
+    assert isinstance(mgr._history, deque) and mgr._history.maxlen == 5
+
+
+def test_fix_failed_dumps_flight_recorder(tmp_path):
+    reg = MetricRegistry()
+    reg.gauge("g", lambda: 1.0)
+    rec = FlightRecorder(reg, interval_s=1.0, retention=8,
+                         dump_dir=str(tmp_path))
+    mgr = AnomalyDetectorManager(
+        _StubCC(), detectors={},
+        notifier=_StubNotifier(AnomalyNotificationResult.FIX),
+        fix_cooldown_ms=0, flight_recorder=rec,
+    )
+    mgr._handle(_StubAnomaly(1, fail=True), now_ms=10)
+    assert mgr.action_counts()["FIX_FAILED"] == 1
+    dumps = list(tmp_path.glob("flight-recorder-*.json"))
+    assert len(dumps) == 1
+    art = json.loads(dumps[0].read_text())
+    assert art["dumpReason"] == "FIX_FAILED:GOAL_VIOLATION"
+
+
+# ---- GET /diagnostics + /metrics server contract --------------------------------
+@pytest.fixture
+def diag_server():
+    cc, backend, _ = full_stack()
+    mgr = AnomalyDetectorManager(
+        cc, detectors={},
+        notifier=_StubNotifier(AnomalyNotificationResult.IGNORE),
+        history_size=16,
+    )
+    mgr._handle(_StubAnomaly(1), now_ms=1000)
+    device_stats.install_gauges(cc.registry)
+    rec = FlightRecorder(cc.registry, interval_s=60.0, retention=32,
+                         journal_source=mgr.journal,
+                         device_stats_source=device_stats.MONITOR.summary)
+    rec.sample_once()
+    srv = CruiseControlHttpServer(cc, port=0, flight_recorder=rec)
+    srv.start()
+    yield srv
+    srv.stop()
+    rec.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(f"{srv.url}/{path}") as r:
+        return r.read().decode(), r.status
+
+
+def test_diagnostics_serves_flight_recorder_artifact(diag_server):
+    body, status = _get(diag_server, "diagnostics")
+    assert status == 200
+    art = json.loads(body)
+    assert art["schema"] == "cc-tpu-flight-recorder/1"
+    assert len(art["series"]) >= 2, sorted(art["series"])
+    for series in art["series"].values():
+        assert series["points"], "every retained series carries points"
+    assert [e["timeMs"] for e in art["events"]] == [1000]
+    assert "functions" in art["deviceStats"]
+
+
+def test_metrics_exposes_compile_and_anomaly_action_families(diag_server):
+    body, status = _get(diag_server, "metrics")
+    assert status == 200
+    assert 'cc_jit_compile_seconds_total{fn="all"}' in body
+    assert 'cc_jit_retraces_total{fn="all"}' in body
+    assert 'cc_anomaly_actions_total{action="IGNORE"} 1.0' in body
+    assert "cc_jax_live_buffers" in body
+    # request timers emit buckets (the migrated HTTP timer family)
+    body2, _ = _get(diag_server, "metrics")
+    assert "cc_http_GET_metrics_seconds_bucket" in body2
+
+
+def test_diagnostics_without_recorder_is_503():
+    cc, _, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{srv.url}/diagnostics")
+        assert err.value.code == 503
+    finally:
+        srv.stop()
+
+
+def test_state_still_200_with_raising_gauge():
+    cc, _, _ = full_stack()
+    cc.registry.gauge("boom.gauge", lambda: 1 / 0)
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    try:
+        body, status = _get(srv, "state")
+        assert status == 200
+        st = json.loads(body)
+        assert str(st["Metrics"]["gauges"]["boom.gauge"]).startswith("error:")
+    finally:
+        srv.stop()

@@ -1,0 +1,142 @@
+"""Span-hygiene static check.
+
+docs/OBSERVABILITY.md states the rule: span names must be static — any
+f-string name construction (positional name or ``sub=``) at a
+``span()``/``device_span()`` call site must be guarded by
+``tracing.enabled()``, so the disabled path never pays for string
+formatting on a hot path.  Until now nothing enforced it; this test scans
+every module in ``cruise_control_tpu/`` with the ast so a violation fails
+CI with the offending file:line."""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "cruise_control_tpu"
+
+SPAN_FUNCS = {"span", "device_span"}
+
+
+def _is_enabled_call(node: ast.AST) -> bool:
+    """True for any `...enabled()` call (tracing.enabled / tel.enabled /
+    the bare-name import form)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", None)
+    return name == "enabled"
+
+
+def _guard_tests(ancestors):
+    """Yield the test expressions of every conditional construct whose
+    TAKEN branch leads to the call: `if` statements (body branch only —
+    an else branch is the path tracing is OFF), ternaries, and
+    `cond and expr` short-circuits."""
+    for parent, child in zip(ancestors, ancestors[1:] + [None]):
+        if isinstance(parent, ast.If) and child in parent.body:
+            yield parent.test
+        elif isinstance(parent, ast.IfExp) and child is parent.body:
+            yield parent.test
+        elif isinstance(parent, ast.BoolOp) and isinstance(parent.op,
+                                                           ast.And):
+            idx = parent.values.index(child) if child in parent.values else 0
+            for v in parent.values[:idx]:
+                yield v
+
+
+def find_unguarded_dynamic_spans(tree: ast.AST):
+    """(lineno, source_hint) for every span()/device_span() call that
+    builds an f-string name without an enclosing enabled() guard."""
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    offenders = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (f.attr if isinstance(f, ast.Attribute)
+                else getattr(f, "id", None))
+        if name not in SPAN_FUNCS:
+            continue
+        dynamic = any(
+            isinstance(a, ast.JoinedStr) for a in node.args
+        ) or any(
+            isinstance(kw.value, ast.JoinedStr) for kw in node.keywords
+        )
+        if not dynamic:
+            continue
+        chain = [node]
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            chain.append(cur)
+        chain.reverse()  # outermost first
+        guarded = any(
+            any(_is_enabled_call(n) for n in ast.walk(test))
+            for test in _guard_tests(chain)
+        )
+        if not guarded:
+            offenders.append((node.lineno, name))
+    return offenders
+
+
+def test_no_unguarded_fstring_span_names_in_package():
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for lineno, fn in find_unguarded_dynamic_spans(tree):
+            violations.append(f"{path.relative_to(PKG.parent)}:{lineno} "
+                              f"({fn} with f-string name)")
+    assert not violations, (
+        "f-string span names must be guarded by tracing.enabled() "
+        "(docs/OBSERVABILITY.md) — pass static names and route dynamic "
+        "parts through sub= inside a guard:\n" + "\n".join(violations)
+    )
+
+
+# ---- the checker itself is tested: it must catch what the rule forbids ----------
+def test_checker_flags_unguarded_fstring():
+    bad = ast.parse(
+        "def f(method):\n"
+        "    with tracing.span(f'http.{method}'):\n"
+        "        pass\n"
+    )
+    assert find_unguarded_dynamic_spans(bad) == [(2, "span")]
+    bad_sub = ast.parse(
+        "def f(method):\n"
+        "    s = tracing.span('http', sub=f'{method}.x')\n"
+    )
+    assert find_unguarded_dynamic_spans(bad_sub) == [(2, "span")]
+
+
+def test_checker_accepts_guarded_forms():
+    guarded_if = ast.parse(
+        "def f(method):\n"
+        "    if tracing.enabled():\n"
+        "        s = tracing.span('http', sub=f'{method}')\n"
+        "    else:\n"
+        "        s = tracing.NOOP\n"
+    )
+    assert find_unguarded_dynamic_spans(guarded_if) == []
+    guarded_ternary = ast.parse(
+        "def f(m):\n"
+        "    s = tracing.span(f'h.{m}') if tracing.enabled() else NOOP\n"
+    )
+    assert find_unguarded_dynamic_spans(guarded_ternary) == []
+    static_name = ast.parse(
+        "def f(m):\n"
+        "    with tracing.span('analyzer.scan', sub=m):\n"
+        "        pass\n"
+    )
+    assert find_unguarded_dynamic_spans(static_name) == []
+    else_branch_is_not_guarded = ast.parse(
+        "def f(m):\n"
+        "    if tracing.enabled():\n"
+        "        pass\n"
+        "    else:\n"
+        "        s = tracing.span(f'h.{m}')\n"
+    )
+    assert find_unguarded_dynamic_spans(else_branch_is_not_guarded) == [
+        (5, "span")
+    ]

@@ -174,7 +174,10 @@ def test_prometheus_exposition_format(tel):
     assert "cc_ops_total 2.0" in text
     assert "cc_http_GET_state_total 3.0" in text
     assert "cc_proposal_computation_timer_seconds_count 1.0" in text
-    assert 'quantile="0.99"' in text
+    # timers are true histograms now: log-spaced buckets + +Inf catch-all
+    assert "# TYPE cc_proposal_computation_timer_seconds histogram" in text
+    assert ('cc_proposal_computation_timer_seconds_bucket{le="+Inf"} 1.0'
+            in text)
     assert "cc_up 1.0" in text
     assert "broken" not in text
     # label escaping keeps the scrape parseable
