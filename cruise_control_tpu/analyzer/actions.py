@@ -51,6 +51,12 @@ class BalancingAction:
     #: JBOD: disk indices on the (single) broker for intra-broker moves
     source_disk: int = -1
     dest_disk: int = -1
+    #: decision provenance: the goal (or engine phase) that generated this
+    #: action and the pass/round it was committed in.  compare=False keeps
+    #: action equality/hashing purely positional — provenance is metadata,
+    #: two identical moves from different goals are still the same move.
+    goal: str = dataclasses.field(default="", compare=False)
+    round: int = dataclasses.field(default=-1, compare=False)
 
     def __str__(self) -> str:
         if self.action_type == ActionType.LEADERSHIP_MOVEMENT:

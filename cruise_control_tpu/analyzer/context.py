@@ -15,7 +15,7 @@ semantics, engine-checked for parity in tests.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Set
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
@@ -108,6 +108,37 @@ class AnalyzerContext:
 
         self._init_aggregates()
         self.actions: List[BalancingAction] = []
+        #: decision provenance: the goal pass currently mutating this
+        #: context (set by the optimizer drivers around each pass) — every
+        #: action applied while set is tagged with it, and rejections are
+        #: charged to it in ``pass_stats``
+        self.current_goal: str = ""
+        self.current_round: int = -1
+        #: per-pass accept/reject accounting:
+        #: {goal name: {"rejected": {categorical reason: count}}}
+        #: (accepted counts are derived from the action tags, so a swap
+        #: decomposed into two applies still counts once)
+        self.pass_stats: Dict[str, dict] = {}
+
+    # ---- decision provenance ----------------------------------------------------
+    def record_reject(self, reason: str) -> None:
+        """Charge one rejected candidate move to the current goal pass
+        under a categorical reason (capacity-exceeded, rack-violation,
+        no-improvement, swap-cap, excluded-broker)."""
+        g = self.current_goal
+        if not g:
+            return
+        st = self.pass_stats.setdefault(g, {"rejected": {}})
+        rej = st["rejected"]
+        rej[reason] = rej.get(reason, 0) + 1
+
+    def _tagged(self, action: BalancingAction) -> BalancingAction:
+        """Stamp the current pass's provenance onto an untagged action."""
+        if self.current_goal and not action.goal:
+            return dataclasses.replace(
+                action, goal=self.current_goal, round=self.current_round
+            )
+        return action
 
     # ---- masks ------------------------------------------------------------------
     @property
@@ -301,7 +332,7 @@ class AnalyzerContext:
             self.disk_load[b, d_src] -= dl
             self.disk_load[b, d_dst] += dl
             self.replica_offline[p, s] = False  # moved off a dead disk
-            self.actions.append(action)
+            self.actions.append(self._tagged(action))
             return
         if action.action_type == ActionType.INTER_BROKER_REPLICA_MOVEMENT:
             s, src, dst = action.slot, action.source_broker, action.dest_broker
@@ -378,11 +409,11 @@ class AnalyzerContext:
             self.apply(a2)
             self.actions.pop()
             self.actions.pop()
-            self.actions.append(action)
+            self.actions.append(self._tagged(action))
             return
         else:
             raise NotImplementedError(action.action_type)
-        self.actions.append(action)
+        self.actions.append(self._tagged(action))
 
     # ---- snapshots --------------------------------------------------------------
     def to_state(self, template: ClusterState) -> ClusterState:

@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from cruise_control_tpu.common.resources import EMPTY_SLOT
-from cruise_control_tpu.analyzer.actions import BalancingAction
+from cruise_control_tpu.analyzer.actions import ActionType, BalancingAction
 from cruise_control_tpu.analyzer.context import AnalyzerContext, OptimizationOptions
 from cruise_control_tpu.analyzer.goals.base import (
     BalancingConstraint,
@@ -156,6 +156,10 @@ class ExecutionProposal:
     #: disk ids while inside the analyzer, log-dir names once the facade has
     #: translated for the executor (upstream replicasToMoveBetweenDisksByBroker)
     disk_moves: tuple = ()
+    #: decision provenance: names of the goal passes (or engine phases)
+    #: whose actions touched this partition, in commit order — answers
+    #: "which goal generated this proposal" straight from the REST payload
+    goals: tuple = ()
 
     @property
     def has_replica_change(self) -> bool:
@@ -178,6 +182,7 @@ class ExecutionProposal:
             "oldReplicas": list(self.old_replicas),
             "newReplicas": list(self.new_replicas),
             "diskMoves": [list(m) for m in self.disk_moves],
+            "goals": list(self.goals),
         }
 
 
@@ -198,6 +203,9 @@ class OptimizerResult:
     execution: Optional[object] = None
     #: Provisioning hints from the final state (ProvisionResponse).
     provision: Optional[object] = None
+    #: Per-goal-pass decision provenance: [{goal, pass, accepted,
+    #: rejected: {reason: count}}] in pass order (both engines fill it).
+    goal_summaries: List[dict] = dataclasses.field(default_factory=list)
 
     @property
     def violation_score_before(self) -> int:
@@ -291,6 +299,9 @@ class OptimizerResult:
             # truncation indicator: the UI labels the table partial when
             # numBrokersChanged > len(brokerLoadDiff)
             "numBrokersChanged": len(bdiff),
+            # decision provenance: what each goal pass accepted/rejected
+            # and why — the "explain this plan per goal" card
+            "goalSummaries": self.goal_summaries,
             "violationsBefore": self.violations_before,
             "violationsAfter": self.violations_after,
             # reference-UI parity: per-goal before/after + ClusterModelStats
@@ -301,6 +312,51 @@ class OptimizerResult:
             "violationScoreAfter": self.violation_score_after,
             "durationSeconds": self.duration_s,
         }
+
+
+def goal_pass_summaries(
+    goals: Sequence[Goal], ctx: AnalyzerContext
+) -> List[dict]:
+    """Per-pass accepted/rejected accounting (decision provenance).
+
+    Accepted counts derive from the action tags (a swap decomposed into
+    two internal applies still counts once); reject counters with their
+    categorical reasons come straight from ``ctx.pass_stats``."""
+    accepted: Dict[str, int] = {}
+    for a in ctx.actions:
+        if a.goal:
+            accepted[a.goal] = accepted.get(a.goal, 0) + 1
+    out = []
+    for i, g in enumerate(goals):
+        st = ctx.pass_stats.get(g.name, {})
+        rejected = {
+            k: int(v) for k, v in sorted(st.get("rejected", {}).items())
+        }
+        out.append({
+            "goal": g.name,
+            "pass": i,
+            "accepted": int(accepted.get(g.name, 0)),
+            "rejected": rejected,
+        })
+    return out
+
+
+def _proposal_goals(ctx: AnalyzerContext) -> Dict[int, tuple]:
+    """{partition: (goal, ...)} — which goal passes touched each partition,
+    deduplicated in commit order (the attribution ``diff_proposals`` stamps
+    onto every ExecutionProposal)."""
+    by_p: Dict[int, dict] = {}
+    for a in ctx.actions:
+        if not a.goal:
+            continue
+        parts = (
+            (a.partition, a.swap_partition)
+            if a.action_type == ActionType.INTER_BROKER_REPLICA_SWAP
+            else (a.partition,)
+        )
+        for p in parts:
+            by_p.setdefault(int(p), {})[a.goal] = None  # ordered set
+    return {p: tuple(d) for p, d in by_p.items()}
 
 
 def diff_proposals(
@@ -317,6 +373,7 @@ def diff_proposals(
     post-search finalize time for a plan touching a few percent of
     partitions."""
     out: List[ExecutionProposal] = []
+    goals_by_p = _proposal_goals(ctx)
     old_leaders = np.take_along_axis(
         initial_assignment, initial_leader_slot[:, None], axis=1
     )[:, 0]
@@ -376,6 +433,7 @@ def diff_proposals(
                 old_replicas=tuple(old_replicas),
                 new_replicas=tuple(new_replicas),
                 disk_moves=tuple(disk_moves),
+                goals=goals_by_p.get(p, ()),
             )
         )
     return out
@@ -424,25 +482,39 @@ class GoalOptimizer:
         from cruise_control_tpu.telemetry import tracing
 
         optimized: List[Goal] = []
-        for goal in self.goals:
-            n_before = len(ctx.actions)
-            # per-goal pass span (goal.name is a static class attribute —
-            # no formatting on the disabled path)
-            with tracing.span("analyzer.goal", sub=goal.name):
-                goal.optimize(ctx, optimized)
-            if LOG.isEnabledFor(_logging.DEBUG):  # violations() is real work
-                LOG.debug(
-                    "%s: %d actions (violations %d -> %d)", goal.name,
-                    len(ctx.actions) - n_before, violations_before[goal.name],
-                    goal.violations(ctx),
-                )
-            if goal.is_hard and goal.violations(ctx) > 0:
-                LOG.error("hard goal %s still violated after optimization",
-                          goal.name)
-                raise OptimizationFailure(
-                    f"{goal.name} still violated after optimization"
-                )
-            optimized.append(goal)
+        try:
+            for i, goal in enumerate(self.goals):
+                n_before = len(ctx.actions)
+                # decision provenance: actions applied and candidates
+                # rejected during this pass are charged to it
+                ctx.current_goal, ctx.current_round = goal.name, i
+                # per-goal pass span (goal.name is a static class attribute —
+                # no formatting on the disabled path)
+                with tracing.span("analyzer.goal", sub=goal.name):
+                    goal.optimize(ctx, optimized)
+                if LOG.isEnabledFor(_logging.DEBUG):  # violations() is work
+                    LOG.debug(
+                        "%s: %d actions (violations %d -> %d)", goal.name,
+                        len(ctx.actions) - n_before,
+                        violations_before[goal.name], goal.violations(ctx),
+                    )
+                if goal.is_hard and goal.violations(ctx) > 0:
+                    LOG.error(
+                        "hard goal %s still violated after optimization",
+                        goal.name,
+                    )
+                    raise OptimizationFailure(
+                        f"{goal.name} still violated after optimization"
+                    )
+                optimized.append(goal)
+        except OptimizationFailure as e:
+            # a failed rebalance must stay diagnosable: ship the per-pass
+            # accounting gathered so far with the failure (the facade
+            # journals it)
+            e.goal_summaries = goal_pass_summaries(self.goals, ctx)
+            raise
+        finally:
+            ctx.current_goal, ctx.current_round = "", -1
 
         violations_after = {g.name: g.violations(ctx) for g in self.goals}
         final_state = ctx.to_state(state)
@@ -464,4 +536,5 @@ class GoalOptimizer:
             duration_s=time.perf_counter() - t0,
             engine="greedy",
             provision=provision,
+            goal_summaries=goal_pass_summaries(self.goals, ctx),
         )

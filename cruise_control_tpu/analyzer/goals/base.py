@@ -85,6 +85,11 @@ class Goal:
 
     name: str = "goal"
     is_hard: bool = False
+    #: categorical reject reason charged when THIS goal's acceptance check
+    #: is the one that eliminates every candidate destination (decision
+    #: provenance; the vocabulary is fixed: capacity-exceeded,
+    #: rack-violation, no-improvement, swap-cap, excluded-broker)
+    reject_reason: str = "no-improvement"
 
     def __init__(self, constraint: Optional[BalancingConstraint] = None):
         self.constraint = constraint or BalancingConstraint()
@@ -163,15 +168,25 @@ def accepted_move_dests(
     current: Goal,
     optimized: Sequence[Goal],
 ) -> np.ndarray:
-    """Destinations passing legality + current goal + all optimized goals."""
+    """Destinations passing legality + current goal + all optimized goals.
+
+    Provenance: when the mask empties, the rejection is charged to the
+    running pass (``ctx.current_goal``) under the categorical reason of
+    the goal whose check eliminated the last destination (structural
+    legality counts as ``excluded-broker``)."""
     ok = legal_move_dests(ctx, p, s)
     if not ok.any():
+        ctx.record_reject("excluded-broker")
         return ok
     ok &= current.accept_move(ctx, p, s)
+    if not ok.any():
+        ctx.record_reject(current.reject_reason)
+        return ok
     for g in optimized:
-        if not ok.any():
-            break
         ok &= g.accept_move(ctx, p, s)
+        if not ok.any():
+            ctx.record_reject(g.reject_reason)
+            break
     return ok
 
 
@@ -184,12 +199,19 @@ def accepted_leadership(
 ) -> bool:
     b = ctx.assignment[p, new_slot]
     if b == EMPTY_SLOT or not ctx.leadership_candidates()[b]:
+        ctx.record_reject("excluded-broker")
         return False
     if ctx.replica_offline[p, new_slot]:
+        ctx.record_reject("excluded-broker")
         return False
     if not current.accept_leadership(ctx, p, new_slot):
+        ctx.record_reject(current.reject_reason)
         return False
-    return all(g.accept_leadership(ctx, p, new_slot) for g in optimized)
+    for g in optimized:
+        if not g.accept_leadership(ctx, p, new_slot):
+            ctx.record_reject(g.reject_reason)
+            return False
+    return True
 
 
 def accepted_swap(
@@ -226,9 +248,17 @@ def accepted_swap(
         return False
     if ctx.is_leader(p2, s2) and not lead_ok[b1]:
         return False
+    # provenance: structural filters above run per candidate PAIR inside
+    # the partner scan and would swamp the per-replica counters; only the
+    # goal-semantic verdicts below are charged
     if not current.accept_swap(ctx, p1, s1, p2, s2):
+        ctx.record_reject(current.reject_reason)
         return False
-    return all(g.accept_swap(ctx, p1, s1, p2, s2) for g in optimized)
+    for g in optimized:
+        if not g.accept_swap(ctx, p1, s1, p2, s2):
+            ctx.record_reject(g.reject_reason)
+            return False
+    return True
 
 
 def swap_action(

@@ -35,6 +35,7 @@ class ReplicaCapacityGoal(Goal):
 
     name = "ReplicaCapacityGoal"
     is_hard = True
+    reject_reason = "capacity-exceeded"
 
     def _limit(self) -> int:
         return self.constraint.max_replicas_per_broker
@@ -93,6 +94,7 @@ class CapacityGoal(Goal):
 
     resource: Resource
     is_hard = True
+    reject_reason = "capacity-exceeded"
 
     def _limits(self, ctx: AnalyzerContext) -> np.ndarray:
         """f64 [B] — absolute load limit per broker."""
@@ -222,6 +224,7 @@ class CapacityGoal(Goal):
         a low-utilization broker; chained NET acceptance (hard-goal twin of
         the ResourceDistributionGoal fallback)."""
         if self._swap_attempts >= self.MAX_SWAP_ATTEMPTS_PER_PASS:
+            ctx.record_reject("swap-cap")
             return False
         self._swap_attempts += 1
         r = self.resource

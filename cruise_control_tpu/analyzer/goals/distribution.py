@@ -182,6 +182,7 @@ class ResourceDistributionGoal(Goal):
         fallback).  Partner replicas are tried smallest-first (largest net
         shed first); acceptance is the chained NET check."""
         if self._swap_attempts >= self.MAX_SWAP_ATTEMPTS_PER_PASS:
+            ctx.record_reject("swap-cap")
             return False
         self._swap_attempts += 1
         l1 = self._moved(ctx, p, s)
@@ -701,6 +702,7 @@ class BrokerSetAwareGoal(Goal):
 
     name = "BrokerSetAwareGoal"
     is_hard = True
+    reject_reason = "excluded-broker"
 
     def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
         t = int(ctx.partition_topic[p])

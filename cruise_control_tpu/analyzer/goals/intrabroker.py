@@ -47,6 +47,7 @@ class IntraBrokerDiskCapacityGoal(Goal):
 
     name = "IntraBrokerDiskCapacityGoal"
     is_hard = True
+    reject_reason = "capacity-exceeded"
 
     def _threshold(self) -> float:
         return self.constraint.capacity_threshold[Resource.DISK]

@@ -32,6 +32,7 @@ class KafkaAssignerEvenRackAwareGoal(Goal):
 
     name = "KafkaAssignerEvenRackAwareGoal"
     is_hard = True
+    reject_reason = "rack-violation"
 
     def _rack_totals(self, ctx: AnalyzerContext) -> np.ndarray:
         totals = np.zeros(ctx.num_brokers, np.int64)  # indexed by rack id

@@ -61,6 +61,7 @@ def _count_over_limit_racks(ctx: AnalyzerContext, limit: np.ndarray) -> int:
 class RackAwareGoal(Goal):
     name = "RackAwareGoal"
     is_hard = True
+    reject_reason = "rack-violation"
 
     def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
         used = _partition_rack_counts(ctx, p, skip_slot=s) > 0
@@ -108,6 +109,7 @@ class RackAwareGoal(Goal):
 class RackAwareDistributionGoal(Goal):
     name = "RackAwareDistributionGoal"
     is_hard = True
+    reject_reason = "rack-violation"
 
     def _alive_racks(self, ctx: AnalyzerContext) -> int:
         return len(set(ctx.broker_rack[ctx.broker_alive].tolist())) or 1

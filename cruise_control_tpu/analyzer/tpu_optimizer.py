@@ -1475,6 +1475,11 @@ class _HostEvaluator:
         self.dest_ok = ctx.dest_candidates()
         self.lead_ok = ctx.leadership_candidates()
         self.excluded = ctx.excluded_partition_mask()
+        #: decision provenance stamped onto every committed action: the
+        #: engine phase ("TpuSearch" / "TpuPolish") and the device
+        #: call/round it was committed in (the search loop advances these)
+        self.goal_tag = "TpuSearch"
+        self.round_index = 0
 
     def _cost(self, b: int, dload=0.0, dlnwin=0.0, dpot=0.0, drc=0.0, dlc=0.0,
               dcload=0.0):
@@ -1549,7 +1554,8 @@ class _HostEvaluator:
                 if (ctx.broker_rack[lower] == ctx.broker_rack[src]).any():
                     delta -= 1e4
             action = BalancingAction(
-                ActionType.INTER_BROKER_REPLICA_MOVEMENT, p, s, src, dst
+                ActionType.INTER_BROKER_REPLICA_MOVEMENT, p, s, src, dst,
+                goal=self.goal_tag, round=self.round_index,
             )
             return action, delta
 
@@ -1577,6 +1583,7 @@ class _HostEvaluator:
         action = BalancingAction(
             ActionType.LEADERSHIP_MOVEMENT,
             p, int(ctx.leader_slot[p]), src, dst, dest_slot=s,
+            goal=self.goal_tag, round=self.round_index,
         )
         return action, delta
 
@@ -1824,12 +1831,14 @@ class _HostEvaluator:
                 a = BalancingAction(
                     ActionType.INTER_BROKER_REPLICA_MOVEMENT,
                     int(pm[j]), int(sm[j]), int(srcs[j]), int(dsts[j]),
+                    goal=self.goal_tag, round=self.round_index,
                 )
             else:
                 a = BalancingAction(
                     ActionType.LEADERSHIP_MOVEMENT,
                     int(pm[j]), int(old_lslot[j]), int(srcs[j]), int(dsts[j]),
-                    dest_slot=int(sm[j]),
+                    dest_slot=int(sm[j]), goal=self.goal_tag,
+                    round=self.round_index,
                 )
             acts.append(a)
         ctx.actions.extend(acts)
@@ -2891,6 +2900,10 @@ class TpuGoalOptimizer:
         K, D = self._pool_sizes(P, S, B)
         evaluator = _HostEvaluator(ctx, cfg, can)
         actions: List[BalancingAction] = []
+        #: decision provenance: one entry per engine phase, same shape as
+        #: the greedy per-goal pass summaries ({goal, pass, accepted,
+        #: rejected: {reason: count}})
+        pass_summaries: List[dict] = []
 
         def budget_exhausted() -> bool:
             # anytime exit: only once the plan-so-far satisfies every hard
@@ -2980,6 +2993,7 @@ class TpuGoalOptimizer:
                     )
                     dsp.block(packed)
                 n_calls += 1
+                evaluator.round_index = n_calls
                 if t_cap is not None:
                     n_capped_calls += 1
                 with tracing.span("analyzer.fetch"):
@@ -3051,6 +3065,15 @@ class TpuGoalOptimizer:
                 "resident search: %d device calls, %d actions committed, "
                 "%d rejected", n_calls, n_committed, n_rejected,
             )
+            # host-recheck rejections are stale/non-improving device picks
+            pass_summaries.append({
+                "goal": "TpuSearch", "pass": len(pass_summaries),
+                "accepted": int(n_committed),
+                "rejected": (
+                    {"no-improvement": int(n_rejected)} if n_rejected else {}
+                ),
+                "rounds": int(n_calls),
+            })
             # polish: fall through to the score-only loop.  The device scan
             # batches per-src-broker candidates, whose coarser granularity
             # converges a few percent short of sequential search; the score-
@@ -3065,9 +3088,15 @@ class TpuGoalOptimizer:
             rounds_budget = cfg.max_rounds
 
         round_fn = self._make_round_fn(K, D)
-        for _ in range(rounds_budget):
+        # the score-only loop is "polish" after a resident search, or the
+        # primary search itself otherwise (score-only / columnar configs)
+        evaluator.goal_tag = "TpuPolish" if pass_summaries else "TpuSearch"
+        polish_accepted = polish_rejected = polish_rounds_run = 0
+        for round_idx in range(rounds_budget):
             if budget_exhausted():
                 break
+            evaluator.round_index = round_idx
+            polish_rounds_run += 1
             with tracing.device_span("analyzer.score") as dsp:
                 scores, k_top, p_top, s_top, d_top = _unpack_round_result(
                     np.asarray(dsp.block(round_fn(m, ca)))
@@ -3092,16 +3121,28 @@ class TpuGoalOptimizer:
                         int(d_top[i])
                     )
                     if action is None or delta >= cfg.improvement_tol:
+                        polish_rejected += 1
                         continue
                     ctx.apply(action)
                     actions.append(action)
                     batch += 1
                     if batch >= cfg.max_moves_per_round:
                         break
+            polish_accepted += batch
             if not batch:
                 break
             with tracing.device_span("analyzer.resync") as dsp:
                 m = dsp.block(_resync_device_model(m, ctx))
+        if polish_rounds_run:
+            pass_summaries.append({
+                "goal": evaluator.goal_tag, "pass": len(pass_summaries),
+                "accepted": int(polish_accepted),
+                "rejected": (
+                    {"no-improvement": int(polish_rejected)}
+                    if polish_rejected else {}
+                ),
+                "rounds": int(polish_rounds_run),
+            })
 
         # Host swap-repair pass: the device vocabulary is single moves +
         # leadership, whose feasibility mask rejects every destination on
@@ -3119,13 +3160,26 @@ class TpuGoalOptimizer:
                 for g in goals:
                     if not g.is_hard:
                         continue  # repair is a hard-goal pass only
+                    # provenance: the repair pass reuses the greedy goal
+                    # machinery, so its tagging/reject accounting applies
+                    ctx.current_goal = g.name
+                    ctx.current_round = len(pass_summaries) + len(repaired)
                     try:
                         g.optimize(ctx, repaired)
                     except Exception as e:  # leave the verdict to _finalize
                         LOG.warning("host swap-repair: %s: %s", g.name, e)
                     repaired.append(g)
+                ctx.current_goal, ctx.current_round = "", -1
                 new_actions = ctx.actions[n_before:]
                 actions.extend(new_actions)
+                from cruise_control_tpu.analyzer.goal_optimizer import (
+                    goal_pass_summaries,
+                )
+
+                offset = len(pass_summaries)
+                for ent in goal_pass_summaries(repaired, ctx):
+                    ent["pass"] += offset
+                    pass_summaries.append(ent)
                 LOG.info(
                     "host swap-repair pass committed %d actions for residual "
                     "hard violations", len(new_actions),
@@ -3134,12 +3188,13 @@ class TpuGoalOptimizer:
             return self._finalize(
                 state, ctx, goals, actions, violations_before, stats_before,
                 initial_assignment, initial_leader_slot, initial_replica_disk,
-                t0,
+                t0, pass_summaries,
             )
 
     def _finalize(
         self, state, ctx, goals, actions, violations_before, stats_before,
         initial_assignment, initial_leader_slot, initial_replica_disk, t0,
+        pass_summaries: Optional[List[dict]] = None,
     ) -> OptimizerResult:
         violations_after = {g.name: g.violations(ctx) for g in goals}
         # same contract as GoalOptimizer: a plan that leaves hard goals
@@ -3153,18 +3208,24 @@ class TpuGoalOptimizer:
                     "(before: %d)", g.name, violations_after[g.name],
                     violations_before[g.name],
                 )
-                raise OptimizationFailure(
+                e = OptimizationFailure(
                     f"{g.name} still violated after TPU search "
                     f"({violations_after[g.name]} violations)"
                 )
+                # diagnosability: ship the per-phase accounting with the
+                # failure (the facade journals it)
+                e.goal_summaries = list(pass_summaries or ())
+                raise e
         if ctx.replica_offline.any():
             LOG.error(
                 "%d offline replicas could not be evacuated",
                 int(ctx.replica_offline.sum()),
             )
-            raise OptimizationFailure(
+            e = OptimizationFailure(
                 "offline replicas could not be evacuated by TPU search"
             )
+            e.goal_summaries = list(pass_summaries or ())
+            raise e
         LOG.info(
             "TPU search done: %d actions, violations %d -> %d, %.2fs",
             len(actions), sum(violations_before.values()),
@@ -3192,4 +3253,5 @@ class TpuGoalOptimizer:
             provision=analyze_provisioning_arrays(
                 ctx.broker_alive, ctx.broker_load, ctx.broker_capacity
             ),
+            goal_summaries=list(pass_summaries or ()),
         )

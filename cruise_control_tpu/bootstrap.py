@@ -327,7 +327,7 @@ def build_app(
     place of dialing ``bootstrap.servers`` — the test seam.
     """
     cfg = config or CruiseControlConfig()
-    from cruise_control_tpu.telemetry import device_stats, tracing
+    from cruise_control_tpu.telemetry import device_stats, events, tracing
 
     tracing.configure(
         enabled=cfg.get_boolean("telemetry.enabled"),
@@ -339,6 +339,35 @@ def build_app(
         retrace_threshold=cfg.get_int(
             "telemetry.device.stats.retrace.threshold"
         ),
+    )
+    events.configure(
+        enabled=cfg.get_boolean("telemetry.events.enabled"),
+        path=cfg.get("telemetry.events.path") or "",
+        max_bytes=cfg.get_int("telemetry.events.max.bytes"),
+        max_files=cfg.get_int("telemetry.events.max.files"),
+        ring_size=cfg.get_int("telemetry.events.ring.size"),
+    )
+    if cfg.get_boolean("telemetry.logging.json"):
+        # structured JSON log lines sharing the event-journal field names
+        from cruise_control_tpu.utils import logging as cc_logging
+
+        cc_logging.configure(
+            level=cfg.get("logging.level"),
+            file=cfg.get("logging.file"),
+            json_lines=True,
+        )
+    # journal the effective config at startup: a postmortem must know what
+    # the server was actually running with (non-default keys only — the
+    # full surface is docs/CONFIGURATION.md)
+    overrides = {
+        name: cfg.get(name)
+        for name, key in cfg._def.keys().items()
+        if cfg.get(name) != key.default
+    }
+    events.emit(
+        "bootstrap.config",
+        numKeys=len(cfg._def.keys()),
+        overrides={k: overrides[k] for k in sorted(overrides)},
     )
     kafka_mode = kafka_wire is not None or bool(cfg.get("bootstrap.servers"))
     if kafka_mode:
@@ -484,6 +513,7 @@ def build_app(
             progress_check_interval_ms=cfg.get_int(
                 "execution.progress.check.interval.ms"
             ),
+            history_retention=cfg.get_int("execution.history.retention"),
         ),
         notifier=cfg.get_configured_instance("executor.notifier.class"),
         default_strategy=_movement_strategy(cfg),
@@ -637,6 +667,12 @@ def build_app(
             ),
             dump_dir=cfg.get("telemetry.recorder.dump.dir"),
             device_stats_source=device_stats.MONITOR.summary,
+            # merge the decision journal into the artifact: an incident
+            # dump carries the why alongside the numbers
+            events_source=(
+                (lambda: events.recent(limit=512))
+                if cfg.get_boolean("telemetry.events.enabled") else None
+            ),
         )
         detector.flight_recorder = flight_recorder
         flight_recorder.start()

@@ -458,6 +458,9 @@ def default_config_def() -> ConfigDef:
     d.define("execution.task.timeout.ticks", ConfigType.INT, 100,
              Importance.LOW, "Progress checks an in-flight move may take "
              "before being declared DEAD.", at_least(1), G)
+    d.define("execution.history.retention", ConfigType.INT, 64,
+             Importance.LOW, "ExecutionResults retained in the executor's "
+             "bounded history deque (was unbounded).", at_least(1), G)
     d.define("default.replication.throttle", ConfigType.DOUBLE, None,
              Importance.MEDIUM, "Replication throttle (bytes/s); None = off.",
              None, G)
@@ -785,6 +788,30 @@ def default_config_def() -> ConfigDef:
              Importance.LOW, "Distinct compiled argument shapes per "
              "logical function above which further compiles count as "
              "retraces (shape churn) and warn.", at_least(2), G)
+    d.define("telemetry.events.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Record the structured decision journal "
+             "(cc-tpu-events/1): optimize/execute lifecycle with per-goal "
+             "summaries, executor batches and task deaths, detector "
+             "decisions, startup config snapshot.  Served on GET /events "
+             "and merged into the flight-recorder artifact.", None, G)
+    d.define("telemetry.events.path", ConfigType.STRING, None,
+             Importance.MEDIUM, "Append-only JSONL file for the event "
+             "journal (a failed rebalance is reconstructable from this "
+             "file alone).  None keeps the journal in-memory only.",
+             None, G)
+    d.define("telemetry.events.max.bytes", ConfigType.INT, 16_777_216,
+             Importance.LOW, "Size-rotate the events file beyond this many "
+             "bytes (file -> file.1 -> ...).", at_least(4096), G)
+    d.define("telemetry.events.max.files", ConfigType.INT, 3,
+             Importance.LOW, "Rotated event files kept (the live file plus "
+             "max.files-1 predecessors).", at_least(1), G)
+    d.define("telemetry.events.ring.size", ConfigType.INT, 2048,
+             Importance.LOW, "Events retained in memory for GET /events "
+             "and the flight-recorder merge.", at_least(16), G)
+    d.define("telemetry.logging.json", ConfigType.BOOLEAN, False,
+             Importance.LOW, "Emit application logs as structured JSON "
+             "lines sharing the event-journal field names (ts/severity/"
+             "kind), so grep/jq work across both files.", None, G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a

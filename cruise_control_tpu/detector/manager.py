@@ -35,6 +35,7 @@ from cruise_control_tpu.detector.notifier import (
 )
 from cruise_control_tpu.executor.executor import OngoingExecutionError
 from cruise_control_tpu.server.progress import OperationProgress
+from cruise_control_tpu.telemetry import events
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("detector")
@@ -103,6 +104,10 @@ class AnomalyDetectorManager:
                 queue.extend(found)
             except Exception as e:  # a broken detector must not kill the loop
                 LOG.exception("%s detector failed", atype.value)
+                events.emit(
+                    "detector.detect_failed", severity="ERROR",
+                    detector=atype.value, error=repr(e),
+                )
                 with self._history_lock:
                     self._history.append({
                         "detector": atype.value,
@@ -156,6 +161,16 @@ class AnomalyDetectorManager:
                     record["action"] = "FIX_FAILED"
                     record["error"] = repr(e)
         final = record["action"]
+        # anomaly → decision → fix outcome, one journal record per anomaly
+        events.emit(
+            "detector.anomaly",
+            severity="ERROR" if final == "FIX_FAILED" else "INFO",
+            anomalyType=anomaly.anomaly_type.value,
+            description=anomaly.description,
+            action=final,
+            fixStarted=record["fixStarted"],
+            error=record.get("error"),
+        )
         with self._history_lock:
             self._by_action[final] = self._by_action.get(final, 0) + 1
             self._history.append(record)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Set
 
 from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
@@ -29,6 +30,7 @@ from cruise_control_tpu.executor.tasks import (
     TaskType,
 )
 from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
+from cruise_control_tpu.telemetry import events
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("executor")
@@ -75,6 +77,9 @@ class ExecutorConfig:
     #: wall-clock between progress checks for real (non-simulated) backends;
     #: the simulated backend advances per tick and ignores it
     progress_check_interval_ms: int = 10_000
+    #: ExecutionResults retained in ``Executor.history`` (the unbounded
+    #: list leaked on a long-running server; mirrors the task-log bound)
+    history_retention: int = 64
 
 
 @dataclasses.dataclass
@@ -113,7 +118,14 @@ class Executor:
         self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
         self._stop_requested = False
         self.planner: Optional[ExecutionTaskPlanner] = None
-        self.history: List[ExecutionResult] = []
+        #: bounded execution-result history (a long-running server used to
+        #: grow this list forever); readers snapshot via list(history)
+        self.history: deque = deque(
+            maxlen=max(1, self.config.history_retention)
+        )
+        #: monotonic execution counter (history is bounded, so len() no
+        #: longer identifies an execution)
+        self._execution_seq = 0
         #: bounded per-execution task log (the UI's execution-history
         #: drill-in: every move's terminal state; upstream exposes the same
         #: via ExecutorState verbose substates).  A plain LIST on purpose:
@@ -205,6 +217,13 @@ class Executor:
             len(planner.leader_tasks), len(planner.intra_tasks),
             planner.strategy.name,
         )
+        events.emit(
+            "executor.start", numProposals=len(proposals),
+            replicaTasks=len(planner.replica_tasks),
+            leaderTasks=len(planner.leader_tasks),
+            intraTasks=len(planner.intra_tasks),
+            strategy=planner.strategy.name,
+        )
         self.planner = planner
         # safety ceiling: replica moves beyond the cap are aborted up front
         # (in strategy order, so the cap keeps the highest-priority moves),
@@ -275,8 +294,9 @@ class Executor:
             )
             self.history.append(result)
             self._finished_movements += completed
+            self._execution_seq += 1
             self.execution_log.append({
-                "executionId": len(self.history),
+                "executionId": self._execution_seq,
                 "endedS": round(time.time(), 1),
                 "strategy": planner.strategy.name,
                 "numProposals": len(proposals),
@@ -304,6 +324,13 @@ class Executor:
                 "execution finished: %d completed / %d dead / %d aborted in "
                 "%d ticks%s", completed, dead, aborted, ticks,
                 " (STOPPED)" if result.stopped else "",
+            )
+            events.emit(
+                "executor.end",
+                severity="WARNING" if (dead or result.stopped) else "INFO",
+                executionId=self._execution_seq, completed=completed,
+                dead=dead, aborted=aborted, ticks=ticks,
+                stopped=result.stopped,
             )
             self._notify(result)
         return result
@@ -337,6 +364,8 @@ class Executor:
         self.state = (
             ExecutorStateValue.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
         )
+        events.emit("executor.phase", phase="replica_moves",
+                    pending=len(planner.replica_tasks))
         in_flight: Dict[int, ExecutionTask] = {}
         in_flight_per_broker: Dict[int, int] = {}
         ticks = 0
@@ -359,8 +388,10 @@ class Executor:
             if batch:
                 from cruise_control_tpu.telemetry import tracing
 
-                # one span per dispatched batch (not per tick): batch count
-                # is bounded by the plan, tick count is not
+                # one span + one event per dispatched batch (not per tick):
+                # batch count is bounded by the plan, tick count is not
+                events.emit("executor.batch", phase="replica_moves",
+                            moves=len(batch), tick=ticks)
                 with tracing.span("executor.batch") as sp:
                     sp.set("moves", len(batch))
                     reassignments = {
@@ -395,6 +426,13 @@ class Executor:
                         "%s", t.task_id, p, list(st.replicas),
                         list(t.proposal.new_replicas),
                     )
+                    events.emit(
+                        "executor.task_dead", severity="WARNING",
+                        taskId=t.task_id, partition=p,
+                        reason="replica-mismatch",
+                        actual=list(st.replicas),
+                        planned=list(t.proposal.new_replicas),
+                    )
                 t.transition(TaskState.COMPLETED if ok else TaskState.DEAD)
                 t.finished_tick = ticks
                 for b in t.participating_brokers:
@@ -407,6 +445,11 @@ class Executor:
                         "ticks", t.task_id, p,
                         self.config.task_timeout_ticks,
                     )
+                    events.emit(
+                        "executor.task_dead", severity="WARNING",
+                        taskId=t.task_id, partition=p, reason="timeout",
+                        timeoutTicks=self.config.task_timeout_ticks,
+                    )
                     t.transition(TaskState.DEAD)
                     t.finished_tick = ticks
                     in_flight.pop(p)
@@ -415,6 +458,11 @@ class Executor:
         # tick budget exhausted: nothing may stay non-terminal, or the result
         # would misreport an incomplete rebalance as success
         for t in in_flight.values():
+            events.emit(
+                "executor.task_dead", severity="WARNING",
+                taskId=t.task_id, partition=t.proposal.partition,
+                reason="tick-budget", maxTicks=max_ticks,
+            )
             t.transition(TaskState.DEAD)
             t.finished_tick = ticks
         for t in planner.replica_tasks:
@@ -424,6 +472,8 @@ class Executor:
 
     def _drive_leader_moves(self, planner: ExecutionTaskPlanner) -> None:
         self.state = ExecutorStateValue.LEADER_MOVEMENT_TASK_IN_PROGRESS
+        events.emit("executor.phase", phase="leader_moves",
+                    pending=len(planner.leader_tasks))
         while True:
             if self._stop_requested:
                 self.state = ExecutorStateValue.STOPPING_EXECUTION
@@ -436,6 +486,8 @@ class Executor:
             )
             if not batch:
                 return
+            events.emit("executor.batch", phase="leader_moves",
+                        moves=len(batch))
             elections = {
                 t.proposal.partition: t.proposal.new_leader for t in batch
             }
@@ -443,10 +495,17 @@ class Executor:
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS)
                 st = self.backend.partition_state(t.proposal.partition)
+                ok = st.leader == t.proposal.new_leader
+                if not ok:
+                    events.emit(
+                        "executor.task_dead", severity="WARNING",
+                        taskId=t.task_id, partition=t.proposal.partition,
+                        reason="leader-election-failed",
+                        actualLeader=st.leader,
+                        plannedLeader=t.proposal.new_leader,
+                    )
                 t.transition(
-                    TaskState.COMPLETED
-                    if st.leader == t.proposal.new_leader
-                    else TaskState.DEAD
+                    TaskState.COMPLETED if ok else TaskState.DEAD
                 )
 
     def _drive_intra_moves(self, planner: ExecutionTaskPlanner) -> None:
@@ -457,6 +516,8 @@ class Executor:
         self.state = (
             ExecutorStateValue.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
         )
+        events.emit("executor.phase", phase="intra_moves",
+                    pending=len(planner.intra_tasks))
         while True:
             if self._stop_requested:
                 self.state = ExecutorStateValue.STOPPING_EXECUTION
@@ -469,6 +530,8 @@ class Executor:
             )
             if not batch:
                 return
+            events.emit("executor.batch", phase="intra_moves",
+                        moves=len(batch))
             moves = {
                 t.proposal.partition: {
                     b: new_dir for b, _old, new_dir in t.proposal.disk_moves
@@ -497,6 +560,12 @@ class Executor:
                     break
                 if tick is None or waited == self.config.task_timeout_ticks:
                     for t in pending:
+                        events.emit(
+                            "executor.task_dead", severity="WARNING",
+                            taskId=t.task_id,
+                            partition=t.proposal.partition,
+                            reason="intra-move-timeout",
+                        )
                         t.transition(TaskState.DEAD)
                     break
                 tick()

@@ -44,7 +44,7 @@ from cruise_control_tpu.monitor.load_monitor import (
     ModelCompletenessRequirements,
 )
 from cruise_control_tpu.server.progress import OperationProgress
-from cruise_control_tpu.telemetry import tracing
+from cruise_control_tpu.telemetry import events, tracing
 from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.utils.metrics import DEFAULT_REGISTRY, MetricRegistry
 
@@ -329,19 +329,40 @@ class CruiseControl:
             operation, state.num_brokers, state.num_partitions,
             opt.__class__.__name__, dryrun,
         )
+        events.emit(
+            "optimize.start", operation=operation,
+            engine=opt.__class__.__name__, dryrun=dryrun,
+            brokers=state.num_brokers, partitions=state.num_partitions,
+        )
         with progress.step(f"Optimizing ({opt.__class__.__name__})"):
             # upstream GoalOptimizer's "proposal-computation-timer"
             with self.registry.timer("proposal-computation-timer"), \
                     tracing.span("facade.optimize"):
                 try:
                     result = opt.optimize(state, options)
-                except Exception:
+                except Exception as e:
                     LOG.exception("%s optimization failed", operation)
+                    # the diagnosability contract: a failed rebalance is
+                    # reconstructable from the journal alone — the failing
+                    # goal (in the error) + the per-pass reject accounting
+                    # the optimizer attached to the failure
+                    events.emit(
+                        "optimize.failed", severity="ERROR",
+                        operation=operation, error=repr(e),
+                        goalSummaries=getattr(e, "goal_summaries", None),
+                    )
                     raise
         LOG.info(
             "%s optimized: %d actions, %d proposals, %.2fs",
             operation, len(result.actions), len(result.proposals),
             result.duration_s,
+        )
+        events.emit(
+            "optimize.end", operation=operation, engine=result.engine,
+            numActions=len(result.actions),
+            numProposals=len(result.proposals),
+            durationS=round(result.duration_s, 3),
+            goalSummaries=result.goal_summaries,
         )
         self.registry.meter(f"operation.{operation.lower()}").mark()
         # the proposals leaving the facade always speak external (Kafka) ids —
@@ -353,6 +374,10 @@ class CruiseControl:
                 f"Executing {len(result.proposals)} proposals"
             ):
                 sizes = self._partition_sizes(state)
+                events.emit(
+                    "execute.start", operation=operation,
+                    numProposals=len(result.proposals),
+                )
                 with self.registry.timer("execution-timer"), \
                         tracing.span("facade.execute"):
                     result.execution = self.executor.execute_proposals(
@@ -364,6 +389,12 @@ class CruiseControl:
                 "%s executed: %d completed / %d dead / %d aborted in "
                 "%d ticks%s", operation, ex.completed, ex.dead, ex.aborted,
                 ex.ticks, " (STOPPED)" if ex.stopped else "",
+            )
+            events.emit(
+                "execute.end", operation=operation,
+                severity="WARNING" if (ex.dead or ex.stopped) else "INFO",
+                completed=ex.completed, dead=ex.dead, aborted=ex.aborted,
+                ticks=ex.ticks, stopped=ex.stopped,
             )
             # the cluster just changed; cached proposals and cached metadata
             # both describe a stale world

@@ -30,7 +30,7 @@ import numpy as np
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
 from cruise_control_tpu.server.purgatory import Purgatory
-from cruise_control_tpu.telemetry import tracing
+from cruise_control_tpu.telemetry import events, tracing
 from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.server.security import (  # re-exported (legacy import site)
     BasicSecurityProvider,
@@ -46,7 +46,7 @@ USER_TASK_HEADER = "User-Task-ID"
 
 GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
-    "user_tasks", "review_board", "metrics", "diagnostics",
+    "user_tasks", "review_board", "metrics", "diagnostics", "events",
 }
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
@@ -77,6 +77,7 @@ class CruiseControlHttpServer:
         purgatory_retention_s: float = 86_400.0,
         ui_path: Optional[str] = None,
         flight_recorder=None,
+        event_journal=None,
     ):
         self.cc = cruise_control
         self.host = host
@@ -91,6 +92,9 @@ class CruiseControlHttpServer:
         self.ui_path = ui_path
         #: telemetry/recorder.FlightRecorder serving GET /diagnostics
         self.flight_recorder = flight_recorder
+        #: telemetry/events.EventJournal serving GET /events (None falls
+        #: back to the process-wide events.JOURNAL at request time)
+        self.event_journal = event_journal
         self.purgatory = Purgatory(retention_s=purgatory_retention_s)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -300,6 +304,35 @@ class CruiseControlHttpServer:
                 extra_families=self._extra_metric_families(),
             )
             return self._send_text(handler, 200, body, CONTENT_TYPE)
+        if endpoint == "events":
+            # decision-provenance journal (docs/OBSERVABILITY.md): the
+            # structured what/why record — optimize/execute lifecycle with
+            # goal summaries, executor batches + task deaths, detector
+            # decisions.  `since` (unix seconds, exclusive) and `kind`
+            # (exact or dotted-prefix family) filter; `limit` paginates
+            # from the newest.
+            from cruise_control_tpu.telemetry import events as events_mod
+
+            journal = self.event_journal or events_mod.JOURNAL
+            if not journal.enabled:
+                return self._send(handler, 503, {
+                    "errorMessage": "event journal disabled "
+                                    "(telemetry.events.enabled=false?)"
+                })
+            since = params.get("since")
+            kind = params.get("kind")
+            limit = int(params.get("limit", 500))
+            matched = journal.recent(
+                since=float(since) if since is not None else None,
+                kind=kind or None,
+            )
+            evs = matched[-limit:] if limit >= 0 else matched
+            return self._send(handler, 200, {
+                "schema": events_mod.SCHEMA,
+                "numMatched": len(matched),
+                "numReturned": len(evs),
+                "events": evs,
+            })
         if endpoint == "diagnostics":
             # flight-recorder artifact: retained time series + the merged
             # anomaly journal (docs/OBSERVABILITY.md) — the crash-readable
@@ -462,6 +495,11 @@ class CruiseControlHttpServer:
             task = self.tasks.submit(
                 endpoint, lambda progress: fn(progress)
             )
+            # journal the operation ↔ User-Task-ID binding: operation
+            # events run on the worker thread (task_scope), this records
+            # who asked for what under which id
+            events.emit("http.task_submitted", operation=endpoint.upper(),
+                        task_id=task.task_id)
         except TooManyTasksError as e:
             if info is not None:
                 # the approval must survive a transient capacity rejection

@@ -16,6 +16,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
 from cruise_control_tpu.server.progress import OperationProgress
+from cruise_control_tpu.telemetry import events
 
 
 class UserTaskState:
@@ -91,7 +92,11 @@ class UserTaskManager:
 
         def run() -> None:
             try:
-                task.future.set_result(fn(task.progress))
+                # every journal event emitted on this worker thread carries
+                # the async protocol's User-Task-ID (events.task_scope is a
+                # thread-local; correlation without signature plumbing)
+                with events.task_scope(tid, endpoint.upper()):
+                    task.future.set_result(fn(task.progress))
             except BaseException as e:  # surfaced via the future
                 task.future.set_exception(e)
             finally:

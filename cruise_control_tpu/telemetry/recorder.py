@@ -31,6 +31,7 @@ Artifact schema (``SCHEMA``):
       "retention": <int>,
       "series": {"<kind:name>": {"kind": ..., "points": [[unix, v], ...]}},
       "events": [<anomaly journal records, merged, time-ordered>],
+      "journal": [<cc-tpu-events/1 decision records, when attached>],
       "deviceStats": {<device_stats.MONITOR.summary()>},
       ...extra keys the dump path merges in ("dumpReason")
     }
@@ -80,6 +81,7 @@ class FlightRecorder:
             Sequence[Callable[[], Dict[str, float]]]] = None,
         dump_dir: Optional[str] = None,
         device_stats_source: Optional[Callable[[], dict]] = None,
+        events_source: Optional[Callable[[], List[dict]]] = None,
     ):
         self.registry = registry
         self.interval_s = max(0.01, float(interval_s))
@@ -88,6 +90,10 @@ class FlightRecorder:
         self.extra_sources = list(extra_sources or ())
         self.dump_dir = dump_dir
         self.device_stats_source = device_stats_source
+        #: telemetry/events journal reader (cc-tpu-events/1 records) —
+        #: merged into the artifact as `journal` so an incident dump
+        #: carries the decision record alongside the numbers
+        self.events_source = events_source
         self._lock = threading.Lock()
         self._series: Dict[str, deque] = {}
         self._prev_cum: Dict[str, float] = {}
@@ -200,6 +206,11 @@ class FlightRecorder:
                 out["deviceStats"] = self.device_stats_source()
             except Exception:  # pragma: no cover - defensive
                 LOG.exception("flight-recorder device-stats source failed")
+        if self.events_source is not None:
+            try:
+                out["journal"] = list(self.events_source())
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("flight-recorder events source failed")
         if extra:
             out.update(extra)
         return out

@@ -1,0 +1,151 @@
+"""Artifact-schema contracts: every telemetry artifact producer is built
+LIVE and validated against the checked-in JSON-schema contract
+(tests/schemas/artifacts.schema.json), so silent field drift — a renamed,
+dropped, or retyped field — fails CI with the offending path instead of
+breaking postmortem tooling that reads committed artifacts.
+
+The validator implements the JSON-Schema subset the contract uses
+(type / properties / required / items / additionalProperties / enum);
+the build environment ships no ``jsonschema`` package and the subset
+keeps the contract readable.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+SCHEMAS = json.loads(
+    (pathlib.Path(__file__).parent / "schemas" / "artifacts.schema.json")
+    .read_text()
+)
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+    "null": type(None),
+}
+
+
+def validate(value, schema, path="$"):
+    """Raise AssertionError with the offending path on any mismatch."""
+    if "enum" in schema:
+        assert value in schema["enum"], \
+            f"{path}: {value!r} not in {schema['enum']}"
+    t = schema.get("type")
+    if t == "number":
+        assert isinstance(value, (int, float)) and not isinstance(
+            value, bool), f"{path}: expected number, got {type(value).__name__}"
+    elif t == "integer":
+        assert isinstance(value, int) and not isinstance(value, bool), \
+            f"{path}: expected integer, got {type(value).__name__}"
+    elif t is not None:
+        assert isinstance(value, _TYPES[t]), \
+            f"{path}: expected {t}, got {type(value).__name__}"
+    if isinstance(value, dict):
+        props = schema.get("properties", {})
+        for req in schema.get("required", ()):
+            assert req in value, f"{path}: missing required field {req!r}"
+        extra = schema.get("additionalProperties", True)
+        for k, v in value.items():
+            if k in props:
+                validate(v, props[k], f"{path}.{k}")
+            elif isinstance(extra, dict):
+                validate(v, extra, f"{path}.{k}")
+            else:
+                assert extra is not False, \
+                    f"{path}: unexpected field {k!r} (closed schema — " \
+                    f"extend tests/schemas/artifacts.schema.json first)"
+    if isinstance(value, list) and "items" in schema:
+        for i, v in enumerate(value):
+            validate(v, schema["items"], f"{path}[{i}]")
+
+
+# ---- live producers --------------------------------------------------------------
+def _phase_profile_artifact():
+    from cruise_control_tpu.telemetry import profile
+    from cruise_control_tpu.telemetry.tracing import Telemetry
+
+    tel = Telemetry(enabled=True)
+    with tel.span("facade.rebalance"):
+        with tel.span("analyzer.scan"):
+            pass
+        with tel.span("analyzer.apply"):
+            pass
+    return [profile.make_artifact(tel=tel),
+            profile.make_artifact(extra={"fixture": "50b/1k"}, tel=tel)]
+
+
+def _flight_recorder_artifacts():
+    from cruise_control_tpu.telemetry.events import EventJournal
+    from cruise_control_tpu.telemetry.recorder import FlightRecorder
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    reg = MetricRegistry()
+    reg.counter("ops").inc(3)
+    reg.gauge("depth", lambda: 7.0)
+    reg.timer("op-timer").update(0.01)
+    journal = EventJournal(enabled=True)
+    journal.emit("optimize.start", operation="REBALANCE", engine="greedy")
+    rec = FlightRecorder(
+        reg, interval_s=60.0, retention=16,
+        journal_source=lambda: [{"timeMs": 1, "action": "IGNORE"}],
+        events_source=lambda: journal.recent(),
+    )
+    rec.sample_once(now=100.0)
+    rec.sample_once(now=105.0)
+    return [rec.artifact(), rec.artifact(extra={"dumpReason": "FIX_FAILED"})]
+
+
+def _event_records(tmp_path):
+    from cruise_control_tpu.telemetry.events import EventJournal
+
+    path = tmp_path / "events.jsonl"
+    journal = EventJournal(enabled=True, path=str(path))
+    journal.emit("optimize.start", operation="REBALANCE",
+                 engine="GoalOptimizer", dryrun=True)
+    journal.emit("executor.task_dead", severity="WARNING", task_id="t-1",
+                 partition=3, reason="timeout")
+    journal.emit("detector.anomaly")  # minimal record: no optional fields
+    ring = journal.recent()
+    on_disk = [json.loads(line) for line in
+               path.read_text().strip().splitlines()]
+    journal.close()
+    assert len(ring) == len(on_disk) == 3
+    return ring + on_disk
+
+
+@pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
+                                      "events"])
+def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
+    if producer == "phase-profile":
+        arts = _phase_profile_artifact()
+        schema = SCHEMAS["cc-tpu-phase-profile/1"]
+    elif producer == "flight-recorder":
+        arts = _flight_recorder_artifacts()
+        schema = SCHEMAS["cc-tpu-flight-recorder/1"]
+    else:
+        arts = _event_records(tmp_path)
+        schema = SCHEMAS["cc-tpu-events/1"]
+    for art in arts:
+        # every artifact must round-trip as plain JSON (numpy scalars or
+        # other non-JSON types in a payload are drift too)
+        validate(json.loads(json.dumps(art)), schema)
+
+
+def test_validator_catches_drift():
+    """The contract has teeth: drop / retype / extend each fails."""
+    schema = SCHEMAS["cc-tpu-events/1"]
+    good = {"schema": "cc-tpu-events/1", "ts": 1.0, "kind": "a.b",
+            "severity": "INFO"}
+    validate(good, schema)
+    with pytest.raises(AssertionError, match="missing required"):
+        validate({k: v for k, v in good.items() if k != "ts"}, schema)
+    with pytest.raises(AssertionError, match="expected number"):
+        validate({**good, "ts": "yesterday"}, schema)
+    with pytest.raises(AssertionError, match="closed schema"):
+        validate({**good, "novel_field": 1}, schema)
+    with pytest.raises(AssertionError, match="not in"):
+        validate({**good, "severity": "FATAL"}, schema)

@@ -1,0 +1,370 @@
+"""Decision-provenance layer tests (PR-3): the ``cc-tpu-events/1``
+structured journal (emit/filter/rotation/correlation), the lifecycle
+hooks (facade, executor, detector), goal attribution on actions /
+proposals / ``goalSummaries``, the ``GET /events`` server contract, and
+the diagnosability contract — a failed rebalance must be reconstructable
+from the events JSONL file ALONE."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.analyzer.goal_optimizer import (
+    GoalOptimizer,
+    make_goals,
+)
+from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.server import CruiseControlHttpServer
+from cruise_control_tpu.telemetry import events
+from cruise_control_tpu.telemetry.events import SCHEMA, EventJournal
+
+from harness import full_stack
+
+
+@pytest.fixture
+def journal(tmp_path):
+    """The process-wide journal, file-backed and enabled for one test."""
+    path = tmp_path / "events.jsonl"
+    events.configure(enabled=True, path=str(path))
+    events.reset()
+    events.configure(enabled=True)  # reset() closed the file; keep path
+    yield events.JOURNAL, path
+    events.reset()
+    events.configure(enabled=False, path="")
+
+
+# ---- journal mechanics ----------------------------------------------------------
+def test_emit_recent_since_kind_and_limit_filters():
+    j = EventJournal(enabled=True)
+    j.emit("optimize.start", operation="REBALANCE")
+    j.emit("executor.batch", moves=3)
+    j.emit("executor.task_dead", severity="WARNING")
+    j.emit("detector.anomaly")
+    assert [e["kind"] for e in j.recent(kind="executor")] == [
+        "executor.batch", "executor.task_dead",
+    ]
+    assert [e["kind"] for e in j.recent(kind="executor.batch")] == [
+        "executor.batch"
+    ]
+    # dotted-prefix match, not substring: "exec" is not a family
+    assert j.recent(kind="exec") == []
+    ts = j.recent(kind="executor.batch")[0]["ts"]
+    assert all(e["ts"] > ts for e in j.recent(since=ts))
+    assert len(j.recent(limit=2)) == 2
+    assert j.recent(limit=2)[-1]["kind"] == "detector.anomaly"
+
+
+def test_disabled_journal_is_noop_and_ring_is_bounded():
+    j = EventJournal(enabled=False)
+    j.emit("optimize.start")
+    assert j.recent() == []
+    j = EventJournal(enabled=True, ring_size=32)
+    for _ in range(100):
+        j.emit("executor.batch")
+    assert len(j.recent()) == 32
+
+
+def test_file_persistence_and_size_rotation(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    j = EventJournal(enabled=True, path=str(path), max_bytes=4096,
+                     max_files=3)
+    for i in range(200):
+        j.emit("executor.batch", moves=i, pad="x" * 64)
+    j.close()
+    rotated = sorted(p.name for p in tmp_path.iterdir())
+    assert "ev.jsonl" in rotated and "ev.jsonl.1" in rotated
+    assert "ev.jsonl.3" not in rotated  # max_files bounds the chain
+    for p in tmp_path.iterdir():
+        for line in p.read_text().strip().splitlines():
+            rec = json.loads(line)  # every line is one valid record
+            assert rec["schema"] == SCHEMA
+
+
+def test_task_scope_correlates_thread_local_emits():
+    j = EventJournal(enabled=True)
+    with j.task_scope("task-42", "REBALANCE"):
+        j.emit("optimize.start")
+        j.emit("optimize.end", operation="EXPLICIT")
+    j.emit("detector.anomaly")
+    evs = j.recent()
+    assert evs[0]["taskId"] == "task-42"
+    assert evs[0]["operation"] == "REBALANCE"
+    assert evs[1]["operation"] == "EXPLICIT"  # explicit beats scope
+    assert "taskId" not in evs[2]
+
+
+# ---- lifecycle hooks ------------------------------------------------------------
+def test_facade_and_executor_emit_lifecycle_events(journal):
+    j, path = journal
+    cc, _, _ = full_stack()
+    cc.rebalance(dryrun=False)
+    kinds = [e["kind"] for e in j.recent()]
+    for expected in ("optimize.start", "optimize.end", "execute.start",
+                     "executor.start", "executor.phase", "executor.batch",
+                     "executor.end", "execute.end"):
+        assert expected in kinds, (expected, kinds)
+    end = j.recent(kind="optimize.end")[-1]
+    assert end["operation"] == "REBALANCE"
+    summaries = end["payload"]["goalSummaries"]
+    assert [s["goal"] for s in summaries] == [
+        g.name for g in make_goals(constraint=cc.constraint)
+    ]
+    assert sum(s["accepted"] for s in summaries) == \
+        end["payload"]["numActions"]
+
+
+def test_executor_task_death_is_journaled(journal):
+    j, _ = journal
+    from tests.test_executor import make_backend, prop
+
+    backend, assignment, _ = make_backend(failed_brokers={3})
+    cfg = ExecutorConfig(task_timeout_ticks=5)
+    p = prop(0, assignment[0], [assignment[0][0], 3])  # 3 never catches up
+    result = Executor(backend, cfg).execute_proposals([p])
+    assert result.dead == 1
+    deaths = j.recent(kind="executor.task_dead")
+    assert len(deaths) == 1
+    assert deaths[0]["payload"]["reason"] == "timeout"
+    assert deaths[0]["payload"]["partition"] == 0
+    end = j.recent(kind="executor.end")[-1]
+    assert end["severity"] == "WARNING" and end["payload"]["dead"] == 1
+
+
+def test_detector_decisions_are_journaled(journal):
+    j, _ = journal
+    from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+    from cruise_control_tpu.detector.notifier import (
+        AnomalyNotificationResult,
+    )
+    from tests.test_observability import (
+        _StubAnomaly,
+        _StubCC,
+        _StubNotifier,
+    )
+
+    mgr = AnomalyDetectorManager(
+        _StubCC(), detectors={},
+        notifier=_StubNotifier(AnomalyNotificationResult.FIX),
+    )
+    mgr._handle(_StubAnomaly(1), now_ms=1000)            # fix succeeds
+    mgr._handle(_StubAnomaly(2, fail=True), now_ms=10**9)  # fix explodes
+    evs = j.recent(kind="detector.anomaly")
+    assert len(evs) == 2
+    assert evs[0]["payload"]["anomalyType"] == "GOAL_VIOLATION"
+    assert evs[0]["payload"]["action"] == "FIX"
+    assert evs[0]["payload"]["fixStarted"] is True
+    assert evs[1]["severity"] == "ERROR"
+    assert evs[1]["payload"]["action"] == "FIX_FAILED"
+    assert "fix exploded" in evs[1]["payload"]["error"]
+
+
+# ---- goal attribution -----------------------------------------------------------
+def test_actions_and_proposals_carry_goal_attribution():
+    cc, _, _ = full_stack()
+    res = cc.rebalance(dryrun=True)
+    assert res.actions, "the skewed fixture always yields moves"
+    for a in res.actions:
+        assert a.goal, f"untagged action {a}"
+        assert a.round >= 0
+    goal_names = {g.name for g in make_goals(constraint=cc.constraint)}
+    assert {a.goal for a in res.actions} <= goal_names
+    assert res.proposals
+    for p in res.proposals:
+        assert p.goals, f"unattributed proposal P{p.partition}"
+        assert set(p.goals) <= goal_names
+        assert p.to_json()["goals"] == list(p.goals)
+    # summary carries the per-pass accounting in pass order
+    s = res.summary()
+    assert [e["pass"] for e in s["goalSummaries"]] == list(
+        range(len(s["goalSummaries"]))
+    )
+
+
+def test_tpu_engine_reports_pass_summaries():
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(seed=7, num_brokers=8, num_racks=4,
+                           num_partitions=48)
+    res = TpuGoalOptimizer(
+        config=TpuSearchConfig(max_rounds=20, steps_per_call=16)
+    ).optimize(state)
+    assert res.goal_summaries, "engine phases must be summarized"
+    assert res.goal_summaries[0]["goal"] == "TpuSearch"
+    assert res.goal_summaries[0]["accepted"] == sum(
+        1 for a in res.actions if a.goal == "TpuSearch"
+    )
+    assert {a.goal for a in res.actions} <= {
+        "TpuSearch", "TpuPolish",
+    } | {g.name for g in make_goals()}
+
+
+def test_capacity_infeasible_greedy_reports_reject_reasons():
+    """The per-pass reject accounting rides the OptimizationFailure."""
+    cc, _, _ = full_stack()
+    cc.constraint.capacity_threshold[Resource.DISK] = 1e-6
+    with pytest.raises(OptimizationFailure) as ei:
+        cc.rebalance(dryrun=True)
+    summaries = ei.value.goal_summaries
+    disk = next(s for s in summaries if s["goal"] == "DiskCapacityGoal")
+    assert disk["rejected"].get("capacity-exceeded", 0) > 0
+
+
+# ---- the diagnosability contract ------------------------------------------------
+def test_failed_rebalance_is_reconstructable_from_journal_file(journal):
+    """Acceptance criterion: a deliberately failed rebalance
+    (capacity-infeasible fixture) is diagnosable from the events JSONL
+    alone — this test reads ONLY the journal file."""
+    _, path = journal
+    cc, _, _ = full_stack()
+    cc.constraint.capacity_threshold[Resource.DISK] = 1e-6
+    with pytest.raises(OptimizationFailure):
+        cc.rebalance(dryrun=False)
+
+    recs = [json.loads(line) for line in
+            path.read_text().strip().splitlines()]
+    start = [r for r in recs if r["kind"] == "optimize.start"]
+    failed = [r for r in recs if r["kind"] == "optimize.failed"]
+    assert start and failed
+    assert start[0]["operation"] == "REBALANCE"
+    f = failed[0]
+    assert f["severity"] == "ERROR"
+    # the goal that emitted the failure is named in the error...
+    assert "DiskCapacityGoal" in f["payload"]["error"]
+    # ...and the reject reasons seen during its pass are recorded
+    disk = next(s for s in f["payload"]["goalSummaries"]
+                if s["goal"] == "DiskCapacityGoal")
+    assert disk["rejected"].get("capacity-exceeded", 0) > 0
+    # no execution ever started for the failed plan
+    assert not any(r["kind"] == "execute.start" for r in recs)
+
+
+# ---- GET /events server contract ------------------------------------------------
+@pytest.fixture
+def server(journal):
+    cc, _, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get_json(srv, path):
+    with urllib.request.urlopen(f"{srv.url}/{path}") as r:
+        return json.loads(r.read().decode()), r.status
+
+
+def test_events_endpoint_filters_and_schema(server, journal):
+    j, _ = journal
+    j.emit("optimize.start", operation="REBALANCE")
+    j.emit("executor.batch", moves=2)
+    j.emit("executor.batch", moves=3)
+    body, status = _get_json(server, "events")
+    assert status == 200
+    assert body["schema"] == SCHEMA
+    assert body["numMatched"] == 3 and len(body["events"]) == 3
+    body, _ = _get_json(server, "events?kind=executor")
+    assert [e["kind"] for e in body["events"]] == [
+        "executor.batch", "executor.batch",
+    ]
+    since = body["events"][0]["ts"]
+    body, _ = _get_json(server, f"events?since={since}")
+    assert all(e["ts"] > since for e in body["events"])
+    body, _ = _get_json(server, "events?limit=1")
+    assert body["numMatched"] == 3 and body["numReturned"] == 1
+    assert body["events"][0]["payload"]["moves"] == 3  # newest kept
+
+
+def test_events_endpoint_503_when_disabled(server):
+    events.configure(enabled=False)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{server.url}/events")
+        assert ei.value.code == 503
+    finally:
+        events.configure(enabled=True)
+
+
+def test_async_rebalance_events_carry_user_task_id(server, journal):
+    j, _ = journal
+    req = urllib.request.Request(
+        f"{server.url}/rebalance?dryrun=true&get_response_timeout_s=30",
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        tid = r.headers["User-Task-ID"]
+        assert r.status == 200
+    submitted = j.recent(kind="http.task_submitted")
+    assert submitted and submitted[0]["taskId"] == tid
+    assert submitted[0]["operation"] == "REBALANCE"
+    for e in j.recent(kind="optimize"):
+        assert e["taskId"] == tid, e  # worker-thread scope correlation
+
+
+# ---- satellites -----------------------------------------------------------------
+def test_executor_history_is_bounded_and_ids_stay_monotonic():
+    from tests.test_executor import make_backend, prop
+
+    backend, assignment, _ = make_backend()
+    ex = Executor(backend, ExecutorConfig(history_retention=3))
+    for i in range(5):
+        old = [b for b in backend.partition_state(0).replicas]
+        new = [old[0], (old[1] + 1) % 4]
+        if new[1] in old:
+            new = [old[0], (old[1] + 2) % 4]
+        ex.execute_proposals([prop(0, old, new)])
+    assert len(ex.history) == 3
+    assert ex.history.maxlen == 3
+    # executionIds keep counting past the bound
+    assert ex.execution_log[-1]["executionId"] == 5
+
+
+def test_flight_recorder_merges_event_journal(journal):
+    j, _ = journal
+    from cruise_control_tpu.telemetry.recorder import FlightRecorder
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    j.emit("optimize.start", operation="REBALANCE")
+    rec = FlightRecorder(MetricRegistry(), interval_s=60.0,
+                         events_source=lambda: j.recent(limit=10))
+    art = rec.artifact()
+    assert art["journal"][-1]["kind"] == "optimize.start"
+
+
+def test_json_logging_shares_event_field_names(tmp_path, journal):
+    import logging
+
+    from cruise_control_tpu.utils.logging import (
+        JsonLineFormatter,
+        configure,
+        get_logger,
+    )
+
+    log_file = tmp_path / "cc.log"
+    configure(level="INFO", file=str(log_file), json_lines=True)
+    try:
+        get_logger("executor").warning("task %d DEAD", 7)
+        for h in logging.getLogger("cruise_control_tpu").handlers:
+            h.flush()
+        rec = json.loads(log_file.read_text().strip().splitlines()[-1])
+        # shared vocabulary with cc-tpu-events/1: ts / severity / kind
+        assert rec["severity"] == "WARNING"
+        assert rec["kind"] == "log.executor"
+        assert isinstance(rec["ts"], float)
+        assert rec["message"] == "task 7 DEAD"
+        ev = events.JOURNAL
+        ev.emit("executor.task_dead", severity="WARNING")
+        shared = {"ts", "severity", "kind"}
+        assert shared <= set(rec) and shared <= set(ev.recent()[-1])
+        assert isinstance(JsonLineFormatter().format(
+            logging.LogRecord("x", logging.INFO, "f", 1, "m", (), None)
+        ), str)
+    finally:
+        configure(level="INFO")  # restore stderr handler

@@ -1,12 +1,17 @@
-"""Span-hygiene static check.
+"""Span- and event-hygiene static checks.
 
-docs/OBSERVABILITY.md states the rule: span names must be static — any
+docs/OBSERVABILITY.md states the rules: span names must be static — any
 f-string name construction (positional name or ``sub=``) at a
 ``span()``/``device_span()`` call site must be guarded by
 ``tracing.enabled()``, so the disabled path never pays for string
-formatting on a hot path.  Until now nothing enforced it; this test scans
-every module in ``cruise_control_tpu/`` with the ast so a violation fails
-CI with the offending file:line."""
+formatting on a hot path.  The same discipline applies to event *kinds*
+at ``events.emit()`` call sites: a dynamic kind mints unbounded journal
+vocabulary (label-cardinality explosion in every ``kind=``-filtered
+consumer), so an f-string kind must sit behind an ``enabled()`` guard —
+and in practice should simply be a static dotted string with the dynamic
+part in the payload.  This test scans every module in
+``cruise_control_tpu/`` with the ast so a violation fails CI with the
+offending file:line."""
 
 import ast
 import pathlib
@@ -14,6 +19,7 @@ import pathlib
 PKG = pathlib.Path(__file__).resolve().parent.parent / "cruise_control_tpu"
 
 SPAN_FUNCS = {"span", "device_span"}
+EVENT_FUNCS = {"emit"}
 
 
 def _is_enabled_call(node: ast.AST) -> bool:
@@ -43,9 +49,9 @@ def _guard_tests(ancestors):
                 yield v
 
 
-def find_unguarded_dynamic_spans(tree: ast.AST):
-    """(lineno, source_hint) for every span()/device_span() call that
-    builds an f-string name without an enclosing enabled() guard."""
+def _find_unguarded_dynamic_calls(tree: ast.AST, func_names):
+    """(lineno, func_name) for every call to one of ``func_names`` that
+    builds an f-string argument without an enclosing enabled() guard."""
     parents = {}
     for node in ast.walk(tree):
         for child in ast.iter_child_nodes(node):
@@ -57,7 +63,7 @@ def find_unguarded_dynamic_spans(tree: ast.AST):
         f = node.func
         name = (f.attr if isinstance(f, ast.Attribute)
                 else getattr(f, "id", None))
-        if name not in SPAN_FUNCS:
+        if name not in func_names:
             continue
         dynamic = any(
             isinstance(a, ast.JoinedStr) for a in node.args
@@ -81,6 +87,23 @@ def find_unguarded_dynamic_spans(tree: ast.AST):
     return offenders
 
 
+def find_unguarded_dynamic_spans(tree: ast.AST):
+    """(lineno, source_hint) for every span()/device_span() call that
+    builds an f-string name without an enclosing enabled() guard."""
+    return _find_unguarded_dynamic_calls(tree, SPAN_FUNCS)
+
+
+def find_unguarded_dynamic_event_kinds(tree: ast.AST):
+    """(lineno, source_hint) for every emit() call that builds an
+    f-string argument (kind or payload value) without an enabled() guard.
+
+    Scope note: payload f-strings are flagged too — on the disabled path
+    emit()'s arguments are still evaluated, so the formatting cost rule is
+    the same as for span names; put dynamic values in the payload as raw
+    kwargs, not pre-formatted strings."""
+    return _find_unguarded_dynamic_calls(tree, EVENT_FUNCS)
+
+
 def test_no_unguarded_fstring_span_names_in_package():
     violations = []
     for path in sorted(PKG.rglob("*.py")):
@@ -92,6 +115,21 @@ def test_no_unguarded_fstring_span_names_in_package():
         "f-string span names must be guarded by tracing.enabled() "
         "(docs/OBSERVABILITY.md) — pass static names and route dynamic "
         "parts through sub= inside a guard:\n" + "\n".join(violations)
+    )
+
+
+def test_no_unguarded_fstring_event_kinds_in_package():
+    violations = []
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for lineno, fn in find_unguarded_dynamic_event_kinds(tree):
+            violations.append(f"{path.relative_to(PKG.parent)}:{lineno} "
+                              f"({fn} with f-string argument)")
+    assert not violations, (
+        "event kinds must be static dotted strings (journal cardinality "
+        "stays bounded; docs/OBSERVABILITY.md) — put dynamic values in "
+        "the payload as raw kwargs, inside an events.enabled() guard if "
+        "formatting is unavoidable:\n" + "\n".join(violations)
     )
 
 
@@ -140,3 +178,30 @@ def test_checker_accepts_guarded_forms():
     assert find_unguarded_dynamic_spans(else_branch_is_not_guarded) == [
         (5, "span")
     ]
+
+
+def test_checker_flags_unguarded_fstring_event_kind():
+    bad = ast.parse(
+        "def f(op):\n"
+        "    events.emit(f'optimize.{op}', operation=op)\n"
+    )
+    assert find_unguarded_dynamic_event_kinds(bad) == [(2, "emit")]
+    bad_payload = ast.parse(
+        "def f(op):\n"
+        "    events.emit('optimize.start', detail=f'op={op}')\n"
+    )
+    assert find_unguarded_dynamic_event_kinds(bad_payload) == [(2, "emit")]
+
+
+def test_checker_accepts_static_and_guarded_event_kinds():
+    static = ast.parse(
+        "def f(op):\n"
+        "    events.emit('optimize.start', operation=op)\n"
+    )
+    assert find_unguarded_dynamic_event_kinds(static) == []
+    guarded = ast.parse(
+        "def f(op):\n"
+        "    if events.enabled():\n"
+        "        events.emit('optimize.start', detail=f'op={op}')\n"
+    )
+    assert find_unguarded_dynamic_event_kinds(guarded) == []
