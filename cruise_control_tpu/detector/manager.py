@@ -149,7 +149,10 @@ class AnomalyDetectorManager:
                              anomaly.description)
                     anomaly.fix(self.cc, progress)
                     record["fixStarted"] = True
-                    self._last_fix_ms = now_ms
+                    # _last_fix_ms is read by state_summary() on HTTP
+                    # worker threads — same lock as the journal
+                    with self._history_lock:
+                        self._last_fix_ms = now_ms
                     LOG.info("self-healing fix finished: %s",
                              anomaly.anomaly_type.value)
                 except OngoingExecutionError:
@@ -218,6 +221,8 @@ class AnomalyDetectorManager:
             return dict(self._by_action)
 
     def state_summary(self) -> dict:
+        with self._history_lock:
+            last_fix_ms = self._last_fix_ms
         return {
             "selfHealingEnabled": {
                 t.value: on
@@ -225,7 +230,7 @@ class AnomalyDetectorManager:
             },
             "recentAnomalies": self.journal()[-10:],
             "metrics": self.action_counts(),
-            "lastFixMs": self._last_fix_ms,
+            "lastFixMs": last_fix_ms,
             "detectors": [t.value for t in self.detectors],
         }
 

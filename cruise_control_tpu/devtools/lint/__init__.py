@@ -1,0 +1,47 @@
+"""cclint — the repo-native static-analysis pass.
+
+Rule-based AST lint for the invariants this codebase enforces by
+convention: lock discipline in its threaded daemons, host-sync and
+retrace hygiene in its jitted hot paths, closure of the config surface
+across code/registry/docs, static observability names, and loud daemon
+loops.  ``docs/STATIC_ANALYSIS.md`` describes every rule, the CLI, and
+the suppression policy; ``tests/test_cclint.py`` runs the pass over the
+package as a tier-1 test with a zero-findings contract.
+
+Usage::
+
+    python -m cruise_control_tpu.devtools.lint [paths] \
+        [--format=text|json] [--rule=id[,id]] [--changed-only]
+"""
+
+from cruise_control_tpu.devtools.lint.context import FileContext
+from cruise_control_tpu.devtools.lint.driver import (
+    RULES,
+    SCHEMA,
+    LintResult,
+    collect_files,
+    default_target,
+    render,
+    run_lint,
+)
+from cruise_control_tpu.devtools.lint.findings import (
+    BAD_SUPPRESSION,
+    Finding,
+    Suppressions,
+    parse_suppressions,
+)
+
+__all__ = [
+    "BAD_SUPPRESSION",
+    "FileContext",
+    "Finding",
+    "LintResult",
+    "RULES",
+    "SCHEMA",
+    "Suppressions",
+    "collect_files",
+    "default_target",
+    "parse_suppressions",
+    "render",
+    "run_lint",
+]

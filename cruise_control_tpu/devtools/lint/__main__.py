@@ -1,0 +1,70 @@
+"""``python -m cruise_control_tpu.devtools.lint`` / the ``cclint``
+console script.  Exit status: 0 = clean, 1 = findings, 2 = usage."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from cruise_control_tpu.devtools.lint.driver import (
+    RULES,
+    default_target,
+    render,
+    run_lint,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cclint",
+        description="repo-native static analysis: lock discipline, JAX "
+                    "hot-path hygiene, config/doc/metric drift "
+                    "(docs/STATIC_ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help=f"files or directories to lint (default: {default_target()})",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json follows tests/schemas/lint.schema.json)",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=None, metavar="ID[,ID]",
+        help="run only these rule ids (repeatable or comma-separated)",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="lint only files changed vs git HEAD (plus untracked) — "
+             "the fast pre-commit mode",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule ids and summaries, then exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, rule in sorted(RULES.items()):
+            print(f"{rule_id}: {rule.summary}")
+        return 0
+
+    rules = None
+    if args.rule:
+        rules = [r.strip() for spec in args.rule for r in spec.split(",")
+                 if r.strip()]
+        unknown = sorted(set(rules) - set(RULES))
+        if unknown:
+            print(f"cclint: unknown rule(s) {unknown}; known: "
+                  f"{sorted(RULES)}", file=sys.stderr)
+            return 2
+
+    result = run_lint(paths=args.paths or None, rules=rules,
+                      changed_only=args.changed_only)
+    print(render(result, args.format))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Per-file parse context: one ``ast.parse`` per file, shared by every
+rule (the driver's single-parse contract — the wall-clock budget in
+``tests/test_cclint.py`` holds the pass to < 5 s over the package)."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class FileContext:
+    path: str                 # as reported in findings
+    text: str
+    lines: List[str]
+    tree: ast.Module
+    _parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    @classmethod
+    def parse(cls, path: str, text: str) -> "FileContext":
+        return cls(path=path, text=text, lines=text.splitlines(),
+                   tree=ast.parse(text, filename=path))
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """child → parent map, built lazily once per file."""
+        if self._parents is None:
+            parents: Dict[ast.AST, ast.AST] = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            self._parents = parents
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> List[ast.AST]:
+        """Path from ``node`` up to the module, nearest parent first."""
+        out = []
+        cur = node
+        parents = self.parents
+        while cur in parents:
+            cur = parents[cur]
+            out.append(cur)
+        return out

@@ -1,0 +1,213 @@
+"""cclint driver: collect files, parse each once, run every rule,
+apply suppressions, render.
+
+The contract the pytest wrapper (``tests/test_cclint.py``) enforces:
+
+* single parse per file — every rule reads the shared
+  :class:`FileContext`;
+* the whole-package pass completes in < 5 s;
+* the merged tree yields ZERO findings — true positives get fixed,
+  deliberate exceptions get an inline suppression with a reason.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import subprocess
+import time
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from cruise_control_tpu.devtools.lint.context import FileContext
+from cruise_control_tpu.devtools.lint.findings import (
+    BAD_SUPPRESSION,
+    Finding,
+    Suppressions,
+    parse_suppressions,
+)
+from cruise_control_tpu.devtools.lint.rules_config import ConfigKeyDriftRule
+from cruise_control_tpu.devtools.lint.rules_except import (
+    SwallowedExceptionRule,
+)
+from cruise_control_tpu.devtools.lint.rules_jax import JaxHotPathRule
+from cruise_control_tpu.devtools.lint.rules_lock import LockDisciplineRule
+from cruise_control_tpu.devtools.lint.rules_obs import ObsDynamicNameRule
+
+SCHEMA = "cc-tpu-lint/1"
+
+#: rule registry — ordered for stable output; ids are the suppression
+#: vocabulary (plus the reserved meta id ``bad-suppression``)
+RULES = {
+    rule.id: rule
+    for rule in (
+        LockDisciplineRule(),
+        JaxHotPathRule(),
+        ConfigKeyDriftRule(),
+        ObsDynamicNameRule(),
+        SwallowedExceptionRule(),
+    )
+}
+
+
+def default_target() -> pathlib.Path:
+    """The package this linter ships in — ``cclint`` with no arguments
+    lints it, from any CWD."""
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def _repo_root() -> pathlib.Path:
+    return default_target().parent
+
+
+def collect_files(paths: Sequence[str],
+                  changed_only: bool = False) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    files = sorted({f.resolve() for f in files})
+    if changed_only:
+        changed = changed_files()
+        if changed is not None:
+            files = [f for f in files if f in changed]
+    return files
+
+
+def changed_files() -> Optional[set]:
+    """Files touched vs HEAD plus untracked, absolute; None when git is
+    unavailable (callers fall back to the full list)."""
+    root = _repo_root()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD", "--diff-filter=d"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if diff.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    names = diff.stdout.splitlines() + untracked.stdout.splitlines()
+    return {(root / n).resolve() for n in names if n.endswith(".py")}
+
+
+def _rel(path: str) -> str:
+    try:
+        return str(pathlib.Path(path).resolve().relative_to(_repo_root()))
+    except ValueError:
+        return path
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    files_scanned: int
+    duration_s: float
+    suppressions_used: int
+    unused_suppressions: List[tuple]  # (path, line, rule)
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_json(self) -> dict:
+        return {
+            "schema": SCHEMA,
+            "findings": [f.to_json() for f in self.findings],
+            "counts": self.counts,
+            "filesScanned": self.files_scanned,
+            "suppressionsUsed": self.suppressions_used,
+            "durationS": round(self.duration_s, 3),
+        }
+
+    def render_text(self) -> str:
+        lines = [f.render() for f in self.findings]
+        for path, line, rule in self.unused_suppressions:
+            lines.append(
+                f"{path}:{line} · note · unused suppression for "
+                f"'{rule}' — remove it"
+            )
+        lines.append(
+            f"cclint: {len(self.findings)} finding(s) in "
+            f"{self.files_scanned} file(s) "
+            f"({self.suppressions_used} suppression(s) honored, "
+            f"{self.duration_s:.2f}s)"
+        )
+        return "\n".join(lines)
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             rules: Optional[Iterable[str]] = None,
+             changed_only: bool = False) -> LintResult:
+    t0 = time.perf_counter()
+    targets = [str(p) for p in (paths or [default_target()])]
+    selected = [RULES[r] for r in (rules or RULES)]
+    files = collect_files(targets, changed_only=changed_only)
+    known_ids = set(RULES) | {BAD_SUPPRESSION}
+
+    ctxs: List[FileContext] = []
+    findings: List[Finding] = []
+    supp_by_path: Dict[str, Suppressions] = {}
+    for path in files:
+        rel = _rel(str(path))
+        try:
+            text = path.read_text()
+            ctx = FileContext.parse(rel, text)
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding(rel, getattr(e, "lineno", 1) or 1,
+                                    "parse-error", f"cannot lint: {e}"))
+            continue
+        ctxs.append(ctx)
+        supp_by_path[rel] = parse_suppressions(rel, ctx.text, known_ids)
+
+    for ctx in ctxs:
+        for rule in selected:
+            if getattr(rule, "project_rule", False):
+                continue
+            findings.extend(rule.check_file(ctx))
+    for rule in selected:
+        if getattr(rule, "project_rule", False):
+            raw = rule.check_project(ctxs)
+            findings.extend(
+                dataclasses.replace(f, path=_rel(f.path)) for f in raw
+            )
+
+    kept: List[Finding] = []
+    for f in findings:
+        supp = supp_by_path.get(f.path)
+        if supp is not None and supp.suppresses(f):
+            continue
+        kept.append(f)
+    used = 0
+    unused: List[tuple] = []
+    for rel, supp in supp_by_path.items():
+        kept.extend(supp.malformed)
+        used += len(supp.used)
+        for line, ids in sorted(supp.by_line.items()):
+            for rule_id in sorted(ids):
+                if (line, rule_id) not in supp.used:
+                    unused.append((rel, line, rule_id))
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(
+        findings=kept,
+        files_scanned=len(files),
+        duration_s=time.perf_counter() - t0,
+        suppressions_used=used,
+        unused_suppressions=unused,
+    )
+
+
+def render(result: LintResult, fmt: str = "text") -> str:
+    if fmt == "json":
+        return json.dumps(result.to_json(), indent=1)
+    return result.render_text()

@@ -1,0 +1,290 @@
+"""jax-hot-path — host-sync and retrace hygiene inside jitted code.
+
+The engine's throughput story depends on jitted functions staying on
+device: one host sync inside a traced function serializes every step
+behind a device→host transfer, and a retrace (new static-arg value or
+new shape) pays seconds-to-minutes of XLA compile time on what looks
+like an innocent call.  The compile/retrace telemetry in
+``telemetry/device_stats.py`` catches these at runtime; this rule
+catches the textual patterns before they ship.
+
+Jit contexts: functions decorated with ``jax.jit``/``pjit`` (including
+``functools.partial(jax.jit, ...)``), functions passed to a
+``jax.jit(...)`` call by name (the ``jax.jit(run)`` /
+``device_stats.instrument("name", jax.jit(run))`` idiom), and defs
+nested inside either (closures trace too).
+
+Flags, inside a jit context:
+
+* host syncs — ``.item()``, ``.tolist()``, ``.block_until_ready()``,
+  ``jax.device_get(...)``, ``np.asarray``/``np.array`` on traced
+  values, and ``print`` (use ``jax.debug.print``);
+* ``float()``/``int()``/``bool()`` applied directly to a traced
+  parameter (concretization — crashes under trace or silently syncs);
+* Python ``if``/``while``/``assert`` whose test references a traced
+  (non-static) parameter directly — data-dependent control flow
+  belongs in ``lax.cond``/``lax.while_loop``/``jnp.where``.
+
+Flags, at call sites of known-jitted callables:
+
+* an f-string argument (a distinct cache key per distinct string —
+  retraces forever) or a dict literal argument (unhashable as a static
+  arg, a fresh pytree structure otherwise).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from cruise_control_tpu.devtools.lint.context import FileContext
+from cruise_control_tpu.devtools.lint.findings import Finding
+
+RULE_ID = "jax-hot-path"
+
+_JIT_NAMES = {"jit", "pjit"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_NP_SYNC_FUNCS = {"asarray", "array"}
+_CONCRETIZERS = {"float", "int", "bool"}
+
+
+def _dotted_tail(func: ast.expr) -> Optional[str]:
+    """`jax.jit` → 'jit', `jit` → 'jit', `functools.partial` → 'partial'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _jit_call(node: ast.expr) -> Optional[ast.Call]:
+    """The ``jax.jit(...)``/``pjit(...)`` Call inside ``node``, seeing
+    through ``functools.partial(jax.jit, ...)``.  Returns the call whose
+    keywords carry static_argnums/static_argnames."""
+    if not isinstance(node, ast.Call):
+        return None
+    tail = _dotted_tail(node.func)
+    if tail in _JIT_NAMES:
+        return node
+    if tail == "partial" and node.args:
+        if _dotted_tail(node.args[0]) in _JIT_NAMES:
+            return node
+    return None
+
+
+def _static_params(fn: ast.AST, jit: Optional[ast.Call]) -> Set[str]:
+    """Parameter names excluded from tracing by static_argnums/names."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: Set[str] = set()
+    for kw in (jit.keywords if jit is not None else ()):
+        if kw.arg == "static_argnums":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                        and 0 <= v.value < len(params):
+                    static.add(params[v.value])
+        elif kw.arg == "static_argnames":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    static.add(v.value)
+    return static
+
+
+def find_jit_functions(tree: ast.Module):
+    """[(FunctionDef, static_param_names)] for every jit context in the
+    module: decorated defs, defs passed by name to a jit call, and defs
+    nested inside either."""
+    jitted = {}
+
+    # decorator form
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            jit = _jit_call(dec)
+            if jit is not None or _dotted_tail(dec) in _JIT_NAMES:
+                jitted[node] = _static_params(node, jit)
+
+    # jax.jit(f) on a local def — match by name, nearest def wins
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+    for node in ast.walk(tree):
+        jit = _jit_call(node)
+        if jit is None or jit is not node:
+            continue
+        args = node.args[1:] if _dotted_tail(node.func) == "partial" \
+            else node.args
+        for a in args[:1]:
+            if isinstance(a, ast.Name) and a.id in defs_by_name:
+                fn = defs_by_name[a.id]
+                jitted.setdefault(fn, _static_params(fn, jit))
+
+    # nested defs trace with their parent
+    for fn in list(jitted):
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                jitted.setdefault(node, set())
+    return [(fn, static) for fn, static in jitted.items()]
+
+
+def find_jitted_names(tree: ast.Module) -> Set[str]:
+    """Names bound to jit-wrapped callables at module/function level:
+    ``f = jax.jit(g)``, ``self._x = jax.jit(g)`` (attr tail), and
+    decorated defs."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_jit_call(d) is not None or _dotted_tail(d) in _JIT_NAMES
+                   for d in node.decorator_list):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign) and _jit_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    names.add(tgt.attr)
+    return names
+
+
+def _traced_name_in_test(test: ast.expr, params: Set[str]) -> Optional[str]:
+    """The first traced-parameter name a branch test depends on, if any.
+
+    Names inside ``x is None`` / ``x is not None`` comparisons are
+    exempt: None-ness is pytree STRUCTURE, resolved at trace time (the
+    ``if t_cap is None: t_cap = jnp.int32(T)`` default-argument idiom),
+    not a data-dependent branch."""
+    structural = set()
+    for node in ast.walk(test):
+        if (isinstance(node, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops)
+                and all(isinstance(c, ast.Constant) and c.value is None
+                        for c in node.comparators)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    structural.add(sub)
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in params \
+                and node not in structural:
+            return node.id
+    return None
+
+
+def _walk_own_body(fn: ast.AST):
+    """Walk ``fn`` without descending into nested defs (those are their
+    own jit contexts in :func:`find_jit_functions` — walking them here
+    too would double-report every finding)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def find_host_syncs(tree: ast.Module):
+    """(lineno, description) for host-sync / traced-branching patterns
+    inside jit contexts."""
+    out = []
+    for fn, static in find_jit_functions(tree):
+        params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs} - static
+        for node in _walk_own_body(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                tail = _dotted_tail(f)
+                if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+                    out.append((node.lineno,
+                                f".{f.attr}() host sync"))
+                elif isinstance(f, ast.Attribute) \
+                        and f.attr == "device_get" :
+                    out.append((node.lineno, "jax.device_get host sync"))
+                elif (isinstance(f, ast.Attribute)
+                      and f.attr in _NP_SYNC_FUNCS
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id in _NP_MODULES):
+                    out.append((node.lineno,
+                                f"{f.value.id}.{f.attr}() materializes the "
+                                "traced value on host"))
+                elif tail == "print" and isinstance(f, ast.Name):
+                    out.append((node.lineno,
+                                "print() inside a jitted function (runs at "
+                                "trace time only, or syncs — use "
+                                "jax.debug.print)"))
+                elif (tail in _CONCRETIZERS and isinstance(f, ast.Name)
+                      and len(node.args) == 1
+                      and isinstance(node.args[0], ast.Name)
+                      and node.args[0].id in params):
+                    out.append((node.lineno,
+                                f"{tail}() concretizes traced parameter "
+                                f"'{node.args[0].id}'"))
+            elif isinstance(node, (ast.If, ast.While)):
+                name = _traced_name_in_test(node.test, params)
+                if name is not None:
+                    out.append((
+                        node.lineno,
+                        "Python branching on traced parameter "
+                        f"'{name}' — use lax.cond/lax.while_loop/"
+                        "jnp.where",
+                    ))
+            elif isinstance(node, ast.Assert):
+                name = _traced_name_in_test(node.test, params)
+                if name is not None:
+                    out.append((
+                        node.lineno,
+                        f"assert on traced parameter '{name}' "
+                        "(concretizes under trace)",
+                    ))
+    return out
+
+
+def find_retrace_risks(tree: ast.Module):
+    """(lineno, description) for calls to known-jitted callables passing
+    f-string or dict-literal arguments."""
+    jitted = find_jitted_names(tree)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _dotted_tail(node.func)
+        if tail not in jitted:
+            continue
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(a, ast.JoinedStr):
+                out.append((node.lineno,
+                            f"f-string argument to jitted '{tail}' — a new "
+                            "jit cache key per distinct string (retrace "
+                            "risk); hoist the string or make it static "
+                            "data"))
+            elif isinstance(a, ast.Dict):
+                out.append((node.lineno,
+                            f"dict-literal argument to jitted '{tail}' — "
+                            "unhashable as a static arg and a fresh pytree "
+                            "otherwise; pass a hashable/frozen structure"))
+    return out
+
+
+class JaxHotPathRule:
+    id = RULE_ID
+    summary = ("no host syncs, traced-value branching, or retrace-risk "
+               "arguments inside/at jitted functions")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        out = []
+        for lineno, desc in find_host_syncs(ctx.tree):
+            out.append(Finding(ctx.path, lineno, self.id, desc))
+        for lineno, desc in find_retrace_risks(ctx.tree):
+            out.append(Finding(ctx.path, lineno, self.id, desc))
+        return out

@@ -364,7 +364,7 @@ class CruiseControl:
             durationS=round(result.duration_s, 3),
             goalSummaries=result.goal_summaries,
         )
-        self.registry.meter(f"operation.{operation.lower()}").mark()
+        self.registry.meter(f"operation.{operation.lower()}").mark()  # cclint: disable=obs-dynamic-name -- bounded: operation is the REST endpoint vocabulary (rebalance/add_broker/...), not caller data
         # the proposals leaving the facade always speak external (Kafka) ids —
         # dryrun consumers (REST, operators) act on them too, not just the
         # executor

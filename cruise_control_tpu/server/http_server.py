@@ -142,8 +142,19 @@ class CruiseControlHttpServer:
                 return self._send(handler, 404, {"errorMessage": "not found"})
             endpoint = parsed.path[len(self.prefix) + 1:].strip("/").lower()
             registry = getattr(self.cc, "registry", None)
+            # KNOWN endpoints only, so an URL scan cannot mint unbounded
+            # metric names in the registry (unknown paths share one
+            # "unknown" bucket; the request-duration timer below reuses
+            # this same gate)
+            known = (
+                (method == "GET" and endpoint in GET_ENDPOINTS)
+                or (method == "POST" and endpoint in ASYNC_POST_ENDPOINTS)
+                or (method == "POST" and endpoint in SYNC_POST_ENDPOINTS)
+            )
             if registry is not None:  # servlet request rates (§5.1)
-                registry.meter(f"http.{method}.{endpoint or 'root'}").mark()
+                bucket = (endpoint or "root") if (known or not endpoint) \
+                    else "unknown"
+                registry.meter(f"http.{method}.{bucket}").mark()  # cclint: disable=obs-dynamic-name -- bounded: method is GET/POST, bucket is drawn from the routing tables plus root/unknown
             params = {
                 k: v[-1] for k, v in parse_qs(parsed.query).items()
             }
@@ -161,13 +172,6 @@ class CruiseControlHttpServer:
                 )
             else:
                 req_span = tracing.NOOP
-            # request duration histogram — KNOWN endpoints only, so an URL
-            # scan cannot mint unbounded timer names in the registry
-            known = (
-                (method == "GET" and endpoint in GET_ENDPOINTS)
-                or (method == "POST" and endpoint in ASYNC_POST_ENDPOINTS)
-                or (method == "POST" and endpoint in SYNC_POST_ENDPOINTS)
-            )
             t_req = time.perf_counter()
             try:
                 with req_span:
@@ -181,7 +185,7 @@ class CruiseControlHttpServer:
                             handler, endpoint, params)
             finally:
                 if known and registry is not None:
-                    registry.timer(f"http.{method}.{endpoint}").update(
+                    registry.timer(f"http.{method}.{endpoint}").update(  # cclint: disable=obs-dynamic-name -- bounded: gated on known, endpoint is in the routing tables
                         time.perf_counter() - t_req
                     )
             self._send(handler, 404, {

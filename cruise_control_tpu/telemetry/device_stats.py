@@ -145,10 +145,13 @@ class DeviceStatsMonitor:
     # ---- configuration ----------------------------------------------------------
     def configure(self, enabled: Optional[bool] = None,
                   retrace_threshold: Optional[int] = None) -> None:
-        if enabled is not None:
-            self.enabled = bool(enabled)
-        if retrace_threshold is not None:
-            self.retrace_threshold = max(2, int(retrace_threshold))
+        # record_compile() reads retrace_threshold under the lock from
+        # whatever thread compiles — configuration takes it too
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if retrace_threshold is not None:
+                self.retrace_threshold = max(2, int(retrace_threshold))
 
     def reset(self) -> None:
         with self._lock:

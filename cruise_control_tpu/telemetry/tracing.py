@@ -171,8 +171,10 @@ class Telemetry:
         if enabled is not None:
             self.enabled = bool(enabled)
         if ring_size is not None:
-            self.ring_size = max(1, int(ring_size))
+            # _finish() reads ring_size under the lock when trimming the
+            # ring — the resize must not interleave with a trim
             with self._lock:
+                self.ring_size = max(1, int(ring_size))
                 del self._ring[: -self.ring_size]
         if slow_span_log_s is not None:
             self.slow_span_log_s = float(slow_span_log_s)

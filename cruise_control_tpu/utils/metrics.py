@@ -261,7 +261,7 @@ class MetricRegistry:
         for n, fn in gauges.items():
             try:
                 gvals[n] = fn()
-            except Exception as exc:
+            except Exception as exc:  # cclint: disable=swallowed-exception -- not silent: the error string becomes the gauge's snapshot value, visible on GET /state
                 gvals[n] = f"error: {exc}"
         out["gauges"] = gvals
         return out

@@ -1,0 +1,485 @@
+"""cclint framework tests (ISSUE 4).
+
+Four contracts:
+
+* **rules** — every registered rule catches its positive fixtures and
+  stays quiet on its negatives; a meta-test proves the fixture table
+  covers the whole registry, so adding a rule without fixtures fails CI;
+* **suppressions** — ``# cclint: disable=rule -- reason`` is honored,
+  a reasonless or unknown-rule suppression is itself a finding, and
+  every suppression checked into the package is load-bearing (stripping
+  any one of them re-surfaces its finding at the same file:line);
+* **output** — the JSON format matches the checked-in
+  ``tests/schemas/lint.schema.json`` contract (closed finding record);
+* **the tree is clean** — the full pass over ``cruise_control_tpu/``
+  yields zero findings in < 5 s (single parse per file).
+"""
+
+import json
+import pathlib
+import re
+
+import pytest
+
+from cruise_control_tpu.devtools.lint import (
+    BAD_SUPPRESSION,
+    FileContext,
+    RULES,
+    parse_suppressions,
+    render,
+    run_lint,
+)
+from cruise_control_tpu.devtools.lint.__main__ import main as cclint_main
+from cruise_control_tpu.devtools.lint.rules_config import (
+    doc_keys,
+    used_keys,
+)
+from test_artifact_schemas import validate
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "cruise_control_tpu"
+
+
+def findings_for(rule_id: str, code: str):
+    ctx = FileContext.parse("fixture.py", code)
+    return RULES[rule_id].check_file(ctx)
+
+
+# ---- per-rule fixtures ----------------------------------------------------------
+# rule id -> (positive snippets that MUST flag, negative snippets that
+# must NOT).  config-key-drift is a project rule; its fixtures run
+# through its pure helpers below but are listed here so the meta-test
+# sees full registry coverage.
+RULE_FIXTURES = {
+    "lock-discipline": {
+        "positive": [
+            # lockset inconsistency: guarded in one method, naked in another
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "    def add(self, x):\n"
+            "        with self._lock:\n"
+            "            self._items.append(x)\n"
+            "    def drop_all(self):\n"
+            "        self._items.clear()\n",
+            # cross-thread write: daemon loop writes, public method reads
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._last = None\n"
+            "    def start(self):\n"
+            "        def loop():\n"
+            "            self._last = 1\n"
+            "        threading.Thread(target=loop).start()\n"
+            "    def summary(self):\n"
+            "        return {'last': self._last}\n",
+        ],
+        "negative": [
+            # everything under the lock (helper called only while held)
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = []\n"
+            "    def add(self, x):\n"
+            "        with self._lock:\n"
+            "            self._record(x)\n"
+            "    def _record(self, x):\n"
+            "        self._items.append(x)\n"
+            "    def snapshot(self):\n"
+            "        with self._lock:\n"
+            "            return list(self._items)\n",
+            # thread-safe primitives are out of scope; __init__ is exempt
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._stop = threading.Event()\n"
+            "        self._data = {}\n"
+            "    def start(self):\n"
+            "        self._stop.clear()\n"
+            "    def stop(self):\n"
+            "        self._stop.set()\n",
+            # no lock attribute -> class out of scope entirely
+            "class C:\n"
+            "    def set(self, x):\n"
+            "        self._x = x\n"
+            "    def get(self):\n"
+            "        return self._x\n",
+        ],
+    },
+    "jax-hot-path": {
+        "positive": [
+            # host sync inside a decorated jit function
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x.item())\n",
+            # print inside a function passed to jax.jit by name
+            "import jax\n"
+            "def make():\n"
+            "    def run(m):\n"
+            "        print(m)\n"
+            "        return m\n"
+            "    return jax.jit(run)\n",
+            # branching on a traced parameter
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n",
+            # np.asarray materializes on host
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.asarray(x)\n",
+            # retrace risk: f-string argument to a jitted callable
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, tag):\n"
+            "    return x\n"
+            "def caller(x, name):\n"
+            "    return f(x, f'tag-{name}')\n",
+            # concretizing a traced parameter
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return [0.0] * int(x)\n",
+        ],
+        "negative": [
+            # the structural-None default idiom is NOT data branching
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def f(x, t_cap=None):\n"
+            "    if t_cap is None:\n"
+            "        t_cap = jnp.int32(8)\n"
+            "    return x * t_cap\n",
+            # static args may branch (resolved at trace time)
+            "import functools, jax\n"
+            "@functools.partial(jax.jit, static_argnums=(1,))\n"
+            "def f(x, mode):\n"
+            "    if mode:\n"
+            "        return x + 1\n"
+            "    return x\n",
+            # host syncs OUTSIDE jit are fine
+            "import numpy as np\n"
+            "def fetch(x):\n"
+            "    print(x)\n"
+            "    return float(np.asarray(x).sum())\n",
+        ],
+    },
+    "config-key-drift": {
+        # project rule: exercised via used-key extraction against the
+        # live registry and doc-table parsing (see tests below)
+        "positive": ["cfg.get_int('no.such.key')\n"],
+        "negative": ["cfg.get_int('tpu.search.max.rounds')\n"],
+    },
+    "obs-dynamic-name": {
+        "positive": [
+            # unguarded f-string span name
+            "def f(m):\n"
+            "    with tracing.span(f'http.{m}'):\n"
+            "        pass\n",
+            # dynamic event kind
+            "def f(op):\n"
+            "    events.emit(f'optimize.{op}')\n",
+            # dynamic metric name (no enabled() escape)
+            "def f(registry, name):\n"
+            "    registry.counter(f'ops.{name}').inc()\n",
+        ],
+        "negative": [
+            # guarded span, static metric, static kind
+            "def f(registry, m, op):\n"
+            "    if tracing.enabled():\n"
+            "        s = tracing.span('http', sub=f'{m}')\n"
+            "    registry.counter('ops').inc()\n"
+            "    events.emit('optimize.start', operation=op)\n",
+            # dict .get homonym is not a metric call
+            "def f(d, k):\n"
+            "    return d.counter(f'x.{k}') if hasattr(d, 'x') else None\n",
+        ],
+    },
+    "swallowed-exception": {
+        "positive": [
+            "def loop(work):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            work()\n"
+            "        except Exception:\n"
+            "            pass\n",
+            "def drain(items):\n"
+            "    for it in items:\n"
+            "        try:\n"
+            "            it.close()\n"
+            "        except:\n"
+            "            continue\n",
+        ],
+        "negative": [
+            # logged -> fine
+            "def loop(work):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            work()\n"
+            "        except Exception:\n"
+            "            LOG.exception('tick failed')\n",
+            # narrow catch -> fine
+            "def loop(work):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            work()\n"
+            "        except KeyError:\n"
+            "            pass\n",
+            # not in a loop -> out of scope
+            "def once(work):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        pass\n",
+        ],
+    },
+}
+
+
+def test_every_registered_rule_has_fixtures():
+    """Registry ↔ fixture-table closure: a rule without a positive
+    fixture is an untested rule."""
+    assert set(RULE_FIXTURES) == set(RULES)
+    for rule_id, cases in RULE_FIXTURES.items():
+        assert cases["positive"], f"{rule_id}: no positive fixture"
+        assert cases["negative"], f"{rule_id}: no negative fixture"
+
+
+@pytest.mark.parametrize("rule_id", sorted(set(RULES) - {"config-key-drift"}))
+def test_rule_fixtures(rule_id):
+    for code in RULE_FIXTURES[rule_id]["positive"]:
+        found = findings_for(rule_id, code)
+        assert found, f"{rule_id} missed a positive fixture:\n{code}"
+        assert all(f.rule == rule_id for f in found)
+        assert all(f.line >= 1 for f in found)
+    for code in RULE_FIXTURES[rule_id]["negative"]:
+        found = findings_for(rule_id, code)
+        assert not found, (
+            f"{rule_id} false positive:\n{code}\n"
+            + "\n".join(f.render() for f in found)
+        )
+
+
+# ---- config-key-drift (project rule) --------------------------------------------
+def test_config_rule_flags_undefined_used_key(tmp_path):
+    bad = tmp_path / "uses_bad_key.py"
+    bad.write_text(RULE_FIXTURES["config-key-drift"]["positive"][0])
+    result = run_lint(paths=[str(bad)], rules=["config-key-drift"])
+    assert any(
+        f.rule == "config-key-drift" and "no.such.key" in f.message
+        for f in result.findings
+    )
+    good = tmp_path / "uses_good_key.py"
+    good.write_text(RULE_FIXTURES["config-key-drift"]["negative"][0])
+    result = run_lint(paths=[str(good)], rules=["config-key-drift"])
+    assert not [f for f in result.findings if "key" in f.message]
+
+
+def test_config_used_key_extraction():
+    import ast
+
+    tree = ast.parse(
+        "x = cfg.get('webserver.http.port')\n"          # config receiver
+        "y = config.get_int('simulation.seed')\n"       # typed getter
+        "z = some_dict.get('not.config')\n"             # plain dict .get
+        "w = cfg.get(key_var)\n"                        # non-literal
+    )
+    keys = {k for k, _ in used_keys(tree)}
+    assert keys == {"webserver.http.port", "simulation.seed"}
+
+
+def test_config_doc_table_parsing_and_drift_detection():
+    doc = (
+        "# Configuration keys\n"
+        "| key | type |\n"
+        "|---|---|\n"
+        "| `alpha.beta` | INT |\n"
+        "| `gamma.delta` | STRING |\n"
+    )
+    table = doc_keys(doc)
+    assert set(table) == {"alpha.beta", "gamma.delta"}
+    assert table["alpha.beta"] == 4  # line anchor for the finding
+    # both drift directions are set differences over these views — prove
+    # the live pass sees the real registry and doc agreeing
+    result = run_lint(paths=[str(PKG / "config")],
+                      rules=["config-key-drift"])
+    assert not result.findings, "\n".join(
+        f.render() for f in result.findings)
+
+
+# ---- suppressions ---------------------------------------------------------------
+SWALLOW = (
+    "def loop(work):\n"
+    "    while True:\n"
+    "        try:\n"
+    "            work()\n"
+    "        except Exception:{comment}\n"
+    "            pass\n"
+)
+
+
+def _lint_file(tmp_path, code, name="mod.py", rules=None):
+    path = tmp_path / name
+    path.write_text(code)
+    return run_lint(paths=[str(path)], rules=rules)
+
+
+def test_suppression_with_reason_is_honored(tmp_path):
+    result = _lint_file(
+        tmp_path,
+        SWALLOW.format(
+            comment="  # cclint: disable=swallowed-exception -- fixture: "
+                    "deliberately silent"),
+    )
+    assert not result.findings
+    assert result.suppressions_used == 1
+
+
+def test_suppression_without_reason_fails(tmp_path):
+    result = _lint_file(
+        tmp_path, SWALLOW.format(
+            comment="  # cclint: disable=swallowed-exception"),
+    )
+    rules = {f.rule for f in result.findings}
+    # the original finding survives AND the reasonless suppression is
+    # itself flagged
+    assert rules == {"swallowed-exception", BAD_SUPPRESSION}
+
+
+def test_suppression_with_unknown_rule_fails(tmp_path):
+    result = _lint_file(
+        tmp_path, SWALLOW.format(
+            comment="  # cclint: disable=swalowed-exception -- typo"),
+    )
+    assert {f.rule for f in result.findings} == {
+        "swallowed-exception", BAD_SUPPRESSION}
+
+
+def test_bad_suppression_cannot_be_suppressed(tmp_path):
+    code = ("x = 1  # cclint: disable=bad-suppression,"
+            "swallowed-exception\n")
+    result = _lint_file(tmp_path, code)
+    assert [f.rule for f in result.findings] == [BAD_SUPPRESSION]
+
+
+def test_suppression_in_string_literal_is_ignored():
+    supp = parse_suppressions(
+        "doc.py",
+        'DOC = """example:\n'
+        '    x()  # cclint: disable=swallowed-exception -- example\n'
+        '"""\n',
+        set(RULES),
+    )
+    assert not supp.by_line and not supp.malformed
+
+
+def test_unused_suppression_is_reported_as_note(tmp_path):
+    result = _lint_file(
+        tmp_path,
+        "x = 1  # cclint: disable=swallowed-exception -- nothing here\n",
+    )
+    assert not result.findings
+    assert result.unused_suppressions
+    assert "unused suppression" in result.render_text()
+
+
+def test_checked_in_suppressions_are_load_bearing(tmp_path):
+    """Flipping any one suppression off re-surfaces its finding at the
+    same file:line (the acceptance criterion for zero-findings-by-
+    suppression honesty)."""
+    marker = re.compile(r"\s*# cclint: disable=[^\n]*")
+    checked = 0
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text()
+        if "cclint: disable=" not in text:
+            continue
+        supp = parse_suppressions(str(path), text, set(RULES))
+        if not supp.by_line:
+            continue  # marker only appears inside a string literal (docs)
+        stripped = tmp_path / path.name
+        stripped.write_text(marker.sub("", text))
+        result = run_lint(paths=[str(stripped)])
+        surfaced = {(f.line, f.rule) for f in result.findings}
+        for line, rule_ids in supp.by_line.items():
+            for rule_id in rule_ids:
+                assert (line, rule_id) in surfaced, (
+                    f"{path}:{line} suppression for '{rule_id}' is stale "
+                    "— the finding no longer fires without it"
+                )
+                checked += 1
+    assert checked >= 4  # the suppressions this PR checked in
+
+
+# ---- output contracts -----------------------------------------------------------
+LINT_SCHEMAS = json.loads(
+    (pathlib.Path(__file__).parent / "schemas" / "lint.schema.json")
+    .read_text()
+)
+
+
+def test_json_output_matches_checked_in_schema(tmp_path):
+    result = _lint_file(tmp_path, SWALLOW.format(comment=""))
+    assert result.findings  # a non-trivial payload
+    payload = json.loads(render(result, "json"))
+    validate(json.loads(json.dumps(payload)),
+             LINT_SCHEMAS["cc-tpu-lint/1"])
+    assert payload["counts"]["swallowed-exception"] == 1
+
+
+def test_text_output_format(tmp_path):
+    result = _lint_file(tmp_path, SWALLOW.format(comment=""))
+    line = result.findings[0].render()
+    # the clickable anchor contract: file:line · rule-id · message
+    assert re.match(r"^.+\.py:\d+ · swallowed-exception · ", line)
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    result = _lint_file(tmp_path, "def broken(:\n")
+    assert [f.rule for f in result.findings] == ["parse-error"]
+
+
+# ---- the CLI --------------------------------------------------------------------
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SWALLOW.format(comment=""))
+    assert cclint_main([str(bad)]) == 1
+    assert cclint_main([str(bad), "--rule=lock-discipline"]) == 0
+    assert cclint_main([str(bad), "--rule=not-a-rule"]) == 2
+    assert cclint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULES:
+        assert rule_id in out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SWALLOW.format(comment=""))
+    assert cclint_main([str(bad), "--format=json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["schema"] == "cc-tpu-lint/1"
+    validate(payload, LINT_SCHEMAS["cc-tpu-lint/1"])
+
+
+# ---- the tree is clean ----------------------------------------------------------
+def test_package_lints_clean_within_budget():
+    """The tier-1 wrapper: the whole package, every rule, zero findings,
+    single parse per file, < 5 s wall clock."""
+    result = run_lint(paths=[str(PKG)])
+    assert not result.findings, (
+        "cclint found new violations — fix them or add a reviewed "
+        "suppression with a reason (docs/STATIC_ANALYSIS.md):\n"
+        + "\n".join(f.render() for f in result.findings)
+    )
+    assert result.files_scanned > 50
+    assert result.duration_s < 5.0, (
+        f"lint pass took {result.duration_s:.2f}s — the single-parse "
+        "budget regressed"
+    )

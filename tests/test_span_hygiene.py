@@ -1,4 +1,5 @@
-"""Span- and event-hygiene static checks.
+"""Span- and event-hygiene checks — now rule ``obs-dynamic-name`` of the
+cclint framework (``cruise_control_tpu/devtools/lint/rules_obs.py``).
 
 docs/OBSERVABILITY.md states the rules: span names must be static — any
 f-string name construction (positional name or ``sub=``) at a
@@ -9,108 +10,38 @@ at ``events.emit()`` call sites: a dynamic kind mints unbounded journal
 vocabulary (label-cardinality explosion in every ``kind=``-filtered
 consumer), so an f-string kind must sit behind an ``enabled()`` guard —
 and in practice should simply be a static dotted string with the dynamic
-part in the payload.  This test scans every module in
-``cruise_control_tpu/`` with the ast so a violation fails CI with the
-offending file:line."""
+part in the payload.
+
+This file started as a one-off AST check and migrated onto the lint
+framework (ISSUE 4); the original guarded/unguarded fixture cases stay
+here verbatim as the rule's unit tests, and the package-wide scans are
+now expressed through the framework driver (which also honors inline
+suppressions — a violation fails CI with the offending file:line unless
+a reviewed ``# cclint: disable=obs-dynamic-name -- reason`` sits on it).
+"""
 
 import ast
 import pathlib
 
+from cruise_control_tpu.devtools.lint import run_lint
+from cruise_control_tpu.devtools.lint.rules_obs import (
+    find_unguarded_dynamic_event_kinds,
+    find_unguarded_dynamic_spans,
+)
+
 PKG = pathlib.Path(__file__).resolve().parent.parent / "cruise_control_tpu"
 
-SPAN_FUNCS = {"span", "device_span"}
-EVENT_FUNCS = {"emit"}
 
-
-def _is_enabled_call(node: ast.AST) -> bool:
-    """True for any `...enabled()` call (tracing.enabled / tel.enabled /
-    the bare-name import form)."""
-    if not isinstance(node, ast.Call):
-        return False
-    f = node.func
-    name = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", None)
-    return name == "enabled"
-
-
-def _guard_tests(ancestors):
-    """Yield the test expressions of every conditional construct whose
-    TAKEN branch leads to the call: `if` statements (body branch only —
-    an else branch is the path tracing is OFF), ternaries, and
-    `cond and expr` short-circuits."""
-    for parent, child in zip(ancestors, ancestors[1:] + [None]):
-        if isinstance(parent, ast.If) and child in parent.body:
-            yield parent.test
-        elif isinstance(parent, ast.IfExp) and child is parent.body:
-            yield parent.test
-        elif isinstance(parent, ast.BoolOp) and isinstance(parent.op,
-                                                           ast.And):
-            idx = parent.values.index(child) if child in parent.values else 0
-            for v in parent.values[:idx]:
-                yield v
-
-
-def _find_unguarded_dynamic_calls(tree: ast.AST, func_names):
-    """(lineno, func_name) for every call to one of ``func_names`` that
-    builds an f-string argument without an enclosing enabled() guard."""
-    parents = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    offenders = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        name = (f.attr if isinstance(f, ast.Attribute)
-                else getattr(f, "id", None))
-        if name not in func_names:
-            continue
-        dynamic = any(
-            isinstance(a, ast.JoinedStr) for a in node.args
-        ) or any(
-            isinstance(kw.value, ast.JoinedStr) for kw in node.keywords
-        )
-        if not dynamic:
-            continue
-        chain = [node]
-        cur = node
-        while cur in parents:
-            cur = parents[cur]
-            chain.append(cur)
-        chain.reverse()  # outermost first
-        guarded = any(
-            any(_is_enabled_call(n) for n in ast.walk(test))
-            for test in _guard_tests(chain)
-        )
-        if not guarded:
-            offenders.append((node.lineno, name))
-    return offenders
-
-
-def find_unguarded_dynamic_spans(tree: ast.AST):
-    """(lineno, source_hint) for every span()/device_span() call that
-    builds an f-string name without an enclosing enabled() guard."""
-    return _find_unguarded_dynamic_calls(tree, SPAN_FUNCS)
-
-
-def find_unguarded_dynamic_event_kinds(tree: ast.AST):
-    """(lineno, source_hint) for every emit() call that builds an
-    f-string argument (kind or payload value) without an enabled() guard.
-
-    Scope note: payload f-strings are flagged too — on the disabled path
-    emit()'s arguments are still evaluated, so the formatting cost rule is
-    the same as for span names; put dynamic values in the payload as raw
-    kwargs, not pre-formatted strings."""
-    return _find_unguarded_dynamic_calls(tree, EVENT_FUNCS)
+def _package_findings():
+    result = run_lint(paths=[str(PKG)], rules=["obs-dynamic-name"])
+    return result.findings
 
 
 def test_no_unguarded_fstring_span_names_in_package():
-    violations = []
-    for path in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for lineno, fn in find_unguarded_dynamic_spans(tree):
-            violations.append(f"{path.relative_to(PKG.parent)}:{lineno} "
-                              f"({fn} with f-string name)")
+    violations = [
+        f.render() for f in _package_findings()
+        if "span" in f.message or "device_span" in f.message
+    ]
     assert not violations, (
         "f-string span names must be guarded by tracing.enabled() "
         "(docs/OBSERVABILITY.md) — pass static names and route dynamic "
@@ -119,17 +50,30 @@ def test_no_unguarded_fstring_span_names_in_package():
 
 
 def test_no_unguarded_fstring_event_kinds_in_package():
-    violations = []
-    for path in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for lineno, fn in find_unguarded_dynamic_event_kinds(tree):
-            violations.append(f"{path.relative_to(PKG.parent)}:{lineno} "
-                              f"({fn} with f-string argument)")
+    violations = [
+        f.render() for f in _package_findings()
+        if "emit" in f.message
+    ]
     assert not violations, (
         "event kinds must be static dotted strings (journal cardinality "
         "stays bounded; docs/OBSERVABILITY.md) — put dynamic values in "
         "the payload as raw kwargs, inside an events.enabled() guard if "
         "formatting is unavoidable:\n" + "\n".join(violations)
+    )
+
+
+def test_no_dynamic_metric_names_in_package():
+    """The framework extension of this file's original scope: registry
+    metric names (counter/gauge/timer/histogram/meter) must be static
+    too, modulo reviewed suppressions stating the cardinality bound."""
+    violations = [
+        f.render() for f in _package_findings()
+        if "registry." in f.message
+    ]
+    assert not violations, (
+        "metric names must be static, or carry a suppression whose "
+        "reason states the bound (docs/STATIC_ANALYSIS.md):\n"
+        + "\n".join(violations)
     )
 
 
