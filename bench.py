@@ -108,7 +108,12 @@ def _full_path_phases() -> dict:
 
     return {
         "monitor": leaf("monitor.cluster_model"),
-        "analyzer-score": leaf("analyzer.scan", "analyzer.score"),
+        # scan = serial dispatch+wait; fetch_wait = the pipelined drive
+        # loop's residual device wait (dispatch_ahead is its enqueue cost)
+        "analyzer-score": leaf(
+            "analyzer.scan", "analyzer.score", "analyzer.fetch_wait",
+            "analyzer.dispatch_ahead",
+        ),
         "analyzer-apply": leaf("analyzer.recheck", "analyzer.apply"),
         "analyzer-upload": leaf("analyzer.upload", "analyzer.resync"),
         "host-finalize": leaf("analyzer.ctx_init", "analyzer.finalize"),
@@ -227,6 +232,11 @@ def main() -> None:
                 "metric": "rebalance_plan_wallclock_50b_1000p",
                 "value": round(tpu_s, 3),
                 "unit": "s",
+                # the greedy wall-clock itself: vs_baseline swings must be
+                # attributable from the artifact alone (r5's 8x -> 53.9x
+                # was a greedy slowdown, not an engine change — invisible
+                # without this number)
+                "baseline_s": round(greedy_s, 3),
                 "vs_baseline": round(greedy_s / tpu_s, 3) if quality_ok else 0,
                 "tracing_overhead_pct": round(overhead_pct, 2),
                 "recorder_overhead_pct": round(recorder_overhead_pct, 2),

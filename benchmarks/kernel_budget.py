@@ -154,6 +154,12 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--trace-dir", default="/tmp/cc_tpu_kernel_budget")
     ap.add_argument("--top", type=int, default=25)
+    ap.add_argument(
+        "--auction-rounds", type=int, default=-1,
+        help="override tpu.search auction_rounds for the traced call "
+        "(-1 = engine default, 0 = one round per alternate destination) — "
+        "the r4 budget's item-2 sweep axis",
+    )
     args = ap.parse_args()
 
     import jax
@@ -177,6 +183,8 @@ def main() -> None:
         opt.config,
         device_batch_per_step=int(min(max(B // 4, 32), 1024)),
     )
+    if args.auction_rounds >= 0:
+        cfg = dataclasses.replace(cfg, auction_rounds=args.auction_rounds)
     fn = T._cached_scan_fn(cfg, K, D, args.steps)
 
     print("warming (compile or cache load)...", file=sys.stderr)
@@ -265,6 +273,7 @@ def main() -> None:
             "brokers": args.brokers, "partitions": args.partitions,
             "racks": args.racks, "seed": 5, "K": K, "D": D,
             "steps_traced": steps,
+            "auction_rounds": int(cfg.auction_rounds),
         },
         "hw": {"hbm_bytes_per_s": HBM_BYTES_PER_S,
                "peak_f32_flops": PEAK_F32_FLOPS, "chip": "v5e"},

@@ -100,6 +100,25 @@ def run(num_brokers: int = 200, num_partitions: int = 5000,
     verify_result(state, tpu, goals)
     s_tpu = violation_score(tpu.final_state, goals)
 
+    # drive-loop pipelining gate: the default (pipelined) engine must
+    # produce a bit-identical plan to serial round-trips
+    import dataclasses as _dc
+
+    from cruise_control_tpu.analyzer.tpu_optimizer import TpuSearchConfig
+
+    serial = TpuGoalOptimizer(
+        config=_dc.replace(TpuSearchConfig(), pipeline_depth=0)
+    ).optimize(state)
+
+    def _tuples(r):
+        return [
+            (a.action_type, a.partition, a.slot, a.source_broker,
+             a.dest_broker, a.dest_slot)
+            for a in r.actions
+        ]
+
+    pipeline_identical = _tuples(serial) == _tuples(tpu)
+
     result.update({
         "tpu": {"wallclock_s": round(t_tpu, 2), "violation_score": s_tpu},
         "speedup": round(t_greedy / max(t_tpu, 1e-9), 1),
@@ -109,6 +128,8 @@ def run(num_brokers: int = 200, num_partitions: int = 5000,
         # which backend the TPU half actually ran on — a CPU-backend
         # refresh must not masquerade as an accelerator measurement
         "tpu_platform": jax.default_backend(),
+        "pipeline_depth": TpuSearchConfig().pipeline_depth,
+        "pipeline_identical": pipeline_identical,
     })
     if out:
         with open(out, "w") as f:
@@ -139,7 +160,10 @@ def main() -> int:
     print(json.dumps(result))
     if args.phase == "greedy":
         return 0
-    return 0 if (result["quality_gate"] and result["speed_gate"]) else 1
+    return 0 if (
+        result["quality_gate"] and result["speed_gate"]
+        and result["pipeline_identical"]
+    ) else 1
 
 
 if __name__ == "__main__":

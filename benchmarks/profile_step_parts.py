@@ -44,6 +44,12 @@ def main() -> None:
     ap.add_argument("--brokers", type=int, default=10000)
     ap.add_argument("--partitions", type=int, default=1000000)
     ap.add_argument("--iters", type=int, default=32)
+    ap.add_argument(
+        "--auction-rounds", default="",
+        help="comma-separated auction_rounds values to sweep the matcher "
+        "component over (e.g. '0,4,2,1'; 0 = one round per alternate "
+        "destination, the engine default) — the r4 budget's item-2 axis",
+    )
     args = ap.parse_args()
 
     import cruise_control_tpu.analyzer.tpu_optimizer as T
@@ -138,12 +144,27 @@ def main() -> None:
 
     res["match_ms"] = round(
         bench_loop(match_body, I, cand_score, jnp.float32(0)) * 1e3, 2)
+
+    # auction-round sweep: the matcher's loop-amortized cost at each round
+    # count (score/step-count effects need the full-engine sweep,
+    # benchmarks/sweep_auction_rounds.py — this isolates the device cost)
+    for rounds in [int(x) for x in args.auction_rounds.split(",") if x]:
+        def match_rounds_body(i, carry, rounds=rounds):
+            sc, acc = carry
+            take, ws, wd = T._match_batch(
+                sc + i * 0, cand_dst, cand_src, cand_p, -1e-4, B, P,
+                rounds=rounds)
+            return sc, acc + ws[0]
+
+        res[f"match_ms_rounds_{rounds}"] = round(
+            bench_loop(match_rounds_body, I, cand_score, jnp.float32(0))
+            * 1e3, 2)
     res["cohort_ms"] = round(
         bench_loop(cohort_body, I, cand_score, jnp.float32(0)) * 1e3, 2)
 
     def topm_body(i, carry):
         sc, acc = carry
-        vals, order = jax.lax.top_k(-(sc[:, 0] + i * 0), 1024)
+        vals, order = jax.lax.top_k(-(sc[:, 0] + i * 0), min(1024, N))
         return sc, acc - vals[0]
 
     res["topM_ms"] = round(

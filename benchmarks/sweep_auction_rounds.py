@@ -1,0 +1,101 @@
+"""Auction-round sweep: full-engine wall/score/plan across round counts
+(the r4 kernel budget's unclaimed item #2 — "fewer/fused auction rounds").
+
+The round-4 probe at north-star shapes rejected rounds 8 → 4 on quality
+(−3 s wall, +23 % steps, +0.17 % score) and landed a fixed-point early
+exit instead; this sweep commits the measurement itself so the verdict is
+an artifact, not folklore.  Each rounds value compiles its own scan
+program (the round count is static in ``_match_batch``), so every config
+gets one untimed warm-up pass on a distinct seed.
+
+Usage:
+    PYTHONPATH=. python benchmarks/sweep_auction_rounds.py \
+        [--brokers 200] [--partitions 5000] [--rounds 0,4,2,1]
+        [--out AUCTION_ROUNDS.json]
+
+Output: one JSON line per rounds value; ``--out`` persists the whole
+sweep (with the backend recorded — a CPU sweep must not masquerade as an
+accelerator measurement).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> None:
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+    _jc()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--brokers", type=int, default=200)
+    ap.add_argument("--partitions", type=int, default=5000)
+    ap.add_argument("--racks", type=int, default=0,
+                    help="0 = max(4, brokers/10)")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--mean-util", type=float, default=0.4)
+    ap.add_argument("--rounds", default="0,4,2,1",
+                    help="comma-separated auction_rounds values "
+                    "(0 = one round per alternate destination, the "
+                    "default = 8 at DESTS_PER_SOURCE alternates)")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    import jax
+
+    from cruise_control_tpu.analyzer.goal_optimizer import make_goals
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.analyzer.verifier import violation_score
+    from cruise_control_tpu.models.generators import random_cluster
+
+    racks = args.racks or max(4, args.brokers // 10)
+
+    def fixture(seed):
+        return random_cluster(
+            seed=seed, num_brokers=args.brokers, num_racks=racks,
+            num_partitions=args.partitions,
+            mean_utilization=args.mean_util,
+        )
+
+    state = fixture(args.seed)
+    goals = make_goals()
+    results = []
+    for rounds in [int(x) for x in args.rounds.split(",") if x]:
+        cfg = TpuSearchConfig(auction_rounds=rounds)
+        opt = TpuGoalOptimizer(config=cfg)
+        opt.optimize(fixture(args.seed + 1))  # warm-up: compile off-clock
+        t0 = time.perf_counter()
+        res = opt.optimize(state)
+        wall = time.perf_counter() - t0
+        row = {
+            "auction_rounds": rounds,
+            "wallclock_s": round(wall, 3),
+            "violation_score": violation_score(res.final_state, goals),
+            "actions": len(res.actions),
+            "device_calls": sum(
+                s.get("rounds", 0) for s in res.goal_summaries
+                if s["goal"] == "TpuSearch"
+            ),
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.out:
+        doc = {
+            "fixture": {"brokers": args.brokers,
+                        "partitions": args.partitions, "seed": args.seed,
+                        "racks": racks, "mean_util": args.mean_util},
+            "platform": jax.default_backend(),
+            "sweep": results,
+        }
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

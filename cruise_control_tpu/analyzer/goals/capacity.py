@@ -27,6 +27,7 @@ from cruise_control_tpu.analyzer.goals.base import (
     leadership_action,
     move_action,
     swap_action,
+    swap_partner_broker_mask,
 )
 
 
@@ -51,6 +52,9 @@ class ReplicaCapacityGoal(Goal):
         # destination and only swaps can still rebalance (upstream
         # ReplicaCapacityGoal actionAcceptance for REPLICA_SWAP)
         return True
+
+    def accept_swap_dest(self, ctx: AnalyzerContext, p1: int, s1: int) -> np.ndarray:
+        return np.ones(ctx.num_brokers, bool)
 
     def violations(self, ctx: AnalyzerContext) -> int:
         over = ctx.broker_replica_count > self._limit()
@@ -97,10 +101,13 @@ class CapacityGoal(Goal):
     reject_reason = "capacity-exceeded"
 
     def _limits(self, ctx: AnalyzerContext) -> np.ndarray:
-        """f64 [B] — absolute load limit per broker."""
-        return (
-            ctx.broker_capacity[:, self.resource].astype(np.float64)
-            * self.constraint.capacity_threshold[self.resource]
+        """f64 [B] — absolute load limit per broker (capacity × threshold
+        never changes during an optimization, so the array is cached for
+        the context's lifetime and frozen)."""
+        return ctx.static_memo(
+            (self.name, "limits"),
+            lambda: ctx.broker_capacity[:, self.resource].astype(np.float64)
+            * self.constraint.capacity_threshold[self.resource],
         )
 
     def _moved_load(self, ctx: AnalyzerContext, p: int, s: int) -> float:
@@ -145,6 +152,10 @@ class CapacityGoal(Goal):
         if d < 0 and cl[b2] > lim[b2]:  # b2 over limit, swap net-sheds it
             return bool(cl[b1] - d <= lim[b1])
         return bool(cl[b1] - d <= lim[b1] and cl[b2] + d <= lim[b2])
+
+    def accept_swap_dest(self, ctx: AnalyzerContext, p1: int, s1: int) -> np.ndarray:
+        # NET semantics: the verdict depends on the partner replica's load
+        return np.ones(ctx.num_brokers, bool)
 
     def violations(self, ctx: AnalyzerContext) -> int:
         over = ctx.broker_cap_load[:, self.resource] > self._limits(ctx) * (1 + 1e-9)
@@ -232,9 +243,12 @@ class CapacityGoal(Goal):
         util = ctx.broker_cap_load[:, r] / np.maximum(
             ctx.broker_capacity[:, r], 1e-9
         )
-        # hoisted out of the partner loop (round-5 swap-fallback slowdown):
-        # dest_candidates() rebuilds a [B] mask on every call
-        dest_ok = ctx.broker_alive & ctx.dest_candidates()
+        # partner-independent screen, ONCE per attempt (see the
+        # ResourceDistributionGoal fallback): exact, so screened brokers'
+        # replicas are never enumerated
+        dest_ok = swap_partner_broker_mask(ctx, p, s, self, optimized)
+        if not dest_ok.any():
+            return False
         order = np.argsort(np.where(dest_ok, util, np.inf))
         for b2 in order[: self.SWAP_PARTNER_BROKERS].tolist():
             if not dest_ok[b2]:

@@ -29,6 +29,7 @@ from cruise_control_tpu.analyzer.goals.base import (
     leadership_action,
     move_action,
     swap_action,
+    swap_partner_broker_mask,
 )
 
 
@@ -40,7 +41,15 @@ class ResourceDistributionGoal(Goal):
 
     # ---- bounds -----------------------------------------------------------------
     def _bounds(self, ctx: AnalyzerContext) -> Tuple[np.ndarray, np.ndarray]:
-        """(lower[B], upper[B]) absolute load bounds (NaN-free; dead = inf)."""
+        """(lower[B], upper[B]) absolute load bounds (NaN-free; dead = inf).
+
+        Memoized per context mutation: acceptance predicates re-derive the
+        bounds per candidate, and the swap fallback multiplies candidates
+        by partner replicas — uncached this was the bulk of the round-5
+        greedy slowdown.  The cached arrays are shared; never mutated."""
+        return ctx.memo((self.name, "bounds"), lambda: self._bounds_now(ctx))
+
+    def _bounds_now(self, ctx: AnalyzerContext) -> Tuple[np.ndarray, np.ndarray]:
         avg = ctx.avg_alive_utilization(self.resource)
         lo_u, up_u = self.constraint.balance_bounds(avg, self.resource)
         cap = ctx.broker_capacity[:, self.resource].astype(np.float64)
@@ -97,6 +106,11 @@ class ResourceDistributionGoal(Goal):
         if d >= 0:  # b1 sheds d, b2 gains d
             return bool(m[b1] - d >= lo[b1] and m[b2] + d <= up[b2])
         return bool(m[b2] + d >= lo[b2] and m[b1] - d <= up[b1])
+
+    def accept_swap_dest(self, ctx: AnalyzerContext, p1: int, s1: int) -> np.ndarray:
+        # NET semantics: the verdict depends on the partner replica's load,
+        # so no partner-independent necessary condition is screened here
+        return np.ones(ctx.num_brokers, bool)
 
     # ---- scoring ----------------------------------------------------------------
     def violations(self, ctx: AnalyzerContext) -> int:
@@ -187,10 +201,14 @@ class ResourceDistributionGoal(Goal):
         self._swap_attempts += 1
         l1 = self._moved(ctx, p, s)
         m = self._metric(ctx)
-        # hoisted out of the partner loop: dest_candidates() rebuilds a [B]
-        # mask per call and the argsort is O(B log B) — per-partner copies
-        # of both were the bulk of the fallback's cost (round-5 VERDICT)
-        dest_ok = ctx.broker_alive & ctx.dest_candidates()
+        # partner-independent screen, ONCE per attempt: structural
+        # legality + every goal's accept_swap_dest over all brokers.
+        # Exact — a screened-out broker could never host an accepted
+        # partner, so its replicas are never enumerated (pre-screen this
+        # fallback walked ~400 pairs per attempt through the full chain)
+        dest_ok = swap_partner_broker_mask(ctx, p, s, self, optimized)
+        if not dest_ok.any():
+            return False
         cold_order = np.argsort(np.where(dest_ok, m, np.inf))
         for b2 in cold_order[: self.SWAP_PARTNER_BROKERS].tolist():
             if not dest_ok[b2]:
@@ -273,9 +291,12 @@ class ReplicaDistributionGoal(Goal):
         return self.constraint.replica_balance_threshold
 
     def _bounds(self, ctx: AnalyzerContext) -> Tuple[int, int]:
-        alive = ctx.broker_alive
-        avg = float(self._counts(ctx)[alive].sum() / max(alive.sum(), 1))
-        return self.constraint.count_bounds(avg, self._threshold())
+        def compute() -> Tuple[int, int]:
+            alive = ctx.broker_alive
+            avg = float(self._counts(ctx)[alive].sum() / max(alive.sum(), 1))
+            return self.constraint.count_bounds(avg, self._threshold())
+
+        return ctx.memo((self.name, "bounds"), compute)
 
     def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
         lo, up = self._bounds(ctx)
@@ -288,6 +309,9 @@ class ReplicaDistributionGoal(Goal):
         self, ctx: AnalyzerContext, p1: int, s1: int, p2: int, s2: int
     ) -> bool:
         return True  # a swap preserves both brokers' replica counts
+
+    def accept_swap_dest(self, ctx: AnalyzerContext, p1: int, s1: int) -> np.ndarray:
+        return np.ones(ctx.num_brokers, bool)
 
     def violations(self, ctx: AnalyzerContext) -> int:
         lo, up = self._bounds(ctx)
@@ -327,11 +351,16 @@ class LeaderReplicaDistributionGoal(Goal):
     is_hard = False
 
     def _bounds(self, ctx: AnalyzerContext) -> Tuple[int, int]:
-        alive = ctx.broker_alive
-        avg = float(ctx.broker_leader_count[alive].sum() / max(alive.sum(), 1))
-        return self.constraint.count_bounds(
-            avg, self.constraint.leader_replica_balance_threshold
-        )
+        def compute() -> Tuple[int, int]:
+            alive = ctx.broker_alive
+            avg = float(
+                ctx.broker_leader_count[alive].sum() / max(alive.sum(), 1)
+            )
+            return self.constraint.count_bounds(
+                avg, self.constraint.leader_replica_balance_threshold
+            )
+
+        return ctx.memo((self.name, "bounds"), compute)
 
     def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
         if not ctx.is_leader(p, s):
@@ -369,6 +398,10 @@ class LeaderReplicaDistributionGoal(Goal):
         # already out of bounds may still improve)
         loser, gainer = (b1, b2) if dl > 0 else (b2, b1)
         return bool(c[loser] - 1 >= lo and c[gainer] + 1 <= up)
+
+    def accept_swap_dest(self, ctx: AnalyzerContext, p1: int, s1: int) -> np.ndarray:
+        # NET semantics (leader delta depends on the partner's leadership)
+        return np.ones(ctx.num_brokers, bool)
 
     def violations(self, ctx: AnalyzerContext) -> int:
         lo, up = self._bounds(ctx)
@@ -470,12 +503,15 @@ class LeaderBytesInDistributionGoal(Goal):
     is_hard = False
 
     def _bounds(self, ctx: AnalyzerContext) -> Tuple[np.ndarray, np.ndarray]:
-        alive = ctx.broker_alive
-        total = ctx.broker_leader_load[:, Resource.NW_IN].sum()
-        cap = ctx.broker_capacity[:, Resource.NW_IN].astype(np.float64)
-        avg = total / max(cap[alive].sum(), 1e-9)
-        lo_u, up_u = self.constraint.balance_bounds(avg, Resource.NW_IN)
-        return lo_u * cap, up_u * cap
+        def compute() -> Tuple[np.ndarray, np.ndarray]:
+            alive = ctx.broker_alive
+            total = ctx.broker_leader_load[:, Resource.NW_IN].sum()
+            cap = ctx.broker_capacity[:, Resource.NW_IN].astype(np.float64)
+            avg = total / max(cap[alive].sum(), 1e-9)
+            lo_u, up_u = self.constraint.balance_bounds(avg, Resource.NW_IN)
+            return lo_u * cap, up_u * cap
+
+        return ctx.memo((self.name, "bounds"), compute)
 
     def accept_leadership(self, ctx: AnalyzerContext, p: int, new_slot: int) -> bool:
         lo, up = self._bounds(ctx)

@@ -68,6 +68,12 @@ from cruise_control_tpu.ops.cost import (
     pack_pload,
 )
 from cruise_control_tpu.ops.grid import gather_pload as _gather_pload
+from cruise_control_tpu.ops.pools import (
+    POOL_RACK_PRIO,
+    pool_prio,
+    pool_row_tables,
+    pool_row_tables_update,
+)
 from cruise_control_tpu.telemetry import device_stats, tracing
 from cruise_control_tpu.utils.logging import get_logger
 
@@ -145,6 +151,36 @@ class TpuSearchConfig:
     #: halves; membership drift over ~4k changed partitions of 1M is
     #: negligible
     repool_steps: int = 128
+    #: pool-rebuild diet: carry the move-pool row tables (ops.pools) in
+    #: the search loop and refresh only the partitions the applied batches
+    #: touched since the last repool, falling back to the from-scratch
+    #: rebuild when the touched set outgrows ``repool_rows_budget`` (or on
+    #: the first build).  Exact — the refreshed tables are bit-identical
+    #: to a full recompute — so this is purely a bytes-moved diet: the
+    #: ~91 GB/rebuild measured in KERNEL_BUDGET_r04.md collapses to one
+    #: [P, S, 2] gather + the budgeted row refresh.  Statically disabled
+    #: when the budget covers every partition anyway (small fixtures keep
+    #: the lean program).
+    repool_incremental: bool = True
+    #: touched-partition rows refreshed per incremental repool before
+    #: falling back to a full rebuild.  Sized for the observed commit
+    #: rate: ~40 commits/step x 128-step windows ≈ 5k touched partitions
+    #: at north-star shapes
+    repool_rows_budget: int = 8192
+    #: drive-loop pipelining: device calls kept in flight beyond the one
+    #: whose result the host is processing (0 = serial round-trips).  The
+    #: speculative call k+1 runs on the device-updated model of call k and
+    #: is consumed ONLY when the host validates every action of call k (the
+    #: common case — the recheck is the f64 twin of the device math), so
+    #: the produced plan is bit-identical to serial mode; on any rejection
+    #: or convergence the in-flight calls are discarded and the loop
+    #: resyncs exactly as the serial loop does.  The win is the drive
+    #: loop's serial tail: fetch + host recheck + re-dispatch no longer
+    #: idle the device (seconds per call on a tunneled chip).  Ignored
+    #: (serial) when time_budget_s is set — the anytime deadline sizes
+    #: each call's step cap from live rate measurements that speculative
+    #: dispatch would have to guess.
+    pipeline_depth: int = 1
     #: actions committed per device step: budgeted-cohort commits plus
     #: disjoint auction winners, capped to this many best-scored actions.
     #: 0 = auto (scales with broker count: B//2 clamped to [32, 2048])
@@ -589,86 +625,41 @@ def _build_round_pools(
     ca: Dict[str, jax.Array],
     K: int,
     D: int,
+    tables: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Device-side candidate pruning for one round → (kp[K], ks[K], dest[D]).
 
     Source pool: top-K replicas by priority (offline ≫ on-over-bound-broker,
     tie-broken by replica size).  Dest pool: top-D least-loaded eligible
     brokers.
+
+    Mid-search recall note (the ranking's shape, see ops.pools.pool_prio):
+    once few brokers are over their balance BOUND, overage is zero almost
+    everywhere and ranking by raw size floods the pool with the largest
+    replicas — exactly the moves that overshoot and score infeasible,
+    starving the fine-balancing moves the tail actually commits.  The
+    priority therefore ranks by above-average stress plus a
+    surplus-matched size term.
+
+    ``tables`` (stored row tables from ops.pools) skips the [P, S]-scale
+    recompute — the scan loop's incremental repool passes its carried,
+    touched-row-refreshed tables here; ``None`` recomputes from scratch
+    (score-only rounds, first build).
     """
     P, S = m.assignment.shape
-    B = m.capacity.shape[0]
-    slot_exists = m.assignment != EMPTY_SLOT
-    cap = jnp.maximum(m.capacity, 1e-9)
-    util = m.broker_load / cap                           # [B, R]
-    overage = jnp.sum(jnp.maximum(util - ca["util_upper"], 0.0), axis=1)  # [B]
-    if m.broker_cload is not None:
-        # percentile-capacity overage is a hard-goal repair driver: brokers
-        # over their capacity-estimate limit must shed even when their mean
-        # utilization looks balanced
-        cutil = m.broker_cload / cap
-        overage = overage + 10.0 * jnp.sum(
-            jnp.maximum(cutil - ca["cap_threshold"], 0.0), axis=1
-        )
-    # replica priority [P, S]
-    is_leader = jnp.arange(S)[None, :] == m.leader_slot[:, None]
-    rload = jnp.where(
-        is_leader[:, :, None], m.leader_load[:, None, :], m.follower_load[:, None, :]
-    )
-    size = jnp.sum(rload / jnp.mean(cap, axis=0), axis=2)        # [P, S]
-    src_b = jnp.clip(m.assignment, 0)
-    # mid-search recall: once few brokers are over their balance BOUND,
-    # `overage` is zero almost everywhere and ranking by raw size floods
-    # the pool with the largest replicas — exactly the moves that overshoot
-    # and score infeasible/worthless, starving the fine-balancing moves the
-    # tail actually commits.  Rank instead by above-AVERAGE stress plus a
-    # surplus-matched size term (peaked where moving the replica brings its
-    # broker to target; a replica larger than the surplus scores down) —
-    # the same water-filling shape the budgeted matcher commits on.
-    alive_cap = jnp.where(m.alive[:, None], m.capacity, 0.0)
-    avg_u = jnp.sum(m.broker_load, axis=0) / jnp.maximum(
-        jnp.sum(alive_cap, axis=0), 1e-9
-    )
-    stress = jnp.sum(jnp.maximum(util - avg_u[None, :], 0.0), axis=1)  # [B]
-    # ONE [P, S, 3] row-gather for all three broker-table lookups
-    # (overage/stress/rack): three separate scalar gathers over the P·S
-    # axis were ~60 ms of the ~140 ms rebuild on the scalar unit — row
-    # gathers amortize the per-index cost across the row.  Rack ids are
-    # < 2^24, so the f32 round trip is exact.
-    btab = jnp.stack(
-        [overage, stress, m.rack.astype(jnp.float32)], axis=1
-    )                                                        # [B, 3]
-    g3 = btab[src_b]                                         # [P, S, 3]
-    surplus = g3[..., 1]
-    fit = surplus - jnp.abs(size - surplus)
-    prio = g3[..., 0] * 10.0 + surplus * 2.0 + fit
-    # rack-violating replicas (lower-indexed slot of same partition shares
-    # the rack) must enter the source pool for repair
-    racks = jnp.where(
-        slot_exists, g3[..., 2].astype(jnp.int32), -1
-    )                                                        # [P, S]
-    same_rack = racks[:, :, None] == racks[:, None, :]             # [P, s, k]
-    k_lt_s = jnp.arange(S)[:, None] > jnp.arange(S)[None, :]       # [s, k]: k < s
-    rack_dup = (
-        jnp.any(same_rack & k_lt_s[None, :, :] & slot_exists[:, None, :], axis=2)
-        & slot_exists
-    )
-    prio = prio + jnp.where(rack_dup, 1e5, 0.0)
-    prio = prio + jnp.where(m.must_move, 1e6, 0.0)
-    # excluded topics leave the pool — except must-move replicas, whose
-    # evacuation overrides exclusion (greedy parity: evacuate_offline_replicas)
-    eligible = slot_exists & (~m.excluded[:, None] | m.must_move)
-    prio = jnp.where(eligible, prio, -jnp.inf)
+    size, base = tables if tables is not None else pool_row_tables(m)
+    prio = pool_prio(m, ca, size, base)
     # Pool selection must be EXACT top-k whenever forced-priority
     # candidates exist — must-move (offline) replicas AND rack-violating
     # replicas both repair hard goals, and approx_max_k keeps one entry
     # per bin, so it can deterministically drop a placeable repair forever
     # (hard-goal failure).  Without forced candidates the pool is a recall
     # heuristic and the approx kernel is several times faster on the P·S
-    # axis.
+    # axis.  ``base`` carries the bonuses, so "any eligible rack repair or
+    # must-move row" reads off the stored table.
     flat = prio.reshape(-1)
     _, flat_idx = jax.lax.cond(
-        jnp.any(m.must_move) | jnp.any(rack_dup),
+        jnp.any(m.must_move) | jnp.any(base >= POOL_RACK_PRIO),
         lambda f: jax.lax.top_k(f, K),
         lambda f: jax.lax.approx_max_k(f, K),
         flat,
@@ -676,6 +667,7 @@ def _build_round_pools(
     kp = (flat_idx // S).astype(jnp.int32)
     ks = (flat_idx % S).astype(jnp.int32)
     # dest pool: least max-utilization eligible brokers
+    util = m.broker_load / jnp.maximum(m.capacity, 1e-9)
     dest_score = jnp.max(util, axis=1) + jnp.where(m.dest_ok, 0.0, jnp.inf)
     _, dest_pool = jax.lax.top_k(-dest_score, D)
     return kp, ks, dest_pool.astype(jnp.int32)
@@ -897,17 +889,49 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
     n_dev = mesh.shape[axis] if mesh is not None else 1
 
     def step(carry):
-        (m, ca, done, t, count, out, counts, pools, since_pool, sc, tb,
+        (m, ca, done, t, count, out, counts, pools, pt, since_pool, sc, tb,
          tpm, n_ovf, since_full, t_cap) = carry
-        need_pool = since_pool >= repool
-        pools = jax.lax.cond(
-            need_pool,
-            lambda: _build_pools(m, cfg, ca, K, D),
-            lambda: pools,
-        )
-        since_pool = jnp.where(need_pool, 0, since_pool)
+        size_t, base_t, tpp, pt_valid, n_incr = pt
         P, S = m.assignment.shape
         B = m.capacity.shape[0]
+        need_pool = since_pool >= repool
+        # pool-rebuild diet: when the carried row tables are valid and the
+        # touched set fits the row budget, refresh only those rows (exact)
+        # instead of the from-scratch [P, S]-scale rebuild.  Statically
+        # compiled out when the budget covers every partition anyway —
+        # small fixtures keep the lean full-rebuild program.
+        RB_POOL = min(P, cfg.repool_rows_budget)
+        incr_repool = cfg.repool_incremental and RB_POOL < P
+
+        def keep_pools():
+            return pools, size_t, base_t, pt_valid, jnp.int32(0)
+
+        def rebuild_pools():
+            if incr_repool:
+                can_incr = pt_valid & (jnp.sum(tpp) <= RB_POOL)
+                sz, bs = jax.lax.cond(
+                    can_incr,
+                    lambda: pool_row_tables_update(
+                        m, size_t, base_t, tpp, RB_POOL
+                    ),
+                    lambda: pool_row_tables(m),
+                )
+                was_incr = can_incr.astype(jnp.int32)
+            else:
+                sz, bs = pool_row_tables(m)
+                was_incr = jnp.int32(0)
+            return (
+                _build_pools(m, cfg, ca, K, D, tables=(sz, bs)), sz, bs,
+                jnp.bool_(True), was_incr,
+            )
+
+        pools, size_t, base_t, pt_valid, was_incr = jax.lax.cond(
+            need_pool, rebuild_pools, keep_pools
+        )
+        n_incr = n_incr + was_incr
+        # the rebuild consumed the touched set; commits below re-accumulate
+        tpp = jnp.where(need_pool, False, tpp)
+        since_pool = jnp.where(need_pool, 0, since_pool)
         Q = max(1, cfg.moves_per_src)
         NROW = (Q + 1) * B
         M_ = min(M, NROW)
@@ -1274,12 +1298,16 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             .at[jnp.clip(win_dst, 0)].max(take_f)
         )
         tpm = jnp.zeros(P, bool).at[jnp.clip(cand_p, 0)].max(take_f)
+        # accumulated since the last repool: the partitions whose rows the
+        # incremental rebuild must refresh
+        tpp = tpp | tpm
         # zero commits on fresh pools = converged; on stale pools = force a
         # repool next step and keep going
         done = done | ((c_step == 0) & (since_pool == 0))
         since_pool = jnp.where(c_step == 0, repool, since_pool + 1)
         return (m, ca, done, t + 1, count + c_step, out, counts, pools,
-                since_pool, sc, tb, tpm, n_ovf, since_full, t_cap)
+                (size_t, base_t, tpp, pt_valid, n_incr), since_pool, sc,
+                tb, tpm, n_ovf, since_full, t_cap)
 
     def cond_fn(slots):
         def cond(carry):
@@ -1314,17 +1342,22 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             jnp.full((Kl, R), -1, jnp.int32),
             jnp.full((Ll,), jnp.inf, jnp.float32),
         )
+        pt0 = (
+            jnp.zeros((P, S), jnp.float32), jnp.zeros((P, S), jnp.float32),
+            jnp.zeros(P, bool), jnp.bool_(False), jnp.int32(0),
+        )
         carry = jax.lax.while_loop(
             cond_fn(slots - M_), step,
             (m, ca, jnp.bool_(False), jnp.int32(0), jnp.int32(0), out0,
-             jnp.zeros((4, T), jnp.int32), pools0, jnp.int32(repool), sc0,
-             jnp.zeros(B, bool), jnp.zeros(P, bool), jnp.int32(0),
+             jnp.zeros((4, T), jnp.int32), pools0, pt0, jnp.int32(repool),
+             sc0, jnp.zeros(B, bool), jnp.zeros(P, bool), jnp.int32(0),
              jnp.int32(0), t_cap.astype(jnp.int32)),
         )
         m, done, t_end, count, out, counts, n_ovf = (
             carry[0], carry[2], carry[3], carry[4], carry[5], carry[6],
-            carry[12]
+            carry[13]
         )
+        n_incr = carry[8][4]
         meta = jnp.zeros((4, T + 2), jnp.float32)
         meta = meta.at[:, :T].set(counts.astype(jnp.float32))
         meta = meta.at[0, T].set(count.astype(jnp.float32))
@@ -1335,6 +1368,8 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # the anytime deadline reads this, robust to trailing zero-commit
         # steps
         meta = meta.at[2, T].set(t_end.astype(jnp.float32))
+        # row 3 tail: incremental (dieted) pool rebuilds this call
+        meta = meta.at[3, T].set(n_incr.astype(jnp.float32))
         return jnp.concatenate([out, meta], axis=1), m
 
     def run(m: DeviceModel, ca, t_cap=None):
@@ -1391,6 +1426,7 @@ def _fetch_scan_result(packed, T: int):
     diag = {
         "n_overflow": int(meta[1, T]),
         "steps_run": int(meta[2, T]),
+        "n_incremental_repool": int(meta[3, T]),
         "improving": meta[1, :T].astype(np.int64),
         "cohort": meta[2, :T].astype(np.int64),
         "auction": meta[3, :T].astype(np.int64),
@@ -1793,6 +1829,10 @@ class _HostEvaluator:
             return [], n_rej
 
         # ---- batched apply (numpy twin of ctx.apply for the disjoint set) ----
+        # mutating aggregates outside ctx.apply: stale memos (balance
+        # bounds, alive averages) must not survive into the next recheck
+        # or the swap-repair pass
+        ctx.invalidate()
         pm, sm = p[idx], sc[idx]
         t = ctx.partition_topic[pm]
         srcs, dsts = src[idx], dst[idx]
@@ -1973,11 +2013,14 @@ def _grid_top_r(cfg: TpuSearchConfig, neg_g, R: int):
     return jax.lax.top_k(neg_g, R)
 
 
-def _build_pools(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int):
+def _build_pools(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
+                 tables=None):
     """All P·S-scale candidate-pool selection in one place → (kp, ks,
-    dest_pool, lp, lsl)."""
+    dest_pool, lp, lsl).  ``tables`` = stored move-pool row tables (see
+    ops.pools); the leadership pool needs no table carry — its priority is
+    already one [P, S, 2] gather plus elementwise work."""
     P, S = m.assignment.shape
-    kp, ks, dest_pool = _build_round_pools(m, ca, K, D)
+    kp, ks, dest_pool = _build_round_pools(m, ca, K, D, tables=tables)
     lp, lsl = _leadership_pool(m, ca, _leadership_pool_size(P, S, K))
     return kp, ks, dest_pool, lp, lsl
 
@@ -2848,7 +2891,12 @@ class TpuGoalOptimizer:
         return K, min(D, B, max(8, cfg.candidate_budget // max(K, 1)))
 
     def _make_round_fn(self, K: int, D: int):
-        return _cached_round_fn(self.config, K, D, self.mesh)
+        # normalized like the scan fn: the score-only round program does
+        # not depend on the host drive-loop knob
+        return _cached_round_fn(
+            dataclasses.replace(self.config, pipeline_depth=0), K, D,
+            self.mesh,
+        )
 
     # ---- main loop ------------------------------------------------------------
     def optimize(
@@ -2937,8 +2985,13 @@ class TpuGoalOptimizer:
                 cfg = dataclasses.replace(
                     cfg, device_batch_per_step=int(np.clip(B // 2, 32, 2048))
                 )
-            scan_fn = _cached_scan_fn(cfg, K, D, cfg.steps_per_call,
-                                      self.mesh)
+            # pipeline_depth is a host-loop knob — the compiled program is
+            # identical at every depth, so it must not key the compile
+            # cache (flipping the knob would recompile a ~minute program)
+            scan_fn = _cached_scan_fn(
+                dataclasses.replace(cfg, pipeline_depth=0), K, D,
+                cfg.steps_per_call, self.mesh,
+            )
             # convergence exits via the device done flag / no-progress break;
             # the bound preserves the score-only path's total action budget
             # counted in *steps* (evacuations commit one per step), so
@@ -2954,7 +3007,36 @@ class TpuGoalOptimizer:
             #: dispatch/fetch overhead — the anytime deadline's rate model
             step_rate: Optional[float] = None
             n_capped_calls = 0
-            for _ in range(calls_budget):
+            # Drive-loop pipelining (one-deep double buffering on the
+            # packed result, depth-generalized): keep up to pipeline_depth
+            # speculative calls in flight, each dispatched on the
+            # device-updated model of its predecessor BEFORE the host
+            # blocks on that predecessor's result — so the fetch + exact
+            # recheck + re-dispatch tail no longer idles the device.  A
+            # speculative result is consumed only when its predecessor
+            # validated cleanly (m advanced to exactly the model the
+            # speculative call ran on), which makes the plan bit-identical
+            # to serial mode; rejections/convergence discard the in-flight
+            # tail.  Serial under a time budget: the per-call step caps
+            # come from live rate measurements.
+            depth = 0 if cfg.time_budget_s else max(0, cfg.pipeline_depth)
+            inflight: List[Tuple[jax.Array, DeviceModel]] = []
+
+            def dispatch_ahead(tip_model) -> None:
+                # enqueue-only (JAX async dispatch): the device chains the
+                # speculative call onto its predecessor's outputs while the
+                # host goes on to fetch/recheck the oldest result
+                while (
+                    len(inflight) < depth
+                    and n_calls + len(inflight) < calls_budget
+                ):
+                    tip = inflight[-1][1] if inflight else tip_model
+                    with tracing.span("analyzer.dispatch_ahead"):
+                        inflight.append(
+                            scan_fn(tip, ca, np.int32(cfg.steps_per_call))
+                        )
+
+            while n_calls < calls_budget:
                 if budget_exhausted():
                     LOG.info(
                         "anytime budget (%.1fs) exhausted after %d calls",
@@ -2977,25 +3059,43 @@ class TpuGoalOptimizer:
                     else:
                         t_cap = min(cfg.steps_per_call, 256)
                 call_t0 = time.perf_counter()
-                # ALWAYS pass t_cap (steps_per_call when uncapped): a
-                # scalar argument binds by shape, so capped and uncapped
-                # calls share ONE compiled executable instead of the 2-arg
-                # signature tracing its own variant.  np.int32, NOT
-                # jnp.asarray: a committed single-device array cannot be
-                # auto-replicated into a multi-process mesh (the multihost
-                # dryrun), while numpy inputs are treated as replicated
-                with tracing.device_span("analyzer.scan") as dsp:
-                    packed, m_new = scan_fn(
-                        m, ca,
-                        np.int32(
-                            cfg.steps_per_call if t_cap is None else t_cap
-                        ),
-                    )
-                    dsp.block(packed)
+                if inflight:
+                    packed, m_new = inflight.pop(0)
+                else:
+                    # ALWAYS pass t_cap (steps_per_call when uncapped): a
+                    # scalar argument binds by shape, so capped and uncapped
+                    # calls share ONE compiled executable instead of the
+                    # 2-arg signature tracing its own variant.  np.int32,
+                    # NOT jnp.asarray: a committed single-device array
+                    # cannot be auto-replicated into a multi-process mesh
+                    # (the multihost dryrun), while numpy inputs are
+                    # treated as replicated
+                    with tracing.device_span("analyzer.scan") as dsp:
+                        packed, m_new = scan_fn(
+                            m, ca,
+                            np.int32(
+                                cfg.steps_per_call if t_cap is None else t_cap
+                            ),
+                        )
+                        if not depth:
+                            dsp.block(packed)
                 n_calls += 1
                 evaluator.round_index = n_calls
                 if t_cap is not None:
                     n_capped_calls += 1
+                if depth:
+                    # issue round k+1 before touching round k's result,
+                    # then block: the wait is the pipeline's residual
+                    # exposure, visible as its own phase.  Speculation
+                    # starts at the SECOND call — the first call's verdict
+                    # (converged?) isn't known yet, and single-call
+                    # searches (re-optimizing an already-balanced cluster,
+                    # the steady-state production case) must not pay a
+                    # wasted device call for the pipeline they cannot use
+                    if n_calls >= 2:
+                        dispatch_ahead(m_new)
+                    with tracing.device_span("analyzer.fetch_wait") as dsp:
+                        dsp.block(packed)
                 with tracing.span("analyzer.fetch"):
                     (k_all, p_all, s_all, d_all, step_counts, device_done,
                      diag) = _fetch_scan_result(packed, cfg.steps_per_call)
@@ -3042,14 +3142,19 @@ class TpuGoalOptimizer:
                 if not batch:
                     LOG.debug("device call %d: nothing validated — stopping",
                               n_calls)
+                    inflight.clear()
                     break  # nothing validated — no further progress possible
                 if not rejected:
+                    # clean validation: the model advances to exactly the
+                    # state the oldest speculative call ran on, so the
+                    # pipeline's results stay valid (plan identity)
                     m = m_new
                     # device_done = a freshly-repooled step committed
                     # nothing: converged under the pool regime (the same
                     # signal a fresh call committing nothing used to give,
                     # without the extra round-trip)
                     if device_done:
+                        inflight.clear()
                         break
                 else:
                     LOG.debug(
@@ -3058,7 +3163,9 @@ class TpuGoalOptimizer:
                         rejected,
                     )
                     # device state includes skipped actions — rebuild from
-                    # the live context before the next call
+                    # the live context before the next call; speculative
+                    # calls ran on that stale state and are discarded
+                    inflight.clear()
                     with tracing.device_span("analyzer.resync") as dsp:
                         m = dsp.block(_resync_device_model(m, ctx))
             LOG.info(

@@ -178,6 +178,9 @@ def _tpu_search_config(cfg: CruiseControlConfig):
         scoring=cfg.get("tpu.search.scoring"),
         steps_per_call=cfg.get_int("tpu.search.steps.per.call"),
         repool_steps=cfg.get_int("tpu.search.repool.steps"),
+        repool_incremental=cfg.get_boolean("tpu.search.repool.incremental"),
+        repool_rows_budget=cfg.get_int("tpu.search.repool.rows.budget"),
+        pipeline_depth=cfg.get_int("tpu.search.pipeline.depth"),
         incremental_rescore=cfg.get_boolean(
             "tpu.search.incremental.rescore"),
         rescore_rows_budget=cfg.get_int("tpu.search.rescore.rows.budget"),

@@ -693,6 +693,25 @@ def default_config_def() -> ConfigDef:
     d.define("tpu.search.repool.steps", ConfigType.INT, 128,
              Importance.LOW, "Steps between on-device candidate-pool "
              "rebuilds.", at_least(1), G)
+    d.define("tpu.search.repool.incremental", ConfigType.BOOLEAN, True,
+             Importance.LOW,
+             "Pool-rebuild diet: carry the move-pool row tables in the "
+             "search loop and refresh only the partitions the applied "
+             "batches touched since the last repool (exact; bit-identical "
+             "tables), falling back to a full rebuild when the touched set "
+             "outgrows tpu.search.repool.rows.budget.", None, G)
+    d.define("tpu.search.repool.rows.budget", ConfigType.INT, 8192,
+             Importance.LOW,
+             "Touched-partition rows refreshed per incremental pool "
+             "rebuild before falling back to a full rebuild.",
+             at_least(1), G)
+    d.define("tpu.search.pipeline.depth", ConfigType.INT, 1,
+             Importance.MEDIUM,
+             "Drive-loop pipelining: speculative device calls kept in "
+             "flight beyond the one whose result the host is processing "
+             "(0 = serial round-trips).  Plans are bit-identical either "
+             "way; serial is forced while tpu.search.time.budget.s is "
+             "set.", at_least(0), G)
     d.define("tpu.search.incremental.rescore", ConfigType.BOOLEAN, False,
              Importance.LOW,
              "Patch only staleness-touched grid entries between repools "

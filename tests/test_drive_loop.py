@@ -1,0 +1,323 @@
+"""Drive-loop pipelining + pool-rebuild diet contracts.
+
+* Plan identity: the pipelined drive loop (speculative device calls in
+  flight) must produce a BIT-IDENTICAL plan to serial mode — the
+  speculative call k+1 runs on call k's device-updated model, which is
+  exactly the model the serial loop would have dispatched on whenever the
+  host validated call k cleanly.
+* Pool-rebuild diet: the incrementally refreshed pool row tables
+  (ops.pools) must equal a from-scratch recompute bit-for-bit, and the
+  engine must produce the same plan with the diet on or off (including
+  the budget-breach fallback).
+* Perf regression guard: the compiled scan step's primitive count is
+  budgeted (tests/budgets/scan_jaxpr_budget.json) so kernel-count
+  regressions are caught on CPU CI without a TPU.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import tpu_optimizer as T
+from cruise_control_tpu.analyzer.context import AnalyzerContext
+from cruise_control_tpu.analyzer.tpu_optimizer import (
+    TpuGoalOptimizer,
+    TpuSearchConfig,
+)
+from cruise_control_tpu.models.generators import Distribution, random_cluster
+from cruise_control_tpu.ops.pools import (
+    pool_row_tables,
+    pool_row_tables_update,
+)
+
+BUDGET_PATH = os.path.join(
+    os.path.dirname(__file__), "budgets", "scan_jaxpr_budget.json"
+)
+
+
+def _action_tuples(result):
+    return [
+        (a.action_type, a.partition, a.slot, a.source_broker,
+         a.dest_broker, a.dest_slot)
+        for a in result.actions
+    ]
+
+
+def test_pipelined_drive_loop_plan_identity_seeded():
+    """Seeded 50b/1k (the driver-bench fixture) with small per-call step
+    budgets so the search takes MANY device calls — the regime where the
+    pipeline actually consumes speculative results."""
+    state = random_cluster(
+        seed=42, num_brokers=50, num_racks=10, num_partitions=1000
+    )
+    base = dict(
+        steps_per_call=16, repool_steps=8, device_batch_per_step=16,
+        max_rounds=40,
+    )
+    plans = {}
+    for depth in (0, 1, 3):
+        cfg = TpuSearchConfig(pipeline_depth=depth, **base)
+        res = TpuGoalOptimizer(config=cfg).optimize(state)
+        plans[depth] = _action_tuples(res)
+    assert plans[1] == plans[0], "depth-1 pipeline must match serial plan"
+    assert plans[3] == plans[0], "depth-3 pipeline must match serial plan"
+    assert plans[0], "fixture must produce a non-trivial plan"
+
+
+def test_pipelined_drive_loop_plan_identity_saturated():
+    """Count-saturated over-capacity fixture: the run ends in host-side
+    swap repair after rejections/hard-goal residue — exactly the paths
+    that must discard the speculative tail instead of consuming it."""
+    from cruise_control_tpu.analyzer.goals.base import BalancingConstraint
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.models.builder import ClusterModelBuilder
+
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 1e9, Resource.NW_IN: 1e9, Resource.NW_OUT: 1e9,
+           Resource.DISK: 100.0}
+    b0 = b.add_broker("r0", cap)
+    b1 = b.add_broker("r1", cap)
+
+    def disk(mb):
+        return {Resource.CPU: 0.1, Resource.NW_IN: 0.1,
+                Resource.NW_OUT: 0.1, Resource.DISK: mb}
+
+    b.add_partition("T", [b0], disk(60.0))
+    b.add_partition("T", [b0], disk(30.0))
+    b.add_partition("T", [b1], disk(10.0))
+    b.add_partition("T", [b1], disk(5.0))
+    state = b.build()
+    constraint = BalancingConstraint(max_replicas_per_broker=2)
+    base = dict(max_rounds=40, topk_per_round=128, max_moves_per_round=32)
+    plans = {}
+    for depth in (0, 2):
+        cfg = TpuSearchConfig(pipeline_depth=depth, **base)
+        res = TpuGoalOptimizer(config=cfg, constraint=constraint).optimize(
+            state
+        )
+        plans[depth] = _action_tuples(res)
+    assert plans[2] == plans[0]
+
+
+def test_incremental_pool_row_tables_bit_identical():
+    """After a batch of placement mutations, refreshing only the touched
+    rows must reproduce the from-scratch tables bit-for-bit, and the pools
+    selected from them must be identical."""
+    state = random_cluster(
+        seed=17, num_brokers=20, num_racks=5, num_partitions=300,
+        distribution=Distribution.EXPONENTIAL,
+    )
+    opt = TpuGoalOptimizer()
+    ctx = AnalyzerContext(state)
+    m = opt._device_model(ctx)
+    ca = opt._constraint_arrays(ctx)
+    size0, base0 = pool_row_tables(m)
+
+    # N applied batches: random replica moves + leadership flips touching
+    # a known partition set (table maintenance only cares about placement,
+    # not feasibility)
+    rng = np.random.default_rng(0)
+    P, S = ctx.num_partitions, ctx.max_rf
+    touched = np.zeros(P, bool)
+    assignment = np.array(m.assignment)
+    leader_slot = np.array(m.leader_slot)
+    for _ in range(4):  # 4 batches of 12 mutations
+        ps = rng.choice(P, size=12, replace=False)
+        for p in ps:
+            s = int(rng.integers(0, S))
+            if rng.random() < 0.5 and assignment[p, s] >= 0:
+                assignment[p, s] = int(rng.integers(0, ctx.num_brokers))
+            occupied = np.nonzero(assignment[p] >= 0)[0]
+            if occupied.size:
+                leader_slot[p] = int(rng.choice(occupied))
+            touched[p] = True
+    m2 = dataclasses.replace(
+        m,
+        assignment=jnp.asarray(assignment),
+        leader_slot=jnp.asarray(leader_slot),
+    )
+
+    full_size, full_base = pool_row_tables(m2)
+    incr_size, incr_base = pool_row_tables_update(
+        m2, size0, base0, jnp.asarray(touched), rows_budget=64
+    )
+    assert np.array_equal(np.asarray(incr_size), np.asarray(full_size))
+    assert np.array_equal(np.asarray(incr_base), np.asarray(full_base))
+
+    K, D = opt._pool_sizes(P, S, ctx.num_brokers)
+    m2 = T._recompute_aggregates(m2)
+    ref = T._build_round_pools(m2, ca, K, D)
+    via_tables = T._build_round_pools(
+        m2, ca, K, D, tables=(incr_size, incr_base)
+    )
+    for a, b in zip(ref, via_tables):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_incremental_repool_scan_equivalence():
+    """The device scan with the diet ON (small row budget, so both the
+    incremental path and the breach fallback execute) commits the same
+    actions as the diet OFF — the packed results' action columns and
+    convergence meta are identical."""
+    state = random_cluster(
+        seed=3, num_brokers=20, num_racks=5, num_partitions=300,
+        distribution=Distribution.EXPONENTIAL, mean_utilization=0.4,
+    )
+    opt = TpuGoalOptimizer()
+    ctx = AnalyzerContext(state)
+    m = opt._device_model(ctx)
+    ca = {
+        k: jnp.asarray(v) for k, v in opt._constraint_arrays_np(ctx).items()
+    }
+    K, D = opt._pool_sizes(ctx.num_partitions, ctx.max_rf, ctx.num_brokers)
+    # device_batch_per_step must exceed the per-step commit rate or the
+    # slot budget (repool window x batch cap) ends every call exactly at
+    # one window and the in-call incremental rebuild never runs
+    base = dict(steps_per_call=32, repool_steps=4, device_batch_per_step=32)
+    packs = {}
+    diags = {}
+    # budgets must be < P or the diet is statically compiled out; 128
+    # covers every 4-step window's touched set (<= 32 partitions), 24
+    # forces breach fallbacks
+    for incr, budget in ((False, 8192), (True, 24), (True, 128)):
+        cfg = TpuSearchConfig(
+            repool_incremental=incr, repool_rows_budget=budget, **base
+        )
+        scan_fn = T._cached_scan_fn(cfg, K, D, cfg.steps_per_call, None)
+        packed, _ = scan_fn(m, ca, np.int32(cfg.steps_per_call))
+        arr = np.asarray(packed)
+        res = T._fetch_scan_result(packed, cfg.steps_per_call)
+        packs[(incr, budget)] = arr
+        diags[(incr, budget)] = res[-1]
+    T_ = base["steps_per_call"]
+    slots = packs[(False, 8192)].shape[1] - (T_ + 2)
+    for key in ((True, 24), (True, 128)):
+        ref, got = packs[(False, 8192)], packs[key]
+        # action columns + counts/total/done meta must match exactly; the
+        # row-3 tail cell is the incremental-rebuild count and may differ
+        assert np.array_equal(ref[:, :slots], got[:, :slots]), key
+        assert np.array_equal(ref[0, slots:], got[0, slots:]), key
+    # the tiny budget (24 rows against ~60-80 touched partitions per
+    # 4-step window) must exercise BOTH regimes; the 128-row budget stays
+    # incremental
+    assert diags[(True, 128)]["n_incremental_repool"] > 0
+    roomy = diags[(True, 128)]["n_incremental_repool"]
+    tight = diags[(True, 24)]["n_incremental_repool"]
+    assert tight <= roomy, "breach must fall back to full rebuilds"
+
+
+def test_engine_plan_identity_with_pool_diet():
+    """End-to-end: diet on vs off produces identical plans through the
+    full engine (host recheck, resync, swap repair and all)."""
+    state = random_cluster(
+        seed=5, num_brokers=12, num_racks=4, num_partitions=120,
+        dead_brokers=2,
+    )
+    base = dict(
+        max_rounds=40, topk_per_round=128, max_moves_per_round=32,
+        steps_per_call=32, repool_steps=4, device_batch_per_step=8,
+    )
+    on = TpuGoalOptimizer(
+        config=TpuSearchConfig(repool_incremental=True,
+                               repool_rows_budget=16, **base)
+    ).optimize(state)
+    off = TpuGoalOptimizer(
+        config=TpuSearchConfig(repool_incremental=False, **base)
+    ).optimize(state)
+    assert _action_tuples(on) == _action_tuples(off)
+
+
+# ---------------------------------------------------------------------------------
+# Perf regression guard: jaxpr primitive budget of the scan step
+# ---------------------------------------------------------------------------------
+
+def _count_primitives(jaxpr) -> dict:
+    """Recursive primitive census of a (Closed)Jaxpr, descending into
+    control-flow/pjit sub-jaxprs."""
+    core = jax.core
+    counts: dict = {}
+
+    def walk(j):
+        j = getattr(j, "jaxpr", j)
+        for eqn in j.eqns:
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+            for v in eqn.params.values():
+                for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                    if isinstance(sub, (core.Jaxpr, core.ClosedJaxpr)):
+                        walk(sub)
+
+    walk(jaxpr)
+    return counts
+
+
+#: the fixed shape the budget is taken at — tiny (trace cost only), but
+#: the program structure (while/cond bodies, incremental-repool branch)
+#: is shape-independent
+_BUDGET_CFG = dict(
+    steps_per_call=4, repool_steps=2, device_batch_per_step=4,
+    max_source_replicas=64, max_dest_brokers=8, repool_rows_budget=16,
+)
+
+
+def _scan_jaxpr_counts() -> dict:
+    state = random_cluster(seed=7, num_brokers=8, num_racks=4,
+                           num_partitions=40)
+    cfg = TpuSearchConfig(**_BUDGET_CFG)
+    opt = TpuGoalOptimizer(config=cfg)
+    ctx = AnalyzerContext(state)
+    m = opt._device_model(ctx)
+    ca = {
+        k: jnp.asarray(v) for k, v in opt._constraint_arrays_np(ctx).items()
+    }
+    K, D = opt._pool_sizes(ctx.num_partitions, ctx.max_rf, ctx.num_brokers)
+    scan_fn = T._cached_scan_fn(cfg, K, D, cfg.steps_per_call, None)
+    jaxpr = jax.make_jaxpr(
+        lambda mm, cc, tc: scan_fn(mm, cc, tc)
+    )(m, ca, jnp.int32(cfg.steps_per_call))
+    return _count_primitives(jaxpr)
+
+
+def write_budget() -> None:
+    """Regenerate the checked-in budget (run on an INTENDED program
+    change): ``python -c "import tests.test_drive_loop as t;
+    t.write_budget()"`` from the repo root."""
+    counts = _scan_jaxpr_counts()
+    os.makedirs(os.path.dirname(BUDGET_PATH), exist_ok=True)
+    with open(BUDGET_PATH, "w") as f:
+        json.dump(
+            {"total": sum(counts.values()), "by_primitive": counts},
+            f, indent=1, sort_keys=True,
+        )
+        f.write("\n")
+
+
+def test_scan_step_primitive_budget():
+    """The scan program's primitive count must not grow more than 10%
+    over the checked-in budget — the CPU-CI proxy for the kernel-count
+    regressions KERNEL_BUDGET_r04.md tracks on the TPU.  On an intended
+    program change, regenerate with :func:`write_budget`."""
+    assert os.path.exists(BUDGET_PATH), (
+        f"missing {BUDGET_PATH} — generate it with the command in this "
+        "test's docstring"
+    )
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    counts = _scan_jaxpr_counts()
+    total = sum(counts.values())
+    ceiling = int(budget["total"] * 1.10)
+    if total > ceiling:
+        grown = {
+            k: (v, budget["by_primitive"].get(k, 0))
+            for k, v in sorted(counts.items())
+            if v > budget["by_primitive"].get(k, 0)
+        }
+        pytest.fail(
+            f"scan program grew to {total} primitives "
+            f"(budget {budget['total']}, +10% ceiling {ceiling}); "
+            f"grown primitives (now, budget): {grown}"
+        )
