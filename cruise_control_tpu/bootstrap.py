@@ -627,6 +627,9 @@ def build_app(
                 ),
                 margin=cfg.get_double("metric.anomaly.percentile.margin"),
                 min_windows=cfg.get_int("metric.anomaly.min.windows"),
+                lower_percentile=cfg.get_double(
+                    "metric.anomaly.percentile.lower.threshold"
+                ),
             )
         else:
             metric_finder = cls()
@@ -645,6 +648,15 @@ def build_app(
         detection_goal_names=cfg.get_list("anomaly.detection.goals") or None,
         self_healing_goal_names=healing_goals or None,
         metric_finder=metric_finder,
+        goal_violation_threshold_multiplier=cfg.get_double(
+            "goal.violation.distribution.threshold.multiplier"
+        ),
+        topic_anomaly_min_bad_partitions=cfg.get_int(
+            "topic.anomaly.min.bad.partitions"
+        ),
+        disk_failure_min_offline_dirs=cfg.get_int(
+            "disk.failure.min.offline.dirs"
+        ),
         detection_interval_ms=cfg.get("anomaly.detection.interval.ms"),
         per_type_interval_ms=_per_type_detector_intervals(cfg),
         fix_cooldown_ms=cfg.get("self.healing.cooldown.ms"),

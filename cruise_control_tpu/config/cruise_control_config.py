@@ -557,6 +557,24 @@ def default_config_def() -> ConfigDef:
     d.define("metric.anomaly.min.windows", ConfigType.INT, 3,
              Importance.LOW, "Minimum windows of history before metric "
              "anomalies are considered.", at_least(1), G)
+    d.define("metric.anomaly.percentile.lower.threshold", ConfigType.DOUBLE,
+             0.0, Importance.LOW, "History percentile a latest-window "
+             "metric must COLLAPSE below (by the margin) to be anomalous "
+             "— a broker gone quiet is as suspicious as one gone hot; "
+             "0 disables the lower-side check.", between(0, 100), G)
+    d.define("goal.violation.distribution.threshold.multiplier",
+             ConfigType.DOUBLE, 1.0, Importance.MEDIUM,
+             "Widen every balance-threshold gap by this factor during "
+             "goal-violation DETECTION only (upstream "
+             "AnomalyDetectorConfig), so a cluster balanced to the "
+             "optimizer's threshold doesn't re-trigger self-healing on "
+             "drift noise.", at_least(1), G)
+    d.define("topic.anomaly.min.bad.partitions", ConfigType.INT, 1,
+             Importance.LOW, "Under-replicated partitions tolerated "
+             "before the topic-anomaly RF repair fires.", at_least(1), G)
+    d.define("disk.failure.min.offline.dirs", ConfigType.INT, 1,
+             Importance.LOW, "Offline log dirs a broker must accumulate "
+             "before the disk-failure detector reports it.", at_least(1), G)
     d.define("self.healing.target.topic.replication.factor", ConfigType.INT,
              None, Importance.LOW, "Target RF for the topic-anomaly "
              "detector; None reads cluster.configs.file.", None, G)

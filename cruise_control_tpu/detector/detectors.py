@@ -10,6 +10,7 @@ drives the manager, tests drive it directly.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from typing import Dict, List, Optional, Sequence
@@ -36,12 +37,41 @@ class GoalViolationDetector:
     expose ``violations()`` directly, so no clone mutation is needed)."""
 
     def __init__(self, cruise_control, goal_names: Optional[Sequence[str]] = None,
-                 fix_goal_names: Optional[Sequence[str]] = None):
+                 fix_goal_names: Optional[Sequence[str]] = None,
+                 threshold_multiplier: float = 1.0):
         self.cc = cruise_control
         self.goal_names = list(goal_names) if goal_names else None
         #: self.healing.goals: goal subset the FIX runs with (None = the
         #: instance's full default stack)
         self.fix_goal_names = list(fix_goal_names) if fix_goal_names else None
+        #: goal.violation.distribution.threshold.multiplier (upstream
+        #: AnomalyDetectorConfig): detection tolerates this much more
+        #: imbalance than the optimizer targets, so a cluster freshly
+        #: balanced to threshold T doesn't re-trigger on drift noise
+        self.threshold_multiplier = float(threshold_multiplier)
+
+    def _detection_constraint(self):
+        constraint = self.cc.constraint
+        m = self.threshold_multiplier
+        if m == 1.0:
+            return constraint
+        # thresholds are 1+gap ratios: the multiplier widens the gap
+        return dataclasses.replace(
+            constraint,
+            balance_threshold={
+                r: 1.0 + (v - 1.0) * m
+                for r, v in constraint.balance_threshold.items()
+            },
+            replica_balance_threshold=(
+                1.0 + (constraint.replica_balance_threshold - 1.0) * m
+            ),
+            leader_replica_balance_threshold=(
+                1.0 + (constraint.leader_replica_balance_threshold - 1.0) * m
+            ),
+            topic_replica_balance_threshold=(
+                1.0 + (constraint.topic_replica_balance_threshold - 1.0) * m
+            ),
+        )
 
     def detect(self, now_ms: int) -> List[Anomaly]:
         try:
@@ -50,7 +80,7 @@ class GoalViolationDetector:
         except NotEnoughValidWindowsError:
             return []  # not enough data yet; upstream skips the round too
         ctx = AnalyzerContext(state)
-        goals = make_goals(self.goal_names, self.cc.constraint)
+        goals = make_goals(self.goal_names, self._detection_constraint())
         violated = {
             g.name: v for g in goals if (v := g.violations(ctx)) > 0
         }
@@ -109,15 +139,22 @@ class DiskFailureDetector:
     via AdminClient describeLogDirs; here the backend's optional
     ``offline_log_dirs()`` capability)."""
 
-    def __init__(self, cruise_control, backend):
+    def __init__(self, cruise_control, backend, min_offline_dirs: int = 1):
         self.cc = cruise_control
         self.backend = backend
+        #: disk.failure.min.offline.dirs: brokers below this offline-dir
+        #: count are tolerated (a single flapping mount on a wide JBOD
+        #: layout needn't trigger a cluster-wide evacuation)
+        self.min_offline_dirs = max(1, int(min_offline_dirs))
 
     def detect(self, now_ms: int) -> List[Anomaly]:
         probe = getattr(self.backend, "offline_log_dirs", None)
         if probe is None:
             return []
-        offline: Dict[int, List[str]] = probe()
+        offline: Dict[int, List[str]] = {
+            b: dirs for b, dirs in probe().items()
+            if len(dirs) >= self.min_offline_dirs
+        }
         if not offline:
             return []
         return [DiskFailures(now_ms, offline)]
@@ -129,10 +166,16 @@ class PercentileMetricAnomalyFinder:
     ``upper_percentile`` of that broker's own history by ``margin``×."""
 
     def __init__(self, upper_percentile: float = 95.0, margin: float = 1.5,
-                 min_windows: int = 3):
+                 min_windows: int = 3, lower_percentile: float = 0.0):
         self.upper_percentile = upper_percentile
         self.margin = margin
         self.min_windows = min_windows
+        #: metric.anomaly.percentile.lower.threshold: when > 0, a metric
+        #: COLLAPSING below this percentile of its own history (by the same
+        #: margin) is anomalous too — a broker gone quiet is as suspicious
+        #: as a broker gone hot (upstream finder checks both sides).  0
+        #: keeps the historical upper-side-only behavior.
+        self.lower_percentile = lower_percentile
 
     def find(self, now_ms: int, values: np.ndarray, metric_names: Sequence[str],
              ) -> List[MetricAnomaly]:
@@ -149,6 +192,15 @@ class PercentileMetricAnomalyFinder:
                 now_ms, int(b), metric_names[int(m)],
                 float(latest[b, m]), float(thresh[b, m] * self.margin),
             ))
+        if self.lower_percentile > 0:
+            lo = np.percentile(history, self.lower_percentile, axis=1)
+            floor = lo / self.margin
+            sag = (latest < floor) & (floor > 1e-9)
+            for b, m in zip(*np.nonzero(sag)):
+                out.append(MetricAnomaly(
+                    now_ms, int(b), metric_names[int(m)],
+                    float(latest[b, m]), float(floor[b, m]),
+                ))
         return out
 
 
@@ -175,23 +227,30 @@ class TopicReplicationFactorAnomalyFinder:
     """Partitions whose live RF is below the target (upstream
     ``TopicReplicationFactorAnomalyFinder``)."""
 
-    def __init__(self, target_rf: int):
+    def __init__(self, target_rf: int, min_bad_partitions: int = 1):
         self.target_rf = target_rf
+        #: topic.anomaly.min.bad.partitions: tolerance before an RF-repair
+        #: fires — a single under-replicated partition mid-churn needn't
+        #: trigger a cluster-wide RF pass
+        self.min_bad_partitions = max(1, int(min_bad_partitions))
 
     def find(self, now_ms: int, topo) -> List[TopicAnomaly]:
         bad = [
             p for p, reps in topo.assignment.items()
             if len(set(reps)) < self.target_rf
         ]
-        if not bad:
+        if len(bad) < self.min_bad_partitions:
             return []
         return [TopicAnomaly(now_ms, self.target_rf, sorted(bad))]
 
 
 class TopicAnomalyDetector:
-    def __init__(self, cruise_control, target_rf: int):
+    def __init__(self, cruise_control, target_rf: int,
+                 min_bad_partitions: int = 1):
         self.cc = cruise_control
-        self.finder = TopicReplicationFactorAnomalyFinder(target_rf)
+        self.finder = TopicReplicationFactorAnomalyFinder(
+            target_rf, min_bad_partitions
+        )
 
     def detect(self, now_ms: int) -> List[Anomaly]:
         topo = self.cc.load_monitor.metadata.refresh()

@@ -172,6 +172,9 @@ class AnomalyDetectorManager:
             description=anomaly.description,
             action=final,
             fixStarted=record["fixStarted"],
+            # the cycle's clock (virtual under the scenario simulator) —
+            # detection-latency assertions read the journal alone
+            timeMs=now_ms,
             error=record.get("error"),
         )
         with self._history_lock:
@@ -245,6 +248,9 @@ def make_detector_manager(
     detection_goal_names=None,
     self_healing_goal_names=None,
     metric_finder=None,
+    goal_violation_threshold_multiplier: float = 1.0,
+    topic_anomaly_min_bad_partitions: int = 1,
+    disk_failure_min_offline_dirs: int = 1,
     **kwargs,
 ) -> AnomalyDetectorManager:
     """Assemble the full upstream detector set for a facade instance."""
@@ -261,6 +267,7 @@ def make_detector_manager(
         AnomalyType.GOAL_VIOLATION: GoalViolationDetector(
             cruise_control, goal_names=detection_goal_names,
             fix_goal_names=self_healing_goal_names,
+            threshold_multiplier=goal_violation_threshold_multiplier,
         ),
         AnomalyType.BROKER_FAILURE: BrokerFailureDetector(
             cruise_control, broker_failure_persist_path
@@ -274,11 +281,13 @@ def make_detector_manager(
     }
     if backend is not None:
         detectors[AnomalyType.DISK_FAILURE] = DiskFailureDetector(
-            cruise_control, backend
+            cruise_control, backend,
+            min_offline_dirs=disk_failure_min_offline_dirs,
         )
     if target_rf is not None:
         detectors[AnomalyType.TOPIC_ANOMALY] = TopicAnomalyDetector(
-            cruise_control, target_rf
+            cruise_control, target_rf,
+            min_bad_partitions=topic_anomaly_min_bad_partitions,
         )
     return AnomalyDetectorManager(
         cruise_control, detectors, notifier=notifier, **kwargs

@@ -57,6 +57,9 @@ def random_cluster(
     mean_utilization: float = 0.35,
     dead_brokers: int = 0,
     new_brokers: int = 0,
+    rack_aware: bool = False,
+    hot_partitions: int = 0,
+    hot_factor: float = 8.0,
 ) -> ClusterState:
     """Generate a random-but-seeded cluster in upstream RandomCluster's spirit.
 
@@ -65,6 +68,13 @@ def random_cluster(
     ``dead_brokers`` marks the *last* k brokers DEAD (their replicas become
     offline) and ``new_brokers`` marks the preceding k NEW — the self-healing
     fixtures in BASELINE.json config #4.
+
+    ``rack_aware=True`` places each partition's replicas on distinct racks
+    (the fault-injection simulator needs RackAwareGoal-clean initial
+    placements, so rack-loss timelines start from a legal cluster).
+    ``hot_partitions``/``hot_factor`` multiply the load of a seeded random
+    partition subset — the skew knob for hot-partition scenarios.  All knobs
+    are seed-stable: the same arguments yield a bit-identical ClusterState.
     """
     rng = np.random.default_rng(seed)
     rf = min(replication_factor, num_brokers)
@@ -79,12 +89,36 @@ def random_cluster(
 
     # placement: per-partition random RF-subset of brokers, vectorized
     # (a per-partition Python loop dominates generation at 1M partitions).
-    # Dense regime (rf close to num_brokers): random-keys argsort — a
-    # uniform permutation per row, first rf entries.  Sparse regime:
-    # rejection sampling (resample rows with duplicate brokers) — uniform
-    # over distinct tuples like choice(replace=False), geometric
+    # Rack-aware regime: a uniform permutation of racks per row picks rf
+    # distinct racks, then a uniform member within each — no two replicas
+    # share a rack.  Dense regime (rf close to num_brokers): random-keys
+    # argsort — a uniform permutation per row, first rf entries.  Sparse
+    # regime: rejection sampling (resample rows with duplicate brokers) —
+    # uniform over distinct tuples like choice(replace=False), geometric
     # convergence when collisions are rare.
-    if 2 * rf >= num_brokers:
+    if rack_aware:
+        if rf > num_racks:
+            raise ValueError(
+                f"rack_aware placement needs rf <= num_racks "
+                f"(rf={rf}, num_racks={num_racks})"
+            )
+        members = [
+            np.flatnonzero(broker_rack == r).astype(np.int32)
+            for r in range(num_racks)
+        ]
+        width = max(m.size for m in members)
+        table = np.zeros((num_racks, width), np.int32)
+        counts = np.zeros(num_racks, np.int64)
+        for r, m in enumerate(members):
+            table[r, : m.size] = m
+            counts[r] = m.size
+        rack_keys = rng.random((num_partitions, num_racks))
+        racks_sel = np.argsort(rack_keys, axis=1)[:, :rf]       # [P, rf]
+        within = rng.integers(0, 1 << 30, size=(num_partitions, rf))
+        assignment = table[
+            racks_sel, within % counts[racks_sel]
+        ].astype(np.int32)
+    elif 2 * rf >= num_brokers:
         keys = rng.random((num_partitions, num_brokers))
         assignment = np.argsort(keys, axis=1)[:, :rf].astype(np.int32)
     else:
@@ -114,6 +148,12 @@ def random_cluster(
     else:  # EXPONENTIAL
         shape = np.exp(-np.linspace(0.0, 5.0, num_partitions)) * 5.0
     shape = shape / shape.mean()
+    if hot_partitions:
+        hot = rng.choice(num_partitions, size=min(hot_partitions,
+                                                  num_partitions),
+                         replace=False)
+        shape = shape.copy()
+        shape[hot] *= hot_factor
 
     # per-resource leader load, scaled to hit the target mean broker utilization:
     # sum_p load[p] * contribution ≈ mean_util * sum_b capacity[b, r]

@@ -1,0 +1,42 @@
+"""Deterministic fault-injection simulator (SURVEY.md §4, RandomCluster
+tradition): scripted fault timelines driven through the REAL monitor →
+detector → analyzer → executor loop on a virtual clock, asserted against
+the event journal.  See docs/ARCHITECTURE.md "Fault-injection simulator"
+and ``python -m cruise_control_tpu.sim --help``."""
+
+from cruise_control_tpu.sim.artifact import (
+    SCHEMA,
+    make_artifact,
+    scenario_summary,
+)
+from cruise_control_tpu.sim.backend import ScriptedClusterBackend
+from cruise_control_tpu.sim.scenarios import (
+    SCENARIOS,
+    SMOKE_SCENARIOS,
+    make_scenario,
+)
+from cruise_control_tpu.sim.simulator import (
+    ScenarioResult,
+    ScenarioSpec,
+    journal_fingerprint,
+    run_scenario,
+)
+from cruise_control_tpu.sim.timeline import Timeline, TimelineEvent
+from cruise_control_tpu.sim.workload import ScenarioWorkload
+
+__all__ = [
+    "SCHEMA",
+    "SCENARIOS",
+    "SMOKE_SCENARIOS",
+    "ScenarioResult",
+    "ScenarioSpec",
+    "ScenarioWorkload",
+    "ScriptedClusterBackend",
+    "Timeline",
+    "TimelineEvent",
+    "journal_fingerprint",
+    "make_artifact",
+    "make_scenario",
+    "run_scenario",
+    "scenario_summary",
+]

@@ -1,0 +1,84 @@
+"""``python -m cruise_control_tpu.sim`` — run scripted fault scenarios and
+emit the ``cc-tpu-scenarios/1`` artifact.
+
+    python -m cruise_control_tpu.sim --list
+    python -m cruise_control_tpu.sim --scenario rack_loss --seed 7
+    python -m cruise_control_tpu.sim --artifact SCENARIOS_r07.json
+
+Without ``--scenario`` the full registry runs.  Exit code is 1 when any
+scenario ends in FIX_FAILED or UNHEALED (regression signal for CI cron).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from cruise_control_tpu.sim.artifact import make_artifact
+from cruise_control_tpu.sim.scenarios import SCENARIOS, make_scenario
+from cruise_control_tpu.sim.simulator import run_scenario
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cruise_control_tpu.sim",
+        description="Deterministic fault-injection scenario runner",
+    )
+    ap.add_argument("--scenario", action="append", default=None,
+                    metavar="NAME",
+                    help="scenario to run (repeatable; default: all)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override every scenario's seed")
+    ap.add_argument("--artifact", metavar="PATH", default=None,
+                    help="write the cc-tpu-scenarios/1 artifact here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the artifact JSON to stdout")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered scenarios and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in SCENARIOS:
+            print(f"{name}: {SCENARIOS[name]().description}")
+        return 0
+
+    names = args.scenario or list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {unknown}; --list shows the registry",
+              file=sys.stderr)
+        return 2
+
+    results = []
+    for name in names:
+        spec = make_scenario(name, seed=args.seed)
+        result = run_scenario(spec)
+        results.append(result)
+        print(
+            f"{name}: {result.heal_outcome()} "
+            f"(detection {result.detection_latency_ms()} ms virtual, "
+            f"{result.actions_executed()} actions, "
+            f"{result.dead_tasks()} dead tasks, "
+            f"{len(result.journal)} journal events)"
+        )
+
+    artifact = make_artifact(results)
+    if args.artifact:
+        with open(args.artifact, "w") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"artifact written: {args.artifact}")
+    if args.json:
+        print(json.dumps(artifact, indent=1, sort_keys=True))
+    bad = [s["name"] for s in artifact["scenarios"]
+           if s["healOutcome"] in ("FIX_FAILED", "UNHEALED")]
+    if bad:
+        print(f"unhealed scenario(s): {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

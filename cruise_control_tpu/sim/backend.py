@@ -1,0 +1,169 @@
+"""ScriptedClusterBackend — the simulated cluster with timeline hooks.
+
+Extends :class:`~cruise_control_tpu.executor.backend.SimulatedClusterBackend`
+(the deterministic state machine the executor drives) with the fault
+machinery scenario timelines need:
+
+* broker kill/restore with **leader failover** to a surviving ISR member
+  (what the Kafka controller does the moment a broker session expires);
+* rack topology + whole-rack loss;
+* broker adds (a new empty broker joins metadata);
+* scripted **stalls** of individual reassignment batches (in-flight moves
+  make no progress for N ticks — the executor's timeout/DEAD path);
+* an armed **mid-execution kill**: the broker dies a fixed number of ticks
+  after the next execution puts reassignments in flight, which no absolute
+  timestamp can script reliably.
+
+It also fixes a liveness gap the base class doesn't need: a *new*
+reassignment for a partition cancels the stale catching-up replicas of the
+previous one (upstream ``alterPartitionReassignments`` semantics), so a
+heal plan issued after a broker died mid-move is not blocked forever by the
+dead broker's abandoned catch-up entry.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+
+
+class ScriptedClusterBackend(SimulatedClusterBackend):
+    def __init__(
+        self,
+        assignment: Dict[int, Sequence[int]],
+        leaders: Dict[int, int],
+        brokers: Set[int],
+        broker_racks: Dict[int, int],
+        move_latency_ticks: int = 1,
+    ):
+        super().__init__(assignment, leaders,
+                         move_latency_ticks=move_latency_ticks,
+                         brokers=set(brokers))
+        #: broker → rack id; the metadata client shares this dict, so
+        #: add_broker updates both views at once
+        self.broker_racks: Dict[int, int] = dict(broker_racks)
+        #: scripted stall: batches left to stall, and for how many ticks
+        self._stall_batches_left = 0
+        self._stall_ticks = 0
+        self._stalled: Dict[int, int] = {}  # partition → ticks remaining
+        #: armed mid-execution kill: (broker, ticks after first in-flight)
+        self._armed_kill: Optional[tuple] = None
+        self._armed_countdown: Optional[int] = None
+
+    # ---- timeline surface -------------------------------------------------------
+    def kill_broker(self, broker: int) -> None:
+        self.failed_brokers.add(broker)
+        for st in self.partitions.values():
+            if st.leader == broker:
+                live = [b for b in st.isr if b not in self.failed_brokers]
+                if live:
+                    st.leader = live[0]
+
+    def restore_broker(self, broker: int) -> None:
+        self.failed_brokers.discard(broker)
+
+    def kill_rack(self, rack: int) -> List[int]:
+        killed = sorted(
+            b for b, r in self.broker_racks.items()
+            if r == rack and b in self.brokers
+            and b not in self.failed_brokers
+        )
+        for b in killed:
+            self.kill_broker(b)
+        return killed
+
+    def add_broker(self, broker: int, rack: int) -> None:
+        self.brokers.add(broker)
+        self.broker_racks[broker] = rack
+
+    def fail_disk(self, broker: int, dirs: Sequence[str]) -> None:
+        have = self.offline_dirs.setdefault(broker, [])
+        for d in dirs:
+            if d not in have:
+                have.append(d)
+
+    def restore_disk(self, broker: int) -> None:
+        self.offline_dirs.pop(broker, None)
+
+    def stall_next_batches(self, ticks: int, batches: int = 1) -> None:
+        self._stall_ticks = int(ticks)
+        self._stall_batches_left = int(batches)
+
+    def arm_kill_mid_execution(self, broker: Optional[int],
+                               after_ticks: int) -> None:
+        """``broker=None`` kills whichever broker is catching up replicas
+        when the countdown fires — guaranteeing the death strands in-flight
+        moves regardless of what the optimizer chose as destinations."""
+        self._armed_kill = (
+            int(broker) if broker is not None else None,
+            max(1, int(after_ticks)),
+        )
+        self._armed_countdown = None
+
+    # ---- admin overrides --------------------------------------------------------
+    def alter_partition_reassignments(
+        self, reassignments: Dict[int, Sequence[int]]
+    ) -> None:
+        # upstream semantics: a new reassignment for a partition cancels the
+        # previous one's still-catching-up adds — drop them from the replica
+        # set so a dead broker's abandoned catch-up can't block the heal
+        for p, new in reassignments.items():
+            st = self.partitions.get(p)
+            if st is None:
+                continue
+            stale = {b for b in st.catching_up if b not in new}
+            if stale:
+                st.catching_up -= stale
+                st.replicas = [b for b in st.replicas if b not in stale]
+                if st.leader not in st.replicas and st.replicas:
+                    st.leader = st.replicas[0]
+        super().alter_partition_reassignments(reassignments)
+        if self._stall_batches_left > 0:
+            self._stall_batches_left -= 1
+            for p in reassignments:
+                if p in self._target:
+                    self._stalled[p] = self._stall_ticks
+
+    # ---- simulation -------------------------------------------------------------
+    def tick(self) -> None:
+        if self._armed_kill is not None:
+            if self._armed_countdown is None and self._target:
+                self._armed_countdown = self._armed_kill[1]
+            if self._armed_countdown is not None:
+                self._armed_countdown -= 1
+                if self._armed_countdown <= 0:
+                    victim = self._armed_kill[0]
+                    if victim is None:
+                        catching = {
+                            b
+                            for p in self._target
+                            for b in self.partitions[p].catching_up
+                            if b not in self.failed_brokers
+                        }
+                        victim = min(catching) if catching else None
+                    if victim is None:
+                        # nothing mid-catch-up yet: re-check next tick
+                        self._armed_countdown = 1
+                    else:
+                        self.kill_broker(victim)
+                        self._armed_kill = None
+                        self._armed_countdown = None
+        stalled = {p for p, left in self._stalled.items() if left > 0}
+        for p in list(self._stalled):
+            self._stalled[p] -= 1
+            if self._stalled[p] <= 0:
+                del self._stalled[p]
+        if not stalled:
+            super().tick()
+            return
+        # hide stalled reassignments from the base tick so they make no
+        # progress (restored before anyone else can observe the gap)
+        hidden = {p: self._target.pop(p) for p in stalled
+                  if p in self._target}
+        hidden_prog = {p: self._progress.pop(p) for p in hidden}
+        try:
+            super().tick()
+        finally:
+            self._target.update(hidden)
+            self._progress.update(hidden_prog)

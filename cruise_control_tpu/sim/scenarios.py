@@ -1,0 +1,296 @@
+"""The scripted scenario suite — named fault timelines over the simulator.
+
+Each entry is a :class:`ScenarioSpec` factory (a fresh spec per call — the
+Timeline carries a consume cursor), covering the SURVEY §2.8/§3.4 anomaly
+matrix end-to-end: broker death (including mid-execution), rack loss,
+cascading disk failures, hot-partition skew, cooldown suppression,
+maintenance precedence, metric gaps, broker adds, double faults, recovery
+then relapse, alert-only metric anomalies, and scripted execution stalls.
+
+``tests/test_scenarios.py`` asserts each scenario's heal outcome by reading
+only the event journal; ``python -m cruise_control_tpu.sim`` runs the suite
+and emits the ``cc-tpu-scenarios/1`` artifact (``SCENARIOS_r07.json``).
+
+Timing note: the monitor averages loads over its (5 × 1-virtual-minute)
+windows, so a load change needs ~3 windows before a capacity detector sees
+it breach — timelines below schedule faults early enough for detection,
+reaction, and the post-heal quiet period to fit the scenario duration.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from cruise_control_tpu.sim.simulator import MIN_MS, ScenarioSpec
+from cruise_control_tpu.sim.timeline import (
+    Timeline,
+    add_broker,
+    disk_failure,
+    hot_partition_skew,
+    kill_broker,
+    kill_broker_mid_execution,
+    maintenance_event,
+    metric_gap,
+    rack_loss,
+    restore_broker,
+    restore_disk,
+    stall_execution,
+)
+
+
+def _broker_death_mid_execution() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="broker_death_mid_execution",
+        description=(
+            "Hot-partition skew triggers a self-healing rebalance; a "
+            "replica-receiving broker dies mid-catch-up — the stuck moves "
+            "go DEAD on timeout, then the broker failure is detected and "
+            "evacuated."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=6.0, leader=0),
+            kill_broker_mid_execution(4 * MIN_MS, after_ticks=2),
+        ]),
+        self_healing={"goal_violation": True, "broker_failure": True},
+        # headroom so the 5-broker cluster stays capacity-feasible after
+        # the kill, and slow enough moves that the kill lands mid-catch-up
+        mean_utilization=0.18,
+        move_latency_ticks=3,
+        duration_ms=30 * MIN_MS,
+    )
+
+
+def _rack_loss() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="rack_loss",
+        description=(
+            "Every broker in rack 2 dies at once; one BrokerFailures "
+            "anomaly covers the whole rack and the fix evacuates onto the "
+            "two surviving racks (rf=2 stays rack-legal)."
+        ),
+        timeline=Timeline([rack_loss(5 * MIN_MS, rack=2)]),
+        self_healing={"broker_failure": True},
+        duration_ms=24 * MIN_MS,
+    )
+
+
+def _cascading_disk_failures() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="cascading_disk_failures",
+        description=(
+            "Broker 1 loses its log dirs, is evacuated, then broker 4 "
+            "fails too — two separate DISK_FAILURE heals; operators "
+            "replace each disk after its heal."
+        ),
+        timeline=Timeline([
+            disk_failure(4 * MIN_MS, broker=1),
+            restore_disk(10 * MIN_MS, broker=1),
+            disk_failure(12 * MIN_MS, broker=4),
+            restore_disk(20 * MIN_MS, broker=4),
+        ]),
+        self_healing={"disk_failure": True},
+        fix_cooldown_ms=3 * MIN_MS,
+        duration_ms=26 * MIN_MS,
+    )
+
+
+def _hot_partition_skew_violation() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="hot_partition_skew_violation",
+        description=(
+            "Partitions led by broker 0 go 8x hot; capacity detection "
+            "goals breach once the windows catch up and the self-healing "
+            "rebalance spreads the hot partitions."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=8.0, leader=0),
+        ]),
+        self_healing={"goal_violation": True},
+        # headroom: post-heal the diurnal peak must stay under the
+        # capacity threshold, or the tail of the run re-triggers
+        mean_utilization=0.18,
+        duration_ms=30 * MIN_MS,
+    )
+
+
+def _anomaly_during_cooldown() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="anomaly_during_cooldown",
+        description=(
+            "A second disk failure lands inside the self-healing cooldown "
+            "window of the first fix — FIX_DELAYED_COOLDOWN, retried and "
+            "healed once the cooldown expires."
+        ),
+        timeline=Timeline([
+            disk_failure(4 * MIN_MS, broker=1),
+            restore_disk(8 * MIN_MS, broker=1),
+            disk_failure(9 * MIN_MS, broker=4),
+            restore_disk(20 * MIN_MS, broker=4),
+        ]),
+        self_healing={"disk_failure": True},
+        fix_cooldown_ms=6 * MIN_MS,
+        duration_ms=26 * MIN_MS,
+    )
+
+
+def _maintenance_suppresses_self_heal() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="maintenance_suppresses_self_heal",
+        description=(
+            "An operator maintenance REBALANCE outranks the goal-violation "
+            "self-heal detected in the same cycle (anomaly priority 0 vs "
+            "4); the self-heal lands in FIX_DELAYED_COOLDOWN behind the "
+            "maintenance fix."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=8.0, leader=0),
+            maintenance_event(6 * MIN_MS, "REBALANCE"),
+        ]),
+        self_healing={"goal_violation": True, "maintenance_event": True},
+        fix_cooldown_ms=8 * MIN_MS,
+        duration_ms=30 * MIN_MS,
+    )
+
+
+def _detection_during_metric_gap() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="detection_during_metric_gap",
+        description=(
+            "The metrics pipeline goes dark for 10 virtual minutes; a "
+            "hot-partition skew inside the gap stays invisible (models "
+            "build from stale windows) and is detected and healed only "
+            "after sampling resumes."
+        ),
+        timeline=Timeline([
+            metric_gap(4 * MIN_MS, duration_ms=10 * MIN_MS),
+            hot_partition_skew(5 * MIN_MS, factor=8.0, leader=0),
+        ]),
+        self_healing={"goal_violation": True},
+        duration_ms=34 * MIN_MS,
+    )
+
+
+def _add_broker_rebalance() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="add_broker_rebalance",
+        description=(
+            "A new empty broker joins; the operator submits a maintenance "
+            "ADD_BROKER event and the fix moves replicas onto it through "
+            "the facade's add_brokers runnable."
+        ),
+        timeline=Timeline([
+            add_broker(4 * MIN_MS, broker=6, rack=0),
+            maintenance_event(6 * MIN_MS, "ADD_BROKER", brokers=[6]),
+        ]),
+        self_healing={"maintenance_event": True},
+        duration_ms=20 * MIN_MS,
+    )
+
+
+def _double_fault() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="double_fault",
+        description=(
+            "Broker 5 dies and broker 1 loses its disks in the same "
+            "minute; broker failure outranks disk failure (priority 1 vs "
+            "2), the disk fix waits out the cooldown, both heal."
+        ),
+        timeline=Timeline([
+            kill_broker(6 * MIN_MS, broker=5),
+            disk_failure(6 * MIN_MS, broker=1),
+            restore_disk(16 * MIN_MS, broker=1),
+        ]),
+        self_healing={"broker_failure": True, "disk_failure": True},
+        fix_cooldown_ms=4 * MIN_MS,
+        duration_ms=26 * MIN_MS,
+    )
+
+
+def _recovery_then_relapse() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="recovery_then_relapse",
+        description=(
+            "Broker 3 dies but returns before the self-healing threshold "
+            "(CHECK escalation only, first-seen cleared on recovery); it "
+            "then dies for good and is healed once the threshold from the "
+            "SECOND failure elapses."
+        ),
+        timeline=Timeline([
+            kill_broker(4 * MIN_MS, broker=3),
+            restore_broker(8 * MIN_MS, broker=3),
+            kill_broker(14 * MIN_MS, broker=3),
+        ]),
+        self_healing={"broker_failure": True},
+        broker_failure_alert_ms=2 * MIN_MS,
+        broker_failure_heal_ms=6 * MIN_MS,
+        duration_ms=30 * MIN_MS,
+    )
+
+
+def _metric_anomaly_alert_only() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="metric_anomaly_alert_only",
+        description=(
+            "Broker 2's traffic spikes 20x against its own history; the "
+            "percentile finder flags it but metric anomalies have no safe "
+            "automatic fix — alert-only, nothing executes."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(10 * MIN_MS, factor=20.0, leader=2),
+        ]),
+        self_healing={"metric_anomaly": True},
+        diurnal_amplitude=0.05,
+        duration_ms=20 * MIN_MS,
+    )
+
+
+def _stalled_execution_retries() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="stalled_execution_retries",
+        description=(
+            "The first reassignment batch of the self-healing rebalance "
+            "stalls past the task timeout (scripted backend stall) and "
+            "goes DEAD; the persisting violation is re-detected and the "
+            "retry completes once the stall drains."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=8.0, leader=0),
+            stall_execution(4 * MIN_MS, ticks=30, batches=1),
+        ]),
+        self_healing={"goal_violation": True},
+        fix_cooldown_ms=2 * MIN_MS,
+        mean_utilization=0.18,  # see hot_partition_skew_violation
+        duration_ms=30 * MIN_MS,
+    )
+
+
+#: name → spec factory; a fresh ScenarioSpec per call
+SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
+    factory().name: factory
+    for factory in (
+        _broker_death_mid_execution,
+        _rack_loss,
+        _cascading_disk_failures,
+        _hot_partition_skew_violation,
+        _anomaly_during_cooldown,
+        _maintenance_suppresses_self_heal,
+        _detection_during_metric_gap,
+        _add_broker_rebalance,
+        _double_fault,
+        _recovery_then_relapse,
+        _metric_anomaly_alert_only,
+        _stalled_execution_retries,
+    )
+}
+
+#: the tier-1 smoke subset (runs under ``-m 'not slow'``); the full matrix
+#: is marked slow and exercised by the CLI artifact run
+SMOKE_SCENARIOS = ("rack_loss", "cascading_disk_failures")
+
+
+def make_scenario(name: str, seed: Optional[int] = None) -> ScenarioSpec:
+    """Fresh spec for a registered scenario, optionally re-seeded."""
+    spec = SCENARIOS[name]()
+    if seed is not None:
+        spec.seed = seed
+    return spec

@@ -1,0 +1,404 @@
+"""The scenario driver: a virtual clock over the REAL control loop.
+
+``run_scenario(spec)`` assembles the production stack — scripted cluster
+backend, metrics reporter → topic → sampler → :class:`LoadMonitor`,
+:class:`Executor`, :class:`CruiseControl` facade, and the full
+:class:`AnomalyDetectorManager` via the same :func:`make_detector_manager`
+bootstrap uses — then advances a virtual clock tick by tick:
+
+    apply due timeline events → synthesize workload → report+ingest samples
+    → run the detection cycle (which self-heals through the facade and
+    executor, synchronously, exactly as the production scheduler thread
+    would).
+
+Nothing in the system under test is mocked; the only simulated parts are
+the cluster itself and the clock.  Ground truth for every assertion is the
+PR-3 **event journal**: the driver swaps in a dedicated
+:class:`EventJournal` for the run, emits ``sim.scenario_start`` /
+``sim.fault`` / ``sim.scenario_end`` markers carrying virtual timestamps,
+and returns every record.  Same seed ⇒ same journal (modulo wall-clock
+fields), which :func:`journal_fingerprint` makes testable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Optional, Sequence
+
+from cruise_control_tpu.bootstrap import _capacity_for
+from cruise_control_tpu.detector.anomalies import AnomalyType
+from cruise_control_tpu.detector.detectors import MaintenanceEventReader
+from cruise_control_tpu.detector.manager import make_detector_manager
+from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.models.generators import random_cluster
+from cruise_control_tpu.monitor.load_monitor import (
+    BackendMetadataClient,
+    LoadMonitor,
+)
+from cruise_control_tpu.monitor.sampling import (
+    MetricsReporterSampler,
+    MetricsTopic,
+    SimulatedMetricsReporter,
+)
+from cruise_control_tpu.sim.backend import ScriptedClusterBackend
+from cruise_control_tpu.sim.timeline import Timeline, TimelineEvent
+from cruise_control_tpu.sim.workload import ScenarioWorkload
+from cruise_control_tpu.telemetry import events
+from cruise_control_tpu.telemetry.events import EventJournal
+from cruise_control_tpu.utils.logging import get_logger
+from cruise_control_tpu.utils.metrics import MetricRegistry
+
+LOG = get_logger("sim")
+
+MIN_MS = 60_000
+
+#: default detection-goal subset (the production anomaly.detection.goals
+#: default — hard goals only, so a legal initial cluster is quiet)
+HARD_DETECTION_GOALS = (
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+)
+
+#: journal fields that carry wall-clock (not virtual) time — stripped by
+#: the determinism fingerprint, kept everywhere else
+_VOLATILE_KEYS = ("ts",)
+_VOLATILE_PAYLOAD_KEYS = ("durationS",)
+
+
+@dataclasses.dataclass
+class ScenarioSpec:
+    """One scripted fault timeline plus the cluster/config it runs on."""
+
+    name: str
+    description: str
+    timeline: Timeline
+    seed: int = 0
+    # cluster shape (random_cluster knobs; rack-aware so the start is legal)
+    num_brokers: int = 6
+    num_racks: int = 3
+    num_partitions: int = 36
+    num_topics: int = 3
+    replication_factor: int = 2
+    # virtual clock
+    duration_ms: int = 30 * MIN_MS
+    tick_ms: int = MIN_MS
+    # workload synthesis
+    mean_utilization: float = 0.25
+    diurnal_amplitude: float = 0.1
+    diurnal_period_ms: int = 7_200_000
+    drift_per_hour: float = 0.0
+    # detector / notifier wiring (mirrors the bootstrap key surface)
+    self_healing: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    detection_interval_ms: int = 2 * MIN_MS
+    fix_cooldown_ms: int = 0
+    broker_failure_alert_ms: int = 0
+    broker_failure_heal_ms: int = 0
+    detection_goals: Optional[Sequence[str]] = HARD_DETECTION_GOALS
+    healing_goals: Optional[Sequence[str]] = None
+    target_rf: Optional[int] = None
+    # executor shape
+    executor_task_timeout_ticks: int = 20
+    move_latency_ticks: int = 1
+
+    def healing_enables(self) -> Dict[AnomalyType, bool]:
+        return {
+            AnomalyType[k.upper()]: bool(v)
+            for k, v in self.self_healing.items()
+        }
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    """A finished run: the journal IS the ground truth — every helper below
+    derives from it alone (the contract ``tests/test_scenarios.py`` keeps)."""
+
+    spec: ScenarioSpec
+    journal: List[dict]
+    ticks: int
+    duration_virtual_ms: int
+
+    # ---- journal readers --------------------------------------------------------
+    def events_of(self, kind: str) -> List[dict]:
+        prefix = kind + "."
+        return [e for e in self.journal
+                if e["kind"] == kind or e["kind"].startswith(prefix)]
+
+    def faults(self) -> List[dict]:
+        return [e.get("payload", {}) for e in self.events_of("sim.fault")]
+
+    def anomalies(self, anomaly_type: Optional[str] = None,
+                  action: Optional[str] = None) -> List[dict]:
+        out = []
+        for e in self.events_of("detector.anomaly"):
+            p = e.get("payload", {})
+            if anomaly_type and p.get("anomalyType") != anomaly_type:
+                continue
+            if action and p.get("action") != action:
+                continue
+            out.append(p)
+        return out
+
+    def fixes_started(self, anomaly_type: Optional[str] = None) -> List[dict]:
+        return [p for p in self.anomalies(anomaly_type) if p.get("fixStarted")]
+
+    def executions(self) -> List[dict]:
+        return [e.get("payload", {}) for e in self.events_of("execute.end")]
+
+    def actions_executed(self) -> int:
+        return sum(int(p.get("completed", 0)) for p in self.executions())
+
+    def dead_tasks(self) -> int:
+        return sum(int(p.get("dead", 0)) for p in self.executions())
+
+    def detection_latency_ms(
+        self, anomaly_type: Optional[str] = None
+    ) -> Optional[int]:
+        """Virtual ms from the first scripted fault to the first detector
+        decision (of the given type) — both read from the journal."""
+        fault_ts = [p.get("virtualMs") for p in self.faults()
+                    if p.get("virtualMs") is not None]
+        det_ts = [p.get("timeMs") for p in self.anomalies(anomaly_type)
+                  if p.get("timeMs") is not None]
+        if not fault_ts or not det_ts:
+            return None
+        return max(0, min(det_ts) - min(fault_ts))
+
+    def heal_outcome(self) -> str:
+        """Classify the run from detector decisions alone: HEALED /
+        FIX_FAILED / ALERT_ONLY / SUPPRESSED / UNHEALED / NO_ANOMALY."""
+        decisions = self.anomalies()
+        if not decisions:
+            return "NO_ANOMALY"
+        last_fix_started = max(
+            (i for i, p in enumerate(decisions) if p.get("fixStarted")),
+            default=None,
+        )
+        failed_after = any(
+            p.get("action") == "FIX_FAILED"
+            for p in decisions[(last_fix_started or 0) + 1:]
+        ) if last_fix_started is not None else False
+        if last_fix_started is not None and not failed_after:
+            return "HEALED"
+        actions = {p.get("action") for p in decisions}
+        if "FIX_FAILED" in actions:
+            return "FIX_FAILED"
+        if actions <= {"IGNORE"}:
+            return "ALERT_ONLY"
+        if actions <= {"IGNORE", "CHECK", "FIX_DELAYED_COOLDOWN",
+                       "FIX_DELAYED_ONGOING_EXECUTION"}:
+            return "SUPPRESSED"
+        return "UNHEALED"
+
+    def fingerprint(self) -> str:
+        return journal_fingerprint(self.journal)
+
+
+def journal_fingerprint(journal: Sequence[dict]) -> str:
+    """SHA-256 over the journal with wall-clock fields stripped — equal
+    across runs of the same seeded scenario (the determinism contract)."""
+    h = hashlib.sha256()
+    for rec in journal:
+        r = {k: v for k, v in rec.items() if k not in _VOLATILE_KEYS}
+        if "payload" in r:
+            r["payload"] = {
+                k: v for k, v in r["payload"].items()
+                if k not in _VOLATILE_PAYLOAD_KEYS
+            }
+        h.update(json.dumps(r, sort_keys=True, default=str).encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------------
+@contextlib.contextmanager
+def _scenario_journal(ring_size: int = 1 << 15):
+    """Swap a dedicated in-memory EventJournal in for the run, so scenario
+    records never mix with (or leak into) the process-wide journal."""
+    prev = events.JOURNAL
+    events.JOURNAL = EventJournal(enabled=True, ring_size=ring_size)
+    try:
+        yield events.JOURNAL
+    finally:
+        events.JOURNAL = prev
+
+
+class _Sim:
+    """The assembled stack plus scripting state for one run."""
+
+    def __init__(self, spec: ScenarioSpec):
+        state = random_cluster(
+            seed=spec.seed,
+            num_brokers=spec.num_brokers,
+            num_racks=spec.num_racks,
+            num_topics=spec.num_topics,
+            num_partitions=spec.num_partitions,
+            replication_factor=spec.replication_factor,
+            rack_aware=True,
+        )
+        self.workload = ScenarioWorkload(
+            state,
+            diurnal_amplitude=spec.diurnal_amplitude,
+            diurnal_period_ms=spec.diurnal_period_ms,
+            drift_per_hour=spec.drift_per_hour,
+        )
+        w = self.workload.model
+        self.backend = ScriptedClusterBackend(
+            {p: list(r) for p, r in w.assignment.items()},
+            dict(w.leaders),
+            brokers=set(range(spec.num_brokers)),
+            broker_racks={
+                b: int(state.broker_rack[b]) for b in range(spec.num_brokers)
+            },
+            move_latency_ticks=spec.move_latency_ticks,
+        )
+        metadata = BackendMetadataClient(
+            self.backend,
+            self.backend.broker_racks,  # shared: add_broker updates both
+            partition_topic={
+                p: f"topic_{int(state.partition_topic[p])}"
+                for p in w.assignment
+            },
+        )
+        self.topic = MetricsTopic()
+        self.reporter = SimulatedMetricsReporter(w, self.topic)
+        self.monitor = LoadMonitor(
+            metadata,
+            MetricsReporterSampler(self.topic),
+            capacity_resolver=_capacity_for(
+                w, spec.num_brokers, target_mean_util=spec.mean_utilization
+            ),
+            window_ms=spec.tick_ms,
+            num_windows=5,
+        )
+        self.executor = Executor(
+            self.backend,
+            ExecutorConfig(
+                task_timeout_ticks=spec.executor_task_timeout_ticks,
+            ),
+        )
+        # a private registry: scenario runs must not pollute the process
+        # default the server / other tests read
+        self.cc = CruiseControl(
+            self.monitor, self.executor, engine="greedy",
+            registry=MetricRegistry(),
+        )
+        self.maintenance = MaintenanceEventReader()
+        self.manager = make_detector_manager(
+            self.cc,
+            backend=self.backend,
+            notifier=SelfHealingNotifier(
+                enabled=spec.healing_enables(),
+                broker_failure_alert_threshold_ms=(
+                    spec.broker_failure_alert_ms
+                ),
+                broker_failure_self_healing_threshold_ms=(
+                    spec.broker_failure_heal_ms
+                ),
+            ),
+            target_rf=spec.target_rf,
+            maintenance_reader=self.maintenance,
+            detection_goal_names=(
+                list(spec.detection_goals) if spec.detection_goals else None
+            ),
+            self_healing_goal_names=(
+                list(spec.healing_goals) if spec.healing_goals else None
+            ),
+            detection_interval_ms=spec.detection_interval_ms,
+            fix_cooldown_ms=spec.fix_cooldown_ms,
+        )
+        #: metric-gap windows [(start_ms, end_ms)), virtual
+        self.gaps: List[tuple] = []
+
+    def in_gap(self, now_ms: int) -> bool:
+        return any(start <= now_ms < end for start, end in self.gaps)
+
+
+def _apply_event(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
+    """Apply one timeline event and journal it with its virtual time."""
+    detail: Dict[str, object] = {}
+    if ev.kind == "kill_broker":
+        sim.backend.kill_broker(ev.arg("broker"))
+    elif ev.kind == "restore_broker":
+        sim.backend.restore_broker(ev.arg("broker"))
+    elif ev.kind == "kill_broker_mid_execution":
+        sim.backend.arm_kill_mid_execution(
+            ev.arg("broker"), ev.arg("after_ticks")
+        )
+    elif ev.kind == "rack_loss":
+        detail["brokers"] = sim.backend.kill_rack(ev.arg("rack"))
+    elif ev.kind == "disk_failure":
+        sim.backend.fail_disk(ev.arg("broker"), ev.arg("dirs"))
+    elif ev.kind == "restore_disk":
+        sim.backend.restore_disk(ev.arg("broker"))
+    elif ev.kind == "hot_partition_skew":
+        parts = ev.arg("partitions")
+        if parts is None:
+            leader = ev.arg("leader")
+            parts = sorted(
+                p for p, st in sim.backend.partitions.items()
+                if st.leader == leader
+            )
+        detail["partitions"] = list(parts)
+        sim.workload.apply_skew(parts, ev.arg("factor"))
+    elif ev.kind == "add_broker":
+        sim.backend.add_broker(ev.arg("broker"), ev.arg("rack"))
+    elif ev.kind == "maintenance_event":
+        sim.maintenance.submit(ev.arg("event_type"), ev.arg("brokers"))
+    elif ev.kind == "metric_gap":
+        sim.gaps.append((ev.at_ms, ev.at_ms + ev.arg("duration_ms")))
+    elif ev.kind == "stall_execution":
+        sim.backend.stall_next_batches(ev.arg("ticks"),
+                                       ev.arg("batches", 1))
+    elif ev.kind == "fail_partition":
+        sim.backend.fail_partitions.add(ev.arg("partition"))
+    else:  # constructors validate kinds; this guards future drift
+        raise ValueError(f"unhandled timeline event kind {ev.kind!r}")
+    events.emit(
+        "sim.fault", fault=ev.kind, virtualMs=now_ms, atMs=ev.at_ms,
+        args=dict(ev.args), **detail,
+    )
+
+
+def run_scenario(spec: ScenarioSpec) -> ScenarioResult:
+    """Drive one scenario to completion and return the journal-backed
+    result.  Deterministic: same spec (incl. seed) ⇒ same fingerprint."""
+    spec.timeline.reset()
+    with _scenario_journal() as journal:
+        sim = _Sim(spec)
+        events.emit(
+            "sim.scenario_start", name=spec.name, seed=spec.seed,
+            brokers=spec.num_brokers, partitions=spec.num_partitions,
+            racks=spec.num_racks, rf=spec.replication_factor,
+            durationMs=spec.duration_ms, tickMs=spec.tick_ms,
+            description=spec.description,
+        )
+        LOG.info("scenario %s starting: %d brokers / %d partitions, %d "
+                 "events", spec.name, spec.num_brokers, spec.num_partitions,
+                 len(spec.timeline))
+        now = 0
+        ticks = 0
+        while now < spec.duration_ms:
+            now += spec.tick_ms
+            ticks += 1
+            for ev in spec.timeline.pop_due(now):
+                _apply_event(sim, ev, now)
+            sim.workload.advance(now)
+            sim.workload.sync_topology(sim.backend)
+            if not sim.in_gap(now):
+                sim.reporter.report(time_ms=now - spec.tick_ms // 2)
+            sim.monitor.run_sampling_iteration(now)
+            sim.manager.run_detection_cycle(now)
+        events.emit(
+            "sim.scenario_end", name=spec.name, virtualMs=now, ticks=ticks,
+            actionCounts=sim.manager.action_counts(),
+        )
+        records = journal.recent()
+    return ScenarioResult(
+        spec=spec, journal=records, ticks=ticks, duration_virtual_ms=now,
+    )

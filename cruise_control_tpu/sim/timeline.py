@@ -1,0 +1,201 @@
+"""Declarative fault timelines — the scenario DSL.
+
+A timeline is an ordered list of :class:`TimelineEvent` records, each a
+``(at_ms, kind, args)`` triple at a **virtual** timestamp.  The simulator
+driver (:mod:`cruise_control_tpu.sim.simulator`) pops due events every tick
+and applies them to the scripted cluster backend / workload synthesizer —
+the system under test (monitor → detector → analyzer → executor) only ever
+sees their *consequences* through its normal interfaces, exactly like a real
+deployment sees a broker vanish from metadata.
+
+Event vocabulary (SURVEY.md §2.8's anomaly matrix, plus execution-level
+faults the executor must survive):
+
+``kill_broker`` / ``restore_broker``
+    Broker death (leaders fail over to surviving ISR members) / recovery.
+``kill_broker_mid_execution``
+    Arms the backend: once the NEXT execution has reassignments in flight,
+    the broker dies ``after_ticks`` backend ticks later — the
+    broker-death-mid-rebalance case no fixed timestamp can script reliably.
+``rack_loss``
+    Kills every broker in a rack at once.
+``disk_failure`` / ``restore_disk``
+    JBOD log dirs go offline on an alive broker / the disk is replaced.
+``hot_partition_skew``
+    Multiplies the synthesized load of a partition subset (explicit ids, or
+    "partitions currently led by broker N" resolved at fire time).
+``add_broker``
+    A new empty broker joins the cluster metadata.
+``maintenance_event``
+    Appends an operator event to the maintenance stream
+    (:class:`~cruise_control_tpu.detector.detectors.MaintenanceEventReader`).
+``metric_gap``
+    The metrics reporter goes dark for a duration — detectors must cope
+    with stale windows.
+``stall_execution``
+    The next ``batches`` reassignment batches make no progress for
+    ``ticks`` backend ticks (scripted executor stall → task timeout path).
+``fail_partition``
+    Reassignments for the partition are silently dropped by the backend
+    (the executor's replica-mismatch/timeout DEAD path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from cruise_control_tpu.detector.anomalies import MaintenanceEvent
+
+KINDS = (
+    "kill_broker",
+    "restore_broker",
+    "kill_broker_mid_execution",
+    "rack_loss",
+    "disk_failure",
+    "restore_disk",
+    "hot_partition_skew",
+    "add_broker",
+    "maintenance_event",
+    "metric_gap",
+    "stall_execution",
+    "fail_partition",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TimelineEvent:
+    """One scripted fault at a virtual timestamp."""
+
+    at_ms: int
+    kind: str
+    args: tuple  # sorted (key, value) pairs — hashable and deterministic
+
+    def arg(self, name, default=None):
+        return dict(self.args).get(name, default)
+
+    def to_json(self) -> dict:
+        return {"atMs": self.at_ms, "kind": self.kind, **dict(self.args)}
+
+
+def _event(at_ms: int, kind: str, **args) -> TimelineEvent:
+    if kind not in KINDS:
+        raise ValueError(f"unknown timeline event kind {kind!r}")
+    if at_ms < 0:
+        raise ValueError(f"{kind}: at_ms must be >= 0, got {at_ms}")
+    return TimelineEvent(int(at_ms), kind, tuple(sorted(args.items())))
+
+
+# ---- constructors (the DSL surface) ---------------------------------------------
+def kill_broker(at_ms: int, broker: int) -> TimelineEvent:
+    return _event(at_ms, "kill_broker", broker=int(broker))
+
+
+def restore_broker(at_ms: int, broker: int) -> TimelineEvent:
+    return _event(at_ms, "restore_broker", broker=int(broker))
+
+
+def kill_broker_mid_execution(
+    at_ms: int, broker: Optional[int] = None, after_ticks: int = 2
+) -> TimelineEvent:
+    """``broker=None``: the backend kills whichever broker is catching up
+    replicas when the countdown fires — the death is guaranteed to strand
+    in-flight moves, whatever destinations the optimizer picked."""
+    return _event(at_ms, "kill_broker_mid_execution",
+                  broker=int(broker) if broker is not None else None,
+                  after_ticks=int(after_ticks))
+
+
+def rack_loss(at_ms: int, rack: int) -> TimelineEvent:
+    return _event(at_ms, "rack_loss", rack=int(rack))
+
+
+def disk_failure(at_ms: int, broker: int,
+                 dirs: Sequence[str] = ("d0",)) -> TimelineEvent:
+    return _event(at_ms, "disk_failure", broker=int(broker),
+                  dirs=tuple(dirs))
+
+
+def restore_disk(at_ms: int, broker: int) -> TimelineEvent:
+    return _event(at_ms, "restore_disk", broker=int(broker))
+
+
+def hot_partition_skew(
+    at_ms: int,
+    factor: float,
+    partitions: Optional[Sequence[int]] = None,
+    leader: Optional[int] = None,
+) -> TimelineEvent:
+    """Skew explicit ``partitions``, or the partitions led by ``leader`` at
+    the moment the event fires (exactly one selector must be given)."""
+    if (partitions is None) == (leader is None):
+        raise ValueError(
+            "hot_partition_skew needs exactly one of partitions= / leader="
+        )
+    return _event(
+        at_ms, "hot_partition_skew", factor=float(factor),
+        partitions=tuple(int(p) for p in partitions) if partitions else None,
+        leader=int(leader) if leader is not None else None,
+    )
+
+
+def add_broker(at_ms: int, broker: int, rack: int) -> TimelineEvent:
+    return _event(at_ms, "add_broker", broker=int(broker), rack=int(rack))
+
+
+def maintenance_event(at_ms: int, event_type: str,
+                      brokers: Sequence[int] = ()) -> TimelineEvent:
+    if event_type not in MaintenanceEvent.TYPES:
+        raise ValueError(f"unknown maintenance event type {event_type!r}")
+    return _event(at_ms, "maintenance_event", event_type=event_type,
+                  brokers=tuple(int(b) for b in brokers))
+
+
+def metric_gap(at_ms: int, duration_ms: int) -> TimelineEvent:
+    return _event(at_ms, "metric_gap", duration_ms=int(duration_ms))
+
+
+def stall_execution(at_ms: int, ticks: int, batches: int = 1) -> TimelineEvent:
+    return _event(at_ms, "stall_execution", ticks=int(ticks),
+                  batches=int(batches))
+
+
+def fail_partition(at_ms: int, partition: int) -> TimelineEvent:
+    return _event(at_ms, "fail_partition", partition=int(partition))
+
+
+class Timeline:
+    """Sorted event schedule with a consume cursor (the driver pops due
+    events once; re-running a scenario builds a fresh Timeline)."""
+
+    def __init__(self, events: Sequence[TimelineEvent] = ()):
+        # stable sort: same-timestamp events fire in authoring order
+        self.events: List[TimelineEvent] = sorted(
+            events, key=lambda e: e.at_ms
+        )
+        self._cursor = 0
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def pop_due(self, now_ms: int) -> List[TimelineEvent]:
+        """Events with ``at_ms <= now_ms`` not yet returned, in order."""
+        out: List[TimelineEvent] = []
+        while (self._cursor < len(self.events)
+               and self.events[self._cursor].at_ms <= now_ms):
+            out.append(self.events[self._cursor])
+            self._cursor += 1
+        return out
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    @property
+    def end_ms(self) -> int:
+        return self.events[-1].at_ms if self.events else 0
+
+    def kinds(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0) + 1
+        return out

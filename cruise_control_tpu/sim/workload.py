@@ -1,0 +1,92 @@
+"""Seeded time-varying workload synthesis for scenario runs.
+
+Wraps the real :class:`~cruise_control_tpu.monitor.sampling.WorkloadModel`
+ground truth that :class:`SimulatedMetricsReporter` observes, and re-derives
+its per-partition rates every virtual tick from three deterministic terms:
+
+* a **diurnal** sine (amplitude/period knobs — load breathes like a real
+  day/night traffic curve),
+* a linear **drift** per virtual hour (organic growth),
+* a per-partition **skew** multiplier vector the timeline's
+  ``hot_partition_skew`` events compound into.
+
+Because the same WorkloadModel object feeds the reporter, every sample the
+monitor ingests flows through the real pipeline — processor, aggregator,
+windows — with zero mocking of the system under test.  Topology (assignment
+/ leaders) is re-synced from the scripted backend each tick, so load follows
+partitions wherever the executor moves them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.models.cluster_state import ClusterState
+from cruise_control_tpu.monitor.sampling import WorkloadModel
+
+
+class ScenarioWorkload:
+    """Deterministic load synthesis over a generated cluster state."""
+
+    def __init__(
+        self,
+        state: ClusterState,
+        diurnal_amplitude: float = 0.2,
+        diurnal_period_ms: int = 7_200_000,
+        drift_per_hour: float = 0.0,
+    ):
+        a = np.array(state.assignment)
+        lslot = np.array(state.leader_slot)
+        assignment = {
+            p: [int(b) for b in a[p] if b >= 0] for p in range(a.shape[0])
+        }
+        leaders = {p: int(a[p, lslot[p]]) for p in range(a.shape[0])}
+        load = np.array(state.leader_load, np.float64)
+        self._base_in = load[:, Resource.NW_IN].copy()
+        self._base_out = load[:, Resource.NW_OUT].copy()
+        self._base_size = load[:, Resource.DISK].copy()
+        self._skew = np.ones(a.shape[0], np.float64)
+        self.diurnal_amplitude = diurnal_amplitude
+        self.diurnal_period_ms = max(1, int(diurnal_period_ms))
+        self.drift_per_hour = drift_per_hour
+        self.model = WorkloadModel(
+            bytes_in=self._base_in.copy(),
+            bytes_out=self._base_out.copy(),
+            size_mb=self._base_size.copy(),
+            assignment=assignment,
+            leaders=leaders,
+        )
+
+    def apply_skew(self, partitions: Sequence[int], factor: float) -> None:
+        """Compound a skew multiplier onto a partition subset (timeline
+        ``hot_partition_skew``); the load follows the partitions through
+        every subsequent rebalance."""
+        idx = np.asarray(list(partitions), int)
+        self._skew[idx] *= float(factor)
+
+    def advance(self, now_ms: int) -> None:
+        """Re-derive the observable rates for virtual time ``now_ms``."""
+        phase = math.sin(2.0 * math.pi * now_ms / self.diurnal_period_ms)
+        mult = (1.0 + self.diurnal_amplitude * phase
+                + self.drift_per_hour * (now_ms / 3_600_000.0))
+        mult = max(mult, 0.05)
+        m = self.model
+        m.bytes_in = self._base_in * mult * self._skew
+        m.bytes_out = self._base_out * mult * self._skew
+        # on-disk size tracks skew (hot partitions grow) but not the
+        # diurnal breath — disk is an integral, not a rate
+        m.size_mb = self._base_size * self._skew
+
+    def sync_topology(self, backend) -> None:
+        """Mirror the scripted backend's current placement into the ground
+        truth the brokers' metrics reporters observe."""
+        self.model.assignment = {
+            p: list(st.replicas) for p, st in backend.partitions.items()
+        }
+        self.model.leaders = {
+            p: st.leader for p, st in backend.partitions.items()
+        }

@@ -117,8 +117,23 @@ def _event_records(tmp_path):
     return ring + on_disk
 
 
+def _scenario_artifact():
+    from cruise_control_tpu.sim import ScenarioSpec, make_artifact, run_scenario
+    from cruise_control_tpu.sim.timeline import Timeline, disk_failure
+
+    spec = ScenarioSpec(
+        name="schema_probe",
+        description="minimal live run for the artifact contract",
+        timeline=Timeline([disk_failure(2 * 60_000, broker=1)]),
+        self_healing={"disk_failure": True},
+        num_brokers=4, num_racks=2, num_partitions=12,
+        duration_ms=6 * 60_000,
+    )
+    return [make_artifact([run_scenario(spec)])]
+
+
 @pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
-                                      "events"])
+                                      "events", "scenarios"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     if producer == "phase-profile":
         arts = _phase_profile_artifact()
@@ -126,6 +141,9 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "flight-recorder":
         arts = _flight_recorder_artifacts()
         schema = SCHEMAS["cc-tpu-flight-recorder/1"]
+    elif producer == "scenarios":
+        arts = _scenario_artifact()
+        schema = SCHEMAS["cc-tpu-scenarios/1"]
     else:
         arts = _event_records(tmp_path)
         schema = SCHEMAS["cc-tpu-events/1"]

@@ -469,6 +469,16 @@ def test_cli_json_format(tmp_path, capsys):
 
 
 # ---- the tree is clean ----------------------------------------------------------
+def test_sim_package_is_scanned_and_clean():
+    """The fault-injection simulator (sim/) is part of the linted tree and
+    carries zero findings of its own (ISSUE 6 satellite)."""
+    result = run_lint(paths=[str(PKG / "sim")])
+    assert result.files_scanned >= 7
+    assert not result.findings, "\n".join(
+        f.render() for f in result.findings
+    )
+
+
 def test_package_lints_clean_within_budget():
     """The tier-1 wrapper: the whole package, every rule, zero findings,
     single parse per file, < 5 s wall clock."""

@@ -1,0 +1,387 @@
+"""Scenario suite (ISSUE 6): scripted fault timelines driven through the
+REAL monitor → detector → analyzer → executor loop on a virtual clock.
+
+The ground-truth contract: every heal-outcome assertion reads ONLY the
+event journal captured by the run — :class:`ScenarioResult`'s helpers are
+pure journal readers (no peeking at backend or manager state), so a
+scenario passing here proves the system's *recorded decisions* tell the
+true story, which is what an operator reconstructing an incident has.
+
+Tier-1 runs the SMOKE subset plus the determinism and artifact contracts;
+the full ≥10-scenario matrix is ``slow`` (the committed
+``SCENARIOS_r07.json`` artifact keeps its outcomes honest in every run).
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.models.generators import random_cluster
+from cruise_control_tpu.sim import (
+    SCENARIOS,
+    SMOKE_SCENARIOS,
+    make_artifact,
+    make_scenario,
+    run_scenario,
+)
+from cruise_control_tpu.sim.simulator import MIN_MS, ScenarioSpec
+from cruise_control_tpu.sim.timeline import (
+    Timeline,
+    disk_failure,
+    hot_partition_skew,
+    restore_disk,
+)
+from test_artifact_schemas import SCHEMAS, validate
+
+MIN = MIN_MS
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r07.json"
+
+#: the outcome each scripted timeline must reach — also pinned against the
+#: committed artifact below, so a regression shows up in tier-1 without
+#: re-running the slow matrix
+EXPECTED_OUTCOMES = {
+    "broker_death_mid_execution": "HEALED",
+    "rack_loss": "HEALED",
+    "cascading_disk_failures": "HEALED",
+    "hot_partition_skew_violation": "HEALED",
+    "anomaly_during_cooldown": "HEALED",
+    "maintenance_suppresses_self_heal": "HEALED",
+    "detection_during_metric_gap": "HEALED",
+    "add_broker_rebalance": "HEALED",
+    "double_fault": "HEALED",
+    "recovery_then_relapse": "HEALED",
+    "metric_anomaly_alert_only": "ALERT_ONLY",
+    "stalled_execution_retries": "HEALED",
+}
+
+_cache = {}
+
+
+def result_for(name):
+    """Run each scenario once per test session (results are reused by the
+    per-scenario assertion, the determinism test, and the artifact test)."""
+    if name not in _cache:
+        _cache[name] = run_scenario(make_scenario(name))
+    return _cache[name]
+
+
+# ---- per-scenario journal assertions --------------------------------------------
+def _check_broker_death_mid_execution(r):
+    # the kill stranded in-flight moves: timeout DEADs in the first
+    # execution, a clean retry at the end
+    assert any(e["payload"].get("reason") == "timeout"
+               for e in r.events_of("executor.task_dead"))
+    assert r.dead_tasks() > 0
+    assert r.executions()[0]["dead"] > 0
+    assert r.executions()[-1]["dead"] == 0
+    assert r.fixes_started("BROKER_FAILURE")
+
+
+def _check_rack_loss(r):
+    (fix,) = r.fixes_started("BROKER_FAILURE")  # one anomaly, whole rack
+    assert "2" in fix["description"] and "5" in fix["description"]
+    assert r.detection_latency_ms("BROKER_FAILURE") <= 2 * MIN
+    # the evacuated brokers never re-trigger (hosting set empty)
+    assert not [p for p in r.anomalies("BROKER_FAILURE")
+                if p["timeMs"] > fix["timeMs"]]
+    assert r.actions_executed() > 0
+
+
+def _check_cascading_disk_failures(r):
+    fixes = r.fixes_started("DISK_FAILURE")
+    assert len(fixes) >= 2
+    b1 = [p["timeMs"] for p in fixes if "{1:" in p["description"]]
+    b4 = [p["timeMs"] for p in fixes if "{4:" in p["description"]]
+    assert b1 and b4 and min(b1) < min(b4)  # a cascade, not one batch
+    assert r.actions_executed() > 0
+
+
+def _check_hot_partition_skew_violation(r):
+    assert r.fixes_started("GOAL_VIOLATION")
+    assert r.detection_latency_ms("GOAL_VIOLATION") is not None
+    # healed for good: the last stretch of the run is violation-quiet
+    assert not [p for p in r.anomalies("GOAL_VIOLATION")
+                if p["timeMs"] > r.duration_virtual_ms - 4 * MIN]
+    assert r.actions_executed() > 0
+
+
+def _check_anomaly_during_cooldown(r):
+    delayed = r.anomalies("DISK_FAILURE", action="FIX_DELAYED_COOLDOWN")
+    assert delayed
+    first_fix = min(p["timeMs"] for p in r.fixes_started("DISK_FAILURE"))
+    b4_fix = [p["timeMs"] for p in r.fixes_started("DISK_FAILURE")
+              if "{4:" in p["description"]]
+    # the second fault's fix waited out the whole cooldown window
+    assert b4_fix and min(b4_fix) >= first_fix + 6 * MIN
+    assert min(p["timeMs"] for p in delayed) < min(b4_fix)
+
+
+def _check_maintenance_suppresses_self_heal(r):
+    (mfix,) = r.fixes_started("MAINTENANCE_EVENT")
+    delayed = r.anomalies("GOAL_VIOLATION", action="FIX_DELAYED_COOLDOWN")
+    # suppressed in the SAME cycle the maintenance fix ran
+    assert delayed and min(p["timeMs"] for p in delayed) == mfix["timeMs"]
+    # and the journal order shows priority: maintenance decided first
+    kinds = [e["payload"]["anomalyType"] for e in
+             r.events_of("detector.anomaly")
+             if e["payload"]["anomalyType"] in ("MAINTENANCE_EVENT",
+                                                "GOAL_VIOLATION")]
+    assert kinds.index("MAINTENANCE_EVENT") < kinds.index("GOAL_VIOLATION")
+
+
+def _check_detection_during_metric_gap(r):
+    gv = r.anomalies("GOAL_VIOLATION")
+    assert gv and r.fixes_started("GOAL_VIOLATION")
+    # blind while the pipeline was dark: no decision before the gap closed
+    gap_end = 14 * MIN
+    assert all(p["timeMs"] >= gap_end for p in gv)
+    assert r.detection_latency_ms("GOAL_VIOLATION") >= 8 * MIN
+
+
+def _check_add_broker_rebalance(r):
+    assert r.fixes_started("MAINTENANCE_EVENT")
+    assert any(e.get("operation") == "ADD_BROKER"
+               for e in r.events_of("optimize.start"))
+    assert r.actions_executed() > 0
+
+
+def _check_double_fault(r):
+    bfix = r.fixes_started("BROKER_FAILURE")
+    dfix = r.fixes_started("DISK_FAILURE")
+    assert bfix and dfix
+    # priority order: broker failure (1) healed before disk failure (2)
+    assert min(p["timeMs"] for p in bfix) <= min(p["timeMs"] for p in dfix)
+    assert r.anomalies("DISK_FAILURE", action="FIX_DELAYED_COOLDOWN")
+
+
+def _check_recovery_then_relapse(r):
+    bf = r.anomalies("BROKER_FAILURE")
+    fixes = [p for p in bf if p["fixStarted"]]
+    # no premature heal: the fix threshold counts from the SECOND failure
+    assert fixes and min(p["timeMs"] for p in fixes) >= 20 * MIN
+    assert any(p["action"] == "CHECK" for p in bf)
+    # the recovered window is decision-free (first-seen was cleared)
+    assert not [p for p in bf if 9 * MIN <= p["timeMs"] < 14 * MIN]
+
+
+def _check_metric_anomaly_alert_only(r):
+    ma = r.anomalies("METRIC_ANOMALY")
+    assert ma
+    assert all(p["action"] == "IGNORE" for p in ma)
+    assert not any(p["fixStarted"] for p in ma)
+    assert any("broker 2" in p["description"] for p in ma)
+    assert r.actions_executed() == 0
+
+
+def _check_stalled_execution_retries(r):
+    assert any(e["payload"].get("reason") == "timeout"
+               for e in r.events_of("executor.task_dead"))
+    assert r.executions()[0]["dead"] > 0
+    assert r.executions()[-1]["dead"] == 0
+    assert not [p for p in r.anomalies("GOAL_VIOLATION")
+                if p["timeMs"] > r.duration_virtual_ms - 4 * MIN]
+
+
+CHECKS = {
+    "broker_death_mid_execution": _check_broker_death_mid_execution,
+    "rack_loss": _check_rack_loss,
+    "cascading_disk_failures": _check_cascading_disk_failures,
+    "hot_partition_skew_violation": _check_hot_partition_skew_violation,
+    "anomaly_during_cooldown": _check_anomaly_during_cooldown,
+    "maintenance_suppresses_self_heal":
+        _check_maintenance_suppresses_self_heal,
+    "detection_during_metric_gap": _check_detection_during_metric_gap,
+    "add_broker_rebalance": _check_add_broker_rebalance,
+    "double_fault": _check_double_fault,
+    "recovery_then_relapse": _check_recovery_then_relapse,
+    "metric_anomaly_alert_only": _check_metric_anomaly_alert_only,
+    "stalled_execution_retries": _check_stalled_execution_retries,
+}
+
+
+def _params():
+    return [
+        pytest.param(
+            name,
+            marks=() if name in SMOKE_SCENARIOS else (pytest.mark.slow,),
+        )
+        for name in sorted(SCENARIOS)
+    ]
+
+
+@pytest.mark.parametrize("name", _params())
+def test_scenario_heals_as_scripted(name):
+    r = result_for(name)
+    assert r.heal_outcome() == EXPECTED_OUTCOMES[name], (
+        f"{name}: journal says {r.heal_outcome()}, expected "
+        f"{EXPECTED_OUTCOMES[name]}"
+    )
+    CHECKS[name](r)
+
+
+# ---- suite-level contracts ------------------------------------------------------
+def test_registry_shape():
+    assert len(SCENARIOS) >= 10
+    assert set(SCENARIOS) == set(EXPECTED_OUTCOMES) == set(CHECKS)
+    for name, factory in SCENARIOS.items():
+        spec = factory()
+        assert spec.name == name
+        assert len(spec.timeline) >= 1
+        assert spec.timeline.end_ms < spec.duration_ms
+        assert spec.description
+
+
+def test_same_seed_same_journal():
+    """The determinism contract: a scenario re-run yields a bit-identical
+    journal modulo wall-clock fields; a different seed does not."""
+    name = SMOKE_SCENARIOS[0]
+    first = result_for(name)
+    again = run_scenario(make_scenario(name))
+    assert first.fingerprint() == again.fingerprint()
+    reseeded = run_scenario(make_scenario(name, seed=first.spec.seed + 1))
+    assert first.fingerprint() != reseeded.fingerprint()
+
+
+def test_journal_is_the_only_ground_truth():
+    """ScenarioResult helpers must work from the journal records alone —
+    rebuilding the result from a JSON round-trip of the journal yields the
+    same derived facts."""
+    from cruise_control_tpu.sim.simulator import ScenarioResult
+
+    r = result_for(SMOKE_SCENARIOS[0])
+    clone = ScenarioResult(
+        spec=r.spec,
+        journal=json.loads(json.dumps(r.journal, default=str)),
+        ticks=r.ticks,
+        duration_virtual_ms=r.duration_virtual_ms,
+    )
+    assert clone.heal_outcome() == r.heal_outcome()
+    assert clone.detection_latency_ms() == r.detection_latency_ms()
+    assert clone.actions_executed() == r.actions_executed()
+    assert clone.fingerprint() == r.fingerprint()
+
+
+def test_detector_events_carry_virtual_time():
+    r = result_for(SMOKE_SCENARIOS[0])
+    decisions = r.events_of("detector.anomaly")
+    assert decisions
+    tick = r.spec.tick_ms
+    for e in decisions:
+        t = e["payload"]["timeMs"]
+        assert 0 < t <= r.duration_virtual_ms and t % tick == 0
+
+
+# ---- artifact contracts ---------------------------------------------------------
+def test_live_artifact_matches_schema():
+    results = [result_for(n) for n in SMOKE_SCENARIOS]
+    art = json.loads(json.dumps(make_artifact(results)))
+    validate(art, SCHEMAS["cc-tpu-scenarios/1"])
+    assert art["summary"]["numScenarios"] == len(SMOKE_SCENARIOS)
+
+
+def test_committed_artifact_is_current():
+    """SCENARIOS_r07.json (the CLI's output) must cover the whole registry
+    with the expected heal outcomes — regenerate it via
+    ``python -m cruise_control_tpu.sim --artifact SCENARIOS_r07.json``
+    whenever scenarios change."""
+    art = json.loads(ARTIFACT_PATH.read_text())
+    validate(art, SCHEMAS["cc-tpu-scenarios/1"])
+    by_name = {s["name"]: s for s in art["scenarios"]}
+    assert set(by_name) == set(SCENARIOS)
+    for name, expected in EXPECTED_OUTCOMES.items():
+        assert by_name[name]["healOutcome"] == expected, (
+            f"{name}: committed artifact says "
+            f"{by_name[name]['healOutcome']}, expected {expected}"
+        )
+        assert by_name[name]["journalEvents"] > 0
+
+
+def test_smoke_scenarios_match_committed_artifact():
+    """The determinism teeth: a smoke scenario re-run today must reproduce
+    the committed artifact's journal fingerprint bit for bit."""
+    art = json.loads(ARTIFACT_PATH.read_text())
+    by_name = {s["name"]: s for s in art["scenarios"]}
+    for name in SMOKE_SCENARIOS:
+        r = result_for(name)
+        assert r.fingerprint() == by_name[name]["journalFingerprint"], (
+            f"{name}: journal drifted from the committed artifact — "
+            "behavior changed; regenerate SCENARIOS_r07.json and review"
+        )
+
+
+# ---- generator knobs (satellite: rack topology + skew, seed-stable) -------------
+_STATE_FIELDS = (
+    "assignment", "leader_slot", "leader_load", "follower_load",
+    "partition_topic", "broker_capacity", "broker_rack", "broker_state",
+    "replica_offline",
+)
+
+
+def test_random_cluster_same_seed_bit_identical():
+    kwargs = dict(
+        num_brokers=9, num_racks=3, num_topics=4, num_partitions=48,
+        replication_factor=3, rack_aware=True, hot_partitions=6,
+        hot_factor=5.0,
+    )
+    a = random_cluster(17, **kwargs)
+    b = random_cluster(17, **kwargs)
+    for f in _STATE_FIELDS:
+        assert np.array_equal(np.array(getattr(a, f)),
+                              np.array(getattr(b, f))), f
+    c = random_cluster(18, **kwargs)
+    assert not all(
+        np.array_equal(np.array(getattr(a, f)), np.array(getattr(c, f)))
+        for f in _STATE_FIELDS
+    )
+
+
+def test_rack_aware_placement_uses_distinct_racks():
+    s = random_cluster(3, num_brokers=9, num_racks=3, num_partitions=60,
+                       replication_factor=3, rack_aware=True)
+    racks = np.array(s.broker_rack)[np.array(s.assignment)]
+    for row in racks:
+        assert len(set(row.tolist())) == len(row)
+
+
+def test_rack_aware_rejects_impossible_rf():
+    with pytest.raises(ValueError, match="rack_aware"):
+        random_cluster(0, num_brokers=6, num_racks=2,
+                       replication_factor=3, rack_aware=True)
+
+
+def test_hot_partition_knob_skews_load():
+    base = random_cluster(5, num_partitions=100, num_brokers=10)
+    # 10 of 100 partitions at 10x ⇒ total ≈ 1.9x the base cluster
+    hot = random_cluster(5, num_partitions=100, num_brokers=10,
+                         hot_partitions=10, hot_factor=10.0)
+    assert float(np.array(hot.leader_load).sum()) > \
+        1.5 * float(np.array(base.leader_load).sum())
+
+
+# ---- an inline custom scenario (the DSL is not registry-bound) ------------------
+def test_custom_inline_scenario_runs():
+    spec = ScenarioSpec(
+        name="inline_disk_blip",
+        description="one disk failure, healed, disk replaced",
+        timeline=Timeline([
+            disk_failure(2 * MIN, broker=1),
+            restore_disk(6 * MIN, broker=1),
+        ]),
+        self_healing={"disk_failure": True},
+        num_brokers=4, num_racks=2, num_partitions=12,
+        duration_ms=8 * MIN,
+    )
+    r = run_scenario(spec)
+    assert r.heal_outcome() == "HEALED"
+    assert r.fixes_started("DISK_FAILURE")
+    assert len(r.faults()) == 2
+
+
+def test_timeline_validation():
+    with pytest.raises(ValueError, match="exactly one"):
+        hot_partition_skew(0, factor=2.0)
+    with pytest.raises(ValueError, match="maintenance"):
+        from cruise_control_tpu.sim.timeline import maintenance_event
+        maintenance_event(0, "EXPLODE")
