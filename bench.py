@@ -26,6 +26,13 @@ engine metric (spans enabled vs disabled) — the <=1% budget gate.
 (file-backed journal + the per-rebalance lifecycle emits vs disabled;
 the engines' provenance accounting runs on BOTH sides — it is part of
 the engine) — <=2% budget.
+``checkpoint_overhead_pct`` gates the write-ahead execution checkpoint
+(executor/journal.py): the greedy plan for the same fixture is driven on
+the simulated backend with the file-backed checkpoint on vs off
+(interleaved best-of), and the wall-clock delta is expressed against the
+north-star metric — the checkpoint must cost <=1% of a served rebalance.
+Plans are untouched by construction (the journal hangs off the executor,
+not the analyzer) — the parity gates stay the bit-identity proof.
 """
 
 from __future__ import annotations
@@ -221,6 +228,48 @@ def main() -> None:
     events.reset()
     events_overhead_pct = (ev_on_s / ev_off_s - 1.0) * 100.0
 
+    # execution-checkpoint overhead: drive the greedy plan against a fresh
+    # simulated backend with the write-ahead journal on vs off.  The delta
+    # is reported against the north-star metric (the checkpoint rides a
+    # full rebalance, so that is the denominator operators care about).
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+    from cruise_control_tpu.executor.journal import ExecutionJournal
+
+    plan = greedy[0].proposals
+    state_a = np.array(state.assignment)
+    state_ls = np.array(state.leader_slot)
+    bench_assignment = {
+        p: [int(b) for b in state_a[p] if b >= 0]
+        for p in range(state_a.shape[0])
+    }
+    bench_leaders = {
+        p: int(state_a[p, state_ls[p]]) for p in range(state_a.shape[0])
+    }
+    ckpt_path = os.path.join(
+        tempfile.mkdtemp(prefix="cc-ckpt-bench-"), "execution.ckpt.jsonl"
+    )
+
+    def _drive(journal):
+        backend = SimulatedClusterBackend(
+            {p: list(r) for p, r in bench_assignment.items()},
+            dict(bench_leaders),
+        )
+        ex = Executor(backend, ExecutorConfig(), journal=journal)
+        ex.execute_proposals(plan, max_ticks=10**6)
+
+    ck_off_s = ck_on_s = np.inf
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _drive(None)
+        ck_off_s = min(ck_off_s, time.perf_counter() - t0)
+        if os.path.exists(ckpt_path):
+            os.remove(ckpt_path)
+        t0 = time.perf_counter()
+        _drive(ExecutionJournal(ckpt_path))
+        ck_on_s = min(ck_on_s, time.perf_counter() - t0)
+    checkpoint_overhead_pct = (ck_on_s - ck_off_s) / tpu_s * 100.0
+
     phases = _full_path_phases()
     tracing.configure(enabled=False)
 
@@ -241,6 +290,11 @@ def main() -> None:
                 "tracing_overhead_pct": round(overhead_pct, 2),
                 "recorder_overhead_pct": round(recorder_overhead_pct, 2),
                 "events_overhead_pct": round(events_overhead_pct, 2),
+                "checkpoint_overhead_pct": round(
+                    checkpoint_overhead_pct, 2),
+                "checkpoint_drive_s": {
+                    "off": round(ck_off_s, 4), "on": round(ck_on_s, 4),
+                },
                 "phases": phases,
             }
         )

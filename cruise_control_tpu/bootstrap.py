@@ -477,6 +477,15 @@ def build_app(
         ),
         skip_loading_samples=cfg.get_boolean("skip.loading.samples"),
     )
+    execution_journal = None
+    checkpoint_path = cfg.get("execution.checkpoint.path")
+    if checkpoint_path:
+        from cruise_control_tpu.executor.journal import ExecutionJournal
+
+        execution_journal = ExecutionJournal(
+            checkpoint_path,
+            max_bytes=cfg.get_int("execution.checkpoint.max.bytes"),
+        )
     executor = Executor(
         backend,
         ExecutorConfig(
@@ -517,14 +526,28 @@ def build_app(
                 "execution.progress.check.interval.ms"
             ),
             history_retention=cfg.get_int("execution.history.retention"),
+            task_retry_max_attempts=cfg.get_int(
+                "execution.task.retry.max.attempts"
+            ),
+            task_retry_backoff_base_ticks=cfg.get_int(
+                "execution.task.retry.backoff.base.ticks"
+            ),
+            task_retry_backoff_max_ticks=cfg.get_int(
+                "execution.task.retry.backoff.max.ticks"
+            ),
+            task_retry_jitter_ticks=cfg.get_int(
+                "execution.task.retry.jitter.ticks"
+            ),
+            dest_exclusion_threshold=cfg.get_int(
+                "execution.task.retry.dest.exclusion.threshold"
+            ),
+            watchdog_stuck_ticks=cfg.get_int(
+                "execution.watchdog.stuck.ticks"
+            ),
         ),
         notifier=cfg.get_configured_instance("executor.notifier.class"),
         default_strategy=_movement_strategy(cfg),
-    )
-    # upstream executor recovery: surface (and optionally stop) reassignments
-    # a previous instance left in flight
-    executor.detect_ongoing_at_startup(
-        stop=cfg.get_boolean("stop.ongoing.execution.at.startup")
+        journal=execution_journal,
     )
     mesh = None
     if cfg.get_int("tpu.mesh.devices") > 1:
@@ -661,6 +684,19 @@ def build_app(
         per_type_interval_ms=_per_type_detector_intervals(cfg),
         fix_cooldown_ms=cfg.get("self.healing.cooldown.ms"),
         history_size=cfg.get_int("anomaly.detector.history.size"),
+    )
+    # crash recovery (docs/ARCHITECTURE.md "Execution recovery"): resume or
+    # cleanly settle the execution a previous instance checkpointed —
+    # BEFORE adopting foreign reassignments (the checkpointed moves are
+    # ours) and with the detector attached, so the self-healing cooldown
+    # honors the recovered execution instead of double-firing
+    if execution_journal is not None:
+        cc.recover_execution()
+    # upstream executor recovery: surface (and optionally stop) reassignments
+    # a previous instance left in flight — anything the checkpoint recovery
+    # did not already settle is foreign work
+    executor.detect_ongoing_at_startup(
+        stop=cfg.get_boolean("stop.ongoing.execution.at.startup")
     )
     if cfg.get_boolean("telemetry.device.stats.enabled"):
         # live-buffer gauges ride the shared registry: GET /state JSON,

@@ -461,6 +461,47 @@ def default_config_def() -> ConfigDef:
     d.define("execution.history.retention", ConfigType.INT, 64,
              Importance.LOW, "ExecutionResults retained in the executor's "
              "bounded history deque (was unbounded).", at_least(1), G)
+    d.define("execution.checkpoint.path", ConfigType.STRING, None,
+             Importance.MEDIUM,
+             "Write-ahead execution checkpoint file "
+             "(cc-tpu-execution-checkpoint/1 JSONL). When set, the "
+             "executor journals every drive-loop state transition and a "
+             "restarted process resumes the execution instead of "
+             "orphaning in-flight moves; None disables durability.",
+             None, G)
+    d.define("execution.checkpoint.max.bytes", ConfigType.LONG, 4_194_304,
+             Importance.LOW,
+             "Checkpoint size at which the file is atomically compacted "
+             "to a snapshot (start + latest per-task states).",
+             at_least(1024), G)
+    d.define("execution.task.retry.max.attempts", ConfigType.INT, 0,
+             Importance.MEDIUM,
+             "Re-dispatches a DEAD/timed-out move may get before going "
+             "terminally DEAD (0 = upstream behavior, no retry).",
+             at_least(0), G)
+    d.define("execution.task.retry.backoff.base.ticks", ConfigType.INT, 2,
+             Importance.LOW,
+             "Exponential retry backoff base: attempt N waits "
+             "base * 2^(N-1) ticks (capped) plus jitter.", at_least(1), G)
+    d.define("execution.task.retry.backoff.max.ticks", ConfigType.INT, 64,
+             Importance.LOW, "Retry backoff ceiling in ticks.",
+             at_least(1), G)
+    d.define("execution.task.retry.jitter.ticks", ConfigType.INT, 1,
+             Importance.LOW,
+             "Deterministic per-task jitter added to each backoff (0-N "
+             "ticks, seeded by task id and attempt — no RNG, so scenario "
+             "fingerprints stay reproducible).", at_least(0), G)
+    d.define("execution.task.retry.dest.exclusion.threshold",
+             ConfigType.INT, 3, Importance.LOW,
+             "Failed-move outcomes charged to a destination broker before "
+             "it is excluded from further dispatches and re-planned "
+             "around (0 disables exclusion).", at_least(0), G)
+    d.define("execution.watchdog.stuck.ticks", ConfigType.INT, 0,
+             Importance.LOW,
+             "Stuck-execution watchdog: after this many ticks without any "
+             "dispatch or completion, stop dispatching; after twice this "
+             "many, abort in-flight moves and journal "
+             "execution.unrecoverable (0 disables).", at_least(0), G)
     d.define("default.replication.throttle", ConfigType.DOUBLE, None,
              Importance.MEDIUM, "Replication throttle (bytes/s); None = off.",
              None, G)

@@ -78,14 +78,35 @@ class AnomalyDetectorManager:
         #: consumed destructively from their stream and would otherwise be
         #: silently lost; harmless for re-detectable anomaly types.
         self._pending_fixes: deque = deque()
+        #: set by facade.recover_execution: the next detection cycle
+        #: treats the recovered execution as the last fix (cooldown),
+        #: using THAT cycle's clock — recovery itself has no access to the
+        #: detector's time base (virtual under the scenario simulator)
+        self._recovery_pending = False
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         cruise_control.anomaly_detector = self
+
+    def note_recovery(self) -> None:
+        """A checkpointed execution was just resumed at startup: start
+        the self-healing cooldown at the next cycle so the detector does
+        not double-fire a fix on top of (or immediately after) the
+        recovered execution."""
+        with self._history_lock:
+            self._recovery_pending = True
 
     # ---- detection cycle --------------------------------------------------------
     def run_detection_cycle(self, now_ms: int) -> List[Anomaly]:
         """Run due detectors, then handle retries + fresh anomalies in
         priority order.  Returns anomalies handled."""
+        with self._history_lock:
+            recovery_pending = self._recovery_pending
+            if recovery_pending:
+                self._recovery_pending = False
+                self._last_fix_ms = now_ms
+        if recovery_pending:
+            events.emit("detector.recovery_cooldown", timeMs=now_ms,
+                        cooldownMs=self.fix_cooldown_ms)
         queue: List[Anomaly]
         queue, self._pending_fixes = list(self._pending_fixes), deque()
         for atype, det in self.detectors.items():

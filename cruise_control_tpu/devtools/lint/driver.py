@@ -33,6 +33,7 @@ from cruise_control_tpu.devtools.lint.rules_except import (
 from cruise_control_tpu.devtools.lint.rules_jax import JaxHotPathRule
 from cruise_control_tpu.devtools.lint.rules_lock import LockDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_obs import ObsDynamicNameRule
+from cruise_control_tpu.devtools.lint.rules_retry import RetryDisciplineRule
 
 SCHEMA = "cc-tpu-lint/1"
 
@@ -46,6 +47,7 @@ RULES = {
         ConfigKeyDriftRule(),
         ObsDynamicNameRule(),
         SwallowedExceptionRule(),
+        RetryDisciplineRule(),
     )
 }
 

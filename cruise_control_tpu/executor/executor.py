@@ -8,6 +8,17 @@ execution at a time; state machine NO_TASK_IN_PROGRESS â†’ STARTING_EXECUTION â†
 tick-based against the :class:`ClusterBackend` seam, so tests and the
 simulated cluster advance deterministically; a real-Kafka adapter polls on
 wall-clock ticks instead.
+
+Crash safety (docs/ARCHITECTURE.md "Execution recovery"): with an
+:class:`~cruise_control_tpu.executor.journal.ExecutionJournal` attached,
+every state transition of the drive loop is checkpointed write-ahead â€”
+batch dispatches BEFORE the backend call, task completions/deaths/retries
+as they land â€” and :meth:`resume` reconciles a loaded checkpoint against
+live backend state so a restarted process continues the execution instead
+of orphaning it.  Failed tasks get bounded exponential-backoff retries
+with deterministic jitter; destinations that keep failing are excluded
+and re-planned around; a stuck-execution watchdog escalates stop â†’ abort
+â†’ ``execution.unrecoverable``.
 """
 
 from __future__ import annotations
@@ -21,6 +32,12 @@ from typing import Dict, List, Optional, Sequence, Set
 from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
 from cruise_control_tpu.executor.backend import ClusterBackend
 from cruise_control_tpu.executor.concurrency import ConcurrencyAdjuster
+from cruise_control_tpu.executor.journal import (
+    ExecutionCheckpoint,
+    ExecutionJournal,
+    ProcessCrash,
+    proposal_to_record,
+)
 from cruise_control_tpu.executor.notifier import ExecutorNotifier
 from cruise_control_tpu.executor.tasks import (
     ExecutionTask,
@@ -28,6 +45,7 @@ from cruise_control_tpu.executor.tasks import (
     ReplicaMovementStrategy,
     TaskState,
     TaskType,
+    strategy_by_name,
 )
 from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
 from cruise_control_tpu.telemetry import events
@@ -80,6 +98,21 @@ class ExecutorConfig:
     #: ExecutionResults retained in ``Executor.history`` (the unbounded
     #: list leaked on a long-running server; mirrors the task-log bound)
     history_retention: int = 64
+    #: execution.task.retry.*: bounded re-dispatch of DEAD/timed-out moves
+    #: with exponential backoff (base * 2^attempt, capped) plus a
+    #: deterministic jitter; 0 attempts = upstream behavior (no retry)
+    task_retry_max_attempts: int = 0
+    task_retry_backoff_base_ticks: int = 2
+    task_retry_backoff_max_ticks: int = 64
+    task_retry_jitter_ticks: int = 1
+    #: DEAD outcomes charged to a destination broker before it is excluded
+    #: from further dispatches and re-planned around (0 disables)
+    dest_exclusion_threshold: int = 3
+    #: stuck-execution watchdog: after this many ticks without any task
+    #: completing or dispatching, stop dispatching new batches; after twice
+    #: this many, abort in-flight moves and journal
+    #: ``execution.unrecoverable`` (0 disables)
+    watchdog_stuck_ticks: int = 0
 
 
 @dataclasses.dataclass
@@ -108,6 +141,7 @@ class Executor:
         config: Optional[ExecutorConfig] = None,
         notifier=None,
         default_strategy: Optional[ReplicaMovementStrategy] = None,
+        journal: Optional[ExecutionJournal] = None,
     ):
         self.backend = backend
         self.config = config or ExecutorConfig()
@@ -115,6 +149,8 @@ class Executor:
         #: default.replica.movement.strategies: ordering used when the caller
         #: passes no explicit strategy
         self.default_strategy = default_strategy
+        #: write-ahead execution checkpoint (None = durability disabled)
+        self.journal = journal
         self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
         self._stop_requested = False
         self.planner: Optional[ExecutionTaskPlanner] = None
@@ -139,6 +175,13 @@ class Executor:
         self.adopted_at_startup: Set[int] = set()
         self.adjuster: Optional[ConcurrencyAdjuster] = None
         self.throttle_helper: Optional[ReplicationThrottleHelper] = None
+        #: DEAD outcomes charged per destination broker (retry feedback)
+        self._dest_failures: Dict[int, int] = {}
+        #: destinations excluded after repeated failures; re-planned around
+        self.excluded_destinations: Set[int] = set()
+        self._retries_scheduled = 0
+        #: last recovery outcome for /state (None = never recovered)
+        self._last_recovery: Optional[dict] = None
 
     # ---- public API -------------------------------------------------------------
     @property
@@ -159,6 +202,9 @@ class Executor:
         executor simply refuses to start a new plan until they drain
         (``has_ongoing_execution`` stays authoritative for OUR plans â€”
         adopted work is surfaced via state()).
+
+        Checkpoint-based recovery (:meth:`resume`) runs BEFORE this: moves
+        belonging to a recovered checkpoint are ours, not foreign.
         """
         ongoing = set(self.backend.ongoing_reassignments())
         if ongoing and stop:
@@ -210,6 +256,8 @@ class Executor:
         sizes = partition_sizes or {}
         planner = ExecutionTaskPlanner(strategy or self.default_strategy)
         planner.add_proposals(proposals)
+        self._execution_seq += 1
+        execution_id = self._execution_seq
         LOG.info(
             "execution starting: %d proposals -> %d replica / %d leadership "
             "/ %d intra-broker tasks (strategy=%s)",
@@ -219,12 +267,36 @@ class Executor:
         )
         events.emit(
             "executor.start", numProposals=len(proposals),
+            executionId=execution_id,
             replicaTasks=len(planner.replica_tasks),
             leaderTasks=len(planner.leader_tasks),
             intraTasks=len(planner.intra_tasks),
             strategy=planner.strategy.name,
         )
-        self.planner = planner
+        # write-ahead: the full approved plan reaches the checkpoint before
+        # anything touches the cluster
+        self._jwrite(
+            "start",
+            executionId=execution_id,
+            strategy=planner.strategy.name,
+            maxTicks=max_ticks,
+            proposals=[proposal_to_record(p) for p in proposals],
+            sizes={int(k): float(v) for k, v in sizes.items()},
+            config={
+                "taskTimeoutTicks": self.config.task_timeout_ticks,
+                "retryMaxAttempts": self.config.task_retry_max_attempts,
+                "retryBackoffBaseTicks":
+                    self.config.task_retry_backoff_base_ticks,
+                "retryBackoffMaxTicks":
+                    self.config.task_retry_backoff_max_ticks,
+                "retryJitterTicks": self.config.task_retry_jitter_ticks,
+                "destExclusionThreshold":
+                    self.config.dest_exclusion_threshold,
+                "watchdogStuckTicks": self.config.watchdog_stuck_ticks,
+                "perBrokerCap":
+                    self.config.num_concurrent_partition_movements_per_broker,
+            },
+        )
         # safety ceiling: replica moves beyond the cap are aborted up front
         # (in strategy order, so the cap keeps the highest-priority moves),
         # and the result reports a partial execution instead of ignoring it
@@ -234,7 +306,180 @@ class Executor:
         )
         for t in ordered[self.config.max_inter_broker_moves:]:
             t.transition(TaskState.ABORTED)
+            self._jwrite("task", taskId=t.task_id,
+                         partition=t.proposal.partition, state="ABORTED",
+                         reason="move-ceiling")
+        return self._drive_to_completion(
+            planner, sizes, max_ticks, len(proposals), execution_id,
+        )
 
+    def resume(self, checkpoint: ExecutionCheckpoint) -> ExecutionResult:
+        """Adopt a loaded checkpoint: reconcile it against live backend
+        state â€” moves that completed while we were down become COMPLETED,
+        vanished destinations are re-planned, still-in-flight or
+        never-dispatched moves are (re-)issued â€” then drive the remainder
+        to completion under the checkpointed budget."""
+        if self.has_ongoing_execution:
+            raise OngoingExecutionError("an execution is already in progress")
+        self.state = ExecutorStateValue.STARTING_EXECUTION
+        self._stop_requested = False
+        if self.journal is not None:
+            # the restarted process owns the checkpoint again
+            self.journal.thaw()
+        planner, recon = self._reconcile(checkpoint)
+        self._execution_seq = max(self._execution_seq,
+                                  checkpoint.execution_id)
+        self._last_recovery = {
+            "executionId": checkpoint.execution_id,
+            "alreadyCompleted": len(recon["completed_prior"]),
+            "completedWhileDown": len(recon["completed_down"]),
+            "adopted": len(recon["adopted"]),
+            "reissued": len(recon["reissued"]),
+            "replanned": len(recon["replanned"]),
+            "aborted": len(recon["aborted"]),
+        }
+        LOG.warning(
+            "resuming execution %d from checkpoint: %d already completed, "
+            "%d completed while down, %d adopted, %d reissued, "
+            "%d replanned, %d aborted",
+            checkpoint.execution_id, *[
+                len(recon[k]) for k in (
+                    "completed_prior", "completed_down", "adopted",
+                    "reissued", "replanned", "aborted")
+            ],
+        )
+        # the recovery story, journal-readable: which partitions must NOT
+        # be re-moved (alreadyCompleted/completedWhileDown) and what the
+        # reconciliation decided for the rest (lists capped like the
+        # execution log's task drill-in)
+        events.emit(
+            "executor.resume", severity="WARNING",
+            executionId=checkpoint.execution_id,
+            phase=checkpoint.phase,
+            alreadyCompleted=recon["completed_prior"][:200],
+            completedWhileDown=recon["completed_down"][:200],
+            adopted=recon["adopted"][:200],
+            reissued=recon["reissued"][:200],
+            replanned=recon["replanned"][:200],
+            aborted=recon["aborted"][:200],
+        )
+        self._jwrite(
+            "resume", executionId=checkpoint.execution_id,
+            completedPrior=len(recon["completed_prior"]),
+            completedWhileDown=len(recon["completed_down"]),
+            adopted=len(recon["adopted"]),
+            reissued=len(recon["reissued"]),
+            replanned=len(recon["replanned"]),
+            aborted=len(recon["aborted"]),
+        )
+        return self._drive_to_completion(
+            planner, checkpoint.sizes, checkpoint.max_ticks,
+            len(checkpoint.proposals), checkpoint.execution_id,
+            resumed=True,
+        )
+
+    def _reconcile(self, checkpoint: ExecutionCheckpoint):
+        """Checkpoint Ã— live cluster â†’ a planner holding the truth.
+
+        Reconciliation rules, per replica task (docs/ARCHITECTURE.md):
+
+        1. recorded terminal (COMPLETED/DEAD/ABORTED) â†’ preserved verbatim;
+        2. live placement already equals the planned replicas â†’ COMPLETED
+           (the move finished while we were down â€” never re-moved);
+        3. a destination broker vanished (dead/degraded/excluded) â†’ the
+           proposal is re-planned onto live brokers, or ABORTED when none
+           qualify;
+        4. otherwise â†’ PENDING: still-in-flight reassignments are re-issued
+           (``alterPartitionReassignments`` is idempotent toward the same
+           target; a new target cancels the stale one), never-dispatched
+           ones dispatch normally.
+
+        Leadership/intra-broker tasks are cheap and idempotent: recorded
+        terminal states are preserved, the rest simply re-run.
+        """
+        strategy = strategy_by_name(checkpoint.strategy) \
+            or self.default_strategy
+        planner = ExecutionTaskPlanner(strategy)
+        planner.add_proposals(checkpoint.proposals)
+        by_id = {t.task_id: t for t in planner.all_tasks}
+        # recorded re-planned destinations apply before any comparison
+        for tid, rec in checkpoint.tasks.items():
+            t = by_id.get(tid)
+            new_reps = rec.get("newReplicas")
+            if (t is not None and new_reps
+                    and t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION):
+                self._swap_proposal(
+                    planner, t,
+                    dataclasses.replace(t.proposal,
+                                        new_replicas=tuple(new_reps)),
+                )
+        recon = {k: [] for k in ("completed_prior", "completed_down",
+                                 "adopted", "reissued", "replanned",
+                                 "aborted")}
+        alive = self.backend.alive_brokers()
+        ongoing = set(self.backend.ongoing_reassignments())
+        for t in planner.all_tasks:
+            rec = checkpoint.tasks.get(t.task_id, {})
+            recorded = rec.get("state", "PENDING")
+            t.attempts = int(rec.get("attempts", 0))
+            p = t.proposal.partition
+            if recorded in ("COMPLETED", "ABORTED", "DEAD"):
+                # terminal before the crash: the checkpoint is authoritative
+                # (direct assignment on purpose â€” transition() guards the
+                # live drive loop, not checkpoint replay)
+                t.state = TaskState[recorded]
+                if (recorded == "COMPLETED"
+                        and t.task_type
+                        == TaskType.INTER_BROKER_REPLICA_ACTION):
+                    recon["completed_prior"].append(p)
+                continue
+            if t.task_type != TaskType.INTER_BROKER_REPLICA_ACTION:
+                continue  # leadership/intra: re-run from PENDING
+            try:
+                st = self.backend.partition_state(p)
+            except KeyError:
+                t.state = TaskState.ABORTED
+                recon["aborted"].append(p)
+                continue
+            if list(st.replicas) == list(t.proposal.new_replicas):
+                t.state = TaskState.COMPLETED
+                recon["completed_down"].append(p)
+                continue
+            if any(b not in alive for b in t.added_brokers):
+                if p in ongoing:
+                    # clear the stale reassignment first: the dead
+                    # destination's abandoned catch-up must not pollute
+                    # the re-planned target on a minimal backend
+                    cancel = getattr(self.backend, "cancel_reassignments",
+                                     None)
+                    if cancel is not None:
+                        try:
+                            cancel([p])
+                        except NotImplementedError:
+                            pass
+                if self._replan_destinations(planner, t, include_dead=True):
+                    recon["replanned"].append(p)
+                else:
+                    t.state = TaskState.ABORTED
+                    recon["aborted"].append(p)
+                    self._jwrite("task", taskId=t.task_id, partition=p,
+                                 state="ABORTED", reason="no-destination")
+                continue
+            recon["adopted" if p in ongoing else "reissued"].append(p)
+        for v in recon.values():
+            v.sort()
+        return planner, recon
+
+    def _drive_to_completion(
+        self,
+        planner: ExecutionTaskPlanner,
+        sizes: Dict[int, float],
+        max_ticks: int,
+        num_proposals: int,
+        execution_id: int,
+        resumed: bool = False,
+    ) -> ExecutionResult:
+        self.planner = planner
         if self.config.replication_throttle is not None:
             self.throttle_helper = ReplicationThrottleHelper(
                 self.backend, self.config.replication_throttle
@@ -246,6 +491,8 @@ class Executor:
                     if t.state == TaskState.PENDING
                 ]
             )
+            self._jwrite("throttle", state="set",
+                         rate=self.config.replication_throttle)
         if self.config.concurrency_adjuster_enabled:
             self.adjuster = ConcurrencyAdjuster(
                 initial_cap=(
@@ -261,9 +508,10 @@ class Executor:
         from cruise_control_tpu.telemetry import tracing
 
         ticks = 0
+        crashed = False
         try:
             with tracing.span("executor.execute") as sp:
-                sp.set("proposals", len(proposals))
+                sp.set("proposals", num_proposals)
                 with tracing.span("executor.replica_moves"):
                     ticks = self._drive_replica_moves(
                         planner, sizes, max_ticks
@@ -274,66 +522,93 @@ class Executor:
                 if not self._stop_requested:
                     with tracing.span("executor.intra_moves"):
                         self._drive_intra_moves(planner)
+        except ProcessCrash:
+            # simulated process death (chaos tests): a real crash executes
+            # nothing past this point, so every cleanup side effect below
+            # is skipped â€” the checkpoint and event journal must reflect
+            # exactly what a dead process left behind
+            crashed = True
+            raise
         finally:
-            if self.throttle_helper is not None:
-                self.throttle_helper.clear_throttles()
-                self.throttle_helper = None
-            completed = sum(
-                1 for t in planner.all_tasks if t.state == TaskState.COMPLETED
-            )
-            dead = sum(1 for t in planner.all_tasks if t.state == TaskState.DEAD)
-            aborted = sum(
-                1 for t in planner.all_tasks if t.state == TaskState.ABORTED
-            )
-            result = ExecutionResult(
-                completed=completed,
-                dead=dead,
-                aborted=aborted,
-                ticks=ticks,
-                stopped=self._stop_requested,
-            )
-            self.history.append(result)
-            self._finished_movements += completed
-            self._execution_seq += 1
-            self.execution_log.append({
-                "executionId": self._execution_seq,
-                "endedS": round(time.time(), 1),
-                "strategy": planner.strategy.name,
-                "numProposals": len(proposals),
-                **dataclasses.asdict(result),
-                # per-move drill-in, bounded: terminal state of each task
-                "tasks": [
-                    {
-                        "taskId": t.task_id,
-                        "type": t.task_type.value,
-                        "partition": t.proposal.partition,
-                        "state": t.state.value,
-                        "from": sorted(t.removed_brokers),
-                        "to": sorted(t.added_brokers),
-                        "startedTick": t.started_tick,
-                        "finishedTick": t.finished_tick,
-                    }
-                    for t in planner.all_tasks[:200]
-                ],
-            })
-            if len(self.execution_log) > 8:
-                del self.execution_log[0]
-            self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
-            log = LOG.warning if (dead or result.stopped) else LOG.info
-            log(
-                "execution finished: %d completed / %d dead / %d aborted in "
-                "%d ticks%s", completed, dead, aborted, ticks,
-                " (STOPPED)" if result.stopped else "",
-            )
-            events.emit(
-                "executor.end",
-                severity="WARNING" if (dead or result.stopped) else "INFO",
-                executionId=self._execution_seq, completed=completed,
-                dead=dead, aborted=aborted, ticks=ticks,
-                stopped=result.stopped,
-            )
-            self._notify(result)
+            if not crashed:
+                if self.throttle_helper is not None:
+                    self.throttle_helper.clear_throttles()
+                    self.throttle_helper = None
+                    self._jwrite("throttle", state="cleared")
+                completed = sum(
+                    1 for t in planner.all_tasks
+                    if t.state == TaskState.COMPLETED
+                )
+                dead = sum(
+                    1 for t in planner.all_tasks if t.state == TaskState.DEAD
+                )
+                aborted = sum(
+                    1 for t in planner.all_tasks
+                    if t.state == TaskState.ABORTED
+                )
+                result = ExecutionResult(
+                    completed=completed,
+                    dead=dead,
+                    aborted=aborted,
+                    ticks=ticks,
+                    stopped=self._stop_requested,
+                )
+                self.history.append(result)
+                self._finished_movements += completed
+                self.execution_log.append({
+                    "executionId": execution_id,
+                    "endedS": round(time.time(), 1),
+                    "strategy": planner.strategy.name,
+                    "numProposals": num_proposals,
+                    "resumed": resumed,
+                    **dataclasses.asdict(result),
+                    # per-move drill-in, bounded: terminal state of each task
+                    "tasks": [
+                        {
+                            "taskId": t.task_id,
+                            "type": t.task_type.value,
+                            "partition": t.proposal.partition,
+                            "state": t.state.value,
+                            "from": sorted(t.removed_brokers),
+                            "to": sorted(t.added_brokers),
+                            "startedTick": t.started_tick,
+                            "finishedTick": t.finished_tick,
+                            "attempts": t.attempts,
+                        }
+                        for t in planner.all_tasks[:200]
+                    ],
+                })
+                if len(self.execution_log) > 8:
+                    del self.execution_log[0]
+                self.state = ExecutorStateValue.NO_TASK_IN_PROGRESS
+                log = LOG.warning if (dead or result.stopped) else LOG.info
+                log(
+                    "execution finished: %d completed / %d dead / %d aborted "
+                    "in %d ticks%s", completed, dead, aborted, ticks,
+                    " (STOPPED)" if result.stopped else "",
+                )
+                events.emit(
+                    "executor.end",
+                    severity="WARNING" if (dead or result.stopped) else "INFO",
+                    executionId=execution_id, completed=completed,
+                    dead=dead, aborted=aborted, ticks=ticks,
+                    stopped=result.stopped, resumed=resumed,
+                )
+                # terminal checkpoint record; the journal truncates itself â€”
+                # a finished execution needs no recovery state
+                self._jwrite(
+                    "end", executionId=execution_id, completed=completed,
+                    dead=dead, aborted=aborted, ticks=ticks,
+                    stopped=result.stopped, resumed=resumed,
+                )
+                self._notify(result)
         return result
+
+    def _jwrite(self, kind: str, **payload) -> None:
+        """Checkpoint write-through.  ProcessCrash (armed crash injection)
+        propagates by design; the journal swallows real IO errors itself."""
+        if self.journal is not None:
+            self.journal.append(kind, **payload)
 
     def _notify(self, result: ExecutionResult) -> None:
         if self.notifier is None:
@@ -345,6 +620,158 @@ class Executor:
                 self.notifier.on_execution_finished(result)
         else:  # plain callable hook
             self.notifier(result)
+
+    # ---- retry / re-planning ----------------------------------------------------
+    def _swap_proposal(self, planner: ExecutionTaskPlanner,
+                       task: ExecutionTask,
+                       proposal: ExecutionProposal) -> None:
+        """Replace a task's proposal, keeping the sibling leadership task
+        (built from the same proposal object) consistent."""
+        old = task.proposal
+        task.proposal = proposal
+        for lt in planner.leader_tasks:
+            if lt.proposal is old:
+                lt.proposal = proposal
+
+    def _replan_destinations(self, planner: ExecutionTaskPlanner,
+                             task: ExecutionTask,
+                             include_dead: bool = False) -> bool:
+        """Re-target a move whose destinations are excluded (or, with
+        ``include_dead``, vanished): each bad destination is replaced by
+        the lowest-id live, non-excluded broker not already used.  A
+        placement-preserving fallback, not a goal-checked plan â€” the
+        detector's goal machinery re-balances later if needed."""
+        degraded: Set[int] = set()
+        deg = getattr(self.backend, "degraded_brokers", None)
+        if deg is not None:
+            degraded = set(deg())
+        if not include_dead and not self.excluded_destinations \
+                and not degraded:
+            return True  # the common fast path: nothing to route around
+        alive = self.backend.alive_brokers()
+        bad = {
+            b for b in task.added_brokers
+            if b in self.excluded_destinations or b in degraded
+            or (include_dead and b not in alive)
+        }
+        if not bad:
+            return True
+        keep = [b for b in task.proposal.new_replicas if b not in bad]
+        candidates = sorted(
+            alive - self.excluded_destinations - degraded - set(keep) - bad
+        )
+        replacement: Dict[int, int] = {}
+        new_replicas: List[int] = []
+        for b in task.proposal.new_replicas:
+            if b in bad:
+                if not candidates:
+                    return False
+                replacement[b] = candidates.pop(0)
+                new_replicas.append(replacement[b])
+            else:
+                new_replicas.append(b)
+        new_leader = replacement.get(task.proposal.new_leader,
+                                     task.proposal.new_leader)
+        self._swap_proposal(planner, task, dataclasses.replace(
+            task.proposal, new_replicas=tuple(new_replicas),
+            new_leader=new_leader,
+        ))
+        events.emit(
+            "executor.task_replanned", severity="WARNING",
+            taskId=task.task_id, partition=task.proposal.partition,
+            replaced={str(k): v for k, v in sorted(replacement.items())},
+            newReplicas=list(new_replicas),
+        )
+        self._jwrite("task", taskId=task.task_id,
+                     partition=task.proposal.partition, state="PENDING",
+                     attempts=task.attempts,
+                     newReplicas=list(new_replicas))
+        return True
+
+    def _ensure_destinations(self, planner: ExecutionTaskPlanner,
+                             task: ExecutionTask) -> bool:
+        """Pre-dispatch gate: re-plan around excluded/degraded
+        destinations; abort the task when nowhere is left to place it."""
+        if self._replan_destinations(planner, task):
+            return True
+        task.transition(TaskState.ABORTED)
+        events.emit(
+            "executor.task_dead", severity="WARNING", taskId=task.task_id,
+            partition=task.proposal.partition, reason="no-destination",
+        )
+        self._jwrite("task", taskId=task.task_id,
+                     partition=task.proposal.partition, state="ABORTED",
+                     reason="no-destination")
+        return False
+
+    def _fail_task(self, t: ExecutionTask, reason: str, ticks: int,
+                   extra: Optional[dict] = None) -> None:
+        """A move failed (timeout / replica mismatch): charge its
+        destinations, then either schedule a bounded backoff retry or
+        declare it DEAD."""
+        p = t.proposal.partition
+        for b in sorted(t.added_brokers):
+            n = self._dest_failures.get(b, 0) + 1
+            self._dest_failures[b] = n
+            if (0 < self.config.dest_exclusion_threshold <= n
+                    and b not in self.excluded_destinations):
+                self.excluded_destinations.add(b)
+                events.emit("executor.dest_excluded", severity="WARNING",
+                            broker=b, failures=n)
+        if (t.attempts < self.config.task_retry_max_attempts
+                and not self._stop_requested):
+            # clear the stale reassignment so the retry re-issues cleanly
+            cancel = getattr(self.backend, "cancel_reassignments", None)
+            if cancel is not None:
+                try:
+                    cancel([p])
+                except NotImplementedError:
+                    pass
+            backoff = min(
+                self.config.task_retry_backoff_base_ticks
+                * (1 << t.attempts),
+                self.config.task_retry_backoff_max_ticks,
+            )
+            jitter = 0
+            if self.config.task_retry_jitter_ticks > 0:
+                # deterministic decorrelation: no RNG (the chaos
+                # fingerprints depend on same-plan â†’ same-schedule), but
+                # different tasks/attempts spread across the window
+                jitter = (t.task_id * 1103515245 + t.attempts * 12345) % (
+                    self.config.task_retry_jitter_ticks + 1
+                )
+            t.attempts += 1
+            t.retry(eligible_tick=ticks + backoff + jitter)
+            self._retries_scheduled += 1
+            LOG.warning(
+                "task %d (partition %d) failed (%s): retry %d/%d in %d "
+                "ticks", t.task_id, p, reason, t.attempts,
+                self.config.task_retry_max_attempts, backoff + jitter,
+            )
+            events.emit(
+                "executor.task_retry", severity="WARNING",
+                taskId=t.task_id, partition=p, reason=reason,
+                attempt=t.attempts,
+                maxAttempts=self.config.task_retry_max_attempts,
+                backoffTicks=backoff + jitter, **(extra or {}),
+            )
+            self._jwrite("task", taskId=t.task_id, partition=p,
+                         state="PENDING", attempts=t.attempts, tick=ticks,
+                         reason=reason)
+            return
+        LOG.warning(
+            "task %d (partition %d) DEAD: %s (attempts=%d)",
+            t.task_id, p, reason, t.attempts,
+        )
+        events.emit(
+            "executor.task_dead", severity="WARNING",
+            taskId=t.task_id, partition=p, reason=reason,
+            attempts=t.attempts, **(extra or {}),
+        )
+        t.transition(TaskState.DEAD)
+        t.finished_tick = ticks
+        self._jwrite("task", taskId=t.task_id, partition=p, state="DEAD",
+                     tick=ticks, attempts=t.attempts, reason=reason)
 
     # ---- drive loops ------------------------------------------------------------
     def _caps(self, in_flight: Optional[Set[int]] = None) -> int:
@@ -358,40 +785,77 @@ class Executor:
             cap = max(1, cap // 2)  # legacy coarse back-off
         return cap
 
+    def _abort_pending_replicas(self, planner: ExecutionTaskPlanner,
+                                reason: str) -> None:
+        for t in planner.replica_tasks:
+            if t.state == TaskState.PENDING:
+                t.transition(TaskState.ABORTED)
+                self._jwrite("task", taskId=t.task_id,
+                             partition=t.proposal.partition,
+                             state="ABORTED", reason=reason)
+
     def _drive_replica_moves(
         self, planner: ExecutionTaskPlanner, sizes: Dict[int, float], max_ticks: int
     ) -> int:
         self.state = (
             ExecutorStateValue.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
         )
-        events.emit("executor.phase", phase="replica_moves",
-                    pending=len(planner.replica_tasks))
+        events.emit(
+            "executor.phase", phase="replica_moves",
+            pending=sum(1 for t in planner.replica_tasks
+                        if t.state == TaskState.PENDING),
+        )
+        self._jwrite("phase", phase="replica_moves")
         in_flight: Dict[int, ExecutionTask] = {}
         in_flight_per_broker: Dict[int, int] = {}
         ticks = 0
+        watchdog = self.config.watchdog_stuck_ticks
+        last_progress_tick = 0
+        halted = False  # watchdog stage 1: no new dispatches
         while ticks < max_ticks:
             if self._stop_requested:
                 self.state = ExecutorStateValue.STOPPING_EXECUTION
                 for t in planner.replica_tasks:
                     if t.state == TaskState.PENDING:
                         t.transition(TaskState.ABORTED)
+                        self._jwrite("task", taskId=t.task_id,
+                                     partition=t.proposal.partition,
+                                     state="ABORTED", reason="stopped")
                     elif t.state == TaskState.IN_PROGRESS:
                         t.transition(TaskState.ABORTING)
                         t.transition(TaskState.ABORTED)
+                        self._jwrite("task", taskId=t.task_id,
+                                     partition=t.proposal.partition,
+                                     state="ABORTED", reason="stopped")
                 return ticks
-            batch = planner.next_replica_batch(
+            batch = [] if halted else planner.next_replica_batch(
                 in_flight_per_broker,
                 self._caps(set(in_flight)),
                 sizes,
                 self.backend.under_replicated_partitions(),
+                now_tick=ticks,
             )
+            if batch:
+                # excluded/degraded destinations are re-planned (or the
+                # task aborted) before anything reaches the cluster
+                batch = [
+                    t for t in batch if self._ensure_destinations(planner, t)
+                ]
             if batch:
                 from cruise_control_tpu.telemetry import tracing
 
+                last_progress_tick = ticks
                 # one span + one event per dispatched batch (not per tick):
                 # batch count is bounded by the plan, tick count is not
                 events.emit("executor.batch", phase="replica_moves",
-                            moves=len(batch), tick=ticks)
+                            moves=len(batch), tick=ticks,
+                            partitions=[t.proposal.partition for t in batch])
+                # write-ahead watermark: the batch reaches the checkpoint
+                # BEFORE the cluster sees it, so no crash point can lose
+                # track of a dispatched move (task ids suffice â€” recovery
+                # maps them back to partitions through the start record)
+                self._jwrite("batch", phase="replica_moves", tick=ticks,
+                             taskIds=[t.task_id for t in batch])
                 with tracing.span("executor.batch") as sp:
                     sp.set("moves", len(batch))
                     reassignments = {
@@ -407,7 +871,9 @@ class Executor:
                             in_flight_per_broker[b] = (
                                 in_flight_per_broker.get(b, 0) + 1
                             )
-            if not in_flight:
+            if not in_flight and not any(
+                t.state == TaskState.PENDING for t in planner.replica_tasks
+            ):
                 break
             # advance the world one tick and harvest completions
             tick = getattr(self.backend, "tick", None)
@@ -416,70 +882,119 @@ class Executor:
             ticks += 1
             ongoing = self.backend.ongoing_reassignments()
             finished = [p for p in in_flight if p not in ongoing]
+            completed_now: List[ExecutionTask] = []
             for p in finished:
                 t = in_flight.pop(p)
-                st = self.backend.partition_state(p)
-                ok = list(st.replicas) == list(t.proposal.new_replicas)
-                if not ok:
-                    LOG.warning(
-                        "task %d (partition %d) DEAD: replicas %s != planned "
-                        "%s", t.task_id, p, list(st.replicas),
-                        list(t.proposal.new_replicas),
-                    )
-                    events.emit(
-                        "executor.task_dead", severity="WARNING",
-                        taskId=t.task_id, partition=p,
-                        reason="replica-mismatch",
-                        actual=list(st.replicas),
-                        planned=list(t.proposal.new_replicas),
-                    )
-                t.transition(TaskState.COMPLETED if ok else TaskState.DEAD)
-                t.finished_tick = ticks
                 for b in t.participating_brokers:
                     in_flight_per_broker[b] -= 1
-            # time out stuck moves (upstream: mark DEAD, leave reassignment)
+                st = self.backend.partition_state(p)
+                ok = list(st.replicas) == list(t.proposal.new_replicas)
+                if ok:
+                    t.transition(TaskState.COMPLETED)
+                    t.finished_tick = ticks
+                    last_progress_tick = ticks
+                    completed_now.append(t)
+                else:
+                    self._fail_task(
+                        t, "replica-mismatch", ticks,
+                        extra={
+                            "actual": list(st.replicas),
+                            "planned": list(t.proposal.new_replicas),
+                        },
+                    )
+            if completed_now:
+                # one aggregated record per tick, not one per move â€” the
+                # checkpoint must cost ~nothing on the bench's hot path
+                self._jwrite("task", state="COMPLETED", tick=ticks,
+                             taskIds=[t.task_id for t in completed_now])
+            # time out stuck moves (upstream: mark DEAD, leave reassignment
+            # â€” unless the retry budget buys another attempt)
             for p, t in list(in_flight.items()):
                 if ticks - t.started_tick > self.config.task_timeout_ticks:
-                    LOG.warning(
-                        "task %d (partition %d) DEAD: no progress in %d "
-                        "ticks", t.task_id, p,
-                        self.config.task_timeout_ticks,
-                    )
-                    events.emit(
-                        "executor.task_dead", severity="WARNING",
-                        taskId=t.task_id, partition=p, reason="timeout",
-                        timeoutTicks=self.config.task_timeout_ticks,
-                    )
-                    t.transition(TaskState.DEAD)
-                    t.finished_tick = ticks
                     in_flight.pop(p)
                     for b in t.participating_brokers:
                         in_flight_per_broker[b] -= 1
-        # tick budget exhausted: nothing may stay non-terminal, or the result
-        # would misreport an incomplete rebalance as success
-        for t in in_flight.values():
-            events.emit(
-                "executor.task_dead", severity="WARNING",
-                taskId=t.task_id, partition=t.proposal.partition,
-                reason="tick-budget", maxTicks=max_ticks,
-            )
-            t.transition(TaskState.DEAD)
-            t.finished_tick = ticks
-        for t in planner.replica_tasks:
-            if t.state == TaskState.PENDING:
-                t.transition(TaskState.ABORTED)
+                    self._fail_task(
+                        t, "timeout", ticks,
+                        extra={
+                            "timeoutTicks": self.config.task_timeout_ticks
+                        },
+                    )
+            # stuck-execution watchdog: stop â†’ abort â†’ unrecoverable
+            if watchdog > 0 and (in_flight or any(
+                t.state == TaskState.PENDING for t in planner.replica_tasks
+            )):
+                stuck = ticks - last_progress_tick
+                if stuck >= 2 * watchdog:
+                    events.emit("executor.watchdog", severity="ERROR",
+                                stage="abort", stuckTicks=stuck)
+                    cancel = getattr(self.backend, "cancel_reassignments",
+                                     None)
+                    if cancel is not None:
+                        try:
+                            cancel(sorted(in_flight))
+                        except NotImplementedError:
+                            pass
+                    for p, t in list(in_flight.items()):
+                        events.emit(
+                            "executor.task_dead", severity="WARNING",
+                            taskId=t.task_id, partition=p,
+                            reason="watchdog", stuckTicks=stuck,
+                        )
+                        t.transition(TaskState.DEAD)
+                        t.finished_tick = ticks
+                        self._jwrite("task", taskId=t.task_id, partition=p,
+                                     state="DEAD", tick=ticks,
+                                     attempts=t.attempts, reason="watchdog")
+                    in_flight.clear()
+                    in_flight_per_broker.clear()
+                    self._abort_pending_replicas(planner, "watchdog")
+                    events.emit(
+                        "execution.unrecoverable", severity="ERROR",
+                        executionId=self._execution_seq, stuckTicks=stuck,
+                        tick=ticks,
+                    )
+                    self._jwrite("phase", phase="unrecoverable", tick=ticks)
+                    break
+                if stuck >= watchdog and not halted:
+                    halted = True
+                    events.emit("executor.watchdog", severity="WARNING",
+                                stage="stop", stuckTicks=stuck)
+        else:
+            # tick budget exhausted: nothing may stay non-terminal, or the
+            # result would misreport an incomplete rebalance as success
+            for t in in_flight.values():
+                events.emit(
+                    "executor.task_dead", severity="WARNING",
+                    taskId=t.task_id, partition=t.proposal.partition,
+                    reason="tick-budget", maxTicks=max_ticks,
+                )
+                t.transition(TaskState.DEAD)
+                t.finished_tick = ticks
+                self._jwrite("task", taskId=t.task_id,
+                             partition=t.proposal.partition, state="DEAD",
+                             tick=ticks, attempts=t.attempts,
+                             reason="tick-budget")
+        self._abort_pending_replicas(planner, "not-started")
         return ticks
 
     def _drive_leader_moves(self, planner: ExecutionTaskPlanner) -> None:
         self.state = ExecutorStateValue.LEADER_MOVEMENT_TASK_IN_PROGRESS
-        events.emit("executor.phase", phase="leader_moves",
-                    pending=len(planner.leader_tasks))
+        events.emit(
+            "executor.phase", phase="leader_moves",
+            pending=sum(1 for t in planner.leader_tasks
+                        if t.state == TaskState.PENDING),
+        )
+        self._jwrite("phase", phase="leader_moves")
         while True:
             if self._stop_requested:
                 self.state = ExecutorStateValue.STOPPING_EXECUTION
                 for t in planner.leader_tasks:
                     if t.state == TaskState.PENDING:
                         t.transition(TaskState.ABORTED)
+                        self._jwrite("task", taskId=t.task_id,
+                                     partition=t.proposal.partition,
+                                     state="ABORTED", reason="stopped")
                 return
             batch = planner.next_leader_batch(
                 self.config.num_concurrent_leader_movements
@@ -488,10 +1003,13 @@ class Executor:
                 return
             events.emit("executor.batch", phase="leader_moves",
                         moves=len(batch))
+            self._jwrite("batch", phase="leader_moves",
+                         taskIds=[t.task_id for t in batch])
             elections = {
                 t.proposal.partition: t.proposal.new_leader for t in batch
             }
             self.backend.elect_leaders(elections)
+            elected: List[ExecutionTask] = []
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS)
                 st = self.backend.partition_state(t.proposal.partition)
@@ -507,23 +1025,40 @@ class Executor:
                 t.transition(
                     TaskState.COMPLETED if ok else TaskState.DEAD
                 )
+                if ok:
+                    elected.append(t)
+                else:
+                    self._jwrite("task", taskId=t.task_id,
+                                 partition=t.proposal.partition,
+                                 state="DEAD",
+                                 reason="leader-election-failed")
+            if elected:
+                self._jwrite("task", state="COMPLETED",
+                             taskIds=[t.task_id for t in elected])
 
     def _drive_intra_moves(self, planner: ExecutionTaskPlanner) -> None:
         """JBOD disk-to-disk moves via alterReplicaLogDirs.  Proposals reach
         the executor with dir NAMES in disk_moves (facade-translated)."""
-        if not planner.intra_tasks:
+        if not any(t.state == TaskState.PENDING for t in planner.intra_tasks):
             return
         self.state = (
             ExecutorStateValue.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
         )
-        events.emit("executor.phase", phase="intra_moves",
-                    pending=len(planner.intra_tasks))
+        events.emit(
+            "executor.phase", phase="intra_moves",
+            pending=sum(1 for t in planner.intra_tasks
+                        if t.state == TaskState.PENDING),
+        )
+        self._jwrite("phase", phase="intra_moves")
         while True:
             if self._stop_requested:
                 self.state = ExecutorStateValue.STOPPING_EXECUTION
                 for t in planner.intra_tasks:
                     if t.state == TaskState.PENDING:
                         t.transition(TaskState.ABORTED)
+                        self._jwrite("task", taskId=t.task_id,
+                                     partition=t.proposal.partition,
+                                     state="ABORTED", reason="stopped")
                 return
             batch = planner.next_intra_batch(
                 self.config.num_concurrent_intra_broker_partition_movements
@@ -532,6 +1067,8 @@ class Executor:
                 return
             events.emit("executor.batch", phase="intra_moves",
                         moves=len(batch))
+            self._jwrite("batch", phase="intra_moves",
+                         taskIds=[t.task_id for t in batch])
             moves = {
                 t.proposal.partition: {
                     b: new_dir for b, _old, new_dir in t.proposal.disk_moves
@@ -556,6 +1093,9 @@ class Executor:
                 for t in batch:
                     if t.state == TaskState.IN_PROGRESS and t not in pending:
                         t.transition(TaskState.COMPLETED)
+                        self._jwrite("task", taskId=t.task_id,
+                                     partition=t.proposal.partition,
+                                     state="COMPLETED")
                 if not pending:
                     break
                 if tick is None or waited == self.config.task_timeout_ticks:
@@ -567,6 +1107,10 @@ class Executor:
                             reason="intra-move-timeout",
                         )
                         t.transition(TaskState.DEAD)
+                        self._jwrite("task", taskId=t.task_id,
+                                     partition=t.proposal.partition,
+                                     state="DEAD",
+                                     reason="intra-move-timeout")
                     break
                 tick()
 
@@ -593,4 +1137,15 @@ class Executor:
             "stopRequested": self._stop_requested,
             "adoptedAtStartup": sorted(self.adopted_at_startup),
             "recentExecutions": recent,
+            # crash-recovery + retry posture (docs/ARCHITECTURE.md
+            # "Execution recovery"): the last checkpoint adoption and the
+            # retry machinery's live counters
+            "recovery": {
+                "checkpointEnabled": self.journal is not None,
+                "lastRecovery": self._last_recovery,
+            },
+            "retries": {
+                "scheduled": self._retries_scheduled,
+                "excludedDestinations": sorted(self.excluded_destinations),
+            },
         }

@@ -48,11 +48,26 @@ class ExecutionTask:
     state: TaskState = TaskState.PENDING
     started_tick: int = -1
     finished_tick: int = -1
+    #: failed dispatches so far (retry-with-backoff accounting)
+    attempts: int = 0
+    #: drive-loop tick before which the task must not (re-)dispatch —
+    #: the executor sets it to now + backoff when scheduling a retry
+    next_eligible_tick: int = 0
 
     def transition(self, new_state: TaskState) -> None:
         if new_state not in _VALID_TRANSITIONS[self.state]:
             raise ValueError(f"illegal transition {self.state} -> {new_state}")
         self.state = new_state
+
+    def retry(self, eligible_tick: int) -> None:
+        """The one deliberate side-door past the state machine: a failed
+        IN_PROGRESS task goes back to PENDING for a re-dispatch after
+        ``eligible_tick`` (instead of terminally DEAD).  Only the
+        executor's bounded retry path calls this."""
+        if self.state is not TaskState.IN_PROGRESS:
+            raise ValueError(f"cannot retry a task in state {self.state}")
+        self.state = TaskState.PENDING
+        self.next_eligible_tick = int(eligible_tick)
 
     @property
     def added_brokers(self) -> Set[int]:
@@ -145,6 +160,28 @@ class ChainedReplicaMovementStrategy(ReplicaMovementStrategy):
         )
 
 
+def strategy_by_name(name: str) -> Optional[ReplicaMovementStrategy]:
+    """Resolve a strategy (or a ``+``-joined chain) from its recorded
+    name — the execution checkpoint persists names, not instances.  None
+    for unknown names (recovery falls back to the executor default)."""
+    classes = {
+        cls.name: cls
+        for cls in (
+            ReplicaMovementStrategy,
+            PrioritizeLargeReplicaMovementStrategy,
+            PrioritizeSmallReplicaMovementStrategy,
+            PostponeUrpReplicaMovementStrategy,
+            PrioritizeMinIsrWithOfflineReplicasStrategy,
+        )
+    }
+    parts = name.split("+") if name else []
+    if not parts or any(p not in classes for p in parts):
+        return None
+    if len(parts) == 1:
+        return classes[parts[0]]()
+    return ChainedReplicaMovementStrategy([classes[p]() for p in parts])
+
+
 # ---------------------------------------------------------------------------------
 # Planner (upstream ExecutionTaskPlanner)
 # ---------------------------------------------------------------------------------
@@ -194,11 +231,17 @@ class ExecutionTaskPlanner:
         sizes: Dict[int, float],
         urp: Set[int],
         max_batch: int = 1 << 30,
+        now_tick: int = 1 << 62,
     ) -> List[ExecutionTask]:
-        """Pending tasks whose participating brokers all have spare slots."""
+        """Pending tasks whose participating brokers all have spare slots.
+        ``now_tick`` filters out retrying tasks still inside their backoff
+        window (``next_eligible_tick``)."""
         budget = dict(in_flight_per_broker)
         batch: List[ExecutionTask] = []
-        pending = [t for t in self.replica_tasks if t.state == TaskState.PENDING]
+        pending = [
+            t for t in self.replica_tasks
+            if t.state == TaskState.PENDING and t.next_eligible_tick <= now_tick
+        ]
         for task in self.strategy.order(pending, sizes, urp):
             brokers = task.participating_brokers
             if any(budget.get(b, 0) >= cap_per_broker for b in brokers):

@@ -786,6 +786,76 @@ class CruiseControl:
         progress.finish()
         return response
 
+    # ---- crash recovery (docs/ARCHITECTURE.md "Execution recovery") -------------
+    def recover_execution(self):
+        """Resume (or cleanly settle) an execution a previous process
+        left checkpointed.  Called once at startup, before the executor
+        adopts foreign reassignments and before the detector scheduler
+        starts: the checkpoint's moves are OURS, and the detector's
+        self-healing must treat the recovered execution like a fix of its
+        own (cooldown starts at the next detection cycle, so recovery
+        cannot double-fire a concurrent self-heal).
+
+        Returns the resumed ExecutionResult, or None when there is no
+        journal / no in-flight checkpoint / reconciliation failed (the
+        failure is journaled as ``execution.recovery.end`` outcome
+        ``aborted`` and the checkpoint cleared, so a crash loop cannot
+        wedge startup)."""
+        journal = getattr(self.executor, "journal", None)
+        if journal is None:
+            return None
+        checkpoint = journal.load()
+        if checkpoint is None:
+            return None
+        LOG.warning(
+            "found in-flight execution checkpoint (execution %d, %d "
+            "proposals, phase %s): recovering",
+            checkpoint.execution_id, len(checkpoint.proposals),
+            checkpoint.phase,
+        )
+        events.emit(
+            "execution.recovery.start", severity="WARNING",
+            executionId=checkpoint.execution_id,
+            numProposals=len(checkpoint.proposals),
+            phase=checkpoint.phase,
+            resumedBefore=checkpoint.resumed_before,
+        )
+        result = None
+        try:
+            result = self.executor.resume(checkpoint)
+        except Exception as e:
+            # a recovery that cannot even reconcile must not wedge every
+            # subsequent startup: journal the abort and clear the
+            # checkpoint (the event journal keeps the full story)
+            LOG.exception("execution recovery failed; aborting checkpoint")
+            events.emit(
+                "execution.recovery.end", severity="ERROR",
+                executionId=checkpoint.execution_id, outcome="aborted",
+                succeeded=False, error=repr(e),
+            )
+            journal.thaw()
+            journal.append("end", executionId=checkpoint.execution_id,
+                           outcome="recovery-aborted", error=repr(e))
+        else:
+            events.emit(
+                "execution.recovery.end",
+                severity="INFO" if result.succeeded else "WARNING",
+                executionId=checkpoint.execution_id, outcome="resumed",
+                succeeded=result.succeeded, completed=result.completed,
+                dead=result.dead, aborted=result.aborted,
+                ticks=result.ticks,
+            )
+        if self.anomaly_detector is not None:
+            # the recovered execution counts as the last fix: self-healing
+            # honors the cooldown instead of double-firing mid-recovery
+            self.anomaly_detector.note_recovery()
+        # whatever happened, the cluster moved while we were away
+        self.invalidate_proposal_cache()
+        invalidate = getattr(self.load_monitor.metadata, "invalidate", None)
+        if invalidate is not None:
+            invalidate()
+        return result
+
     # ---- admin ------------------------------------------------------------------
     def stop_execution(self) -> None:
         self.executor.stop_execution()

@@ -44,6 +44,13 @@ from cruise_control_tpu.server.user_tasks import (
 PREFIX = "/kafkacruisecontrol"
 USER_TASK_HEADER = "User-Task-ID"
 
+#: Retry-After guidance on backpressure responses (RFC 9110 §10.2.3).
+#: 429 (task capacity) clears as soon as a worker frees up — retry fast;
+#: 503 (monitor not ready) clears when enough metric windows accumulate —
+#: that takes sampling intervals, so poll an order of magnitude slower.
+RETRY_AFTER_BUSY_S = 2
+RETRY_AFTER_NOT_READY_S = 30
+
 GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
     "user_tasks", "review_board", "metrics", "diagnostics", "events",
@@ -196,7 +203,8 @@ class CruiseControlHttpServer:
             self._send(handler, 400, {"errorMessage": str(e)})
         except NotEnoughValidWindowsError as e:
             self._log.info("%s %s -> 503: %s", method, handler.path, e)
-            self._send(handler, 503, {"errorMessage": str(e)})
+            self._send(handler, 503, {"errorMessage": str(e)},
+                       headers={"Retry-After": str(RETRY_AFTER_NOT_READY_S)})
         except Exception as e:
             self._log.exception("%s %s -> 500", method, handler.path)
             self._send(handler, 500, {"errorMessage": repr(e)})
@@ -508,7 +516,9 @@ class CruiseControlHttpServer:
             if info is not None:
                 # the approval must survive a transient capacity rejection
                 self.purgatory.requeue(info.review_id)
-            return self._send(handler, 429, {"errorMessage": str(e)})
+            return self._send(handler, 429, {"errorMessage": str(e)},
+                              headers={"Retry-After":
+                                       str(RETRY_AFTER_BUSY_S)})
         return self._respond_task(handler, task, params)
 
     def _respond_task(self, handler, task, params: dict) -> None:
@@ -527,11 +537,14 @@ class CruiseControlHttpServer:
             )
         err = task.future.exception()
         if err is not None:
-            code = 503 if isinstance(err, NotEnoughValidWindowsError) else 500
+            not_ready = isinstance(err, NotEnoughValidWindowsError)
+            headers = {USER_TASK_HEADER: task.task_id}
+            if not_ready:
+                headers["Retry-After"] = str(RETRY_AFTER_NOT_READY_S)
             return self._send(
-                handler, code,
+                handler, 503 if not_ready else 500,
                 {"errorMessage": repr(err), "UserTaskId": task.task_id},
-                headers={USER_TASK_HEADER: task.task_id},
+                headers=headers,
             )
         result = task.future.result()
         if hasattr(result, "violations_after"):

@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 import time
 import uuid
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import CancelledError, Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
 from cruise_control_tpu.server.progress import OperationProgress
@@ -33,6 +33,8 @@ class UserTask:
         self.progress = OperationProgress(endpoint)
         self.created_s = time.time()
         self.completed_s: Optional[float] = None
+        #: the pool's wrapper future (shutdown cancels queued ones)
+        self.pool_future: Optional[Future] = None
 
     @property
     def state(self) -> str:
@@ -102,7 +104,7 @@ class UserTaskManager:
             finally:
                 task.completed_s = time.time()
 
-        self._pool.submit(run)
+        task.pool_future = self._pool.submit(run)
         return task
 
     def get(self, task_id: str) -> Optional[UserTask]:
@@ -133,8 +135,29 @@ class UserTaskManager:
             for _, tid in done[: max(0, len(done) - self.max_cached_completed)]:
                 del self._tasks[tid]
 
-    def shutdown(self) -> None:
-        self._pool.shutdown(wait=False)
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        """Stop the pool without leaking threads or wedging server stop:
+        queued (not-yet-started) work is cancelled — its tasks complete
+        with CancelledError so late polls see a terminal state instead of
+        an eternal ACTIVE — and the worker threads are joined with a
+        bounded timeout (an operation stuck mid-execution must not hang
+        shutdown forever; daemonized HTTP threads die with the process)."""
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        now = time.time()
+        with self._lock:
+            tasks = list(self._tasks.values())
+        for t in tasks:
+            if (t.pool_future is not None and t.pool_future.cancelled()
+                    and not t.future.done()):
+                t.future.set_exception(
+                    CancelledError("server shut down before the task ran")
+                )
+                t.completed_s = now
+        deadline = now + max(0.0, timeout_s)
+        # ThreadPoolExecutor keeps no public thread handle; `_threads` is
+        # the stable stdlib attribute (the bounded join is the whole point)
+        for thread in list(getattr(self._pool, "_threads", ())):
+            thread.join(timeout=max(0.0, deadline - time.time()))
 
 
 class TooManyTasksError(RuntimeError):

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Set
 
 from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+from cruise_control_tpu.executor.journal import ProcessCrash
 
 
 class ScriptedClusterBackend(SimulatedClusterBackend):
@@ -50,6 +51,14 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
         #: armed mid-execution kill: (broker, ticks after first in-flight)
         self._armed_kill: Optional[tuple] = None
         self._armed_countdown: Optional[int] = None
+        #: armed process crash: ticks after first in-flight reassignment
+        self._armed_crash: Optional[int] = None
+        self._crash_countdown: Optional[int] = None
+        #: armed broker flapping: (broker|None, down, up, cycles)
+        self._armed_flap: Optional[tuple] = None
+        #: live flap state machine: [broker, phase_ticks_left, is_down,
+        #: cycles_left, down_ticks, up_ticks]
+        self._flap_state: Optional[list] = None
 
     # ---- timeline surface -------------------------------------------------------
     def kill_broker(self, broker: int) -> None:
@@ -101,6 +110,38 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
         )
         self._armed_countdown = None
 
+    def arm_crash_mid_execution(self, after_ticks: int) -> None:
+        """The control plane dies ``after_ticks`` ticks after the next
+        execution puts reassignments in flight: ``tick()`` raises
+        ProcessCrash, which unwinds the executor without any cleanup."""
+        self._armed_crash = max(1, int(after_ticks))
+        self._crash_countdown = None
+
+    def arm_flap_mid_execution(
+        self,
+        broker: Optional[int],
+        down_ticks: int,
+        up_ticks: int,
+        cycles: int,
+    ) -> None:
+        """``broker=None``: flap whichever broker is catching up replicas
+        when the flapping starts (the executor's timeout/retry path)."""
+        self._armed_flap = (
+            int(broker) if broker is not None else None,
+            max(1, int(down_ticks)), max(1, int(up_ticks)),
+            max(1, int(cycles)),
+        )
+        self._flap_state = None
+
+    def _first_catching_up(self) -> Optional[int]:
+        catching = {
+            b
+            for p in self._target
+            for b in self.partitions[p].catching_up
+            if b not in self.failed_brokers
+        }
+        return min(catching) if catching else None
+
     # ---- admin overrides --------------------------------------------------------
     def alter_partition_reassignments(
         self, reassignments: Dict[int, Sequence[int]]
@@ -127,6 +168,42 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
 
     # ---- simulation -------------------------------------------------------------
     def tick(self) -> None:
+        if self._armed_crash is not None:
+            if self._crash_countdown is None and self._target:
+                self._crash_countdown = self._armed_crash
+            if self._crash_countdown is not None:
+                self._crash_countdown -= 1
+                if self._crash_countdown <= 0:
+                    self._armed_crash = None
+                    self._crash_countdown = None
+                    # unwinds the executor mid-drive with no cleanup (the
+                    # driver catches it and marks the process down)
+                    raise ProcessCrash("scripted crash_process fired")
+        if self._armed_flap is not None and self._target:
+            broker, down, up, cycles = self._armed_flap
+            if broker is None:
+                broker = self._first_catching_up()
+            if broker is not None:
+                self._armed_flap = None
+                # [broker, phase_ticks_left, is_down, cycles_left, down, up]
+                self._flap_state = [broker, down, True, cycles, down, up]
+                self.kill_broker(broker)
+        elif self._flap_state is not None:
+            st = self._flap_state
+            st[1] -= 1
+            if st[1] <= 0:
+                broker = st[0]
+                if st[2]:  # down phase over: broker comes back
+                    self.restore_broker(broker)
+                    st[2] = False
+                    st[1] = st[5]
+                    st[3] -= 1
+                elif st[3] <= 0:  # all cycles done, broker stays up
+                    self._flap_state = None
+                else:  # up phase over: broker dies again
+                    self.kill_broker(broker)
+                    st[2] = True
+                    st[1] = st[4]
         if self._armed_kill is not None:
             if self._armed_countdown is None and self._target:
                 self._armed_countdown = self._armed_kill[1]

@@ -9,7 +9,7 @@ then relapse, alert-only metric anomalies, and scripted execution stalls.
 
 ``tests/test_scenarios.py`` asserts each scenario's heal outcome by reading
 only the event journal; ``python -m cruise_control_tpu.sim`` runs the suite
-and emits the ``cc-tpu-scenarios/1`` artifact (``SCENARIOS_r07.json``).
+and emits the ``cc-tpu-scenarios/1`` artifact (``SCENARIOS_r08.json``).
 
 Timing note: the monitor averages loads over its (5 × 1-virtual-minute)
 windows, so a load change needs ~3 windows before a capacity detector sees
@@ -25,13 +25,16 @@ from cruise_control_tpu.sim.simulator import MIN_MS, ScenarioSpec
 from cruise_control_tpu.sim.timeline import (
     Timeline,
     add_broker,
+    crash_process,
     disk_failure,
+    flap_broker,
     hot_partition_skew,
     kill_broker,
     kill_broker_mid_execution,
     maintenance_event,
     metric_gap,
     rack_loss,
+    restart_process,
     restore_broker,
     restore_disk,
     stall_execution,
@@ -264,6 +267,106 @@ def _stalled_execution_retries() -> ScenarioSpec:
     )
 
 
+# ---- crash-safe execution (ISSUE 7): checkpoint/resume + retry chaos -----------
+def _crash_resume_mid_execution() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="crash_resume_mid_execution",
+        description=(
+            "The control plane crashes mid-rebalance (checkpoint armed); "
+            "the restarted process replays the execution checkpoint, "
+            "marks the moves that finished as COMPLETED, and resumes the "
+            "rest — zero already-completed partitions are re-moved."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=6.0, leader=0),
+            crash_process(4 * MIN_MS, after_ticks=6),
+            restart_process(16 * MIN_MS),
+        ]),
+        self_healing={"goal_violation": True},
+        checkpoint=True,
+        mean_utilization=0.18,
+        move_latency_ticks=4,
+        executor_moves_per_broker=1,  # multiple batches: some complete
+        fix_cooldown_ms=2 * MIN_MS,   # before the crash, some do not
+        duration_ms=32 * MIN_MS,
+    )
+
+
+def _crash_completes_while_down() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="crash_completes_while_down",
+        description=(
+            "The process crashes right after dispatching; the cluster "
+            "finishes every in-flight move while the controller is down. "
+            "Recovery reconciles checkpoint vs live state, marks all "
+            "moves COMPLETED-while-down, and resumes without issuing a "
+            "single new replica batch."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=6.0, leader=0),
+            crash_process(4 * MIN_MS, after_ticks=2),
+            restart_process(18 * MIN_MS),
+        ]),
+        self_healing={"goal_violation": True},
+        checkpoint=True,
+        mean_utilization=0.18,
+        move_latency_ticks=6,
+        fix_cooldown_ms=2 * MIN_MS,
+        duration_ms=32 * MIN_MS,
+    )
+
+
+def _crash_recovery_replans_dead_destination() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="crash_recovery_replans_dead_destination",
+        description=(
+            "Crash mid-execution, then a replica-receiving broker dies "
+            "while the controller is down: recovery finds the vanished "
+            "destination, re-plans those moves onto live brokers, resumes "
+            "the rest, and the broker-failure heal evacuates the corpse."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=6.0, leader=0),
+            crash_process(4 * MIN_MS, after_ticks=2),
+            kill_broker_mid_execution(4 * MIN_MS, after_ticks=4),
+            restart_process(17 * MIN_MS),
+        ]),
+        self_healing={"goal_violation": True, "broker_failure": True},
+        checkpoint=True,
+        mean_utilization=0.15,
+        move_latency_ticks=10,  # in-flight at restart: the dead dest matters
+        fix_cooldown_ms=2 * MIN_MS,
+        broker_failure_heal_ms=4 * MIN_MS,
+        duration_ms=40 * MIN_MS,
+    )
+
+
+def _flapping_destination_retries() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="flapping_destination_retries",
+        description=(
+            "A replica-receiving broker flaps (dies/recovers twice) "
+            "during the self-healing rebalance: moves onto it time out, "
+            "the executor retries them with exponential backoff, and the "
+            "execution completes with zero dead tasks once the broker "
+            "stays up."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=8.0, leader=0),
+            flap_broker(4 * MIN_MS, down_ticks=8, up_ticks=6, cycles=2),
+        ]),
+        self_healing={"goal_violation": True},
+        task_retry_attempts=4,
+        task_retry_backoff_base_ticks=2,
+        task_retry_backoff_max_ticks=16,
+        executor_task_timeout_ticks=5,
+        move_latency_ticks=2,
+        mean_utilization=0.18,
+        fix_cooldown_ms=2 * MIN_MS,
+        duration_ms=30 * MIN_MS,
+    )
+
+
 #: name → spec factory; a fresh ScenarioSpec per call
 SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
     factory().name: factory
@@ -280,12 +383,19 @@ SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
         _recovery_then_relapse,
         _metric_anomaly_alert_only,
         _stalled_execution_retries,
+        _crash_resume_mid_execution,
+        _crash_completes_while_down,
+        _crash_recovery_replans_dead_destination,
+        _flapping_destination_retries,
     )
 }
 
 #: the tier-1 smoke subset (runs under ``-m 'not slow'``); the full matrix
-#: is marked slow and exercised by the CLI artifact run
-SMOKE_SCENARIOS = ("rack_loss", "cascading_disk_failures")
+#: is marked slow and exercised by the CLI artifact run.
+#: crash_resume_mid_execution rides in tier-1 so the crash-resume journal
+#: fingerprint is re-verified bit-for-bit on every run (ISSUE 7).
+SMOKE_SCENARIOS = ("rack_loss", "cascading_disk_failures",
+                   "crash_resume_mid_execution")
 
 
 def make_scenario(name: str, seed: Optional[int] = None) -> ScenarioSpec:

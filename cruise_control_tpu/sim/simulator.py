@@ -26,6 +26,8 @@ import contextlib
 import dataclasses
 import hashlib
 import json
+import os
+import tempfile
 from typing import Dict, List, Optional, Sequence
 
 from cruise_control_tpu.bootstrap import _capacity_for
@@ -34,6 +36,7 @@ from cruise_control_tpu.detector.detectors import MaintenanceEventReader
 from cruise_control_tpu.detector.manager import make_detector_manager
 from cruise_control_tpu.detector.notifier import SelfHealingNotifier
 from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.executor.journal import ExecutionJournal, ProcessCrash
 from cruise_control_tpu.facade import CruiseControl
 from cruise_control_tpu.models.generators import random_cluster
 from cruise_control_tpu.monitor.load_monitor import (
@@ -104,7 +107,18 @@ class ScenarioSpec:
     target_rf: Optional[int] = None
     # executor shape
     executor_task_timeout_ticks: int = 20
+    executor_moves_per_broker: int = 5
     move_latency_ticks: int = 1
+    # crash-safe execution knobs (ISSUE 7): write-ahead checkpoint +
+    # retry with backoff + watchdog — off by default so pre-existing
+    # scenario timelines keep their semantics
+    checkpoint: bool = False
+    task_retry_attempts: int = 0
+    task_retry_backoff_base_ticks: int = 2
+    task_retry_backoff_max_ticks: int = 16
+    task_retry_jitter_ticks: int = 1
+    dest_exclusion_threshold: int = 0
+    watchdog_stuck_ticks: int = 0
 
     def healing_enables(self) -> Dict[AnomalyType, bool]:
         return {
@@ -150,11 +164,16 @@ class ScenarioResult:
     def executions(self) -> List[dict]:
         return [e.get("payload", {}) for e in self.events_of("execute.end")]
 
+    def executor_ends(self) -> List[dict]:
+        """``executor.end`` payloads: one per drive — facade executions
+        AND checkpoint resumes (which never pass through the facade)."""
+        return [e.get("payload", {}) for e in self.events_of("executor.end")]
+
     def actions_executed(self) -> int:
-        return sum(int(p.get("completed", 0)) for p in self.executions())
+        return sum(int(p.get("completed", 0)) for p in self.executor_ends())
 
     def dead_tasks(self) -> int:
-        return sum(int(p.get("dead", 0)) for p in self.executions())
+        return sum(int(p.get("dead", 0)) for p in self.executor_ends())
 
     def detection_latency_ms(
         self, anomaly_type: Optional[str] = None
@@ -169,23 +188,52 @@ class ScenarioResult:
             return None
         return max(0, min(det_ts) - min(fault_ts))
 
+    def recoveries(self) -> List[dict]:
+        """``execution.recovery.end`` payloads (checkpoint adoptions)."""
+        return [e.get("payload", {})
+                for e in self.events_of("execution.recovery.end")]
+
+    def resume_summaries(self) -> List[dict]:
+        """``executor.resume`` payloads: the reconciliation story — which
+        partitions were already done and what was re-issued/re-planned."""
+        return [e.get("payload", {})
+                for e in self.events_of("executor.resume")]
+
     def heal_outcome(self) -> str:
-        """Classify the run from detector decisions alone: HEALED /
-        FIX_FAILED / ALERT_ONLY / SUPPRESSED / UNHEALED / NO_ANOMALY."""
-        decisions = self.anomalies()
-        if not decisions:
+        """Classify the run from the journal alone: HEALED / FIX_FAILED /
+        ALERT_ONLY / SUPPRESSED / UNHEALED / NO_ANOMALY.
+
+        A successfully *resumed* checkpoint recovery counts as a started
+        fix: the crash interrupted a self-healing execution mid-flight and
+        the restarted process finished it — the crashed process never got
+        to journal a fix outcome, but the recovery records tell the same
+        story (journal order stands in for time: recovery events carry no
+        virtual clock)."""
+        decisions = []  # (journal_idx, detector decision payload)
+        fix_marks = []  # journal_idx of fixes started + resumed recoveries
+        for i, e in enumerate(self.journal):
+            kind = e["kind"]
+            if (kind == "detector.anomaly"
+                    or kind.startswith("detector.anomaly.")):
+                p = e.get("payload", {})
+                decisions.append((i, p))
+                if p.get("fixStarted"):
+                    fix_marks.append(i)
+            elif kind == "execution.recovery.end":
+                p = e.get("payload", {})
+                if p.get("outcome") == "resumed" and p.get("succeeded"):
+                    fix_marks.append(i)
+        if not decisions and not fix_marks:
             return "NO_ANOMALY"
-        last_fix_started = max(
-            (i for i, p in enumerate(decisions) if p.get("fixStarted")),
-            default=None,
-        )
-        failed_after = any(
-            p.get("action") == "FIX_FAILED"
-            for p in decisions[(last_fix_started or 0) + 1:]
-        ) if last_fix_started is not None else False
-        if last_fix_started is not None and not failed_after:
-            return "HEALED"
-        actions = {p.get("action") for p in decisions}
+        last_fix = max(fix_marks, default=None)
+        if last_fix is not None:
+            failed_after = any(
+                p.get("action") == "FIX_FAILED"
+                for i, p in decisions if i > last_fix
+            )
+            if not failed_after:
+                return "HEALED"
+        actions = {p.get("action") for _, p in decisions}
         if "FIX_FAILED" in actions:
             return "FIX_FAILED"
         if actions <= {"IGNORE"}:
@@ -229,9 +277,18 @@ def _scenario_journal(ring_size: int = 1 << 15):
 
 
 class _Sim:
-    """The assembled stack plus scripting state for one run."""
+    """The assembled stack plus scripting state for one run.
+
+    The *cluster* (backend, workload ground truth, maintenance stream) is
+    built once and survives process crashes; the *control plane* (monitor
+    → facade → executor → detector manager) is built by
+    :meth:`_build_control_plane` and rebuilt from scratch on
+    ``restart_process`` — a restarted process starts with empty metric
+    windows and recovers only what the execution checkpoint persisted,
+    exactly like a real redeploy."""
 
     def __init__(self, spec: ScenarioSpec):
+        self.spec = spec
         state = random_cluster(
             seed=spec.seed,
             num_brokers=spec.num_brokers,
@@ -257,30 +314,67 @@ class _Sim:
             },
             move_latency_ticks=spec.move_latency_ticks,
         )
+        self._partition_topic = {
+            p: f"topic_{int(state.partition_topic[p])}" for p in w.assignment
+        }
+        # capacities are sized ONCE from the pristine workload: a process
+        # restart must not resize the cluster
+        self._capacity_resolver = _capacity_for(
+            w, spec.num_brokers, target_mean_util=spec.mean_utilization
+        )
+        self.maintenance = MaintenanceEventReader()
+        #: execution checkpoint location; survives restarts (the path never
+        #: enters the event journal, so fingerprints stay deterministic)
+        self._checkpoint_path = (
+            os.path.join(tempfile.mkdtemp(prefix="cc-sim-ckpt-"),
+                         "execution.ckpt.jsonl")
+            if spec.checkpoint else None
+        )
+        self.process_up = True
+        #: metric-gap windows [(start_ms, end_ms)), virtual
+        self.gaps: List[tuple] = []
+        self._build_control_plane()
+
+    def _build_control_plane(self) -> None:
+        spec = self.spec
         metadata = BackendMetadataClient(
             self.backend,
             self.backend.broker_racks,  # shared: add_broker updates both
-            partition_topic={
-                p: f"topic_{int(state.partition_topic[p])}"
-                for p in w.assignment
-            },
+            partition_topic=self._partition_topic,
         )
         self.topic = MetricsTopic()
-        self.reporter = SimulatedMetricsReporter(w, self.topic)
+        self.reporter = SimulatedMetricsReporter(self.workload.model,
+                                                 self.topic)
         self.monitor = LoadMonitor(
             metadata,
             MetricsReporterSampler(self.topic),
-            capacity_resolver=_capacity_for(
-                w, spec.num_brokers, target_mean_util=spec.mean_utilization
-            ),
+            capacity_resolver=self._capacity_resolver,
             window_ms=spec.tick_ms,
             num_windows=5,
+        )
+        journal = (
+            ExecutionJournal(self._checkpoint_path)
+            if self._checkpoint_path else None
         )
         self.executor = Executor(
             self.backend,
             ExecutorConfig(
                 task_timeout_ticks=spec.executor_task_timeout_ticks,
+                num_concurrent_partition_movements_per_broker=(
+                    spec.executor_moves_per_broker
+                ),
+                task_retry_max_attempts=spec.task_retry_attempts,
+                task_retry_backoff_base_ticks=(
+                    spec.task_retry_backoff_base_ticks
+                ),
+                task_retry_backoff_max_ticks=(
+                    spec.task_retry_backoff_max_ticks
+                ),
+                task_retry_jitter_ticks=spec.task_retry_jitter_ticks,
+                dest_exclusion_threshold=spec.dest_exclusion_threshold,
+                watchdog_stuck_ticks=spec.watchdog_stuck_ticks,
             ),
+            journal=journal,
         )
         # a private registry: scenario runs must not pollute the process
         # default the server / other tests read
@@ -288,7 +382,6 @@ class _Sim:
             self.monitor, self.executor, engine="greedy",
             registry=MetricRegistry(),
         )
-        self.maintenance = MaintenanceEventReader()
         self.manager = make_detector_manager(
             self.cc,
             backend=self.backend,
@@ -312,8 +405,17 @@ class _Sim:
             detection_interval_ms=spec.detection_interval_ms,
             fix_cooldown_ms=spec.fix_cooldown_ms,
         )
-        #: metric-gap windows [(start_ms, end_ms)), virtual
-        self.gaps: List[tuple] = []
+
+    def crash(self) -> None:
+        self.process_up = False
+
+    def restart(self) -> None:
+        """The 'new process': fresh monitor windows, fresh detector state,
+        fresh executor — then the facade's checkpoint recovery path, which
+        resumes whatever the dead process left in flight."""
+        self._build_control_plane()
+        self.cc.recover_execution()
+        self.process_up = True
 
     def in_gap(self, now_ms: int) -> bool:
         return any(start <= now_ms < end for start, end in self.gaps)
@@ -357,6 +459,23 @@ def _apply_event(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
                                        ev.arg("batches", 1))
     elif ev.kind == "fail_partition":
         sim.backend.fail_partitions.add(ev.arg("partition"))
+    elif ev.kind == "crash_process":
+        sim.backend.arm_crash_mid_execution(ev.arg("after_ticks"))
+    elif ev.kind == "flap_broker":
+        sim.backend.arm_flap_mid_execution(
+            ev.arg("broker"), ev.arg("down_ticks"), ev.arg("up_ticks"),
+            ev.arg("cycles"),
+        )
+    elif ev.kind == "restart_process":
+        # the fault marker goes first so the journal reads operator-style:
+        # restart → recovery.start → executor.resume → recovery.end
+        events.emit(
+            "sim.fault", fault=ev.kind, virtualMs=now_ms, atMs=ev.at_ms,
+            args=dict(ev.args), wasDown=not sim.process_up,
+        )
+        if not sim.process_up:
+            sim.restart()
+        return
     else:  # constructors validate kinds; this guards future drift
         raise ValueError(f"unhandled timeline event kind {ev.kind!r}")
     events.emit(
@@ -390,10 +509,23 @@ def run_scenario(spec: ScenarioSpec) -> ScenarioResult:
                 _apply_event(sim, ev, now)
             sim.workload.advance(now)
             sim.workload.sync_topology(sim.backend)
-            if not sim.in_gap(now):
-                sim.reporter.report(time_ms=now - spec.tick_ms // 2)
-            sim.monitor.run_sampling_iteration(now)
-            sim.manager.run_detection_cycle(now)
+            if sim.process_up:
+                if not sim.in_gap(now):
+                    sim.reporter.report(time_ms=now - spec.tick_ms // 2)
+                sim.monitor.run_sampling_iteration(now)
+                try:
+                    sim.manager.run_detection_cycle(now)
+                except ProcessCrash:
+                    # the armed crash fired inside the executor drive loop:
+                    # the whole control plane is gone; only the cluster
+                    # (backend) and the frozen checkpoint survive
+                    sim.crash()
+                    events.emit("sim.crash", severity="ERROR",
+                                virtualMs=now)
+            else:
+                # the process is down but the cluster lives on: in-flight
+                # reassignments keep progressing, brokers keep flapping
+                sim.backend.tick()
         events.emit(
             "sim.scenario_end", name=spec.name, virtualMs=now, ticks=ticks,
             actionCounts=sim.manager.action_counts(),

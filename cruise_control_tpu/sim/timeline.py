@@ -38,6 +38,22 @@ faults the executor must survive):
 ``fail_partition``
     Reassignments for the partition are silently dropped by the backend
     (the executor's replica-mismatch/timeout DEAD path).
+``crash_process`` / ``restart_process``
+    Process death and rebirth of the WHOLE control plane.  ``crash_process``
+    arms the backend: once the next execution has reassignments in flight,
+    ``after_ticks`` backend ticks later a
+    :class:`~cruise_control_tpu.executor.journal.ProcessCrash` unwinds the
+    executor mid-drive (no cleanup runs — exactly like a real SIGKILL; the
+    execution checkpoint freezes at the crash point).  The cluster lives on
+    while the process is down (moves keep progressing).  ``restart_process``
+    rebuilds the monitor → detector → analyzer → executor stack and runs
+    the facade's checkpoint recovery path.
+``flap_broker``
+    A broker repeatedly dies and recovers mid-execution (``down_ticks``
+    dead / ``up_ticks`` alive, ``cycles`` times, starting once the next
+    execution has moves in flight).  ``broker=None`` flaps whichever broker
+    is catching up replicas when the flapping starts — the executor's
+    timeout → retry-with-backoff path.
 """
 
 from __future__ import annotations
@@ -60,6 +76,9 @@ KINDS = (
     "metric_gap",
     "stall_execution",
     "fail_partition",
+    "crash_process",
+    "restart_process",
+    "flap_broker",
 )
 
 
@@ -162,6 +181,35 @@ def stall_execution(at_ms: int, ticks: int, batches: int = 1) -> TimelineEvent:
 
 def fail_partition(at_ms: int, partition: int) -> TimelineEvent:
     return _event(at_ms, "fail_partition", partition=int(partition))
+
+
+def crash_process(at_ms: int, after_ticks: int = 2) -> TimelineEvent:
+    """Arm a process crash: the control plane dies ``after_ticks`` backend
+    ticks after the NEXT execution puts reassignments in flight."""
+    return _event(at_ms, "crash_process", after_ticks=int(after_ticks))
+
+
+def restart_process(at_ms: int) -> TimelineEvent:
+    """Rebuild the control plane and run checkpoint recovery (no-op when
+    the process is not down)."""
+    return _event(at_ms, "restart_process")
+
+
+def flap_broker(
+    at_ms: int,
+    broker: Optional[int] = None,
+    down_ticks: int = 8,
+    up_ticks: int = 8,
+    cycles: int = 2,
+) -> TimelineEvent:
+    """``broker=None``: flap whichever broker is catching up replicas when
+    the flapping starts (guaranteed to hit in-flight moves)."""
+    return _event(
+        at_ms, "flap_broker",
+        broker=int(broker) if broker is not None else None,
+        down_ticks=int(down_ticks), up_ticks=int(up_ticks),
+        cycles=int(cycles),
+    )
 
 
 class Timeline:

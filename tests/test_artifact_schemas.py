@@ -117,6 +117,39 @@ def _event_records(tmp_path):
     return ring + on_disk
 
 
+def _checkpoint_records(tmp_path):
+    """Run a real execution with the write-ahead journal attached and
+    validate every record it persisted (plus a torn-tail read-back)."""
+    from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.executor.journal import ExecutionJournal
+
+    path = tmp_path / "execution.ckpt.jsonl"
+    backend = SimulatedClusterBackend(
+        {0: [0, 1], 1: [1, 2]}, {0: 0, 1: 1}, brokers={0, 1, 2, 3},
+    )
+    journal = ExecutionJournal(str(path))
+    recorded = []
+    original = journal._write_line
+
+    def capture(line):
+        recorded.append(json.loads(line))
+        original(line)
+
+    journal._write_line = capture
+    ex = Executor(backend, journal=journal)
+    result = ex.execute_proposals([
+        ExecutionProposal(partition=0, topic=0, old_leader=0, new_leader=2,
+                          old_replicas=(0, 1), new_replicas=(2, 3)),
+    ])
+    assert result.succeeded
+    assert {r["kind"] for r in recorded} >= {"start", "batch", "task", "end"}
+    # the end record truncated the file: nothing left to recover
+    assert journal.load() is None
+    return recorded
+
+
 def _scenario_artifact():
     from cruise_control_tpu.sim import ScenarioSpec, make_artifact, run_scenario
     from cruise_control_tpu.sim.timeline import Timeline, disk_failure
@@ -133,7 +166,7 @@ def _scenario_artifact():
 
 
 @pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
-                                      "events", "scenarios"])
+                                      "events", "scenarios", "checkpoint"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     if producer == "phase-profile":
         arts = _phase_profile_artifact()
@@ -144,6 +177,9 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "scenarios":
         arts = _scenario_artifact()
         schema = SCHEMAS["cc-tpu-scenarios/1"]
+    elif producer == "checkpoint":
+        arts = _checkpoint_records(tmp_path)
+        schema = SCHEMAS["cc-tpu-execution-checkpoint/1"]
     else:
         arts = _event_records(tmp_path)
         schema = SCHEMAS["cc-tpu-events/1"]

@@ -203,6 +203,71 @@ RULE_FIXTURES = {
             "    return d.counter(f'x.{k}') if hasattr(d, 'x') else None\n",
         ],
     },
+    "retry-discipline": {
+        "positive": [
+            # constant backoff + unbounded: hammers the dependency forever
+            "import time\n"
+            "def fetch(conn):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return conn.read()\n"
+            "        except Exception:\n"
+            "            time.sleep(5)\n",
+            # bounded, but still a fixed cadence — no backoff, no jitter
+            "import time\n"
+            "def poll(conn):\n"
+            "    for _ in range(3):\n"
+            "        try:\n"
+            "            return conn.read()\n"
+            "        except OSError:\n"
+            "            time.sleep(1.0)\n",
+            # unbounded even with a computed delay: no exit on failure
+            "import time\n"
+            "def settle(conn, backoff):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return conn.read()\n"
+            "        except OSError:\n"
+            "            time.sleep(backoff())\n",
+        ],
+        "negative": [
+            # exponential backoff with a bounded attempt budget
+            "import time\n"
+            "def fetch(conn):\n"
+            "    delay = 0.1\n"
+            "    for attempt in range(5):\n"
+            "        try:\n"
+            "            return conn.read()\n"
+            "        except OSError:\n"
+            "            time.sleep(delay)\n"
+            "            delay = min(delay * 2, 2.0)\n"
+            "    raise TimeoutError('gave up')\n",
+            # while True, but the failure path escalates (raise bound)
+            "import time\n"
+            "def fetch(conn, deadline, backoff):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return conn.read()\n"
+            "        except OSError:\n"
+            "            if time.time() > deadline:\n"
+            "                raise\n"
+            "            time.sleep(backoff())\n",
+            # daemon service loop without a sleep: swallowed-exception's
+            # beat, not a retry loop
+            "def loop(stop, work):\n"
+            "    while not stop.is_set():\n"
+            "        try:\n"
+            "            work()\n"
+            "        except Exception:\n"
+            "            LOG.exception('tick failed')\n",
+            # sleep in a loop without exception handling: a poll pace,
+            # not a retry
+            "import time\n"
+            "def wait_for(cond):\n"
+            "    while not cond():\n"
+            "        time.sleep(0.5)\n",
+        ],
+    },
     "swallowed-exception": {
         "positive": [
             "def loop(work):\n"

@@ -171,6 +171,30 @@ class TestManagerEndToEnd:
             r["action"] == "FIX_DELAYED_COOLDOWN" for r in st["recentAnomalies"]
         )
 
+    def test_recovered_execution_claims_cooldown(self):
+        """note_recovery (ISSUE 7): a resumed checkpoint counts as the
+        last fix — the first post-recovery cycle starts the cooldown, so
+        self-healing cannot double-fire on top of the recovery."""
+        cc, backend, _ = full_stack()
+        mgr = make_detector_manager(
+            cc, backend=backend,
+            notifier=healing_notifier(goal_violation=True),
+            fix_cooldown_ms=10 * MIN,
+            detection_interval_ms=0,
+        )
+        mgr.note_recovery()
+        mgr.run_detection_cycle(now_ms=MIN)  # claims the cooldown at MIN
+        st = mgr.state_summary()
+        assert st["lastFixMs"] == MIN
+        assert st["metrics"].get("FIX", 0) == 0
+        assert any(
+            r["action"] == "FIX_DELAYED_COOLDOWN"
+            for r in st["recentAnomalies"]
+        ), "the violation fix should have been delayed by the recovery"
+        # cooldown over: the delayed fix proceeds normally
+        mgr.run_detection_cycle(now_ms=12 * MIN)
+        assert mgr.state_summary()["metrics"]["FIX"] >= 1
+
     def test_maintenance_event_remove_broker(self):
         cc, backend, _ = full_stack()
         reader = MaintenanceEventReader()

@@ -1,6 +1,8 @@
 """Executor layer tests (upstream ExecutorTest / ExecutionTaskPlannerTest /
 ExecutionTaskManagerTest tier, against the simulated backend)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -519,6 +521,303 @@ def test_broker_death_mid_execution_kills_tasks_then_self_heals():
     assert any(a.anomaly_type == AnomalyType.BROKER_FAILURE for a in handled)
     for p, st in backend.partitions.items():
         assert 3 not in st.replicas, (p, st)
+
+
+# ---- crash-safe execution (ISSUE 7) ---------------------------------------------
+def _crash_prop(p, old, new):
+    return ExecutionProposal(
+        partition=p, topic=0, old_leader=old[0], new_leader=new[0],
+        old_replicas=tuple(old), new_replicas=tuple(new),
+    )
+
+
+def _crash_fixture():
+    """Small deterministic plan over a 4-broker cluster: 3 replica moves
+    (each with a leader change) at latency 2 — several checkpoint records
+    per phase, several batches worth of boundaries."""
+    assignment = {p: [(p + i) % 4 for i in range(2)] for p in range(6)}
+    leaders = {p: assignment[p][0] for p in range(6)}
+    backend = SimulatedClusterBackend(
+        {p: list(r) for p, r in assignment.items()}, dict(leaders),
+        move_latency_ticks=2,
+    )
+    plan = [_crash_prop(p, assignment[p], [2, 3]) for p in (0, 1, 4)]
+    return backend, plan
+
+
+def _placement(backend):
+    return {
+        p: (list(st.replicas), st.leader)
+        for p, st in backend.partitions.items()
+    }
+
+
+def test_crash_consistency_at_every_checkpoint_boundary(tmp_path):
+    """THE crash-consistency harness (ISSUE 7 satellite): kill the
+    executor at EVERY checkpoint-write boundary of a small plan, recover
+    with a fresh process, and assert reconciliation converges to the same
+    final replica placement as the uninterrupted run.  A crash before the
+    ``start`` record leaves nothing durable — the cluster is untouched
+    and re-detection re-plans, which must converge too."""
+    from cruise_control_tpu.executor.journal import (
+        ExecutionJournal,
+        ProcessCrash,
+    )
+
+    backend, plan = _crash_fixture()
+    Executor(backend).execute_proposals(plan)
+    reference = _placement(backend)
+
+    path = str(tmp_path / "execution.ckpt.jsonl")
+    boundaries = 0
+    for n in range(0, 200):
+        backend, plan = _crash_fixture()
+        if os.path.exists(path):
+            os.remove(path)
+        journal = ExecutionJournal(path)
+        journal.crash_after(n)
+        ex = Executor(backend, journal=journal)
+        try:
+            ex.execute_proposals(plan)
+            break  # n >= total records: the plan completed crash-free
+        except ProcessCrash:
+            boundaries += 1
+        # the "restarted process": fresh executor, same checkpoint path
+        recovered = ExecutionJournal(path)
+        checkpoint = recovered.load()
+        ex2 = Executor(backend, journal=recovered)
+        if checkpoint is None:
+            # crash before the start record: nothing durable, cluster
+            # untouched — re-detection re-plans the same proposals
+            assert not backend.ongoing_reassignments()
+            result = ex2.execute_proposals(plan)
+        else:
+            result = ex2.resume(checkpoint)
+        assert result.dead == 0 and result.aborted == 0, (n, result)
+        assert _placement(backend) == reference, f"diverged at boundary {n}"
+        assert recovered.load() is None, f"checkpoint not cleared at {n}"
+    else:
+        raise AssertionError("plan never completed without crashing")
+    assert boundaries >= 6  # the fixture really has that many boundaries
+
+
+def test_resume_never_removes_completed_partitions(tmp_path):
+    """Recovery marks moves that finished (before or during the outage)
+    COMPLETED and the resumed drive never re-issues them — asserted from
+    the backend's observed alter calls."""
+    from cruise_control_tpu.executor.journal import (
+        ExecutionJournal,
+        ProcessCrash,
+    )
+
+    backend, plan = _crash_fixture()
+    path = str(tmp_path / "ckpt.jsonl")
+    journal = ExecutionJournal(path)
+    # crash right after the first batch's completions are recorded
+    # (start, phase, batch, then task records)
+    journal.crash_after(5)
+    ex = Executor(backend, journal=journal)
+    with pytest.raises(ProcessCrash):
+        ex.execute_proposals(plan)
+    completed_before = {
+        p for p, st in backend.partitions.items()
+        if [2, 3] == list(st.replicas)
+    }
+    assert completed_before  # the fixture crashes after real progress
+    while backend.ongoing_reassignments():
+        backend.tick()  # the cluster finishes in-flight work while down
+
+    realtered = []
+    original = backend.alter_partition_reassignments
+
+    def spy(reassignments):
+        realtered.extend(reassignments)
+        original(reassignments)
+
+    backend.alter_partition_reassignments = spy
+    recovered = ExecutionJournal(path)
+    ex2 = Executor(backend, journal=recovered)
+    result = ex2.resume(recovered.load())
+    assert result.dead == 0
+    assert not (set(realtered) & completed_before), (
+        realtered, completed_before)
+    summary = ex2.state_summary()["recovery"]["lastRecovery"]
+    assert summary["executionId"] == 1
+    assert summary["alreadyCompleted"] + summary["completedWhileDown"] >= 1
+
+
+def test_resume_replans_vanished_destination(tmp_path):
+    """A destination broker that died during the outage is re-planned
+    onto a live broker; the resumed execution completes."""
+    from cruise_control_tpu.executor.journal import (
+        ExecutionJournal,
+        ProcessCrash,
+    )
+
+    backend, plan = _crash_fixture()
+    backend.move_latency_ticks = 50  # nothing completes before the crash
+    path = str(tmp_path / "ckpt.jsonl")
+    journal = ExecutionJournal(path)
+    # start, phase, batch persist; the 4th write (the first timeout's task
+    # record, task_timeout=3) crashes — moves are dispatched and in flight
+    journal.crash_after(3)
+    ex = Executor(backend, journal=journal,
+                  config=ExecutorConfig(task_timeout_ticks=3))
+    with pytest.raises(ProcessCrash):
+        ex.execute_proposals(plan)
+    assert backend.ongoing_reassignments()  # really crashed mid-flight
+    backend.failed_brokers.add(3)  # destination 3 dies while we are down
+
+    recovered = ExecutionJournal(path)
+    ex2 = Executor(backend, journal=recovered)
+    backend.move_latency_ticks = 1
+    result = ex2.resume(recovered.load())
+    assert result.dead == 0 and result.completed > 0
+    for p in (0, 1, 4):
+        assert 3 not in backend.partitions[p].replicas
+        assert 2 in backend.partitions[p].replicas
+    summary = ex2.state_summary()["recovery"]["lastRecovery"]
+    assert summary["replanned"] == 3
+
+
+def test_retry_with_backoff_recovers_transient_failure():
+    """A move that times out while its destination is down is retried
+    with exponential backoff and completes once the broker returns."""
+    backend, assignment, _ = make_backend(move_latency_ticks=1)
+    backend.failed_brokers.add(3)
+    revive_at = {"tick": 12}
+    orig_tick = backend.tick
+
+    def tick():
+        orig_tick()
+        if backend.ticks >= revive_at["tick"]:
+            backend.failed_brokers.discard(3)
+    backend.tick = tick
+    cfg = ExecutorConfig(
+        task_timeout_ticks=3,
+        task_retry_max_attempts=4,
+        task_retry_backoff_base_ticks=2,
+        task_retry_backoff_max_ticks=16,
+        task_retry_jitter_ticks=0,
+    )
+    ex = Executor(backend, cfg)
+    p = prop(0, assignment[0], [assignment[0][0], 3])
+    result = ex.execute_proposals([p], max_ticks=200)
+    assert result.succeeded, result
+    task = ex.planner.replica_tasks[0]
+    assert task.attempts >= 1  # it really went through the retry path
+    assert 3 in backend.partitions[0].replicas
+
+
+def test_retry_budget_exhaustion_goes_dead():
+    """The retry budget is a bound: a permanently failing destination
+    exhausts it and the task lands DEAD, not in an endless loop."""
+    backend, assignment, _ = make_backend(failed_brokers={3})
+    cfg = ExecutorConfig(
+        task_timeout_ticks=2,
+        task_retry_max_attempts=2,
+        task_retry_backoff_base_ticks=1,
+        task_retry_backoff_max_ticks=2,
+        task_retry_jitter_ticks=0,
+    )
+    ex = Executor(backend, cfg)
+    p = prop(0, assignment[0], [assignment[0][0], 3])
+    result = ex.execute_proposals([p], max_ticks=200)
+    assert result.dead == 1
+    assert ex.planner.replica_tasks[0].attempts == 2
+
+
+def test_dest_exclusion_feeds_replanning():
+    """Repeated failures charge the destination; once excluded, later
+    dispatches re-plan onto a different broker and succeed."""
+    backend, assignment, _ = make_backend(
+        num_partitions=8, failed_brokers={3}
+    )
+    cfg = ExecutorConfig(
+        task_timeout_ticks=2,
+        task_retry_max_attempts=3,
+        task_retry_backoff_base_ticks=1,
+        task_retry_backoff_max_ticks=2,
+        task_retry_jitter_ticks=0,
+        dest_exclusion_threshold=2,
+    )
+    ex = Executor(backend, cfg)
+    p = prop(0, assignment[0], [assignment[0][0], 3])
+    result = ex.execute_proposals([p], max_ticks=200)
+    # after 2 failures broker 3 is excluded; the next retry re-plans and
+    # the move completes elsewhere
+    assert result.succeeded, result
+    assert 3 in ex.excluded_destinations
+    assert 3 not in backend.partitions[0].replicas
+    assert ex.state_summary()["retries"]["excludedDestinations"] == [3]
+
+
+def test_watchdog_escalates_stop_abort_unrecoverable():
+    """With every destination dead and no retry budget... the watchdog
+    first halts dispatch, then aborts in-flight moves instead of burning
+    the full tick budget."""
+    backend, assignment, _ = make_backend(failed_brokers={3})
+    cfg = ExecutorConfig(
+        task_timeout_ticks=10_000,  # timeouts never fire: watchdog must
+        watchdog_stuck_ticks=5,
+    )
+    ex = Executor(backend, cfg)
+    p = prop(0, assignment[0], [assignment[0][0], 3])
+    result = ex.execute_proposals([p], max_ticks=10_000)
+    assert not result.succeeded
+    assert result.dead == 1
+    assert result.ticks <= 12  # 2 * watchdog + slack, NOT the tick budget
+    # the aborted reassignment was cancelled on the backend
+    assert not backend.ongoing_reassignments()
+
+
+def test_checkpoint_compaction_preserves_recovery(tmp_path):
+    """Rotation (max_bytes exceeded) compacts to a snapshot atomically;
+    a crash after compaction still recovers the full picture."""
+    from cruise_control_tpu.executor.journal import (
+        ExecutionJournal,
+        ProcessCrash,
+    )
+
+    def fixture():
+        assignment = {
+            p: [(p + i) % 4 for i in range(2)] for p in range(48)
+        }
+        leaders = {p: assignment[p][0] for p in range(48)}
+        b = SimulatedClusterBackend(
+            {p: list(r) for p, r in assignment.items()}, dict(leaders),
+            move_latency_ticks=2,
+        )
+        # p % 4 in (0, 1): old replicas differ from [2, 3] → real moves
+        return b, [_crash_prop(p, assignment[p], [2, 3])
+                   for p in range(48) if p % 4 < 2]
+
+    backend, plan = fixture()
+    path = str(tmp_path / "ckpt.jsonl")
+    journal = ExecutionJournal(path, max_bytes=1024)  # rotate constantly
+    compactions = {"n": 0}
+    orig_compact = journal._compact
+
+    def counting_compact():
+        compactions["n"] += 1
+        orig_compact()
+
+    journal._compact = counting_compact
+    journal.crash_after(12)
+    ex = Executor(backend, journal=journal)
+    with pytest.raises(ProcessCrash):
+        ex.execute_proposals(plan)
+    assert compactions["n"] >= 1, "fixture never rotated the checkpoint"
+    recovered = ExecutionJournal(path)
+    checkpoint = recovered.load()
+    assert checkpoint is not None
+    assert len(checkpoint.proposals) == len(plan)
+    result = Executor(backend, journal=recovered).resume(checkpoint)
+    assert result.dead == 0
+
+    reference_backend, reference_plan = fixture()
+    Executor(reference_backend).execute_proposals(reference_plan)
+    assert _placement(backend) == _placement(reference_backend)
 
 
 def test_min_isr_strategy_prioritizes_urp_fixes_end_to_end():

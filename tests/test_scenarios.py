@@ -9,7 +9,10 @@ true story, which is what an operator reconstructing an incident has.
 
 Tier-1 runs the SMOKE subset plus the determinism and artifact contracts;
 the full ≥10-scenario matrix is ``slow`` (the committed
-``SCENARIOS_r07.json`` artifact keeps its outcomes honest in every run).
+``SCENARIOS_r08.json`` artifact keeps its outcomes honest in every run).
+The crash/resume scenarios (ISSUE 7) prove — from the journal alone —
+that a process crash mid-execution resumes without re-moving completed
+partitions.
 """
 
 import json
@@ -36,7 +39,7 @@ from cruise_control_tpu.sim.timeline import (
 from test_artifact_schemas import SCHEMAS, validate
 
 MIN = MIN_MS
-ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r07.json"
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r08.json"
 
 #: the outcome each scripted timeline must reach — also pinned against the
 #: committed artifact below, so a regression shows up in tier-1 without
@@ -54,6 +57,10 @@ EXPECTED_OUTCOMES = {
     "recovery_then_relapse": "HEALED",
     "metric_anomaly_alert_only": "ALERT_ONLY",
     "stalled_execution_retries": "HEALED",
+    "crash_resume_mid_execution": "HEALED",
+    "crash_completes_while_down": "HEALED",
+    "crash_recovery_replans_dead_destination": "HEALED",
+    "flapping_destination_retries": "HEALED",
 }
 
 _cache = {}
@@ -184,6 +191,78 @@ def _check_stalled_execution_retries(r):
                 if p["timeMs"] > r.duration_virtual_ms - 4 * MIN]
 
 
+# ---- crash-safe execution (ISSUE 7): journal-only crash/resume proofs ----------
+def _post_resume_replica_moves(r):
+    """Partitions dispatched in replica-move batches AFTER the resume —
+    the set that must not intersect what the checkpoint already finished."""
+    seen_resume = False
+    moved = set()
+    for e in r.journal:
+        if e["kind"] == "executor.resume":
+            seen_resume = True
+        elif seen_resume and e["kind"] == "executor.batch":
+            p = e.get("payload", {})
+            if p.get("phase") == "replica_moves":
+                moved |= set(p.get("partitions", ()))
+    return moved
+
+
+def _check_crash_resume_mid_execution(r):
+    assert len(r.events_of("sim.crash")) == 1
+    (resume,) = r.resume_summaries()
+    done_before = set(resume["alreadyCompleted"]) \
+        | set(resume["completedWhileDown"])
+    # the crash landed mid-execution: some moves durably done, some not
+    assert done_before and (resume["reissued"] or resume["adopted"])
+    # THE acceptance criterion: zero already-completed partitions re-moved
+    assert not (_post_resume_replica_moves(r) & done_before)
+    (recovery,) = r.recoveries()
+    assert recovery["outcome"] == "resumed" and recovery["succeeded"]
+    # the recovered execution claims the self-healing cooldown (no
+    # double-fire during/after recovery)
+    assert r.events_of("detector.recovery_cooldown")
+    # healed for good: the tail of the run is violation-quiet
+    assert not [p for p in r.anomalies("GOAL_VIOLATION")
+                if p["timeMs"] > r.duration_virtual_ms - 4 * MIN]
+
+
+def _check_crash_completes_while_down(r):
+    (resume,) = r.resume_summaries()
+    # every replica move finished while the controller was down...
+    assert resume["completedWhileDown"]
+    assert not resume["reissued"] and not resume["replanned"]
+    # ...so the resumed execution issues zero new replica batches
+    assert not _post_resume_replica_moves(r)
+    (recovery,) = r.recoveries()
+    assert recovery["succeeded"] and recovery["ticks"] == 0
+
+
+def _check_crash_recovery_replans_dead_destination(r):
+    (resume,) = r.resume_summaries()
+    assert resume["replanned"]  # vanished destination re-planned
+    replans = [e["payload"] for e in r.events_of("executor.task_replanned")]
+    assert replans and all(p["newReplicas"] for p in replans)
+    (recovery,) = r.recoveries()
+    assert recovery["outcome"] == "resumed" and recovery["succeeded"]
+    # the corpse is detected and evacuated by the broker-failure heal
+    assert r.fixes_started("BROKER_FAILURE")
+    assert r.dead_tasks() == 0
+
+
+def _check_flapping_destination_retries(r):
+    retries = [e["payload"] for e in r.events_of("executor.task_retry")]
+    assert retries
+    assert all(p["reason"] == "timeout" and p["attempt"] >= 1
+               for p in retries)
+    assert all(p["backoffTicks"] >= 1 for p in retries)
+    # the retries did their job: every drive ends with zero dead tasks
+    assert r.executor_ends() and all(
+        p.get("dead") == 0 for p in r.executor_ends()
+    )
+    assert not [e for e in r.events_of("executor.task_dead")
+                if e["payload"].get("reason") == "timeout"]
+
+
 CHECKS = {
     "broker_death_mid_execution": _check_broker_death_mid_execution,
     "rack_loss": _check_rack_loss,
@@ -198,6 +277,11 @@ CHECKS = {
     "recovery_then_relapse": _check_recovery_then_relapse,
     "metric_anomaly_alert_only": _check_metric_anomaly_alert_only,
     "stalled_execution_retries": _check_stalled_execution_retries,
+    "crash_resume_mid_execution": _check_crash_resume_mid_execution,
+    "crash_completes_while_down": _check_crash_completes_while_down,
+    "crash_recovery_replans_dead_destination":
+        _check_crash_recovery_replans_dead_destination,
+    "flapping_destination_retries": _check_flapping_destination_retries,
 }
 
 
@@ -282,9 +366,9 @@ def test_live_artifact_matches_schema():
 
 
 def test_committed_artifact_is_current():
-    """SCENARIOS_r07.json (the CLI's output) must cover the whole registry
+    """SCENARIOS_r08.json (the CLI's output) must cover the whole registry
     with the expected heal outcomes — regenerate it via
-    ``python -m cruise_control_tpu.sim --artifact SCENARIOS_r07.json``
+    ``python -m cruise_control_tpu.sim --artifact SCENARIOS_r08.json``
     whenever scenarios change."""
     art = json.loads(ARTIFACT_PATH.read_text())
     validate(art, SCHEMAS["cc-tpu-scenarios/1"])
@@ -307,7 +391,7 @@ def test_smoke_scenarios_match_committed_artifact():
         r = result_for(name)
         assert r.fingerprint() == by_name[name]["journalFingerprint"], (
             f"{name}: journal drifted from the committed artifact — "
-            "behavior changed; regenerate SCENARIOS_r07.json and review"
+            "behavior changed; regenerate SCENARIOS_r08.json and review"
         )
 
 

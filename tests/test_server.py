@@ -197,6 +197,86 @@ class TestSyncEndpoints:
             srv.stop()
 
 
+class TestBackpressureHeaders:
+    """429/503 responses carry Retry-After so clients back off instead of
+    hammering (ISSUE 7 satellite)."""
+
+    @staticmethod
+    def _raw_post(srv, endpoint, **params):
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        url = f"{srv.url}/{endpoint}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, method="POST", data=b"")
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers)
+
+    def test_429_carries_retry_after(self):
+        from cruise_control_tpu.server import UserTaskManager
+
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(
+            cc, port=0, user_task_manager=UserTaskManager(max_active_tasks=0),
+        )
+        srv.start()
+        try:
+            code, headers = self._raw_post(srv, "rebalance", dryrun="true")
+            assert code == 429
+            assert headers.get("Retry-After") == "2"
+        finally:
+            srv.stop()
+
+    def test_monitor_not_ready_503_carries_retry_after(self):
+        cc, _, _ = full_stack(windows=0)  # no valid metric windows yet
+        srv = CruiseControlHttpServer(cc, port=0)
+        srv.start()
+        try:
+            code, headers = self._raw_post(
+                srv, "rebalance", dryrun="true", get_response_timeout_s="10",
+            )
+            assert code == 503
+            assert headers.get("Retry-After") == "30"
+        finally:
+            srv.stop()
+
+
+class TestUserTaskManagerShutdown:
+    def test_shutdown_cancels_queued_and_joins_bounded(self):
+        import threading
+        import time as time_mod
+
+        from cruise_control_tpu.server import UserTaskManager
+
+        mgr = UserTaskManager(max_workers=1)
+        release = threading.Event()
+        running = threading.Event()
+
+        def block(progress):
+            running.set()
+            release.wait(timeout=30)
+            return "done"
+
+        first = mgr.submit("rebalance", block)
+        assert running.wait(timeout=5)
+        queued = mgr.submit("rebalance", lambda progress: "never runs")
+        t0 = time_mod.perf_counter()
+        mgr.shutdown(timeout_s=0.5)
+        elapsed = time_mod.perf_counter() - t0
+        # bounded: the blocked worker must not wedge shutdown
+        assert elapsed < 5.0
+        # the queued task is terminally cancelled, not eternally ACTIVE
+        assert queued.state == "CompletedWithError"
+        assert queued.completed_s is not None
+        release.set()
+        first.future.result(timeout=5)
+
+
 class TestSecurity:
     def test_basic_auth_rejects_and_accepts(self):
         cc, _, _ = full_stack()
