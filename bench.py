@@ -33,6 +33,12 @@ the simulated backend with the file-backed checkpoint on vs off
 north-star metric — the checkpoint must cost <=1% of a served rebalance.
 Plans are untouched by construction (the journal hangs off the executor,
 not the analyzer) — the parity gates stay the bit-identity proof.
+``precompute_overhead_pct`` gates the proposal-precompute daemon
+(analyzer/precompute.py): the refresh loop ticking at a 50ms stress
+interval against a warm generation-fresh cache vs stopped, on the same
+engine metric — must stay within ±1% (steady state is one generation
+probe per tick; plans bit-identical by construction, the daemon only
+ever calls the same get_proposals the REST path does).
 """
 
 from __future__ import annotations
@@ -52,10 +58,9 @@ def _best_of(n: int, fn) -> float:
     return best
 
 
-def _full_path_phases() -> dict:
-    """One traced dryrun=False rebalance through the whole stack (monitor →
-    analyzer → executor) on a simulated 50b/1k cluster; returns the phase
-    breakdown keyed by the taxonomy's leaf names."""
+def _full_stack_cc(engine: str = "tpu"):
+    """The simulated 50b/1k full stack (monitor → facade → executor) the
+    full-path phase breakdown AND the precompute-overhead gate run on."""
     from cruise_control_tpu.bootstrap import _capacity_for
     from cruise_control_tpu.executor.backend import SimulatedClusterBackend
     from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
@@ -70,7 +75,6 @@ def _full_path_phases() -> dict:
         SimulatedMetricsReporter,
         WorkloadModel,
     )
-    from cruise_control_tpu.telemetry import profile, tracing
 
     rng = np.random.default_rng(42)
     P, B, rf = 1000, 50, 3
@@ -99,9 +103,18 @@ def _full_path_phases() -> dict:
     for wdx in range(3):
         reporter.report(time_ms=wdx * 1000 + 500)
         monitor.run_sampling_iteration((wdx + 1) * 1000)
-    cc = CruiseControl(
-        monitor, Executor(backend, ExecutorConfig()), engine="tpu"
+    return CruiseControl(
+        monitor, Executor(backend, ExecutorConfig()), engine=engine
     )
+
+
+def _full_path_phases() -> dict:
+    """One traced dryrun=False rebalance through the whole stack (monitor →
+    analyzer → executor) on a simulated 50b/1k cluster; returns the phase
+    breakdown keyed by the taxonomy's leaf names."""
+    from cruise_control_tpu.telemetry import profile, tracing
+
+    cc = _full_stack_cc(engine="tpu")
     tracing.reset()
     t0 = time.perf_counter()
     cc.rebalance(dryrun=False)
@@ -270,6 +283,30 @@ def main() -> None:
         ck_on_s = min(ck_on_s, time.perf_counter() - t0)
     checkpoint_overhead_pct = (ck_on_s - ck_off_s) / tpu_s * 100.0
 
+    # proposal-precompute daemon overhead (ISSUE 8): the warm-plan
+    # refresh loop ticking at a 50ms STRESS interval (600x the production
+    # default) against a fresh cache must not tax the north-star engine
+    # metric — steady state is one generation probe per tick, a full
+    # recompute only after an invalidation.  Interleaved off/on, best-of.
+    from cruise_control_tpu.analyzer.precompute import (
+        ProposalPrecomputingExecutor,
+    )
+
+    pre_cc = _full_stack_cc(engine="greedy")
+    pre_cc.get_proposals()  # warm + generation-fresh for the whole gate
+    precompute = ProposalPrecomputingExecutor(pre_cc, interval_s=0.05)
+    pc_off_s = pc_on_s = np.inf
+    for _ in range(5):
+        t0 = time.perf_counter()
+        tpu_opt.optimize(state)
+        pc_off_s = min(pc_off_s, time.perf_counter() - t0)
+        precompute.start(tick_s=0.05)
+        t0 = time.perf_counter()
+        tpu_opt.optimize(state)
+        pc_on_s = min(pc_on_s, time.perf_counter() - t0)
+        precompute.stop()
+    precompute_overhead_pct = (pc_on_s / pc_off_s - 1.0) * 100.0
+
     phases = _full_path_phases()
     tracing.configure(enabled=False)
 
@@ -295,6 +332,9 @@ def main() -> None:
                 "checkpoint_drive_s": {
                     "off": round(ck_off_s, 4), "on": round(ck_on_s, 4),
                 },
+                "precompute_overhead_pct": round(
+                    precompute_overhead_pct, 2),
+                "precompute_daemon_state": precompute.state_summary(),
                 "phases": phases,
             }
         )

@@ -1,0 +1,360 @@
+"""Serve-load harness (ISSUE 8): thousands of concurrent REST clients
+against the overload-safe front door, while a full rebalance computes
+concurrently.
+
+    PYTHONPATH=. python benchmarks/serve_load.py --clients 1000 \
+        --duration-s 6 --artifact SERVE_LOAD_r08.json
+
+Builds the full in-process stack (simulated cluster → monitor → facade →
+REAL CruiseControlHttpServer with admission control), warms the proposal
+cache through the precompute path, gates on ``/health``, then:
+
+* ``--clients`` threads hammer ``GET /proposals`` (served from the warm
+  plan) for ``--duration-s``, recording per-request latency, status, and
+  Retry-After/cached/stale markers;
+* one thread POSTs a full ``rebalance`` (dryrun) against a SECOND, much
+  larger cluster facade sharing the process — the analyzer burns CPU for
+  seconds while the cached reads must stay in the tens of milliseconds.
+
+The ``cc-tpu-serve-load/1`` artifact records the acceptance gates:
+under a load ≥4× the admission capacity, admitted p99 stays bounded,
+every shed carries Retry-After, zero unhandled 5xx, and cached
+``GET /proposals`` p99 ≤ 50 ms while the rebalance runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+SCHEMA = "cc-tpu-serve-load/1"
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return round(sorted_vals[idx], 3)
+
+
+def _latency_summary(vals_ms: List[float]) -> dict:
+    s = sorted(vals_ms)
+    return {
+        "count": len(s),
+        "p50": _percentile(s, 0.50),
+        "p90": _percentile(s, 0.90),
+        "p99": _percentile(s, 0.99),
+        "max": round(s[-1], 3) if s else None,
+    }
+
+
+def _client_loop(url: str, deadline: float, records: List[dict]) -> None:
+    """One looping GET /proposals client (runs inside a client process)."""
+    while time.perf_counter() < deadline:
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(url, timeout=30) as r:
+                body = json.loads(r.read())
+                rec = (r.status, r.headers.get("Retry-After"),
+                       body.get("cached"), body.get("stale"))
+        except urllib.error.HTTPError as e:
+            e.read()
+            rec = (e.code, e.headers.get("Retry-After"), None, None)
+        except Exception:
+            rec = (0, None, None, None)
+        records.append({
+            "ms": (time.perf_counter() - t0) * 1000.0,
+            "status": rec[0],
+            "retry_after": rec[1],
+            "cached": rec[2],
+            "stale": rec[3],
+        })
+
+
+def client_process(url: str, n_threads: int, duration_s: float,
+                   out_path: str) -> None:
+    """Entry point for one CLIENT process: the clients must not share the
+    server process's GIL, or the measurement times the harness instead of
+    the server (the analyzer burn would starve in-process clients).  The
+    clients also run niced: real load generators live on other machines
+    and do not steal the server's CPU — on a small box, un-niced client
+    processes would starve the accept loop and hide the whole overload
+    in the kernel backlog where no admission layer can see it."""
+    import os
+
+    try:
+        os.nice(10)
+    except OSError:  # pragma: no cover - permission-restricted container
+        pass
+    records: List[dict] = []
+    deadline = time.perf_counter() + duration_s
+    threads = [
+        threading.Thread(target=_client_loop, args=(url, deadline, records),
+                         daemon=True)
+        for _ in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 60)
+    with open(out_path, "w") as f:
+        json.dump(records, f)
+
+
+def build_stack(brokers: int, partitions: int):
+    sys.path.insert(0, "tests")
+    from harness import full_stack
+
+    return full_stack(num_partitions=partitions, num_brokers=brokers)
+
+
+def build_big_stack(brokers: int, partitions: int):
+    """The north-star-shaped fixture (bench.py's full-path cluster):
+    feasible by construction at any size, so the concurrent rebalance is
+    a real multi-second analyzer burn, not an instant infeasibility."""
+    import numpy as np
+
+    from cruise_control_tpu.bootstrap import _capacity_for
+    from cruise_control_tpu.executor.backend import SimulatedClusterBackend
+    from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor.load_monitor import (
+        BackendMetadataClient,
+        LoadMonitor,
+    )
+    from cruise_control_tpu.monitor.sampling import (
+        MetricsReporterSampler,
+        MetricsTopic,
+        SimulatedMetricsReporter,
+        WorkloadModel,
+    )
+
+    rng = np.random.default_rng(42)
+    P, B, rf = partitions, brokers, 3
+    assignment = {p: [(p + i) % B for i in range(rf)] for p in range(P)}
+    leaders = {p: assignment[p][0] for p in range(P)}
+    w = WorkloadModel(
+        bytes_in=rng.uniform(50, 1500, P),
+        bytes_out=rng.uniform(50, 3000, P),
+        size_mb=rng.uniform(100, 2000, P),
+        assignment=assignment,
+        leaders=leaders,
+    )
+    backend = SimulatedClusterBackend(
+        {p: list(r) for p, r in assignment.items()}, dict(leaders),
+        brokers=set(range(B)),
+    )
+    topic = MetricsTopic()
+    reporter = SimulatedMetricsReporter(w, topic)
+    monitor = LoadMonitor(
+        BackendMetadataClient(backend, {b: b % 10 for b in range(B)}),
+        MetricsReporterSampler(topic),
+        capacity_resolver=_capacity_for(w, B),
+        window_ms=1000,
+        num_windows=5,
+    )
+    for wdx in range(3):
+        reporter.report(time_ms=wdx * 1000 + 500)
+        monitor.run_sampling_iteration((wdx + 1) * 1000)
+    return CruiseControl(
+        monitor, Executor(backend, ExecutorConfig()), engine="greedy",
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--clients", type=int, default=1000)
+    ap.add_argument("--duration-s", type=float, default=6.0)
+    ap.add_argument("--get-concurrent", type=int, default=8)
+    ap.add_argument("--compute-concurrent", type=int, default=2)
+    ap.add_argument("--queue-size", type=int, default=8)
+    ap.add_argument("--max-inflight", type=int, default=0,
+                    help="global in-flight ceiling (0 = auto)")
+    ap.add_argument("--brokers", type=int, default=6)
+    ap.add_argument("--partitions", type=int, default=48)
+    ap.add_argument("--rebalance-brokers", type=int, default=50)
+    ap.add_argument("--rebalance-partitions", type=int, default=1000)
+    ap.add_argument("--artifact", default=None)
+    args = ap.parse_args()
+
+    from cruise_control_tpu.server.http_server import CruiseControlHttpServer
+
+    # serving-process tuning: with the analyzer burning CPU in-process,
+    # the default 5ms GIL switch interval adds multi-quantum stalls to
+    # every cached read — a serving deployment shortens it
+    sys.setswitchinterval(0.0005)
+
+    cc, _, _ = build_stack(args.brokers, args.partitions)
+    srv = CruiseControlHttpServer(
+        cc, port=0,
+        get_max_concurrent=args.get_concurrent,
+        compute_max_concurrent=args.compute_concurrent,
+        admission_queue_size=args.queue_size,
+        admission_queue_timeout_s=0.2,
+        max_inflight=args.max_inflight,
+        access_log=False,
+    )
+    srv.start()
+
+    # the concurrent full rebalance runs on a second, much larger facade in
+    # the same process — same GIL, same CPUs — so the cached reads compete
+    # with a real analyzer burn, not a toy one
+    big_cc = build_big_stack(args.rebalance_brokers,
+                             args.rebalance_partitions)
+
+    # warm the cache (the precompute daemon's job in production)
+    cc.get_proposals()
+    assert cc.proposal_cache_fresh(), "warmup did not leave a fresh plan"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}/health", timeout=10
+    ) as r:
+        health = json.loads(r.read())
+        assert health["ready"] is True, f"not ready: {health}"
+
+    rebalance_result: Dict[str, object] = {}
+
+    def rebalance() -> None:
+        t0 = time.perf_counter()
+        try:
+            res = big_cc.rebalance(dryrun=True)
+            rebalance_result.update(
+                status=200, numProposals=len(res.proposals),
+            )
+        except Exception as e:  # recorded, not fatal to the measurement
+            rebalance_result.update(status=500, error=repr(e))
+        rebalance_result["durationS"] = round(time.perf_counter() - t0, 3)
+
+    # fan the clients out over separate PROCESSES: the load must compete
+    # with the server for sockets and CPUs, not for the server's GIL
+    import multiprocessing as mp
+    import os
+    import tempfile
+
+    n_procs = max(2, min(8, mp.cpu_count() // 2))
+    per_proc = max(1, args.clients // n_procs)
+    tmpdir = tempfile.mkdtemp(prefix="cc-serve-load-")
+    outs = [os.path.join(tmpdir, f"clients-{i}.json")
+            for i in range(n_procs)]
+    procs = [
+        mp.Process(target=client_process,
+                   args=(f"{srv.url}/proposals", per_proc,
+                         args.duration_s, out))
+        for out in outs
+    ]
+    rb_thread = threading.Thread(target=rebalance, daemon=True)
+    t_start = time.perf_counter()
+    rb_thread.start()
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=args.duration_s + 120)
+    rb_thread.join(timeout=300)
+    wall_s = time.perf_counter() - t_start
+    # SERVER-side latency (the PR-2 histogram substrate): time spent in
+    # the handler for admitted requests.  The client-observed numbers
+    # additionally include kernel accept-queue wait and — on a small box —
+    # load-generator starvation, so the serving-latency gate reads the
+    # server's own timer.
+    server_timer = cc.registry.timer("http.GET.proposals").snapshot()
+    admission_state = srv.admission.state_summary()
+    srv.stop()
+
+    records: List[dict] = []
+    for out in outs:
+        with open(out) as f:
+            records.extend(json.load(f))
+    actual_clients = per_proc * n_procs
+    admitted = [r for r in records if 200 <= r["status"] < 300]
+    shed = [r for r in records if r["status"] in (429, 503)]
+    unreachable = [r for r in records if r["status"] == 0]
+    unhandled = [r for r in records
+                 if r["status"] >= 500 and not r["retry_after"]]
+    cached_hits = [r for r in admitted if r["cached"]]
+    capacity = args.get_concurrent + args.queue_size
+    load_factor = actual_clients / max(1, capacity)
+
+    client_admitted = _latency_summary([r["ms"] for r in admitted])
+    gates = {
+        "load_factor_ge_4x": load_factor >= 4.0,
+        "sheds_all_carry_retry_after": all(
+            r["retry_after"] for r in shed
+        ) and bool(shed),
+        "zero_unhandled_5xx": not unhandled and not unreachable,
+        # serving latency is the server's own admitted-request timer; the
+        # client-observed p99 bounds the end-to-end tail (no collapse)
+        "cached_get_p99_le_50ms": (
+            server_timer["count"] > 0
+            and server_timer["p99Sec"] * 1000.0 <= 50.0
+        ),
+        "admitted_p99_bounded": (
+            client_admitted["p99"] is not None
+            and client_admitted["p99"] <= 5000.0
+        ),
+        "rebalance_completed_concurrently": (
+            rebalance_result.get("status") == 200
+            and rebalance_result.get("durationS", 0) > 0
+        ),
+    }
+    gates["pass"] = all(gates.values())
+    artifact = {
+        "schema": SCHEMA,
+        "generated_unix": round(time.time(), 3),
+        "config": {
+            "clients": actual_clients,
+            "clientProcesses": n_procs,
+            "durationS": args.duration_s,
+            "getConcurrent": args.get_concurrent,
+            "computeConcurrent": args.compute_concurrent,
+            "queueSize": args.queue_size,
+            "admissionCapacity": capacity,
+            "loadFactor": round(load_factor, 2),
+            "brokers": args.brokers,
+            "partitions": args.partitions,
+            "rebalanceBrokers": args.rebalance_brokers,
+            "rebalancePartitions": args.rebalance_partitions,
+        },
+        "totals": {
+            "requests": len(records),
+            "admitted2xx": len(admitted),
+            "shed": len(shed),
+            "shedWithRetryAfter": sum(
+                1 for r in shed if r["retry_after"]),
+            "unhandled5xx": len(unhandled),
+            "unreachable": len(unreachable),
+            "requestsPerSecond": round(len(records) / max(wall_s, 1e-9), 1),
+            "shedRate": round(len(shed) / max(1, len(records)), 4),
+            "cacheHitRate": round(
+                len(cached_hits) / max(1, len(admitted)), 4),
+        },
+        "latencyMs": {
+            "clientObservedAdmitted": client_admitted,
+            "clientObservedShed": _latency_summary(
+                [r["ms"] for r in shed]),
+            "serverHandlerAdmitted": {
+                "count": server_timer["count"],
+                "p50": round(server_timer["p50Sec"] * 1000.0, 3),
+                "p99": round(server_timer["p99Sec"] * 1000.0, 3),
+                "max": round(server_timer["maxSec"] * 1000.0, 3),
+            },
+        },
+        "admission": admission_state,
+        "rebalance": rebalance_result,
+        "gates": gates,
+    }
+    print(json.dumps(artifact, indent=1, sort_keys=True))
+    if args.artifact:
+        with open(args.artifact, "w") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"artifact written: {args.artifact}", file=sys.stderr)
+    return 0 if gates["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2892,9 +2892,10 @@ class TpuGoalOptimizer:
 
     def _make_round_fn(self, K: int, D: int):
         # normalized like the scan fn: the score-only round program does
-        # not depend on the host drive-loop knob
+        # not depend on the host drive-loop knobs
         return _cached_round_fn(
-            dataclasses.replace(self.config, pipeline_depth=0), K, D,
+            dataclasses.replace(self.config, pipeline_depth=0,
+                                time_budget_s=0.0), K, D,
             self.mesh,
         )
 
@@ -2985,11 +2986,13 @@ class TpuGoalOptimizer:
                 cfg = dataclasses.replace(
                     cfg, device_batch_per_step=int(np.clip(B // 2, 32, 2048))
                 )
-            # pipeline_depth is a host-loop knob — the compiled program is
-            # identical at every depth, so it must not key the compile
-            # cache (flipping the knob would recompile a ~minute program)
+            # pipeline_depth and time_budget_s are host-loop knobs — the
+            # compiled program is identical at every value (the step cap
+            # rides a runtime arg), so they must not key the compile cache
+            # (a per-request deadline would recompile a ~minute program)
             scan_fn = _cached_scan_fn(
-                dataclasses.replace(cfg, pipeline_depth=0), K, D,
+                dataclasses.replace(cfg, pipeline_depth=0,
+                                    time_budget_s=0.0), K, D,
                 cfg.steps_per_call, self.mesh,
             )
             # convergence exits via the device done flag / no-progress break;

@@ -559,6 +559,16 @@ def build_app(
         from cruise_control_tpu.utils import jit_cache
 
         jit_cache.enable(cfg.get("tpu.persistent.compilation.cache.dir"))
+    breaker = None
+    if cfg.get_int("proposals.precompute.breaker.failure.threshold") > 0:
+        from cruise_control_tpu.analyzer.precompute import CircuitBreaker
+
+        breaker = CircuitBreaker(
+            failure_threshold=cfg.get_int(
+                "proposals.precompute.breaker.failure.threshold"
+            ),
+            reset_s=cfg.get("proposals.precompute.breaker.reset.ms") / 1000,
+        )
     cc = CruiseControl(
         monitor,
         executor,
@@ -576,6 +586,7 @@ def build_app(
         allowed_goals=cfg.get_list("goals"),
         default_goal_names=cfg.get_list("default.goals"),
         hard_goal_names=cfg.get_list("hard.goals"),
+        breaker=breaker,
     )
     if kafka_mode and cfg.get_int("num.metric.fetchers") > 1:
         # each per-fetcher consumer reads the WHOLE reporter topic (the
@@ -751,7 +762,31 @@ def build_app(
         ),
         ui_path=cfg.get("webserver.ui.path"),
         flight_recorder=flight_recorder,
+        get_max_concurrent=cfg.get_int(
+            "webserver.request.get.max.concurrent"
+        ),
+        compute_max_concurrent=cfg.get_int(
+            "webserver.request.compute.max.concurrent"
+        ),
+        admission_queue_size=cfg.get_int("webserver.request.queue.size"),
+        admission_queue_timeout_s=(
+            cfg.get("webserver.request.queue.timeout.ms") / 1000
+        ),
+        default_deadline_ms=cfg.get_int(
+            "webserver.request.default.deadline.ms"
+        ),
+        max_body_bytes=cfg.get_int("webserver.request.max.body.bytes"),
+        read_timeout_s=cfg.get("webserver.request.read.timeout.ms") / 1000,
+        drain_timeout_s=cfg.get("webserver.request.drain.timeout.ms") / 1000,
+        max_inflight=cfg.get_int("webserver.request.max.inflight"),
     )
+    if cfg.get_boolean("proposals.precompute.enabled"):
+        # the §3.5 warm-plan daemon: GET /proposals answers from cache,
+        # and each pass doubles as the breaker's half-open probe
+        cc.start_proposal_precomputation(
+            interval_s=cfg.get("proposal.precompute.interval.ms") / 1000,
+            engine=cfg.get("proposal.precompute.engine"),
+        )
     return App(cfg, backend, reporter, cc, fetchers, server, detector,
                flight_recorder)
 

@@ -406,6 +406,22 @@ def default_config_def() -> ConfigDef:
     d.define("proposal.precompute.engine", ConfigType.STRING, None,
              Importance.LOW, "Engine for precomputed proposals (tpu/greedy); "
              "None = the instance default.", None, G)
+    d.define("proposals.precompute.enabled", ConfigType.BOOLEAN, False,
+             Importance.MEDIUM, "Keep a warm proposal plan against the "
+             "live model on a background thread (upstream §3.5): "
+             "GET /proposals and POST /rebalance?allow_cached=true answer "
+             "from the cache in milliseconds, and analyzer/monitor "
+             "outages degrade to the last-good plan with stale=true "
+             "instead of 503ing.", None, G)
+    d.define("proposals.precompute.breaker.failure.threshold",
+             ConfigType.INT, 3, Importance.MEDIUM,
+             "Consecutive analyzer failures that trip the circuit "
+             "breaker into cached/shed-only serving (0 disables the "
+             "breaker).", at_least(0), G)
+    d.define("proposals.precompute.breaker.reset.ms", ConfigType.LONG,
+             30_000, Importance.LOW, "Open-state hold before the breaker "
+             "lets one probe through (half-open); the probe's success "
+             "closes it.", at_least(1), G)
     d.define("cpu.balance.threshold", ConfigType.DOUBLE, 1.1,
              Importance.MEDIUM, "Max/avg CPU ratio considered balanced.",
              at_least(1), G)
@@ -692,6 +708,42 @@ def default_config_def() -> ConfigDef:
     d.define("user.task.executor.threads", ConfigType.INT, 4,
              Importance.LOW, "Worker threads running async user tasks.",
              at_least(1), G)
+    d.define("webserver.request.get.max.concurrent", ConfigType.INT, 16,
+             Importance.MEDIUM, "Concurrent read requests (GET + async "
+             "polls) admitted; beyond this requests wait in the bounded "
+             "admission queue.", at_least(1), G)
+    d.define("webserver.request.compute.max.concurrent", ConfigType.INT, 4,
+             Importance.MEDIUM, "Concurrent analyzer-bound requests "
+             "(async POST submissions) admitted.", at_least(1), G)
+    d.define("webserver.request.queue.size", ConfigType.INT, 16,
+             Importance.MEDIUM, "Bounded admission queue in front of the "
+             "per-class concurrency limits; a full queue load-sheds with "
+             "429 + Retry-After.", at_least(0), G)
+    d.define("webserver.request.queue.timeout.ms", ConfigType.LONG, 2000,
+             Importance.LOW, "Max admission-queue wait before a request "
+             "is shed (clipped by the request's own deadline-ms).",
+             at_least(0), G)
+    d.define("webserver.request.default.deadline.ms", ConfigType.LONG, 0,
+             Importance.LOW, "Default per-request deadline when the "
+             "client sends no deadline-ms header (0 = none).",
+             at_least(0), G)
+    d.define("webserver.request.max.body.bytes", ConfigType.INT, 1_048_576,
+             Importance.LOW, "POST bodies declared larger than this are "
+             "rejected with 413 before anything reads them (0 disables).",
+             at_least(0), G)
+    d.define("webserver.request.read.timeout.ms", ConfigType.LONG, 10_000,
+             Importance.LOW, "Per-connection socket read timeout: a "
+             "slow-loris client trickling bytes is disconnected (thread "
+             "reaped) after this.", at_least(1), G)
+    d.define("webserver.request.drain.timeout.ms", ConfigType.LONG, 5_000,
+             Importance.LOW, "Graceful-shutdown bound: in-flight requests "
+             "are joined at most this long after the server stops "
+             "accepting.", at_least(0), G)
+    d.define("webserver.request.max.inflight", ConfigType.INT, 0,
+             Importance.MEDIUM, "Global in-flight request ceiling — a "
+             "storm beyond it is shed with 429 + Retry-After at the door "
+             "(0 = auto: per-class limits + queue + headroom).",
+             at_least(0), G)
 
     # framework-specific: the TPU search engine (no upstream equivalent —
     # replaces AnalyzerConfig's greedy-recursion knobs)

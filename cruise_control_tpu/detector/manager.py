@@ -77,7 +77,7 @@ class AnomalyDetectorManager:
         #: retried next cycle.  Needed for maintenance events, which are
         #: consumed destructively from their stream and would otherwise be
         #: silently lost; harmless for re-detectable anomaly types.
-        self._pending_fixes: deque = deque()
+        self._pending_fixes: deque = deque()  # cclint: disable=bounded-resource -- drained in full every detection cycle; bounded by the per-cycle anomaly count, and dropping a pending maintenance fix would silently lose an operator request
         #: set by facade.recover_execution: the next detection cycle
         #: treats the recovered execution as the last fix (cooldown),
         #: using THAT cycle's clock — recovery itself has no access to the
@@ -108,7 +108,7 @@ class AnomalyDetectorManager:
             events.emit("detector.recovery_cooldown", timeMs=now_ms,
                         cooldownMs=self.fix_cooldown_ms)
         queue: List[Anomaly]
-        queue, self._pending_fixes = list(self._pending_fixes), deque()
+        queue, self._pending_fixes = list(self._pending_fixes), deque()  # cclint: disable=bounded-resource -- the swap-in replacement for the per-cycle pending set; same justification as its __init__ twin
         for atype, det in self.detectors.items():
             last = self._last_run_ms.get(atype)
             interval = self.per_type_interval_ms.get(
@@ -201,6 +201,13 @@ class AnomalyDetectorManager:
         with self._history_lock:
             self._by_action[final] = self._by_action.get(final, 0) + 1
             self._history.append(record)
+        # proposal-cache invalidation (ISSUE 8): an anomaly means the model
+        # the warm precomputed plan was computed against no longer
+        # describes the cluster — the plan is marked stale (kept as the
+        # degraded-serving fallback, never served as fresh again)
+        notify = getattr(self.cc, "note_anomaly", None)
+        if notify is not None:
+            notify(anomaly)
         if final == "FIX_FAILED" and self.flight_recorder is not None:
             # the crash-readable artifact, written at the exact moment an
             # operator will want it; must never add a second failure

@@ -26,6 +26,9 @@ from cruise_control_tpu.devtools.lint.findings import (
     Suppressions,
     parse_suppressions,
 )
+from cruise_control_tpu.devtools.lint.rules_bounded import (
+    BoundedResourceRule,
+)
 from cruise_control_tpu.devtools.lint.rules_config import ConfigKeyDriftRule
 from cruise_control_tpu.devtools.lint.rules_except import (
     SwallowedExceptionRule,
@@ -48,6 +51,7 @@ RULES = {
         ObsDynamicNameRule(),
         SwallowedExceptionRule(),
         RetryDisciplineRule(),
+        BoundedResourceRule(),
     )
 }
 

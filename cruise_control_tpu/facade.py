@@ -19,12 +19,16 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from cruise_control_tpu.common.resources import BrokerState
 from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.precompute import (
+    AnalyzerSaturatedError,
+    CachedPlan,
+)
 from cruise_control_tpu.analyzer.goal_optimizer import (
     ExecutionProposal,
     GoalOptimizer,
@@ -43,6 +47,7 @@ from cruise_control_tpu.monitor.load_monitor import (
     LoadMonitor,
     ModelCompletenessRequirements,
 )
+from cruise_control_tpu.server import admission
 from cruise_control_tpu.server.progress import OperationProgress
 from cruise_control_tpu.telemetry import events, tracing
 from cruise_control_tpu.utils.logging import get_logger
@@ -84,6 +89,7 @@ class CruiseControl:
         allowed_goals: Optional[Sequence[str]] = None,
         default_goal_names: Optional[Sequence[str]] = None,
         hard_goal_names: Optional[Sequence[str]] = None,
+        breaker=None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -130,21 +136,49 @@ class CruiseControl:
         )
         self.anomaly_detector = None  # attached by AnomalyDetectorManager
         self.proposal_precomputer = None  # started on demand (§3.5)
+        #: analyzer circuit breaker (precompute.CircuitBreaker); None =
+        #: disabled.  Bootstrap wires it from proposals.precompute.breaker.*
+        self.breaker = breaker
         self._start_time = time.time()
         # cached proposals (upstream GoalOptimizer proposal precompute, §3.5)
         self._proposal_ttl_s = proposal_ttl_s
         self._cached_proposals: Optional[OptimizerResult] = None
         self._cached_at: float = 0.0
         self._cache_lock = threading.Lock()
+        #: the warm plan degraded-mode serving falls back on: survives
+        #: invalidation (marked stale, not dropped) so an overloaded or
+        #: window-starved server still has a last-good answer
+        self._last_good: Optional[CachedPlan] = None
+        #: single-flight guard: one proposal computation at a time — a
+        #: GET /proposals stampede on a cold cache must not fan out into
+        #: N identical optimizations
+        self._compute_lock = threading.Lock()
 
     # ---- engine selection -------------------------------------------------------
     def _make_engine(self, engine: Optional[str], constraint=None):
         name = engine or self.default_engine
         constraint = constraint or self.constraint
         if name == "tpu":
+            config = self.tpu_config
+            # the request deadline clips the engine's anytime budget: an
+            # abandoned POST /rebalance stops burning analyzer time at its
+            # deadline instead of running the search to convergence.
+            # time_budget_s is a host-loop knob normalized out of the
+            # compile-cache key, so per-request budgets never recompile.
+            rem = admission.remaining_s()
+            if rem is not None:
+                from cruise_control_tpu.analyzer.tpu_optimizer import (
+                    TpuSearchConfig,
+                )
+
+                base = config or TpuSearchConfig()
+                budget = max(0.05, rem * 0.9)  # headroom for fetch+finalize
+                if base.time_budget_s:
+                    budget = min(budget, base.time_budget_s)
+                config = dataclasses.replace(base, time_budget_s=budget)
             return TpuGoalOptimizer(
                 constraint=constraint, mesh=self.mesh,
-                config=self.tpu_config,
+                config=config,
             )
         if name == "greedy":
             return GoalOptimizer(
@@ -200,9 +234,30 @@ class CruiseControl:
     ) -> ClusterState:
         with tracing.span("facade.model"):
             with progress.step("Acquiring model-generation semaphore"):
-                lock = self.load_monitor.acquire_for_model_generation()
-            with lock, progress.step("Generating cluster model"):
-                return self.load_monitor.cluster_model(requirements)
+                # the semaphore wait honors the request deadline: a queued
+                # request whose client gave up must not keep holding a
+                # thread against the model lock
+                rem = admission.remaining_s()
+                if rem is None:
+                    lock = self.load_monitor.acquire_for_model_generation()
+                elif rem <= 0:
+                    raise admission.DeadlineExceededError(
+                        "deadline exceeded before model generation"
+                    )
+                else:
+                    lock = self.load_monitor.acquire_for_model_generation(
+                        timeout_s=max(0.05, rem)
+                    )
+            try:
+                with lock, progress.step("Generating cluster model"):
+                    return self.load_monitor.cluster_model(requirements)
+            except RuntimeError:
+                if admission.expired():
+                    raise admission.DeadlineExceededError(
+                        "deadline exceeded waiting for the model-generation "
+                        "semaphore"
+                    ) from None
+                raise
 
     @staticmethod
     def _to_internal(state: ClusterState, broker_ids: Sequence[int]) -> List[int]:
@@ -324,6 +379,17 @@ class CruiseControl:
             )
         else:
             opt = self._make_engine(engine, constraint)
+        # a dead request must not reach the analyzer at all, and repeated
+        # analyzer failures trip the breaker into cached/shed-only serving
+        # (both checked before the optimize.start journal mark — a refused
+        # request must not leave a dangling start record)
+        admission.check_deadline(operation)
+        if self.breaker is not None and not self.breaker.allow():
+            raise AnalyzerSaturatedError(
+                "analyzer circuit breaker open "
+                f"({self.breaker.state_summary()['lastError']})",
+                retry_after_s=self.breaker.retry_after_s(),
+            )
         LOG.info(
             "%s starting: %d brokers / %d partitions, engine=%s, dryrun=%s",
             operation, state.num_brokers, state.num_partitions,
@@ -342,6 +408,8 @@ class CruiseControl:
                     result = opt.optimize(state, options)
                 except Exception as e:
                     LOG.exception("%s optimization failed", operation)
+                    if self.breaker is not None:
+                        self.breaker.record_failure(repr(e))
                     # the diagnosability contract: a failed rebalance is
                     # reconstructable from the journal alone — the failing
                     # goal (in the error) + the per-pass reject accounting
@@ -352,6 +420,9 @@ class CruiseControl:
                         goalSummaries=getattr(e, "goal_summaries", None),
                     )
                     raise
+                else:
+                    if self.breaker is not None:
+                        self.breaker.record_success()
         LOG.info(
             "%s optimized: %d actions, %d proposals, %.2fs",
             operation, len(result.actions), len(result.proposals),
@@ -722,35 +793,251 @@ class CruiseControl:
         return TopicConfigurationResult(proposals, execution)
 
     # ---- proposals cache (upstream proposal precompute, §3.5) -------------------
-    def get_proposals(
-        self,
-        engine: Optional[str] = None,
-        ignore_cache: bool = False,
-        progress: Optional[OperationProgress] = None,
-    ) -> OptimizerResult:
-        progress = progress or OperationProgress("PROPOSALS")
+    def _servable_cached(
+        self, ignore_cache: bool, generation_fresh_only: bool
+    ) -> Optional[OptimizerResult]:
+        """The cached result a get_proposals call may answer with, or
+        None.  ``generation_fresh_only`` is the serving layer's stricter
+        freshness (warm plan at the current model generation); the legacy
+        path keeps the wall-clock TTL semantics."""
+        if ignore_cache:
+            return None
+        if generation_fresh_only:
+            if not self.proposal_cache_fresh():
+                return None
+            with self._cache_lock:
+                plan = self._last_good
+            return plan.result if plan is not None else None
         with self._cache_lock:
             fresh = (
                 self._cached_proposals is not None
                 and time.time() - self._cached_at < self._proposal_ttl_s
             )
-            if fresh and not ignore_cache:
+            return self._cached_proposals if fresh else None
+
+    def get_proposals(
+        self,
+        engine: Optional[str] = None,
+        ignore_cache: bool = False,
+        progress: Optional[OperationProgress] = None,
+        generation_fresh_only: bool = False,
+    ) -> OptimizerResult:
+        progress = progress or OperationProgress("PROPOSALS")
+        cached = self._servable_cached(ignore_cache, generation_fresh_only)
+        if cached is not None:
+            progress.add_step("Returning cached proposals")
+            progress.finish()
+            return cached
+        # single-flight: a stampede on a cold cache serializes here and
+        # every follower re-checks the cache the leader just filled.  The
+        # wait honors the caller's deadline.
+        rem = admission.remaining_s()
+        acquired = self._compute_lock.acquire(
+            timeout=-1 if rem is None else max(0.0, rem)
+        )
+        if not acquired:
+            raise admission.DeadlineExceededError(
+                "deadline exceeded waiting for an in-flight proposal "
+                "computation"
+            )
+        try:
+            cached = self._servable_cached(
+                ignore_cache, generation_fresh_only
+            )
+            if cached is not None:
                 progress.add_step("Returning cached proposals")
                 progress.finish()
-                return self._cached_proposals
-        state = self._model(None, progress)
-        result = self._goal_based_operation(
-            "PROPOSALS", state, None, OptimizationOptions(), True,
-            engine, progress,
-        )
+                return cached
+            generation = self._model_generation()
+            state = self._model(None, progress)
+            result = self._goal_based_operation(
+                "PROPOSALS", state, None, OptimizationOptions(), True,
+                engine, progress,
+            )
+            sizes = self._partition_sizes(state)
+        finally:
+            self._compute_lock.release()
+        now = time.time()
         with self._cache_lock:
             self._cached_proposals = result
-            self._cached_at = time.time()
+            self._cached_at = now
+            self._last_good = CachedPlan(
+                result=result,
+                generation=generation,
+                partition_sizes=sizes,
+                computed_monotonic=time.monotonic(),
+                computed_unix=now,
+                engine=result.engine,
+            )
         return result
 
-    def invalidate_proposal_cache(self) -> None:
+    def _model_generation(self) -> str:
+        gen = getattr(self.load_monitor, "model_generation", None)
+        return gen() if gen is not None else ""
+
+    def invalidate_proposal_cache(self, reason: str = "execution") -> None:
+        """Drop the TTL cache and mark the warm plan stale.  The warm plan
+        is KEPT — it is the last-good answer degraded-mode serving falls
+        back on, now carrying its invalidation reason."""
         with self._cache_lock:
             self._cached_proposals = None
+            if self._last_good is not None and \
+                    self._last_good.invalidated is None:
+                self._last_good.invalidated = reason
+
+    def note_anomaly(self, anomaly) -> None:
+        """Detector hook: a detected anomaly means the model the warm plan
+        was computed against no longer describes the cluster."""
+        self.invalidate_proposal_cache(
+            f"anomaly:{anomaly.anomaly_type.value}"
+        )
+
+    def proposal_cache_fresh(self) -> bool:
+        """True while the warm plan still answers for the live model:
+        computed against the current model generation, never invalidated,
+        and inside the TTL."""
+        with self._cache_lock:
+            plan = self._last_good
+        if plan is None or plan.invalidated is not None:
+            return False
+        if plan.age_s() >= self._proposal_ttl_s:
+            return False
+        return plan.generation == self._model_generation()
+
+    def proposal_cache_state(self) -> dict:
+        with self._cache_lock:
+            plan = self._last_good
+        if plan is None:
+            return {"cacheWarm": False}
+        return {
+            "cacheWarm": True,
+            "cacheFresh": self.proposal_cache_fresh(),
+            "cacheGeneration": plan.generation,
+            "cacheAgeS": round(plan.age_s(), 3),
+            "cacheInvalidated": plan.invalidated,
+            "cacheEngine": plan.engine,
+        }
+
+    def serve_proposals(
+        self,
+        engine: Optional[str] = None,
+        ignore_cache: bool = False,
+        allow_stale: bool = True,
+        progress: Optional[OperationProgress] = None,
+    ) -> "Tuple[OptimizerResult, dict]":
+        """The serving-layer entry for ``GET /proposals``: answer from the
+        warm plan in milliseconds when it is fresh, recompute when it is
+        not — and when the analyzer is saturated (breaker open) or the
+        monitor window-starved, **degrade** to the last-good plan with an
+        explicit ``stale=true`` + generation marker instead of 503ing.
+
+        Returns ``(result, meta)`` with meta keys ``cached`` / ``stale`` /
+        ``proposalGeneration`` / ``cacheAgeS`` / ``staleReason``."""
+        def meta_for(plan: CachedPlan, stale: bool) -> dict:
+            out = {
+                "cached": True,
+                "stale": stale,
+                "proposalGeneration": plan.generation,
+                "cacheAgeS": round(plan.age_s(), 3),
+            }
+            if stale:
+                out["staleReason"] = (
+                    plan.invalidated or "model generation advanced"
+                )
+            return out
+
+        with self._cache_lock:
+            plan = self._last_good
+        if plan is not None and not ignore_cache \
+                and self.proposal_cache_fresh():
+            self.registry.meter("proposals.cache.hit").mark()
+            return plan.result, meta_for(plan, stale=False)
+        try:
+            result = self.get_proposals(
+                engine=engine, ignore_cache=ignore_cache, progress=progress,
+                generation_fresh_only=True,
+            )
+        except Exception:
+            # saturated / window-starved / analyzer failure: the degraded
+            # path — for a read-only plan view, the last-good plan with an
+            # explicit stale marker beats a 503 (the failure itself is
+            # journaled by the compute path; repeated ones trip the breaker)
+            if plan is not None and allow_stale:
+                self.registry.meter("proposals.cache.stale").mark()
+                events.emit("proposals.served_stale", severity="WARNING",
+                            generation=plan.generation,
+                            reason=plan.invalidated or "stale-generation")
+                return plan.result, meta_for(plan, stale=True)
+            raise
+        self.registry.meter("proposals.cache.miss").mark()
+        with self._cache_lock:
+            new_plan = self._last_good
+        meta = {"cached": False, "stale": False}
+        if new_plan is not None:
+            meta["proposalGeneration"] = new_plan.generation
+        return result, meta
+
+    def rebalance_cached(
+        self,
+        dryrun: bool = True,
+        progress: Optional[OperationProgress] = None,
+        strategy: Optional[ReplicaMovementStrategy] = None,
+    ) -> OptimizerResult:
+        """``POST /rebalance?allow_cached=true``: execute (or return) the
+        warm precomputed plan in milliseconds instead of recomputing.
+        Falls back to a full rebalance when no warm plan exists.  A stale
+        plan is still served/executed — that is the operator's explicit
+        ``allow_cached`` trade — with the staleness marked on the result."""
+        progress = progress or OperationProgress("REBALANCE")
+        with self._cache_lock:
+            plan = self._last_good
+        if plan is None:
+            return self.rebalance(dryrun=dryrun, progress=progress,
+                                  strategy=strategy)
+        stale = not self.proposal_cache_fresh()
+        result = dataclasses.replace(plan.result) if dataclasses.is_dataclass(
+            plan.result) else plan.result
+        result.cache_meta = {
+            "cached": True,
+            "stale": stale,
+            "proposalGeneration": plan.generation,
+            "cacheAgeS": round(plan.age_s(), 3),
+        }
+        self.registry.meter(
+            "proposals.cache.stale" if stale else "proposals.cache.hit"
+        ).mark()
+        progress.add_step("Serving precomputed proposals")
+        if dryrun:
+            progress.finish()
+            return result
+        self._sanity_check_no_execution(dryrun)
+        with progress.step(
+            f"Executing {len(result.proposals)} cached proposals"
+        ):
+            events.emit(
+                "execute.start", operation="REBALANCE",
+                numProposals=len(result.proposals), cached=True,
+                stale=stale,
+            )
+            with self.registry.timer("execution-timer"), \
+                    tracing.span("facade.execute"):
+                result.execution = self.executor.execute_proposals(
+                    result.proposals, strategy=strategy,
+                    partition_sizes=plan.partition_sizes,
+                )
+        ex = result.execution
+        events.emit(
+            "execute.end", operation="REBALANCE",
+            severity="WARNING" if (ex.dead or ex.stopped) else "INFO",
+            completed=ex.completed, dead=ex.dead, aborted=ex.aborted,
+            ticks=ex.ticks, stopped=ex.stopped,
+        )
+        self.invalidate_proposal_cache()
+        invalidate = getattr(self.load_monitor.metadata, "invalidate", None)
+        if invalidate is not None:
+            invalidate()
+        progress.finish()
+        return result
 
     def start_proposal_precomputation(
         self, interval_s: float = 30.0, engine: Optional[str] = None
@@ -878,10 +1165,15 @@ class CruiseControl:
                 "isProposalReady": self._cached_proposals is not None,
                 "readyGoals": [g.name for g in make_goals(
                     constraint=self.constraint)],
+                "proposalCache": self.proposal_cache_state(),
                 **(
                     {"proposalPrecompute":
                      self.proposal_precomputer.state_summary()}
                     if self.proposal_precomputer is not None else {}
+                ),
+                **(
+                    {"circuitBreaker": self.breaker.state_summary()}
+                    if self.breaker is not None else {}
                 ),
             },
         }

@@ -300,3 +300,10 @@ class MetricSampleAggregator:
     def generation(self) -> int:
         """Monotonic state version (upstream aggregator generation)."""
         return self._generation
+
+    @property
+    def window_generation(self) -> int:
+        """Latest absolute metric window observed (-1 before any sample).
+        Window-granular where ``generation`` is per-sample — the model
+        generation the proposal cache keys on."""
+        return int(self._window_index.max(initial=-1))

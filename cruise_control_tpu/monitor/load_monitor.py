@@ -315,9 +315,26 @@ class LoadMonitor:
         return self.ingest_samples(psamples, bsamples, now_ms)
 
     # ---- model generation -------------------------------------------------------
-    def acquire_for_model_generation(self) -> "ModelGenerationLock":
-        """Upstream ``acquireForModelGeneration`` semaphore."""
-        return ModelGenerationLock(self._model_semaphore)
+    def acquire_for_model_generation(
+        self, timeout_s: Optional[float] = None
+    ) -> "ModelGenerationLock":
+        """Upstream ``acquireForModelGeneration`` semaphore.  ``timeout_s``
+        bounds the acquire wait (request-deadline propagation); None keeps
+        the 60s default."""
+        return ModelGenerationLock(
+            self._model_semaphore,
+            timeout_s=60.0 if timeout_s is None else timeout_s,
+        )
+
+    def model_generation(self) -> str:
+        """Coarse model-generation marker the proposal cache keys on:
+        bumps when a new metric window opens or the partition universe
+        grows — NOT on every sample (the per-sample aggregator generation
+        would mark every cached plan stale within one sampling interval).
+        Topology changes the windows can't see (broker death) reach the
+        cache through the detector-anomaly invalidation hook instead."""
+        agg = self.partition_aggregator
+        return f"w{agg.window_generation}.e{agg.num_entities}"
 
     def cluster_model(
         self,
@@ -476,11 +493,12 @@ class LoadMonitor:
 
 
 class ModelGenerationLock:
-    def __init__(self, sem: threading.Semaphore):
+    def __init__(self, sem: threading.Semaphore, timeout_s: float = 60.0):
         self._sem = sem
+        self._timeout_s = timeout_s
 
     def __enter__(self):
-        acquired = self._sem.acquire(timeout=60.0)
+        acquired = self._sem.acquire(timeout=self._timeout_s)
         if not acquired:
             raise RuntimeError("could not acquire model-generation semaphore")
         return self

@@ -27,8 +27,17 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from cruise_control_tpu.analyzer.precompute import AnalyzerSaturatedError
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
+from cruise_control_tpu.server import admission as admission_mod
+from cruise_control_tpu.server.admission import (
+    CLASS_COMPUTE,
+    CLASS_GET,
+    AdmissionController,
+    DeadlineExceededError,
+    RequestShedError,
+)
 from cruise_control_tpu.server.purgatory import Purgatory
 from cruise_control_tpu.telemetry import events, tracing
 from cruise_control_tpu.utils.logging import get_logger
@@ -43,6 +52,9 @@ from cruise_control_tpu.server.user_tasks import (
 
 PREFIX = "/kafkacruisecontrol"
 USER_TASK_HEADER = "User-Task-ID"
+#: per-request deadline header (milliseconds the client is willing to
+#: wait); propagated into the facade as a thread-local deadline scope
+DEADLINE_HEADER = "deadline-ms"
 
 #: Retry-After guidance on backpressure responses (RFC 9110 §10.2.3).
 #: 429 (task capacity) clears as soon as a worker frees up — retry fast;
@@ -54,6 +66,7 @@ RETRY_AFTER_NOT_READY_S = 30
 GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
     "user_tasks", "review_board", "metrics", "diagnostics", "events",
+    "health",
 }
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
@@ -85,6 +98,15 @@ class CruiseControlHttpServer:
         ui_path: Optional[str] = None,
         flight_recorder=None,
         event_journal=None,
+        get_max_concurrent: int = 16,
+        compute_max_concurrent: int = 4,
+        admission_queue_size: int = 16,
+        admission_queue_timeout_s: float = 2.0,
+        default_deadline_ms: int = 0,
+        max_body_bytes: int = 1 << 20,
+        read_timeout_s: float = 10.0,
+        drain_timeout_s: float = 5.0,
+        max_inflight: int = 0,
     ):
         self.cc = cruise_control
         self.host = host
@@ -103,17 +125,59 @@ class CruiseControlHttpServer:
         #: back to the process-wide events.JOURNAL at request time)
         self.event_journal = event_journal
         self.purgatory = Purgatory(retention_s=purgatory_retention_s)
+        #: the overload-safe front door (ISSUE 8): per-class concurrency
+        #: limits + one bounded queue; sheds with Retry-After instead of
+        #: stacking threads onto the analyzer
+        self.admission = AdmissionController(
+            max_concurrent={
+                CLASS_GET: get_max_concurrent,
+                CLASS_COMPUTE: compute_max_concurrent,
+            },
+            queue_size=admission_queue_size,
+            queue_timeout_s=admission_queue_timeout_s,
+            retry_after_s=RETRY_AFTER_BUSY_S,
+            on_shed=self._on_shed,
+            max_inflight=max_inflight,
+        )
+        self.default_deadline_ms = max(0, int(default_deadline_ms))
+        self.max_body_bytes = max(0, int(max_body_bytes))
+        self.read_timeout_s = read_timeout_s
+        self.drain_timeout_s = drain_timeout_s
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._log = get_logger("server")
+        registry = getattr(self.cc, "registry", None)
+        if registry is not None:
+            registry.gauge("http.admission.queued",
+                           lambda: float(self.admission.queued()))
+            registry.gauge("http.admission.inflight",
+                           lambda: float(self.admission.inflight()))
+
+    def _on_shed(self, cls: str, reason: str) -> None:
+        registry = getattr(self.cc, "registry", None)
+        if registry is not None:
+            registry.meter("http.admission.shed").mark()
+        events.emit("http.request_shed", severity="WARNING",
+                    admissionClass=cls, reason=reason)
 
     # ---- lifecycle --------------------------------------------------------------
     def start(self) -> None:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # per-connection socket timeout: a slow-loris client trickling
+            # headers cannot pin a server thread past this (the stdlib
+            # handler closes the connection on socket timeout)
+            timeout = server.read_timeout_s
+
             def log_message(self, *args):  # quiet; metrics cover observability
                 pass
+
+            def handle_one_request(self):
+                try:
+                    super().handle_one_request()
+                except TimeoutError:  # header/read timeout → reap quietly
+                    self.close_connection = True
 
             def do_GET(self):
                 server._dispatch(self, "GET")
@@ -121,16 +185,43 @@ class CruiseControlHttpServer:
             def do_POST(self):
                 server._dispatch(self, "POST")
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class Httpd(ThreadingHTTPServer):
+            # handler threads are daemons and server_close must not join
+            # them unbounded — the graceful drain below does the bounded
+            # join through the admission controller's in-flight count
+            daemon_threads = True
+            block_on_close = False
+            # socketserver's default listen backlog is FIVE: under a
+            # client storm, connections then queue invisibly in the
+            # kernel instead of reaching admission control, which is the
+            # layer that must decide (admit/queue/shed) — accept fast,
+            # decide explicitly
+            request_queue_size = 512
+
+        self._httpd = Httpd((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="cc-http"
         )
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout_s: Optional[float] = None) -> None:
+        """Graceful drain: stop accepting, shed the admission queue with
+        Retry-After, join in-flight requests (bounded), then shut the task
+        pool down (queued tasks cancelled, workers joined bounded)."""
+        timeout = (self.drain_timeout_s if drain_timeout_s is None
+                   else drain_timeout_s)
         if self._httpd is not None:
-            self._httpd.shutdown()
+            self._httpd.shutdown()  # accept loop stops; in-flight continue
+        drained = self.admission.drain(timeout_s=timeout)
+        if not drained:
+            self._log.warning(
+                "server drain timed out after %.1fs with %d request(s) "
+                "in flight", timeout, self.admission.inflight(),
+            )
+        events.emit("http.server_drain", drained=drained,
+                    shedTotal=self.admission.shed_total)
+        if self._httpd is not None:
             self._httpd.server_close()
             self._httpd = None
         self.tasks.shutdown()
@@ -140,74 +231,171 @@ class CruiseControlHttpServer:
         return f"http://{self.host}:{self.port}{self.prefix}"
 
     # ---- dispatch ---------------------------------------------------------------
+    def _admission_class(self, method: str, endpoint: str,
+                         handler, params: dict) -> str:
+        """Cheap reads vs analyzer-bound work.  Async-POST *polls* (a
+        known task id riding along) are reads — shedding them under load
+        would strand every client of the 202 protocol."""
+        if method == "GET":
+            return CLASS_GET
+        if endpoint in ASYNC_POST_ENDPOINTS:
+            tid = handler.headers.get(USER_TASK_HEADER) \
+                or params.get("user_task_id")
+            return CLASS_GET if tid else CLASS_COMPUTE
+        return CLASS_GET
+
+    def _request_deadline(self, handler) -> Optional[float]:
+        """Absolute monotonic deadline from the ``deadline-ms`` header (or
+        the configured default); None = none."""
+        raw = handler.headers.get(DEADLINE_HEADER)
+        ms = int(raw) if raw is not None else self.default_deadline_ms
+        if ms <= 0:
+            return None
+        return time.monotonic() + ms / 1000.0
+
     def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
-        try:
-            parsed = urlparse(handler.path)
-            if method == "GET" and parsed.path.rstrip("/") in ("/ui", ""):
-                return self._serve_ui(handler)
-            if not parsed.path.startswith(self.prefix + "/"):
-                return self._send(handler, 404, {"errorMessage": "not found"})
-            endpoint = parsed.path[len(self.prefix) + 1:].strip("/").lower()
-            registry = getattr(self.cc, "registry", None)
-            # KNOWN endpoints only, so an URL scan cannot mint unbounded
-            # metric names in the registry (unknown paths share one
-            # "unknown" bucket; the request-duration timer below reuses
-            # this same gate)
-            known = (
-                (method == "GET" and endpoint in GET_ENDPOINTS)
-                or (method == "POST" and endpoint in ASYNC_POST_ENDPOINTS)
-                or (method == "POST" and endpoint in SYNC_POST_ENDPOINTS)
-            )
-            if registry is not None:  # servlet request rates (§5.1)
-                bucket = (endpoint or "root") if (known or not endpoint) \
-                    else "unknown"
-                registry.meter(f"http.{method}.{bucket}").mark()  # cclint: disable=obs-dynamic-name -- bounded: method is GET/POST, bucket is drawn from the routing tables plus root/unknown
-            params = {
-                k: v[-1] for k, v in parse_qs(parsed.query).items()
-            }
-            if self.security is not None and not self._authenticated(handler):
-                handler.send_response(401)
-                handler.send_header("WWW-Authenticate", "Basic")
-                handler.end_headers()
-                return
-            # request span, correlated with the async protocol's task id
-            # via _respond_task's annotate (guard before the f-string: the
-            # disabled path must not pay for formatting)
-            if tracing.enabled():
-                req_span = tracing.span(
-                    "http", sub=f"{method}.{endpoint or 'root'}"
-                )
-            else:
-                req_span = tracing.NOOP
-            t_req = time.perf_counter()
+        with self.admission.track():
             try:
-                with req_span:
-                    if method == "GET" and endpoint in GET_ENDPOINTS:
-                        return self._handle_get(handler, endpoint, params)
-                    if method == "POST" and endpoint in ASYNC_POST_ENDPOINTS:
-                        return self._handle_async_post(
-                            handler, endpoint, params)
-                    if method == "POST" and endpoint in SYNC_POST_ENDPOINTS:
-                        return self._handle_sync_post(
-                            handler, endpoint, params)
-            finally:
-                if known and registry is not None:
-                    registry.timer(f"http.{method}.{endpoint}").update(  # cclint: disable=obs-dynamic-name -- bounded: gated on known, endpoint is in the routing tables
-                        time.perf_counter() - t_req
+                self._dispatch_inner(handler, method)
+            except RequestShedError as e:
+                self._send(handler, 429, {"errorMessage": str(e)},
+                           headers={"Retry-After": str(e.retry_after_s)})
+            except DeadlineExceededError as e:
+                # the client's own deadline passed: there is nobody left to
+                # retry fast, but Retry-After keeps automated clients honest
+                self._send(handler, 503, {"errorMessage": str(e)},
+                           headers={"Retry-After": str(RETRY_AFTER_BUSY_S)})
+            except AnalyzerSaturatedError as e:
+                self._send(handler, 503, {"errorMessage": str(e)},
+                           headers={"Retry-After": str(e.retry_after_s)})
+            except (ValueError, KeyError) as e:
+                self._log.warning("%s %s -> 400: %s", method, handler.path, e)
+                self._send(handler, 400, {"errorMessage": str(e)})
+            except NotEnoughValidWindowsError as e:
+                self._log.info("%s %s -> 503: %s", method, handler.path, e)
+                self._send(
+                    handler, 503, {"errorMessage": str(e)},
+                    headers={"Retry-After": str(RETRY_AFTER_NOT_READY_S)})
+            except Exception as e:
+                self._log.exception("%s %s -> 500", method, handler.path)
+                self._send(handler, 500, {"errorMessage": repr(e)})
+
+    def _dispatch_inner(self, handler: BaseHTTPRequestHandler,
+                        method: str) -> None:
+        parsed = urlparse(handler.path)
+        if method == "GET" and parsed.path.rstrip("/") in ("/ui", ""):
+            return self._serve_ui(handler)
+        # /health answers before auth, admission, and draining checks: a
+        # load balancer's probe must never be queued, shed, or locked out
+        if method == "GET" and parsed.path.rstrip("/") in (
+                "/health", self.prefix + "/health"):
+            return self._handle_health(handler)
+        if not parsed.path.startswith(self.prefix + "/"):
+            return self._send(handler, 404, {"errorMessage": "not found"})
+        # the global in-flight ceiling: a storm becomes explicit 429s at
+        # the door instead of invisible scheduler queueing (a handler
+        # thread exists per connection — bound what they may carry)
+        self.admission.check_global()
+        endpoint = parsed.path[len(self.prefix) + 1:].strip("/").lower()
+        registry = getattr(self.cc, "registry", None)
+        # KNOWN endpoints only, so an URL scan cannot mint unbounded
+        # metric names in the registry (unknown paths share one
+        # "unknown" bucket; the request-duration timer below reuses
+        # this same gate)
+        known = (
+            (method == "GET" and endpoint in GET_ENDPOINTS)
+            or (method == "POST" and endpoint in ASYNC_POST_ENDPOINTS)
+            or (method == "POST" and endpoint in SYNC_POST_ENDPOINTS)
+        )
+        if registry is not None:  # servlet request rates (§5.1)
+            bucket = (endpoint or "root") if (known or not endpoint) \
+                else "unknown"
+            registry.meter(f"http.{method}.{bucket}").mark()  # cclint: disable=obs-dynamic-name -- bounded: method is GET/POST, bucket is drawn from the routing tables plus root/unknown
+        params = {
+            k: v[-1] for k, v in parse_qs(parsed.query).items()
+        }
+        if method == "POST" and self.max_body_bytes:
+            # request bodies are unused by this API; a declared body past
+            # the cap is rejected before anything reads it (413)
+            length = int(handler.headers.get("Content-Length") or 0)
+            if length > self.max_body_bytes:
+                return self._send(handler, 413, {
+                    "errorMessage": (
+                        f"request body {length} bytes > cap "
+                        f"{self.max_body_bytes} (webserver.request."
+                        f"max.body.bytes)"
                     )
-            self._send(handler, 404, {
-                "errorMessage": f"unknown endpoint {method} {endpoint!r}"
-            })
-        except (ValueError, KeyError) as e:
-            self._log.warning("%s %s -> 400: %s", method, handler.path, e)
-            self._send(handler, 400, {"errorMessage": str(e)})
-        except NotEnoughValidWindowsError as e:
-            self._log.info("%s %s -> 503: %s", method, handler.path, e)
-            self._send(handler, 503, {"errorMessage": str(e)},
-                       headers={"Retry-After": str(RETRY_AFTER_NOT_READY_S)})
-        except Exception as e:
-            self._log.exception("%s %s -> 500", method, handler.path)
-            self._send(handler, 500, {"errorMessage": repr(e)})
+                })
+        if self.security is not None and not self._authenticated(handler):
+            handler.send_response(401)
+            handler.send_header("WWW-Authenticate", "Basic")
+            handler.end_headers()
+            return
+        deadline = self._request_deadline(handler)
+        cls = self._admission_class(method, endpoint, handler, params)
+        with admission_mod.deadline_scope(deadline):
+            # an already-dead request sheds before admission: it must not
+            # consume a slot another client could use
+            admission_mod.check_deadline(f"{method} {endpoint}")
+            with self.admission.admit(cls):
+                # request span, correlated with the async protocol's task
+                # id via _respond_task's annotate (guard before the
+                # f-string: the disabled path must not pay for formatting)
+                if tracing.enabled():
+                    req_span = tracing.span(
+                        "http", sub=f"{method}.{endpoint or 'root'}"
+                    )
+                else:
+                    req_span = tracing.NOOP
+                t_req = time.perf_counter()
+                try:
+                    with req_span:
+                        if method == "GET" and endpoint in GET_ENDPOINTS:
+                            return self._handle_get(
+                                handler, endpoint, params)
+                        if method == "POST" \
+                                and endpoint in ASYNC_POST_ENDPOINTS:
+                            return self._handle_async_post(
+                                handler, endpoint, params)
+                        if method == "POST" \
+                                and endpoint in SYNC_POST_ENDPOINTS:
+                            return self._handle_sync_post(
+                                handler, endpoint, params)
+                finally:
+                    if known and registry is not None:
+                        registry.timer(f"http.{method}.{endpoint}").update(  # cclint: disable=obs-dynamic-name -- bounded: gated on known, endpoint is in the routing tables
+                            time.perf_counter() - t_req
+                        )
+        self._send(handler, 404, {
+            "errorMessage": f"unknown endpoint {method} {endpoint!r}"
+        })
+
+    def _handle_health(self, handler) -> None:
+        """Liveness + readiness for load balancers (never queued, never
+        shed, no auth): readiness = enough monitor windows for a model +
+        analyzer breaker not open + not draining."""
+        monitor_state: dict = {}
+        windows = 0
+        try:
+            monitor_state = self.cc.load_monitor.state_summary()
+            windows = int(monitor_state.get("numValidWindows") or 0)
+        except Exception as e:  # a broken monitor is a NOT-ready, not a 500
+            monitor_state = {"error": repr(e)}
+        breaker = getattr(self.cc, "breaker", None)
+        breaker_state = breaker.state if breaker is not None else None
+        draining = self.admission.draining
+        ready = (windows >= 1 and not draining
+                 and breaker_state != "OPEN")
+        body = {
+            "liveness": "UP",
+            "ready": ready,
+            "monitorWindows": windows,
+            "monitorState": monitor_state.get("state"),
+            "breaker": breaker_state,
+            "draining": draining,
+            "admission": self.admission.state_summary(),
+        }
+        return self._send(handler, 200 if ready else 503, body)
 
     def _authenticated(self, handler) -> bool:
         """Support both the provider SPI (authenticate_request) and the
@@ -365,10 +553,16 @@ class CruiseControlHttpServer:
         if endpoint == "partition_load":
             return self._send(handler, 200, self._partition_load_response(params))
         if endpoint == "proposals":
-            result = self.cc.get_proposals(
+            # serve from the warm precomputed plan when fresh; degrade to
+            # the last-good plan (stale=true + generation marker) when the
+            # analyzer is saturated or the monitor window-starved
+            result, meta = self.cc.serve_proposals(
                 ignore_cache=_flag(params, "ignore_proposal_cache"),
+                allow_stale=_flag(params, "allow_stale", default=True),
             )
-            return self._send(handler, 200, _optimizer_response(result, params))
+            body = _optimizer_response(result, params)
+            body.update(meta)
+            return self._send(handler, 200, body)
         if endpoint == "kafka_cluster_state":
             return self._send(handler, 200, self._cluster_state_response())
         if endpoint == "user_tasks":
@@ -505,7 +699,8 @@ class CruiseControlHttpServer:
         fn = self._operation(endpoint, params)
         try:
             task = self.tasks.submit(
-                endpoint, lambda progress: fn(progress)
+                endpoint, lambda progress: fn(progress),
+                deadline_monotonic=admission_mod.current_deadline(),
             )
             # journal the operation ↔ User-Task-ID binding: operation
             # events run on the worker thread (task_scope), this records
@@ -528,7 +723,11 @@ class CruiseControlHttpServer:
         if timeout_s:
             try:
                 task.future.result(timeout=timeout_s)
-            except Exception:
+            except BaseException:
+                # the wait only decides 200-vs-202; the error branch below
+                # reports the failure.  BaseException on purpose: a worker
+                # unwound by a simulated ProcessCrash must still produce
+                # an HTTP response, not kill the handler thread.
                 pass
         if not task.future.done():
             return self._send(
@@ -538,11 +737,19 @@ class CruiseControlHttpServer:
         err = task.future.exception()
         if err is not None:
             not_ready = isinstance(err, NotEnoughValidWindowsError)
+            overload = isinstance(
+                err, (DeadlineExceededError, AnalyzerSaturatedError,
+                      RequestShedError)
+            )
             headers = {USER_TASK_HEADER: task.task_id}
             if not_ready:
                 headers["Retry-After"] = str(RETRY_AFTER_NOT_READY_S)
+            elif overload:
+                headers["Retry-After"] = str(
+                    getattr(err, "retry_after_s", RETRY_AFTER_BUSY_S)
+                )
             return self._send(
-                handler, 503 if not_ready else 500,
+                handler, 503 if (not_ready or overload) else 500,
                 {"errorMessage": repr(err), "UserTaskId": task.task_id},
                 headers=headers,
             )
@@ -581,6 +788,13 @@ class CruiseControlHttpServer:
         if endpoint == "rebalance":
             rebalance_disk = _flag(params, "rebalance_disk")
             kafka_assigner = _flag(params, "kafka_assigner")
+            if _flag(params, "allow_cached") and not (
+                    goal_list or rebalance_disk or kafka_assigner):
+                # serve/execute the warm precomputed plan in milliseconds
+                # (§3.5); the response carries cached/stale markers
+                return lambda progress: cc.rebalance_cached(
+                    dryrun=dryrun, progress=progress,
+                )
             return lambda progress: cc.rebalance(
                 goals=goal_list, dryrun=dryrun, engine=engine,
                 progress=progress, rebalance_disk=rebalance_disk,
@@ -723,4 +937,6 @@ def _optimizer_response(result, params: dict) -> dict:
         body["proposals"] = [p.to_json() for p in result.proposals]
     else:
         body["proposals"] = [p.to_json() for p in result.proposals[:20]]
+    # cached-plan provenance (rebalance_cached): stale/generation markers
+    body.update(getattr(result, "cache_meta", None) or {})
     return body

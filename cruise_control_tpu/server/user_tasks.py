@@ -15,6 +15,7 @@ import uuid
 from concurrent.futures import CancelledError, Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
+from cruise_control_tpu.server import admission
 from cruise_control_tpu.server.progress import OperationProgress
 from cruise_control_tpu.telemetry import events
 
@@ -61,11 +62,15 @@ class UserTaskManager:
     def __init__(self, max_active_tasks: int = 25,
                  completed_task_ttl_s: float = 3600.0,
                  max_workers: int = 4,
-                 max_cached_completed: int = 100):
+                 max_cached_completed: int = 100,
+                 id_factory: Optional[Callable[[], str]] = None):
         self.max_active_tasks = max_active_tasks
         self.completed_task_ttl_s = completed_task_ttl_s
         #: completed tasks kept at most, oldest evicted first (on top of TTL)
         self.max_cached_completed = max_cached_completed
+        #: task-id source (the scenario simulator injects a deterministic
+        #: counter so journal fingerprints are reproducible)
+        self.id_factory = id_factory
         self._tasks: Dict[str, UserTask] = {}
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
@@ -74,8 +79,12 @@ class UserTaskManager:
 
     # ---- lifecycle --------------------------------------------------------------
     def submit(self, endpoint: str, fn: Callable[[OperationProgress], object],
-               task_id: Optional[str] = None) -> UserTask:
-        """Run ``fn(progress)`` on the pool under a new (or supplied) task id."""
+               task_id: Optional[str] = None,
+               deadline_monotonic: Optional[float] = None) -> UserTask:
+        """Run ``fn(progress)`` on the pool under a new (or supplied) task
+        id.  ``deadline_monotonic`` re-enters the request's deadline scope
+        on the worker thread — an abandoned request stops burning analyzer
+        time at its deadline even though the 202 handoff changed threads."""
         self._expire()
         with self._lock:
             active = sum(
@@ -86,7 +95,10 @@ class UserTaskManager:
                 raise TooManyTasksError(
                     f"{active} active tasks >= cap {self.max_active_tasks}"
                 )
-            tid = task_id or str(uuid.uuid4())
+            tid = task_id or (
+                self.id_factory() if self.id_factory is not None
+                else str(uuid.uuid4())
+            )
             if tid in self._tasks:
                 return self._tasks[tid]  # idempotent resubmit: same task
             task = UserTask(tid, endpoint)
@@ -97,7 +109,11 @@ class UserTaskManager:
                 # every journal event emitted on this worker thread carries
                 # the async protocol's User-Task-ID (events.task_scope is a
                 # thread-local; correlation without signature plumbing)
-                with events.task_scope(tid, endpoint.upper()):
+                with events.task_scope(tid, endpoint.upper()), \
+                        admission.deadline_scope(deadline_monotonic):
+                    # a task whose deadline passed while queued behind the
+                    # worker pool must not run at all
+                    admission.check_deadline(endpoint)
                     task.future.set_result(fn(task.progress))
             except BaseException as e:  # surfaced via the future
                 task.future.set_exception(e)

@@ -3,7 +3,7 @@ emit the ``cc-tpu-scenarios/1`` artifact.
 
     python -m cruise_control_tpu.sim --list
     python -m cruise_control_tpu.sim --scenario rack_loss --seed 7
-    python -m cruise_control_tpu.sim --artifact SCENARIOS_r08.json
+    python -m cruise_control_tpu.sim --artifact SCENARIOS_r09.json
 
 Without ``--scenario`` the full registry runs.  Exit code is 1 when any
 scenario ends in FIX_FAILED or UNHEALED (regression signal for CI cron).

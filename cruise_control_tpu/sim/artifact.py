@@ -6,7 +6,7 @@ anomaly decisions, and what the executor actually did — every field derived
 from the run's event journal (the same ground truth the test suite asserts
 on).  The checked-in contract lives in ``tests/schemas/artifacts.schema.json``
 (closed records — field drift fails CI), and the committed instance is
-``SCENARIOS_r08.json``.
+``SCENARIOS_r09.json``.
 """
 
 from __future__ import annotations

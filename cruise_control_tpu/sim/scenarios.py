@@ -9,7 +9,7 @@ then relapse, alert-only metric anomalies, and scripted execution stalls.
 
 ``tests/test_scenarios.py`` asserts each scenario's heal outcome by reading
 only the event journal; ``python -m cruise_control_tpu.sim`` runs the suite
-and emits the ``cc-tpu-scenarios/1`` artifact (``SCENARIOS_r08.json``).
+and emits the ``cc-tpu-scenarios/1`` artifact (``SCENARIOS_r09.json``).
 
 Timing note: the monitor averages loads over its (5 × 1-virtual-minute)
 windows, so a load change needs ~3 windows before a capacity detector sees
@@ -25,18 +25,23 @@ from cruise_control_tpu.sim.simulator import MIN_MS, ScenarioSpec
 from cruise_control_tpu.sim.timeline import (
     Timeline,
     add_broker,
+    analyzer_outage,
     crash_process,
     disk_failure,
     flap_broker,
     hot_partition_skew,
+    http_request,
     kill_broker,
     kill_broker_mid_execution,
     maintenance_event,
     metric_gap,
     rack_loss,
+    request_storm,
     restart_process,
+    restore_analyzer,
     restore_broker,
     restore_disk,
+    slow_client,
     stall_execution,
 )
 
@@ -367,6 +372,109 @@ def _flapping_destination_retries() -> ScenarioSpec:
     )
 
 
+# ---- overload-safe serving (ISSUE 8): chaos on the front door -------------------
+def _degraded_serving_survives_analyzer_outage() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="degraded_serving_survives_analyzer_outage",
+        description=(
+            "The analyzer starts failing every optimization; after two "
+            "failed precompute passes the circuit breaker opens and "
+            "GET /proposals degrades to the last-good cached plan with an "
+            "explicit stale=true marker (no 5xx).  Once the analyzer "
+            "recovers, the half-open probe closes the breaker and fresh "
+            "serving resumes."
+        ),
+        timeline=Timeline([
+            http_request(5 * MIN_MS, "proposals"),
+            analyzer_outage(6 * MIN_MS),
+            http_request(9 * MIN_MS, "proposals"),
+            http_request(11 * MIN_MS, "proposals"),
+            restore_analyzer(12 * MIN_MS),
+            http_request(13 * MIN_MS, "proposals"),
+            http_request(14 * MIN_MS, "health"),
+        ]),
+        serve_http=True,
+        precompute_interval_ticks=2,
+        breaker_failures=2,
+        breaker_reset_ms=4 * MIN_MS,
+        duration_ms=16 * MIN_MS,
+    )
+
+
+def _request_storm_sheds_with_retry_after() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="request_storm_sheds_with_retry_after",
+        description=(
+            "16 concurrent GET /proposals clients hit a front door sized "
+            "for 2 (queue 0), then 8 concurrent POST /rebalance clients "
+            "hit a compute class sized for 1: the overflow is shed with "
+            "429 + Retry-After, the admitted requests complete, and "
+            "nothing 5xxes — load becomes backpressure, not collapse."
+        ),
+        timeline=Timeline([
+            request_storm(6 * MIN_MS, n=16, endpoint="proposals"),
+            request_storm(8 * MIN_MS, n=8, endpoint="rebalance",
+                          method="POST", params={"dryrun": "true"}),
+            http_request(10 * MIN_MS, "health"),
+        ]),
+        serve_http=True,
+        precompute_interval_ticks=2,
+        http_get_concurrent=2,
+        http_compute_concurrent=1,
+        http_queue_size=0,
+        duration_ms=12 * MIN_MS,
+    )
+
+
+def _slow_loris_connection_reaped() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="slow_loris_connection_reaped",
+        description=(
+            "A slow-loris client opens a connection and trickles a "
+            "partial request forever: the per-connection read timeout "
+            "reaps it (thread freed) and a normal request issued right "
+            "after is served untouched."
+        ),
+        timeline=Timeline([
+            slow_client(5 * MIN_MS, hold_s=2.0),
+            http_request(5 * MIN_MS, "state"),
+            http_request(6 * MIN_MS, "health"),
+        ]),
+        serve_http=True,
+        http_read_timeout_ms=500,
+        duration_ms=8 * MIN_MS,
+    )
+
+
+def _crash_mid_request_recovers_front_door() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="crash_mid_request_recovers_front_door",
+        description=(
+            "An operator's POST /rebalance (dryrun=false) is mid-"
+            "execution when the process crashes (checkpoint armed): the "
+            "client gets an explicit 500, the front door goes dark "
+            "(health unreachable) while the cluster finishes in-flight "
+            "moves, and the restarted process resumes the checkpoint and "
+            "reports ready again."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=6.0, leader=0),
+            crash_process(5 * MIN_MS, after_ticks=4),
+            http_request(6 * MIN_MS, "rebalance", method="POST",
+                         params={"dryrun": "false"}),
+            http_request(8 * MIN_MS, "health"),
+            restart_process(16 * MIN_MS),
+            http_request(18 * MIN_MS, "health"),
+        ]),
+        serve_http=True,
+        checkpoint=True,
+        mean_utilization=0.18,
+        move_latency_ticks=4,
+        executor_moves_per_broker=1,
+        duration_ms=24 * MIN_MS,
+    )
+
+
 #: name → spec factory; a fresh ScenarioSpec per call
 SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
     factory().name: factory
@@ -387,15 +495,23 @@ SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
         _crash_completes_while_down,
         _crash_recovery_replans_dead_destination,
         _flapping_destination_retries,
+        _degraded_serving_survives_analyzer_outage,
+        _request_storm_sheds_with_retry_after,
+        _slow_loris_connection_reaped,
+        _crash_mid_request_recovers_front_door,
     )
 }
 
 #: the tier-1 smoke subset (runs under ``-m 'not slow'``); the full matrix
 #: is marked slow and exercised by the CLI artifact run.
 #: crash_resume_mid_execution rides in tier-1 so the crash-resume journal
-#: fingerprint is re-verified bit-for-bit on every run (ISSUE 7).
+#: fingerprint is re-verified bit-for-bit on every run (ISSUE 7);
+#: degraded_serving_survives_analyzer_outage does the same for the
+#: serving layer (ISSUE 8) — its requests are sequential, so the journal
+#: is bit-reproducible (storms are not, and stay out of smoke).
 SMOKE_SCENARIOS = ("rack_loss", "cascading_disk_failures",
-                   "crash_resume_mid_execution")
+                   "crash_resume_mid_execution",
+                   "degraded_serving_survives_analyzer_outage")
 
 
 def make_scenario(name: str, seed: Optional[int] = None) -> ScenarioSpec:

@@ -28,8 +28,13 @@ import hashlib
 import json
 import os
 import tempfile
+import time
 from typing import Dict, List, Optional, Sequence
 
+from cruise_control_tpu.analyzer.precompute import (
+    CircuitBreaker,
+    ProposalPrecomputingExecutor,
+)
 from cruise_control_tpu.bootstrap import _capacity_for
 from cruise_control_tpu.detector.anomalies import AnomalyType
 from cruise_control_tpu.detector.detectors import MaintenanceEventReader
@@ -48,6 +53,8 @@ from cruise_control_tpu.monitor.sampling import (
     MetricsTopic,
     SimulatedMetricsReporter,
 )
+from cruise_control_tpu.server.http_server import CruiseControlHttpServer
+from cruise_control_tpu.server.user_tasks import UserTaskManager
 from cruise_control_tpu.sim.backend import ScriptedClusterBackend
 from cruise_control_tpu.sim.timeline import Timeline, TimelineEvent
 from cruise_control_tpu.sim.workload import ScenarioWorkload
@@ -69,9 +76,11 @@ HARD_DETECTION_GOALS = (
 )
 
 #: journal fields that carry wall-clock (not virtual) time — stripped by
-#: the determinism fingerprint, kept everywhere else
+#: the determinism fingerprint, kept everywhere else.  latencyMs/elapsedS
+#: ride the serving-chaos events (sim.http / sim.http_slow_client);
+#: cacheAgeS rides proposals responses (all wall-clock).
 _VOLATILE_KEYS = ("ts",)
-_VOLATILE_PAYLOAD_KEYS = ("durationS",)
+_VOLATILE_PAYLOAD_KEYS = ("durationS", "latencyMs", "elapsedS", "cacheAgeS")
 
 
 @dataclasses.dataclass
@@ -119,6 +128,23 @@ class ScenarioSpec:
     task_retry_jitter_ticks: int = 1
     dest_exclusion_threshold: int = 0
     watchdog_stuck_ticks: int = 0
+    # serving-layer chaos knobs (ISSUE 8): a REAL CruiseControlHttpServer
+    # in front of the facade, driven by http_request/request_storm/
+    # slow_client timeline events — off by default
+    serve_http: bool = False
+    http_get_concurrent: int = 8
+    http_compute_concurrent: int = 2
+    http_queue_size: int = 4
+    http_queue_timeout_ms: int = 500
+    #: wall-clock per-connection read timeout (slow-loris reaping)
+    http_read_timeout_ms: int = 5_000
+    #: >0: run one synchronous proposal-precompute pass every N ticks
+    #: (the daemon's loop, driven deterministically by the virtual clock)
+    precompute_interval_ticks: int = 0
+    #: >0: attach an analyzer CircuitBreaker with this failure threshold,
+    #: clocked on VIRTUAL time so trip/reset timing is deterministic
+    breaker_failures: int = 0
+    breaker_reset_ms: int = 4 * MIN_MS
 
     def healing_enables(self) -> Dict[AnomalyType, bool]:
         return {
@@ -199,6 +225,25 @@ class ScenarioResult:
         return [e.get("payload", {})
                 for e in self.events_of("executor.resume")]
 
+    def http_responses(self, endpoint: Optional[str] = None) -> List[dict]:
+        """``sim.http`` payloads (one per scripted request), optionally
+        filtered by endpoint."""
+        out = [e.get("payload", {}) for e in self.events_of("sim.http")]
+        if endpoint is not None:
+            out = [p for p in out if p.get("endpoint") == endpoint]
+        return out
+
+    def storms(self) -> List[dict]:
+        """``sim.http_storm`` payloads: aggregated concurrent-client
+        results."""
+        return [e.get("payload", {})
+                for e in self.events_of("sim.http_storm")]
+
+    def breaker_transitions(self) -> List[dict]:
+        """``analyzer.breaker`` payloads in journal order."""
+        return [e.get("payload", {})
+                for e in self.events_of("analyzer.breaker")]
+
     def heal_outcome(self) -> str:
         """Classify the run from the journal alone: HEALED / FIX_FAILED /
         ALERT_ONLY / SUPPRESSED / UNHEALED / NO_ANOMALY.
@@ -276,6 +321,23 @@ def _scenario_journal(ring_size: int = 1 << 15):
         events.JOURNAL = prev
 
 
+def _script_analyzer_outage(cc) -> None:
+    """Swap the facade's engine factory for one that always fails — the
+    scripted analyzer outage (the serving layer's chaos seam; the cluster
+    seams stay the backend/workload as ever)."""
+
+    class _FailingOptimizer:
+        def optimize(self, state, options=None):
+            raise RuntimeError("scripted analyzer outage")
+
+    cc._make_engine = lambda engine, constraint=None: _FailingOptimizer()
+
+
+def _restore_analyzer(cc) -> None:
+    if "_make_engine" in cc.__dict__:
+        del cc.__dict__["_make_engine"]
+
+
 class _Sim:
     """The assembled stack plus scripting state for one run.
 
@@ -333,6 +395,16 @@ class _Sim:
         self.process_up = True
         #: metric-gap windows [(start_ms, end_ms)), virtual
         self.gaps: List[tuple] = []
+        #: the virtual clock, readable by injected clocks (the breaker)
+        self.now_ms = 0
+        #: scripted analyzer failure window (analyzer_outage event);
+        #: survives restarts — the outage outlives the process
+        self.analyzer_down = False
+        #: deterministic User-Task-ID source (uuid4 would make every
+        #: journal fingerprint unreproducible)
+        self._task_seq = 0
+        self.server: Optional[CruiseControlHttpServer] = None
+        self.precompute: Optional[ProposalPrecomputingExecutor] = None
         self._build_control_plane()
 
     def _build_control_plane(self) -> None:
@@ -376,12 +448,22 @@ class _Sim:
             ),
             journal=journal,
         )
+        breaker = None
+        if spec.breaker_failures > 0:
+            # virtual-clock breaker: trip/reset timing is deterministic
+            breaker = CircuitBreaker(
+                failure_threshold=spec.breaker_failures,
+                reset_s=spec.breaker_reset_ms / 1000.0,
+                clock=lambda: self.now_ms / 1000.0,
+            )
         # a private registry: scenario runs must not pollute the process
         # default the server / other tests read
         self.cc = CruiseControl(
             self.monitor, self.executor, engine="greedy",
-            registry=MetricRegistry(),
+            registry=MetricRegistry(), breaker=breaker,
         )
+        if self.analyzer_down:
+            _script_analyzer_outage(self.cc)
         self.manager = make_detector_manager(
             self.cc,
             backend=self.backend,
@@ -405,20 +487,148 @@ class _Sim:
             detection_interval_ms=spec.detection_interval_ms,
             fix_cooldown_ms=spec.fix_cooldown_ms,
         )
+        if spec.serve_http:
+            # the REAL front door: one worker thread + a deterministic
+            # task-id counter keep sequential-request journals
+            # bit-reproducible (concurrent storms opt out of fingerprints)
+            def next_task_id() -> str:
+                self._task_seq += 1
+                return f"sim-task-{self._task_seq}"
+
+            self.server = CruiseControlHttpServer(
+                self.cc, port=0, access_log=False,
+                user_task_manager=UserTaskManager(
+                    max_workers=1, id_factory=next_task_id,
+                ),
+                get_max_concurrent=spec.http_get_concurrent,
+                compute_max_concurrent=spec.http_compute_concurrent,
+                admission_queue_size=spec.http_queue_size,
+                admission_queue_timeout_s=(
+                    spec.http_queue_timeout_ms / 1000.0
+                ),
+                read_timeout_s=spec.http_read_timeout_ms / 1000.0,
+                drain_timeout_s=2.0,
+            )
+            self.server.start()
+        if spec.precompute_interval_ticks > 0:
+            # built but never start()ed: run_scenario drives refresh_once
+            # synchronously on the virtual clock
+            self.precompute = ProposalPrecomputingExecutor(self.cc)
 
     def crash(self) -> None:
+        """SIGKILL semantics: the front door vanishes with the process —
+        no drain, no task-pool shutdown, connections just die."""
         self.process_up = False
+        self._halt_server()
+
+    def _halt_server(self) -> None:
+        if self.server is not None and self.server._httpd is not None:
+            self.server._httpd.shutdown()
+            self.server._httpd.server_close()
+            self.server._httpd = None
+
+    def stop_serving(self) -> None:
+        """End-of-scenario teardown (graceful, unlike crash)."""
+        if self.server is not None:
+            if self.server._httpd is not None:
+                self.server.stop()
+            self.server = None
 
     def restart(self) -> None:
         """The 'new process': fresh monitor windows, fresh detector state,
         fresh executor — then the facade's checkpoint recovery path, which
         resumes whatever the dead process left in flight."""
+        self._halt_server()
         self._build_control_plane()
         self.cc.recover_execution()
         self.process_up = True
 
     def in_gap(self, now_ms: int) -> bool:
         return any(start <= now_ms < end for start, end in self.gaps)
+
+    # ---- HTTP drivers (serving-layer chaos) -------------------------------------
+    def _request(self, method: str, endpoint: str, params: Dict[str, str],
+                 deadline_ms: Optional[int] = None,
+                 timeout_s: float = 60.0) -> dict:
+        """One real HTTP request; returns {status, retryAfter, body} with
+        status 0 when the process/server is unreachable (crashed)."""
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        if self.server is None:
+            raise RuntimeError("scenario spec must set serve_http=True")
+        params = dict(params)
+        if method == "POST" and endpoint not in ("stop_proposal_execution",
+                                                 "pause_sampling",
+                                                 "resume_sampling", "admin",
+                                                 "review", "train"):
+            # long-poll: the virtual clock must not advance while an async
+            # operation is mid-flight — the tick blocks on the result
+            params.setdefault("get_response_timeout_s", "55")
+        if endpoint == "health":
+            url = f"http://127.0.0.1:{self.server.port}/health"
+        else:
+            url = f"{self.server.url}/{endpoint}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        headers = {}
+        if deadline_ms is not None:
+            headers["deadline-ms"] = str(deadline_ms)
+        req = urllib.request.Request(
+            url, method=method, headers=headers,
+            data=b"" if method == "POST" else None,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                raw = resp.read()
+                status, hdrs = resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status, hdrs = e.code, dict(e.headers)
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return {"status": 0, "retryAfter": None, "body": {}}
+        try:
+            body = json.loads(raw) if raw else {}
+        except ValueError:
+            body = {}
+        return {
+            "status": status,
+            "retryAfter": hdrs.get("Retry-After"),
+            "body": body,
+        }
+
+    def _slow_client_probe(self, hold_s: float) -> dict:
+        """Open a connection, trickle a partial request, and report
+        whether the server reaped it within the wall-clock bound."""
+        import socket
+
+        if self.server is None:
+            raise RuntimeError("scenario spec must set serve_http=True")
+        t0 = time.monotonic()
+        closed = False
+        with socket.create_connection(
+            ("127.0.0.1", self.server.port), timeout=hold_s + 5
+        ) as sock:
+            sock.sendall(b"GET " + self.server.prefix.encode()
+                         + b"/state HTTP/1.1\r\nHost: sim\r\n")
+            # never finish the headers; the read timeout must reap us
+            deadline = time.monotonic() + hold_s + 3
+            sock.settimeout(0.25)
+            while time.monotonic() < deadline:
+                try:
+                    if sock.recv(4096) == b"":
+                        closed = True
+                        break
+                except TimeoutError:
+                    continue
+                except (ConnectionError, OSError):
+                    closed = True
+                    break
+        return {
+            "closed": closed,
+            "elapsedS": round(time.monotonic() - t0, 3),
+        }
 
 
 def _apply_event(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
@@ -466,6 +676,28 @@ def _apply_event(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
             ev.arg("broker"), ev.arg("down_ticks"), ev.arg("up_ticks"),
             ev.arg("cycles"),
         )
+    elif ev.kind == "analyzer_outage":
+        sim.analyzer_down = True
+        _script_analyzer_outage(sim.cc)
+    elif ev.kind == "restore_analyzer":
+        sim.analyzer_down = False
+        _restore_analyzer(sim.cc)
+    elif ev.kind == "http_request":
+        events.emit("sim.fault", fault=ev.kind, virtualMs=now_ms,
+                    atMs=ev.at_ms, args=dict(ev.args))
+        _apply_http_request(sim, ev, now_ms)
+        return
+    elif ev.kind == "request_storm":
+        events.emit("sim.fault", fault=ev.kind, virtualMs=now_ms,
+                    atMs=ev.at_ms, args=dict(ev.args))
+        _apply_request_storm(sim, ev, now_ms)
+        return
+    elif ev.kind == "slow_client":
+        probe = sim._slow_client_probe(ev.arg("hold_s"))
+        events.emit("sim.fault", fault=ev.kind, virtualMs=now_ms,
+                    atMs=ev.at_ms, args=dict(ev.args))
+        events.emit("sim.http_slow_client", virtualMs=now_ms, **probe)
+        return
     elif ev.kind == "restart_process":
         # the fault marker goes first so the journal reads operator-style:
         # restart → recovery.start → executor.resume → recovery.end
@@ -481,6 +713,76 @@ def _apply_event(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
     events.emit(
         "sim.fault", fault=ev.kind, virtualMs=now_ms, atMs=ev.at_ms,
         args=dict(ev.args), **detail,
+    )
+
+
+def _apply_http_request(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
+    """One synchronous request; the response becomes a ``sim.http``
+    journal event.  A 500 carrying the armed ProcessCrash means the
+    control plane died mid-request — the sim marks the process down
+    exactly as it does for a crash inside the detection cycle."""
+    if not sim.process_up:
+        res = {"status": 0, "retryAfter": None, "body": {}}
+    else:
+        t0 = time.monotonic()
+        res = sim._request(
+            ev.arg("method", "GET"), ev.arg("endpoint"),
+            dict(ev.arg("params", ())),
+            deadline_ms=ev.arg("deadline_ms"),
+        )
+        res["latencyMs"] = round((time.monotonic() - t0) * 1000, 3)
+    body = res.pop("body", {}) or {}
+    err = body.get("errorMessage")
+    events.emit(
+        "sim.http", virtualMs=now_ms,
+        endpoint=ev.arg("endpoint"), method=ev.arg("method", "GET"),
+        status=res["status"], retryAfter=res.get("retryAfter"),
+        cached=body.get("cached"), stale=body.get("stale"),
+        ready=body.get("ready"),
+        latencyMs=res.get("latencyMs"),
+        error=(str(err)[:120] if err else None),
+    )
+    if res["status"] == 500 and err and "ProcessCrash" in str(err):
+        sim.crash()
+        events.emit("sim.crash", severity="ERROR", virtualMs=now_ms)
+
+
+def _apply_request_storm(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
+    """N concurrent clients; ONE aggregated journal event (per-request
+    ordering under concurrency is nondeterministic by nature)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    n = ev.arg("n")
+    method = ev.arg("method", "GET")
+    endpoint = ev.arg("endpoint")
+    params = dict(ev.arg("params", ()))
+
+    def one(_: int) -> dict:
+        return sim._request(method, endpoint, dict(params))
+
+    with ThreadPoolExecutor(max_workers=n) as pool:
+        results = list(pool.map(one, range(n)))
+    status_counts: Dict[str, int] = {}
+    shed_with_retry = shed_without_retry = server_errors = ok = 0
+    for r in results:
+        status_counts[str(r["status"])] = \
+            status_counts.get(str(r["status"]), 0) + 1
+        if r["status"] in (429, 503):
+            if r.get("retryAfter"):
+                shed_with_retry += 1
+            else:
+                shed_without_retry += 1
+        elif r["status"] >= 500 or r["status"] == 0:
+            server_errors += 1
+        elif 200 <= r["status"] < 300:
+            ok += 1
+    events.emit(
+        "sim.http_storm", virtualMs=now_ms, endpoint=endpoint,
+        method=method, clients=n,
+        statusCounts={k: status_counts[k] for k in sorted(status_counts)},
+        admitted=ok, shedWithRetryAfter=shed_with_retry,
+        shedMissingRetryAfter=shed_without_retry,
+        unhandled5xx=server_errors,
     )
 
 
@@ -505,6 +807,7 @@ def run_scenario(spec: ScenarioSpec) -> ScenarioResult:
         while now < spec.duration_ms:
             now += spec.tick_ms
             ticks += 1
+            sim.now_ms = now  # injected clocks (the breaker) read this
             for ev in spec.timeline.pop_due(now):
                 _apply_event(sim, ev, now)
             sim.workload.advance(now)
@@ -522,10 +825,15 @@ def run_scenario(spec: ScenarioSpec) -> ScenarioResult:
                     sim.crash()
                     events.emit("sim.crash", severity="ERROR",
                                 virtualMs=now)
+                if (sim.process_up and sim.precompute is not None
+                        and ticks % spec.precompute_interval_ticks == 0):
+                    # the precompute daemon's loop, on the virtual clock
+                    sim.precompute.refresh_once()
             else:
                 # the process is down but the cluster lives on: in-flight
                 # reassignments keep progressing, brokers keep flapping
                 sim.backend.tick()
+        sim.stop_serving()  # graceful drain (journaled) before the end mark
         events.emit(
             "sim.scenario_end", name=spec.name, virtualMs=now, ticks=ticks,
             actionCounts=sim.manager.action_counts(),

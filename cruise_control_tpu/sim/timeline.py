@@ -54,6 +54,13 @@ faults the executor must survive):
     execution has moves in flight).  ``broker=None`` flaps whichever broker
     is catching up replicas when the flapping starts — the executor's
     timeout → retry-with-backoff path.
+``http_request`` / ``request_storm`` / ``slow_client``
+    Serving-layer chaos (ISSUE 8): real HTTP requests against the
+    scenario's front door — one synchronous request, N concurrent
+    clients, or a slow-loris connection probe.
+``analyzer_outage`` / ``restore_analyzer``
+    Scripted analyzer failure window: every optimization raises until
+    restored — degraded-mode serving + circuit-breaker territory.
 """
 
 from __future__ import annotations
@@ -79,6 +86,11 @@ KINDS = (
     "crash_process",
     "restart_process",
     "flap_broker",
+    "http_request",
+    "request_storm",
+    "slow_client",
+    "analyzer_outage",
+    "restore_analyzer",
 )
 
 
@@ -210,6 +222,64 @@ def flap_broker(
         down_ticks=int(down_ticks), up_ticks=int(up_ticks),
         cycles=int(cycles),
     )
+
+
+# ---- serving-layer chaos (ISSUE 8): requests as timeline events -----------------
+def http_request(
+    at_ms: int,
+    endpoint: str,
+    method: str = "GET",
+    params: Optional[Dict[str, str]] = None,
+    deadline_ms: Optional[int] = None,
+) -> TimelineEvent:
+    """One REAL HTTP request against the scenario's front door, issued
+    synchronously at the virtual timestamp (the spec must set
+    ``serve_http=True``).  The response is journaled as ``sim.http``
+    (status, Retry-After presence, cached/stale markers)."""
+    return _event(
+        at_ms, "http_request", endpoint=str(endpoint),
+        method=method.upper(),
+        params=tuple(sorted((params or {}).items())),
+        deadline_ms=int(deadline_ms) if deadline_ms is not None else None,
+    )
+
+
+def request_storm(
+    at_ms: int,
+    n: int,
+    endpoint: str,
+    method: str = "GET",
+    params: Optional[Dict[str, str]] = None,
+) -> TimelineEvent:
+    """``n`` concurrent clients hitting one endpoint at once.  Per-request
+    results are aggregated into ONE ``sim.http_storm`` journal event
+    (status counts, sheds with/without Retry-After, unhandled 5xx) —
+    concurrency makes per-request journal order nondeterministic, so storm
+    scenarios stay out of the bit-fingerprinted smoke set."""
+    return _event(
+        at_ms, "request_storm", n=int(n), endpoint=str(endpoint),
+        method=method.upper(),
+        params=tuple(sorted((params or {}).items())),
+    )
+
+
+def slow_client(at_ms: int, hold_s: float = 2.0) -> TimelineEvent:
+    """A slow-loris probe: open a raw connection, trickle a partial
+    request, and verify the server reaps the connection within its
+    read timeout instead of pinning a handler thread (``hold_s`` bounds
+    the wall-clock wait for the reap)."""
+    return _event(at_ms, "slow_client", hold_s=float(hold_s))
+
+
+def analyzer_outage(at_ms: int) -> TimelineEvent:
+    """From this point every optimization raises (scripted analyzer
+    failure): proposal serving must degrade to the last-good cached plan
+    and the circuit breaker must trip after repeated failures."""
+    return _event(at_ms, "analyzer_outage")
+
+
+def restore_analyzer(at_ms: int) -> TimelineEvent:
+    return _event(at_ms, "restore_analyzer")
 
 
 class Timeline:

@@ -268,6 +268,59 @@ RULE_FIXTURES = {
             "        time.sleep(0.5)\n",
         ],
     },
+    "bounded-resource": {
+        "positive": [
+            # unbounded deque: overload becomes memory growth, not
+            # backpressure
+            "from collections import deque\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.buffer = deque()\n",
+            # Queue() with no maxsize (module-qualified)
+            "import queue\n"
+            "def make():\n"
+            "    return queue.Queue()\n",
+            # SimpleQueue has no bound at all
+            "import queue\n"
+            "def make():\n"
+            "    return queue.SimpleQueue()\n",
+            # pool with the implicit cpu-scaled default worker count
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def pool():\n"
+            "    return ThreadPoolExecutor()\n",
+            # an explicit None bound is still unbounded
+            "from collections import deque\n"
+            "def ring():\n"
+            "    return deque([], None)\n",
+        ],
+        "negative": [
+            # bounds as keywords (values may be variables)
+            "from collections import deque\n"
+            "def ring(n):\n"
+            "    return deque(maxlen=n)\n",
+            "import queue\n"
+            "def make(cap):\n"
+            "    return queue.Queue(maxsize=cap)\n",
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def pool(n):\n"
+            "    return ThreadPoolExecutor(max_workers=n)\n",
+            # positional bounds count too
+            "import queue\n"
+            "def make():\n"
+            "    return queue.Queue(128)\n",
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def pool():\n"
+            "    return ThreadPoolExecutor(4)\n",
+            # **kwargs may carry the bound — benefit of the doubt
+            "from collections import deque\n"
+            "def ring(**kw):\n"
+            "    return deque(**kw)\n",
+            # attribute chains that merely end in a matching name are
+            # out of scope (factory.pools.Queue() is not queue.Queue)
+            "def make(factory):\n"
+            "    return factory.pools.Queue()\n",
+        ],
+    },
     "swallowed-exception": {
         "positive": [
             "def loop(work):\n"

@@ -364,8 +364,20 @@ def test_metrics_exposes_compile_and_anomaly_action_families(diag_server):
     )
     assert ignore and float(ignore.group(1)) >= 1.0, body[:2000]
     assert "cc_jax_live_buffers" in body
-    # request timers emit buckets (the migrated HTTP timer family)
-    body2, _ = _get(diag_server, "metrics")
+    # request timers emit buckets (the migrated HTTP timer family).  The
+    # endpoint timer is updated in the handler's `finally` AFTER the
+    # response bytes are flushed, so an immediate re-GET can render the
+    # exposition before the first request's update lands on a busy box —
+    # poll briefly instead of racing it.
+    import time as time_mod
+
+    deadline = time_mod.time() + 5.0
+    body2 = ""
+    while time_mod.time() < deadline:
+        body2, _ = _get(diag_server, "metrics")
+        if "cc_http_GET_metrics_seconds_bucket" in body2:
+            break
+        time_mod.sleep(0.05)
     assert "cc_http_GET_metrics_seconds_bucket" in body2
 
 

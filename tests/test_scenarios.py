@@ -9,7 +9,7 @@ true story, which is what an operator reconstructing an incident has.
 
 Tier-1 runs the SMOKE subset plus the determinism and artifact contracts;
 the full ≥10-scenario matrix is ``slow`` (the committed
-``SCENARIOS_r08.json`` artifact keeps its outcomes honest in every run).
+``SCENARIOS_r09.json`` artifact keeps its outcomes honest in every run).
 The crash/resume scenarios (ISSUE 7) prove — from the journal alone —
 that a process crash mid-execution resumes without re-moving completed
 partitions.
@@ -39,7 +39,7 @@ from cruise_control_tpu.sim.timeline import (
 from test_artifact_schemas import SCHEMAS, validate
 
 MIN = MIN_MS
-ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r08.json"
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r09.json"
 
 #: the outcome each scripted timeline must reach — also pinned against the
 #: committed artifact below, so a regression shows up in tier-1 without
@@ -61,6 +61,10 @@ EXPECTED_OUTCOMES = {
     "crash_completes_while_down": "HEALED",
     "crash_recovery_replans_dead_destination": "HEALED",
     "flapping_destination_retries": "HEALED",
+    "degraded_serving_survives_analyzer_outage": "NO_ANOMALY",
+    "request_storm_sheds_with_retry_after": "NO_ANOMALY",
+    "slow_loris_connection_reaped": "NO_ANOMALY",
+    "crash_mid_request_recovers_front_door": "HEALED",
 }
 
 _cache = {}
@@ -263,6 +267,64 @@ def _check_flapping_destination_retries(r):
                 if e["payload"].get("reason") == "timeout"]
 
 
+# ---- overload-safe serving (ISSUE 8): journal-only front-door proofs -----------
+def _check_degraded_serving_survives_analyzer_outage(r):
+    reqs = r.http_responses("proposals")
+    # every proposals request answered 200 across the whole outage —
+    # degraded, never broken
+    assert [p["status"] for p in reqs] == [200, 200, 200, 200]
+    assert [bool(p["stale"]) for p in reqs] == [False, True, True, False]
+    # the breaker's full story, read from the journal alone:
+    # trip → half-open probe → close
+    assert [p["state"] for p in r.breaker_transitions()] == \
+        ["OPEN", "HALF_OPEN", "CLOSED"]
+    assert r.events_of("proposals.served_stale")
+    # scripted analyzer failures are on the record (the why of the trip)
+    assert any("scripted analyzer outage" in str(e["payload"].get("error"))
+               for e in r.events_of("optimize.failed"))
+    assert r.http_responses("health")[-1]["ready"] is True
+
+
+def _check_request_storm_sheds_with_retry_after(r):
+    get_storm, post_storm = r.storms()
+    for storm in (get_storm, post_storm):
+        # THE shedding contract: overflow is shed with Retry-After, the
+        # admitted requests complete, nothing 5xxes
+        assert storm["admitted"] >= 1
+        assert storm["shedWithRetryAfter"] > 0
+        assert storm["shedMissingRetryAfter"] == 0
+        assert storm["unhandled5xx"] == 0
+    assert get_storm["clients"] == 16 and post_storm["clients"] == 8
+    # server-side shed decisions are journaled too
+    assert r.events_of("http.request_shed")
+    # and the front door stays healthy afterwards
+    assert r.http_responses("health")[-1]["ready"] is True
+
+
+def _check_slow_loris_connection_reaped(r):
+    (probe,) = [e["payload"] for e in r.events_of("sim.http_slow_client")]
+    assert probe["closed"] is True
+    # a normal request issued alongside the loris is served untouched
+    (state_req,) = r.http_responses("state")
+    assert state_req["status"] == 200
+    assert r.http_responses("health")[-1]["ready"] is True
+
+
+def _check_crash_mid_request_recovers_front_door(r):
+    (req,) = r.http_responses("rebalance")
+    # the crashed request fails EXPLICITLY (500 naming the crash), not by
+    # hanging the client forever
+    assert req["status"] == 500 and "ProcessCrash" in str(req["error"])
+    assert len(r.events_of("sim.crash")) == 1
+    # the front door is dark while the process is down, ready again after
+    # the restart's checkpoint recovery
+    health = r.http_responses("health")
+    assert [p["status"] for p in health] == [0, 200]
+    assert health[-1]["ready"] is True
+    (recovery,) = r.recoveries()
+    assert recovery["outcome"] == "resumed" and recovery["succeeded"]
+
+
 CHECKS = {
     "broker_death_mid_execution": _check_broker_death_mid_execution,
     "rack_loss": _check_rack_loss,
@@ -282,6 +344,13 @@ CHECKS = {
     "crash_recovery_replans_dead_destination":
         _check_crash_recovery_replans_dead_destination,
     "flapping_destination_retries": _check_flapping_destination_retries,
+    "degraded_serving_survives_analyzer_outage":
+        _check_degraded_serving_survives_analyzer_outage,
+    "request_storm_sheds_with_retry_after":
+        _check_request_storm_sheds_with_retry_after,
+    "slow_loris_connection_reaped": _check_slow_loris_connection_reaped,
+    "crash_mid_request_recovers_front_door":
+        _check_crash_mid_request_recovers_front_door,
 }
 
 
@@ -366,9 +435,9 @@ def test_live_artifact_matches_schema():
 
 
 def test_committed_artifact_is_current():
-    """SCENARIOS_r08.json (the CLI's output) must cover the whole registry
+    """SCENARIOS_r09.json (the CLI's output) must cover the whole registry
     with the expected heal outcomes — regenerate it via
-    ``python -m cruise_control_tpu.sim --artifact SCENARIOS_r08.json``
+    ``python -m cruise_control_tpu.sim --artifact SCENARIOS_r09.json``
     whenever scenarios change."""
     art = json.loads(ARTIFACT_PATH.read_text())
     validate(art, SCHEMAS["cc-tpu-scenarios/1"])
@@ -391,7 +460,7 @@ def test_smoke_scenarios_match_committed_artifact():
         r = result_for(name)
         assert r.fingerprint() == by_name[name]["journalFingerprint"], (
             f"{name}: journal drifted from the committed artifact — "
-            "behavior changed; regenerate SCENARIOS_r08.json and review"
+            "behavior changed; regenerate SCENARIOS_r09.json and review"
         )
 
 

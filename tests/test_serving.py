@@ -1,0 +1,502 @@
+"""Overload-safe serving tests (ISSUE 8): admission control, request
+deadlines, the warm proposal cache + degraded-mode serving, the analyzer
+circuit breaker, /health, raw-HTTP hardening (413, slow-loris), and
+graceful drain.
+
+Server-level behavior under real concurrency is exercised end-to-end by
+the serving-chaos scenarios (``tests/test_scenarios.py``) and the load
+harness (``benchmarks/serve_load.py``); the tests here pin the unit
+contracts those runs compose."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.analyzer.precompute import (
+    AnalyzerSaturatedError,
+    CircuitBreaker,
+)
+from cruise_control_tpu.server import admission
+from cruise_control_tpu.server.admission import (
+    CLASS_COMPUTE,
+    CLASS_GET,
+    AdmissionController,
+    DeadlineExceededError,
+    RequestShedError,
+)
+from cruise_control_tpu.server.http_server import CruiseControlHttpServer
+from cruise_control_tpu.server.user_tasks import UserTaskManager
+
+from harness import full_stack
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+class _FailingOptimizer:
+    def optimize(self, state, options=None):
+        raise RuntimeError("scripted analyzer failure")
+
+
+def _fail_analyzer(cc):
+    cc._make_engine = lambda engine, constraint=None: _FailingOptimizer()
+
+
+def _restore_analyzer(cc):
+    cc.__dict__.pop("_make_engine", None)
+
+
+# ---- admission controller --------------------------------------------------------
+class TestAdmission:
+    def test_admits_within_limit(self):
+        ctl = AdmissionController({CLASS_GET: 2}, queue_size=0)
+        with ctl.admit(CLASS_GET):
+            with ctl.admit(CLASS_GET):
+                assert ctl.active(CLASS_GET) == 2
+        assert ctl.active(CLASS_GET) == 0
+        assert ctl.admitted_total == 2
+
+    def test_queue_full_sheds_with_retry_after(self):
+        ctl = AdmissionController({CLASS_GET: 1}, queue_size=0,
+                                  retry_after_s=7)
+        with ctl.admit(CLASS_GET):
+            with pytest.raises(RequestShedError) as e:
+                with ctl.admit(CLASS_GET):
+                    pass
+        assert e.value.retry_after_s == 7
+        assert ctl.shed_total == 1
+
+    def test_queue_timeout_sheds(self):
+        ctl = AdmissionController({CLASS_GET: 1}, queue_size=4,
+                                  queue_timeout_s=0.05)
+        with ctl.admit(CLASS_GET):
+            t0 = time.perf_counter()
+            with pytest.raises(RequestShedError):
+                with ctl.admit(CLASS_GET):
+                    pass
+            assert time.perf_counter() - t0 < 2.0
+
+    def test_queued_request_runs_when_slot_frees(self):
+        ctl = AdmissionController({CLASS_COMPUTE: 1}, queue_size=4,
+                                  queue_timeout_s=5.0)
+        entered = threading.Event()
+        release = threading.Event()
+        ran = []
+
+        def holder():
+            with ctl.admit(CLASS_COMPUTE):
+                entered.set()
+                release.wait(timeout=10)
+
+        def waiter():
+            with ctl.admit(CLASS_COMPUTE):
+                ran.append(True)
+
+        t1 = threading.Thread(target=holder)
+        t1.start()
+        assert entered.wait(timeout=5)
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        time.sleep(0.05)
+        assert ctl.queued() == 1
+        release.set()
+        t2.join(timeout=5)
+        t1.join(timeout=5)
+        assert ran == [True]
+
+    def test_drain_sheds_queued_waiters_and_joins_inflight(self):
+        ctl = AdmissionController({CLASS_GET: 1}, queue_size=4,
+                                  queue_timeout_s=30.0)
+        release = threading.Event()
+        entered = threading.Event()
+        outcomes = []
+
+        def holder():
+            with ctl.track(), ctl.admit(CLASS_GET):
+                entered.set()
+                release.wait(timeout=10)
+
+        def waiter():
+            try:
+                with ctl.track(), ctl.admit(CLASS_GET):
+                    outcomes.append("ran")
+            except RequestShedError as e:
+                outcomes.append(str(e))
+
+        t1 = threading.Thread(target=holder)
+        t1.start()
+        assert entered.wait(timeout=5)
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        time.sleep(0.05)
+        # the holder is still in flight: drain sheds the waiter instantly
+        # but must wait for (and report) the in-flight request
+        done = []
+        t3 = threading.Thread(
+            target=lambda: done.append(ctl.drain(timeout_s=5.0)))
+        t3.start()
+        t2.join(timeout=5)
+        assert outcomes and "draining" in outcomes[0]
+        release.set()
+        t3.join(timeout=10)
+        t1.join(timeout=5)
+        assert done == [True]
+        with pytest.raises(RequestShedError):
+            with ctl.admit(CLASS_GET):
+                pass
+
+
+# ---- request deadlines -----------------------------------------------------------
+class TestDeadlines:
+    def test_scope_nesting_keeps_tighter_deadline(self):
+        now = time.monotonic()
+        with admission.deadline_scope(now + 10):
+            with admission.deadline_scope(now + 5):
+                assert admission.remaining_s() < 6
+            with admission.deadline_scope(now + 50):
+                # the outer, tighter deadline wins
+                assert admission.remaining_s() < 11
+        assert admission.remaining_s() is None
+
+    def test_expired_deadline_rejects_operation_before_analyzer(self):
+        cc, _, _ = full_stack()
+        with admission.deadline_scope(time.monotonic() - 0.1):
+            with pytest.raises(DeadlineExceededError):
+                cc.rebalance(dryrun=True)
+
+    def test_near_expiry_clips_tpu_anytime_budget(self):
+        cc, _, _ = full_stack()
+        with admission.deadline_scope(time.monotonic() + 5.0):
+            engine = cc._make_engine("tpu")
+        assert 0 < engine.config.time_budget_s <= 5.0
+        # no deadline -> no budget injected
+        engine = cc._make_engine("tpu")
+        assert engine.config is None or not engine.config.time_budget_s
+
+    def test_worker_skips_task_whose_deadline_passed(self):
+        mgr = UserTaskManager(max_workers=1)
+        ran = []
+        task = mgr.submit("rebalance", lambda p: ran.append(True),
+                          deadline_monotonic=time.monotonic() - 1.0)
+        with pytest.raises(DeadlineExceededError):
+            task.future.result(timeout=5)
+        assert not ran and task.state == "CompletedWithError"
+        mgr.shutdown()
+
+    def test_expired_deadline_maps_to_503_with_retry_after(self):
+        """End to end: the worker pool is busy, the queued task's deadline
+        expires before it starts, the long-poll answer is a 503 shed."""
+        cc, _, _ = full_stack()
+        release = threading.Event()
+        mgr = UserTaskManager(max_workers=1)
+        srv = CruiseControlHttpServer(cc, port=0, user_task_manager=mgr)
+        srv.start()
+        try:
+            mgr.submit("blocker", lambda p: release.wait(timeout=30))
+            code, headers, body = self._post(
+                srv, "rebalance", {"dryrun": "true",
+                                   "get_response_timeout_s": "10"},
+                headers={"deadline-ms": "200"}, release=release,
+            )
+            assert code == 503
+            assert "Retry-After" in headers
+            assert "deadline" in body["errorMessage"].lower()
+        finally:
+            release.set()
+            srv.stop()
+
+    @staticmethod
+    def _post(srv, endpoint, params, headers, release):
+        import urllib.parse
+
+        url = f"{srv.url}/{endpoint}?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, method="POST", data=b"",
+                                     headers=headers)
+
+        # free the worker only after the deadline passed, so the queued
+        # task deterministically starts dead
+        def _free():
+            time.sleep(0.5)
+            release.set()
+
+        threading.Thread(target=_free, daemon=True).start()
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, dict(r.headers), json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read())
+
+
+# ---- warm proposal cache + degraded serving --------------------------------------
+class TestProposalCache:
+    def test_generation_bump_invalidates(self):
+        cc, _, reporter = full_stack()
+        cc.get_proposals()
+        assert cc.proposal_cache_fresh()
+        result, meta = cc.serve_proposals()
+        assert meta["cached"] is True and meta["stale"] is False
+        # a new metric window = a new model generation: the plan is stale
+        reporter.report(time_ms=3500)
+        cc.load_monitor.run_sampling_iteration(4000)
+        assert not cc.proposal_cache_fresh()
+        _, meta = cc.serve_proposals()
+        assert meta["cached"] is False  # recomputed against the new model
+        assert cc.proposal_cache_fresh()
+
+    def test_anomaly_invalidates_and_marks_reason(self):
+        from types import SimpleNamespace
+
+        from cruise_control_tpu.detector.anomalies import AnomalyType
+
+        cc, _, _ = full_stack()
+        cc.get_proposals()
+        assert cc.proposal_cache_fresh()
+        cc.note_anomaly(SimpleNamespace(
+            anomaly_type=AnomalyType.BROKER_FAILURE))
+        assert not cc.proposal_cache_fresh()
+        state = cc.proposal_cache_state()
+        assert state["cacheInvalidated"] == "anomaly:BROKER_FAILURE"
+
+    def test_degrades_to_stale_on_analyzer_failure(self):
+        cc, _, _ = full_stack()
+        cc.get_proposals()
+        baseline = cc.proposal_cache_state()["cacheGeneration"]
+        from types import SimpleNamespace
+
+        from cruise_control_tpu.detector.anomalies import AnomalyType
+
+        cc.note_anomaly(SimpleNamespace(
+            anomaly_type=AnomalyType.GOAL_VIOLATION))
+        _fail_analyzer(cc)
+        result, meta = cc.serve_proposals()
+        assert meta["stale"] is True
+        assert meta["proposalGeneration"] == baseline
+        assert meta["staleReason"] == "anomaly:GOAL_VIOLATION"
+        # an explicit opt-out gets the real failure instead
+        with pytest.raises(RuntimeError):
+            cc.serve_proposals(allow_stale=False)
+        _restore_analyzer(cc)
+
+    def test_cold_cache_failure_still_raises(self):
+        cc, _, _ = full_stack()
+        _fail_analyzer(cc)
+        with pytest.raises(RuntimeError):
+            cc.serve_proposals()
+
+    def test_rebalance_cached_serves_warm_plan(self):
+        cc, backend, _ = full_stack()
+        cc.get_proposals()
+        t0 = time.perf_counter()
+        result = cc.rebalance_cached(dryrun=True)
+        assert time.perf_counter() - t0 < 0.1  # milliseconds, not a solve
+        assert result.cache_meta["cached"] is True
+        assert result.proposals
+        # and the cached plan actually executes
+        done = cc.rebalance_cached(dryrun=False)
+        assert done.execution is not None and done.execution.succeeded
+        # execution invalidates the plan it just consumed
+        assert not cc.proposal_cache_fresh()
+
+
+# ---- circuit breaker -------------------------------------------------------------
+class TestCircuitBreaker:
+    def test_trip_probe_recover(self):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=2, reset_s=10.0,
+                           clock=lambda: clock[0])
+        assert b.allow() and b.state == "CLOSED"
+        b.record_failure("boom")
+        assert b.allow()  # one failure < threshold
+        b.record_failure("boom")
+        assert b.state == "OPEN" and not b.allow()
+        clock[0] = 5.0
+        assert not b.allow()  # reset_s not elapsed
+        clock[0] = 10.0
+        assert b.allow()      # the half-open probe
+        assert not b.allow()  # only ONE probe at a time
+        b.record_failure("still down")
+        assert b.state == "OPEN"
+        clock[0] = 25.0
+        assert b.allow()
+        b.record_success()
+        assert b.state == "CLOSED" and b.allow()
+        assert b.trips == 2
+
+    def test_facade_breaker_refuses_compute_and_serves_stale(self):
+        cc, _, _ = full_stack()
+        clock = [0.0]
+        cc.breaker = CircuitBreaker(failure_threshold=1, reset_s=60.0,
+                                    clock=lambda: clock[0])
+        cc.get_proposals()
+        _fail_analyzer(cc)
+        with pytest.raises(RuntimeError):
+            cc.get_proposals(ignore_cache=True)
+        assert cc.breaker.state == "OPEN"
+        # compute refused while open: a direct rebalance is saturated...
+        with pytest.raises(AnalyzerSaturatedError) as e:
+            cc.rebalance(dryrun=True)
+        assert e.value.retry_after_s >= 1
+        # ...but proposals serving degrades to the last-good plan (made
+        # stale here so the hit path can't answer first)
+        cc.invalidate_proposal_cache("test")
+        _, meta = cc.serve_proposals()
+        assert meta["stale"] is True
+        # probe after reset: analyzer recovered, breaker closes
+        _restore_analyzer(cc)
+        clock[0] = 60.0
+        result, meta = cc.serve_proposals()
+        assert meta["stale"] is False
+        assert cc.breaker.state == "CLOSED"
+
+
+# ---- /health + raw-HTTP hardening + drain ----------------------------------------
+class TestHealthAndHardening:
+    def test_health_ready(self):
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(cc, port=0)
+        srv.start()
+        try:
+            for path in ("/health", "/kafkacruisecontrol/health"):
+                code, _, body = _get(f"http://127.0.0.1:{srv.port}{path}")
+                assert code == 200
+                assert body["liveness"] == "UP" and body["ready"] is True
+                assert body["monitorWindows"] >= 1
+        finally:
+            srv.stop()
+
+    def test_health_not_ready_without_windows(self):
+        cc, _, _ = full_stack(windows=0)
+        srv = CruiseControlHttpServer(cc, port=0)
+        srv.start()
+        try:
+            code, _, body = _get(f"http://127.0.0.1:{srv.port}/health")
+            assert code == 503
+            assert body["liveness"] == "UP" and body["ready"] is False
+        finally:
+            srv.stop()
+
+    def test_health_reports_draining_but_is_never_shed(self):
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(cc, port=0)
+        srv.start()
+        try:
+            srv.admission.drain(timeout_s=0.5)
+            # normal requests are shed with Retry-After...
+            code, headers, _ = _get(f"{srv.url}/state")
+            assert code == 429 and "Retry-After" in headers
+            # ...the probe still answers (ready=false tells the LB why)
+            code, _, body = _get(f"http://127.0.0.1:{srv.port}/health")
+            assert code == 503 and body["draining"] is True
+        finally:
+            srv.stop()
+
+    def test_oversized_body_413(self):
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(cc, port=0, max_body_bytes=1024)
+        srv.start()
+        try:
+            req = urllib.request.Request(
+                f"{srv.url}/rebalance?dryrun=true", method="POST",
+                data=b"", headers={"Content-Length": str(1 << 20)},
+            )
+            # body deliberately NOT sent: the server must answer from the
+            # declared length alone, before reading anything
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 413
+        finally:
+            srv.stop()
+
+    def test_slow_loris_connection_reaped(self):
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(cc, port=0, read_timeout_s=0.3)
+        srv.start()
+        try:
+            t0 = time.monotonic()
+            closed = False
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=10) as sock:
+                sock.sendall(b"GET /kafkacruisecontrol/state HTTP/1.1\r\n")
+                sock.settimeout(0.1)
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    try:
+                        if sock.recv(4096) == b"":
+                            closed = True
+                            break
+                    except TimeoutError:
+                        continue
+                    except (ConnectionError, OSError):
+                        closed = True
+                        break
+            assert closed, "slow-loris connection was not reaped"
+            assert time.monotonic() - t0 < 5
+            # the server is still fine for normal clients
+            code, _, _ = _get(f"http://127.0.0.1:{srv.port}/health")
+            assert code == 200
+        finally:
+            srv.stop()
+
+    def test_stop_drains_and_completes_inflight(self):
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(cc, port=0, drain_timeout_s=5.0)
+        srv.start()
+        results = []
+
+        def slow_get():
+            results.append(_get(f"{srv.url}/proposals")[0])
+
+        t = threading.Thread(target=slow_get)
+        t.start()
+        time.sleep(0.05)
+        srv.stop()
+        t.join(timeout=10)
+        # the in-flight request was joined, not killed
+        assert results == [200]
+
+
+# ---- the committed SERVE_LOAD artifact -------------------------------------------
+def test_committed_serve_load_artifact_passes_gates():
+    """SERVE_LOAD_r08.json (benchmarks/serve_load.py output) must match
+    the schema contract and hold every acceptance gate: ≥4× admission
+    capacity, sheds all carrying Retry-After, zero unhandled 5xx, and
+    server-side cached GET /proposals p99 ≤ 50 ms while a concurrent
+    full rebalance ran."""
+    import pathlib
+
+    from test_artifact_schemas import SCHEMAS, validate
+
+    art = json.loads(
+        (pathlib.Path(__file__).parent.parent / "SERVE_LOAD_r08.json")
+        .read_text()
+    )
+    validate(art, SCHEMAS["cc-tpu-serve-load/1"])
+    for gate, ok in art["gates"].items():
+        assert ok is True, f"serve-load gate failed: {gate}"
+    assert art["config"]["loadFactor"] >= 4.0
+    assert art["totals"]["shed"] > 0
+    assert art["totals"]["shed"] == art["totals"]["shedWithRetryAfter"]
+    assert art["totals"]["unhandled5xx"] == 0
+    assert art["latencyMs"]["serverHandlerAdmitted"]["p99"] <= 50.0
+    assert art["rebalance"]["status"] == 200
+
+
+# ---- serving state surface -------------------------------------------------------
+def test_state_exposes_cache_and_breaker():
+    cc, _, _ = full_stack()
+    cc.breaker = CircuitBreaker()
+    cc.get_proposals()
+    analyzer = cc.state()["AnalyzerState"]
+    assert analyzer["proposalCache"]["cacheWarm"] is True
+    assert analyzer["circuitBreaker"]["state"] == "CLOSED"
