@@ -307,6 +307,22 @@ def main() -> None:
         precompute.stop()
     precompute_overhead_pct = (pc_on_s / pc_off_s - 1.0) * 100.0
 
+    # delta-replan gates (ISSUE 9): the steady-state settled replan must
+    # re-validate a fresh plan >=10x faster than a cold recompute, and
+    # the dirty tracking must cost <=1% on the forced-cold path.  The
+    # full two-engine / three-fixture matrix lives in
+    # benchmarks/replan_bench.py -> REPLAN_r09.json; the driver bench
+    # carries the north-star engine's drift fixture so a regression in
+    # either gate shows up in every BENCH artifact.
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.replan_bench import measure_fixture, measure_overhead
+
+    replan_fixture = measure_fixture("load_perturbation", engine="tpu",
+                                     best_of=2)
+    replan_overhead = measure_overhead(engine="tpu", rounds=2)
+
     phases = _full_path_phases()
     tracing.configure(enabled=False)
 
@@ -335,6 +351,19 @@ def main() -> None:
                 "precompute_overhead_pct": round(
                     precompute_overhead_pct, 2),
                 "precompute_daemon_state": precompute.state_summary(),
+                # delta-replan gates (full matrix: REPLAN_r09.json)
+                "replan_after_drift": {
+                    "settle_speedup": replan_fixture["settle_speedup"],
+                    "settle_gate": 10.0,
+                    "absorb_speedup": replan_fixture["absorb_speedup"],
+                    "score_ok": bool(
+                        replan_fixture["absorb_score_ok"]
+                        and replan_fixture["settle_score_ok"]
+                    ),
+                    "mode": replan_fixture["mode"],
+                },
+                "replan_overhead_pct": replan_overhead[
+                    "replan_overhead_pct"],
                 "phases": phases,
             }
         )

@@ -175,7 +175,7 @@ class AnalyzerContext:
         try:
             return self._static_memo[key]
         except KeyError:
-            v = self._static_memo[key] = fn()
+            v = self._static_memo[key] = fn()  # cclint: disable=cache-key-discipline -- context-lifetime cache by design: an AnalyzerContext is built per model generation and never outlives it, and the cached values (broker states/options-derived masks) are immutable for that lifetime
             if isinstance(v, np.ndarray):
                 v.flags.writeable = False
             return v
@@ -477,6 +477,47 @@ class AnalyzerContext:
         else:
             raise NotImplementedError(action.action_type)
         self.actions.append(self._tagged(action))
+
+    # ---- warm-start seeding (delta replan) --------------------------------------
+    def reseed(
+        self,
+        assignment: np.ndarray,
+        leader_slot: np.ndarray,
+        replica_disk: Optional[np.ndarray] = None,
+    ) -> None:
+        """Re-point this context's placement at a warm-start seed (the
+        previous plan's final placement) and rebuild every aggregate.
+
+        The seed describes a *hypothetical* placement (the previous plan
+        has not necessarily executed), so per-replica offline flags are
+        re-derived from first principles: a seeded replica is offline
+        exactly when it sits on a dead/removed broker or a broker
+        requested for removal — the model's per-disk/per-replica offline
+        flags for rows the seed did not move are kept (a failed disk stays
+        failed wherever the seed points).
+        """
+        assert assignment.shape == self.assignment.shape, "seed shape drift"
+        moved = np.any(assignment != self.assignment, axis=1)
+        self.assignment = np.array(assignment, np.int32)
+        self.leader_slot = np.array(leader_slot, np.int32)
+        if replica_disk is not None and self.replica_disk is not None:
+            self.replica_disk = np.array(replica_disk, np.int32)
+        # rows the seed moved: offline only where the seed lands on a
+        # non-hosting broker; untouched rows keep their recorded flags
+        dead = ~self.broker_alive
+        on_dead = (self.assignment != EMPTY_SLOT) & dead[
+            np.clip(self.assignment, 0, None)
+        ]
+        self.replica_offline = np.where(
+            moved[:, None], on_dead, self.replica_offline | on_dead
+        )
+        for b in self.options.brokers_to_remove:
+            self.replica_offline |= self.assignment == b
+        self.offline_origin = np.where(
+            self.replica_offline, self.assignment, EMPTY_SLOT
+        ).astype(np.int32)
+        self._init_aggregates()
+        self.invalidate()
 
     # ---- snapshots --------------------------------------------------------------
     def to_state(self, template: ClusterState) -> ClusterState:

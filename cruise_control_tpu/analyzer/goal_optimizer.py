@@ -456,16 +456,25 @@ class GoalOptimizer:
         self,
         state: ClusterState,
         options: Optional[OptimizationOptions] = None,
+        warm_start=None,
+        carry=None,
     ) -> OptimizerResult:
+        """``warm_start`` (replan.delta.WarmStart-shaped) seeds the goal
+        passes at a previous plan's final placement — on a drifted steady
+        state the passes then accept only the delta's worth of moves —
+        and enables signature-based partial re-verification.  ``carry``
+        is accepted for engine-API parity and ignored (the device carry
+        is the TPU engine's)."""
         from cruise_control_tpu.telemetry import tracing
 
         with tracing.span("analyzer.greedy"):
-            return self._optimize(state, options)
+            return self._optimize(state, options, warm_start=warm_start)
 
     def _optimize(
         self,
         state: ClusterState,
         options: Optional[OptimizationOptions] = None,
+        warm_start=None,
     ) -> OptimizerResult:
         t0 = time.perf_counter()
         ctx = AnalyzerContext(state, options)
@@ -474,8 +483,27 @@ class GoalOptimizer:
         initial_replica_disk = (
             ctx.replica_disk.copy() if ctx.replica_disk is not None else None
         )
+        if warm_start is not None:
+            ctx.reseed(
+                warm_start.assignment, warm_start.leader_slot,
+                warm_start.replica_disk,
+            )
         stats_before = stats_summary(cluster_stats(state))
-        violations_before = {g.name: g.violations(ctx) for g in self.goals}
+        if warm_start is not None:
+            from cruise_control_tpu.analyzer.verifier import (
+                partial_violations,
+            )
+
+            violations_before, _, reused_before = partial_violations(
+                ctx, self.goals,
+                warm_start.prev_signatures, warm_start.prev_violations,
+                force_full=warm_start.full_verify,
+            )
+        else:
+            violations_before = {
+                g.name: g.violations(ctx) for g in self.goals
+            }
+            reused_before = []
 
         import logging as _logging
 
@@ -516,18 +544,41 @@ class GoalOptimizer:
         finally:
             ctx.current_goal, ctx.current_round = "", -1
 
-        violations_after = {g.name: g.violations(ctx) for g in self.goals}
+        replan_verify = None
+        if warm_start is not None:
+            from cruise_control_tpu.analyzer.verifier import (
+                partial_violations,
+            )
+
+            violations_after, sigs_after, reused_after = partial_violations(
+                ctx, self.goals,
+                warm_start.prev_signatures, warm_start.prev_violations,
+                force_full=warm_start.full_verify,
+            )
+            replan_verify = {
+                "signatures": sigs_after,
+                "reusedBefore": list(reused_before),
+                "reusedAfter": list(reused_after),
+                "fullVerify": bool(warm_start.full_verify),
+            }
+        else:
+            violations_after = {
+                g.name: g.violations(ctx) for g in self.goals
+            }
         final_state = ctx.to_state(state)
         stats_after = stats_summary(cluster_stats(final_state))
         from cruise_control_tpu.analyzer.provision import analyze_provisioning
 
         provision = analyze_provisioning(final_state)
-        return OptimizerResult(
+        result = OptimizerResult(
             proposals=diff_proposals(
                 initial_assignment, initial_leader_slot, ctx,
                 initial_replica_disk,
             ),
-            actions=list(ctx.actions),
+            actions=(
+                list(warm_start.prev_actions) + list(ctx.actions)
+                if warm_start is not None else list(ctx.actions)
+            ),
             violations_before=violations_before,
             violations_after=violations_after,
             stats_before=stats_before,
@@ -538,3 +589,6 @@ class GoalOptimizer:
             provision=provision,
             goal_summaries=goal_pass_summaries(self.goals, ctx),
         )
+        if replan_verify is not None:
+            result.replan_verify = replan_verify
+        return result

@@ -90,6 +90,17 @@ class Goal:
     #: provenance; the vocabulary is fixed: capacity-exceeded,
     #: rack-violation, no-improvement, swap-cap, excluded-broker)
     reject_reason: str = "no-improvement"
+    #: model fields this goal's ``violations()`` reads (the partial-verify
+    #: vocabulary — see ``verifier.INPUT_FIELDS``).  The delta-replan path
+    #: reuses a previously verified verdict when every declared input is
+    #: BIT-IDENTICAL between the two contexts, so a declaration may be
+    #: conservative (extra fields cost reuse, never correctness) but must
+    #: never omit a field the verdict depends on.  The base default is the
+    #: full surface; subclasses narrow it.
+    inputs: tuple = (
+        "assignment", "leader_slot", "loads", "capacity", "racks",
+        "broker_state", "topics", "offline", "disks",
+    )
 
     def __init__(self, constraint: Optional[BalancingConstraint] = None):
         self.constraint = constraint or BalancingConstraint()

@@ -37,6 +37,7 @@ class ReplicaCapacityGoal(Goal):
     name = "ReplicaCapacityGoal"
     is_hard = True
     reject_reason = "capacity-exceeded"
+    inputs = ("assignment", "broker_state")
 
     def _limit(self) -> int:
         return self.constraint.max_replicas_per_broker
@@ -99,6 +100,8 @@ class CapacityGoal(Goal):
     resource: Resource
     is_hard = True
     reject_reason = "capacity-exceeded"
+    inputs = ("assignment", "leader_slot", "loads", "capacity",
+              "broker_state")
 
     def _limits(self, ctx: AnalyzerContext) -> np.ndarray:
         """f64 [B] — absolute load limit per broker (capacity × threshold

@@ -38,6 +38,8 @@ class ResourceDistributionGoal(Goal):
 
     resource: Resource
     is_hard = False
+    inputs = ("assignment", "leader_slot", "loads", "capacity",
+              "broker_state")
 
     # ---- bounds -----------------------------------------------------------------
     def _bounds(self, ctx: AnalyzerContext) -> Tuple[np.ndarray, np.ndarray]:
@@ -283,6 +285,7 @@ class ReplicaDistributionGoal(Goal):
 
     name = "ReplicaDistributionGoal"
     is_hard = False
+    inputs = ("assignment", "broker_state")
 
     def _counts(self, ctx: AnalyzerContext) -> np.ndarray:
         return ctx.broker_replica_count
@@ -349,6 +352,7 @@ class LeaderReplicaDistributionGoal(Goal):
 
     name = "LeaderReplicaDistributionGoal"
     is_hard = False
+    inputs = ("assignment", "leader_slot", "broker_state")
 
     def _bounds(self, ctx: AnalyzerContext) -> Tuple[int, int]:
         def compute() -> Tuple[int, int]:
@@ -443,6 +447,7 @@ class TopicReplicaDistributionGoal(Goal):
 
     name = "TopicReplicaDistributionGoal"
     is_hard = False
+    inputs = ("assignment", "topics", "broker_state")
 
     def _bounds_for_topic(self, ctx: AnalyzerContext, t: int) -> Tuple[int, int]:
         alive = ctx.broker_alive
@@ -501,6 +506,8 @@ class LeaderBytesInDistributionGoal(Goal):
 
     name = "LeaderBytesInDistributionGoal"
     is_hard = False
+    inputs = ("assignment", "leader_slot", "loads", "capacity",
+              "broker_state")
 
     def _bounds(self, ctx: AnalyzerContext) -> Tuple[np.ndarray, np.ndarray]:
         def compute() -> Tuple[np.ndarray, np.ndarray]:
@@ -572,6 +579,7 @@ class PotentialNwOutGoal(Goal):
 
     name = "PotentialNwOutGoal"
     is_hard = False
+    inputs = ("assignment", "loads", "capacity", "broker_state")
 
     def _limits(self, ctx: AnalyzerContext) -> np.ndarray:
         return (
@@ -616,6 +624,7 @@ class PreferredLeaderElectionGoal(Goal):
 
     name = "PreferredLeaderElectionGoal"
     is_hard = False
+    inputs = ("assignment", "leader_slot", "broker_state")
 
     def violations(self, ctx: AnalyzerContext) -> int:
         lead_ok = ctx.leadership_candidates()
@@ -658,6 +667,7 @@ class MinTopicLeadersPerBrokerGoal(Goal):
 
     name = "MinTopicLeadersPerBrokerGoal"
     is_hard = True
+    inputs = ("assignment", "leader_slot", "topics", "broker_state")
 
     def _applies(self) -> bool:
         return (
@@ -738,6 +748,7 @@ class BrokerSetAwareGoal(Goal):
 
     name = "BrokerSetAwareGoal"
     is_hard = True
+    inputs = ("assignment", "topics", "broker_state")
     reject_reason = "excluded-broker"
 
     def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:

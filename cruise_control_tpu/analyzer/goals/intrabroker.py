@@ -47,6 +47,8 @@ class IntraBrokerDiskCapacityGoal(Goal):
 
     name = "IntraBrokerDiskCapacityGoal"
     is_hard = True
+    inputs = ("assignment", "leader_slot", "loads", "disks",
+              "broker_state")
     reject_reason = "capacity-exceeded"
 
     def _threshold(self) -> float:
@@ -128,6 +130,8 @@ class IntraBrokerDiskUsageDistributionGoal(Goal):
 
     name = "IntraBrokerDiskUsageDistributionGoal"
     is_hard = False
+    inputs = ("assignment", "leader_slot", "loads", "disks",
+              "broker_state")
 
     def _bounds(self, ctx: AnalyzerContext, b: int) -> Tuple[float, float]:
         ok = ctx.disk_alive_mask(b)

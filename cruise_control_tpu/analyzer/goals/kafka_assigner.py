@@ -32,6 +32,8 @@ class KafkaAssignerEvenRackAwareGoal(Goal):
 
     name = "KafkaAssignerEvenRackAwareGoal"
     is_hard = True
+    inputs = ("assignment", "leader_slot", "racks", "broker_state",
+              "offline")
     reject_reason = "rack-violation"
 
     def _rack_totals(self, ctx: AnalyzerContext) -> np.ndarray:
@@ -150,6 +152,8 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
 
     name = "KafkaAssignerDiskUsageDistributionGoal"
     is_hard = False
+    inputs = ("assignment", "leader_slot", "loads", "capacity",
+              "broker_state")
 
     def _bounds(self, ctx: AnalyzerContext) -> Tuple[float, float]:
         avg = ctx.avg_alive_utilization(Resource.DISK)

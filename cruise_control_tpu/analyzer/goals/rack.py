@@ -61,6 +61,7 @@ def _count_over_limit_racks(ctx: AnalyzerContext, limit: np.ndarray) -> int:
 class RackAwareGoal(Goal):
     name = "RackAwareGoal"
     is_hard = True
+    inputs = ("assignment", "racks", "broker_state", "offline")
     reject_reason = "rack-violation"
 
     def accept_move(self, ctx: AnalyzerContext, p: int, s: int) -> np.ndarray:
@@ -109,6 +110,7 @@ class RackAwareGoal(Goal):
 class RackAwareDistributionGoal(Goal):
     name = "RackAwareDistributionGoal"
     is_hard = True
+    inputs = ("assignment", "racks", "broker_state", "offline")
     reject_reason = "rack-violation"
 
     def _alive_racks(self, ctx: AnalyzerContext) -> int:

@@ -25,6 +25,14 @@ Three pieces:
   facade's warm plan fresh on an interval (each pass is also the natural
   half-open probe).  ``refresh_once`` is public and synchronous so the
   simulator can drive it deterministically without the thread.
+
+With ``replan.enabled`` the refreshes this daemon triggers (and every
+other proposal computation) route through the delta replanner
+(:mod:`cruise_control_tpu.replan`): a generation bump WARM-STARTS from
+the previous plan — delta model build, dirty-row device upload, seeded
+search, partial re-verification, zero-delta short-circuit — instead of
+cold recomputing.  The daemon itself is unchanged: the routing lives
+behind ``CruiseControl.get_proposals``.
 """
 
 from __future__ import annotations

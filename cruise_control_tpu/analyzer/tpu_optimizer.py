@@ -1318,7 +1318,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             return (~done) & (t < jnp.minimum(T, carry[-1])) & (count <= slots)
         return cond
 
-    def run_capped(m: DeviceModel, ca, t_cap):
+    def run_capped(m: DeviceModel, ca, t_cap, size0, base0, tpp0, valid0):
         P, S = m.assignment.shape
         B = m.capacity.shape[0]
         M_ = min(M, (max(1, cfg.moves_per_src) + 1) * B)
@@ -1342,9 +1342,14 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             jnp.full((Kl, R), -1, jnp.int32),
             jnp.full((Ll,), jnp.inf, jnp.float32),
         )
+        # pool row tables enter as runtime state (the cross-call /
+        # cross-plan diet): a caller holding tables from a previous call —
+        # or a previous PLAN, with the dirty rows marked in tpp0 — passes
+        # them with valid0=True, and the first repool of this call refreshes
+        # only the marked rows instead of rebuilding from scratch.  Cold
+        # callers pass zeros + valid0=False (same compiled program).
         pt0 = (
-            jnp.zeros((P, S), jnp.float32), jnp.zeros((P, S), jnp.float32),
-            jnp.zeros(P, bool), jnp.bool_(False), jnp.int32(0),
+            size0, base0, tpp0, valid0, jnp.int32(0),
         )
         carry = jax.lax.while_loop(
             cond_fn(slots - M_), step,
@@ -1357,7 +1362,7 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
             carry[0], carry[2], carry[3], carry[4], carry[5], carry[6],
             carry[13]
         )
-        n_incr = carry[8][4]
+        size_t, base_t, tpp_out, _pt_valid, n_incr = carry[8]
         meta = jnp.zeros((4, T + 2), jnp.float32)
         meta = meta.at[:, :T].set(counts.astype(jnp.float32))
         meta = meta.at[0, T].set(count.astype(jnp.float32))
@@ -1370,14 +1375,24 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         meta = meta.at[2, T].set(t_end.astype(jnp.float32))
         # row 3 tail: incremental (dieted) pool rebuilds this call
         meta = meta.at[3, T].set(n_incr.astype(jnp.float32))
-        return jnp.concatenate([out, meta], axis=1), m
+        # tpp_out = rows touched since the last in-call rebuild: exactly
+        # what the NEXT call (or the next plan's warm start) must refresh
+        return (jnp.concatenate([out, meta], axis=1), m,
+                (size_t, base_t, tpp_out))
 
-    def run(m: DeviceModel, ca, t_cap=None):
+    def _cold_tables(m: DeviceModel):
+        P, S = m.assignment.shape
+        z = jnp.zeros((P, S), jnp.float32)
+        return z, z, jnp.zeros(P, bool), jnp.bool_(False)
+
+    def run(m: DeviceModel, ca, t_cap=None, tables=None):
         # t_cap omitted (benchmarks, unbudgeted runs) = uncapped; a jnp
         # scalar binds by shape, so every capped call shares one executable
         if t_cap is None:
             t_cap = jnp.int32(T)
-        return run_capped(m, ca, t_cap)
+        if tables is None:
+            tables = _cold_tables(m)
+        return run_capped(m, ca, t_cap, *tables)
 
     if mesh is None:
         return device_stats.instrument("analyzer.scan_fn", jax.jit(run))
@@ -1389,13 +1404,18 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
     # model + constraints replicated in, results replicated out; the
     # sharding happens inside the loop (see _reduced_candidates)
     rep = PartitionSpec()
-    sharded = shard_map_norep(run_capped, mesh, in_specs=(rep, rep, rep),
-                              out_specs=(rep, rep))
+    sharded = shard_map_norep(
+        run_capped, mesh,
+        in_specs=(rep, rep, rep, rep, rep, rep, rep),
+        out_specs=(rep, rep, (rep, rep, rep)),
+    )
 
-    def run_sharded(m: DeviceModel, ca, t_cap=None):
+    def run_sharded(m: DeviceModel, ca, t_cap=None, tables=None):
         if t_cap is None:
             t_cap = jnp.int32(T)
-        return sharded(m, ca, t_cap)
+        if tables is None:
+            tables = _cold_tables(m)
+        return sharded(m, ca, t_cap, *tables)
 
     return device_stats.instrument("analyzer.scan_fn", jax.jit(run_sharded))
 
@@ -2879,6 +2899,131 @@ class TpuGoalOptimizer:
         )
         return _recompute_aggregates(m)
 
+    def _warm_device_model(self, ctx: AnalyzerContext, warm_start, carry):
+        """Device model for this search: a delta re-upload of the carried
+        previous-plan model when the warm start allows it, else the full
+        build.  Returns ``(m, tab)`` where ``tab`` is the pool-row-table
+        carry tuple for the first scan call (None = cold tables).
+
+        The delta path re-uploads ONLY the dirty partitions' load rows
+        into the resident [P, S(·R)] tables (the cross-plan extension of
+        the ops/pools incremental repool); [B]-scale masks are rebuilt
+        fresh (they are tiny), and aggregates are one fused recompute.
+        Usable only when the carried model matches the seeded placement
+        bit-for-bit and the broker axis did not change — the planner
+        invalidates the carry on capacity/rack drift, this guard covers
+        placement/shape drift."""
+        usable = (
+            warm_start is not None
+            and carry is not None
+            and carry.valid
+            and carry.model is not None
+            and carry.assignment is not None
+            and carry.assignment.shape == ctx.assignment.shape
+            and carry.model.capacity.shape[0] == ctx.num_brokers
+            and not ctx.cap_distinct
+            and carry.model.leader_cload is None
+            and np.array_equal(carry.assignment, ctx.assignment)
+            and np.array_equal(carry.leader_slot, ctx.leader_slot)
+            and not ctx.excluded_partition_mask().any()
+        )
+        if not usable:
+            return self._device_model(ctx), None
+        cm = carry.model
+        P, S = ctx.assignment.shape
+        dirty = warm_start.dirty_partitions
+        rows = (
+            np.nonzero(dirty)[0] if dirty is not None
+            else np.arange(P)
+        )
+        lead, fol = cm.leader_load, cm.follower_load
+        if rows.size:
+            # the dirty-row scatter's shape is bucketed to a power of two
+            # so the number of compiled scatter programs stays O(log P)
+            # across the plan lifetime — a raw rows.size shape would
+            # recompile on every replan (the no-retraces contract).  The
+            # padding duplicates the FIRST dirty row index with its own
+            # new value, so every duplicate write carries identical bytes
+            # (deterministic under XLA's unordered scatter).
+            n = rows.size
+            n2 = 64
+            while n2 < n:
+                n2 <<= 1
+            n2 = min(n2, ctx.num_partitions)
+            idx = np.full(n2, rows[0], np.int32)
+            idx[:n] = rows
+            lv = np.asarray(ctx.leader_load)[idx]
+            fv = np.asarray(ctx.follower_load)[idx]
+            ridx = jnp.asarray(idx)
+            lead = lead.at[ridx].set(jnp.asarray(lv))
+            fol = fol.at[ridx].set(jnp.asarray(fv))
+        any_off = bool(ctx.replica_offline.any())
+        m = dataclasses.replace(
+            cm,
+            leader_load=lead,
+            follower_load=fol,
+            dest_ok=jnp.asarray(ctx.dest_candidates()),
+            lead_ok=jnp.asarray(ctx.leadership_candidates()),
+            alive=jnp.asarray(ctx.broker_alive),
+            excluded=jnp.zeros(P, bool),
+            must_move=(
+                jnp.asarray(ctx.replica_offline) if any_off
+                else jnp.zeros((P, S), bool)
+            ),
+            offline_origin=(
+                jnp.asarray(ctx.offline_origin) if any_off
+                else jnp.full((P, S), EMPTY_SLOT, jnp.int32)
+            ),
+        )
+        m = dataclasses.replace(
+            m,
+            pload=pack_pload(
+                m.leader_load, m.follower_load, m.excluded,
+                m.leader_cload, m.follower_cload,
+            ),
+        )
+        m = _recompute_aggregates(m)
+        tab = None
+        if carry.tables is not None:
+            # rows whose pool-table inputs may differ from the carried
+            # tables: the delta's dirty rows, rows touched after the
+            # tables were captured, and any row with must-move flags on
+            # either side (their repair bonuses ride the tables)
+            tpp0 = np.zeros(P, bool)
+            if dirty is not None:
+                tpp0 |= dirty
+            else:
+                tpp0[:] = True
+            if carry.pending_touched is not None:
+                tpp0 |= carry.pending_touched
+            if carry.had_must_move is not None:
+                tpp0 |= carry.had_must_move
+            if any_off:
+                tpp0 |= np.any(ctx.replica_offline, axis=1)
+            tab = (carry.tables[0], carry.tables[1],
+                   jnp.asarray(tpp0), np.True_)
+        return m, tab
+
+    def _export_carry(self, carry, m, ctx, tab, post_table_touched):
+        """Retain this plan's end state for the next warm start."""
+        if m is None:
+            carry.invalidate()
+            return
+        carry.model = _resync_device_model(m, ctx)
+        carry.assignment = ctx.assignment.copy()
+        carry.leader_slot = ctx.leader_slot.copy()
+        carry.had_must_move = np.any(ctx.replica_offline, axis=1)
+        if tab is not None and bool(tab[3]):
+            carry.tables = (tab[0], tab[1])
+            pending = np.asarray(tab[2]).copy()
+            if post_table_touched is not None:
+                pending |= post_table_touched
+            carry.pending_touched = pending
+        else:
+            carry.tables = None
+            carry.pending_touched = None
+        carry.valid = True
+
     def _pool_sizes(self, P: int, S: int, B: int) -> Tuple[int, int]:
         cfg = self.config
         # the auction commits at most one move per destination broker per
@@ -2904,7 +3049,14 @@ class TpuGoalOptimizer:
         self,
         state: ClusterState,
         options: Optional[OptimizationOptions] = None,
+        warm_start=None,
+        carry=None,
     ) -> OptimizerResult:
+        """``warm_start`` (a :class:`replan.delta.WarmStart`-shaped object)
+        seeds the search at a previous plan's final placement and enables
+        the exact signature-based partial re-verification; ``carry`` (a
+        ``ReplanCarry``) retains/consumes the device model + pool row
+        tables across plans — the cross-plan half of the repool diet."""
         from cruise_control_tpu.analyzer.goal_optimizer import make_goals
 
         t0 = time.perf_counter()
@@ -2912,14 +3064,33 @@ class TpuGoalOptimizer:
         with tracing.span("analyzer.tpu"):
             with tracing.span("analyzer.ctx_init"):
                 ctx = AnalyzerContext(state, options)
-            initial_assignment = ctx.assignment.copy()
-            initial_leader_slot = ctx.leader_slot.copy()
-            initial_replica_disk = (
-                ctx.replica_disk.copy() if ctx.replica_disk is not None
-                else None
-            )
+                initial_assignment = ctx.assignment.copy()
+                initial_leader_slot = ctx.leader_slot.copy()
+                initial_replica_disk = (
+                    ctx.replica_disk.copy() if ctx.replica_disk is not None
+                    else None
+                )
+                if warm_start is not None:
+                    ctx.reseed(
+                        warm_start.assignment, warm_start.leader_slot,
+                        warm_start.replica_disk,
+                    )
             goals = make_goals(constraint=self.constraint)
-            violations_before = {g.name: g.violations(ctx) for g in goals}
+            if warm_start is not None:
+                from cruise_control_tpu.analyzer.verifier import (
+                    partial_violations,
+                )
+
+                violations_before, _, reused_before = partial_violations(
+                    ctx, goals,
+                    warm_start.prev_signatures, warm_start.prev_violations,
+                    force_full=warm_start.full_verify,
+                )
+            else:
+                violations_before = {
+                    g.name: g.violations(ctx) for g in goals
+                }
+                reused_before = []
             stats_before = stats_summary(cluster_stats(state))
 
             import contextlib
@@ -2933,15 +3104,18 @@ class TpuGoalOptimizer:
                     state, ctx, goals, violations_before, stats_before,
                     initial_assignment, initial_leader_slot,
                     initial_replica_disk, t0, cfg,
+                    warm_start=warm_start, carry=carry,
+                    reused_before=reused_before,
                 )
 
     def _search(
         self, state, ctx, goals, violations_before, stats_before,
         initial_assignment, initial_leader_slot, initial_replica_disk, t0,
-        cfg,
+        cfg, warm_start=None, carry=None, reused_before=(),
     ) -> OptimizerResult:
+        tab = None
         with tracing.device_span("analyzer.upload") as dsp:
-            m = self._device_model(ctx)
+            m, tab = self._warm_device_model(ctx, warm_start, carry)
             dsp.block(m.broker_load)
         can = self._constraint_arrays_np(ctx)
         ca = {k: jnp.asarray(v) for k, v in can.items()}
@@ -3022,8 +3196,28 @@ class TpuGoalOptimizer:
             # to serial mode; rejections/convergence discard the in-flight
             # tail.  Serial under a time budget: the per-call step caps
             # come from live rate measurements.
-            depth = 0 if cfg.time_budget_s else max(0, cfg.pipeline_depth)
-            inflight: List[Tuple[jax.Array, DeviceModel]] = []
+            # warm starts run SERIAL: a steady-state replan converges in
+            # one or two calls, so the speculative call the pipeline
+            # issues at call 2 is almost always pure waste — and its
+            # enqueued device work delays the carry export behind it
+            depth = (
+                0 if (cfg.time_budget_s or warm_start is not None)
+                else max(0, cfg.pipeline_depth)
+            )
+            inflight: List[Tuple] = []
+            # pool row tables ride OUTSIDE the call too (cross-call diet):
+            # each call returns its end-of-call tables + touched set, and
+            # the next call's first repool refreshes only those rows.  A
+            # warm start seeds them from the previous PLAN's carry with the
+            # delta's dirty rows pre-marked; cold runs start invalid (the
+            # first repool is a full rebuild, exactly as before).
+            if tab is None:
+                P_ = ctx.num_partitions
+                tab = (
+                    jnp.zeros((P_, ctx.max_rf), jnp.float32),
+                    jnp.zeros((P_, ctx.max_rf), jnp.float32),
+                    jnp.zeros(P_, bool), np.False_,
+                )
 
             def dispatch_ahead(tip_model) -> None:
                 # enqueue-only (JAX async dispatch): the device chains the
@@ -3033,10 +3227,17 @@ class TpuGoalOptimizer:
                     len(inflight) < depth
                     and n_calls + len(inflight) < calls_budget
                 ):
-                    tip = inflight[-1][1] if inflight else tip_model
+                    if inflight:
+                        tip, tip_tab = (
+                            inflight[-1][1],
+                            inflight[-1][2] + (np.True_,),
+                        )
+                    else:
+                        tip, tip_tab = tip_model, tab
                     with tracing.span("analyzer.dispatch_ahead"):
                         inflight.append(
-                            scan_fn(tip, ca, np.int32(cfg.steps_per_call))
+                            scan_fn(tip, ca, np.int32(cfg.steps_per_call),
+                                    tip_tab)
                         )
 
             while n_calls < calls_budget:
@@ -3063,7 +3264,7 @@ class TpuGoalOptimizer:
                         t_cap = min(cfg.steps_per_call, 256)
                 call_t0 = time.perf_counter()
                 if inflight:
-                    packed, m_new = inflight.pop(0)
+                    packed, m_new, tab_new = inflight.pop(0)
                 else:
                     # ALWAYS pass t_cap (steps_per_call when uncapped): a
                     # scalar argument binds by shape, so capped and uncapped
@@ -3074,11 +3275,12 @@ class TpuGoalOptimizer:
                     # (the multihost dryrun), while numpy inputs are
                     # treated as replicated
                     with tracing.device_span("analyzer.scan") as dsp:
-                        packed, m_new = scan_fn(
+                        packed, m_new, tab_new = scan_fn(
                             m, ca,
                             np.int32(
                                 cfg.steps_per_call if t_cap is None else t_cap
                             ),
+                            tab,
                         )
                         if not depth:
                             dsp.block(packed)
@@ -3152,6 +3354,7 @@ class TpuGoalOptimizer:
                     # state the oldest speculative call ran on, so the
                     # pipeline's results stay valid (plan identity)
                     m = m_new
+                    tab = tab_new + (np.True_,)
                     # device_done = a freshly-repooled step committed
                     # nothing: converged under the pool regime (the same
                     # signal a fresh call committing nothing used to give,
@@ -3167,8 +3370,12 @@ class TpuGoalOptimizer:
                     )
                     # device state includes skipped actions — rebuild from
                     # the live context before the next call; speculative
-                    # calls ran on that stale state and are discarded
+                    # calls ran on that stale state and are discarded, and
+                    # so are the row tables (computed against the rejected
+                    # placement — the next call rebuilds from scratch)
                     inflight.clear()
+                    tab = (tab[0], tab[1],
+                           jnp.zeros(ctx.num_partitions, bool), np.False_)
                     with tracing.device_span("analyzer.resync") as dsp:
                         m = dsp.block(_resync_device_model(m, ctx))
             LOG.info(
@@ -3197,6 +3404,9 @@ class TpuGoalOptimizer:
         else:
             rounds_budget = cfg.max_rounds
 
+        #: actions committed past this index postdate the carried pool
+        #: tables (polish / swap repair) — the carry marks their rows
+        n_actions_at_tables = len(actions)
         round_fn = self._make_round_fn(K, D)
         # the score-only loop is "polish" after a resident search, or the
         # primary search itself otherwise (score-only / columnar configs)
@@ -3294,19 +3504,53 @@ class TpuGoalOptimizer:
                     "host swap-repair pass committed %d actions for residual "
                     "hard violations", len(new_actions),
                 )
+        if carry is not None:
+            with tracing.device_span("analyzer.carry_export") as dsp:
+                post = np.zeros(ctx.num_partitions, bool)
+                for a in actions[n_actions_at_tables:]:
+                    post[a.partition] = True
+                    if a.action_type == ActionType.INTER_BROKER_REPLICA_SWAP:
+                        post[a.swap_partition] = True
+                self._export_carry(carry, m, ctx, tab, post)
+                if carry.model is not None:
+                    dsp.block(carry.model.broker_load)
         with tracing.span("analyzer.finalize"):
             return self._finalize(
                 state, ctx, goals, actions, violations_before, stats_before,
                 initial_assignment, initial_leader_slot, initial_replica_disk,
-                t0, pass_summaries,
+                t0, pass_summaries, warm_start=warm_start,
+                reused_before=reused_before,
             )
 
     def _finalize(
         self, state, ctx, goals, actions, violations_before, stats_before,
         initial_assignment, initial_leader_slot, initial_replica_disk, t0,
-        pass_summaries: Optional[List[dict]] = None,
+        pass_summaries: Optional[List[dict]] = None, warm_start=None,
+        reused_before=(),
     ) -> OptimizerResult:
-        violations_after = {g.name: g.violations(ctx) for g in goals}
+        replan_verify = None
+        if warm_start is not None:
+            # partial re-verification: a goal whose declared inputs are
+            # bit-identical to the previously verified final state reuses
+            # that verdict EXACTLY (hash match ⇒ same arrays ⇒ same
+            # violations); replan.full.verify forces the full pass
+            from cruise_control_tpu.analyzer.verifier import (
+                partial_violations,
+            )
+
+            violations_after, sigs_after, reused_after = partial_violations(
+                ctx, goals,
+                warm_start.prev_signatures, warm_start.prev_violations,
+                force_full=warm_start.full_verify,
+            )
+            replan_verify = {
+                "signatures": sigs_after,
+                "reusedBefore": list(reused_before),
+                "reusedAfter": list(reused_after),
+                "fullVerify": bool(warm_start.full_verify),
+            }
+        else:
+            violations_after = {g.name: g.violations(ctx) for g in goals}
         # same contract as GoalOptimizer: a plan that leaves hard goals
         # violated must not reach the executor
         from cruise_control_tpu.analyzer.goals.base import OptimizationFailure
@@ -3347,12 +3591,15 @@ class TpuGoalOptimizer:
             analyze_provisioning_arrays,
         )
 
-        return OptimizerResult(
+        result = OptimizerResult(
             proposals=diff_proposals(
                 initial_assignment, initial_leader_slot, ctx,
                 initial_replica_disk,
             ),
-            actions=actions,
+            actions=(
+                list(warm_start.prev_actions) + actions
+                if warm_start is not None else actions
+            ),
             violations_before=violations_before,
             violations_after=violations_after,
             stats_before=stats_before,
@@ -3365,3 +3612,6 @@ class TpuGoalOptimizer:
             ),
             goal_summaries=list(pass_summaries or ()),
         )
+        if replan_verify is not None:
+            result.replan_verify = replan_verify
+        return result

@@ -104,6 +104,97 @@ def verify_result(
             raise VerificationError("excluded topic placement changed")
 
 
+# ---------------------------------------------------------------------------------
+# Per-goal input signatures — the partial-verify primitive (delta replan)
+# ---------------------------------------------------------------------------------
+
+#: input-field vocabulary → the context arrays it covers.  Goals declare
+#: which fields their ``violations()`` verdict reads (``Goal.inputs``);
+#: two contexts with bit-identical declared inputs necessarily yield the
+#: same verdict, so a previously verified result can be reused EXACTLY —
+#: this is a memo over immutable bytes, not a heuristic.
+INPUT_FIELDS = {
+    "assignment": ("assignment",),
+    "leader_slot": ("leader_slot",),
+    "loads": ("leader_load", "follower_load",
+              "leader_cap_load", "follower_cap_load"),
+    "capacity": ("broker_capacity",),
+    "racks": ("broker_rack",),
+    "broker_state": ("broker_state",),
+    "topics": ("partition_topic",),
+    "offline": ("replica_offline",),
+    "disks": ("replica_disk", "disk_capacity", "disk_offline"),
+}
+
+
+def _field_hash(ctx: AnalyzerContext, field: str, _cache: dict) -> str:
+    """Hash one input field's arrays (shared across goals via _cache)."""
+    try:
+        return _cache[field]
+    except KeyError:
+        import hashlib
+
+        h = hashlib.sha256()
+        for attr in INPUT_FIELDS[field]:
+            arr = getattr(ctx, attr, None)
+            if arr is None:
+                h.update(b"none")
+                continue
+            a = np.ascontiguousarray(arr)
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+        out = _cache[field] = h.hexdigest()
+        return out
+
+
+def goal_input_signatures(
+    ctx: AnalyzerContext, goals: Sequence[Goal]
+) -> dict:
+    """{goal name: signature} over each goal's declared inputs.  Each
+    underlying array is hashed once and shared across goals, so the cost
+    is ~one pass over the model, not one per goal."""
+    cache: dict = {}
+    out = {}
+    for g in goals:
+        out[g.name] = "|".join(
+            _field_hash(ctx, f, cache) for f in sorted(g.inputs)
+        )
+    return out
+
+
+def partial_violations(
+    ctx: AnalyzerContext,
+    goals: Sequence[Goal],
+    prev_signatures: Optional[dict] = None,
+    prev_violations: Optional[dict] = None,
+    force_full: bool = False,
+) -> tuple:
+    """Per-goal violations with exact signature-based reuse.
+
+    Returns ``(violations, signatures, reused_names)``.  A goal's verdict
+    is reused from ``prev_violations`` only when its input signature is
+    BIT-IDENTICAL to ``prev_signatures`` — reuse is exact, so it applies
+    to hard goals too.  ``force_full`` (the ``replan.full.verify`` safety
+    net) recomputes everything while still returning fresh signatures."""
+    sigs = goal_input_signatures(ctx, goals)
+    out: dict = {}
+    reused = []
+    for g in goals:
+        if (
+            not force_full
+            and prev_signatures is not None
+            and prev_violations is not None
+            and g.name in prev_violations
+            and prev_signatures.get(g.name) == sigs[g.name]
+        ):
+            out[g.name] = prev_violations[g.name]
+            reused.append(g.name)
+        else:
+            out[g.name] = g.violations(ctx)
+    return out, sigs, reused
+
+
 def violation_score(
     state: ClusterState, goals: Sequence[Goal], options: Optional[OptimizationOptions] = None
 ) -> int:

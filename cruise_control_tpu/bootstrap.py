@@ -569,6 +569,24 @@ def build_app(
             ),
             reset_s=cfg.get("proposals.precompute.breaker.reset.ms") / 1000,
         )
+    replanner = None
+    if cfg.get_boolean("replan.enabled"):
+        from cruise_control_tpu.replan import DeltaReplanner, ReplanConfig
+
+        replanner = DeltaReplanner(
+            monitor,
+            ReplanConfig(
+                enabled=True,
+                dirty_load_rel_threshold=cfg.get_double(
+                    "replan.dirty.load.relative.threshold"
+                ),
+                dirty_partition_budget_ratio=cfg.get_double(
+                    "replan.dirty.partition.budget.ratio"
+                ),
+                full_verify=cfg.get_boolean("replan.full.verify"),
+                table_carry=cfg.get_boolean("replan.table.carry.enabled"),
+            ),
+        )
     cc = CruiseControl(
         monitor,
         executor,
@@ -587,6 +605,7 @@ def build_app(
         default_goal_names=cfg.get_list("default.goals"),
         hard_goal_names=cfg.get_list("hard.goals"),
         breaker=breaker,
+        replanner=replanner,
     )
     if kafka_mode and cfg.get_int("num.metric.fetchers") > 1:
         # each per-fetcher consumer reads the WHOLE reporter topic (the

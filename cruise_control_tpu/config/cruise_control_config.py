@@ -422,6 +422,37 @@ def default_config_def() -> ConfigDef:
              30_000, Importance.LOW, "Open-state hold before the breaker "
              "lets one probe through (half-open); the probe's success "
              "closes it.", at_least(1), G)
+    d.define("replan.enabled", ConfigType.BOOLEAN, False,
+             Importance.MEDIUM, "Incremental re-optimization: proposal "
+             "computations (the precompute daemon, GET /proposals misses, "
+             "anomaly-invalidated refreshes) diff the new model against "
+             "the previous one and WARM-START the search from the "
+             "previous plan — delta model build, delta device upload, "
+             "seeded search, partial re-verification — instead of cold "
+             "recomputing.  Falls back to the cold path whenever the "
+             "delta exceeds its budget or the model shape drifts.",
+             None, G)
+    d.define("replan.dirty.load.relative.threshold", ConfigType.DOUBLE,
+             0.05, Importance.LOW, "Per-partition relative load drift "
+             "below which the delta model keeps the previous row's bits "
+             "(the replan's quality/working-set trade; 0 marks every "
+             "drifted row dirty).", at_least(0), G)
+    d.define("replan.dirty.partition.budget.ratio", ConfigType.DOUBLE,
+             0.25, Importance.LOW, "Dirty-partition fraction of the "
+             "model above which a replan cold-starts instead of "
+             "warm-starting (a warm start over a mostly-changed model "
+             "saves nothing).", between(0, 1), G)
+    d.define("replan.full.verify", ConfigType.BOOLEAN, False,
+             Importance.LOW, "Safety net: re-verify EVERY goal on warm "
+             "replans even when its input signature matches the "
+             "previously verified state (signature reuse is exact, so "
+             "this buys audit comfort, not correctness).", None, G)
+    d.define("replan.table.carry.enabled", ConfigType.BOOLEAN, True,
+             Importance.LOW, "Carry the TPU engine's device model and "
+             "pool row tables across plans, so a warm replan re-uploads "
+             "only dirty rows and the first repool refreshes rather than "
+             "rebuilds (ops/pools incremental repool extended to "
+             "cross-plan lifetime).", None, G)
     d.define("cpu.balance.threshold", ConfigType.DOUBLE, 1.1,
              Importance.MEDIUM, "Max/avg CPU ratio considered balanced.",
              at_least(1), G)

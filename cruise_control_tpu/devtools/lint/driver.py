@@ -29,6 +29,9 @@ from cruise_control_tpu.devtools.lint.findings import (
 from cruise_control_tpu.devtools.lint.rules_bounded import (
     BoundedResourceRule,
 )
+from cruise_control_tpu.devtools.lint.rules_cache import (
+    CacheKeyDisciplineRule,
+)
 from cruise_control_tpu.devtools.lint.rules_config import ConfigKeyDriftRule
 from cruise_control_tpu.devtools.lint.rules_except import (
     SwallowedExceptionRule,
@@ -52,6 +55,7 @@ RULES = {
         SwallowedExceptionRule(),
         RetryDisciplineRule(),
         BoundedResourceRule(),
+        CacheKeyDisciplineRule(),
     )
 }
 

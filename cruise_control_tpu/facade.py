@@ -90,6 +90,7 @@ class CruiseControl:
         default_goal_names: Optional[Sequence[str]] = None,
         hard_goal_names: Optional[Sequence[str]] = None,
         breaker=None,
+        replanner=None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -139,6 +140,9 @@ class CruiseControl:
         #: analyzer circuit breaker (precompute.CircuitBreaker); None =
         #: disabled.  Bootstrap wires it from proposals.precompute.breaker.*
         self.breaker = breaker
+        #: delta replanner (replan.DeltaReplanner); None = every proposal
+        #: computation cold-starts.  Bootstrap wires it from replan.*
+        self.replanner = replanner
         self._start_time = time.time()
         # cached proposals (upstream GoalOptimizer proposal precompute, §3.5)
         self._proposal_ttl_s = proposal_ttl_s
@@ -231,6 +235,7 @@ class CruiseControl:
         self,
         requirements: Optional[ModelCompletenessRequirements],
         progress: OperationProgress,
+        builder=None,
     ) -> ClusterState:
         with tracing.span("facade.model"):
             with progress.step("Acquiring model-generation semaphore"):
@@ -250,6 +255,11 @@ class CruiseControl:
                     )
             try:
                 with lock, progress.step("Generating cluster model"):
+                    if builder is not None:
+                        # delta-replan seam: the replanner builds (and
+                        # returns its delta alongside) under the same
+                        # model-generation semaphore the cold path uses
+                        return builder(requirements)
                     return self.load_monitor.cluster_model(requirements)
             except RuntimeError:
                 if admission.expired():
@@ -336,6 +346,8 @@ class CruiseControl:
         engine: Optional[str],
         progress: OperationProgress,
         strategy: Optional[ReplicaMovementStrategy] = None,
+        warm_start=None,
+        carry=None,
     ) -> OptimizerResult:
         if tracing.enabled():  # guard: no formatting on the disabled path
             op_span = tracing.span("facade", sub=operation.lower())
@@ -345,7 +357,7 @@ class CruiseControl:
             sp.set("dryrun", dryrun)
             return self._goal_based_operation_traced(
                 operation, state, goals, options, dryrun, engine, progress,
-                strategy,
+                strategy, warm_start=warm_start, carry=carry,
             )
 
     def _goal_based_operation_traced(
@@ -358,6 +370,8 @@ class CruiseControl:
         engine: Optional[str],
         progress: OperationProgress,
         strategy: Optional[ReplicaMovementStrategy] = None,
+        warm_start=None,
+        carry=None,
     ) -> OptimizerResult:
         constraint = self._resolved_constraint(state, options)
         # brokers whose every log dir is offline stay alive in the model (their
@@ -395,17 +409,28 @@ class CruiseControl:
             operation, state.num_brokers, state.num_partitions,
             opt.__class__.__name__, dryrun,
         )
+        start_extra = {}
+        if warm_start is not None:
+            # only stamped on warm runs so cold journals stay byte-stable
+            start_extra["warmStart"] = True
         events.emit(
             "optimize.start", operation=operation,
             engine=opt.__class__.__name__, dryrun=dryrun,
             brokers=state.num_brokers, partitions=state.num_partitions,
+            **start_extra,
         )
         with progress.step(f"Optimizing ({opt.__class__.__name__})"):
             # upstream GoalOptimizer's "proposal-computation-timer"
             with self.registry.timer("proposal-computation-timer"), \
                     tracing.span("facade.optimize"):
                 try:
-                    result = opt.optimize(state, options)
+                    if warm_start is not None or carry is not None:
+                        result = opt.optimize(
+                            state, options, warm_start=warm_start,
+                            carry=carry,
+                        )
+                    else:
+                        result = opt.optimize(state, options)
                 except Exception as e:
                     LOG.exception("%s optimization failed", operation)
                     if self.breaker is not None:
@@ -849,11 +874,16 @@ class CruiseControl:
                 progress.finish()
                 return cached
             generation = self._model_generation()
-            state = self._model(None, progress)
-            result = self._goal_based_operation(
-                "PROPOSALS", state, None, OptimizationOptions(), True,
-                engine, progress,
-            )
+            if self.replanner is not None:
+                result, state = self._replan_proposals(
+                    engine, generation, progress
+                )
+            else:
+                state = self._model(None, progress)
+                result = self._goal_based_operation(
+                    "PROPOSALS", state, None, OptimizationOptions(), True,
+                    engine, progress,
+                )
             sizes = self._partition_sizes(state)
         finally:
             self._compute_lock.release()
@@ -870,6 +900,101 @@ class CruiseControl:
                 engine=result.engine,
             )
         return result
+
+    def _replan_proposals(self, engine, generation: str, progress):
+        """Proposal computation through the delta replanner: delta model
+        build under the model semaphore → warm-start decision → warm (or
+        cold) optimization → snapshot commit.  A warm-path failure falls
+        back to one cold attempt — a replan must never be WORSE than the
+        cold path it replaces — and every decision lands in the journal
+        (``replan.start`` / ``replan.end`` / ``replan.warm_failed``)."""
+        built = self._model(
+            None, progress, builder=self.replanner.build_model
+        )
+        state, delta, agg_mark = built
+        warm, reason = self.replanner.warm_start_for(state, delta)
+        # zero-delta short-circuit: the generation bumped but the delta
+        # build proved the model BIT-IDENTICAL to the snapshot's (every
+        # drift below the dirty threshold patched away, no topology or
+        # shape change) — the previous plan is exactly servable, no
+        # search needed.  This is the ROADMAP item-2 cache-invalidation
+        # story closed: a window roll re-validates the cached plan in
+        # milliseconds instead of recomputing it.  The full-verify
+        # safety net (replan.full.verify) disables the short-circuit.
+        snap_result = self.replanner.servable_snapshot(
+            engine or self.default_engine, delta
+        )
+        if warm is not None and snap_result is not None:
+            events.emit(
+                "replan.start", mode="warm", reason=None,
+                generation=generation, dirtyPartitions=0, deltaModel=True,
+            )
+            self.replanner.commit(
+                state, snap_result, generation, agg_mark
+            )
+            self.replanner.record_mode("warm", "zero-delta")
+            events.emit(
+                "replan.end", mode="warm", reason=None,
+                generation=generation, dirtyPartitions=0, deltaModel=True,
+                shortCircuit=True,
+                tableCarry=bool(self.replanner.carry.tables is not None),
+                engine=snap_result.engine, goalsReused=-1,
+                durationS=0.0,
+            )
+            progress.add_step("Re-validated previous plan (zero delta)")
+            return snap_result, state
+        mode = "warm" if warm is not None else "cold"
+        events.emit(
+            "replan.start", mode=mode, reason=None if warm else reason,
+            generation=generation,
+            dirtyPartitions=(
+                delta.n_dirty_partitions if delta is not None else None
+            ),
+            deltaModel=bool(delta is not None and not delta.full),
+        )
+        t0 = time.perf_counter()
+        kwargs = self.replanner.engine_kwargs(warm) if warm else {}
+        try:
+            result = self._goal_based_operation(
+                "PROPOSALS", state, None, OptimizationOptions(), True,
+                engine, progress, **kwargs,
+            )
+        except Exception as e:
+            if warm is None:
+                raise
+            # the warm attempt failed (seed infeasible under the new
+            # model, carry drift, ...): journal it, drop the replan state,
+            # and serve the request through one cold attempt
+            LOG.warning("warm replan failed, falling back cold: %r", e)
+            events.emit(
+                "replan.warm_failed", severity="WARNING", error=repr(e),
+                generation=generation,
+            )
+            self.replanner.reset("warm-failed")
+            mode, reason = "cold", "warm-failed"
+            result = self._goal_based_operation(
+                "PROPOSALS", state, None, OptimizationOptions(), True,
+                engine, progress,
+            )
+        self.replanner.commit(state, result, generation, agg_mark)
+        self.replanner.record_mode(mode, reason)
+        verify = getattr(result, "replan_verify", None)
+        events.emit(
+            "replan.end", mode=mode,
+            reason=None if mode == "warm" else reason,
+            generation=generation,
+            dirtyPartitions=(
+                delta.n_dirty_partitions if delta is not None else None
+            ),
+            deltaModel=bool(delta is not None and not delta.full),
+            tableCarry=bool(self.replanner.carry.tables is not None),
+            engine=result.engine,
+            goalsReused=(
+                len(verify["reusedAfter"]) if verify is not None else 0
+            ),
+            durationS=round(time.perf_counter() - t0, 4),
+        )
+        return result, state
 
     def _model_generation(self) -> str:
         gen = getattr(self.load_monitor, "model_generation", None)
@@ -908,15 +1033,19 @@ class CruiseControl:
         with self._cache_lock:
             plan = self._last_good
         if plan is None:
-            return {"cacheWarm": False}
-        return {
-            "cacheWarm": True,
-            "cacheFresh": self.proposal_cache_fresh(),
-            "cacheGeneration": plan.generation,
-            "cacheAgeS": round(plan.age_s(), 3),
-            "cacheInvalidated": plan.invalidated,
-            "cacheEngine": plan.engine,
-        }
+            out = {"cacheWarm": False}
+        else:
+            out = {
+                "cacheWarm": True,
+                "cacheFresh": self.proposal_cache_fresh(),
+                "cacheGeneration": plan.generation,
+                "cacheAgeS": round(plan.age_s(), 3),
+                "cacheInvalidated": plan.invalidated,
+                "cacheEngine": plan.engine,
+            }
+        if self.replanner is not None:
+            out["replan"] = self.replanner.state_summary()
+        return out
 
     def serve_proposals(
         self,

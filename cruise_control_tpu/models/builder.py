@@ -58,6 +58,63 @@ class _Partition:
     disks: Optional[List[int]] = None  # disk index per replica slot
 
 
+def patch_cluster_state(
+    prev_state: ClusterState,
+    *,
+    assignment: np.ndarray,
+    leader_slot: np.ndarray,
+    replica_offline: np.ndarray,
+    load_dirty: np.ndarray,
+    new_leader_load: np.ndarray,
+    broker_state: np.ndarray,
+    broker_ids: Sequence[int],
+    added_capacity: Optional[np.ndarray] = None,
+    added_racks: Optional[np.ndarray] = None,
+) -> ClusterState:
+    """Delta model build: produce the next :class:`ClusterState` by
+    patching the previous one's arrays instead of re-running the
+    per-partition builder loop (the monitor's ``cluster_model_delta``
+    front half computes the diffs; this is the assemble step).
+
+    The exactness contract the warm-start path relies on: rows NOT in
+    ``load_dirty`` keep the previous load tables' bits verbatim (follower
+    loads are re-derived only for dirty rows, with the same formula the
+    full builder uses), so resident device tables refreshed for exactly
+    the dirty rows equal a from-scratch rebuild bit-for-bit.  The broker
+    axis may only ever grow by appending (``added_capacity`` /
+    ``added_racks``) — an insert would shift internal indices, which the
+    caller must detect and route to the full builder.
+    """
+    prev_load = np.asarray(prev_state.leader_load, np.float32)
+    leader_load = np.where(
+        load_dirty[:, None], new_leader_load.astype(np.float32), prev_load
+    )
+    fol = leader_load.copy()
+    fol[:, Resource.NW_OUT] = 0.0
+    fol[:, Resource.CPU] = leader_load[:, Resource.CPU] * FOLLOWER_CPU_RATIO
+    follower_load = np.where(
+        load_dirty[:, None], fol,
+        np.asarray(prev_state.follower_load, np.float32),
+    )
+    capacity = np.asarray(prev_state.broker_capacity, np.float32)
+    rack = np.asarray(prev_state.broker_rack, np.int32)
+    if added_capacity is not None and len(added_capacity):
+        capacity = np.concatenate([capacity, added_capacity.astype(
+            np.float32)], axis=0)
+        rack = np.concatenate([rack, added_racks.astype(np.int32)])
+    return prev_state.replace(
+        assignment=np.asarray(assignment, np.int32),
+        leader_slot=np.asarray(leader_slot, np.int32),
+        leader_load=leader_load,
+        follower_load=follower_load,
+        replica_offline=np.asarray(replica_offline, bool),
+        broker_capacity=capacity,
+        broker_rack=rack,
+        broker_state=np.asarray(broker_state, np.int8),
+        broker_ids=tuple(broker_ids),
+    )
+
+
 class ClusterModelBuilder:
     """Accumulates brokers/partitions, emits a dense :class:`ClusterState`."""
 

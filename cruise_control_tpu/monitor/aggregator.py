@@ -95,6 +95,17 @@ class MetricSampleAggregator:
         self._window_index = np.full(W, -1, np.int64)
         self._first_window = -1  # earliest absolute window ever observed
         self._generation = 0
+        # ---- dirty tracking (delta replan) ----------------------------------
+        #: per-entity generation of the last accepted sample — consumers
+        #: diff against a remembered generation mark to get the entities
+        #: whose raw data changed since (O(E) compare, no mutation, so any
+        #: number of consumers can hold independent marks)
+        self._entity_touch_gen = np.zeros(num_entities, np.int64)
+        #: generation of the last window eviction.  An eviction changes the
+        #: window set, which shifts EVERY entity's mean — consumers seeing
+        #: ``eviction_generation > mark`` must treat all entities as
+        #: candidates, not just the sample-touched ones.
+        self._eviction_gen = 0
 
     # ---- ingest -----------------------------------------------------------------
     def ensure_entities(self, num_entities: int) -> None:
@@ -117,6 +128,11 @@ class MetricSampleAggregator:
             [self._count, np.zeros((W, extra), np.int64)], axis=1)
         self.num_entities = num_entities
         self._generation += 1
+        # brand-new entities are dirty by construction
+        self._entity_touch_gen = np.concatenate([
+            self._entity_touch_gen,
+            np.full(extra, self._generation, np.int64),
+        ])
 
     def _slot_for(self, abs_window: int) -> Optional[int]:
         hits = np.nonzero(self._window_index == abs_window)[0]
@@ -134,6 +150,7 @@ class MetricSampleAggregator:
         self._latest_ts[slot] = -1
         self._count[slot] = 0
         self._generation += 1
+        self._eviction_gen = self._generation
         return slot
 
     def add_sample(
@@ -154,6 +171,7 @@ class MetricSampleAggregator:
             self._latest_ts[slot, entity] = timestamp_ms
         self._count[slot, entity] += 1
         self._generation += 1
+        self._entity_touch_gen[entity] = self._generation
         return True
 
     def add_samples_batch(
@@ -300,6 +318,23 @@ class MetricSampleAggregator:
     def generation(self) -> int:
         """Monotonic state version (upstream aggregator generation)."""
         return self._generation
+
+    @property
+    def eviction_generation(self) -> int:
+        """Generation of the last window eviction (0 = never).  Past a
+        consumer's mark, window means may have shifted for entities no new
+        sample touched — the dirty set must widen to every entity."""
+        return self._eviction_gen
+
+    def dirty_entities_since(self, generation_mark: int) -> np.ndarray:
+        """bool [E] — entities whose raw samples changed after the mark.
+        When a window eviction happened after the mark this is all-True
+        (the roll moved every mean); otherwise exactly the sample-touched
+        set.  The delta-replan monitor narrows this candidate set further
+        by value-diffing against the previous model's loads."""
+        if self._eviction_gen > generation_mark:
+            return np.ones(self.num_entities, bool)
+        return self._entity_touch_gen > generation_mark
 
     @property
     def window_generation(self) -> int:

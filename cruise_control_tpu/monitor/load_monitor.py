@@ -349,12 +349,12 @@ class LoadMonitor:
             sp.set("partitions", state.num_partitions)
             return state
 
-    def _cluster_model(
-        self,
-        requirements: Optional[ModelCompletenessRequirements] = None,
-    ) -> ClusterState:
-        req = requirements or ModelCompletenessRequirements()
-        topo = self.metadata.refresh()
+    def _aggregated_means(
+        self, req: ModelCompletenessRequirements, topo: ClusterTopology
+    ):
+        """Shared aggregation front half of both model builds: enforce
+        completeness, collapse valid windows to per-partition mean loads.
+        Returns ``(mean_vals [max_pid, M], agg, wsel)``."""
         # completeness is scored over the topology's partition universe, not
         # the raw entity axis — sparse keys (deleted partitions) leave hole
         # entities in the aggregator that must not count as missing data
@@ -394,6 +394,15 @@ class LoadMonitor:
         if mean_vals.shape[0] < max_pid:
             pad = np.zeros((max_pid - mean_vals.shape[0], mean_vals.shape[1]))
             mean_vals = np.concatenate([mean_vals, pad], axis=0)
+        return mean_vals, agg, wsel
+
+    def _cluster_model(
+        self,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+    ) -> ClusterState:
+        req = requirements or ModelCompletenessRequirements()
+        topo = self.metadata.refresh()
+        mean_vals, agg, wsel = self._aggregated_means(req, topo)
 
         builder = ClusterModelBuilder()
         broker_index: Dict[int, int] = {}
@@ -453,6 +462,7 @@ class LoadMonitor:
             # carry the per-window series into the model (upstream
             # model/Load.java): [P, W, R] in the state's dense partition
             # order, follower series derived the same way as the mean
+            max_pid = max(topo.assignment, default=-1) + 1
             vals = agg.values[:, wsel, :]                    # [E, W, M]
             if vals.shape[0] < max_pid:
                 vals = np.concatenate(
@@ -477,6 +487,204 @@ class LoadMonitor:
                 capacity_percentile=self.capacity_estimation_percentile,
             )
         return state
+
+    # ---- delta model build (incremental re-optimization) ------------------------
+    def aggregation_mark(self) -> int:
+        """Aggregator generation to remember alongside a model snapshot —
+        ``cluster_model_delta`` diffs dirty entities against it."""
+        return self.partition_aggregator.generation
+
+    def cluster_model_delta(
+        self,
+        prev_state: ClusterState,
+        prev_mark: int,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+        prev_generation: str = "",
+        rel_threshold: float = 0.05,
+        abs_floor: float = 1e-6,
+    ):
+        """Build the next model by PATCHING ``prev_state``'s arrays, and
+        report what changed as a structured :class:`ModelDelta`.
+
+        The contract the warm-start path leans on: when ``delta.full`` is
+        False, every row NOT marked dirty is bit-identical to the previous
+        model (loads below ``rel_threshold`` relative drift keep the
+        previous values), so resident device tables only need the dirty
+        rows re-uploaded.  Structural drift the patch cannot express —
+        partition-universe changes, RF growth, broker reindexing, JBOD /
+        window-series models — degrades to the full builder with the
+        reason recorded.  Completeness requirements are enforced exactly
+        as in :meth:`cluster_model`.
+        """
+        from cruise_control_tpu.common.resources import (
+            EMPTY_SLOT,
+            BrokerState,
+        )
+        from cruise_control_tpu.replan.delta import ModelDelta
+
+        req = requirements or ModelCompletenessRequirements()
+        gen = self.model_generation()
+
+        def full(reason: str):
+            state = self._cluster_model(requirements)
+            return state, ModelDelta(
+                generation=gen, prev_generation=prev_generation,
+                full=True, reason=reason,
+            )
+
+        if (
+            prev_state.has_disks
+            or prev_state.leader_load_windows is not None
+            or self.capacity_estimation_percentile > 0
+        ):
+            return full("unsupported-model-features")
+        topo = self.metadata.refresh()
+        P, S = prev_state.num_partitions, prev_state.max_replication_factor
+        ext_p = list(prev_state.partition_ids or range(P))
+        if sorted(topo.assignment) != sorted(ext_p):
+            return full("partition-universe-changed")
+        if max((len(r) for r in topo.assignment.values()), default=1) > S:
+            return full("replication-factor-grew")
+        prev_b = list(prev_state.broker_ids or range(prev_state.num_brokers))
+        new_b = topo.broker_ids()
+        if new_b[: len(prev_b)] != prev_b:
+            # an insert in the middle shifts every internal index — the
+            # previous placement arrays no longer mean the same brokers
+            return full("broker-axis-reindexed")
+        added = tuple(new_b[len(prev_b):])
+        if added and any(
+            isinstance(topo.broker_rack.get(b), str) for b in added
+        ):
+            # string rack names densify through the builder's private
+            # name→id table, which the patch path cannot reconstruct
+            return full("added-broker-needs-rack-densification")
+        B = len(new_b)
+
+        mean_vals, _agg, _wsel = self._aggregated_means(req, topo)
+
+        # ---- load diff (vectorized, narrowed by the aggregator's dirty set)
+        idx = np.asarray(ext_p, int)
+        mv = mean_vals[idx]                                  # [P, M]
+        new_load = np.zeros((P, NUM_RESOURCES), np.float32)
+        new_load[:, Resource.CPU] = mv[:, P_CPU]
+        new_load[:, Resource.NW_IN] = mv[:, P_NW_IN]
+        new_load[:, Resource.NW_OUT] = mv[:, P_NW_OUT]
+        new_load[:, Resource.DISK] = mv[:, P_DISK]
+        prev_load = np.asarray(prev_state.leader_load, np.float32)
+        scale = np.maximum(np.abs(prev_load), abs_floor)
+        load_dirty = np.any(
+            np.abs(new_load - prev_load) > rel_threshold * scale, axis=1
+        )
+        # entities with no new sample AND no window eviction since the
+        # previous build cannot have moved — the value diff above already
+        # says so, this just documents that the aggregator's dirty set is
+        # a superset of the value diff
+        candidates = self.partition_aggregator.dirty_entities_since(prev_mark)
+        in_range = idx < candidates.shape[0]
+        load_dirty &= np.where(in_range, candidates[np.minimum(
+            idx, candidates.shape[0] - 1)], True)
+
+        # ---- topology diff
+        b_index = {e: i for i, e in enumerate(new_b)}
+        new_assign = np.full((P, S), EMPTY_SLOT, np.int32)
+        new_lslot = np.zeros(P, np.int32)
+        for i, pid in enumerate(ext_p):
+            reps = topo.assignment[pid]
+            for s, b in enumerate(reps):
+                new_assign[i, s] = b_index[b]
+            leader = topo.leaders[pid]
+            new_lslot[i] = reps.index(leader) if leader in reps else 0
+        prev_assign = np.asarray(prev_state.assignment)
+        prev_ls = np.asarray(prev_state.leader_slot)
+        topo_dirty = (
+            np.any(new_assign != prev_assign, axis=1)
+            | (new_lslot != prev_ls)
+        )
+
+        # ---- broker diff
+        alive = topo.alive_brokers
+        new_bstate = np.array([
+            int(BrokerState.ALIVE if alive is None or b in alive
+                else BrokerState.DEAD)
+            for b in new_b
+        ], np.int8)
+        prev_bstate = np.asarray(prev_state.broker_state, np.int8)
+
+        # offline flags: dead-broker replicas + per-replica disk failures
+        dead = (new_bstate == int(BrokerState.DEAD)) | (
+            new_bstate == int(BrokerState.REMOVED)
+        )
+        exists = new_assign != EMPTY_SLOT
+        new_off = exists & dead[np.clip(new_assign, 0, None)]
+        pid_to_row = {pid: i for i, pid in enumerate(ext_p)}
+        for pid, brokers in (topo.offline_replicas or {}).items():
+            i = pid_to_row.get(pid)
+            if i is None:
+                continue
+            for b in brokers:
+                bi = b_index.get(b)
+                if bi is None:
+                    continue
+                hits = np.nonzero(new_assign[i] == bi)[0]
+                if hits.size:
+                    new_off[i, hits[0]] = True
+        prev_off = np.asarray(prev_state.replica_offline, bool)
+        topo_dirty |= np.any(new_off != prev_off, axis=1)
+
+        dirty_brokers = np.zeros(B, bool)
+        n_prev = len(prev_b)
+        dirty_brokers[:n_prev] = new_bstate[:n_prev] != prev_bstate
+        dirty_brokers[n_prev:] = True
+        prev_dead = (prev_bstate == int(BrokerState.DEAD)) | (
+            prev_bstate == int(BrokerState.REMOVED)
+        )
+        removed = tuple(
+            b for i, b in enumerate(prev_b)
+            if dead[i] and not prev_dead[i]
+        )
+
+        # ---- patched state: untouched rows keep the previous bits
+        dirty = load_dirty | topo_dirty
+        add_cap = add_rack = None
+        if added:
+            from cruise_control_tpu.models.builder import _resource_vec
+
+            add_cap = np.stack([
+                _resource_vec(self.capacity_resolver.capacity_for_broker(b)
+                              .capacity)
+                for b in added
+            ])
+            add_rack = np.array(
+                [int(topo.broker_rack.get(b, 0)) for b in added], np.int32
+            )
+        from cruise_control_tpu.models.builder import patch_cluster_state
+
+        state = patch_cluster_state(
+            prev_state,
+            assignment=new_assign,
+            leader_slot=new_lslot,
+            replica_offline=new_off,
+            load_dirty=load_dirty,
+            new_leader_load=new_load,
+            broker_state=new_bstate,
+            broker_ids=new_b,
+            added_capacity=add_cap,
+            added_racks=add_rack,
+        )
+        delta = ModelDelta(
+            generation=gen,
+            prev_generation=prev_generation,
+            full=False,
+            dirty_partitions=dirty,
+            dirty_topology=topo_dirty,
+            dirty_brokers=dirty_brokers,
+            added_brokers=added,
+            removed_brokers=removed,
+            topology_changed=bool(topo_dirty.any()),
+            load_changed=bool(load_dirty.any()),
+            shape_changed=bool(added),
+        )
+        return state, delta
 
     # ---- observability ----------------------------------------------------------
     def state_summary(self) -> dict:

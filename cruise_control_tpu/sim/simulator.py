@@ -145,6 +145,13 @@ class ScenarioSpec:
     #: clocked on VIRTUAL time so trip/reset timing is deterministic
     breaker_failures: int = 0
     breaker_reset_ms: int = 4 * MIN_MS
+    # incremental re-optimization (delta replan): route the proposal
+    # refreshes through replan.DeltaReplanner — generation bumps
+    # warm-start from the previous plan instead of cold recomputing.
+    # Off by default so pre-existing scenario journals keep their bits.
+    replan_enabled: bool = False
+    replan_budget_ratio: float = 0.5
+    replan_load_threshold: float = 0.05
 
     def healing_enables(self) -> Dict[AnomalyType, bool]:
         return {
@@ -243,6 +250,33 @@ class ScenarioResult:
         """``analyzer.breaker`` payloads in journal order."""
         return [e.get("payload", {})
                 for e in self.events_of("analyzer.breaker")]
+
+    def replans(self, mode: Optional[str] = None) -> List[dict]:
+        """``replan.end`` payloads (one per proposal computation routed
+        through the delta replanner), optionally filtered by mode
+        (``warm``/``cold``)."""
+        out = [e.get("payload", {}) for e in self.events_of("replan.end")]
+        if mode is not None:
+            out = [p for p in out if p.get("mode") == mode]
+        return out
+
+    def replans_after_fault(self, fault_kind: str) -> List[dict]:
+        """``replan.end`` payloads that appear in the journal AFTER the
+        first scripted fault of the given kind (journal order — the
+        assertion vocabulary for 'the refresh after the drift served
+        warm')."""
+        fault_idx = None
+        out = []
+        for i, e in enumerate(self.journal):
+            if (
+                fault_idx is None
+                and e["kind"] == "sim.fault"
+                and e.get("payload", {}).get("fault") == fault_kind
+            ):
+                fault_idx = i
+            elif fault_idx is not None and e["kind"] == "replan.end":
+                out.append(e.get("payload", {}))
+        return out
 
     def heal_outcome(self) -> str:
         """Classify the run from the journal alone: HEALED / FIX_FAILED /
@@ -462,6 +496,21 @@ class _Sim:
             self.monitor, self.executor, engine="greedy",
             registry=MetricRegistry(), breaker=breaker,
         )
+        if spec.replan_enabled:
+            from cruise_control_tpu.replan import (
+                DeltaReplanner,
+                ReplanConfig,
+            )
+
+            # a restart rebuilds this cold (fresh monitor windows mean a
+            # fresh snapshot anyway) — exactly like a real redeploy
+            self.cc.replanner = DeltaReplanner(
+                self.monitor,
+                ReplanConfig(
+                    dirty_partition_budget_ratio=spec.replan_budget_ratio,
+                    dirty_load_rel_threshold=spec.replan_load_threshold,
+                ),
+            )
         if self.analyzer_down:
             _script_analyzer_outage(self.cc)
         self.manager = make_detector_manager(
@@ -656,6 +705,14 @@ def _apply_event(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
                 p for p, st in sim.backend.partitions.items()
                 if st.leader == leader
             )
+        detail["partitions"] = list(parts)
+        sim.workload.apply_skew(parts, ev.arg("factor"))
+    elif ev.kind == "perturb_broker_load":
+        broker = ev.arg("broker")
+        parts = sorted(
+            p for p, st in sim.backend.partitions.items()
+            if broker in st.replicas
+        )
         detail["partitions"] = list(parts)
         sim.workload.apply_skew(parts, ev.arg("factor"))
     elif ev.kind == "add_broker":

@@ -24,6 +24,10 @@ faults the executor must survive):
 ``hot_partition_skew``
     Multiplies the synthesized load of a partition subset (explicit ids, or
     "partitions currently led by broker N" resolved at fire time).
+``perturb_broker_load``
+    Scales the synthesized load of every partition HOSTED on one broker
+    (replica membership resolved at fire time) — the canonical
+    steady-state drift the delta-replan subsystem warm-starts over.
 ``add_broker``
     A new empty broker joins the cluster metadata.
 ``maintenance_event``
@@ -78,6 +82,7 @@ KINDS = (
     "disk_failure",
     "restore_disk",
     "hot_partition_skew",
+    "perturb_broker_load",
     "add_broker",
     "maintenance_event",
     "metric_gap",
@@ -168,6 +173,17 @@ def hot_partition_skew(
         partitions=tuple(int(p) for p in partitions) if partitions else None,
         leader=int(leader) if leader is not None else None,
     )
+
+
+def perturb_broker_load(
+    at_ms: int, broker: int, factor: float
+) -> TimelineEvent:
+    """Scale the load of every partition hosted on ``broker`` (resolved
+    from the live placement when the event fires) by ``factor``.  The
+    scaled load follows the partitions through subsequent rebalances —
+    this is persistent drift, not a transient spike."""
+    return _event(at_ms, "perturb_broker_load", broker=int(broker),
+                  factor=float(factor))
 
 
 def add_broker(at_ms: int, broker: int, rack: int) -> TimelineEvent:

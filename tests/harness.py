@@ -50,11 +50,15 @@ def full_stack(
     engine="greedy",
     executor_config=None,
     jbod_disks=None,
+    registry=None,
 ):
     """Build the whole system over a skewed simulated cluster.
 
     ``jbod_disks``: dict of dir name → capacity MB to give EVERY broker a
     JBOD layout; initial replicas all land on the first dir (skewed).
+    ``registry``: a private MetricRegistry for tests that assert exact
+    metric values — the default shares the process-wide registry, whose
+    counters accumulate across every test in the run.
     Returns (cruise_control, backend, reporter).
     """
     w, brokers = skewed_workload(
@@ -93,5 +97,5 @@ def full_stack(
         reporter.report(time_ms=wdx * WINDOW + 500)
         monitor.run_sampling_iteration((wdx + 1) * WINDOW)
     executor = Executor(backend, executor_config or ExecutorConfig())
-    cc = CruiseControl(monitor, executor, engine=engine)
+    cc = CruiseControl(monitor, executor, engine=engine, registry=registry)
     return cc, backend, reporter

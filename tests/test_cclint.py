@@ -321,6 +321,63 @@ RULE_FIXTURES = {
             "    return factory.pools.Queue()\n",
         ],
     },
+    "cache-key-discipline": {
+        "positive": [
+            # keyed cache, no generation term, no invalidate path: a
+            # stale plan is served as fresh forever
+            "class PlanCache:\n"
+            "    def __init__(self):\n"
+            "        self._plan_cache = {}\n"
+            "    def put(self, topic, plan):\n"
+            "        self._plan_cache[topic] = plan\n",
+            # attribute cache with no freshness companion at all
+            "class C:\n"
+            "    def refresh(self, model):\n"
+            "        self._cached_plan = self._compute(model)\n",
+            # memo keyed on a raw tuple without a version component
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._memo = {}\n"
+            "    def bounds(self, b, r):\n"
+            "        self._memo[(b, r)] = self._derive(b, r)\n",
+        ],
+        "negative": [
+            # generation term in the key
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._plan_cache = {}\n"
+            "    def put(self, topic, generation, plan):\n"
+            "        self._plan_cache[(topic, generation)] = plan\n",
+            # clear-on-mutation: invalidate() empties the memo
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._memo = {}\n"
+            "    def memo(self, key, fn):\n"
+            "        self._memo[key] = fn()\n"
+            "    def invalidate(self):\n"
+            "        self._memo.clear()\n",
+            # TTL sibling store records when the cache was filled
+            "import time\n"
+            "class C:\n"
+            "    def refresh(self, model):\n"
+            "        self._cached_plan = self._compute(model)\n"
+            "        self._cached_at = time.time()\n",
+            # the cached value itself carries its generation
+            "class C:\n"
+            "    def refresh(self, model, gen):\n"
+            "        self._cached_plan = CachedPlan(plan=model,\n"
+            "                                       generation=gen)\n",
+            # locks named like caches are infrastructure, not caches
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._cache_lock = threading.Lock()\n",
+            # storing None/empty IS the invalidation, never flagged
+            "class C:\n"
+            "    def invalidate_cache(self):\n"
+            "        self._cached_plan = None\n",
+        ],
+    },
     "swallowed-exception": {
         "positive": [
             "def loop(work):\n"

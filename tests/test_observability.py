@@ -313,7 +313,11 @@ def test_fix_failed_dumps_flight_recorder(tmp_path):
 # ---- GET /diagnostics + /metrics server contract --------------------------------
 @pytest.fixture
 def diag_server():
-    cc, backend, _ = full_stack()
+    # a PRIVATE registry: this fixture's tests assert exact counter
+    # values, and the process-wide default registry accumulates state
+    # from every other test in the run (the long-documented ordering
+    # flake was exactly that cross-test leakage)
+    cc, backend, _ = full_stack(registry=MetricRegistry())
     mgr = AnomalyDetectorManager(
         cc, detectors={},
         notifier=_StubNotifier(AnomalyNotificationResult.IGNORE),
@@ -354,15 +358,11 @@ def test_metrics_exposes_compile_and_anomaly_action_families(diag_server):
     assert status == 200
     assert 'cc_jit_compile_seconds_total{fn="all"}' in body
     assert 'cc_jit_retraces_total{fn="all"}' in body
-    # presence + label contract only, NOT the exact count: the registry is
-    # process-global and an earlier test's detector thread can land one
-    # more IGNORE before this GET (the long-documented ordering flake)
-    import re
-
-    ignore = re.search(
-        r'cc_anomaly_actions_total\{action="IGNORE"\} (\d+\.\d+)', body
-    )
-    assert ignore and float(ignore.group(1)) >= 1.0, body[:2000]
+    # EXACT count: the fixture's registry (and detector manager) are
+    # private to this test, so the one _handle() in the fixture is the
+    # only possible IGNORE — the old leak-tolerant >=1.0 assert papered
+    # over cross-test registry leakage the isolated registry removes
+    assert 'cc_anomaly_actions_total{action="IGNORE"} 1.0' in body
     assert "cc_jax_live_buffers" in body
     # request timers emit buckets (the migrated HTTP timer family).  The
     # endpoint timer is updated in the handler's `finally` AFTER the

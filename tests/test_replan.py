@@ -1,0 +1,495 @@
+"""Delta-replan subsystem contracts (incremental re-optimization).
+
+* Dirty tracking: the aggregator's per-entity dirty set and the monitor's
+  value-diffed ``ModelDelta`` — untouched rows of a delta model are
+  BIT-IDENTICAL to the previous model.
+* Warm-vs-cold equivalence (property-style over seeded drift deltas): a
+  warm-started plan's score stays within the parity gate's tolerance of
+  the cold plan on the same model, and the plan still passes the full
+  verifier.
+* Budget breach: a delta beyond the dirty budget falls back to the cold
+  path bit-identically (same actions, same proposals).
+* Device carry: the TPU engine's warm plan with the cross-plan pool-table
+  carry equals the carry-less warm plan bit-for-bit (the carried tables
+  are exact, not approximate).
+* Facade routing: replan decisions are journaled (``replan.start`` /
+  ``replan.end``) and a warm-path failure falls back to one cold attempt.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import AnalyzerContext
+from cruise_control_tpu.analyzer.goal_optimizer import (
+    GoalOptimizer,
+    make_goals,
+)
+from cruise_control_tpu.analyzer.verifier import (
+    goal_input_signatures,
+    partial_violations,
+    verify_result,
+    violation_score,
+)
+from cruise_control_tpu.monitor.aggregator import MetricSampleAggregator
+from cruise_control_tpu.monitor.metric_defs import MetricDef
+from cruise_control_tpu.replan import DeltaReplanner, ReplanConfig
+from cruise_control_tpu.replan.delta import ReplanCarry, WarmStart
+from cruise_control_tpu.telemetry import events
+
+from harness import WINDOW, full_stack
+
+
+def _warm_score_tolerance(cold_score: int) -> int:
+    """The parity-gate discipline, one-sided: the warm plan may not be
+    more than marginally worse than the cold plan on the same model."""
+    return cold_score + max(1, round(0.02 * cold_score))
+
+
+def _roll_windows(cc, reporter, start_window: int, n: int = 2):
+    """Report + ingest ``n`` fresh windows so the drifted loads become
+    COMPLETED windows the model build can see (the newest window is
+    always in-progress and excluded)."""
+    for k in range(start_window, start_window + n):
+        reporter.report(time_ms=k * WINDOW + 500)
+        cc.load_monitor.run_sampling_iteration((k + 1) * WINDOW)
+
+
+def _drift_broker(reporter, broker: int, factor: float, limit=None):
+    """Scale the load of partitions hosted on ``broker`` (the skewed test
+    workload leads everything on broker 0, so replica membership is the
+    selector that works for every broker).  ``limit`` caps the subset so
+    warm-path tests stay under the dirty budget."""
+    w = reporter.workload
+    parts = [p for p, reps in w.assignment.items() if broker in reps]
+    if limit is not None:
+        parts = parts[:limit]
+    for p in parts:
+        w.bytes_in[p] *= factor
+        w.bytes_out[p] *= factor
+    return parts
+
+
+# ---- aggregator dirty tracking ---------------------------------------------------
+def test_aggregator_dirty_entities_since():
+    from cruise_control_tpu.monitor.metric_defs import AggregationFunction
+
+    d = MetricDef()
+    d.define("m", AggregationFunction.AVG)
+    d.freeze()
+    agg = MetricSampleAggregator(d, num_entities=4, window_ms=100,
+                                 num_windows=3)
+    agg.add_sample(0, 50, [1.0])
+    mark = agg.generation
+    assert not agg.dirty_entities_since(mark).any()
+    agg.add_sample(2, 60, [2.0])
+    dirty = agg.dirty_entities_since(mark)
+    assert dirty.tolist() == [False, False, True, False]
+    # an eviction (window roll past retention) widens to all-True: the
+    # dropped window moved every entity's mean
+    for w in range(1, 6):
+        agg.add_sample(1, w * 100 + 1, [1.0])
+    assert agg.eviction_generation > mark
+    assert agg.dirty_entities_since(mark).all()
+    # new entities are dirty by construction
+    mark2 = agg.generation
+    agg.ensure_entities(6)
+    assert agg.dirty_entities_since(mark2)[4:].all()
+
+
+# ---- monitor delta build ---------------------------------------------------------
+def test_cluster_model_delta_patches_only_dirty_rows():
+    cc, backend, reporter = full_stack(num_partitions=24, num_brokers=4)
+    mon = cc.load_monitor
+    prev = mon.cluster_model()
+    mark = mon.aggregation_mark()
+    drifted = _drift_broker(reporter, 0, 3.0)
+    _roll_windows(cc, reporter, 3)
+    state, delta = mon.cluster_model_delta(prev, mark)
+    assert not delta.full
+    assert delta.load_changed and not delta.topology_changed
+    dirty = delta.dirty_partitions
+    assert set(np.nonzero(dirty)[0]) <= set(drifted)
+    assert dirty.any()
+    # clean rows keep the previous model's BITS; dirty rows match a
+    # from-scratch build exactly
+    fresh = mon._cluster_model()
+    pl = np.asarray(prev.leader_load)
+    nl = np.asarray(state.leader_load)
+    fl = np.asarray(fresh.leader_load)
+    assert np.array_equal(nl[~dirty], pl[~dirty])
+    assert np.array_equal(nl[dirty], fl[dirty])
+    assert np.array_equal(
+        np.asarray(state.follower_load)[dirty],
+        np.asarray(fresh.follower_load)[dirty],
+    )
+
+
+def test_cluster_model_delta_broker_death_and_add():
+    cc, backend, reporter = full_stack(num_partitions=24, num_brokers=4)
+    mon = cc.load_monitor
+    prev = mon.cluster_model()
+    mark = mon.aggregation_mark()
+    backend.failed_brokers.add(3)
+    _roll_windows(cc, reporter, 3)
+    state, delta = mon.cluster_model_delta(prev, mark)
+    assert not delta.full
+    assert delta.topology_changed
+    assert delta.removed_brokers == (3,)
+    assert delta.dirty_brokers[3]
+    # every partition with a replica on the dead broker is topology-dirty
+    hosts3 = np.any(np.asarray(prev.assignment) == 3, axis=1)
+    assert (delta.dirty_topology >= hosts3).all()
+    # broker add: prefix-compatible axis growth, no full rebuild
+    prev2 = state
+    mark2 = mon.aggregation_mark()
+    backend.brokers.add(4)
+    mon.metadata.broker_rack[4] = 0
+    _roll_windows(cc, reporter, 5)
+    state2, delta2 = mon.cluster_model_delta(prev2, mark2)
+    assert not delta2.full
+    assert delta2.shape_changed and delta2.added_brokers == (4,)
+    assert state2.num_brokers == 5
+    assert np.asarray(state2.broker_capacity).shape[0] == 5
+
+
+def test_cluster_model_delta_falls_back_full_on_universe_drift():
+    cc, backend, reporter = full_stack(num_partitions=12, num_brokers=3)
+    mon = cc.load_monitor
+    prev = mon.cluster_model()
+    mark = mon.aggregation_mark()
+    # a brand-new partition changes the universe → full rebuild
+    backend.partitions[99] = type(next(iter(backend.partitions.values())))(
+        replicas=[0, 1], leader=0
+    )
+    state, delta = mon.cluster_model_delta(prev, mark)
+    assert delta.full and delta.reason == "partition-universe-changed"
+    assert state.num_partitions == 13
+
+
+# ---- context reseed + partial verify ---------------------------------------------
+def test_reseed_rebuilds_exact_aggregates():
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(seed=9, num_brokers=6, num_racks=3,
+                           num_partitions=40)
+    res = GoalOptimizer().optimize(state)
+    ctx = AnalyzerContext(state)
+    ctx.reseed(
+        np.asarray(res.final_state.assignment),
+        np.asarray(res.final_state.leader_slot),
+    )
+    ref = AnalyzerContext(res.final_state)
+    assert np.allclose(ctx.broker_load, ref.broker_load)
+    assert np.array_equal(ctx.broker_replica_count, ref.broker_replica_count)
+    assert np.array_equal(ctx.broker_leader_count, ref.broker_leader_count)
+    ctx.recompute_check()
+
+
+def test_partial_violations_signature_reuse_is_exact():
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(seed=4, num_brokers=6, num_racks=3,
+                           num_partitions=40)
+    goals = make_goals()
+    ctx = AnalyzerContext(state)
+    sigs = goal_input_signatures(ctx, goals)
+    truth = {g.name: g.violations(ctx) for g in goals}
+    # identical context: everything reuses, nothing recomputes wrong
+    wrong = {name: v + 100 for name, v in truth.items()}
+    reused_viol, _, reused = partial_violations(ctx, goals, sigs, wrong)
+    assert set(reused) == set(truth)
+    assert reused_viol == wrong  # proves reuse actually happened
+    # a load perturbation invalidates exactly the load-reading goals
+    ctx2 = AnalyzerContext(state)
+    ctx2.leader_load = ctx2.leader_load.copy()
+    ctx2.leader_load[0] *= 1.5
+    viol2, _, reused2 = partial_violations(ctx2, goals, sigs, wrong)
+    for g in goals:
+        if "loads" in g.inputs:
+            assert g.name not in reused2
+            assert viol2[g.name] == g.violations(ctx2)
+        else:
+            assert g.name in reused2
+    # the safety net recomputes everything
+    full_viol, _, none_reused = partial_violations(
+        ctx, goals, sigs, wrong, force_full=True
+    )
+    assert none_reused == [] and full_viol == truth
+
+
+# ---- warm-vs-cold equivalence (property-style) -----------------------------------
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_warm_plan_score_within_parity_tolerance(seed):
+    """Seeded drift deltas: the warm-started plan must score inside the
+    parity tolerance of the cold plan computed on the SAME drifted model,
+    and still pass the full verifier."""
+    rng = np.random.default_rng(seed)
+    cc, backend, reporter = full_stack(num_partitions=24, num_brokers=4)
+    mon = cc.load_monitor
+    prev = mon.cluster_model()
+    mark = mon.aggregation_mark()
+    opt = GoalOptimizer()
+    prev_res = opt.optimize(prev)
+
+    broker = int(rng.integers(0, 4))
+    factor = float(rng.uniform(1.5, 4.0))
+    _drift_broker(reporter, broker, factor, limit=4)
+    _roll_windows(cc, reporter, 3)
+    state, delta = mon.cluster_model_delta(prev, mark)
+    assert not delta.full and delta.dirty_partitions.any()
+
+    goals = make_goals()
+    cold = GoalOptimizer().optimize(state)
+    fctx = AnalyzerContext(prev_res.final_state)
+    warm = GoalOptimizer().optimize(state, warm_start=WarmStart(
+        assignment=np.asarray(prev_res.final_state.assignment),
+        leader_slot=np.asarray(prev_res.final_state.leader_slot),
+        prev_actions=list(prev_res.actions),
+        dirty_partitions=delta.dirty_partitions,
+        prev_signatures=goal_input_signatures(fctx, goals),
+        prev_violations=prev_res.violations_after,
+    ))
+    verify_result(state, warm, goals)
+    s_cold = violation_score(cold.final_state, goals)
+    s_warm = violation_score(warm.final_state, goals)
+    assert s_warm <= _warm_score_tolerance(s_cold), (seed, s_warm, s_cold)
+
+
+def test_budget_breach_falls_back_cold_bit_identically():
+    """A delta beyond the dirty budget must produce EXACTLY the cold
+    path's plan — the fallback is the cold path, not a degraded warm."""
+    def build():
+        cc, backend, reporter = full_stack(num_partitions=24, num_brokers=4)
+        return cc, backend, reporter
+
+    # replanner with a zero-ish budget: every delta breaches
+    cc1, b1, r1 = build()
+    cc1.replanner = DeltaReplanner(
+        cc1.load_monitor,
+        ReplanConfig(dirty_partition_budget_ratio=0.0001),
+    )
+    cc2, b2, r2 = build()
+
+    for cc, reporter in ((cc1, r1), (cc2, r2)):
+        cc.get_proposals(ignore_cache=True)
+        _drift_broker(reporter, 0, 3.0)
+        _roll_windows(cc, reporter, 3)
+    p1 = cc1.get_proposals(ignore_cache=True)
+    p2 = cc2.get_proposals(ignore_cache=True)
+    assert cc1.replanner.last_mode == "cold"
+    assert "dirty-budget-exceeded" in cc1.replanner.last_reason
+    acts = lambda r: [
+        (a.action_type, a.partition, a.slot, a.source_broker, a.dest_broker,
+         a.dest_slot) for a in r.actions
+    ]
+    assert acts(p1) == acts(p2)
+    assert [pr.to_json() for pr in p1.proposals] == [
+        pr.to_json() for pr in p2.proposals
+    ]
+
+
+# ---- TPU engine: warm start + device carry ---------------------------------------
+@pytest.mark.parametrize("small_repool_budget", [True, False])
+def test_tpu_warm_carry_matches_carryless_warm(small_repool_budget):
+    """The cross-plan pool-table carry is a pure diet: the warm plan with
+    the carried device model + tables must equal the carry-less warm plan
+    bit-for-bit (actions and final placement), whether the first repool
+    runs the incremental refresh (budget < P) or the full rebuild."""
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.models.generators import random_cluster
+
+    state = random_cluster(seed=13, num_brokers=10, num_racks=5,
+                           num_partitions=80)
+    kwargs = dict(steps_per_call=16, repool_steps=4, device_batch_per_step=8,
+                  max_rounds=40)
+    if small_repool_budget:
+        kwargs.update(repool_incremental=True, repool_rows_budget=24)
+    cfg = TpuSearchConfig(**kwargs)
+
+    goals = make_goals()
+    carry = ReplanCarry()
+    opt = TpuGoalOptimizer(config=cfg)
+    prev = opt.optimize(state, carry=carry)
+    assert carry.valid and carry.model is not None
+
+    # drift: perturb the loads of every partition led by broker 0
+    lead = np.asarray(state.leader_broker())
+    dirty = lead == 0
+    new_leader_load = np.asarray(state.leader_load).copy()
+    new_leader_load[dirty] *= 1.7
+    new_follower = new_leader_load.copy()
+    from cruise_control_tpu.common.resources import (
+        FOLLOWER_CPU_RATIO,
+        Resource,
+    )
+
+    new_follower[:, Resource.NW_OUT] = 0.0
+    new_follower[:, Resource.CPU] *= FOLLOWER_CPU_RATIO
+    drifted = state.replace(
+        leader_load=np.where(
+            dirty[:, None], new_leader_load, np.asarray(state.leader_load)
+        ),
+        follower_load=np.where(
+            dirty[:, None], new_follower, np.asarray(state.follower_load)
+        ),
+    )
+
+    fctx = AnalyzerContext(prev.final_state)
+
+    def warm_start():
+        return WarmStart(
+            assignment=np.asarray(prev.final_state.assignment),
+            leader_slot=np.asarray(prev.final_state.leader_slot),
+            prev_actions=list(prev.actions),
+            dirty_partitions=dirty.copy(),
+            prev_signatures=goal_input_signatures(fctx, goals),
+            prev_violations=prev.violations_after,
+        )
+
+    with_carry = TpuGoalOptimizer(config=cfg).optimize(
+        drifted, warm_start=warm_start(), carry=carry
+    )
+    without_carry = TpuGoalOptimizer(config=cfg).optimize(
+        drifted, warm_start=warm_start()
+    )
+    acts = lambda r: [
+        (a.action_type, a.partition, a.slot, a.source_broker, a.dest_broker,
+         a.dest_slot) for a in r.actions
+    ]
+    assert acts(with_carry) == acts(without_carry)
+    assert np.array_equal(
+        np.asarray(with_carry.final_state.assignment),
+        np.asarray(without_carry.final_state.assignment),
+    )
+    verify_result(drifted, with_carry, goals)
+    # quality: warm stays inside the parity tolerance of cold
+    cold = TpuGoalOptimizer(config=cfg).optimize(drifted)
+    s_cold = violation_score(cold.final_state, goals)
+    s_warm = violation_score(with_carry.final_state, goals)
+    assert s_warm <= _warm_score_tolerance(s_cold), (s_warm, s_cold)
+
+
+# ---- committed artifact ----------------------------------------------------------
+def test_committed_replan_artifact_gates_hold():
+    """REPLAN_r09.json must match its checked-in schema and show every
+    gate green: settled replans ≥10× on every (engine, fixture) pair,
+    absorb floors met, scores inside the parity tolerance, and the
+    dirty-tracking overhead within ±1%.  Regenerate via
+    ``PYTHONPATH=. python benchmarks/replan_bench.py --best-of 3
+    --artifact REPLAN_r09.json``."""
+    import json
+    import pathlib
+
+    from jsonschema import validate
+
+    root = pathlib.Path(__file__).parent
+    schemas = json.loads((root / "schemas" / "artifacts.schema.json")
+                         .read_text())
+    art = json.loads((root.parent / "REPLAN_r09.json").read_text())
+    validate(art, schemas["cc-tpu-replan/1"])
+    assert art["gates"]["pass"] is True
+    names = {(f["engine"], f["name"]) for f in art["fixtures"]}
+    assert {e for e, _ in names} == {"greedy", "tpu"}
+    assert {n for _, n in names} == {
+        "load_perturbation", "broker_removed", "broker_added"
+    }
+    for f in art["fixtures"]:
+        assert f["mode"] == "warm", f
+        assert f["settle_speedup"] >= 10.0, f
+        assert f["settle_score_ok"] and f["absorb_score_ok"], f
+    # one-sided like the bench gate: negative = tracking measured FREE
+    # (interleaved best-of noise on a contended box)
+    assert art["overhead"]["replan_overhead_pct"] <= 1.0
+
+
+# ---- facade routing --------------------------------------------------------------
+def test_facade_replan_journals_warm_and_serves_cache():
+    cc, backend, reporter = full_stack(num_partitions=24, num_brokers=4)
+    cc.replanner = DeltaReplanner(cc.load_monitor, ReplanConfig())
+    events.configure(enabled=True)
+    try:
+        events.JOURNAL.recent()  # touch to ensure journal exists
+        cc.get_proposals(ignore_cache=True)
+        _drift_broker(reporter, 0, 2.5, limit=3)
+        _roll_windows(cc, reporter, 3)
+        assert not cc.proposal_cache_fresh()
+        cc.get_proposals(ignore_cache=True)
+        ends = [
+            e["payload"] for e in events.JOURNAL.recent()
+            if e["kind"] == "replan.end"
+        ]
+        assert ends[-1]["mode"] == "warm"
+        assert ends[-1]["deltaModel"] is True
+        assert ends[-1]["dirtyPartitions"] > 0
+        assert cc.replanner.warm_plans == 1
+        # the warm plan is now the fresh cached plan the server serves
+        assert cc.proposal_cache_fresh()
+        result, meta = cc.serve_proposals()
+        assert meta["cached"] is True and meta["stale"] is False
+    finally:
+        events.configure(enabled=False)
+        events.reset()
+
+
+def test_zero_delta_short_circuit_serves_previous_plan():
+    """A generation bump over a BIT-IDENTICAL model (every drift below
+    the dirty threshold) re-validates the previous plan without an
+    engine call — and the full-verify safety net disables that."""
+    cc, backend, reporter = full_stack(num_partitions=24, num_brokers=4)
+    cc.replanner = DeltaReplanner(cc.load_monitor, ReplanConfig())
+    first = cc.get_proposals(ignore_cache=True)
+    _roll_windows(cc, reporter, 3)  # stable workload: zero delta
+    events.configure(enabled=True)
+    try:
+        second = cc.get_proposals(ignore_cache=True)
+        assert second is first  # the very same result object — no search
+        (end,) = [e["payload"] for e in events.JOURNAL.recent()
+                  if e["kind"] == "replan.end"]
+        assert end["mode"] == "warm" and end.get("shortCircuit") is True
+        # the snapshot re-anchored at the new generation
+        assert cc.replanner.snapshot.generation == \
+            cc.load_monitor.model_generation()
+        # safety net: full verify forces the engine to run
+        cc.replanner.config.full_verify = True
+        _roll_windows(cc, reporter, 5)
+        third = cc.get_proposals(ignore_cache=True)
+        assert third is not second
+    finally:
+        events.configure(enabled=False)
+        events.reset()
+
+
+def test_facade_warm_failure_falls_back_cold(monkeypatch):
+    cc, backend, reporter = full_stack(num_partitions=24, num_brokers=4)
+    cc.replanner = DeltaReplanner(cc.load_monitor, ReplanConfig())
+    cc.get_proposals(ignore_cache=True)
+    _drift_broker(reporter, 1, 2.5, limit=3)
+    _roll_windows(cc, reporter, 3)
+
+    real = GoalOptimizer.optimize
+    calls = {"warm": 0}
+
+    def boom(self, state, options=None, warm_start=None, carry=None):
+        if warm_start is not None:
+            calls["warm"] += 1
+            raise RuntimeError("scripted warm failure")
+        return real(self, state, options)
+
+    monkeypatch.setattr(GoalOptimizer, "optimize", boom)
+    events.configure(enabled=True)
+    try:
+        res = cc.get_proposals(ignore_cache=True)
+        assert calls["warm"] == 1
+        assert res is not None
+        assert cc.replanner.last_mode == "cold"
+        assert cc.replanner.last_reason == "warm-failed"
+        kinds = [e["kind"] for e in events.JOURNAL.recent()]
+        assert "replan.warm_failed" in kinds
+        # the replan state was reset — the NEXT plan rebuilds a snapshot
+        assert cc.replanner.snapshot is not None  # committed by fallback
+    finally:
+        events.configure(enabled=False)
+        events.reset()

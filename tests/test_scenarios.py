@@ -9,7 +9,7 @@ true story, which is what an operator reconstructing an incident has.
 
 Tier-1 runs the SMOKE subset plus the determinism and artifact contracts;
 the full ≥10-scenario matrix is ``slow`` (the committed
-``SCENARIOS_r09.json`` artifact keeps its outcomes honest in every run).
+``SCENARIOS_r10.json`` artifact keeps its outcomes honest in every run).
 The crash/resume scenarios (ISSUE 7) prove — from the journal alone —
 that a process crash mid-execution resumes without re-moving completed
 partitions.
@@ -39,7 +39,7 @@ from cruise_control_tpu.sim.timeline import (
 from test_artifact_schemas import SCHEMAS, validate
 
 MIN = MIN_MS
-ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r09.json"
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r10.json"
 
 #: the outcome each scripted timeline must reach — also pinned against the
 #: committed artifact below, so a regression shows up in tier-1 without
@@ -65,6 +65,8 @@ EXPECTED_OUTCOMES = {
     "request_storm_sheds_with_retry_after": "NO_ANOMALY",
     "slow_loris_connection_reaped": "NO_ANOMALY",
     "crash_mid_request_recovers_front_door": "HEALED",
+    "warm_replan_after_drift": "HEALED",
+    "warm_replan_after_add_broker": "HEALED",
 }
 
 _cache = {}
@@ -325,6 +327,39 @@ def _check_crash_mid_request_recovers_front_door(r):
     assert recovery["outcome"] == "resumed" and recovery["succeeded"]
 
 
+# ---- incremental re-optimization (delta replan, ISSUE 9) -------------------------
+def _check_warm_replan_after_drift(r):
+    """The journal alone proves the refresh after the drift served WARM:
+    the first replan that saw the drifted windows took the delta path
+    (dirty partitions marked, delta model build), no refresh between the
+    drift and the heal cold-recomputed, and the violation healed."""
+    after = r.replans_after_fault("perturb_broker_load")
+    assert after, "no replans after the drift fault"
+    absorbing = [p for p in after if p.get("dirtyPartitions", 0) > 0]
+    assert absorbing, "no replan ever saw the drifted windows"
+    first = absorbing[0]
+    assert first["mode"] == "warm" and first["deltaModel"] is True
+    # the whole steady state stays warm: after the cold bootstrap plan,
+    # every routed refresh — including post-drift and post-heal — served
+    # from the delta path (the dirty set may also carry the heal's
+    # topology rows when the fix lands between refreshes)
+    assert [p["mode"] for p in r.replans()].count("cold") == 1
+    assert r.fixes_started("GOAL_VIOLATION")
+    assert r.actions_executed() > 0
+
+
+def _check_warm_replan_after_add_broker(r):
+    """Broker-axis growth stays on the delta path: the refreshes after
+    the add are warm with deltaModel=True (the model was patched, not
+    rebuilt), and the maintenance fix moves replicas onto the newcomer."""
+    after = r.replans_after_fault("add_broker")
+    assert after, "no replans after the broker add"
+    assert after[0]["mode"] == "warm" and after[0]["deltaModel"] is True
+    assert [p["mode"] for p in r.replans()].count("cold") == 1
+    assert r.fixes_started("MAINTENANCE_EVENT")
+    assert r.actions_executed() > 0
+
+
 CHECKS = {
     "broker_death_mid_execution": _check_broker_death_mid_execution,
     "rack_loss": _check_rack_loss,
@@ -351,6 +386,8 @@ CHECKS = {
     "slow_loris_connection_reaped": _check_slow_loris_connection_reaped,
     "crash_mid_request_recovers_front_door":
         _check_crash_mid_request_recovers_front_door,
+    "warm_replan_after_drift": _check_warm_replan_after_drift,
+    "warm_replan_after_add_broker": _check_warm_replan_after_add_broker,
 }
 
 
@@ -435,9 +472,9 @@ def test_live_artifact_matches_schema():
 
 
 def test_committed_artifact_is_current():
-    """SCENARIOS_r09.json (the CLI's output) must cover the whole registry
+    """SCENARIOS_r10.json (the CLI's output) must cover the whole registry
     with the expected heal outcomes — regenerate it via
-    ``python -m cruise_control_tpu.sim --artifact SCENARIOS_r09.json``
+    ``python -m cruise_control_tpu.sim --artifact SCENARIOS_r10.json``
     whenever scenarios change."""
     art = json.loads(ARTIFACT_PATH.read_text())
     validate(art, SCHEMAS["cc-tpu-scenarios/1"])
@@ -460,7 +497,7 @@ def test_smoke_scenarios_match_committed_artifact():
         r = result_for(name)
         assert r.fingerprint() == by_name[name]["journalFingerprint"], (
             f"{name}: journal drifted from the committed artifact — "
-            "behavior changed; regenerate SCENARIOS_r09.json and review"
+            "behavior changed; regenerate SCENARIOS_r10.json and review"
         )
 
 
