@@ -1,17 +1,22 @@
 """cclint — the repo-native static-analysis pass.
 
-Rule-based AST lint for the invariants this codebase enforces by
-convention: lock discipline in its threaded daemons, host-sync and
-retrace hygiene in its jitted hot paths, closure of the config surface
-across code/registry/docs, static observability names, and loud daemon
-loops.  ``docs/STATIC_ANALYSIS.md`` describes every rule, the CLI, and
+Two-phase, whole-program rule pack for the invariants this codebase
+enforces by convention.  Phase 1 (per file, content-hash cached under
+``.cclint_cache/``): lock discipline in the threaded daemons, host-sync
+and retrace hygiene in the jitted hot paths, static observability
+names, loud daemon loops, bounded resources, retry and cache-key
+discipline.  Phase 2 (project symbol graph + call graph): cross-module
+locksets, transitive jax-hot-path, deadline propagation from the HTTP
+handlers, journal-schema closure, and the config-surface closure.
+``docs/STATIC_ANALYSIS.md`` describes the architecture, every rule, and
 the suppression policy; ``tests/test_cclint.py`` runs the pass over the
 package as a tier-1 test with a zero-findings contract.
 
 Usage::
 
     python -m cruise_control_tpu.devtools.lint [paths] \
-        [--format=text|json] [--rule=id[,id]] [--changed-only]
+        [--format=text|json|sarif] [--rule=id[,id]] [--changed-only] \
+        [--stats]
 """
 
 from cruise_control_tpu.devtools.lint.context import FileContext

@@ -27,8 +27,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         help=f"files or directories to lint (default: {default_target()})",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="output format (json follows tests/schemas/lint.schema.json)",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="output format (json follows tests/schemas/lint.schema.json; "
+             "sarif is the 2.1.0 profile in tests/schemas/"
+             "sarif.schema.json for editor/CI annotation)",
+    )
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="print phase accounting (files parsed, cache hits, graph "
+             "build ms) — the budget test asserts on these",
     )
     parser.add_argument(
         "--rule", action="append", default=None, metavar="ID[,ID]",
@@ -62,7 +69,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     result = run_lint(paths=args.paths or None, rules=rules,
                       changed_only=args.changed_only)
-    print(render(result, args.format))
+    print(render(result, args.format, show_stats=args.stats))
     return 1 if result.findings else 0
 
 

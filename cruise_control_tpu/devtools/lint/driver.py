@@ -1,24 +1,43 @@
-"""cclint driver: collect files, parse each once, run every rule,
-apply suppressions, render.
+"""cclint driver — the two-phase whole-program pass.
+
+Phase 1 (per file, cached): parse once, run every per-file rule on the
+shared :class:`FileContext`, extract the picklable
+:class:`~graph.ModuleSummary`.  Both products are cached under
+``.cclint_cache/`` keyed by content hash and salted with the lint
+package's own sources, so a warm run re-parses nothing.
+
+Phase 2 (whole program): assemble the summaries into the
+:class:`~graph.SymbolGraph` (+ lazy :class:`~callgraph.CallGraph`) and
+run the project rules — the interprocedural lockset, transitive
+jax-hot-path, deadline propagation, journal-schema closure, and the
+config-surface closure.
 
 The contract the pytest wrapper (``tests/test_cclint.py``) enforces:
 
-* single parse per file — every rule reads the shared
-  :class:`FileContext`;
-* the whole-package pass completes in < 5 s;
+* single parse per file — every rule reads the shared context (or the
+  cache of its products);
+* the whole-package pass completes in < 5 s, cold AND warm;
 * the merged tree yields ZERO findings — true positives get fixed,
-  deliberate exceptions get an inline suppression with a reason.
+  deliberate exceptions get an inline suppression with a reason;
+* ``--changed-only`` re-lints reverse-dependents of changed modules
+  via the import graph, and always runs the project rules over the
+  full graph, so interprocedural findings cannot be dodged by a
+  partial diff.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import pathlib
 import subprocess
 import time
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Set
 
+from cruise_control_tpu.devtools.lint import graph as graph_mod
+from cruise_control_tpu.devtools.lint import sarif as sarif_mod
+from cruise_control_tpu.devtools.lint.cache import CacheEntry, CacheStore
 from cruise_control_tpu.devtools.lint.context import FileContext
 from cruise_control_tpu.devtools.lint.findings import (
     BAD_SUPPRESSION,
@@ -26,6 +45,7 @@ from cruise_control_tpu.devtools.lint.findings import (
     Suppressions,
     parse_suppressions,
 )
+from cruise_control_tpu.devtools.lint.project import ProjectContext
 from cruise_control_tpu.devtools.lint.rules_bounded import (
     BoundedResourceRule,
 )
@@ -33,6 +53,9 @@ from cruise_control_tpu.devtools.lint.rules_cache import (
     CacheKeyDisciplineRule,
 )
 from cruise_control_tpu.devtools.lint.rules_config import ConfigKeyDriftRule
+from cruise_control_tpu.devtools.lint.rules_deadline import (
+    DeadlinePropagationRule,
+)
 from cruise_control_tpu.devtools.lint.rules_except import (
     SwallowedExceptionRule,
 )
@@ -40,6 +63,9 @@ from cruise_control_tpu.devtools.lint.rules_jax import JaxHotPathRule
 from cruise_control_tpu.devtools.lint.rules_lock import LockDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_obs import ObsDynamicNameRule
 from cruise_control_tpu.devtools.lint.rules_retry import RetryDisciplineRule
+from cruise_control_tpu.devtools.lint.rules_schema import JournalSchemaRule
+from cruise_control_tpu.devtools.lint.rules_xjax import JaxTransitiveRule
+from cruise_control_tpu.devtools.lint.rules_xlock import CrossModuleLockRule
 
 SCHEMA = "cc-tpu-lint/1"
 
@@ -56,6 +82,10 @@ RULES = {
         RetryDisciplineRule(),
         BoundedResourceRule(),
         CacheKeyDisciplineRule(),
+        CrossModuleLockRule(),
+        JaxTransitiveRule(),
+        DeadlinePropagationRule(),
+        JournalSchemaRule(),
     )
 }
 
@@ -68,6 +98,17 @@ def default_target() -> pathlib.Path:
 
 def _repo_root() -> pathlib.Path:
     return default_target().parent
+
+
+def cache_dir() -> Optional[pathlib.Path]:
+    """``.cclint_cache/`` under the repo root (override with
+    CCLINT_CACHE_DIR; CCLINT_CACHE=0 disables).  Safe to delete."""
+    if os.environ.get("CCLINT_CACHE", "1") == "0":
+        return None
+    override = os.environ.get("CCLINT_CACHE_DIR")
+    if override:
+        return pathlib.Path(override)
+    return _repo_root() / ".cclint_cache"
 
 
 def collect_files(paths: Sequence[str],
@@ -122,6 +163,9 @@ class LintResult:
     duration_s: float
     suppressions_used: int
     unused_suppressions: List[tuple]  # (path, line, rule)
+    #: phase/budget accounting (the --stats surface): filesParsed is
+    #: cache misses, cacheHits warm reuses, graphBuildMs phase 2
+    stats: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def counts(self) -> Dict[str, int]:
@@ -138,9 +182,15 @@ class LintResult:
             "filesScanned": self.files_scanned,
             "suppressionsUsed": self.suppressions_used,
             "durationS": round(self.duration_s, 3),
+            "stats": {
+                "filesParsed": int(self.stats.get("filesParsed", 0)),
+                "cacheHits": int(self.stats.get("cacheHits", 0)),
+                "graphBuildMs": round(
+                    float(self.stats.get("graphBuildMs", 0.0)), 3),
+            },
         }
 
-    def render_text(self) -> str:
+    def render_text(self, show_stats: bool = False) -> str:
         lines = [f.render() for f in self.findings]
         for path, line, rule in self.unused_suppressions:
             lines.append(
@@ -153,45 +203,132 @@ class LintResult:
             f"({self.suppressions_used} suppression(s) honored, "
             f"{self.duration_s:.2f}s)"
         )
+        if show_stats:
+            lines.append(
+                f"cclint stats: {int(self.stats.get('filesParsed', 0))} "
+                f"parsed, {int(self.stats.get('cacheHits', 0))} cache "
+                f"hit(s), graph build "
+                f"{self.stats.get('graphBuildMs', 0.0):.1f} ms"
+            )
         return "\n".join(lines)
+
+
+def _per_file_rules(selected) -> list:
+    return [r for r in selected if not getattr(r, "project_rule", False)]
+
+
+def _project_rules(selected) -> list:
+    return [r for r in selected if getattr(r, "project_rule", False)]
 
 
 def run_lint(paths: Optional[Sequence[str]] = None,
              rules: Optional[Iterable[str]] = None,
-             changed_only: bool = False) -> LintResult:
+             changed_only: bool = False,
+             changed_paths: Optional[Set[pathlib.Path]] = None) -> LintResult:
+    """``changed_paths`` overrides the git-derived changed set (tests
+    inject it; the CLI always derives it from git)."""
     t0 = time.perf_counter()
     targets = [str(p) for p in (paths or [default_target()])]
     selected = [RULES[r] for r in (rules or RULES)]
-    files = collect_files(targets, changed_only=changed_only)
+    selected_ids = {r.id for r in selected}
+    files = collect_files(targets)
     known_ids = set(RULES) | {BAD_SUPPRESSION}
 
-    ctxs: List[FileContext] = []
+    store = CacheStore(cache_dir(), graph_mod.lint_sources_salt())
+    all_per_file = [r for r in RULES.values()
+                    if not getattr(r, "project_rule", False)]
+
     findings: List[Finding] = []
     supp_by_path: Dict[str, Suppressions] = {}
+    summaries: List[graph_mod.ModuleSummary] = []
+    abs_by_rel: Dict[str, pathlib.Path] = {}
+    per_file_findings: Dict[str, List[Finding]] = {}
+    parsed = 0
+
+    # ---- phase 1: per-file (cached) ---------------------------------------------
     for path in files:
         rel = _rel(str(path))
+        abs_by_rel[rel] = path
         try:
             text = path.read_text()
-            ctx = FileContext.parse(rel, text)
-        except (OSError, SyntaxError, ValueError) as e:
-            findings.append(Finding(rel, getattr(e, "lineno", 1) or 1,
-                                    "parse-error", f"cannot lint: {e}"))
+        except OSError as e:
+            findings.append(Finding(rel, 1, "parse-error",
+                                    f"cannot lint: {e}"))
             continue
-        ctxs.append(ctx)
-        supp_by_path[rel] = parse_suppressions(rel, ctx.text, known_ids)
-
-    for ctx in ctxs:
-        for rule in selected:
-            if getattr(rule, "project_rule", False):
+        supp_by_path[rel] = parse_suppressions(rel, text, known_ids)
+        h = graph_mod.file_hash(text)
+        entry = store.get(h)
+        if entry is None:
+            try:
+                ctx = FileContext.parse(rel, text)
+            except (SyntaxError, ValueError) as e:
+                findings.append(
+                    Finding(rel, getattr(e, "lineno", 1) or 1,
+                            "parse-error", f"cannot lint: {e}"))
                 continue
-            findings.extend(rule.check_file(ctx))
-    for rule in selected:
-        if getattr(rule, "project_rule", False):
-            raw = rule.check_project(ctxs)
+            parsed += 1
+            raw: List[Finding] = []
+            for rule in all_per_file:
+                raw.extend(rule.check_file(ctx))
+            summary = graph_mod.extract_summary(ctx.tree, ctx.all_nodes)
+            entry = CacheEntry(
+                summary=summary,
+                findings=[(f.rule, f.line, f.col, f.message)
+                          for f in raw],
+            )
+            store.put(h, entry)
+        mod, _root = graph_mod.module_name_for(path)
+        summary = dataclasses.replace(entry.summary, path=rel,
+                                      module=mod)
+        summaries.append(summary)
+        per_file_findings[rel] = [
+            Finding(rel, line, rule, message, col)
+            for rule, line, col, message in entry.findings
+            if rule in selected_ids
+        ]
+    store.save()
+
+    # ---- phase 2: the whole-program graph ---------------------------------------
+    t_graph = time.perf_counter()
+    graph = graph_mod.build_graph(summaries)
+
+    lint_set: Set[str] = set(per_file_findings)
+    if changed_only:
+        changed = (changed_paths if changed_paths is not None
+                   else changed_files())
+        if changed is not None:
+            changed_rels = {_rel(str(p)) for p in changed}
+            seeds = {
+                s.module for s in summaries
+                if s.path in changed_rels and s.module is not None
+            }
+            closure = graph.dependents_closure(seeds)
+            lint_set = {
+                s.path for s in summaries
+                if s.path in changed_rels or s.module in closure
+            }
+
+    for rel in sorted(lint_set):
+        findings.extend(per_file_findings.get(rel, ()))
+
+    project = ProjectContext(
+        graph=graph,
+        summaries=summaries,
+        linted_abs={p.resolve() for p in files},
+        repo_root=_repo_root(),
+    )
+    # Under --changed-only the project rules still run over the FULL
+    # graph (an interprocedural finding cannot be dodged by a partial
+    # diff) — unless nothing changed at all, the pre-commit no-op.
+    if not (changed_only and not lint_set):
+        for rule in _project_rules(selected):
+            raw = rule.check_project(project)
             findings.extend(
                 dataclasses.replace(f, path=_rel(f.path)) for f in raw
             )
+    graph_ms = (time.perf_counter() - t_graph) * 1000.0
 
+    # ---- suppression filter ------------------------------------------------------
     kept: List[Finding] = []
     for f in findings:
         supp = supp_by_path.get(f.path)
@@ -201,8 +338,14 @@ def run_lint(paths: Optional[Sequence[str]] = None,
     used = 0
     unused: List[tuple] = []
     for rel, supp in supp_by_path.items():
-        kept.extend(supp.malformed)
         used += len(supp.used)
+        if rel not in lint_set:
+            # outside the (possibly --changed-only-restricted) lint set
+            # this file's per-file findings were dropped, so neither its
+            # malformed-suppression findings nor unused-suppression
+            # notes are meaningful this run
+            continue
+        kept.extend(supp.malformed)
         for line, ids in sorted(supp.by_line.items()):
             for rule_id in sorted(ids):
                 if (line, rule_id) not in supp.used:
@@ -210,14 +353,22 @@ def run_lint(paths: Optional[Sequence[str]] = None,
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
     return LintResult(
         findings=kept,
-        files_scanned=len(files),
+        files_scanned=len(lint_set),
         duration_s=time.perf_counter() - t0,
         suppressions_used=used,
         unused_suppressions=unused,
+        stats={
+            "filesParsed": parsed,
+            "cacheHits": store.hits,
+            "graphBuildMs": graph_ms,
+        },
     )
 
 
-def render(result: LintResult, fmt: str = "text") -> str:
+def render(result: LintResult, fmt: str = "text",
+           show_stats: bool = False) -> str:
     if fmt == "json":
         return json.dumps(result.to_json(), indent=1)
-    return result.render_text()
+    if fmt == "sarif":
+        return json.dumps(sarif_mod.to_sarif(result, RULES), indent=1)
+    return result.render_text(show_stats=show_stats)

@@ -1,0 +1,734 @@
+"""Phase 1 of the whole-program pass: the project symbol graph.
+
+``cclint`` grew up as a per-file rule pack; the interprocedural rules
+(``cross-module-lock``, ``jax-transitive``, ``deadline-propagation``,
+``journal-schema``) need a view that crosses the function and file
+boundary.  This module extracts ONE picklable :class:`ModuleSummary`
+per file — imports, classes (locks, attribute types), functions (call
+sites with held-context info, attribute accesses, host-sync ops, jit
+membership, event emits), config keys — and assembles the summaries
+into a :class:`SymbolGraph` with import resolution and reverse
+dependencies.  ``callgraph.py`` layers call edges and reachability on
+top.
+
+Summaries are pure data (no AST references), so they cache: the driver
+stores them under ``.cclint_cache/`` keyed by file content hash, salted
+with a hash of the lint package's own sources (editing any rule
+invalidates everything).  A warm run re-extracts nothing and re-parses
+only changed files; the whole-program phase then rebuilds the graph
+from summaries in milliseconds, which is how the package-wide pass
+stays inside the < 5 s budget in ``tests/test_cclint.py``.
+
+Approximations (documented in docs/STATIC_ANALYSIS.md): receiver types
+come from constructor assignments (``x = ClassName(...)``,
+``self._y = ClassName(...)``), parameter annotations, and
+``var = self`` aliasing — not from dataflow; calls through containers,
+dynamic dispatch, and monkey-patching are invisible."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import pathlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from cruise_control_tpu.devtools.lint import rules_config
+
+#: bump (or just edit any lint source — the salt covers it) to drop
+#: cached summaries whose shape this module no longer understands
+SUMMARY_VERSION = 1
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_NP_SYNC_FUNCS = {"asarray", "array"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_SAFE_CTORS = {"Event", "Semaphore", "BoundedSemaphore", "Barrier",
+               "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+               "ThreadPoolExecutor", "ProcessPoolExecutor"}
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "add", "update", "setdefault", "pop", "popleft", "popitem",
+             "remove", "discard", "clear", "sort", "reverse", "rotate"}
+#: callee-name pattern for compile-cache-key factories whose config
+#: argument is normalized via dataclasses.replace(...)
+_CACHE_FN_HINTS = ("_cached_", "_fn_cache", "cache_key")
+
+
+# ---- summary records (all picklable, no AST) ------------------------------------
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    callee: str                  # dotted as written: "f", "mod.f", "self._x.m"
+    lineno: int
+    nargs: int                   # positional arg count
+    kwargs: Tuple[str, ...]      # keyword names present
+    none_kwargs: Tuple[str, ...]  # keywords whose value is literal None
+    arg_exprs: Tuple[str, ...]   # dotted reprs of the first args ("" = complex)
+    with_ctxs: Tuple[str, ...]   # dotted with-contexts held at this site
+    first_arg_false: bool = False  # first positional arg is literal False
+
+
+@dataclasses.dataclass(frozen=True)
+class AttrAccess:
+    recv: str                    # "self", "x", "self._y" (dotted receiver)
+    attr: str
+    write: bool
+    lineno: int
+    with_ctxs: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class EmitSite:
+    callee: str                  # "events.emit", "emit", "self._journal.emit"
+    lineno: int
+    kind: Optional[str]          # literal kind, None when dynamic
+    fields: Tuple[str, ...]      # payload keyword names
+    star: bool                   # **kwargs present → field set unknown
+    severity: Optional[str]      # literal severity keyword, if any
+
+
+@dataclasses.dataclass
+class FuncSummary:
+    name: str                    # "f", "C.m", "start>Handler.do_GET"
+    cls: Optional[str]           # innermost enclosing class name
+    lineno: int
+    params: Tuple[str, ...]
+    annotations: Dict[str, str]  # param → dotted type as written
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    accesses: List[AttrAccess] = dataclasses.field(default_factory=list)
+    var_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    sync_ops: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    attr_reads: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list)          # (recv Name, attr, lineno)
+    is_jit: bool = False
+    static_params: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class ClassSummary:
+    name: str
+    lineno: int
+    bases: Tuple[str, ...]
+    lock_attrs: Set[str] = dataclasses.field(default_factory=set)
+    safe_attrs: Set[str] = dataclasses.field(default_factory=set)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class ModuleSummary:
+    path: str                                   # repo-relative (driver sets)
+    module: Optional[str]                       # dotted name (driver sets)
+    #: raw import records: (level, from_module or None, name, alias)
+    imports: List[Tuple[int, Optional[str], str, str]] = dataclasses.field(
+        default_factory=list)
+    functions: Dict[str, FuncSummary] = dataclasses.field(
+        default_factory=dict)
+    classes: Dict[str, ClassSummary] = dataclasses.field(
+        default_factory=dict)
+    config_keys: List[Tuple[str, int]] = dataclasses.field(
+        default_factory=list)
+    emits: List[EmitSite] = dataclasses.field(default_factory=list)
+    #: compile-cache-key normalization sites: (lineno, excluded key names)
+    normalized_keys: List[Tuple[int, Tuple[str, ...]]] = dataclasses.field(
+        default_factory=list)
+
+
+# ---- dotted-expression helpers --------------------------------------------------
+def dotted(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` → "a.b.c" for pure Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def anno_to_dotted(node: ast.expr) -> Optional[str]:
+    """Annotation → dotted type: plain chains, forward-ref strings
+    ("CruiseControlFacade"), and Optional[X] unwrapped to X."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        v = node.value.strip()
+        return v if v.replace(".", "").replace("_", "").isalnum() else None
+    if isinstance(node, ast.Subscript):
+        head = dotted(node.value)
+        if head and head.rsplit(".", 1)[-1] == "Optional":
+            return anno_to_dotted(node.slice)
+        return None
+    return dotted(node)
+
+
+def _with_ctx_expr(item: ast.withitem) -> Optional[str]:
+    """The dotted string a with-item holds: a plain dotted expr for
+    ``with self._lock:``, the call's dotted func for
+    ``with deadline_scope(...):`` / ``with self.admission.admit(c):``."""
+    expr = item.context_expr
+    d = dotted(expr)
+    if d is not None:
+        return d
+    if isinstance(expr, ast.Call):
+        return dotted(expr.func)
+    return None
+
+
+def module_name_for(path: pathlib.Path) -> Tuple[Optional[str], pathlib.Path]:
+    """(dotted module name, package root dir) by ascending while
+    ``__init__.py`` exists — works for the real package and for fixture
+    packages in tmp dirs alike.  A bare file outside any package gets its
+    stem as module name and its parent as root."""
+    path = path.resolve()
+    parts = [path.stem] if path.name != "__init__.py" else []
+    cur = path.parent
+    while (cur / "__init__.py").exists():
+        parts.insert(0, cur.name)
+        nxt = cur.parent
+        if nxt == cur:
+            break
+        cur = nxt
+    if not parts:
+        parts = [path.parent.name]
+    return ".".join(parts), cur
+
+
+# ---- extraction -----------------------------------------------------------------
+class _Extractor:
+    """One pass over a module tree producing a ModuleSummary."""
+
+    def __init__(self, tree: ast.Module, jit_funcs=None):
+        self.summary = ModuleSummary(path="", module=None)
+        #: AST FunctionDef → (static param names) for jit contexts, from
+        #: rules_jax.find_jit_functions (shared, single source of truth)
+        self._jit: Dict[ast.AST, Set[str]] = dict(jit_funcs or ())
+        self._scan_module(tree)
+
+    # -- scope walk -------------------------------------------------------------
+    # Function keys encode the lexical nesting: a method is
+    # ``ClassKey.name``, a nested def is ``parentkey>name``, a class
+    # defined inside a function keys as ``parentkey>ClassName`` (so the
+    # Handler-inside-start() idiom resolves).  Closure lookups ascend by
+    # splitting on ``>``.
+    _MODULE_KEY = "<module>"
+
+    def _scan_module(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                self._record_import(stmt)
+            elif isinstance(stmt, ast.If):
+                # `if TYPE_CHECKING:` (and try/except import fallbacks
+                # one level down) still bind names the resolver needs
+                for sub in stmt.body + stmt.orelse:
+                    if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                        self._record_import(sub)
+                rec = self._module_func()
+                self._scan_stmt(stmt, rec, (), cls_key=None,
+                                func_key=self._MODULE_KEY)
+            elif isinstance(stmt, ast.ClassDef):
+                self._scan_class(stmt, prefix="")
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(stmt, cls_key=None, prefix="", sep="")
+            else:
+                rec = self._module_func()
+                self._scan_stmt(stmt, rec, (), cls_key=None,
+                                func_key=self._MODULE_KEY)
+
+    def _module_func(self) -> FuncSummary:
+        key = self._MODULE_KEY
+        if key not in self.summary.functions:
+            self.summary.functions[key] = FuncSummary(
+                name=key, cls=None, lineno=0, params=(), annotations={})
+        return self.summary.functions[key]
+
+    def _record_import(self, stmt) -> None:
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                alias = a.asname or a.name.split(".")[0]
+                target = a.name if a.asname else a.name.split(".")[0]
+                self.summary.imports.append((0, None, target, alias))
+        else:
+            mod = stmt.module or ""
+            for a in stmt.names:
+                if a.name == "*":
+                    continue
+                self.summary.imports.append(
+                    (stmt.level, mod, a.name, a.asname or a.name))
+
+    def _scan_class(self, cls: ast.ClassDef, prefix: str) -> None:
+        key = f"{prefix}>{cls.name}" if prefix else cls.name
+        rec = ClassSummary(
+            name=key, lineno=cls.lineno,
+            bases=tuple(d for d in (dotted(b) for b in cls.bases) if d),
+        )
+        self.summary.classes[key] = rec
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                rec.methods.add(stmt.name)
+                self._scan_function(stmt, cls_key=key, prefix=key, sep=".")
+            elif isinstance(stmt, ast.ClassDef):
+                self._scan_class(stmt, prefix)
+
+    def _scan_function(self, fn, cls_key: Optional[str], prefix: str,
+                       sep: str) -> None:
+        key = f"{prefix}{sep}{fn.name}" if prefix else fn.name
+        args = fn.args
+        params = tuple(a.arg for a in args.posonlyargs + args.args
+                       + args.kwonlyargs)
+        annos = {
+            a.arg: d
+            for a in args.posonlyargs + args.args + args.kwonlyargs
+            if a.annotation is not None
+            and (d := anno_to_dotted(a.annotation)) is not None
+        }
+        rec = FuncSummary(name=key, cls=cls_key, lineno=fn.lineno,
+                          params=params, annotations=annos)
+        if fn in self._jit:
+            rec.is_jit = True
+            rec.static_params = tuple(sorted(self._jit[fn]))
+        self.summary.functions[key] = rec
+        for stmt in fn.body:
+            self._scan_stmt(stmt, rec, (), cls_key=cls_key, func_key=key)
+
+    # -- statement walk with held with-contexts --
+    def _scan_stmt(self, node: ast.stmt, rec: FuncSummary,
+                   held: Tuple[str, ...], cls_key: Optional[str],
+                   func_key: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its body runs later on whatever thread calls it
+            self._scan_function(node, cls_key=cls_key, prefix=func_key,
+                                sep=">")
+            return
+        if isinstance(node, ast.ClassDef):
+            self._scan_class(node, prefix=func_key)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            ctxs = tuple(c for c in (_with_ctx_expr(i) for i in node.items)
+                         if c)
+            for i in node.items:
+                self._scan_expr(i.context_expr, rec, held)
+            inner = held + ctxs
+            for stmt in node.body:
+                self._scan_stmt(stmt, rec, inner, cls_key, func_key)
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            if value is not None:
+                self._scan_expr(value, rec, held)
+                self._note_binding(targets, value, rec)
+            for tgt in targets:
+                self._scan_target(tgt, rec, held)
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                self._scan_target(tgt, rec, held)
+            return
+        # compound statements: recurse with the same held set
+        for field in ("body", "orelse", "finalbody"):
+            for stmt in getattr(node, field, ()):
+                self._scan_stmt(stmt, rec, held, cls_key, func_key)
+        for handler in getattr(node, "handlers", ()):
+            for stmt in handler.body:
+                self._scan_stmt(stmt, rec, held, cls_key, func_key)
+        for field in ("test", "iter", "value", "exc", "msg"):
+            child = getattr(node, field, None)
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, rec, held)
+
+    def _note_binding(self, targets, value: ast.expr,
+                      rec: FuncSummary) -> None:
+        """Record receiver-type facts: ``x = ClassName(...)``,
+        ``self._y = Lock()`` (class attr kinds), ``alias = self``,
+        ``self.tasks = param or Ctor()`` (either operand types it), and
+        ``self.cc = param`` when the parameter is annotated."""
+        if isinstance(value, ast.BoolOp):
+            operand = next(
+                (v for v in value.values if isinstance(v, ast.Call)),
+                next((v for v in value.values
+                      if isinstance(v, ast.Name)), None))
+            if operand is not None:
+                self._note_binding(targets, operand, rec)
+            return
+        ctor = None
+        if isinstance(value, ast.Call):
+            ctor = dotted(value.func)
+        elif isinstance(value, ast.Name) and value.id in rec.params:
+            ctor = rec.annotations.get(value.id)
+        is_self = isinstance(value, ast.Name) and value.id == "self"
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                if ctor is not None:
+                    rec.var_types[tgt.id] = ctor
+                elif is_self:
+                    rec.var_types[tgt.id] = "<self>"
+            elif isinstance(tgt, ast.Attribute):
+                d = dotted(tgt)
+                if d is None or ctor is None:
+                    continue
+                if d.startswith("self.") and d.count(".") == 1 \
+                        and rec.cls is not None:
+                    attr = d.split(".", 1)[1]
+                    csum = self.summary.classes.get(rec.cls)
+                    if csum is not None:
+                        tail = ctor.rsplit(".", 1)[-1]
+                        if tail in _LOCK_CTORS:
+                            csum.lock_attrs.add(attr)
+                        elif tail in _SAFE_CTORS:
+                            csum.safe_attrs.add(attr)
+                        else:
+                            csum.attr_types.setdefault(attr, ctor)
+
+    def _scan_target(self, tgt: ast.expr, rec: FuncSummary,
+                     held: Tuple[str, ...]) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._scan_target(el, rec, held)
+            return
+        node = tgt
+        while isinstance(node, ast.Subscript):
+            self._scan_expr(node.slice, rec, held)
+            node = node.value
+        d = dotted(node)
+        if d is not None and "." in d:
+            recv, attr = d.rsplit(".", 1)
+            rec.accesses.append(AttrAccess(recv, attr, True,
+                                           tgt.lineno, held))
+
+    # -- expression walk --
+    def _scan_expr(self, expr: ast.expr, rec: FuncSummary,
+                   held: Tuple[str, ...]) -> None:
+        nodes = list(ast.walk(expr))
+        # a call's func attribute is the call site, not an attribute
+        # read (self._shed(...) must not make _shed a "guarded attr")
+        call_funcs = {id(n.func) for n in nodes
+                      if isinstance(n, ast.Call)}
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                self._note_call(node, rec, held)
+            elif isinstance(node, ast.Attribute) \
+                    and id(node) not in call_funcs \
+                    and isinstance(node.ctx, ast.Load):
+                d = dotted(node)
+                if d is None:
+                    continue
+                recv, attr = d.rsplit(".", 1)
+                if recv == "self":
+                    rec.accesses.append(AttrAccess(recv, attr, False,
+                                                   node.lineno, held))
+                elif "." not in recv:
+                    rec.attr_reads.append((recv, attr, node.lineno))
+            elif isinstance(node, (ast.Lambda,)):
+                pass  # lambdas stay opaque (documented blind spot)
+
+    def _note_call(self, node: ast.Call, rec: FuncSummary,
+                   held: Tuple[str, ...]) -> None:
+        callee = dotted(node.func)
+        if callee is None:
+            return
+        tail = callee.rsplit(".", 1)[-1]
+        # mutator calls on a dotted receiver are attribute writes
+        if tail in _MUTATORS and "." in callee:
+            base = callee.rsplit(".", 1)[0]
+            if "." in base:
+                recv, attr = base.rsplit(".", 1)
+                rec.accesses.append(AttrAccess(recv, attr, True,
+                                               node.lineno, held))
+        kwargs = tuple(kw.arg for kw in node.keywords if kw.arg)
+        none_kwargs = tuple(
+            kw.arg for kw in node.keywords
+            if kw.arg and isinstance(kw.value, ast.Constant)
+            and kw.value.value is None
+        )
+        arg_exprs = tuple(dotted(a) or "" for a in node.args[:4])
+        first_false = bool(
+            node.args and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value is False
+        )
+        rec.calls.append(CallSite(
+            callee=callee, lineno=node.lineno, nargs=len(node.args),
+            kwargs=kwargs, none_kwargs=none_kwargs, arg_exprs=arg_exprs,
+            with_ctxs=held, first_arg_false=first_false,
+        ))
+        # Thread(target=f): surface the target as an arg expr so the
+        # call graph can treat it as called (kwarg order-independent)
+        if tail == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target" and (d := dotted(kw.value)):
+                    rec.calls.append(CallSite(
+                        callee=d, lineno=node.lineno, nargs=0, kwargs=(),
+                        none_kwargs=(), arg_exprs=(), with_ctxs=(),
+                    ))
+        # host-sync ops, recorded for EVERY function: the transitive
+        # jax rule decides whether a jit context reaches them
+        if tail in _SYNC_METHODS and isinstance(node.func, ast.Attribute):
+            rec.sync_ops.append((node.lineno, f".{tail}() host sync"))
+        elif tail == "device_get" and "." in callee:
+            rec.sync_ops.append((node.lineno, "jax.device_get host sync"))
+        elif tail in _NP_SYNC_FUNCS and "." in callee \
+                and callee.split(".", 1)[0] in _NP_MODULES:
+            rec.sync_ops.append(
+                (node.lineno, f"{callee}() materializes on host"))
+        # events.emit(...) sites for the journal-schema rule
+        if tail == "emit":
+            kind = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                kind = node.args[0].value
+            severity = None
+            fields = []
+            for kw in node.keywords:
+                if kw.arg == "severity":
+                    if isinstance(kw.value, ast.Constant):
+                        severity = kw.value.value
+                elif kw.arg in ("operation", "task_id", "kind"):
+                    if kw.arg == "kind" and kind is None \
+                            and isinstance(kw.value, ast.Constant):
+                        kind = kw.value.value
+                elif kw.arg is not None:
+                    fields.append(kw.arg)
+            if len(node.args) >= 2 and severity is None \
+                    and isinstance(node.args[1], ast.Constant):
+                severity = node.args[1].value
+            self.summary.emits.append(EmitSite(
+                callee=callee, lineno=node.lineno, kind=kind,
+                fields=tuple(fields),
+                star=any(kw.arg is None for kw in node.keywords),
+                severity=severity,
+            ))
+        # config getter call sites (rules_config consumes these)
+        if isinstance(node.func, ast.Attribute):
+            claimed = tail in rules_config._TYPED_GETTERS
+            if not claimed and tail == "get":
+                recv = node.func.value
+                name = (recv.id if isinstance(recv, ast.Name)
+                        else recv.attr if isinstance(recv, ast.Attribute)
+                        else None)
+                claimed = name in rules_config._CONFIG_RECEIVERS
+            if claimed and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                self.summary.config_keys.append(
+                    (node.args[0].value, node.args[0].lineno))
+        # compile-cache-key normalization: a *_cached_* factory taking a
+        # dataclasses.replace(cfg, k=..., ...) argument declares k
+        # excluded from the compile cache key
+        if any(h in callee for h in _CACHE_FN_HINTS):
+            for a in node.args:
+                if isinstance(a, ast.Call) \
+                        and dotted(a.func) in ("dataclasses.replace",
+                                               "replace"):
+                    keys = tuple(kw.arg for kw in a.keywords if kw.arg)
+                    if keys:
+                        self.summary.normalized_keys.append(
+                            (node.lineno, keys))
+
+
+def extract_summary(tree: ast.Module, nodes=None) -> ModuleSummary:
+    """Build a ModuleSummary for one parsed file.  ``nodes`` is the
+    FileContext's memoized flat node list (used only to find jit
+    contexts without an extra walk)."""
+    from cruise_control_tpu.devtools.lint.rules_jax import (
+        find_jit_functions,
+    )
+
+    jit = [(fn, set(static)) for fn, static in
+           find_jit_functions(tree, nodes)]
+    return _Extractor(tree, jit).summary
+
+
+# ---- the assembled graph --------------------------------------------------------
+@dataclasses.dataclass
+class SymbolGraph:
+    """All module summaries plus resolution helpers."""
+
+    modules: Dict[str, ModuleSummary]          # dotted module → summary
+    by_path: Dict[str, ModuleSummary]          # finding path → summary
+    package_roots: Dict[str, pathlib.Path]     # dotted module → pkg root
+
+    def __post_init__(self):
+        self._import_map: Dict[str, Dict[str, str]] = {}
+        self._class_index: Dict[str, Tuple[str, ClassSummary]] = {}
+        for mod, s in self.modules.items():
+            for cname, csum in s.classes.items():
+                self._class_index.setdefault(f"{mod}.{cname}", (mod, csum))
+
+    # -- import resolution --
+    def import_aliases(self, module: str) -> Dict[str, str]:
+        """alias → absolute dotted target for one module."""
+        cached = self._import_map.get(module)
+        if cached is not None:
+            return cached
+        s = self.modules.get(module)
+        out: Dict[str, str] = {}
+        if s is not None:
+            pkg_parts = module.split(".")[:-1]
+            for level, from_mod, name, alias in s.imports:
+                if level == 0 and from_mod is None:
+                    out[alias] = name
+                    continue
+                if level == 0:
+                    base = from_mod
+                else:
+                    up = pkg_parts[: len(pkg_parts) - (level - 1)]
+                    base = ".".join(up + ([from_mod] if from_mod else []))
+                out[alias] = f"{base}.{name}" if base else name
+        self._import_map[module] = out
+        return out
+
+    def module_deps(self, module: str) -> Set[str]:
+        """Project modules this module imports (for the import graph)."""
+        out: Set[str] = set()
+        for target in self.import_aliases(module).values():
+            # target may be a module or a module attribute — try both
+            if target in self.modules:
+                out.add(target)
+            else:
+                parent = target.rsplit(".", 1)[0] if "." in target else None
+                if parent in self.modules:
+                    out.add(parent)
+        out.discard(module)
+        return out
+
+    def reverse_deps(self) -> Dict[str, Set[str]]:
+        """module → set of modules importing it (direct)."""
+        rev: Dict[str, Set[str]] = {m: set() for m in self.modules}
+        for m in self.modules:
+            for dep in self.module_deps(m):
+                if dep in rev:
+                    rev[dep].add(m)
+        return rev
+
+    def dependents_closure(self, seeds: Set[str]) -> Set[str]:
+        """seeds plus every module that transitively imports one."""
+        rev = self.reverse_deps()
+        out, stack = set(), list(seeds)
+        while stack:
+            m = stack.pop()
+            if m in out:
+                continue
+            out.add(m)
+            stack.extend(rev.get(m, ()))
+        return out
+
+    # -- symbol resolution --
+    def resolve_class(self, module: str,
+                      name: str) -> Optional[Tuple[str, ClassSummary]]:
+        """A dotted class name as written in ``module`` → (defining
+        module, ClassSummary), following import aliases."""
+        s = self.modules.get(module)
+        if s is None:
+            return None
+        if name in s.classes:
+            return module, s.classes[name]
+        aliases = self.import_aliases(module)
+        head, _, rest = name.partition(".")
+        target = aliases.get(head)
+        if target is None:
+            return self._class_index.get(name)
+        full = f"{target}.{rest}" if rest else target
+        hit = self._class_index.get(full)
+        if hit is not None:
+            return hit
+        # alias may name a module: "mod.Class"
+        if rest and target in self.modules:
+            csum = self.modules[target].classes.get(rest)
+            if csum is not None:
+                return target, csum
+        return None
+
+    def class_method(self, module: str, csum: ClassSummary,
+                     method: str, _depth=0):
+        """(module, FuncSummary) for a method, ascending base classes
+        (project classes only, left-to-right, depth-capped)."""
+        s = self.modules.get(module)
+        if s is not None:
+            fs = s.functions.get(f"{csum.name}.{method}")
+            if fs is not None:
+                return module, fs
+        if _depth >= 4:
+            return None
+        for base in csum.bases:
+            hit = self.resolve_class(module, base)
+            if hit is not None:
+                found = self.class_method(hit[0], hit[1], method,
+                                          _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def class_of_receiver(self, module: str, func: FuncSummary,
+                          recv: str) -> Optional[Tuple[str, ClassSummary]]:
+        """Best-effort class of a receiver expression inside ``func``:
+        ``self`` → enclosing class; locals via constructor assignment /
+        annotation / ``alias = self``; ``self._y`` via the class's
+        constructor-assigned attribute types."""
+        head, _, rest = recv.partition(".")
+        if head == "self":
+            if func.cls is None:
+                return None
+            s = self.modules.get(module)
+            csum = s.classes.get(func.cls) if s else None
+            hit = (module, csum) if csum is not None else None
+        else:
+            ctor = func.var_types.get(head) or func.annotations.get(head)
+            if ctor == "<self>":
+                hit = self.class_of_receiver(module, func, "self")
+            elif ctor is not None:
+                hit = self.resolve_class(module, ctor)
+            elif ">" in func.name:
+                # closure lookup: ascend enclosing functions by key
+                s = self.modules.get(module)
+                parent_key = func.name.rsplit(">", 1)[0]
+                parent = s.functions.get(parent_key) if s else None
+                if parent is None and "." in parent_key:
+                    # the parent key may cross a class boundary
+                    parent = s.functions.get(
+                        parent_key.rsplit(".", 1)[0]) if s else None
+                hit = (self.class_of_receiver(module, parent, head)
+                       if parent is not None else None)
+            else:
+                hit = None
+        # descend attribute chains through constructor-typed attrs:
+        # app.worker → App.attr_types["worker"] → Worker
+        while hit is not None and rest:
+            attr, _, rest = rest.partition(".")
+            cmod, csum = hit
+            ctor = csum.attr_types.get(attr)
+            hit = self.resolve_class(cmod, ctor) if ctor else None
+        return hit
+
+
+def file_hash(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def lint_sources_salt() -> str:
+    """Hash of the lint package's own sources — editing any rule or this
+    module invalidates every cached summary and cached finding."""
+    pkg = pathlib.Path(__file__).resolve().parent
+    h = hashlib.sha256(str(SUMMARY_VERSION).encode())
+    for p in sorted(pkg.glob("*.py")):
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def build_graph(summaries: Sequence[ModuleSummary]) -> SymbolGraph:
+    modules: Dict[str, ModuleSummary] = {}
+    by_path: Dict[str, ModuleSummary] = {}
+    roots: Dict[str, pathlib.Path] = {}
+    for s in summaries:
+        if s.module is not None:
+            modules.setdefault(s.module, s)
+        by_path[s.path] = s
+    for s in summaries:
+        if s.module is not None and s.path:
+            p = pathlib.Path(s.path)
+            depth = s.module.count(".")
+            root = p
+            for _ in range(depth + 1):
+                root = root.parent
+            roots[s.module] = root
+    return SymbolGraph(modules=modules, by_path=by_path,
+                       package_roots=roots)

@@ -61,10 +61,10 @@ def _module_of(call: ast.Call) -> Optional[str]:
     return None
 
 
-def find_unbounded(tree: ast.AST) -> List[tuple]:
+def find_unbounded(tree: ast.AST, nodes=None) -> List[tuple]:
     """(lineno, message) per unbounded construction."""
     out: List[tuple] = []
-    for node in ast.walk(tree):
+    for node in (nodes if nodes is not None else ast.walk(tree)):
         if not isinstance(node, ast.Call):
             continue
         name = _ctor_name(node)
@@ -113,5 +113,5 @@ class BoundedResourceRule:
     def check_file(self, ctx: FileContext) -> List[Finding]:
         return [
             Finding(ctx.path, lineno, self.id, message)
-            for lineno, message in find_unbounded(ctx.tree)
+            for lineno, message in find_unbounded(ctx.tree, ctx.all_nodes)
         ]

@@ -139,9 +139,9 @@ def _value_has_fresh_kwarg(value: ast.AST) -> bool:
     )
 
 
-def find_undisciplined_caches(tree: ast.AST) -> List[tuple]:
+def find_undisciplined_caches(tree: ast.AST, nodes=None) -> List[tuple]:
     out: List[tuple] = []
-    for cls in ast.walk(tree):
+    for cls in (nodes if nodes is not None else ast.walk(tree)):
         if not isinstance(cls, ast.ClassDef):
             continue
         for fn in [
@@ -217,5 +217,6 @@ class CacheKeyDisciplineRule:
     def check_file(self, ctx: FileContext) -> List[Finding]:
         return [
             Finding(ctx.path, lineno, self.id, message)
-            for lineno, message in find_undisciplined_caches(ctx.tree)
+            for lineno, message in find_undisciplined_caches(ctx.tree,
+                                                 ctx.all_nodes)
         ]

@@ -72,10 +72,10 @@ def doc_keys(text: str) -> Dict[str, int]:
     return out
 
 
-def used_keys(tree: ast.AST) -> Iterable[Tuple[str, int]]:
+def used_keys(tree: ast.AST, nodes=None) -> Iterable[Tuple[str, int]]:
     """(key, lineno) for every config-getter call site with a literal
     string key in this tree."""
-    for node in ast.walk(tree):
+    for node in (nodes if nodes is not None else ast.walk(tree)):
         if not isinstance(node, ast.Call):
             continue
         f = node.func
@@ -114,18 +114,21 @@ class ConfigKeyDriftRule:
     def check_file(self, ctx: FileContext) -> List[Finding]:
         return []
 
-    def check_project(self, ctxs: List[FileContext]) -> List[Finding]:
+    def check_project(self, project) -> List[Finding]:
+        """Runs over the phase-1 summaries (getter call sites are
+        pre-extracted into ``ModuleSummary.config_keys``, so warm cached
+        runs never re-walk an AST for this rule)."""
         out: List[Finding] = []
         try:
             defined = defined_keys()
         except Exception as e:  # config module broken: one loud finding
             return [Finding(str(config_module_path()), 1, self.id,
                             f"config registry failed to load: {e!r}")]
-        for ctx in ctxs:
-            for key, lineno in used_keys(ctx.tree):
+        for s in project.summaries:
+            for key, lineno in s.config_keys:
                 if key not in defined:
                     out.append(Finding(
-                        ctx.path, lineno, self.id,
+                        s.path, lineno, self.id,
                         f"config key '{key}' is not defined in "
                         "config/cruise_control_config.py — a request "
                         "reaching this call raises ConfigException",

@@ -56,15 +56,17 @@ def _records(handler: ast.ExceptHandler) -> bool:
     return False
 
 
-def find_swallowed_in_loops(tree: ast.AST, parents=None):
+def find_swallowed_in_loops(tree: ast.AST, parents=None, nodes=None):
     """(lineno,) for every broad, silent handler inside a loop."""
+    if nodes is None:
+        nodes = list(ast.walk(tree))
     if parents is None:
         parents = {}
-        for node in ast.walk(tree):
+        for node in nodes:
             for child in ast.iter_child_nodes(node):
                 parents[child] = node
     out = []
-    for node in ast.walk(tree):
+    for node in nodes:
         if not isinstance(node, ast.ExceptHandler):
             continue
         if not _catches_broadly(node) or _records(node):
@@ -97,5 +99,6 @@ class SwallowedExceptionRule:
                 "flatline; add LOG.exception(...)/events.emit(...) or "
                 "narrow the catch",
             )
-            for lineno in find_swallowed_in_loops(ctx.tree, ctx.parents)
+            for lineno in find_swallowed_in_loops(ctx.tree, ctx.parents,
+                                                  ctx.all_nodes)
         ]

@@ -98,14 +98,16 @@ def _static_params(fn: ast.AST, jit: Optional[ast.Call]) -> Set[str]:
     return static
 
 
-def find_jit_functions(tree: ast.Module):
+def find_jit_functions(tree: ast.Module, nodes=None):
     """[(FunctionDef, static_param_names)] for every jit context in the
     module: decorated defs, defs passed by name to a jit call, and defs
     nested inside either."""
     jitted = {}
+    if nodes is None:
+        nodes = list(ast.walk(tree))
 
     # decorator form
-    for node in ast.walk(tree):
+    for node in nodes:
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         for dec in node.decorator_list:
@@ -115,10 +117,10 @@ def find_jit_functions(tree: ast.Module):
 
     # jax.jit(f) on a local def — match by name, nearest def wins
     defs_by_name = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs_by_name.setdefault(node.name, node)
-    for node in ast.walk(tree):
+    for node in nodes:
         jit = _jit_call(node)
         if jit is None or jit is not node:
             continue
@@ -138,12 +140,12 @@ def find_jit_functions(tree: ast.Module):
     return [(fn, static) for fn, static in jitted.items()]
 
 
-def find_jitted_names(tree: ast.Module) -> Set[str]:
+def find_jitted_names(tree: ast.Module, nodes=None) -> Set[str]:
     """Names bound to jit-wrapped callables at module/function level:
     ``f = jax.jit(g)``, ``self._x = jax.jit(g)`` (attr tail), and
     decorated defs."""
     names: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in (nodes if nodes is not None else ast.walk(tree)):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if any(_jit_call(d) is not None or _dotted_tail(d) in _JIT_NAMES
                    for d in node.decorator_list):
@@ -194,11 +196,11 @@ def _walk_own_body(fn: ast.AST):
         stack.extend(ast.iter_child_nodes(node))
 
 
-def find_host_syncs(tree: ast.Module):
+def find_host_syncs(tree: ast.Module, nodes=None):
     """(lineno, description) for host-sync / traced-branching patterns
     inside jit contexts."""
     out = []
-    for fn, static in find_jit_functions(tree):
+    for fn, static in find_jit_functions(tree, nodes):
         params = {a.arg for a in fn.args.posonlyargs + fn.args.args
                   + fn.args.kwonlyargs} - static
         for node in _walk_own_body(fn):
@@ -250,12 +252,12 @@ def find_host_syncs(tree: ast.Module):
     return out
 
 
-def find_retrace_risks(tree: ast.Module):
+def find_retrace_risks(tree: ast.Module, nodes=None):
     """(lineno, description) for calls to known-jitted callables passing
     f-string or dict-literal arguments."""
-    jitted = find_jitted_names(tree)
+    jitted = find_jitted_names(tree, nodes)
     out = []
-    for node in ast.walk(tree):
+    for node in (nodes if nodes is not None else ast.walk(tree)):
         if not isinstance(node, ast.Call):
             continue
         tail = _dotted_tail(node.func)
@@ -283,8 +285,9 @@ class JaxHotPathRule:
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         out = []
-        for lineno, desc in find_host_syncs(ctx.tree):
+        nodes = ctx.all_nodes
+        for lineno, desc in find_host_syncs(ctx.tree, nodes):
             out.append(Finding(ctx.path, lineno, self.id, desc))
-        for lineno, desc in find_retrace_risks(ctx.tree):
+        for lineno, desc in find_retrace_risks(ctx.tree, nodes):
             out.append(Finding(ctx.path, lineno, self.id, desc))
         return out

@@ -283,7 +283,7 @@ class LockDisciplineRule:
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.all_nodes:
             if isinstance(node, ast.ClassDef):
                 out.extend(self._check_class(ctx, node))
         return out

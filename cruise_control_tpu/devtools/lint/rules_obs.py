@@ -68,15 +68,21 @@ def _guard_tests(ancestors):
                 yield v
 
 
-def _find_unguarded_dynamic_calls(tree: ast.AST, func_names):
+def _find_unguarded_dynamic_calls(tree: ast.AST, func_names,
+                                  nodes=None, parents=None):
     """(lineno, func_name) for every call to one of ``func_names`` that
-    builds an f-string argument without an enclosing enabled() guard."""
-    parents = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
+    builds an f-string argument without an enclosing enabled() guard.
+    ``nodes``/``parents`` accept the FileContext's memoized traversal
+    products (the bare-tree form re-walks, for the unit-test helpers)."""
+    if nodes is None:
+        nodes = list(ast.walk(tree))
+    if parents is None:
+        parents = {}
+        for node in nodes:
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
     offenders = []
-    for node in ast.walk(tree):
+    for node in nodes:
         if not isinstance(node, ast.Call):
             continue
         f = node.func
@@ -106,13 +112,14 @@ def _find_unguarded_dynamic_calls(tree: ast.AST, func_names):
     return offenders
 
 
-def find_unguarded_dynamic_spans(tree: ast.AST):
+def find_unguarded_dynamic_spans(tree: ast.AST, nodes=None, parents=None):
     """(lineno, source_hint) for every span()/device_span() call that
     builds an f-string name without an enclosing enabled() guard."""
-    return _find_unguarded_dynamic_calls(tree, SPAN_FUNCS)
+    return _find_unguarded_dynamic_calls(tree, SPAN_FUNCS, nodes, parents)
 
 
-def find_unguarded_dynamic_event_kinds(tree: ast.AST):
+def find_unguarded_dynamic_event_kinds(tree: ast.AST, nodes=None,
+                                       parents=None):
     """(lineno, source_hint) for every emit() call that builds an
     f-string argument (kind or payload value) without an enabled() guard.
 
@@ -120,7 +127,7 @@ def find_unguarded_dynamic_event_kinds(tree: ast.AST):
     emit()'s arguments are still evaluated, so the formatting cost rule is
     the same as for span names; put dynamic values in the payload as raw
     kwargs, not pre-formatted strings."""
-    return _find_unguarded_dynamic_calls(tree, EVENT_FUNCS)
+    return _find_unguarded_dynamic_calls(tree, EVENT_FUNCS, nodes, parents)
 
 
 def _receiver_is_registry(func: ast.expr) -> bool:
@@ -134,12 +141,12 @@ def _receiver_is_registry(func: ast.expr) -> bool:
     return False
 
 
-def find_dynamic_metric_names(tree: ast.AST):
+def find_dynamic_metric_names(tree: ast.AST, nodes=None):
     """(lineno, func_name) for registry.counter/gauge/… calls whose NAME
     argument is an f-string — flagged unconditionally (cardinality, not
     cost: there is no disabled path for the registry)."""
     offenders = []
-    for node in ast.walk(tree):
+    for node in (nodes if nodes is not None else ast.walk(tree)):
         if not isinstance(node, ast.Call):
             continue
         f = node.func
@@ -163,14 +170,17 @@ class ObsDynamicNameRule:
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         out = []
-        for lineno, fn in find_unguarded_dynamic_spans(ctx.tree):
+        nodes, parents = ctx.all_nodes, ctx.parents
+        for lineno, fn in find_unguarded_dynamic_spans(
+                ctx.tree, nodes, parents):
             out.append(Finding(
                 ctx.path, lineno, self.id,
                 f"{fn}() with f-string name outside a tracing.enabled() "
                 "guard — pass a static name and route dynamic parts "
                 "through sub= inside a guard (docs/OBSERVABILITY.md)",
             ))
-        for lineno, fn in find_unguarded_dynamic_event_kinds(ctx.tree):
+        for lineno, fn in find_unguarded_dynamic_event_kinds(
+                ctx.tree, nodes, parents):
             out.append(Finding(
                 ctx.path, lineno, self.id,
                 f"{fn}() with f-string argument outside an "
@@ -178,7 +188,7 @@ class ObsDynamicNameRule:
                 "dotted strings; put dynamic values in the payload as "
                 "raw kwargs (docs/OBSERVABILITY.md)",
             ))
-        for lineno, fn in find_dynamic_metric_names(ctx.tree):
+        for lineno, fn in find_dynamic_metric_names(ctx.tree, nodes):
             out.append(Finding(
                 ctx.path, lineno, self.id,
                 f"registry.{fn}() with f-string metric name — every "

@@ -95,10 +95,10 @@ def _failure_path_bounded(loop: ast.While) -> bool:
     return False
 
 
-def find_retry_findings(tree: ast.AST) -> List[tuple]:
+def find_retry_findings(tree: ast.AST, nodes=None) -> List[tuple]:
     """(lineno, message) per violation."""
     out: List[tuple] = []
-    for node in ast.walk(tree):
+    for node in (nodes if nodes is not None else ast.walk(tree)):
         if not isinstance(node, (ast.For, ast.While)):
             continue
         if not _handlers(node) or not _has_sleep(node):
@@ -127,5 +127,5 @@ class RetryDisciplineRule:
     def check_file(self, ctx: FileContext) -> List[Finding]:
         return [
             Finding(ctx.path, lineno, self.id, message)
-            for lineno, message in find_retry_findings(ctx.tree)
+            for lineno, message in find_retry_findings(ctx.tree, ctx.all_nodes)
         ]

@@ -1,0 +1,95 @@
+"""jax-transitive — host syncs reachable from jit regions through calls.
+
+``rules_jax`` flags host syncs lexically inside a jit context; a helper
+one call away is invisible to it, and the ROADMAP's sharding/fusion
+waves push exactly that pattern (a jitted scan step calling a scoring
+helper that quietly does ``np.asarray``).  This rule walks the project
+call graph from every jit context and flags:
+
+* **transitive host syncs** — ``.item()``/``.tolist()``/
+  ``.block_until_ready()``/``jax.device_get``/``np.asarray``/
+  ``np.array`` in any function reachable from a jit context (the jit
+  function's own body is the per-file rule's finding, not repeated
+  here), with the call path in the message;
+
+* **compile-cache-key leaks** — a call like
+  ``_cached_scan_fn(dataclasses.replace(cfg, pipeline_depth=0,
+  time_budget_s=0.0), ...)`` declares those keys *normalized out* of
+  the compile cache key (the compiled program must be identical at
+  every value).  A read of such a key (``cfg.pipeline_depth``) inside a
+  jit context of the same module bakes one arbitrary value into the
+  compiled program — the compiled-once-serve-many invariant breaks
+  silently.  Host-loop reads stay legal.
+
+Control-flow-on-traced-values is NOT checked transitively: whether a
+callee's argument is traced depends on the call site's static-argnum
+set, which the summary does not track through calls — a documented
+blind spot (docs/STATIC_ANALYSIS.md)."""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from cruise_control_tpu.devtools.lint.callgraph import render_path
+from cruise_control_tpu.devtools.lint.findings import Finding
+
+RULE_ID = "jax-transitive"
+
+
+class JaxTransitiveRule:
+    id = RULE_ID
+    summary = ("no host syncs in functions reachable from jit contexts "
+               "via the call graph; compile-cache-normalized config keys "
+               "must not be read inside traced compute")
+    project_rule = True
+
+    def check_file(self, ctx) -> List[Finding]:
+        return []
+
+    def check_project(self, project) -> List[Finding]:
+        graph = project.graph
+        cg = project.callgraph
+        roots: Set[str] = {
+            fid for fid, fn in cg.funcs.items() if fn.is_jit
+        }
+        out: List[Finding] = []
+        reach = cg.reachable_from(roots)
+        for fid, path in sorted(reach.items()):
+            fn = cg.funcs[fid]
+            if fn.is_jit:
+                continue  # its own body is per-file jurisdiction
+            mod = fid.split(":", 1)[0]
+            s = graph.modules.get(mod)
+            if s is None:
+                continue
+            for lineno, desc in fn.sync_ops:
+                out.append(Finding(
+                    s.path, lineno, self.id,
+                    f"{desc} reachable from a jit context: "
+                    f"{render_path(path)} — under trace this serializes "
+                    "the step behind a device→host transfer; hoist the "
+                    "sync out of the traced call chain",
+                ))
+        # compile-cache-key leaks: per module with normalization sites
+        for mod, s in graph.modules.items():
+            if not s.normalized_keys:
+                continue
+            excluded = {}
+            for site_line, keys in s.normalized_keys:
+                for k in keys:
+                    excluded.setdefault(k, site_line)
+            for fkey, fn in s.functions.items():
+                if not fn.is_jit and f"{mod}:{fkey}" not in reach:
+                    continue  # host-loop reads of the key stay legal
+                for recv, attr, lineno in fn.attr_reads:
+                    if attr in excluded:
+                        out.append(Finding(
+                            s.path, lineno, self.id,
+                            f"'{attr}' is normalized out of the compile "
+                            f"cache key (line {excluded[attr]}) but read "
+                            "inside traced compute — the compiled program "
+                            "would bake in one arbitrary value; pass it "
+                            "as a runtime operand or re-key the cache",
+                        ))
+        out.sort(key=lambda f: (f.path, f.line))
+        return out

@@ -19,7 +19,11 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
+
+if TYPE_CHECKING:  # wiring-time imports only (bootstrap builds both)
+    from cruise_control_tpu.analyzer.precompute import CircuitBreaker
+    from cruise_control_tpu.replan.planner import DeltaReplanner
 
 import numpy as np
 
@@ -89,8 +93,8 @@ class CruiseControl:
         allowed_goals: Optional[Sequence[str]] = None,
         default_goal_names: Optional[Sequence[str]] = None,
         hard_goal_names: Optional[Sequence[str]] = None,
-        breaker=None,
-        replanner=None,
+        breaker: Optional["CircuitBreaker"] = None,
+        replanner: Optional["DeltaReplanner"] = None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor

@@ -22,7 +22,10 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+if TYPE_CHECKING:  # import cycle: the facade builds this server
+    from cruise_control_tpu.facade import CruiseControl
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
@@ -84,7 +87,7 @@ class CruiseControlHttpServer:
 
     def __init__(
         self,
-        cruise_control,
+        cruise_control: "CruiseControl",
         host: str = "127.0.0.1",
         port: int = 9090,
         security_provider: Optional[BasicSecurityProvider] = None,
