@@ -39,6 +39,12 @@ interval against a warm generation-fresh cache vs stopped, on the same
 engine metric — must stay within ±1% (steady state is one generation
 probe per tick; plans bit-identical by construction, the daemon only
 ever calls the same get_proposals the REST path does).
+``slo_overhead_pct`` gates the SLO observatory (telemetry/slo.py +
+trace.py + device_cost.py): SLO evaluation at a 250ms stress interval
+(120x the production default), trace correlation live (store +
+per-optimize trace scope), and device-cost capture enabled vs all
+three off — must cost <=1% of the engine metric (tracing + journal
+stay on on both sides; their costs are gated separately above).
 """
 
 from __future__ import annotations
@@ -115,6 +121,7 @@ def _full_path_phases() -> dict:
     from cruise_control_tpu.telemetry import profile, tracing
 
     cc = _full_stack_cc(engine="tpu")
+    tracing.configure(enabled=True)  # not inherited: gates above toggle it
     tracing.reset()
     t0 = time.perf_counter()
     cc.rebalance(dryrun=False)
@@ -271,16 +278,30 @@ def main() -> None:
         ex = Executor(backend, ExecutorConfig(), journal=journal)
         ex.execute_proposals(plan, max_ticks=10**6)
 
+    # best-of-9 with the CYCLE COLLECTOR off: the measured quantity is a
+    # ~2ms delta between ~10ms drives, and by this point the process
+    # heap holds everything the earlier gates allocated — allocation-
+    # count-triggered gc passes inside a drive charge the journal a
+    # pro-rata share of scanning that aged heap, which a production
+    # checkpoint write never pays.  Refcounting still frees the drive's
+    # garbage; both sides are measured identically.
+    import gc
+
     ck_off_s = ck_on_s = np.inf
-    for _ in range(5):
-        t0 = time.perf_counter()
-        _drive(None)
-        ck_off_s = min(ck_off_s, time.perf_counter() - t0)
-        if os.path.exists(ckpt_path):
-            os.remove(ckpt_path)
-        t0 = time.perf_counter()
-        _drive(ExecutionJournal(ckpt_path))
-        ck_on_s = min(ck_on_s, time.perf_counter() - t0)
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(9):
+            t0 = time.perf_counter()
+            _drive(None)
+            ck_off_s = min(ck_off_s, time.perf_counter() - t0)
+            if os.path.exists(ckpt_path):
+                os.remove(ckpt_path)
+            t0 = time.perf_counter()
+            _drive(ExecutionJournal(ckpt_path))
+            ck_on_s = min(ck_on_s, time.perf_counter() - t0)
+    finally:
+        gc.enable()
     checkpoint_overhead_pct = (ck_on_s - ck_off_s) / tpu_s * 100.0
 
     # proposal-precompute daemon overhead (ISSUE 8): the warm-plan
@@ -296,7 +317,7 @@ def main() -> None:
     pre_cc.get_proposals()  # warm + generation-fresh for the whole gate
     precompute = ProposalPrecomputingExecutor(pre_cc, interval_s=0.05)
     pc_off_s = pc_on_s = np.inf
-    for _ in range(5):
+    for _ in range(7):
         t0 = time.perf_counter()
         tpu_opt.optimize(state)
         pc_off_s = min(pc_off_s, time.perf_counter() - t0)
@@ -306,6 +327,50 @@ def main() -> None:
         pc_on_s = min(pc_on_s, time.perf_counter() - t0)
         precompute.stop()
     precompute_overhead_pct = (pc_on_s / pc_off_s - 1.0) * 100.0
+
+    # SLO-observatory overhead (ISSUE 11): the SLO engine ticking at a
+    # 250ms STRESS interval (120x the production default; a full
+    # registry+journal evaluation is ~1.5ms, so 50ms ticks would just
+    # measure timeslice theft on this 1-CPU box, not the subsystem),
+    # trace correlation live (store installed, every optimize under a
+    # trace scope), and device-cost capture enabled — vs all three off,
+    # on the same engine metric.  Tracing + the journal are ON on BOTH
+    # sides (their own costs are gated above); this isolates the
+    # observatory.
+    from cruise_control_tpu.telemetry import device_cost
+    from cruise_control_tpu.telemetry import trace as trace_mod
+    from cruise_control_tpu.telemetry.slo import SloEngine
+
+    events.configure(enabled=True, path=ev_path)
+    tracing.configure(enabled=True)
+    slo_engine = SloEngine(
+        DEFAULT_REGISTRY, events_reader=events.recent,
+        maintenance_hooks=[device_cost.MONITOR.capture_pending],
+    )
+    # best-of-9 interleaved pairs: the true cost (~one 1.5ms evaluation
+    # landing inside each measured optimize) is well under the box's
+    # run-to-run noise, so both minima need the extra draws to converge
+    slo_off_s = slo_on_s = np.inf
+    for i in range(9):
+        trace_mod.configure(enabled=False)
+        device_cost.configure(enabled=False)
+        t0 = time.perf_counter()
+        tpu_opt.optimize(state)
+        slo_off_s = min(slo_off_s, time.perf_counter() - t0)
+        trace_mod.configure(enabled=True)
+        device_cost.configure(enabled=True)
+        slo_engine.start(interval_s=0.25)
+        t0 = time.perf_counter()
+        with trace_mod.trace_scope(f"bench-trace-{i}"):
+            tpu_opt.optimize(state)
+        slo_on_s = min(slo_on_s, time.perf_counter() - t0)
+        slo_engine.stop()
+    slo_evaluations = slo_engine.evaluations
+    trace_mod.configure(enabled=False)
+    tracing.configure(enabled=False)
+    events.configure(enabled=False)
+    events.reset()
+    slo_overhead_pct = (slo_on_s / slo_off_s - 1.0) * 100.0
 
     # delta-replan gates (ISSUE 9): the steady-state settled replan must
     # re-validate a fresh plan >=10x faster than a cold recompute, and
@@ -321,7 +386,7 @@ def main() -> None:
 
     replan_fixture = measure_fixture("load_perturbation", engine="tpu",
                                      best_of=2)
-    replan_overhead = measure_overhead(engine="tpu", rounds=2)
+    replan_overhead = measure_overhead(engine="tpu", rounds=3)
 
     phases = _full_path_phases()
     tracing.configure(enabled=False)
@@ -364,6 +429,10 @@ def main() -> None:
                 },
                 "replan_overhead_pct": replan_overhead[
                     "replan_overhead_pct"],
+                # SLO engine + trace correlation + device-cost capture
+                # enabled vs off (<=1% gate; stress 250ms interval)
+                "slo_overhead_pct": round(slo_overhead_pct, 2),
+                "slo_evaluations": slo_evaluations,
                 "phases": phases,
             }
         )

@@ -68,11 +68,15 @@ class App:
     detector_manager: object
     #: telemetry/recorder.FlightRecorder; None when disabled
     flight_recorder: object = None
+    #: telemetry/slo.SloEngine; None when disabled
+    slo_engine: object = None
 
     def shutdown(self) -> None:
         self.cruise_control.stop_proposal_precomputation()
         self.detector_manager.stop()
         self.fetcher_manager.stop()
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         if self.flight_recorder is not None:
             self.flight_recorder.stop()
         self.server.stop()
@@ -330,7 +334,13 @@ def build_app(
     place of dialing ``bootstrap.servers`` — the test seam.
     """
     cfg = config or CruiseControlConfig()
-    from cruise_control_tpu.telemetry import device_stats, events, tracing
+    from cruise_control_tpu.telemetry import (
+        device_cost,
+        device_stats,
+        events,
+        tracing,
+    )
+    from cruise_control_tpu.telemetry import trace as trace_mod
 
     tracing.configure(
         enabled=cfg.get_boolean("telemetry.enabled"),
@@ -342,6 +352,15 @@ def build_app(
         retrace_threshold=cfg.get_int(
             "telemetry.device.stats.retrace.threshold"
         ),
+    )
+    device_cost.configure(
+        enabled=cfg.get_boolean("telemetry.device.cost.enabled"),
+        hbm_gbps=cfg.get_double("telemetry.device.cost.hbm.gbps"),
+    )
+    trace_mod.configure(
+        enabled=cfg.get_boolean("telemetry.trace.enabled"),
+        max_traces=cfg.get_int("telemetry.trace.max.traces"),
+        spans_per_trace=cfg.get_int("telemetry.trace.spans.per.trace"),
     )
     events.configure(
         enabled=cfg.get_boolean("telemetry.events.enabled"),
@@ -732,9 +751,19 @@ def build_app(
         # live-buffer gauges ride the shared registry: GET /state JSON,
         # /metrics gauge families, and the flight recorder's series
         device_stats.install_gauges(cc.registry)
+    if cfg.get_boolean("telemetry.device.cost.enabled"):
+        # HBM-utilization estimate + pending-capture depth as gauges
+        device_cost.install_gauges(cc.registry)
     flight_recorder = None
     if cfg.get_boolean("telemetry.recorder.enabled"):
         from cruise_control_tpu.telemetry.recorder import FlightRecorder
+
+        def _device_summary() -> dict:
+            out = device_stats.MONITOR.summary()
+            # the kernel budget, live: per-executable flops/bytes/HBM
+            # alongside the compile stats in one diagnostics block
+            out["deviceCost"] = device_cost.MONITOR.summary()
+            return out
 
         flight_recorder = FlightRecorder(
             cc.registry,
@@ -747,16 +776,58 @@ def build_app(
                 if cfg.get_boolean("telemetry.device.stats.enabled") else ()
             ),
             dump_dir=cfg.get("telemetry.recorder.dump.dir"),
-            device_stats_source=device_stats.MONITOR.summary,
+            device_stats_source=_device_summary,
             # merge the decision journal into the artifact: an incident
             # dump carries the why alongside the numbers
             events_source=(
                 (lambda: events.recent(limit=512))
                 if cfg.get_boolean("telemetry.events.enabled") else None
             ),
+            # and the retained trace index: the dump names the
+            # correlation ids GET /trace?id= can still reconstruct
+            traces_source=(
+                trace_mod.STORE.index
+                if cfg.get_boolean("telemetry.trace.enabled") else None
+            ),
         )
         detector.flight_recorder = flight_recorder
         flight_recorder.start()
+    slo_engine = None
+    if cfg.get_boolean("telemetry.slo.enabled"):
+        from cruise_control_tpu.telemetry.slo import (
+            SloEngine,
+            parse_objectives,
+        )
+
+        on_breach = []
+        if flight_recorder is not None:
+            # reuse the FIX_FAILED dump plumbing: an SLO breach
+            # self-captures its diagnostic context the moment it trips
+            def _dump_on_breach(name: str, row) -> None:
+                flight_recorder.dump(f"slo.breach:{name}")
+
+            on_breach.append(_dump_on_breach)
+        maintenance = []
+        if cfg.get_boolean("telemetry.device.cost.enabled"):
+            # per-executable cost capture pays one AOT compile each —
+            # pumped here, off every request thread
+            maintenance.append(device_cost.MONITOR.capture_pending)
+        slo_engine = SloEngine(
+            registry=cc.registry,
+            events_reader=(
+                events.recent
+                if cfg.get_boolean("telemetry.events.enabled") else None
+            ),
+            window_ms=cfg.get_int("telemetry.slo.window.ms"),
+            breach_cycles=cfg.get_int("telemetry.slo.breach.cycles"),
+            recover_cycles=cfg.get_int("telemetry.slo.recover.cycles"),
+            objectives=parse_objectives(cfg.get("telemetry.slo.objectives")),
+            on_breach=on_breach,
+            maintenance_hooks=maintenance,
+        )
+        slo_engine.start(
+            interval_s=cfg.get_double("telemetry.slo.interval.ms") / 1000
+        )
     tasks = UserTaskManager(
         max_active_tasks=cfg.get_int("max.active.user.tasks"),
         completed_task_ttl_s=(
@@ -798,6 +869,7 @@ def build_app(
         read_timeout_s=cfg.get("webserver.request.read.timeout.ms") / 1000,
         drain_timeout_s=cfg.get("webserver.request.drain.timeout.ms") / 1000,
         max_inflight=cfg.get_int("webserver.request.max.inflight"),
+        slo_engine=slo_engine,
     )
     if cfg.get_boolean("proposals.precompute.enabled"):
         # the §3.5 warm-plan daemon: GET /proposals answers from cache,
@@ -807,7 +879,7 @@ def build_app(
             engine=cfg.get("proposal.precompute.engine"),
         )
     return App(cfg, backend, reporter, cc, fetchers, server, detector,
-               flight_recorder)
+               flight_recorder, slo_engine)
 
 
 def _movement_strategy(cfg: CruiseControlConfig):

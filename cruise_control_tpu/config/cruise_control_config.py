@@ -973,6 +973,54 @@ def default_config_def() -> ConfigDef:
              Importance.LOW, "Emit application logs as structured JSON "
              "lines sharing the event-journal field names (ts/severity/"
              "kind), so grep/jq work across both files.", None, G)
+    d.define("telemetry.slo.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Run the SLO observatory: periodic "
+             "evaluation of the declarative SLO registry (heal-latency "
+             "percentiles, serve p99s, warm-replan duty cycle, zero "
+             "unhandled 5xx, bounded growth) over the event journal + "
+             "metric registry, with slo.breach/slo.recovered journal "
+             "events and the cc-tpu-slo/1 gate table on GET /slo.",
+             None, G)
+    d.define("telemetry.slo.interval.ms", ConfigType.DOUBLE, 30_000.0,
+             Importance.LOW, "SLO evaluation period (the observatory's "
+             "background tick; also pumps pending device-cost captures).",
+             at_least(10), G)
+    d.define("telemetry.slo.window.ms", ConfigType.INT, 600_000,
+             Importance.LOW, "Sliding journal window each SLO is "
+             "evaluated over (by record timestamp).", at_least(1000), G)
+    d.define("telemetry.slo.breach.cycles", ConfigType.INT, 2,
+             Importance.LOW, "Consecutive violating evaluations before a "
+             "SLO transitions to BREACHED (hysteresis: one noisy window "
+             "must not page).", at_least(1), G)
+    d.define("telemetry.slo.recover.cycles", ConfigType.INT, 2,
+             Importance.LOW, "Consecutive passing evaluations before a "
+             "breached SLO transitions back to OK.", at_least(1), G)
+    d.define("telemetry.slo.objectives", ConfigType.STRING, None,
+             Importance.LOW, "Objective overrides as "
+             "'name=value,name=value' (e.g. "
+             "'serve.cached_get.p99.ms=25,replan.warm.duty.cycle=0.8'); "
+             "unnamed SLOs keep their registry defaults.", None, G)
+    d.define("telemetry.trace.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Retain completed request-correlated span "
+             "trees in the bounded trace store: one X-Trace-Id per "
+             "request, stamped on every span and journal event it "
+             "produces, reconstructable as Chrome-trace JSON on "
+             "GET /trace?id=.", None, G)
+    d.define("telemetry.trace.max.traces", ConfigType.INT, 64,
+             Importance.LOW, "Distinct trace ids retained (oldest "
+             "evicted).", at_least(1), G)
+    d.define("telemetry.trace.spans.per.trace", ConfigType.INT, 512,
+             Importance.LOW, "Root span trees retained per trace id.",
+             at_least(1), G)
+    d.define("telemetry.device.cost.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Capture cost_analysis()/memory_analysis() "
+             "per compiled executable (flops, bytes accessed, arg/output/"
+             "temp HBM bytes) via one off-request AOT compile each, "
+             "exported as cc_device_* gauges and the live HBM-bandwidth "
+             "utilization estimate.", None, G)
+    d.define("telemetry.device.cost.hbm.gbps", ConfigType.DOUBLE, 819.0,
+             Importance.LOW, "Assumed per-device HBM bandwidth (GB/s) for "
+             "the utilization estimate.", at_least(0.001), G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a

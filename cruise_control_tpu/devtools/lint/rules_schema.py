@@ -43,7 +43,8 @@ RULE_ID = "journal-schema"
 #: journal objects, the process-wide singleton)
 _JOURNAL_RECV = {"events", "journal", "JOURNAL"}
 #: keyword/positional names that are record envelope, not payload
-ENVELOPE = {"severity", "operation", "task_id", "kind"}
+#: (trace_id rides the common traceId field, like task_id → taskId)
+ENVELOPE = {"severity", "operation", "task_id", "trace_id", "kind"}
 
 SCHEMA_RELPATH = pathlib.Path("tests") / "schemas" / "artifacts.schema.json"
 EVENTS_SCHEMA = "cc-tpu-events/1"

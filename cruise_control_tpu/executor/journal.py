@@ -220,7 +220,12 @@ class ExecutionJournal:
                 "payload": payload,
             }
             self._track(kind, payload)
-            self._pending.append(json.dumps(rec, default=str))
+            # compact separators: the start record positionally encodes
+            # the WHOLE plan, so whitespace is ~10% of the checkpoint's
+            # bytes and encode time on the write-ahead hot path
+            self._pending.append(
+                json.dumps(rec, default=str, separators=(",", ":"))
+            )
             try:
                 if kind in _FLUSH_KINDS or len(self._pending) >= _MAX_BUFFERED:
                     self._flush_locked()
@@ -310,7 +315,19 @@ class ExecutionJournal:
         self._replace_file(self._snapshot_records())
 
     def _truncate(self) -> None:
-        self._replace_file([])
+        """Empty the checkpoint in place.  Unlike :meth:`_compact`,
+        truncation has no content whose torn write could corrupt
+        recovery — and a crash that loses the truncate entirely just
+        leaves the completed execution's end-terminated log, which
+        ``load()`` already answers None for.  So no tmp + fsync +
+        os.replace here: the atomic dance costs ~1 ms per execution
+        (an fsync plus two metadata ops), measurable against the <=1%
+        checkpoint budget."""
+        self._close()
+        with open(self.path, "w"):
+            pass
+        self._seq = 0
+        self._bytes = 0
 
     def _close(self) -> None:
         if self._fh is not None:

@@ -911,7 +911,16 @@ class CruiseControl:
         cold) optimization → snapshot commit.  A warm-path failure falls
         back to one cold attempt — a replan must never be WORSE than the
         cold path it replaces — and every decision lands in the journal
-        (``replan.start`` / ``replan.end`` / ``replan.warm_failed``)."""
+        (``replan.start`` / ``replan.end`` / ``replan.warm_failed``).
+        The whole decision runs under a ``facade.replan`` span, so a
+        trace reconstructed from one id shows the replan phase between
+        the request span and the engine's device slices."""
+        with tracing.span("facade.replan"):
+            return self._replan_proposals_traced(
+                engine, generation, progress
+            )
+
+    def _replan_proposals_traced(self, engine, generation: str, progress):
         built = self._model(
             None, progress, builder=self.replanner.build_model
         )

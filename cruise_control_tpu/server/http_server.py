@@ -19,8 +19,10 @@ from __future__ import annotations
 
 import base64
 import json
+import re
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import TYPE_CHECKING, Dict, List, Optional
 
@@ -43,6 +45,7 @@ from cruise_control_tpu.server.admission import (
 )
 from cruise_control_tpu.server.purgatory import Purgatory
 from cruise_control_tpu.telemetry import events, tracing
+from cruise_control_tpu.telemetry import trace as trace_mod
 from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.server.security import (  # re-exported (legacy import site)
     BasicSecurityProvider,
@@ -58,6 +61,11 @@ USER_TASK_HEADER = "User-Task-ID"
 #: per-request deadline header (milliseconds the client is willing to
 #: wait); propagated into the facade as a thread-local deadline scope
 DEADLINE_HEADER = "deadline-ms"
+#: end-to-end correlation header: client-supplied or minted per request,
+#: echoed on the response, stamped on every span and journal event the
+#: request produces, and queryable via GET /trace?id=
+TRACE_HEADER = "X-Trace-Id"
+_TRACE_ID_OK = re.compile(r"[A-Za-z0-9._-]{1,64}$")
 
 #: Retry-After guidance on backpressure responses (RFC 9110 §10.2.3).
 #: 429 (task capacity) clears as soon as a worker frees up — retry fast;
@@ -69,7 +77,7 @@ RETRY_AFTER_NOT_READY_S = 30
 GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
     "user_tasks", "review_board", "metrics", "diagnostics", "events",
-    "health",
+    "health", "slo", "trace",
 }
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
@@ -110,6 +118,9 @@ class CruiseControlHttpServer:
         read_timeout_s: float = 10.0,
         drain_timeout_s: float = 5.0,
         max_inflight: int = 0,
+        slo_engine=None,
+        trace_store=None,
+        trace_id_factory=None,
     ):
         self.cc = cruise_control
         self.host = host
@@ -127,6 +138,16 @@ class CruiseControlHttpServer:
         #: telemetry/events.EventJournal serving GET /events (None falls
         #: back to the process-wide events.JOURNAL at request time)
         self.event_journal = event_journal
+        #: telemetry/slo.SloEngine serving GET /slo (None → 503)
+        self.slo_engine = slo_engine
+        #: telemetry/trace.TraceStore serving GET /trace; also installed
+        #: as the tracer's root-span sink so request spans are retained
+        self.trace_store = trace_mod.install(trace_store)
+        #: trace-id source (the scenario simulator injects a deterministic
+        #: counter so journal fingerprints stay reproducible)
+        self._trace_id_factory = trace_id_factory or (
+            lambda: uuid.uuid4().hex[:16]
+        )
         self.purgatory = Purgatory(retention_s=purgatory_retention_s)
         #: the overload-safe front door (ISSUE 8): per-class concurrency
         #: limits + one bounded queue; sheds with Retry-After instead of
@@ -256,32 +277,61 @@ class CruiseControlHttpServer:
             return None
         return time.monotonic() + ms / 1000.0
 
+    def _request_trace_id(self, handler) -> str:
+        """The request's correlation id: a well-formed client-supplied
+        ``X-Trace-Id`` wins (cross-service correlation), anything else is
+        minted — so a hostile header can never grow the id space."""
+        raw = (handler.headers.get(TRACE_HEADER) or "").strip()
+        if raw and _TRACE_ID_OK.match(raw):
+            return raw
+        return self._trace_id_factory()
+
+    def _note_unhandled_5xx(self) -> None:
+        """Feed the zero-unhandled-5xx SLO: a 500 (or a 5xx carrying no
+        backpressure guidance) is an operator-page, not a retry hint."""
+        registry = getattr(self.cc, "registry", None)
+        if registry is not None:
+            registry.meter("http.unhandled.error").mark()
+
     def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
-        with self.admission.track():
-            try:
-                self._dispatch_inner(handler, method)
-            except RequestShedError as e:
-                self._send(handler, 429, {"errorMessage": str(e)},
-                           headers={"Retry-After": str(e.retry_after_s)})
-            except DeadlineExceededError as e:
-                # the client's own deadline passed: there is nobody left to
-                # retry fast, but Retry-After keeps automated clients honest
-                self._send(handler, 503, {"errorMessage": str(e)},
-                           headers={"Retry-After": str(RETRY_AFTER_BUSY_S)})
-            except AnalyzerSaturatedError as e:
-                self._send(handler, 503, {"errorMessage": str(e)},
-                           headers={"Retry-After": str(e.retry_after_s)})
-            except (ValueError, KeyError) as e:
-                self._log.warning("%s %s -> 400: %s", method, handler.path, e)
-                self._send(handler, 400, {"errorMessage": str(e)})
-            except NotEnoughValidWindowsError as e:
-                self._log.info("%s %s -> 503: %s", method, handler.path, e)
-                self._send(
-                    handler, 503, {"errorMessage": str(e)},
-                    headers={"Retry-After": str(RETRY_AFTER_NOT_READY_S)})
-            except Exception as e:
-                self._log.exception("%s %s -> 500", method, handler.path)
-                self._send(handler, 500, {"errorMessage": repr(e)})
+        # one correlation id per request: every span and journal event
+        # produced inside (including on async worker threads) carries it,
+        # and GET /trace?id= reconstructs the request end-to-end
+        with trace_mod.trace_scope(self._request_trace_id(handler)):
+            with self.admission.track():
+                try:
+                    self._dispatch_inner(handler, method)
+                except RequestShedError as e:
+                    self._send(handler, 429, {"errorMessage": str(e)},
+                               headers={"Retry-After":
+                                        str(e.retry_after_s)})
+                except DeadlineExceededError as e:
+                    # the client's own deadline passed: there is nobody
+                    # left to retry fast, but Retry-After keeps automated
+                    # clients honest
+                    self._send(handler, 503, {"errorMessage": str(e)},
+                               headers={"Retry-After":
+                                        str(RETRY_AFTER_BUSY_S)})
+                except AnalyzerSaturatedError as e:
+                    self._send(handler, 503, {"errorMessage": str(e)},
+                               headers={"Retry-After":
+                                        str(e.retry_after_s)})
+                except (ValueError, KeyError) as e:
+                    self._log.warning("%s %s -> 400: %s", method,
+                                      handler.path, e)
+                    self._send(handler, 400, {"errorMessage": str(e)})
+                except NotEnoughValidWindowsError as e:
+                    self._log.info("%s %s -> 503: %s", method,
+                                   handler.path, e)
+                    self._send(
+                        handler, 503, {"errorMessage": str(e)},
+                        headers={"Retry-After":
+                                 str(RETRY_AFTER_NOT_READY_S)})
+                except Exception as e:
+                    self._log.exception("%s %s -> 500", method,
+                                        handler.path)
+                    self._note_unhandled_5xx()
+                    self._send(handler, 500, {"errorMessage": repr(e)})
 
     def _dispatch_inner(self, handler: BaseHTTPRequestHandler,
                         method: str) -> None:
@@ -450,6 +500,9 @@ class CruiseControlHttpServer:
             # remote UI and its poll loop silently never starts
             handler.send_header("Access-Control-Expose-Headers",
                                 "User-Task-ID")
+        tid = trace_mod.current_trace_id()
+        if tid:
+            handler.send_header(TRACE_HEADER, tid)
         for k, v in (headers or {}).items():
             handler.send_header(k, v)
         handler.end_headers()
@@ -536,6 +589,43 @@ class CruiseControlHttpServer:
                 "numReturned": len(evs),
                 "events": evs,
             })
+        if endpoint == "slo":
+            # the SLO observatory's gate table (cc-tpu-slo/1): objectives
+            # vs measured over the journal window + registry, with
+            # hysteresis state (docs/OBSERVABILITY.md "SLO observatory")
+            if self.slo_engine is None:
+                return self._send(handler, 503, {
+                    "errorMessage": "no SLO engine attached "
+                                    "(telemetry.slo.enabled=false?)"
+                })
+            return self._send(handler, 200, self.slo_engine.report())
+        if endpoint == "trace":
+            # end-to-end trace reconstruction: ?id= returns Chrome-trace
+            # JSON (cc-tpu-trace/1) merging the id's retained span trees
+            # with its journal records; without id, the trace index
+            store = self.trace_store
+            if store is None or not store.enabled:
+                return self._send(handler, 503, {
+                    "errorMessage": "trace store disabled "
+                                    "(telemetry.trace.enabled=false?)"
+                })
+            tid = params.get("id")
+            if not tid:
+                return self._send(handler, 200, {"traces": store.index()})
+            from cruise_control_tpu.telemetry import events as events_mod
+
+            journal = self.event_journal or events_mod.JOURNAL
+            matched = [e for e in journal.recent()
+                       if e.get("traceId") == tid]
+            spans = store.spans(tid)
+            if not spans and not matched:
+                return self._send(handler, 404, {
+                    "errorMessage": f"unknown trace id {tid!r} (evicted, "
+                                    "or the request never ran here)"
+                })
+            return self._send(
+                handler, 200, trace_mod.chrome_trace(tid, spans, matched)
+            )
         if endpoint == "diagnostics":
             # flight-recorder artifact: retained time series + the merged
             # anomaly journal (docs/OBSERVABILITY.md) — the crash-readable
@@ -704,6 +794,7 @@ class CruiseControlHttpServer:
             task = self.tasks.submit(
                 endpoint, lambda progress: fn(progress),
                 deadline_monotonic=admission_mod.current_deadline(),
+                trace_id=trace_mod.current_trace_id(),
             )
             # journal the operation ↔ User-Task-ID binding: operation
             # events run on the worker thread (task_scope), this records
@@ -751,6 +842,8 @@ class CruiseControlHttpServer:
                 headers["Retry-After"] = str(
                     getattr(err, "retry_after_s", RETRY_AFTER_BUSY_S)
                 )
+            else:
+                self._note_unhandled_5xx()
             return self._send(
                 handler, 503 if (not_ready or overload) else 500,
                 {"errorMessage": repr(err), "UserTaskId": task.task_id},

@@ -17,7 +17,7 @@ from typing import Callable, Dict, List, Optional
 
 from cruise_control_tpu.server import admission
 from cruise_control_tpu.server.progress import OperationProgress
-from cruise_control_tpu.telemetry import events
+from cruise_control_tpu.telemetry import events, trace
 
 
 class UserTaskState:
@@ -80,11 +80,14 @@ class UserTaskManager:
     # ---- lifecycle --------------------------------------------------------------
     def submit(self, endpoint: str, fn: Callable[[OperationProgress], object],
                task_id: Optional[str] = None,
-               deadline_monotonic: Optional[float] = None) -> UserTask:
+               deadline_monotonic: Optional[float] = None,
+               trace_id: Optional[str] = None) -> UserTask:
         """Run ``fn(progress)`` on the pool under a new (or supplied) task
         id.  ``deadline_monotonic`` re-enters the request's deadline scope
         on the worker thread — an abandoned request stops burning analyzer
-        time at its deadline even though the 202 handoff changed threads."""
+        time at its deadline even though the 202 handoff changed threads.
+        ``trace_id`` re-enters the request's correlation scope the same
+        way, so the operation's spans and journal events keep the id."""
         self._expire()
         with self._lock:
             active = sum(
@@ -108,8 +111,10 @@ class UserTaskManager:
             try:
                 # every journal event emitted on this worker thread carries
                 # the async protocol's User-Task-ID (events.task_scope is a
-                # thread-local; correlation without signature plumbing)
-                with events.task_scope(tid, endpoint.upper()), \
+                # thread-local; correlation without signature plumbing) and
+                # the request's trace id (trace.trace_scope: no-op on None)
+                with trace.trace_scope(trace_id), \
+                        events.task_scope(tid, endpoint.upper()), \
                         admission.deadline_scope(deadline_monotonic):
                     # a task whose deadline passed while queued behind the
                     # worker pool must not run at all

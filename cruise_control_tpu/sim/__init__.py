@@ -7,6 +7,7 @@ and ``python -m cruise_control_tpu.sim --help``."""
 from cruise_control_tpu.sim.artifact import (
     SCHEMA,
     make_artifact,
+    make_slo_artifact,
     scenario_summary,
 )
 from cruise_control_tpu.sim.backend import ScriptedClusterBackend
@@ -37,6 +38,7 @@ __all__ = [
     "journal_fingerprint",
     "make_artifact",
     "make_scenario",
+    "make_slo_artifact",
     "run_scenario",
     "scenario_summary",
 ]

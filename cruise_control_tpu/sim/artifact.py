@@ -1,4 +1,5 @@
-"""The ``cc-tpu-scenarios/1`` artifact — per-scenario heal outcomes.
+"""The ``cc-tpu-scenarios/1`` artifact — per-scenario heal outcomes —
+plus the scenario-mode ``cc-tpu-slo/1`` gate table.
 
 One JSON document summarizing a scenario-suite run: for every scenario, the
 heal outcome, virtual detection latency, the faults injected, per-type
@@ -6,17 +7,32 @@ anomaly decisions, and what the executor actually did — every field derived
 from the run's event journal (the same ground truth the test suite asserts
 on).  The checked-in contract lives in ``tests/schemas/artifacts.schema.json``
 (closed records — field drift fails CI), and the committed instance is
-``SCENARIOS_r09.json``.
+``SCENARIOS_r11.json``.  :func:`make_slo_artifact` collapses one scenario's
+journal into the SLO observatory's gate table — the artifact shape the
+long-horizon soak (ROADMAP item 5) will gate on.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from cruise_control_tpu.sim.simulator import ScenarioResult
 
 SCHEMA = "cc-tpu-scenarios/1"
+
+
+def make_slo_artifact(result: ScenarioResult,
+                      objectives: Optional[dict] = None) -> dict:
+    """One scenario's journal → the ``cc-tpu-slo/1`` gate table."""
+    report = result.slo_report(objectives=objectives)
+    return report.to_artifact(extra={
+        "scenario": {
+            "name": result.spec.name,
+            "seed": result.spec.seed,
+            "durationVirtualMs": result.duration_virtual_ms,
+        },
+    })
 
 
 def scenario_summary(result: ScenarioResult) -> dict:

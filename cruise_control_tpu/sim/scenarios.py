@@ -508,6 +508,39 @@ def _warm_replan_after_drift() -> ScenarioSpec:
     )
 
 
+def _slo_observatory() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="slo_observatory",
+        description=(
+            "The SLO observatory's gating scenario (ISSUE 11): steady "
+            "HTTP proposal serving over the warm replan loop, one "
+            "scripted drift fault detected and healed mid-run — the "
+            "journal alone yields the cc-tpu-slo/1 gate table (heal "
+            "latency p50/p99, cached-GET and compute serve p99, warm "
+            "duty cycle, zero unhandled 5xx, shed fairness, bounded "
+            "journal growth), the shape ROADMAP item 5's soak consumes."
+        ),
+        timeline=Timeline([
+            # warm the proposal cache, then poll it through the fault
+            http_request(3 * MIN_MS, "proposals"),
+            http_request(5 * MIN_MS, "proposals"),
+            perturb_broker_load(7 * MIN_MS, broker=0, factor=5.0),
+            http_request(12 * MIN_MS, "proposals"),
+            http_request(18 * MIN_MS, "proposals"),
+            http_request(22 * MIN_MS, "proposals"),
+            http_request(26 * MIN_MS, "state"),
+        ]),
+        self_healing={"goal_violation": True},
+        diurnal_amplitude=0.0,
+        serve_http=True,
+        precompute_interval_ticks=2,
+        replan_enabled=True,
+        replan_budget_ratio=0.8,
+        mean_utilization=0.18,
+        duration_ms=28 * MIN_MS,
+    )
+
+
 def _warm_replan_after_add_broker() -> ScenarioSpec:
     return ScenarioSpec(
         name="warm_replan_after_add_broker",
@@ -557,6 +590,7 @@ SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
         _crash_mid_request_recovers_front_door,
         _warm_replan_after_drift,
         _warm_replan_after_add_broker,
+        _slo_observatory,
     )
 }
 
@@ -570,10 +604,14 @@ SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
 #: warm_replan_after_drift rides in tier-1 so the delta-replan journal
 #: (warm refreshes before AND after the drift, zero cold recomputes in
 #: the steady state) is re-verified bit-for-bit on every run (ISSUE 9).
+#: slo_observatory rides in tier-1 so the cc-tpu-slo/1 gate table stays
+#: derivable (all green) from one scenario's journal on every run
+#: (ISSUE 11; its sequential requests keep the journal bit-reproducible,
+#: deterministic sim-trace-N ids included).
 SMOKE_SCENARIOS = ("rack_loss", "cascading_disk_failures",
                    "crash_resume_mid_execution",
                    "degraded_serving_survives_analyzer_outage",
-                   "warm_replan_after_drift")
+                   "warm_replan_after_drift", "slo_observatory")
 
 
 def make_scenario(name: str, seed: Optional[int] = None) -> ScenarioSpec:

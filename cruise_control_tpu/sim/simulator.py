@@ -278,6 +278,32 @@ class ScenarioResult:
                 out.append(e.get("payload", {}))
         return out
 
+    def heal_latency_percentiles(self, pcts=(50, 99)) -> Dict[int, int]:
+        """Fault→fix latency percentiles (virtual ms, journal order) —
+        the SLO engine's heal-latency samples over this run's journal.
+        Empty dict when no fix ever started."""
+        from cruise_control_tpu.telemetry import slo as slo_mod
+
+        samples = slo_mod.heal_latencies_ms(self.journal)
+        if not samples:
+            return {}
+        return {
+            int(q): int(slo_mod.percentile(samples, q)) for q in pcts
+        }
+
+    def slo_report(self, objectives=None):
+        """Evaluate the whole SLO registry over this run's journal
+        (virtual clock, journal order — no registry snapshot): the gate
+        table ROADMAP item 5's soak consumes, and what scenario
+        assertions use instead of re-deriving latencies by hand."""
+        from cruise_control_tpu.telemetry import slo as slo_mod
+
+        return slo_mod.evaluate_slos(
+            self.journal, snapshot=None, objectives=objectives,
+            window_ms=None, source="scenario",
+            horizon_ms=float(self.duration_virtual_ms),
+        )
+
     def heal_outcome(self) -> str:
         """Classify the run from the journal alone: HEALED / FIX_FAILED /
         ALERT_ONLY / SUPPRESSED / UNHEALED / NO_ANOMALY.
@@ -437,6 +463,10 @@ class _Sim:
         #: deterministic User-Task-ID source (uuid4 would make every
         #: journal fingerprint unreproducible)
         self._task_seq = 0
+        #: deterministic X-Trace-Id source, same contract: trace ids land
+        #: on journal records, so they must be seed-stable.  Sim-level
+        #: (not control-plane) so a process restart keeps counting.
+        self._trace_seq = 0
         self.server: Optional[CruiseControlHttpServer] = None
         self.precompute: Optional[ProposalPrecomputingExecutor] = None
         self._build_control_plane()
@@ -544,11 +574,16 @@ class _Sim:
                 self._task_seq += 1
                 return f"sim-task-{self._task_seq}"
 
+            def next_trace_id() -> str:
+                self._trace_seq += 1
+                return f"sim-trace-{self._trace_seq}"
+
             self.server = CruiseControlHttpServer(
                 self.cc, port=0, access_log=False,
                 user_task_manager=UserTaskManager(
                     max_workers=1, id_factory=next_task_id,
                 ),
+                trace_id_factory=next_trace_id,
                 get_max_concurrent=spec.http_get_concurrent,
                 compute_max_concurrent=spec.http_compute_concurrent,
                 admission_queue_size=spec.http_queue_size,

@@ -1,9 +1,12 @@
 """Telemetry subsystem: structured tracing spans (:mod:`tracing`),
 phase-tree profiling artifacts (:mod:`profile`), Prometheus text
 exposition of the metric registry + span timers (:mod:`exposition`),
-JAX compile/retrace/live-buffer observability (:mod:`device_stats`), and
-the flight recorder's retained time series + event journal
-(:mod:`recorder`, ``GET /diagnostics``).
+JAX compile/retrace/live-buffer observability (:mod:`device_stats`),
+per-executable device-cost capture (:mod:`device_cost`), the flight
+recorder's retained time series + event journal (:mod:`recorder`,
+``GET /diagnostics``), end-to-end trace correlation (:mod:`trace`,
+``GET /trace?id=``), and the journal-driven SLO engine (:mod:`slo`,
+``GET /slo``).
 
 The upstream analog is the Dropwizard ``MetricRegistry`` wired through
 every subsystem and exposed via JMX plus the ``AnomalyDetectorState``

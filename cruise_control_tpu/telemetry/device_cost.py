@@ -1,0 +1,359 @@
+"""Per-executable device-cost telemetry — the kernel budget, always on.
+
+``benchmarks/KERNEL_BUDGET_r04.md`` measured the device step offline:
+flops, bytes moved, HBM utilization (7.5 %, ~92 % headroom).  Those
+numbers only existed in a benchmark artifact; the live server had no idea
+what its compiled programs cost.  This module turns the offline budget
+into live telemetry:
+
+* When :mod:`device_stats` detects a compile, it queues a **pending
+  capture** here: the logical function name plus the call's argument
+  shapes (``jax.ShapeDtypeStruct`` skeleton — no arrays retained).
+* :meth:`DeviceCostMonitor.capture_pending` materializes queued captures
+  off the hot path (the SLO observatory's evaluation loop pumps it; tests
+  and ``GET /diagnostics`` may too): ``fn.lower(shapes).compile()`` →
+  ``cost_analysis()`` (flops, bytes accessed) + ``memory_analysis()``
+  (argument / output / temp HBM bytes).  One AOT compile per distinct
+  executable, never on the request path, never twice.
+* Every instrumented call marks a per-function **call-rate** bucket, so
+  the captured per-call byte traffic becomes a live **HBM-bandwidth
+  utilization estimate**: ``Σ_fn bytes_accessed(fn) × rate(fn) /
+  bandwidth`` — the per-scan-step number ROADMAP item 2's kernel work can
+  be gated against without re-running the offline budget.
+
+Exposed as ``cc_device_*`` families on ``GET /metrics`` (per-``fn``
+labels), a ``device.cost.hbm.utilization`` registry gauge, and a
+``deviceCost`` block in the flight-recorder / diagnostics summary.
+
+Thread-safe: one lock; the per-call path touches only the rate buckets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("device_cost")
+
+#: assumed HBM bandwidth for the utilization estimate (overridden by
+#: telemetry.device.cost.hbm.gbps; the default is a single v4-class chip)
+_DEFAULT_HBM_GBPS = 819.0
+
+#: rate window for the live utilization estimate (seconds)
+_RATE_WINDOW_S = 60
+
+#: pending-capture bound: compiles are rare; a burst beyond this simply
+#: drops the oldest uncaptured executable
+_MAX_PENDING = 32
+
+#: distinct executables retained per logical function
+_MAX_PER_FN = 8
+
+
+def _shape_skeleton(args: tuple, kwargs: dict):
+    """(args, kwargs) with array leaves replaced by ShapeDtypeStructs —
+    enough for ``fn.lower()`` to reproduce the executable, with no device
+    buffers kept alive."""
+    import jax
+
+    def strip(leaf):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(strip, (args, kwargs))
+
+
+class ExecutableCost:
+    """Cost/memory analysis of one compiled executable."""
+
+    __slots__ = ("signature", "flops", "bytes_accessed", "arg_bytes",
+                 "output_bytes", "temp_bytes", "code_bytes",
+                 "captured_unix")
+
+    def __init__(self, signature: tuple):
+        self.signature = signature
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.arg_bytes = 0
+        self.output_bytes = 0
+        self.temp_bytes = 0
+        self.code_bytes = 0
+        self.captured_unix = 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "flops": self.flops,
+            "bytesAccessed": self.bytes_accessed,
+            "argBytes": self.arg_bytes,
+            "outputBytes": self.output_bytes,
+            "tempBytes": self.temp_bytes,
+            "codeBytes": self.code_bytes,
+        }
+
+
+class DeviceCostMonitor:
+    """Process-wide per-executable cost state (module singleton below,
+    reconfigured once by bootstrap — the instrumentation sites are the
+    same module-level jit factories :mod:`device_stats` wraps)."""
+
+    def __init__(self, enabled: bool = True,
+                 hbm_gbps: float = _DEFAULT_HBM_GBPS):
+        self.enabled = enabled
+        self.hbm_gbps = float(hbm_gbps)
+        self._lock = threading.Lock()
+        #: fn name → {signature: ExecutableCost}
+        self._costs: Dict[str, Dict[tuple, ExecutableCost]] = {}
+        #: fn name → deque of [second, calls] buckets (Meter-style O(1))
+        self._call_buckets: Dict[str, deque] = {}
+        self._call_totals: Dict[str, int] = {}
+        #: compiles waiting for an AOT cost capture:
+        #: (name, fn, signature, shape skeleton)
+        self._pending: deque = deque(maxlen=_MAX_PENDING)
+        self.captures = 0
+        self.capture_failures = 0
+
+    # ---- configuration ----------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  hbm_gbps: Optional[float] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if hbm_gbps is not None:
+                self.hbm_gbps = max(1e-9, float(hbm_gbps))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._costs.clear()
+            self._call_buckets.clear()
+            self._call_totals.clear()
+            self._pending.clear()
+            self.captures = 0
+            self.capture_failures = 0
+
+    # ---- instrumentation hooks (device_stats calls these) -----------------------
+    def note_call(self, name: str) -> None:
+        """One dispatched call of an instrumented jitted function."""
+        if not self.enabled:
+            return
+        sec = int(time.time())
+        with self._lock:
+            buckets = self._call_buckets.get(name)
+            if buckets is None:
+                buckets = self._call_buckets[name] = deque(
+                    maxlen=_RATE_WINDOW_S)
+            if buckets and buckets[-1][0] == sec:
+                buckets[-1][1] += 1
+            else:
+                buckets.append([sec, 1])
+            self._call_totals[name] = self._call_totals.get(name, 0) + 1
+
+    def note_compile(self, name: str, fn: Any, signature: tuple,
+                     args: tuple, kwargs: dict) -> None:
+        """A compile was detected: queue a cost capture for later (the
+        shapes are stripped immediately so no arrays are retained)."""
+        if not self.enabled:
+            return
+        try:
+            skeleton = _shape_skeleton(args, kwargs)
+        except Exception:  # pragma: no cover - exotic leaves
+            LOG.exception("device-cost shape skeleton failed for %s", name)
+            return
+        with self._lock:
+            known = self._costs.get(name, {})
+            if signature in known:
+                return
+            self._pending.append((name, fn, signature, skeleton))
+
+    # ---- capture (off the hot path) ---------------------------------------------
+    def capture_pending(self, max_captures: int = 1) -> int:
+        """Materialize up to ``max_captures`` queued cost captures via the
+        AOT path (``lower(shapes).compile()``).  Runs one extra backend
+        compile per distinct executable — which is why this is pumped from
+        the SLO observatory's maintenance tick, never a request thread.
+        Returns the number captured; never raises."""
+        done = 0
+        while done < max_captures:
+            with self._lock:
+                if not self._pending or not self.enabled:
+                    return done
+                name, fn, signature, skeleton = self._pending.popleft()
+            cost = self._capture_one(name, fn, signature, skeleton)
+            with self._lock:
+                if cost is None:
+                    self.capture_failures += 1
+                    continue
+                per_fn = self._costs.setdefault(name, {})
+                if len(per_fn) < _MAX_PER_FN:
+                    per_fn[signature] = cost
+                self.captures += 1
+            done += 1
+        return done
+
+    @staticmethod
+    def _capture_one(name: str, fn: Any, signature: tuple,
+                     skeleton) -> Optional[ExecutableCost]:
+        try:
+            args, kwargs = skeleton
+            compiled = fn.lower(*args, **kwargs).compile()
+            cost = ExecutableCost(signature)
+            analysis = compiled.cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0] if analysis else {}
+            if analysis:
+                cost.flops = float(analysis.get("flops", 0.0) or 0.0)
+                cost.bytes_accessed = float(
+                    analysis.get("bytes accessed", 0.0) or 0.0)
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                cost.arg_bytes = int(
+                    getattr(mem, "argument_size_in_bytes", 0) or 0)
+                cost.output_bytes = int(
+                    getattr(mem, "output_size_in_bytes", 0) or 0)
+                cost.temp_bytes = int(
+                    getattr(mem, "temp_size_in_bytes", 0) or 0)
+                cost.code_bytes = int(
+                    getattr(mem, "generated_code_size_in_bytes", 0) or 0)
+            cost.captured_unix = round(time.time(), 3)
+            return cost
+        except Exception:
+            # cost analysis is best-effort observability: an unsupported
+            # backend / jax API drift must not break the server
+            LOG.exception("device-cost capture failed for %s", name)
+            return None
+
+    # ---- readers ----------------------------------------------------------------
+    def _rate_per_s(self, name: str, now: Optional[float] = None) -> float:
+        now = time.time() if now is None else now
+        cutoff = int(now) - _RATE_WINDOW_S
+        buckets = self._call_buckets.get(name)
+        if not buckets:
+            return 0.0
+        calls = sum(c for s, c in buckets if s >= cutoff)
+        return calls / float(_RATE_WINDOW_S)
+
+    def per_function(self) -> Dict[str, dict]:
+        """fn → aggregated cost view (worst-case executable per metric,
+        call totals, live rate)."""
+        with self._lock:
+            names = sorted(set(self._costs) | set(self._call_totals))
+            out = {}
+            for name in names:
+                per = self._costs.get(name, {})
+                entry: Dict[str, Any] = {
+                    "executables": len(per),
+                    "calls": self._call_totals.get(name, 0),
+                    "callRatePerS": round(self._rate_per_s(name), 4),
+                }
+                if per:
+                    entry["flops"] = max(c.flops for c in per.values())
+                    entry["bytesAccessed"] = max(
+                        c.bytes_accessed for c in per.values())
+                    entry["argBytes"] = max(
+                        c.arg_bytes for c in per.values())
+                    entry["outputBytes"] = max(
+                        c.output_bytes for c in per.values())
+                    entry["tempBytes"] = max(
+                        c.temp_bytes for c in per.values())
+                out[name] = entry
+            return out
+
+    def hbm_utilization(self) -> float:
+        """Live HBM-bandwidth utilization estimate in [0, ∞): captured
+        per-call byte traffic × the live call rate over the assumed
+        bandwidth.  0.0 until both a capture and calls exist."""
+        per = self.per_function()
+        bandwidth = self.hbm_gbps * 1e9
+        total = 0.0
+        for entry in per.values():
+            total += entry.get("bytesAccessed", 0.0) * entry["callRatePerS"]
+        return total / bandwidth
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def summary(self) -> dict:
+        """JSON view (flight-recorder artifact, diagnostics)."""
+        return {
+            "enabled": self.enabled,
+            "hbmGbps": self.hbm_gbps,
+            "captures": self.captures,
+            "captureFailures": self.capture_failures,
+            "pendingCaptures": self.pending(),
+            "hbmUtilization": round(self.hbm_utilization(), 6),
+            "functions": self.per_function(),
+        }
+
+    def families(self) -> List[tuple]:
+        """``extra_families`` rows for the Prometheus exposition:
+        per-``fn`` ``cc_device_*`` gauges."""
+        per = self.per_function()
+        if not per:
+            return []
+        fams = []
+        for fam, field, help_ in (
+            ("cc_device_flops", "flops",
+             "XLA-estimated flops per call of the compiled executable"),
+            ("cc_device_bytes_accessed", "bytesAccessed",
+             "XLA-estimated HBM bytes accessed per call"),
+            ("cc_device_hbm_arg_bytes", "argBytes",
+             "Argument buffer bytes resident per call"),
+            ("cc_device_hbm_output_bytes", "outputBytes",
+             "Output buffer bytes per call"),
+            ("cc_device_hbm_temp_bytes", "tempBytes",
+             "Temp (scratch) HBM bytes per call"),
+            ("cc_device_call_rate_per_s", "callRatePerS",
+             "Dispatched calls per second (60s window)"),
+        ):
+            rows = [({"fn": name}, float(entry.get(field, 0.0)))
+                    for name, entry in per.items() if field in entry]
+            if rows:
+                fams.append((fam, "gauge", help_, rows))
+        fams.append((
+            "cc_device_hbm_utilization_estimate", "gauge",
+            "Estimated HBM bandwidth utilization (captured bytes/call x "
+            "live call rate / assumed bandwidth)",
+            [({}, float(self.hbm_utilization()))],
+        ))
+        return fams
+
+    def install_gauges(self, registry) -> None:
+        """Registry gauges (GET /state JSON + flight-recorder series)."""
+        registry.gauge("device.cost.hbm.utilization",
+                       lambda: float(self.hbm_utilization()))
+        registry.gauge("device.cost.pending.captures",
+                       lambda: float(self.pending()))
+
+
+#: process-wide default (bootstrap reconfigures it from the
+#: telemetry.device.cost.* keys)
+MONITOR = DeviceCostMonitor()
+
+
+# module-level conveniences bound to the default instance -------------------------
+def configure(enabled: Optional[bool] = None,
+              hbm_gbps: Optional[float] = None) -> None:
+    MONITOR.configure(enabled, hbm_gbps)
+
+
+def enabled() -> bool:
+    return MONITOR.enabled
+
+
+def capture_pending(max_captures: int = 1) -> int:
+    return MONITOR.capture_pending(max_captures)
+
+
+def install_gauges(registry) -> None:
+    MONITOR.install_gauges(registry)
+
+
+def reset() -> None:
+    MONITOR.reset()

@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from cruise_control_tpu.telemetry import device_cost
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("device_stats")
@@ -104,6 +105,9 @@ class _InstrumentedJit:
         mon = self._mon
         if not mon.enabled:
             return self._fn(*args, **kwargs)
+        # per-call rate feed for the device-cost HBM estimate (O(1), its
+        # own enabled flag)
+        device_cost.MONITOR.note_call(self._name)
         size_fn = getattr(self._fn, "_cache_size", None)
         if size_fn is not None:
             before = size_fn()
@@ -120,7 +124,12 @@ class _InstrumentedJit:
             t0 = time.perf_counter()
             out = self._fn(*args, **kwargs)
             dt = time.perf_counter() - t0
-        mon.record_compile(self._name, dt, _call_signature(args, kwargs))
+        signature = _call_signature(args, kwargs)
+        mon.record_compile(self._name, dt, signature)
+        # queue (not run) the per-executable cost/memory analysis capture
+        device_cost.MONITOR.note_compile(
+            self._name, self._fn, signature, args, kwargs
+        )
         return out
 
     def __getattr__(self, item):

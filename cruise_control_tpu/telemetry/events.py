@@ -22,6 +22,7 @@ Record schema (one JSON object per line, ``SCHEMA`` in every record):
      "severity": "INFO"|"WARNING"|"ERROR",
      "operation": "REBALANCE",      # optional: facade operation
      "taskId": "<User-Task-ID>",    # optional: async-protocol correlation
+     "traceId": "<X-Trace-Id>",     # optional: end-to-end request trace
      "payload": {...}}              # optional: kind-specific details
 
 Persistence: an append-only JSONL file with size rotation
@@ -129,6 +130,24 @@ class EventJournal:
         scope = getattr(self._local, "scope", None)
         return scope[0] if scope else None
 
+    # ---- trace-id correlation (thread-local) ------------------------------------
+    @contextlib.contextmanager
+    def trace_scope(self, trace_id: Optional[str]):
+        """Events emitted on this thread inside the scope carry ``traceId``
+        — the end-to-end correlation id the HTTP layer mints per request
+        (and re-enters on async worker threads), so one rebalance's journal
+        records, spans, and executor batches all share one id.  ``None``
+        is a no-op scope (callers never need to branch)."""
+        prev = getattr(self._local, "trace", None)
+        self._local.trace = trace_id if trace_id is not None else prev
+        try:
+            yield
+        finally:
+            self._local.trace = prev
+
+    def current_trace_id(self) -> Optional[str]:
+        return getattr(self._local, "trace", None)
+
     # ---- emission ---------------------------------------------------------------
     def emit(
         self,
@@ -136,6 +155,7 @@ class EventJournal:
         severity: str = "INFO",
         operation: Optional[str] = None,
         task_id: Optional[str] = None,
+        trace_id: Optional[str] = None,
         **payload: Any,
     ) -> None:
         """Append one event.  No-op when disabled; never raises (a journal
@@ -148,6 +168,8 @@ class EventJournal:
             task_id = scope[0]
         if operation is None and scope:
             operation = scope[1]
+        if trace_id is None:
+            trace_id = getattr(self._local, "trace", None)
         rec: Dict[str, Any] = {
             "schema": SCHEMA,
             "ts": round(time.time(), 3),
@@ -158,6 +180,8 @@ class EventJournal:
             rec["operation"] = operation
         if task_id:
             rec["taskId"] = task_id
+        if trace_id:
+            rec["traceId"] = trace_id
         if payload:
             rec["payload"] = payload
         try:
@@ -249,8 +273,9 @@ def enabled() -> bool:
 
 
 def emit(kind: str, severity: str = "INFO", operation: Optional[str] = None,
-         task_id: Optional[str] = None, **payload: Any) -> None:
-    JOURNAL.emit(kind, severity, operation, task_id, **payload)
+         task_id: Optional[str] = None, trace_id: Optional[str] = None,
+         **payload: Any) -> None:
+    JOURNAL.emit(kind, severity, operation, task_id, trace_id, **payload)
 
 
 def recent(since: Optional[float] = None, kind: Optional[str] = None,
@@ -260,6 +285,10 @@ def recent(since: Optional[float] = None, kind: Optional[str] = None,
 
 def task_scope(task_id: str, operation: Optional[str] = None):
     return JOURNAL.task_scope(task_id, operation)
+
+
+def trace_scope(trace_id: Optional[str]):
+    return JOURNAL.trace_scope(trace_id)
 
 
 def reset() -> None:

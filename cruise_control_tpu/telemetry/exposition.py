@@ -21,6 +21,11 @@ Rendering rules (one metric family per registry entry):
   ``all`` aggregate) + persistent-compilation-cache counters, from
   :mod:`telemetry.device_stats` (rendered whenever the span layer is —
   i.e. on the server path)
+* Cost      -> ``cc_device_flops`` / ``cc_device_bytes_accessed`` /
+  ``cc_device_hbm_{arg,output,temp}_bytes`` / ``cc_device_call_rate_per_s``
+  (``fn`` label) + ``cc_device_hbm_utilization_estimate``, from
+  :mod:`telemetry.device_cost` — already-captured analyses only, a
+  scrape never triggers a compile
 
 Registry names like ``proposal-computation-timer`` or ``http.GET.state``
 are sanitized to the ``[a-zA-Z_:][a-zA-Z0-9_:]*`` metric grammar and
@@ -32,7 +37,7 @@ from __future__ import annotations
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from cruise_control_tpu.telemetry import device_stats, profile
+from cruise_control_tpu.telemetry import device_cost, device_stats, profile
 from cruise_control_tpu.telemetry.tracing import Telemetry
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
@@ -205,8 +210,15 @@ def render_prometheus(
                         f"{_fmt(ent[field])}"
                     )
         _device_stats_lines(lines)
+        # per-executable device-cost gauges (cc_device_*): rendered only
+        # from ALREADY-captured analyses — a scrape never compiles
+        device_families = device_cost.MONITOR.families() \
+            if device_cost.MONITOR.enabled else ()
+    else:
+        device_families = ()
 
-    for fam_name, fam_type, fam_help, rows in (extra_families or ()):
+    for fam_name, fam_type, fam_help, rows in (
+            tuple(device_families) + tuple(extra_families or ())):
         lines.append(f"# HELP {fam_name} {fam_help}")
         lines.append(f"# TYPE {fam_name} {fam_type}")
         for labels, value in rows:

@@ -32,6 +32,7 @@ Artifact schema (``SCHEMA``):
       "series": {"<kind:name>": {"kind": ..., "points": [[unix, v], ...]}},
       "events": [<anomaly journal records, merged, time-ordered>],
       "journal": [<cc-tpu-events/1 decision records, when attached>],
+      "traces": [<trace.TraceStore.index() summaries, when attached>],
       "deviceStats": {<device_stats.MONITOR.summary()>},
       ...extra keys the dump path merges in ("dumpReason")
     }
@@ -82,6 +83,7 @@ class FlightRecorder:
         dump_dir: Optional[str] = None,
         device_stats_source: Optional[Callable[[], dict]] = None,
         events_source: Optional[Callable[[], List[dict]]] = None,
+        traces_source: Optional[Callable[[], List[dict]]] = None,
     ):
         self.registry = registry
         self.interval_s = max(0.01, float(interval_s))
@@ -94,6 +96,10 @@ class FlightRecorder:
         #: merged into the artifact as `journal` so an incident dump
         #: carries the decision record alongside the numbers
         self.events_source = events_source
+        #: telemetry/trace.TraceStore.index — per-trace summaries merged
+        #: into the artifact as `traces` (an incident dump names the
+        #: correlation ids an operator can pull via GET /trace?id=)
+        self.traces_source = traces_source
         self._lock = threading.Lock()
         self._series: Dict[str, deque] = {}
         self._prev_cum: Dict[str, float] = {}
@@ -211,6 +217,11 @@ class FlightRecorder:
                 out["journal"] = list(self.events_source())
             except Exception:  # pragma: no cover - defensive
                 LOG.exception("flight-recorder events source failed")
+        if self.traces_source is not None:
+            try:
+                out["traces"] = list(self.traces_source())
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("flight-recorder traces source failed")
         if extra:
             out.update(extra)
         return out

@@ -31,6 +31,7 @@ take one small lock per span CLOSE (opens are lock-free).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -48,9 +49,10 @@ class SpanRecord:
     per-span cost to one small object."""
 
     __slots__ = ("name", "path", "kind", "start_unix", "duration_s",
-                 "attrs", "children", "_t0")
+                 "attrs", "children", "trace_id", "_t0")
 
-    def __init__(self, name: str, path: str, kind: str):
+    def __init__(self, name: str, path: str, kind: str,
+                 trace_id: Optional[str] = None):
         self.name = name
         self.path = path
         self.kind = kind                 # "host" | "device"
@@ -58,6 +60,9 @@ class SpanRecord:
         self.duration_s = 0.0
         self.attrs: Optional[Dict[str, Any]] = None
         self.children: List["SpanRecord"] = []
+        #: end-to-end correlation id (the HTTP layer's X-Trace-Id scope);
+        #: completed roots carrying one are offered to the trace store
+        self.trace_id = trace_id
         self._t0 = time.perf_counter()
 
     def set(self, key: str, value: Any) -> None:
@@ -73,6 +78,8 @@ class SpanRecord:
         }
         if self.kind != "host":
             out["kind"] = self.kind
+        if self.trace_id:
+            out["traceId"] = self.trace_id
         if self.attrs:
             out["attrs"] = dict(self.attrs)
         if self.children:
@@ -160,6 +167,11 @@ class Telemetry:
         #: path -> [count, total_s] (profile.py derives self_s from the
         #: path hierarchy)
         self._agg: Dict[str, List[float]] = {}
+        #: completed-ROOT-span sink for trace-id-carrying spans
+        #: (telemetry/trace.TraceStore installs itself here); called
+        #: outside the lock, exceptions swallowed — a broken sink must
+        #: not take the span layer down with it
+        self.root_sink = None
 
     # ---- configuration ----------------------------------------------------------
     def configure(
@@ -186,6 +198,22 @@ class Telemetry:
             self._ring.clear()
             self._agg.clear()
 
+    # ---- trace-id correlation (thread-local) ------------------------------------
+    @contextlib.contextmanager
+    def trace_scope(self, trace_id: Optional[str]):
+        """Spans opened on this thread inside the scope carry the trace id
+        (and completed roots flow to the installed trace store).  ``None``
+        keeps whatever scope is already active (no-op nesting)."""
+        prev = getattr(self._local, "trace_id", None)
+        self._local.trace_id = trace_id if trace_id is not None else prev
+        try:
+            yield
+        finally:
+            self._local.trace_id = prev
+
+    def current_trace_id(self) -> Optional[str]:
+        return getattr(self._local, "trace_id", None)
+
     # ---- span lifecycle ---------------------------------------------------------
     def _stack(self) -> list:
         st = getattr(self._local, "stack", None)
@@ -203,7 +231,8 @@ class Telemetry:
             name = f"{name}.{sub}"
         st = self._stack()
         path = f"{st[-1].path}/{name}" if st else name
-        rec = SpanRecord(name, path, kind)
+        rec = SpanRecord(name, path, kind,
+                         getattr(self._local, "trace_id", None))
         st.append(rec)
         return _LiveSpan(self, rec)
 
@@ -217,7 +246,8 @@ class Telemetry:
             name = f"{name}.{sub}"
         st = self._stack()
         path = f"{st[-1].path}/{name}" if st else name
-        rec = SpanRecord(name, path, "device")
+        rec = SpanRecord(name, path, "device",
+                         getattr(self._local, "trace_id", None))
         st.append(rec)
         return _DeviceSpan(self, rec)
 
@@ -256,6 +286,11 @@ class Telemetry:
             if not st:  # root span completed
                 self._ring.append(rec)
                 del self._ring[: -self.ring_size]
+        if not st and rec.trace_id is not None and self.root_sink is not None:
+            try:
+                self.root_sink(rec)
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("trace root sink failed")
         if self.slow_span_log_s and rec.duration_s >= self.slow_span_log_s:
             LOG.warning(
                 "slow span %s: %.3fs (threshold %.3fs)",

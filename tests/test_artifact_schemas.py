@@ -150,6 +150,49 @@ def _checkpoint_records(tmp_path):
     return recorded
 
 
+def _slo_artifacts():
+    """Both live producers: the engine's GET /slo payload (hysteresis
+    attached) and the scenario-mode pure evaluation."""
+    from cruise_control_tpu.telemetry.events import EventJournal
+    from cruise_control_tpu.telemetry.slo import SloEngine, evaluate_slos
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    journal = EventJournal(enabled=True)
+    journal.emit("replan.end", mode="warm")
+    journal.emit("detector.anomaly", anomalyType="BROKER_FAILURE",
+                 timeMs=120_000, fixStarted=True, action="FIX")
+    reg = MetricRegistry()
+    reg.timer("http.GET.proposals").update(0.005)
+    engine = SloEngine(registry=reg,
+                       events_reader=lambda: journal.recent(),
+                       window_ms=1e12)
+    engine.evaluate()
+    scenario = evaluate_slos(journal.recent(), source="scenario",
+                             horizon_ms=600_000)
+    return [engine.report(),
+            scenario.to_artifact(extra={"scenario": {"name": "probe"}})]
+
+
+def _trace_artifact():
+    from cruise_control_tpu.telemetry.events import EventJournal
+    from cruise_control_tpu.telemetry.trace import TraceStore, chrome_trace
+    from cruise_control_tpu.telemetry.tracing import Telemetry
+
+    tel = Telemetry(enabled=True)
+    store = TraceStore()
+    tel.root_sink = store.on_root
+    journal = EventJournal(enabled=True)
+    with tel.trace_scope("probe-trace"), journal.trace_scope("probe-trace"):
+        with tel.span("http.GET.proposals"):
+            with tel.device_span("analyzer.scan"):
+                pass
+            journal.emit("replan.end", mode="warm")
+    evs = [e for e in journal.recent()
+           if e.get("traceId") == "probe-trace"]
+    assert evs, "trace scope failed to stamp the journal"
+    return [chrome_trace("probe-trace", store.spans("probe-trace"), evs)]
+
+
 def _scenario_artifact():
     from cruise_control_tpu.sim import ScenarioSpec, make_artifact, run_scenario
     from cruise_control_tpu.sim.timeline import Timeline, disk_failure
@@ -166,7 +209,8 @@ def _scenario_artifact():
 
 
 @pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
-                                      "events", "scenarios", "checkpoint"])
+                                      "events", "scenarios", "checkpoint",
+                                      "slo", "trace"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     if producer == "phase-profile":
         arts = _phase_profile_artifact()
@@ -180,6 +224,12 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "checkpoint":
         arts = _checkpoint_records(tmp_path)
         schema = SCHEMAS["cc-tpu-execution-checkpoint/1"]
+    elif producer == "slo":
+        arts = _slo_artifacts()
+        schema = SCHEMAS["cc-tpu-slo/1"]
+    elif producer == "trace":
+        arts = _trace_artifact()
+        schema = SCHEMAS["cc-tpu-trace/1"]
     else:
         arts = _event_records(tmp_path)
         schema = SCHEMAS["cc-tpu-events/1"]

@@ -1,0 +1,52 @@
+"""Perf-trajectory contract (ISSUE 11 satellite): the committed
+``BENCH_TRAJECTORY.md`` table is in sync with the ``BENCH_r*.json``
+artifacts, and the LATEST round's gates all still hold — asserted from
+the committed records alone, no bench re-run."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import bench_trajectory  # noqa: E402
+
+
+def test_every_round_parses():
+    rounds = bench_trajectory.load_rounds()
+    assert len(rounds) >= 10
+    numbers = [rnd for rnd, _ in rounds]
+    assert numbers == sorted(numbers)
+    for _, rec in rounds:
+        assert rec["metric"] == "rebalance_plan_wallclock_50b_1000p"
+        assert rec["value"] > 0
+
+
+def test_committed_table_is_current():
+    rounds = bench_trajectory.load_rounds()
+    committed = bench_trajectory.OUTPUT.read_text()
+    assert committed == bench_trajectory.render(rounds), (
+        "BENCH_TRAJECTORY.md drifted from the BENCH_r*.json artifacts — "
+        "regenerate via PYTHONPATH=. python benchmarks/bench_trajectory.py"
+    )
+    # every round is a row
+    for rnd, _ in rounds:
+        assert f"| r{rnd:02d} |" in committed
+
+
+def test_latest_round_holds_every_gate():
+    rounds = bench_trajectory.load_rounds()
+    latest, rec = rounds[-1]
+    verdicts = bench_trajectory.gate_verdicts(rec)
+    # the full gate surface exists from round 10 on (slo gate included)
+    for gate in ("northstar_s", "vs_baseline", "tracing_overhead_pct",
+                 "recorder_overhead_pct", "events_overhead_pct",
+                 "checkpoint_overhead_pct", "precompute_overhead_pct",
+                 "replan_overhead_pct", "slo_overhead_pct",
+                 "replan_settle_speedup"):
+        assert gate in verdicts, f"round r{latest} lost the {gate} gate"
+        value, ok = verdicts[gate]
+        assert ok, (
+            f"round r{latest} fails {gate}: measured {value} — the perf "
+            "trajectory regressed; see BENCH_TRAJECTORY.md"
+        )

@@ -1116,17 +1116,20 @@ def test_cache_is_disposable(tmp_path, monkeypatch):
 # a finally) and asserts its rule reports it at that exact site.
 MUTATIONS = {
     "deadline-propagation": (
+        "deadline-propagation",
         "cruise_control_tpu/server/admission.py",
         "self._cond.wait(left)",
         "self._cond.wait()",
     ),
     "cross-module-lock": (
+        "cross-module-lock",
         "cruise_control_tpu/facade.py",
         '            self.replanner.record_mode("warm", "zero-delta")',
         '            self.replanner.record_mode("warm", "zero-delta")\n'
         "            self.replanner.snapshot = None",
     ),
     "jax-transitive": (
+        "jax-transitive",
         "cruise_control_tpu/models/cluster_state.py",
         "    return _segment_sum_by_broker(rload, state.assignment, "
         "state.num_brokers)",
@@ -1135,16 +1138,26 @@ MUTATIONS = {
         "state.num_brokers)",
     ),
     "journal-schema": (
+        "journal-schema",
         "cruise_control_tpu/executor/executor.py",
         'events.emit("executor.dest_excluded", severity="WARNING",',
         'events.emit("executor.dest_banned", severity="WARNING",',
     ),
+    # ISSUE 11 satellite: an SLO breach emitted under an unregistered
+    # kind must be caught — proving the closed registry still reaches
+    # the observatory layer of the live tree
+    "journal-schema-slo-kind": (
+        "journal-schema",
+        "cruise_control_tpu/telemetry/slo.py",
+        '"slo.breach", severity="WARNING", slo=row.name,',
+        '"slo.breach_unregistered", severity="WARNING", slo=row.name,',
+    ),
 }
 
 
-@pytest.mark.parametrize("rule_id", sorted(MUTATIONS))
-def test_interprocedural_rules_catch_planted_bugs_in_situ(rule_id):
-    rel, needle, replacement = MUTATIONS[rule_id]
+@pytest.mark.parametrize("case", sorted(MUTATIONS))
+def test_interprocedural_rules_catch_planted_bugs_in_situ(case):
+    rule_id, rel, needle, replacement = MUTATIONS[case]
     path = PKG.parent / rel
     orig = path.read_text()
     assert needle in orig, (

@@ -1,0 +1,450 @@
+"""SLO observatory (ISSUE 11): the declarative SLO engine (hysteresis,
+window eviction, duty-cycle math over a scripted journal), per-executable
+device-cost capture, and end-to-end trace correlation — including THE
+acceptance test: one rebalance driven through the real HTTP server and
+reconstructed from its trace id alone as valid Chrome-trace JSON."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.telemetry import device_cost, device_stats, events
+from cruise_control_tpu.telemetry import trace as trace_mod
+from cruise_control_tpu.telemetry import tracing
+from cruise_control_tpu.telemetry.events import EventJournal
+from cruise_control_tpu.telemetry.slo import (
+    SloEngine,
+    evaluate_slos,
+    heal_latencies_ms,
+    parse_objectives,
+)
+from cruise_control_tpu.telemetry.trace import TraceStore, chrome_trace
+from cruise_control_tpu.utils.metrics import MetricRegistry
+from harness import full_stack
+from test_artifact_schemas import SCHEMAS, validate
+
+
+# ---- scripted-journal helpers ---------------------------------------------------
+def _fault(ts, virtual_ms, fault="rack_loss"):
+    return {"schema": "cc-tpu-events/1", "ts": ts, "kind": "sim.fault",
+            "severity": "INFO",
+            "payload": {"fault": fault, "virtualMs": virtual_ms}}
+
+
+def _fix(ts, time_ms, atype="BROKER_FAILURE", started=True):
+    return {"schema": "cc-tpu-events/1", "ts": ts,
+            "kind": "detector.anomaly", "severity": "INFO",
+            "payload": {"anomalyType": atype, "timeMs": time_ms,
+                        "fixStarted": started, "action": "FIX"}}
+
+
+def _replan(ts, mode):
+    return {"schema": "cc-tpu-events/1", "ts": ts, "kind": "replan.end",
+            "severity": "INFO", "payload": {"mode": mode}}
+
+
+# ---- heal-latency + duty-cycle math ---------------------------------------------
+def test_heal_latency_pairs_faults_with_fixes():
+    journal = [
+        _fault(1.0, 300_000),
+        _fix(2.0, 420_000),                       # 120s after the fault
+        _fault(3.0, 600_000, fault="disk_failure"),
+        _fix(4.0, 540_000, started=False),        # delayed, no sample
+        _fix(5.0, 900_000, atype="DISK_FAILURE"),  # 300s after its fault
+    ]
+    assert heal_latencies_ms(journal) == [120_000, 300_000]
+
+
+def test_heal_latency_without_fault_markers_uses_first_detection():
+    # live mode: no sim.fault records — the episode starts at the first
+    # detection of the type (a cooldown-delayed fix charges its wait)
+    journal = [
+        _fix(1.0, 100_000, started=False),
+        _fix(2.0, 400_000),
+    ]
+    assert heal_latencies_ms(journal) == [300_000]
+
+
+def test_duty_cycle_math_on_scripted_journal():
+    journal = [_replan(1.0, "cold"), _replan(2.0, "warm"),
+               _replan(3.0, "warm"), _replan(4.0, "warm")]
+    rep = evaluate_slos(journal, source="scenario", horizon_ms=60_000)
+    assert rep.slo("replan.warm.duty.cycle").measured == pytest.approx(0.75)
+    assert rep.slo("replan.warm.duty.cycle").ok is True
+    # all-cold breaches the objective
+    rep = evaluate_slos([_replan(1.0, "cold"), _replan(2.0, "cold")],
+                        source="scenario", horizon_ms=60_000)
+    assert rep.slo("replan.warm.duty.cycle").ok is False
+    # no replans at all: NO_DATA, not a breach
+    rep = evaluate_slos([], source="scenario", horizon_ms=60_000)
+    assert rep.slo("replan.warm.duty.cycle").state == "NO_DATA"
+
+
+def test_window_eviction_drops_old_records():
+    now = time.time()
+    old = [_replan(now - 3600.0, "cold") for _ in range(4)]
+    fresh = [_replan(now - 10.0, "warm"), _replan(now - 5.0, "warm")]
+    rep = evaluate_slos(old + fresh, window_ms=60_000.0, now=now)
+    # only the two in-window warm replans count: duty cycle 1.0, and the
+    # journal-growth rate sees 2 events over the 1-minute window
+    assert rep.slo("replan.warm.duty.cycle").measured == pytest.approx(1.0)
+    assert rep.slo("journal.growth.per.min").measured == pytest.approx(2.0)
+    # widen the window: the cold replans return
+    rep = evaluate_slos(old + fresh, window_ms=7_200_000.0, now=now)
+    assert rep.slo("replan.warm.duty.cycle").measured == pytest.approx(
+        2.0 / 6.0)
+
+
+def test_registry_snapshot_feeds_serve_and_5xx_slos():
+    reg = MetricRegistry()
+    for ms in (5, 7, 9, 120):
+        reg.timer("http.GET.proposals").update(ms / 1000.0)
+    reg.meter("http.unhandled.error").mark(2)
+    rep = evaluate_slos([], snapshot=reg.snapshot(), window_ms=60_000.0)
+    assert rep.slo("serve.cached_get.p99.ms").measured == pytest.approx(
+        120.0, rel=0.01)
+    assert rep.slo("serve.cached_get.p99.ms").ok is False  # > 50ms
+    assert rep.slo("http.unhandled.5xx").measured == 2.0
+    assert rep.slo("http.unhandled.5xx").ok is False
+    assert rep.all_ok() is False
+
+
+def test_parse_objectives():
+    assert parse_objectives(None) == {}
+    assert parse_objectives(" serve.cached_get.p99.ms=25, "
+                            "replan.warm.duty.cycle=0.8 ") == {
+        "serve.cached_get.p99.ms": 25.0,
+        "replan.warm.duty.cycle": 0.8,
+    }
+
+
+# ---- hysteresis ------------------------------------------------------------------
+def _engine(journal, **kwargs):
+    kwargs.setdefault("window_ms", 1e12)
+    return SloEngine(events_reader=lambda: journal.recent(), **kwargs)
+
+
+def test_breach_requires_consecutive_bad_cycles(monkeypatch):
+    journal = EventJournal(enabled=True)
+    monkeypatch.setattr(events, "JOURNAL", journal)
+    eng = _engine(journal, breach_cycles=3, recover_cycles=2,
+                  objectives={"replan.warm.duty.cycle": 1.0})
+    journal.emit("replan.end", mode="cold")
+    eng.evaluate()
+    eng.evaluate()
+    assert not journal.recent(kind="slo.breach")  # 2 < breach_cycles
+    eng.evaluate()
+    (breach,) = journal.recent(kind="slo.breach")
+    assert breach["payload"]["slo"] == "replan.warm.duty.cycle"
+    assert breach["severity"] == "WARNING"
+    assert breach["payload"]["consecutive"] == 3
+    # still breached: no duplicate event on further bad cycles
+    eng.evaluate()
+    assert len(journal.recent(kind="slo.breach")) == 1
+    state = eng.report()["hysteresis"]["perSlo"]["replan.warm.duty.cycle"]
+    assert state["state"] == "BREACHED"
+    assert state["breachedSince"] is not None
+
+
+def test_recover_requires_consecutive_good_cycles(monkeypatch):
+    journal = EventJournal(enabled=True)
+    monkeypatch.setattr(events, "JOURNAL", journal)
+    eng = _engine(journal, breach_cycles=1, recover_cycles=2,
+                  objectives={"replan.warm.duty.cycle": 1.0})
+    journal.emit("replan.end", mode="cold")
+    eng.evaluate()
+    assert journal.recent(kind="slo.breach")
+    # flip the measurement to passing: warm replans dominate
+    for _ in range(9):
+        journal.emit("replan.end", mode="warm")
+    eng.objectives["replan.warm.duty.cycle"] = 0.5
+    eng.evaluate()
+    assert not journal.recent(kind="slo.recovered")  # 1 < recover_cycles
+    eng.evaluate()
+    (rec,) = journal.recent(kind="slo.recovered")
+    assert rec["payload"]["slo"] == "replan.warm.duty.cycle"
+    state = eng.report()["hysteresis"]["perSlo"]["replan.warm.duty.cycle"]
+    assert state["state"] == "OK" and state["breachedSince"] is None
+
+
+def test_no_data_freezes_hysteresis(monkeypatch):
+    journal = EventJournal(enabled=True)
+    monkeypatch.setattr(events, "JOURNAL", journal)
+    eng = _engine(journal, breach_cycles=2,
+                  objectives={"replan.warm.duty.cycle": 1.0})
+    journal.emit("replan.end", mode="cold")
+    eng.evaluate()                      # bad #1
+    journal.reset()                     # journal empty → NO_DATA
+    eng.evaluate()
+    journal.emit("replan.end", mode="cold")
+    eng.evaluate()                      # bad #2 (the NO_DATA didn't reset)
+    assert journal.recent(kind="slo.breach")
+
+
+def test_breach_hook_dumps_flight_recorder(tmp_path, monkeypatch):
+    """Satellite: a breach self-captures its diagnostic context via the
+    same dump plumbing FIX_FAILED uses."""
+    from cruise_control_tpu.telemetry.recorder import FlightRecorder
+
+    journal = EventJournal(enabled=True)
+    monkeypatch.setattr(events, "JOURNAL", journal)
+    recorder = FlightRecorder(MetricRegistry(), dump_dir=str(tmp_path),
+                              events_source=lambda: journal.recent())
+    pumped = []
+    eng = _engine(
+        journal, breach_cycles=1,
+        objectives={"replan.warm.duty.cycle": 1.0},
+        on_breach=[lambda name, row: recorder.dump(f"slo.breach:{name}")],
+        maintenance_hooks=[lambda: pumped.append(1)],
+    )
+    journal.emit("replan.end", mode="cold")
+    eng.evaluate()
+    dumps = list(tmp_path.glob("flight-recorder-*.json"))
+    assert len(dumps) == 1
+    art = json.loads(dumps[0].read_text())
+    assert art["dumpReason"] == "slo.breach:replan.warm.duty.cycle"
+    validate(art, SCHEMAS["cc-tpu-flight-recorder/1"])
+    # the breach event itself reached the journal the artifact merged
+    assert any(e["kind"] == "slo.breach" for e in art["journal"])
+    assert pumped  # maintenance hooks ran on the evaluation tick
+
+
+# ---- device-cost capture ---------------------------------------------------------
+def test_device_cost_capture_and_hbm_estimate():
+    import jax
+    import jax.numpy as jnp
+
+    mon = device_cost.DeviceCostMonitor(enabled=True, hbm_gbps=1.0)
+    stats_mon = device_stats.DeviceStatsMonitor(enabled=True)
+    fn = stats_mon.instrument("test.cost_fn", jax.jit(
+        lambda x: (x @ x).sum()))
+    # route the wrapper's hooks at our private monitor
+    real = device_cost.MONITOR
+    device_cost.MONITOR = mon
+    try:
+        x = jnp.ones((64, 64))
+        fn(x)
+        fn(x)
+    finally:
+        device_cost.MONITOR = real
+    assert mon.pending() == 1
+    assert mon.capture_pending(max_captures=4) == 1
+    assert mon.pending() == 0
+    summary = mon.summary()
+    entry = summary["functions"]["test.cost_fn"]
+    assert entry["flops"] > 0
+    assert entry["bytesAccessed"] > 0
+    assert entry["argBytes"] >= 64 * 64 * 4
+    assert entry["calls"] == 2
+    # 2 calls within the window at bandwidth 1 GB/s → utilization > 0
+    assert mon.hbm_utilization() > 0.0
+    fams = dict((f[0], f) for f in mon.families())
+    assert "cc_device_flops" in fams
+    assert "cc_device_hbm_utilization_estimate" in fams
+    # a second identical call queues nothing (signature already captured)
+    device_cost.MONITOR = mon
+    try:
+        fn(jnp.ones((64, 64)))
+    finally:
+        device_cost.MONITOR = real
+    assert mon.pending() == 0
+
+
+def test_device_cost_disabled_is_inert():
+    mon = device_cost.DeviceCostMonitor(enabled=False)
+    mon.note_call("x")
+    mon.note_compile("x", None, ("sig",), (), {})
+    assert mon.pending() == 0
+    assert mon.capture_pending() == 0
+    assert mon.summary()["functions"] == {}
+
+
+# ---- trace store + exporter ------------------------------------------------------
+def test_trace_scope_stamps_spans_and_events(monkeypatch):
+    journal = EventJournal(enabled=True)
+    monkeypatch.setattr(events, "JOURNAL", journal)
+    tel = tracing.TELEMETRY
+    store = TraceStore()
+    prev_sink, prev_enabled = tel.root_sink, tel.enabled
+    tel.root_sink, tel.enabled = store.on_root, True
+    try:
+        with trace_mod.trace_scope("t-123"):
+            with tel.span("outer"):
+                with tel.span("inner"):
+                    events.emit("optimize.start", operation="REBALANCE")
+        with tel.span("untraced"):
+            pass
+    finally:
+        tel.root_sink, tel.enabled = prev_sink, prev_enabled
+    (rec,) = journal.recent()
+    assert rec["traceId"] == "t-123"
+    (root,) = store.spans("t-123")
+    assert root["name"] == "outer" and root["traceId"] == "t-123"
+    assert root["children"][0]["name"] == "inner"
+    assert store.spans("other") == []
+    assert store.index()[0]["numRoots"] == 1
+
+
+def test_trace_store_evicts_oldest():
+    store = TraceStore(max_traces=2)
+
+    class Rec:
+        def __init__(self, tid):
+            self.trace_id = tid
+
+        def to_json(self):
+            return {"name": "r", "startUnix": 1.0, "durationSec": 0.1}
+
+    for tid in ("a", "b", "c"):
+        store.on_root(Rec(tid))
+    assert [t["traceId"] for t in store.index()] == ["b", "c"]
+
+
+def test_chrome_trace_export_shape():
+    spans = [{
+        "name": "http.GET.proposals", "startUnix": 10.0,
+        "durationSec": 0.5, "traceId": "t",
+        "children": [{"name": "analyzer.scan", "startUnix": 10.1,
+                      "durationSec": 0.2, "kind": "device"}],
+    }]
+    evs = [{"schema": "cc-tpu-events/1", "ts": 10.2, "kind": "replan.end",
+            "severity": "INFO", "traceId": "t", "payload": {"mode": "warm"}}]
+    art = json.loads(json.dumps(chrome_trace("t", spans, evs)))
+    validate(art, SCHEMAS["cc-tpu-trace/1"])
+    by_name = {e["name"]: e for e in art["traceEvents"]}
+    assert by_name["analyzer.scan"]["cat"] == "device"
+    assert by_name["replan.end"]["ph"] == "i"
+    assert by_name["http.GET.proposals"]["dur"] == pytest.approx(5e5)
+    # events are time-ordered for the viewer
+    ts = [e["ts"] for e in art["traceEvents"]]
+    assert ts == sorted(ts)
+
+
+# ---- THE acceptance test: reconstruct a rebalance from one trace id -------------
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def _post(url, headers=None):
+    req = urllib.request.Request(url, method="POST", data=b"",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+@pytest.fixture
+def traced_server(monkeypatch):
+    from cruise_control_tpu.replan import DeltaReplanner
+    from cruise_control_tpu.server.http_server import CruiseControlHttpServer
+
+    journal = EventJournal(enabled=True)
+    monkeypatch.setattr(events, "JOURNAL", journal)
+    cc, backend, reporter = full_stack(engine="tpu",
+                                       registry=MetricRegistry())
+    cc.replanner = DeltaReplanner(cc.load_monitor)
+    store = TraceStore()
+    server = CruiseControlHttpServer(cc, port=0, access_log=False,
+                                     trace_store=store)
+    prev_enabled = tracing.TELEMETRY.enabled
+    tracing.TELEMETRY.enabled = True
+    server.start()
+    try:
+        yield server, journal, store
+    finally:
+        server.stop()
+        tracing.TELEMETRY.enabled = prev_enabled
+        tracing.TELEMETRY.root_sink = None
+
+
+def test_rebalance_reconstructs_from_trace_id_alone(traced_server):
+    """Acceptance criterion (ISSUE 11): drive one rebalance through the
+    real HTTP server under one correlation id — the proposal computation
+    routes through the delta replanner, the execution through the real
+    executor — then reconstruct it from ``GET /trace?id=`` alone: valid
+    Chrome-trace JSON carrying the request spans, the replan phase, at
+    least one device-phase slice, and at least one executor batch, all
+    sharing the id that is also on the journal records."""
+    server, journal, store = traced_server
+    tid = "e2e-rebalance-1"
+    headers = {"X-Trace-Id": tid}
+
+    status, hdrs, body = _get(f"{server.url}/proposals", headers)
+    assert status == 200
+    assert hdrs["X-Trace-Id"] == tid  # echoed for client-side correlation
+    status, hdrs, body = _post(
+        f"{server.url}/rebalance?allow_cached=true&dryrun=false"
+        "&get_response_timeout_s=90", headers,
+    )
+    assert status == 200
+    assert body["cached"] is True
+
+    status, _, art = _get(f"{server.url}/trace?id={tid}", headers)
+    assert status == 200
+    art = json.loads(json.dumps(art))
+    validate(art, SCHEMAS["cc-tpu-trace/1"])
+    assert art["traceId"] == tid
+
+    slices = [e for e in art["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in slices}
+    # the request spans (handler thread) and the async worker's execution
+    assert "http.GET.proposals" in names
+    assert "http.POST.rebalance" in names
+    # the replan phase sits between the request and the engine
+    assert "facade.replan" in names
+    # ≥1 device-phase slice from the TPU engine's device spans
+    assert [e for e in slices if e["cat"] == "device"]
+    # ≥1 executor batch from the execution drive loop
+    assert "executor.batch" in names
+
+    # the journal records the same correlation id end to end
+    instants = {e["name"] for e in art["traceEvents"] if e["ph"] == "i"}
+    assert {"replan.start", "replan.end", "execute.start",
+            "execute.end"} <= instants
+    traced = [e for e in journal.recent() if e.get("traceId") == tid]
+    assert {"replan.end", "executor.batch", "execute.end"} <= {
+        e["kind"] for e in traced}
+    # and an unknown id is a clean 404, not an empty 200
+    try:
+        _get(f"{server.url}/trace?id=no-such-trace")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    else:  # pragma: no cover
+        raise AssertionError("unknown trace id must 404")
+
+
+def test_trace_index_and_slo_endpoint(traced_server):
+    server, journal, store = traced_server
+    _get(f"{server.url}/proposals", {"X-Trace-Id": "idx-1"})
+    status, _, body = _get(f"{server.url}/trace")
+    assert status == 200
+    assert any(t["traceId"] == "idx-1" for t in body["traces"])
+    # no SLO engine attached → a clean 503 naming the config key
+    try:
+        _get(f"{server.url}/slo")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert "telemetry.slo.enabled" in json.loads(e.read())[
+            "errorMessage"]
+    else:  # pragma: no cover
+        raise AssertionError("GET /slo without an engine must 503")
+
+
+def test_slo_endpoint_serves_gate_table(traced_server, monkeypatch):
+    server, journal, store = traced_server
+    eng = SloEngine(registry=server.cc.registry,
+                    events_reader=lambda: journal.recent(),
+                    window_ms=1e12)
+    server.slo_engine = eng
+    _get(f"{server.url}/proposals", {"X-Trace-Id": "slo-req"})
+    status, _, art = _get(f"{server.url}/slo")
+    assert status == 200
+    validate(json.loads(json.dumps(art)), SCHEMAS["cc-tpu-slo/1"])
+    names = {row["name"] for row in art["slos"]}
+    assert {"heal.latency.p99.ms", "serve.cached_get.p99.ms",
+            "replan.warm.duty.cycle", "http.unhandled.5xx"} <= names
+    assert art["hysteresis"]["evaluations"] >= 1
