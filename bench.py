@@ -388,6 +388,23 @@ def main() -> None:
                                      best_of=2)
     replan_overhead = measure_overhead(engine="tpu", rounds=3)
 
+    # long-horizon soak smoke gate (ISSUE 12): the tier-1 soak — the
+    # seeded composed fault schedule + continuous traffic over the full
+    # stack at small scale — must stay ALL GREEN and inside its
+    # wall-clock budget (the full 1000-broker day lives in SOAK_r12.json;
+    # this keeps its driver honest in every bench round).
+    from cruise_control_tpu.sim.soak import (
+        make_soak_artifact,
+        run_soak,
+        smoke_spec,
+    )
+
+    t0 = time.perf_counter()
+    soak_result = run_soak(smoke_spec())
+    soak_wall_s = time.perf_counter() - t0
+    soak_art = make_soak_artifact(soak_result)
+    soak_budget_s = 120.0
+
     phases = _full_path_phases()
     tracing.configure(enabled=False)
 
@@ -433,6 +450,16 @@ def main() -> None:
                 # enabled vs off (<=1% gate; stress 250ms interval)
                 "slo_overhead_pct": round(slo_overhead_pct, 2),
                 "slo_evaluations": slo_evaluations,
+                # the tier-1 soak smoke: all gates green + wall budget
+                "soak_smoke": {
+                    "wall_s": round(soak_wall_s, 2),
+                    "budget_s": soak_budget_s,
+                    "all_ok": bool(soak_art["allOk"]),
+                    "fault_classes": soak_art["schedule"][
+                        "distinctFaultClasses"],
+                    "heal_outcome": soak_art["heals"]["outcome"],
+                    "fingerprint": soak_art["journalFingerprint"],
+                },
                 "phases": phases,
             }
         )

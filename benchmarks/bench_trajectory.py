@@ -98,6 +98,14 @@ def gate_verdicts(rec: dict) -> Dict[str, Tuple[float, bool]]:
     if isinstance(drift, dict) and drift.get("settle_speedup") is not None:
         s = float(drift["settle_speedup"])
         out["replan_settle_speedup"] = (s, s >= REPLAN_SETTLE_MIN)
+    soak = rec.get("soak_smoke")
+    if isinstance(soak, dict) and soak.get("wall_s") is not None:
+        w = float(soak["wall_s"])
+        out["soak_smoke"] = (
+            w,
+            bool(soak.get("all_ok"))
+            and w <= float(soak.get("budget_s", 120.0)),
+        )
     return out
 
 
@@ -120,6 +128,7 @@ def render(rounds: List[Tuple[int, dict]]) -> str:
         ("replan_overhead_pct", "replan % (≤1)"),
         ("slo_overhead_pct", "slo % (≤1)"),
         ("replan_settle_speedup", f"settle × (≥{REPLAN_SETTLE_MIN:g})"),
+        ("soak_smoke", "soak smoke s (green, ≤budget)"),
     ]
     lines = [
         "# Perf trajectory — every committed driver-bench round",

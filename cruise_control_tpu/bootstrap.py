@@ -625,6 +625,7 @@ def build_app(
         hard_goal_names=cfg.get_list("hard.goals"),
         breaker=breaker,
         replanner=replanner,
+        replan_heals=cfg.get_boolean("replan.heal.enabled"),
     )
     if kafka_mode and cfg.get_int("num.metric.fetchers") > 1:
         # each per-fetcher consumer reads the WHOLE reporter topic (the

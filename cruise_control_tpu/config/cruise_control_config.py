@@ -447,6 +447,14 @@ def default_config_def() -> ConfigDef:
              "replans even when its input signature matches the "
              "previously verified state (signature reuse is exact, so "
              "this buys audit comfort, not correctness).", None, G)
+    d.define("replan.heal.enabled", ConfigType.BOOLEAN, False,
+             Importance.MEDIUM, "Route full-stack self-healing rebalances "
+             "(the detector's goal-violation fixes with the default goal "
+             "stack and options) through the delta replanner too, so a "
+             "heal plan WARM-STARTS from the previous plan and commits "
+             "itself as the next diff base — the warm control loop "
+             "covers the fault path, not just proposal refreshes.  "
+             "Requires replan.enabled.", None, G)
     d.define("replan.table.carry.enabled", ConfigType.BOOLEAN, True,
              Importance.LOW, "Carry the TPU engine's device model and "
              "pool row tables across plans, so a warm replan re-uploads "
@@ -1044,6 +1052,30 @@ def default_config_def() -> ConfigDef:
     d.define("simulation.target.mean.utilization", ConfigType.DOUBLE, 0.45,
              Importance.LOW, "Auto-sized broker capacities aim for this "
              "mean utilization.", between(0.01, 1), G)
+    # long-horizon soak driver (python -m cruise_control_tpu.sim.soak):
+    # a seeded fault-schedule day over the full stack, gated on SLOs
+    d.define("sim.soak.profile", ConfigType.STRING, "soak_day",
+             Importance.LOW, "Named soak the CLI runs by default "
+             "(soak_smoke = the tier-1 fingerprinted variant, soak_day = "
+             "the full simulated day).", None, G)
+    d.define("sim.soak.seed", ConfigType.INT, 12,
+             Importance.LOW, "Fault-schedule RNG seed: same seed, same "
+             "day — byte for byte.", None, G)
+    d.define("sim.soak.num.brokers", ConfigType.INT, 1024,
+             Importance.LOW, "Soak cluster broker count (the committed "
+             "SOAK artifact runs >= 1000).", at_least(4), G)
+    d.define("sim.soak.num.partitions", ConfigType.INT, 4096,
+             Importance.LOW, "Soak cluster partition count.",
+             at_least(4), G)
+    d.define("sim.soak.duration.minutes", ConfigType.INT, 1440,
+             Importance.LOW, "Virtual soak horizon in minutes (1440 = one "
+             "day).", at_least(10), G)
+    d.define("sim.soak.engine", ConfigType.STRING, "tpu",
+             Importance.LOW, "Analyzer engine the soak's facade heals and "
+             "replans with (tpu | greedy).", None, G)
+    d.define("sim.soak.slo.window.minutes", ConfigType.INT, 60,
+             Importance.LOW, "Rolling SLO-engine window (virtual minutes) "
+             "for the soak's hysteresis pass.", at_least(1), G)
 
     G = "logging"
     d.define("logging.level", ConfigType.STRING, "INFO",

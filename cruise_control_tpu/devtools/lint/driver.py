@@ -64,6 +64,9 @@ from cruise_control_tpu.devtools.lint.rules_lock import LockDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_obs import ObsDynamicNameRule
 from cruise_control_tpu.devtools.lint.rules_retry import RetryDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_schema import JournalSchemaRule
+from cruise_control_tpu.devtools.lint.rules_wallclock import (
+    WallClockDisciplineRule,
+)
 from cruise_control_tpu.devtools.lint.rules_xjax import JaxTransitiveRule
 from cruise_control_tpu.devtools.lint.rules_xlock import CrossModuleLockRule
 
@@ -86,6 +89,7 @@ RULES = {
         JaxTransitiveRule(),
         DeadlinePropagationRule(),
         JournalSchemaRule(),
+        WallClockDisciplineRule(),
     )
 }
 

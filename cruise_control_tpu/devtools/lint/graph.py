@@ -114,6 +114,21 @@ class ClassSummary:
     methods: Set[str] = dataclasses.field(default_factory=set)
 
 
+@dataclasses.dataclass(frozen=True)
+class WallClockSite:
+    """One ``time.time()``/``time.monotonic()``/argless ``datetime.now()``
+    call, with the context the wall-clock-discipline rule scopes on."""
+
+    lineno: int
+    call: str                    # dotted callee as written
+    func: str                    # innermost enclosing function name ("" =
+    #                              module level)
+    clock_param: bool            # an enclosing function takes an injected
+    #                              clock/now parameter
+    guarded: bool                # the documented `X if X is None else X`
+    #                              wall-clock-as-fallback idiom
+
+
 @dataclasses.dataclass
 class ModuleSummary:
     path: str                                   # repo-relative (driver sets)
@@ -130,6 +145,9 @@ class ModuleSummary:
     emits: List[EmitSite] = dataclasses.field(default_factory=list)
     #: compile-cache-key normalization sites: (lineno, excluded key names)
     normalized_keys: List[Tuple[int, Tuple[str, ...]]] = dataclasses.field(
+        default_factory=list)
+    #: wall-clock reads (rules_wallclock consumes these in phase 2)
+    wallclock_sites: List[WallClockSite] = dataclasses.field(
         default_factory=list)
 
 
@@ -524,6 +542,72 @@ class _Extractor:
                             (node.lineno, keys))
 
 
+#: parameter names that mark a function as receiving an injected clock —
+#: inside such a function a direct wall-clock read is drift by definition
+CLOCK_PARAMS = frozenset((
+    "now", "now_ms", "now_s", "time_ms", "clock", "time_fn", "wall_clock",
+))
+
+#: the wall-clock reads the discipline rule cares about
+_WALL_CALLS = frozenset(("time.time", "time.monotonic"))
+
+
+def _is_wall_call(node: ast.Call) -> Optional[str]:
+    d = dotted(node.func)
+    if d is None:
+        return None
+    if d in _WALL_CALLS:
+        return d
+    # argless datetime.now() / datetime.datetime.now()
+    if d.endswith("datetime.now") or d == "datetime.now":
+        if not node.args and not node.keywords:
+            return d
+    return None
+
+
+def _is_none_test(test: ast.expr) -> bool:
+    return (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], (ast.Is, ast.IsNot))
+        and any(isinstance(c, ast.Constant) and c.value is None
+                for c in [test.left] + list(test.comparators))
+    )
+
+
+def _extract_wallclock(tree: ast.Module) -> List[WallClockSite]:
+    """One recursive pass tracking the enclosing-function stack and the
+    ``is None``-guard stack (the wall-clock-as-fallback idiom)."""
+    sites: List[WallClockSite] = []
+
+    def walk(node, funcs, clock_param, guarded):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+            funcs = funcs + [node.name]
+            clock_param = clock_param or bool(params & CLOCK_PARAMS)
+        elif isinstance(node, ast.Lambda):
+            a = node.args
+            params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+            clock_param = clock_param or bool(params & CLOCK_PARAMS)
+        elif isinstance(node, (ast.IfExp, ast.If)) \
+                and _is_none_test(node.test):
+            guarded = True
+        elif isinstance(node, ast.Call):
+            call = _is_wall_call(node)
+            if call is not None:
+                sites.append(WallClockSite(
+                    lineno=node.lineno, call=call,
+                    func=funcs[-1] if funcs else "",
+                    clock_param=clock_param, guarded=guarded,
+                ))
+        for child in ast.iter_child_nodes(node):
+            walk(child, funcs, clock_param, guarded)
+
+    walk(tree, [], False, False)
+    return sites
+
+
 def extract_summary(tree: ast.Module, nodes=None) -> ModuleSummary:
     """Build a ModuleSummary for one parsed file.  ``nodes`` is the
     FileContext's memoized flat node list (used only to find jit
@@ -534,7 +618,13 @@ def extract_summary(tree: ast.Module, nodes=None) -> ModuleSummary:
 
     jit = [(fn, set(static)) for fn, static in
            find_jit_functions(tree, nodes)]
-    return _Extractor(tree, jit).summary
+    summary = _Extractor(tree, jit).summary
+    # the scope/guard walk only runs on files that read a wall clock at
+    # all (the flat node list answers that in one cheap scan)
+    if any(isinstance(n, ast.Call) and _is_wall_call(n) is not None
+           for n in (nodes if nodes is not None else ast.walk(tree))):
+        summary.wallclock_sites = _extract_wallclock(tree)
+    return summary
 
 
 # ---- the assembled graph --------------------------------------------------------

@@ -187,6 +187,19 @@ class SimulatedClusterBackend(ClusterBackend):
             )
             self._progress[p] = 0
 
+    def _promote_leader(self, st: PartitionState) -> None:
+        """Leader election after a membership change: prefer a LIVE
+        replica (what the Kafka controller does) — promoting a dead
+        broker leaves a partition leaderless-in-practice while live
+        replicas exist, the placement violation ISSUE 12's soak caught."""
+        if st.leader in st.replicas and st.leader not in self.failed_brokers:
+            return
+        live = [b for b in st.replicas if b not in self.failed_brokers]
+        if live:
+            st.leader = live[0]
+        elif st.replicas and st.leader not in st.replicas:
+            st.leader = st.replicas[0]
+
     def elect_leaders(self, partitions: Dict[int, int]) -> None:
         for p, leader in partitions.items():
             st = self.partitions[p]
@@ -227,8 +240,7 @@ class SimulatedClusterBackend(ClusterBackend):
             # membership and its catching-up (URP) status
             st.replicas = [b for b in st.replicas if b not in adds]
             st.catching_up -= set(adds)
-            if st.leader not in st.replicas and st.replicas:
-                st.leader = st.replicas[0]
+            self._promote_leader(st)
 
     def partition_state(self, partition: int) -> PartitionState:
         return self.partitions[partition]
@@ -278,8 +290,7 @@ class SimulatedClusterBackend(ClusterBackend):
                 st.catching_up -= set(new)
                 old = st.replicas
                 st.replicas = list(new)
-                if st.leader not in st.replicas:
-                    st.leader = st.replicas[0]
+                self._promote_leader(st)
                 # keep the replica→dir map honest: dropped replicas free
                 # their dir entry; arrivals land on a healthy dir when the
                 # broker has one (upstream: alterReplicaLogDirs picks a

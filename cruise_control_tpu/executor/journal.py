@@ -166,6 +166,10 @@ class ExecutionJournal:
         self._crash_after: Optional[int] = None
         #: group-commit buffer of serialized-but-unflushed records
         self._pending: List[str] = []
+        #: lifetime high-water mark of the on-disk checkpoint (bytes) —
+        #: compaction/truncation shrink the file mid-execution, so a
+        #: retention gate needs the peak, not the (usually empty) endpoint
+        self.high_water_bytes = 0
         #: compaction model: latest start payload + per-task latest states
         self._start: Optional[dict] = None
         self._tasks: Dict[int, dict] = {}
@@ -277,6 +281,8 @@ class ExecutionJournal:
         self._fh.write(data)
         self._fh.flush()
         self._bytes += len(data)
+        if self._bytes > self.high_water_bytes:
+            self.high_water_bytes = self._bytes
 
     def _snapshot_records(self) -> List[dict]:
         """The compacted equivalent of the current file contents."""

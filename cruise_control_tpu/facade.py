@@ -95,6 +95,7 @@ class CruiseControl:
         hard_goal_names: Optional[Sequence[str]] = None,
         breaker: Optional["CircuitBreaker"] = None,
         replanner: Optional["DeltaReplanner"] = None,
+        replan_heals: bool = False,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -147,6 +148,12 @@ class CruiseControl:
         #: delta replanner (replan.DeltaReplanner); None = every proposal
         #: computation cold-starts.  Bootstrap wires it from replan.*
         self.replanner = replanner
+        #: replan.heal.enabled: full-stack self-healing rebalances (the
+        #: detector's goal-violation fixes) ALSO route through the
+        #: replanner and warm-start from the previous plan — the
+        #: steady-state control loop ROADMAP item 4 closes.  Off keeps the
+        #: historical cold heal path.
+        self.replan_heals = bool(replan_heals)
         self._start_time = time.time()
         # cached proposals (upstream GoalOptimizer proposal precompute, §3.5)
         self._proposal_ttl_s = proposal_ttl_s
@@ -538,6 +545,26 @@ class CruiseControl:
             )
             goals = (INTRA_BROKER_GOAL_ORDER if rebalance_disk
                      else KAFKA_ASSIGNER_GOAL_ORDER)
+        if (
+            self.replan_heals
+            and self.replanner is not None
+            and goals is None
+            and options is None
+            and requirements is None
+        ):
+            # replan.heal.enabled: a full-stack default-option rebalance —
+            # the detector's goal-violation fix — warm-starts from the
+            # previous plan through the replanner (the same single-flight
+            # lock the proposal path holds, so a heal and a refresh never
+            # interleave their snapshot commits).  Goal subsets, explicit
+            # options, and completeness overrides keep the cold path: the
+            # snapshot describes full-stack plans only.
+            with self._compute_lock:
+                result, _state = self._replan_operation(
+                    "REBALANCE", dryrun, engine,
+                    self._model_generation(), progress, strategy,
+                )
+            return result
         state = self._model(requirements, progress)
         return self._goal_based_operation(
             "REBALANCE", state, goals, options or OptimizationOptions(),
@@ -906,7 +933,15 @@ class CruiseControl:
         return result
 
     def _replan_proposals(self, engine, generation: str, progress):
-        """Proposal computation through the delta replanner: delta model
+        """Proposal computation through the delta replanner (see
+        :meth:`_replan_operation`)."""
+        return self._replan_operation(
+            "PROPOSALS", True, engine, generation, progress
+        )
+
+    def _replan_operation(self, operation: str, dryrun: bool, engine,
+                          generation: str, progress, strategy=None):
+        """A goal-based operation through the delta replanner: delta model
         build under the model semaphore → warm-start decision → warm (or
         cold) optimization → snapshot commit.  A warm-path failure falls
         back to one cold attempt — a replan must never be WORSE than the
@@ -914,13 +949,21 @@ class CruiseControl:
         (``replan.start`` / ``replan.end`` / ``replan.warm_failed``).
         The whole decision runs under a ``facade.replan`` span, so a
         trace reconstructed from one id shows the replan phase between
-        the request span and the engine's device slices."""
+        the request span and the engine's device slices.
+
+        ``dryrun=False`` is the self-healing seam (replan.heal.enabled):
+        the detector's full-stack REBALANCE fix warm-starts exactly like a
+        proposal refresh, then executes — so a fault's heal plan absorbs
+        into the steady state instead of cold-recomputing.  Executed
+        operations never take the zero-delta short-circuit (re-executing a
+        snapshot plan would re-issue moves the cluster already made)."""
         with tracing.span("facade.replan"):
-            return self._replan_proposals_traced(
-                engine, generation, progress
+            return self._replan_operation_traced(
+                operation, dryrun, engine, generation, progress, strategy
             )
 
-    def _replan_proposals_traced(self, engine, generation: str, progress):
+    def _replan_operation_traced(self, operation: str, dryrun: bool, engine,
+                                 generation: str, progress, strategy=None):
         built = self._model(
             None, progress, builder=self.replanner.build_model
         )
@@ -934,13 +977,20 @@ class CruiseControl:
         # story closed: a window roll re-validates the cached plan in
         # milliseconds instead of recomputing it.  The full-verify
         # safety net (replan.full.verify) disables the short-circuit.
-        snap_result = self.replanner.servable_snapshot(
-            engine or self.default_engine, delta
+        snap_result = (
+            self.replanner.servable_snapshot(
+                engine or self.default_engine, delta
+            ) if dryrun else None
         )
+        # heal-origin replans stamp their operation on the envelope;
+        # PROPOSALS refreshes keep their historical (fingerprinted) shape
+        op_extra = {} if operation == "PROPOSALS" else {
+            "operation": operation}
         if warm is not None and snap_result is not None:
             events.emit(
                 "replan.start", mode="warm", reason=None,
                 generation=generation, dirtyPartitions=0, deltaModel=True,
+                **op_extra,
             )
             self.replanner.commit(
                 state, snap_result, generation, agg_mark
@@ -952,7 +1002,7 @@ class CruiseControl:
                 shortCircuit=True,
                 tableCarry=bool(self.replanner.carry.tables is not None),
                 engine=snap_result.engine, goalsReused=-1,
-                durationS=0.0,
+                durationS=0.0, **op_extra,
             )
             progress.add_step("Re-validated previous plan (zero delta)")
             return snap_result, state
@@ -964,13 +1014,14 @@ class CruiseControl:
                 delta.n_dirty_partitions if delta is not None else None
             ),
             deltaModel=bool(delta is not None and not delta.full),
+            **op_extra,
         )
         t0 = time.perf_counter()
         kwargs = self.replanner.engine_kwargs(warm) if warm else {}
         try:
             result = self._goal_based_operation(
-                "PROPOSALS", state, None, OptimizationOptions(), True,
-                engine, progress, **kwargs,
+                operation, state, None, OptimizationOptions(), dryrun,
+                engine, progress, strategy, **kwargs,
             )
         except Exception as e:
             if warm is None:
@@ -986,8 +1037,8 @@ class CruiseControl:
             self.replanner.reset("warm-failed")
             mode, reason = "cold", "warm-failed"
             result = self._goal_based_operation(
-                "PROPOSALS", state, None, OptimizationOptions(), True,
-                engine, progress,
+                operation, state, None, OptimizationOptions(), dryrun,
+                engine, progress, strategy,
             )
         self.replanner.commit(state, result, generation, agg_mark)
         self.replanner.record_mode(mode, reason)
@@ -1006,6 +1057,7 @@ class CruiseControl:
                 len(verify["reusedAfter"]) if verify is not None else 0
             ),
             durationS=round(time.perf_counter() - t0, 4),
+            **op_extra,
         )
         return result, state
 

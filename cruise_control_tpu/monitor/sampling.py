@@ -70,21 +70,40 @@ class CruiseControlMetric:
 
 class MetricsTopic:
     """In-memory ``__CruiseControlMetrics``: append-only log with offset-based
-    consumption so multiple samplers can tail it independently."""
+    consumption so multiple samplers can tail it independently.
 
-    def __init__(self, name: str = "__CruiseControlMetrics") -> None:
+    Like its real-Kafka namesake the log has **retention**: only the newest
+    ``max_records`` records are kept (a 1000-broker reporter produces ~15k
+    records per interval — an unbounded log is a multi-GB leak over a
+    simulated day, the exact failure mode the long-horizon soak gates on).
+    Offsets are absolute and survive trimming; a consumer that fell behind
+    retention simply resumes from the oldest retained record, exactly like
+    a Kafka consumer whose offset aged out.
+    """
+
+    def __init__(self, name: str = "__CruiseControlMetrics",
+                 max_records: Optional[int] = 1_000_000) -> None:
         self.name = name
+        self.max_records = max_records
         self._records: List[CruiseControlMetric] = []
+        #: absolute offset of ``_records[0]`` (> 0 once retention trimmed)
+        self._base = 0
 
     def produce(self, records: Iterable[CruiseControlMetric]) -> None:
         self._records.extend(records)
+        if self.max_records is not None \
+                and len(self._records) > self.max_records:
+            drop = len(self._records) - self.max_records
+            del self._records[:drop]
+            self._base += drop
 
     def consume_from(self, offset: int) -> Tuple[List[CruiseControlMetric], int]:
-        records = self._records[offset:]
-        return records, len(self._records)
+        start = max(int(offset) - self._base, 0)
+        records = self._records[start:]
+        return records, self._base + len(self._records)
 
     def __len__(self) -> int:
-        return len(self._records)
+        return self._base + len(self._records)
 
 
 # ---------------------------------------------------------------------------------

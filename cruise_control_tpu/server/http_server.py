@@ -448,7 +448,13 @@ class CruiseControlHttpServer:
             "draining": draining,
             "admission": self.admission.state_summary(),
         }
-        return self._send(handler, 200 if ready else 503, body)
+        # an unready 503 carries Retry-After like every other
+        # backpressure response (shed fairness: no 5xx without guidance)
+        return self._send(
+            handler, 200 if ready else 503, body,
+            headers=(None if ready
+                     else {"Retry-After": str(RETRY_AFTER_NOT_READY_S)}),
+        )
 
     def _authenticated(self, handler) -> bool:
         """Support both the provider SPI (authenticate_request) and the

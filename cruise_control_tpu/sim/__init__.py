@@ -11,10 +11,23 @@ from cruise_control_tpu.sim.artifact import (
     scenario_summary,
 )
 from cruise_control_tpu.sim.backend import ScriptedClusterBackend
+from cruise_control_tpu.sim.fault_schedule import (
+    FaultScheduleConfig,
+    generate_timeline,
+    schedule_summary,
+)
 from cruise_control_tpu.sim.scenarios import (
     SCENARIOS,
     SMOKE_SCENARIOS,
     make_scenario,
+)
+from cruise_control_tpu.sim.soak import (
+    SOAKS,
+    SoakResult,
+    SoakSpec,
+    make_soak_artifact,
+    run_soak,
+    smoke_spec,
 )
 from cruise_control_tpu.sim.simulator import (
     ScenarioResult,
@@ -29,16 +42,25 @@ __all__ = [
     "SCHEMA",
     "SCENARIOS",
     "SMOKE_SCENARIOS",
+    "SOAKS",
+    "FaultScheduleConfig",
     "ScenarioResult",
     "ScenarioSpec",
     "ScenarioWorkload",
     "ScriptedClusterBackend",
+    "SoakResult",
+    "SoakSpec",
     "Timeline",
     "TimelineEvent",
+    "generate_timeline",
     "journal_fingerprint",
     "make_artifact",
     "make_scenario",
     "make_slo_artifact",
+    "make_soak_artifact",
     "run_scenario",
+    "run_soak",
     "scenario_summary",
+    "schedule_summary",
+    "smoke_spec",
 ]

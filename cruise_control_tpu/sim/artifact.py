@@ -7,7 +7,7 @@ anomaly decisions, and what the executor actually did — every field derived
 from the run's event journal (the same ground truth the test suite asserts
 on).  The checked-in contract lives in ``tests/schemas/artifacts.schema.json``
 (closed records — field drift fails CI), and the committed instance is
-``SCENARIOS_r11.json``.  :func:`make_slo_artifact` collapses one scenario's
+``SCENARIOS_r12.json``.  :func:`make_slo_artifact` collapses one scenario's
 journal into the SLO observatory's gate table — the artifact shape the
 long-horizon soak (ROADMAP item 5) will gate on.
 """
@@ -64,14 +64,16 @@ def scenario_summary(result: ScenarioResult) -> dict:
     }
 
 
-def make_artifact(results: Sequence[ScenarioResult]) -> dict:
+def make_artifact(results: Sequence[ScenarioResult],
+                  now: Optional[float] = None) -> dict:
+    now = time.time() if now is None else now
     scenarios: List[dict] = [scenario_summary(r) for r in results]
     outcomes: Dict[str, int] = {}
     for s in scenarios:
         outcomes[s["healOutcome"]] = outcomes.get(s["healOutcome"], 0) + 1
     return {
         "schema": SCHEMA,
-        "generated_unix": round(time.time(), 3),
+        "generated_unix": round(now, 3),
         "scenarios": scenarios,
         "summary": {
             "numScenarios": len(scenarios),

@@ -41,6 +41,12 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
         super().__init__(assignment, leaders,
                          move_latency_ticks=move_latency_ticks,
                          brokers=set(brokers))
+        #: virtual-clock source the driver injects (sim.now_ms): armed
+        #: kills/flaps journal the moment they actually FIRE — the arm
+        #: marker alone charges heal latency from a countdown that may
+        #: sit frozen for hours between executions (backend ticks only
+        #: advance while moves are in flight)
+        self.clock_ms = None
         #: broker → rack id; the metadata client shares this dict, so
         #: add_broker updates both views at once
         self.broker_racks: Dict[int, int] = dict(broker_racks)
@@ -59,6 +65,17 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
         #: live flap state machine: [broker, phase_ticks_left, is_down,
         #: cycles_left, down_ticks, up_ticks]
         self._flap_state: Optional[list] = None
+
+    def _journal_fired(self, fault: str, **args) -> None:
+        """The armed fault actually landed: a journal marker at the REAL
+        virtual time (heal-latency pairing reads these; the arm-time
+        sim.fault marker stays for schedule provenance)."""
+        if self.clock_ms is None:
+            return
+        from cruise_control_tpu.telemetry import events
+
+        events.emit("sim.fault", fault=fault,
+                    virtualMs=int(self.clock_ms()), args=args)
 
     # ---- timeline surface -------------------------------------------------------
     def kill_broker(self, broker: int) -> None:
@@ -157,8 +174,7 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
             if stale:
                 st.catching_up -= stale
                 st.replicas = [b for b in st.replicas if b not in stale]
-                if st.leader not in st.replicas and st.replicas:
-                    st.leader = st.replicas[0]
+                self._promote_leader(st)
         super().alter_partition_reassignments(reassignments)
         if self._stall_batches_left > 0:
             self._stall_batches_left -= 1
@@ -188,6 +204,8 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
                 # [broker, phase_ticks_left, is_down, cycles_left, down, up]
                 self._flap_state = [broker, down, True, cycles, down, up]
                 self.kill_broker(broker)
+                self._journal_fired("kill_broker", broker=broker,
+                                    via="flap")
         elif self._flap_state is not None:
             st = self._flap_state
             st[1] -= 1
@@ -195,6 +213,8 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
                 broker = st[0]
                 if st[2]:  # down phase over: broker comes back
                     self.restore_broker(broker)
+                    self._journal_fired("restore_broker", broker=broker,
+                                        via="flap")
                     st[2] = False
                     st[1] = st[5]
                     st[3] -= 1
@@ -202,6 +222,8 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
                     self._flap_state = None
                 else:  # up phase over: broker dies again
                     self.kill_broker(broker)
+                    self._journal_fired("kill_broker", broker=broker,
+                                        via="flap")
                     st[2] = True
                     st[1] = st[4]
         if self._armed_kill is not None:
@@ -224,6 +246,8 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
                         self._armed_countdown = 1
                     else:
                         self.kill_broker(victim)
+                        self._journal_fired("kill_broker", broker=victim,
+                                            via="armed")
                         self._armed_kill = None
                         self._armed_countdown = None
         stalled = {p for p, left in self._stalled.items() if left > 0}

@@ -152,6 +152,28 @@ class ScenarioSpec:
     replan_enabled: bool = False
     replan_budget_ratio: float = 0.5
     replan_load_threshold: float = 0.05
+    #: route goal-violation self-heal rebalances through the replanner
+    #: too (warm heal plans — ROADMAP item 4's closed loop); off by
+    #: default so pre-existing scenario journals keep their bits
+    replan_heal: bool = False
+    #: the engine the facade optimizes with (self-heals AND proposals).
+    #: Scenarios keep the greedy default; the 1000-broker soak runs "tpu".
+    engine: str = "greedy"
+    # metric-anomaly finder tuning (the production metric.anomaly.* keys;
+    # defaults mirror PercentileMetricAnomalyFinder's).  A full-stack
+    # rebalance redistributes traffic, so at soak scale every broker's
+    # own-history percentile breaches right after a heal — the soak widens
+    # the margin and slows the detector instead of drowning the journal.
+    metric_anomaly_margin: float = 1.5
+    metric_anomaly_min_windows: int = 3
+    metric_anomaly_interval_ms: Optional[int] = None
+    # journal shape for the run: ring size and (for the long-horizon soak)
+    # file-backed size rotation, so retention is exercised under load.
+    # Scenarios stay in-memory with the historical ring.
+    journal_ring_size: int = 1 << 15
+    journal_path: Optional[str] = None
+    journal_max_bytes: int = 16 * 1024 * 1024
+    journal_max_files: int = 3
 
     def healing_enables(self) -> Dict[AnomalyType, bool]:
         return {
@@ -370,14 +392,24 @@ def journal_fingerprint(journal: Sequence[dict]) -> str:
 
 # ---------------------------------------------------------------------------------
 @contextlib.contextmanager
-def _scenario_journal(ring_size: int = 1 << 15):
-    """Swap a dedicated in-memory EventJournal in for the run, so scenario
-    records never mix with (or leak into) the process-wide journal."""
+def _scenario_journal(ring_size: int = 1 << 15, path: Optional[str] = None,
+                      max_bytes: int = 16 * 1024 * 1024, max_files: int = 3,
+                      clock=None):
+    """Swap a dedicated EventJournal in for the run, so scenario records
+    never mix with (or leak into) the process-wide journal.  ``clock``
+    injects the run's virtual clock as the ``ts`` source — ts-windowed
+    readers (the SLO engine's sliding window) then follow the scenario
+    clock, not the host's.  ``path`` adds file-backed size rotation (the
+    soak's retention exercise); scenarios stay in-memory."""
     prev = events.JOURNAL
-    events.JOURNAL = EventJournal(enabled=True, ring_size=ring_size)
+    events.JOURNAL = EventJournal(
+        enabled=True, ring_size=ring_size, path=path,
+        max_bytes=max_bytes, max_files=max_files, clock=clock,
+    )
     try:
         yield events.JOURNAL
     finally:
+        events.JOURNAL.close()
         events.JOURNAL = prev
 
 
@@ -387,7 +419,7 @@ def _script_analyzer_outage(cc) -> None:
     seams stay the backend/workload as ever)."""
 
     class _FailingOptimizer:
-        def optimize(self, state, options=None):
+        def optimize(self, state, options=None, **kwargs):
             raise RuntimeError("scripted analyzer outage")
 
     cc._make_engine = lambda engine, constraint=None: _FailingOptimizer()
@@ -436,6 +468,9 @@ class _Sim:
             },
             move_latency_ticks=spec.move_latency_ticks,
         )
+        #: armed kills/flaps journal the moment they FIRE, at the real
+        #: virtual time (heal-latency pairing reads the firing, not the arm)
+        self.backend.clock_ms = lambda: self.now_ms
         self._partition_topic = {
             p: f"topic_{int(state.partition_topic[p])}" for p in w.assignment
         }
@@ -523,8 +558,9 @@ class _Sim:
         # a private registry: scenario runs must not pollute the process
         # default the server / other tests read
         self.cc = CruiseControl(
-            self.monitor, self.executor, engine="greedy",
+            self.monitor, self.executor, engine=spec.engine,
             registry=MetricRegistry(), breaker=breaker,
+            replan_heals=spec.replan_heal,
         )
         if spec.replan_enabled:
             from cruise_control_tpu.replan import (
@@ -543,6 +579,15 @@ class _Sim:
             )
         if self.analyzer_down:
             _script_analyzer_outage(self.cc)
+        from cruise_control_tpu.detector.detectors import (
+            PercentileMetricAnomalyFinder,
+        )
+
+        per_type_interval = {}
+        if spec.metric_anomaly_interval_ms:
+            per_type_interval[AnomalyType.METRIC_ANOMALY] = int(
+                spec.metric_anomaly_interval_ms
+            )
         self.manager = make_detector_manager(
             self.cc,
             backend=self.backend,
@@ -557,6 +602,10 @@ class _Sim:
             ),
             target_rf=spec.target_rf,
             maintenance_reader=self.maintenance,
+            metric_finder=PercentileMetricAnomalyFinder(
+                margin=spec.metric_anomaly_margin,
+                min_windows=spec.metric_anomaly_min_windows,
+            ),
             detection_goal_names=(
                 list(spec.detection_goals) if spec.detection_goals else None
             ),
@@ -565,6 +614,7 @@ class _Sim:
             ),
             detection_interval_ms=spec.detection_interval_ms,
             fix_cooldown_ms=spec.fix_cooldown_ms,
+            per_type_interval_ms=per_type_interval or None,
         )
         if spec.serve_http:
             # the REAL front door: one worker thread + a deterministic
@@ -852,10 +902,17 @@ def _apply_request_storm(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
     def one(_: int) -> dict:
         return sim._request(method, endpoint, dict(params))
 
-    with ThreadPoolExecutor(max_workers=n) as pool:
-        results = list(pool.map(one, range(n)))
+    if sim.process_up:
+        with ThreadPoolExecutor(max_workers=n) as pool:
+            results = list(pool.map(one, range(n)))
+    else:
+        # the storm hits a crashed process: every connection dies at the
+        # socket, which is the CRASH's signature (sim.crash is on the
+        # record), not a serving-layer 5xx — counted as unreachable
+        results = [{"status": 0, "retryAfter": None} for _ in range(n)]
     status_counts: Dict[str, int] = {}
     shed_with_retry = shed_without_retry = server_errors = ok = 0
+    unreachable = 0
     for r in results:
         status_counts[str(r["status"])] = \
             status_counts.get(str(r["status"]), 0) + 1
@@ -864,7 +921,9 @@ def _apply_request_storm(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
                 shed_with_retry += 1
             else:
                 shed_without_retry += 1
-        elif r["status"] >= 500 or r["status"] == 0:
+        elif r["status"] == 0:
+            unreachable += 1
+        elif r["status"] >= 500:
             server_errors += 1
         elif 200 <= r["status"] < 300:
             ok += 1
@@ -874,15 +933,27 @@ def _apply_request_storm(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
         statusCounts={k: status_counts[k] for k in sorted(status_counts)},
         admitted=ok, shedWithRetryAfter=shed_with_retry,
         shedMissingRetryAfter=shed_without_retry,
-        unhandled5xx=server_errors,
+        unhandled5xx=server_errors, unreachable=unreachable,
     )
 
 
-def run_scenario(spec: ScenarioSpec) -> ScenarioResult:
+def run_scenario(spec: ScenarioSpec, on_tick=None) -> ScenarioResult:
     """Drive one scenario to completion and return the journal-backed
-    result.  Deterministic: same spec (incl. seed) ⇒ same fingerprint."""
+    result.  Deterministic: same spec (incl. seed) ⇒ same fingerprint.
+
+    ``on_tick(sim, now_ms)`` runs at the end of every tick (the soak
+    driver's seam: resource sampling, rolling SLO evaluation, placement
+    invariants) — it must not mutate the system under test.  The journal's
+    ``ts`` field follows the VIRTUAL clock for the whole run (it is
+    volatile for fingerprints either way), so ts-windowed readers see
+    scenario time."""
     spec.timeline.reset()
-    with _scenario_journal() as journal:
+    clock_ms = [0.0]
+    with _scenario_journal(
+        ring_size=spec.journal_ring_size, path=spec.journal_path,
+        max_bytes=spec.journal_max_bytes, max_files=spec.journal_max_files,
+        clock=lambda: clock_ms[0] / 1000.0,
+    ) as journal:
         sim = _Sim(spec)
         events.emit(
             "sim.scenario_start", name=spec.name, seed=spec.seed,
@@ -900,6 +971,7 @@ def run_scenario(spec: ScenarioSpec) -> ScenarioResult:
             now += spec.tick_ms
             ticks += 1
             sim.now_ms = now  # injected clocks (the breaker) read this
+            clock_ms[0] = float(now)  # the journal's ts source
             for ev in spec.timeline.pop_due(now):
                 _apply_event(sim, ev, now)
             sim.workload.advance(now)
@@ -925,6 +997,8 @@ def run_scenario(spec: ScenarioSpec) -> ScenarioResult:
                 # the process is down but the cluster lives on: in-flight
                 # reassignments keep progressing, brokers keep flapping
                 sim.backend.tick()
+            if on_tick is not None:
+                on_tick(sim, now)
         sim.stop_serving()  # graceful drain (journaled) before the end mark
         events.emit(
             "sim.scenario_end", name=spec.name, virtualMs=now, ticks=ticks,

@@ -70,15 +70,25 @@ class EventJournal:
         max_bytes: int = _DEFAULT_MAX_BYTES,
         max_files: int = _DEFAULT_MAX_FILES,
         ring_size: int = _DEFAULT_RING_SIZE,
+        clock=None,
     ):
         self.enabled = enabled
         self.path = path
         self.max_bytes = max(4096, int(max_bytes))
         self.max_files = max(1, int(max_files))
+        #: the ``ts`` source.  Production journals stamp wall time; the
+        #: scenario simulator injects its virtual clock so ts-windowed
+        #: readers (the SLO engine's sliding window) follow the scenario
+        #: clock instead of the host's — a soak evaluating "the last 30
+        #: minutes" means 30 *virtual* minutes.
+        self.clock = clock or time.time
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(16, int(ring_size)))
         self._fh = None
         self._bytes_written = 0
+        #: total records accepted since construction — the ring is bounded,
+        #: so long-horizon growth accounting needs the unclipped count
+        self.total_emitted = 0
         self._local = threading.local()
 
     # ---- configuration ----------------------------------------------------------
@@ -172,7 +182,7 @@ class EventJournal:
             trace_id = getattr(self._local, "trace", None)
         rec: Dict[str, Any] = {
             "schema": SCHEMA,
-            "ts": round(time.time(), 3),
+            "ts": round(self.clock(), 3),
             "kind": kind,
             "severity": severity if severity in SEVERITIES else "INFO",
         }
@@ -191,6 +201,7 @@ class EventJournal:
             return
         with self._lock:
             self._ring.append(rec)
+            self.total_emitted += 1
             if self.path:
                 try:
                     self._write_line(line)

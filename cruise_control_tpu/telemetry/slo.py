@@ -49,37 +49,55 @@ OK = "OK"
 BREACHED = "BREACHED"
 NO_DATA = "NO_DATA"
 
-#: timeline fault kinds that start a heal-latency clock (scripted faults
-#: an anomaly detector is expected to react to; serving-layer chaos and
-#: operator events are not heal targets)
-FAULT_KINDS = frozenset((
-    "kill_broker", "kill_broker_mid_execution", "rack_loss",
-    "disk_failure", "hot_partition_skew", "perturb_broker_load",
-    "fail_partition", "crash_process", "flap_broker",
-))
+#: timeline fault kind → the anomaly type expected to heal it.  Faults
+#: pair with fixes of their OWN type: a mild load perturbation the warm
+#:  replanner absorbs silently must not charge its timestamp to the next
+#: broker-failure heal (the mispairing a day-long soak makes obvious —
+#: every leftover fault inflated a later fix by hours).  Serving-layer
+#: chaos, operator events, and process crashes (healed by the recovery
+#: path, not a detector fix) are not heal targets.
+FAULT_ANOMALY_TYPES: Dict[str, str] = {
+    "kill_broker": "BROKER_FAILURE",
+    "rack_loss": "BROKER_FAILURE",
+    "disk_failure": "DISK_FAILURE",
+    "hot_partition_skew": "GOAL_VIOLATION",
+    "perturb_broker_load": "GOAL_VIOLATION",
+    "fail_partition": "GOAL_VIOLATION",
+    # armed faults (kill_broker_mid_execution, flap_broker) pair via the
+    # "kill_broker" marker the backend journals when the arm actually
+    # FIRES — the arm-time marker may precede the death by hours (the
+    # countdown only advances while an execution drives backend ticks)
+}
+
+#: kinds that start a heal-latency clock (kept for artifact consumers)
+FAULT_KINDS = frozenset(FAULT_ANOMALY_TYPES)
 
 
 # ---- journal-derived measurements ------------------------------------------------
 def heal_latencies_ms(journal: Sequence[dict]) -> List[int]:
     """Heal-latency samples (virtual ms, journal order): one sample per
     ``detector.anomaly`` record with ``fixStarted`` — measured from the
-    earliest unconsumed scripted fault marker (``sim.fault`` carrying
-    ``virtualMs``), or, absent fault markers (live deployments), from the
-    first detection of that anomaly type in the current episode — to the
-    fix.  Delayed fixes (cooldown / ongoing execution) therefore charge
-    their full wait; multiple concurrent faults pair FIFO, an
-    approximation that is exact for the percentile view a soak gates on.
-    """
+    scripted fault that CAUSED the anomaly to the fix.
+
+    Pairing is per anomaly type: a fix of type T consumes the LATEST
+    unconsumed type-T fault marker at or before the type's first
+    detection in the episode (earlier unconsumed type-T faults coalesced
+    into the same anomaly — one rack loss is many broker deaths, one
+    heal — or were absorbed without a detector fix, and are dropped).
+    Absent fault markers (live deployments) the episode's first
+    detection starts the clock.  Delayed fixes (cooldown / ongoing
+    execution) charge their full wait either way."""
     samples: List[int] = []
-    pending_faults: List[int] = []
+    pending: Dict[str, List[int]] = {}
     first_seen: Dict[str, int] = {}
     for e in journal:
         kind = e.get("kind")
         p = e.get("payload", {})
         if kind == "sim.fault":
             t = p.get("virtualMs")
-            if t is not None and p.get("fault") in FAULT_KINDS:
-                pending_faults.append(int(t))
+            atype = FAULT_ANOMALY_TYPES.get(p.get("fault", ""))
+            if t is not None and atype is not None:
+                pending.setdefault(atype, []).append(int(t))
         elif kind == "detector.anomaly":
             t = p.get("timeMs")
             if t is None:
@@ -88,9 +106,21 @@ def heal_latencies_ms(journal: Sequence[dict]) -> List[int]:
             first_seen.setdefault(atype, int(t))
             if p.get("fixStarted"):
                 start = first_seen.pop(atype, int(t))
-                if pending_faults:
-                    start = min(start, pending_faults.pop(0))
+                q = pending.get(atype)
+                if q:
+                    causes = [f for f in q if f <= start]
+                    if causes:
+                        start = causes[-1]
+                        del q[:len(causes)]
                 samples.append(max(0, int(t) - start))
+            elif p.get("action") == "FIX_FAILED":
+                # a failed fix CLOSES the episode: if the violation
+                # persists the next detection re-seeds within one
+                # detection interval, but a violation that self-resolved
+                # (a hot spell reverting) must not leave a stale anchor
+                # that charges the NEXT heal of this type with hours of
+                # quiet (the mispairing a day-long soak exposed)
+                first_seen.pop(atype, None)
     return samples
 
 

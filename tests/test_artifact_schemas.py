@@ -193,6 +193,42 @@ def _trace_artifact():
     return [chrome_trace("probe-trace", store.spans("probe-trace"), evs)]
 
 
+def _soak_artifact():
+    """A micro soak (12 virtual minutes, 8 brokers) through the REAL
+    driver: the cc-tpu-soak/1 producer exercised end to end."""
+    from cruise_control_tpu.sim.fault_schedule import FaultScheduleConfig
+    from cruise_control_tpu.sim.soak import (
+        MIN_MS,
+        SoakSpec,
+        make_soak_artifact,
+        run_soak,
+    )
+
+    spec = SoakSpec(
+        name="soak_probe", seed=3,
+        num_brokers=8, num_racks=2, num_partitions=24, num_topics=2,
+        engine="greedy",
+        duration_ms=12 * MIN_MS, diurnal_period_ms=12 * MIN_MS,
+        detection_interval_ms=2 * MIN_MS, fix_cooldown_ms=MIN_MS,
+        precompute_interval_ticks=3,
+        journal_ring_size=4096, journal_max_bytes=65536,
+        sample_interval_ticks=2, slo_interval_ticks=4,
+        slo_window_ms=6 * MIN_MS,
+        schedule=FaultScheduleConfig(
+            seed=3, duration_ms=12 * MIN_MS,
+            num_brokers=8, num_racks=2, num_partitions=24,
+            broker_deaths=0, rack_losses=0, disk_failures=1,
+            hot_skews=0, load_perturbations=0, metric_gaps=0,
+            process_crashes=0, broker_flaps=0, analyzer_outages=0,
+            execution_stalls=0, request_storms=0,
+            settle_ms=3 * MIN_MS, quiet_tail_ms=4 * MIN_MS,
+            min_spacing_ms=2 * MIN_MS, heal_ms=2 * MIN_MS,
+            http_poll_interval_ms=4 * MIN_MS,
+        ),
+    )
+    return [make_soak_artifact(run_soak(spec))]
+
+
 def _scenario_artifact():
     from cruise_control_tpu.sim import ScenarioSpec, make_artifact, run_scenario
     from cruise_control_tpu.sim.timeline import Timeline, disk_failure
@@ -210,7 +246,7 @@ def _scenario_artifact():
 
 @pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
                                       "events", "scenarios", "checkpoint",
-                                      "slo", "trace"])
+                                      "slo", "trace", "soak"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     if producer == "phase-profile":
         arts = _phase_profile_artifact()
@@ -230,6 +266,12 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "trace":
         arts = _trace_artifact()
         schema = SCHEMAS["cc-tpu-trace/1"]
+    elif producer == "soak":
+        arts = _soak_artifact()
+        schema = SCHEMAS["cc-tpu-soak/1"]
+        # the embedded gate table is itself a valid cc-tpu-slo/1
+        validate(json.loads(json.dumps(arts[0]["slo"])),
+                 SCHEMAS["cc-tpu-slo/1"])
     else:
         arts = _event_records(tmp_path)
         schema = SCHEMAS["cc-tpu-events/1"]

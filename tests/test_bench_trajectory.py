@@ -38,12 +38,12 @@ def test_latest_round_holds_every_gate():
     rounds = bench_trajectory.load_rounds()
     latest, rec = rounds[-1]
     verdicts = bench_trajectory.gate_verdicts(rec)
-    # the full gate surface exists from round 10 on (slo gate included)
+    # the full gate surface exists from round 11 on (soak gate included)
     for gate in ("northstar_s", "vs_baseline", "tracing_overhead_pct",
                  "recorder_overhead_pct", "events_overhead_pct",
                  "checkpoint_overhead_pct", "precompute_overhead_pct",
                  "replan_overhead_pct", "slo_overhead_pct",
-                 "replan_settle_speedup"):
+                 "replan_settle_speedup", "soak_smoke"):
         assert gate in verdicts, f"round r{latest} lost the {gate} gate"
         value, ok = verdicts[gate]
         assert ok, (

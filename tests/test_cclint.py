@@ -662,6 +662,79 @@ PACKAGE_FIXTURES = {
             },
         ],
     },
+    "wall-clock-discipline": {
+        "positive": [
+            # sim/ modules run on the scenario clock — a bare wall read
+            # anywhere in them is drift
+            {
+                "pkg/sim/__init__.py": "",
+                "pkg/sim/driver.py": (
+                    "import time\n"
+                    "def tick(backend):\n"
+                    "    return time.time()\n"
+                ),
+            },
+            # clock-param scope, outside sim/: the injected now exists,
+            # reading the host clock next to it is the bug
+            {
+                "pkg/evalmod.py": (
+                    "import time\n"
+                    "def evaluate(journal, now_ms):\n"
+                    "    return now_ms - time.monotonic()\n"
+                ),
+            },
+            # argless datetime.now() counts too
+            {
+                "pkg/sim/__init__.py": "",
+                "pkg/sim/clockmod.py": (
+                    "import datetime\n"
+                    "def stamp(rec):\n"
+                    "    rec['at'] = datetime.datetime.now()\n"
+                    "    return rec\n"
+                ),
+            },
+        ],
+        "negative": [
+            # the same read in a plain module without a clock parameter
+            # is out of scope (production wall-clock code is everywhere)
+            {
+                "pkg/plain.py": (
+                    "import time\n"
+                    "def uptime(start):\n"
+                    "    return time.time() - start\n"
+                ),
+            },
+            # the documented fallback idiom: wall time only when no
+            # clock was injected
+            {
+                "pkg/evalmod.py": (
+                    "import time\n"
+                    "def evaluate(journal, now=None):\n"
+                    "    now = time.time() if now is None else now\n"
+                    "    return now\n"
+                ),
+            },
+            # simulator.py's real-server hold loops are allowlisted
+            {
+                "pkg/sim/__init__.py": "",
+                "pkg/sim/simulator.py": (
+                    "import time\n"
+                    "def _slow_client_probe(hold_s):\n"
+                    "    t0 = time.monotonic()\n"
+                    "    return time.monotonic() - t0 < hold_s\n"
+                ),
+            },
+            # references (injectable defaults) never call — out of scope
+            {
+                "pkg/sim/__init__.py": "",
+                "pkg/sim/engine.py": (
+                    "import time\n"
+                    "def make(clock=None):\n"
+                    "    return clock or time.time\n"
+                ),
+            },
+        ],
+    },
     "journal-schema": {
         "positive": [
             # unregistered kind + undeclared field + bad severity
@@ -1151,6 +1224,23 @@ MUTATIONS = {
         "cruise_control_tpu/telemetry/slo.py",
         '"slo.breach", severity="WARNING", slo=row.name,',
         '"slo.breach_unregistered", severity="WARNING", slo=row.name,',
+    ),
+    # ISSUE 12 satellite: dropping the SLO evaluator's is-None fallback
+    # guard (wall clock ALWAYS, injected now ignored) must be caught —
+    # the exact window-eviction drift class the soak surfaced
+    "wall-clock-slo-fallback": (
+        "wall-clock-discipline",
+        "cruise_control_tpu/telemetry/slo.py",
+        "now = time.time() if now is None else now",
+        "now = time.time()",
+    ),
+    # and a host-clock read planted in the scenario driver's tick loop
+    # (the virtual clock's own assignment site) must be caught
+    "wall-clock-sim-tick": (
+        "wall-clock-discipline",
+        "cruise_control_tpu/sim/simulator.py",
+        "sim.now_ms = now  # injected clocks (the breaker) read this",
+        "sim.now_ms = int(time.time() * 1000)",
     ),
 }
 

@@ -288,6 +288,35 @@ class TestLoadMonitor:
 
 
 class TestReviewRegressions:
+    def test_metrics_topic_retention_bounds_memory(self):
+        """ISSUE 12: the in-memory reporter topic has Kafka-style
+        retention — a 1000-broker day produces ~22M records, and the
+        unbounded log was a multi-GB leak.  Absolute offsets survive the
+        trim; a consumer that aged out resumes from the oldest retained
+        record."""
+        from cruise_control_tpu.monitor.sampling import CruiseControlMetric
+        from cruise_control_tpu.monitor.sampling import RawMetricType as RT
+
+        def rec(i):
+            return CruiseControlMetric(RT.BROKER_CPU_UTIL, i, 0, float(i))
+
+        topic = MetricsTopic(max_records=100)
+        topic.produce([rec(i) for i in range(40)])
+        got, off = topic.consume_from(0)
+        assert len(got) == 40 and off == 40
+        topic.produce([rec(i) for i in range(40, 250)])
+        # retention trimmed to the newest 100; absolute length keeps
+        # counting and the stored internal list is bounded
+        assert len(topic) == 250
+        assert len(topic._records) == 100
+        # the up-to-date consumer sees exactly the new tail
+        got, off2 = topic.consume_from(off)
+        assert off2 == 250
+        assert [r.time_ms for r in got] == list(range(150, 250))
+        # an aged-out consumer resumes from the oldest retained record
+        got, _ = topic.consume_from(10)
+        assert [r.time_ms for r in got] == list(range(150, 250))
+
     def test_sampler_retains_future_records(self):
         """Records at/after end_ms are held for the next poll, not dropped
         (code-review regression)."""

@@ -9,7 +9,7 @@ true story, which is what an operator reconstructing an incident has.
 
 Tier-1 runs the SMOKE subset plus the determinism and artifact contracts;
 the full ≥10-scenario matrix is ``slow`` (the committed
-``SCENARIOS_r11.json`` artifact keeps its outcomes honest in every run).
+``SCENARIOS_r12.json`` artifact keeps its outcomes honest in every run).
 The crash/resume scenarios (ISSUE 7) prove — from the journal alone —
 that a process crash mid-execution resumes without re-moving completed
 partitions.
@@ -39,7 +39,7 @@ from cruise_control_tpu.sim.timeline import (
 from test_artifact_schemas import SCHEMAS, validate
 
 MIN = MIN_MS
-ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r11.json"
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r12.json"
 
 #: the outcome each scripted timeline must reach — also pinned against the
 #: committed artifact below, so a regression shows up in tier-1 without

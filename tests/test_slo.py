@@ -98,6 +98,40 @@ def test_window_eviction_drops_old_records():
         2.0 / 6.0)
 
 
+def test_window_follows_an_injected_virtual_clock():
+    """ISSUE 12 satellite: a journal whose ``ts`` is the scenario's
+    VIRTUAL clock (the sim's EventJournal clock injection) windows
+    correctly against a virtual ``now`` — evaluating 'the last virtual
+    hour' of a simulated day must not consult the host clock (which
+    would evict everything: virtual ts are decades before wall time)."""
+    day = [_replan(hour * 3600.0, "cold" if hour < 12 else "warm")
+           for hour in range(24)]
+    # at virtual hour 23.5, a 2h window sees only the warm tail
+    rep = evaluate_slos(day, window_ms=2 * 3_600_000.0, now=23.5 * 3600.0)
+    assert rep.slo("replan.warm.duty.cycle").measured == pytest.approx(1.0)
+    assert rep.slo("journal.growth.per.min").measured == pytest.approx(
+        2.0 / 120.0)
+    # the same journal against the HOST clock would window to nothing —
+    # the drift this satellite fixed
+    rep = evaluate_slos(day, window_ms=2 * 3_600_000.0, now=time.time())
+    assert rep.slo("replan.warm.duty.cycle").state == "NO_DATA"
+    # the engine form: hysteresis driven on the virtual clock, the
+    # journal growing as virtual time advances (a real soak's shape)
+    view = day[:12]  # the cold morning so far
+    vnow = [11.5 * 3600.0]
+    eng = SloEngine(events_reader=lambda: view, window_ms=3_600_000.0,
+                    breach_cycles=1, recover_cycles=1, objectives={},
+                    clock=lambda: vnow[0])
+    rep = eng.evaluate()
+    assert rep.slo("replan.warm.duty.cycle").measured == pytest.approx(0.0)
+    assert rep.slo("replan.warm.duty.cycle").state == "BREACHED"
+    view = day  # the warm afternoon arrives; the window slides with it
+    vnow[0] = 23.5 * 3600.0
+    rep = eng.evaluate()
+    assert rep.slo("replan.warm.duty.cycle").measured == pytest.approx(1.0)
+    assert rep.slo("replan.warm.duty.cycle").state == "OK"
+
+
 def test_registry_snapshot_feeds_serve_and_5xx_slos():
     reg = MetricRegistry()
     for ms in (5, 7, 9, 120):
@@ -337,6 +371,21 @@ def _post(url, headers=None):
         return resp.status, dict(resp.headers), json.loads(resp.read())
 
 
+def _wait_indexed(server, trace_id, timeout_s=5.0):
+    """Bounded poll for a trace id to land in the store: completed roots
+    flow tracing.root_sink → TraceStore in the handler's ``finally``,
+    AFTER the response bytes flush — an immediate follow-up GET can race
+    it on a contended box (same class as test_observability's documented
+    bucket race)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        _, _, body = _get(f"{server.url}/trace")
+        if any(t["traceId"] == trace_id for t in body["traces"]):
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"trace {trace_id!r} never reached the store")
+
+
 @pytest.fixture
 def traced_server(monkeypatch):
     from cruise_control_tpu.replan import DeltaReplanner
@@ -383,7 +432,22 @@ def test_rebalance_reconstructs_from_trace_id_alone(traced_server):
     assert status == 200
     assert body["cached"] is True
 
-    status, _, art = _get(f"{server.url}/trace?id={tid}", headers)
+    # root spans land post-flush (see _wait_indexed); both requests'
+    # roots must be in the store before reconstruction is complete
+    deadline = time.monotonic() + 5.0
+    while True:
+        try:
+            status, _, art = _get(f"{server.url}/trace?id={tid}", headers)
+        except urllib.error.HTTPError:
+            status, art = 404, {}
+        have = {e.get("name") for e in art.get("traceEvents", ())}
+        if {"http.GET.proposals", "http.POST.rebalance"} <= have:
+            break
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"trace {tid!r} incomplete after 5s: {sorted(have)[:8]}"
+            )
+        time.sleep(0.05)
     assert status == 200
     art = json.loads(json.dumps(art))
     validate(art, SCHEMAS["cc-tpu-trace/1"])
@@ -420,6 +484,7 @@ def test_rebalance_reconstructs_from_trace_id_alone(traced_server):
 def test_trace_index_and_slo_endpoint(traced_server):
     server, journal, store = traced_server
     _get(f"{server.url}/proposals", {"X-Trace-Id": "idx-1"})
+    _wait_indexed(server, "idx-1")  # root spans land post-flush
     status, _, body = _get(f"{server.url}/trace")
     assert status == 200
     assert any(t["traceId"] == "idx-1" for t in body["traces"])
