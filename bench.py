@@ -45,6 +45,12 @@ trace.py + device_cost.py): SLO evaluation at a 250ms stress interval
 per-optimize trace scope), and device-cost capture enabled vs all
 three off — must cost <=1% of the engine metric (tracing + journal
 stay on on both sides; their costs are gated separately above).
+``validation_overhead_pct`` gates the metrics-quarantine stage
+(monitor/sampling.py SampleValidator): one full ingest pass of the
+50b/1k reporter output (1000 partition + 50 broker samples) with the
+validator on vs off, interleaved best-of, expressed against the
+north-star metric — the data-integrity front door must cost <=1% of a
+served rebalance.
 """
 
 from __future__ import annotations
@@ -64,9 +70,11 @@ def _best_of(n: int, fn) -> float:
     return best
 
 
-def _full_stack_cc(engine: str = "tpu"):
+def _full_stack_cc(engine: str = "tpu", return_parts: bool = False):
     """The simulated 50b/1k full stack (monitor → facade → executor) the
-    full-path phase breakdown AND the precompute-overhead gate run on."""
+    full-path phase breakdown, the precompute-overhead gate, and the
+    validation-overhead gate run on.  ``return_parts`` also returns the
+    reporter (the validation gate re-drives ingest)."""
     from cruise_control_tpu.bootstrap import _capacity_for
     from cruise_control_tpu.executor.backend import SimulatedClusterBackend
     from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
@@ -109,9 +117,12 @@ def _full_stack_cc(engine: str = "tpu"):
     for wdx in range(3):
         reporter.report(time_ms=wdx * 1000 + 500)
         monitor.run_sampling_iteration((wdx + 1) * 1000)
-    return CruiseControl(
+    cc = CruiseControl(
         monitor, Executor(backend, ExecutorConfig()), engine=engine
     )
+    if return_parts:
+        return cc, reporter
+    return cc
 
 
 def _full_path_phases() -> dict:
@@ -372,6 +383,36 @@ def main() -> None:
     events.reset()
     slo_overhead_pct = (slo_on_s / slo_off_s - 1.0) * 100.0
 
+    # sample-validation overhead (ISSUE 13): the metrics-quarantine stage
+    # on the FULL ingest path — reporter output for the 50b/1k fixture
+    # (1000 partition + 50 broker samples per interval) driven through
+    # run_sampling_iteration with the validator on vs off, interleaved
+    # best-of.  The delta is expressed against the north-star metric
+    # (validation rides every sampling interval of a served deployment);
+    # clean-path work is one vectorized finiteness/sign/membership pass.
+    val_cc, val_reporter = _full_stack_cc(engine="greedy",
+                                          return_parts=True)
+    val_monitor = val_cc.load_monitor
+    val_validator = val_monitor.sample_validator
+    val_t = [3000]
+
+    def _ingest_pass():
+        val_reporter.report(time_ms=val_t[0] + 500)
+        val_monitor.run_sampling_iteration(val_t[0] + 1000)
+        val_t[0] += 1000
+
+    val_off_s = val_on_s = np.inf
+    for _ in range(9):
+        val_validator.config.enabled = False
+        t0 = time.perf_counter()
+        _ingest_pass()
+        val_off_s = min(val_off_s, time.perf_counter() - t0)
+        val_validator.config.enabled = True
+        t0 = time.perf_counter()
+        _ingest_pass()
+        val_on_s = min(val_on_s, time.perf_counter() - t0)
+    validation_overhead_pct = (val_on_s - val_off_s) / tpu_s * 100.0
+
     # delta-replan gates (ISSUE 9): the steady-state settled replan must
     # re-validate a fresh plan >=10x faster than a cold recompute, and
     # the dirty tracking must cost <=1% on the forced-cold path.  The
@@ -433,6 +474,12 @@ def main() -> None:
                 "precompute_overhead_pct": round(
                     precompute_overhead_pct, 2),
                 "precompute_daemon_state": precompute.state_summary(),
+                # metrics-quarantine validation on the ingest path (≤1%)
+                "validation_overhead_pct": round(
+                    validation_overhead_pct, 2),
+                "validation_ingest_s": {
+                    "off": round(val_off_s, 5), "on": round(val_on_s, 5),
+                },
                 # delta-replan gates (full matrix: REPLAN_r09.json)
                 "replan_after_drift": {
                     "settle_speedup": replan_fixture["settle_speedup"],

@@ -37,6 +37,7 @@ GATES: Dict[str, Tuple[str, float]] = {
     "precompute_overhead_pct": ("absmax", 1.0),
     "replan_overhead_pct": ("max", 1.0),
     "slo_overhead_pct": ("max", 1.0),
+    "validation_overhead_pct": ("max", 1.0),
 }
 
 #: the north-star wall-clock ceiling (round-6 acceptance, held since)
@@ -127,6 +128,7 @@ def render(rounds: List[Tuple[int, dict]]) -> str:
         ("precompute_overhead_pct", "precompute % (±1)"),
         ("replan_overhead_pct", "replan % (≤1)"),
         ("slo_overhead_pct", "slo % (≤1)"),
+        ("validation_overhead_pct", "validation % (≤1)"),
         ("replan_settle_speedup", f"settle × (≥{REPLAN_SETTLE_MIN:g})"),
         ("soak_smoke", "soak smoke s (green, ≤budget)"),
     ]

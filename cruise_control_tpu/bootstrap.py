@@ -478,9 +478,31 @@ def build_app(
         # default persistence on Kafka: the retention-bounded store topics
         sample_store = kafka_store
     window_ms = cfg.get("partition.metrics.window.ms")
+    from cruise_control_tpu.monitor.sampling import (
+        SampleValidationConfig,
+        SampleValidator,
+    )
+
+    sample_validator = SampleValidator(SampleValidationConfig(
+        enabled=cfg.get_boolean("monitor.sample.validation.enabled"),
+        spike_factor=cfg.get_double(
+            "monitor.sample.validation.spike.factor"
+        ),
+        max_age_ms=cfg.get_int("monitor.sample.validation.max.age.ms"),
+        storm_ratio=cfg.get_double(
+            "monitor.sample.validation.storm.ratio"
+        ),
+        storm_min_samples=cfg.get_int(
+            "monitor.sample.validation.storm.min.samples"
+        ),
+        storm_window_batches=cfg.get_int(
+            "monitor.sample.validation.storm.window.batches"
+        ),
+    ))
     monitor = LoadMonitor(
         metadata,
         kafka_sampler if kafka_mode else _make_sampler(cfg, topic),
+        sample_validator=sample_validator,
         capacity_resolver=capacity_resolver,
         sample_store=sample_store,
         window_ms=window_ms,
@@ -606,6 +628,19 @@ def build_app(
                 table_carry=cfg.get_boolean("replan.table.carry.enabled"),
             ),
         )
+    engine_degradation = None
+    if use_tpu:
+        # the TPU→greedy engine ladder (ISSUE 13): a cold TPU failure
+        # degrades to greedy with a breaker-style cooldown instead of
+        # failing the operation
+        from cruise_control_tpu.analyzer.degradation import (
+            EngineDegradation,
+        )
+
+        engine_degradation = EngineDegradation(
+            cooldown_s=cfg.get("analyzer.engine.degraded.cooldown.ms")
+            / 1000,
+        )
     cc = CruiseControl(
         monitor,
         executor,
@@ -626,6 +661,7 @@ def build_app(
         breaker=breaker,
         replanner=replanner,
         replan_heals=cfg.get_boolean("replan.heal.enabled"),
+        engine_degradation=engine_degradation,
     )
     if kafka_mode and cfg.get_int("num.metric.fetchers") > 1:
         # each per-fetcher consumer reads the WHOLE reporter topic (the

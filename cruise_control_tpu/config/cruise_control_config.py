@@ -354,6 +354,41 @@ def default_config_def() -> ConfigDef:
     d.define("metric.sampler.class", ConfigType.CLASS,
              "cruise_control_tpu.monitor.sampling.MetricsReporterSampler",
              Importance.HIGH, "MetricSampler implementation.", None, G)
+    # the data-integrity validation stage (ISSUE 13): upstream
+    # CruiseControlMetricsProcessor sanity checks, made explicit
+    d.define("monitor.sample.validation.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM,
+             "Validate every ingested metric sample before aggregation: "
+             "non-finite / negative values and samples for entities "
+             "absent from current metadata are QUARANTINED (journaled as "
+             "monitor.sample_quarantined, counted per reason) instead of "
+             "silently poisoning window means and model loads.", None, G)
+    d.define("monitor.sample.validation.spike.factor", ConfigType.DOUBLE,
+             0.0, Importance.LOW,
+             "Absurd-spike rate limit on broker samples: a metric more "
+             "than this many times the broker's last accepted value is "
+             "quarantined (reason 'spike').  0 disables; values <= 1 are "
+             "meaningless and treated as disabled.", at_least(0), G)
+    d.define("monitor.sample.validation.max.age.ms", ConfigType.LONG, 0,
+             Importance.LOW,
+             "Quarantine samples timestamped more than this many ms "
+             "before the sampling poll (a wedged reporter replaying "
+             "ancient data; reason 'stale').  0 disables.", at_least(0), G)
+    d.define("monitor.sample.validation.storm.ratio", ConfigType.DOUBLE,
+             0.5, Importance.LOW,
+             "Quarantine-storm threshold: a broker whose rolling "
+             "quarantined-sample ratio reaches this is surfaced as an "
+             "alert-only metric anomaly (sample.quarantine.ratio) — "
+             "persistently bad data is itself an anomaly.",
+             between(0, 1), G)
+    d.define("monitor.sample.validation.storm.min.samples", ConfigType.INT,
+             4, Importance.LOW,
+             "Broker samples the storm window must hold before a "
+             "quarantine-storm finding can fire.", at_least(1), G)
+    d.define("monitor.sample.validation.storm.window.batches",
+             ConfigType.INT, 8, Importance.LOW,
+             "Ingest batches in the rolling quarantine-storm window.",
+             at_least(1), G)
 
     G = "analyzer"
     d.define("goals", ConfigType.LIST,
@@ -493,6 +528,14 @@ def default_config_def() -> ConfigDef:
     d.define("use.tpu.optimizer", ConfigType.BOOLEAN, True,
              Importance.HIGH, "Route optimizations through the TPU engine "
              "(framework-specific; no upstream equivalent).", None, G)
+    d.define("analyzer.engine.degraded.cooldown.ms", ConfigType.LONG,
+             300_000, Importance.MEDIUM,
+             "Engine degradation ladder: after a cold TPU-engine failure "
+             "(XLA OOM, compile error, plan-sanity rejection) the failed "
+             "operation and everything for this long afterwards serve on "
+             "the greedy engine (analyzer.engine_degraded journaled); "
+             "the first TPU attempt past the cooldown is the recovery "
+             "probe.", at_least(1), G)
 
     G = "executor"
     d.define("num.concurrent.partition.movements.per.broker", ConfigType.INT, 5,

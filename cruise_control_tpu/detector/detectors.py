@@ -206,21 +206,34 @@ class PercentileMetricAnomalyFinder:
 
 class MetricAnomalyDetector:
     """Feeds the broker aggregator's windowed history through a finder SPI
-    (upstream ``MetricAnomalyDetector``)."""
+    (upstream ``MetricAnomalyDetector``).  Also surfaces the monitor's
+    quarantine-storm findings (ISSUE 13): a broker whose samples are
+    *persistently* rejected by the validation stage is itself anomalous —
+    the data went dark even though the broker keeps reporting — reported
+    alert-only as ``sample.quarantine.ratio`` (no safe automatic fix)."""
 
     def __init__(self, cruise_control, finder: Optional[PercentileMetricAnomalyFinder] = None):
         self.cc = cruise_control
         self.finder = finder or PercentileMetricAnomalyFinder()
 
     def detect(self, now_ms: int) -> List[Anomaly]:
+        out: List[Anomaly] = []
         agg = self.cc.load_monitor.broker_aggregator.aggregate()
-        if agg.values.size == 0:
-            return []
-        names = [
-            m.name for m in
-            self.cc.load_monitor.broker_aggregator.metric_def.all_metrics()
-        ]
-        return list(self.finder.find(now_ms, agg.values, names))
+        if agg.values.size:
+            names = [
+                m.name for m in
+                self.cc.load_monitor.broker_aggregator
+                    .metric_def.all_metrics()
+            ]
+            out.extend(self.finder.find(now_ms, agg.values, names))
+        validator = getattr(self.cc.load_monitor, "sample_validator", None)
+        if validator is not None:
+            for broker, ratio, threshold in validator.storm_findings():
+                out.append(MetricAnomaly(
+                    now_ms, int(broker), "sample.quarantine.ratio",
+                    float(ratio), float(threshold),
+                ))
+        return out
 
 
 class TopicReplicationFactorAnomalyFinder:

@@ -45,6 +45,19 @@ checkpoint.  ``load()`` skips undecodable lines (a torn final line from
 a real crash) and returns the checkpoint only when the last execution
 never wrote its ``end`` record.
 
+Integrity (ISSUE 13): every record is framed with a per-record CRC32
+member (:mod:`cruise_control_tpu.utils.checksum`; format-versioned —
+pre-CRC logs still load).  ``load()`` distinguishes a **torn tail** (the
+final line undecodable or CRC-mismatched — expected from a real crash
+mid-write, dropped with a warning exactly as before) from **mid-file
+corruption** (any earlier bad line — bit rot, a truncated-then-appended
+file, operator damage): the latter fails loudly — ``LOG.error`` plus an
+``executor.checkpoint_corrupt`` journal event — and the checkpoint is
+treated as absent after the last good record before the corruption (the
+suffix's ordering can no longer be trusted; reconciliation re-derives
+the rest from live cluster state, which the group-commit durability
+model already guarantees is safe).
+
 Crash injection: :meth:`crash_after` arms a simulated process death used
 by the chaos simulator and the crash-consistency tests —
 :class:`ProcessCrash` deliberately subclasses ``BaseException`` so the
@@ -64,6 +77,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
+from cruise_control_tpu.utils.checksum import scan_lines, stamp_line
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("executor.journal")
@@ -226,10 +240,12 @@ class ExecutionJournal:
             self._track(kind, payload)
             # compact separators: the start record positionally encodes
             # the WHOLE plan, so whitespace is ~10% of the checkpoint's
-            # bytes and encode time on the write-ahead hot path
-            self._pending.append(
+            # bytes and encode time on the write-ahead hot path.  The
+            # CRC frame makes a bit-flipped-but-still-JSON record
+            # detectable at load time.
+            self._pending.append(stamp_line(
                 json.dumps(rec, default=str, separators=(",", ":"))
-            )
+            ))
             try:
                 if kind in _FLUSH_KINDS or len(self._pending) >= _MAX_BUFFERED:
                     self._flush_locked()
@@ -310,7 +326,9 @@ class ExecutionJournal:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             for rec in records:
-                f.write(json.dumps(rec, default=str) + "\n")
+                f.write(stamp_line(
+                    json.dumps(rec, default=str, separators=(",", ":"))
+                ) + "\n")
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
@@ -356,22 +374,47 @@ class ExecutionJournal:
     # ---- recovery ---------------------------------------------------------------
     def load(self) -> Optional[ExecutionCheckpoint]:
         """The in-flight execution this checkpoint describes, or None
-        (no file, empty file, or the last execution wrote its ``end``)."""
+        (no file, empty file, or the last execution wrote its ``end``).
+
+        Bad-line policy: silent skip is reserved for the FILE TAIL —
+        exactly one undecodable/CRC-mismatched final line, the signature
+        of a real crash mid-write (appends flush in order, so everything
+        before it is intact).  Any earlier bad line is mid-file
+        corruption: it is journaled loudly (``executor.checkpoint_corrupt``)
+        and every record from the corruption onward is discarded — the
+        checkpoint is absent after the last good record, and
+        reconciliation re-derives the rest from live cluster state."""
         try:
-            with open(self.path) as f:
+            # binary read: bit rot may leave bytes that are not UTF-8 —
+            # such a line must classify as torn/corrupt, not crash load()
+            with open(self.path, "rb") as f:
                 lines = f.read().splitlines()
         except OSError:
             return None
-        records: List[dict] = []
-        for line in lines:
-            if not line.strip():
-                continue
-            try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError:
-                # a torn line from a real crash mid-write: everything
-                # before it is intact (appends are flushed in order)
-                LOG.warning("checkpoint %s: skipping torn record", self.path)
+        records, bad, n_lines = scan_lines(lines)
+        if bad:
+            if bad == [n_lines - 1]:
+                # the torn final line of a real crash: tolerated, as ever
+                LOG.warning("checkpoint %s: dropping torn final record",
+                            self.path)
+            else:
+                from cruise_control_tpu.telemetry import events
+
+                first_bad = bad[0]
+                dropped = n_lines - first_bad
+                LOG.error(
+                    "checkpoint %s: mid-file corruption at record %d — "
+                    "discarding it and the %d record(s) after it; "
+                    "recovery will reconcile from live cluster state",
+                    self.path, first_bad, dropped,
+                )
+                events.emit(
+                    "executor.checkpoint_corrupt", severity="ERROR",
+                    line=first_bad, dropped=dropped,
+                )
+                # every good record before the corruption is trusted;
+                # the suffix is not (its ordering can't be proven)
+                records = records[:first_bad]
         start_idx = None
         for i, rec in enumerate(records):
             if rec.get("kind") == "start":

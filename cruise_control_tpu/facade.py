@@ -22,6 +22,7 @@ import time
 from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 if TYPE_CHECKING:  # wiring-time imports only (bootstrap builds both)
+    from cruise_control_tpu.analyzer.degradation import EngineDegradation
     from cruise_control_tpu.analyzer.precompute import CircuitBreaker
     from cruise_control_tpu.replan.planner import DeltaReplanner
 
@@ -29,6 +30,10 @@ import numpy as np
 
 from cruise_control_tpu.common.resources import BrokerState
 from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.degradation import (
+    PlanSanityError,
+    plan_sanity_reason,
+)
 from cruise_control_tpu.analyzer.precompute import (
     AnalyzerSaturatedError,
     CachedPlan,
@@ -96,6 +101,7 @@ class CruiseControl:
         breaker: Optional["CircuitBreaker"] = None,
         replanner: Optional["DeltaReplanner"] = None,
         replan_heals: bool = False,
+        engine_degradation: Optional["EngineDegradation"] = None,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -154,6 +160,10 @@ class CruiseControl:
         #: steady-state control loop ROADMAP item 4 closes.  Off keeps the
         #: historical cold heal path.
         self.replan_heals = bool(replan_heals)
+        #: engine degradation ladder (analyzer/degradation.py); None =
+        #: cold TPU failures surface to the caller as before.  Bootstrap
+        #: wires it whenever the TPU engine is the default.
+        self.engine_degradation = engine_degradation
         self._start_time = time.time()
         # cached proposals (upstream GoalOptimizer proposal precompute, §3.5)
         self._proposal_ttl_s = proposal_ttl_s
@@ -394,6 +404,16 @@ class CruiseControl:
             except ValueError:
                 continue
             options.excluded_brokers_for_replica_move.add(internal)
+        # engine degradation ladder (analyzer/degradation.py): a recent
+        # cold TPU failure routes would-be TPU operations straight to the
+        # greedy engine until the cooldown expires; the first TPU attempt
+        # past it is the recovery probe
+        tpu_requested = goals is None and \
+            (engine or self.default_engine) == "tpu"
+        degradation = self.engine_degradation
+        degraded_pick = bool(
+            tpu_requested and degradation is not None and degradation.active()
+        )
         if goals is not None:
             # A goal subset pins the operation's semantics (e.g. demote =
             # PreferredLeaderElectionGoal only).  The TPU search optimizes the
@@ -402,6 +422,8 @@ class CruiseControl:
                 goals=make_goals(goals, constraint),
                 constraint=constraint,
             )
+        elif degraded_pick:
+            opt = self._make_engine("greedy", constraint)
         else:
             opt = self._make_engine(engine, constraint)
         # a dead request must not reach the analyzer at all, and repeated
@@ -430,18 +452,63 @@ class CruiseControl:
             brokers=state.num_brokers, partitions=state.num_partitions,
             **start_extra,
         )
+        def _optimize_with(o):
+            """One engine attempt, gated: a result with non-finite scores
+            or a score worse than the pre-plan state never leaves the
+            facade (the plan sanity gate — last line of defense when
+            garbage slipped past the monitor's quarantine)."""
+            if warm_start is not None or carry is not None:
+                r = o.optimize(
+                    state, options, warm_start=warm_start, carry=carry,
+                )
+            else:
+                r = o.optimize(state, options)
+            reason = plan_sanity_reason(
+                r, hard_goals=self.hard_goal_names
+            )
+            if reason is not None:
+                LOG.error("%s: %s plan rejected (%s)", operation,
+                          o.__class__.__name__, reason)
+                events.emit(
+                    "analyzer.plan_rejected", severity="ERROR",
+                    engine=o.__class__.__name__, reason=reason,
+                    scoreBefore=r.violation_score_before,
+                    scoreAfter=r.violation_score_after,
+                )
+                raise PlanSanityError(o.__class__.__name__, reason)
+            return r
+
+        fell_back = False
         with progress.step(f"Optimizing ({opt.__class__.__name__})"):
             # upstream GoalOptimizer's "proposal-computation-timer"
             with self.registry.timer("proposal-computation-timer"), \
                     tracing.span("facade.optimize"):
                 try:
-                    if warm_start is not None or carry is not None:
-                        result = opt.optimize(
-                            state, options, warm_start=warm_start,
-                            carry=carry,
+                    try:
+                        result = _optimize_with(opt)
+                    except Exception as e:
+                        if degraded_pick or not tpu_requested \
+                                or degradation is None:
+                            raise
+                        # a COLD TPU failure (XLA OOM, compile error, a
+                        # sanity-gate rejection): fall one rung down the
+                        # ladder — serve this operation on the greedy
+                        # engine and hold further TPU attempts for a
+                        # breaker-style cooldown
+                        fell_back = True
+                        LOG.exception(
+                            "%s: tpu engine failed; degrading to greedy",
+                            operation,
                         )
-                    else:
-                        result = opt.optimize(state, options)
+                        degradation.record_failure(repr(e))
+                        events.emit(
+                            "analyzer.engine_degraded", severity="WARNING",
+                            engine="tpu", fallback="greedy", error=repr(e),
+                            cooldownS=degradation.cooldown_s,
+                        )
+                        result = _optimize_with(
+                            self._make_engine("greedy", constraint)
+                        )
                 except Exception as e:
                     LOG.exception("%s optimization failed", operation)
                     if self.breaker is not None:
@@ -459,6 +526,13 @@ class CruiseControl:
                 else:
                     if self.breaker is not None:
                         self.breaker.record_success()
+                    if (tpu_requested and not degraded_pick
+                            and not fell_back and degradation is not None
+                            and degradation.record_success()):
+                        # the post-cooldown probe succeeded: the ladder
+                        # closes and TPU serving resumes
+                        events.emit("analyzer.engine_recovered",
+                                    engine="tpu")
         LOG.info(
             "%s optimized: %d actions, %d proposals, %.2fs",
             operation, len(result.actions), len(result.proposals),
@@ -1368,6 +1442,11 @@ class CruiseControl:
                 **(
                     {"circuitBreaker": self.breaker.state_summary()}
                     if self.breaker is not None else {}
+                ),
+                **(
+                    {"engineDegradation":
+                     self.engine_degradation.state_summary()}
+                    if self.engine_degradation is not None else {}
                 ),
             },
         }

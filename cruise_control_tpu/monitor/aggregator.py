@@ -156,14 +156,21 @@ class MetricSampleAggregator:
     def add_sample(
         self, entity: int, timestamp_ms: int, values: Sequence[float]
     ) -> bool:
-        """Record one sample; returns False if it fell outside retention."""
+        """Record one sample; returns False if it fell outside retention
+        or carried a non-finite value (defense in depth behind the
+        monitor's quarantine stage — one NaN in ``_sum`` poisons every
+        mean/extrapolation computed from that window forever, so the
+        raw-state tensors refuse it even when a caller skips
+        validation)."""
         abs_window = int(timestamp_ms) // self.window_ms
+        v = np.asarray(values, np.float64)
+        if not np.isfinite(v).all():
+            return False
         slot = self._slot_for(abs_window)
         if slot is None:
             return False
         if self._first_window < 0 or abs_window < self._first_window:
             self._first_window = abs_window
-        v = np.asarray(values, np.float64)
         self._sum[slot, entity] += v
         self._max[slot, entity] = np.maximum(self._max[slot, entity], v)
         if timestamp_ms >= self._latest_ts[slot, entity]:

@@ -43,8 +43,10 @@ from cruise_control_tpu.monitor.sampling import (
     P_NW_IN,
     P_NW_OUT,
     MetricSampler,
+    SampleValidator,
 )
 from cruise_control_tpu.monitor.sample_store import NoopSampleStore, SampleStore
+from cruise_control_tpu.telemetry import events
 from cruise_control_tpu.utils.logging import get_logger
 
 _LOG = get_logger("monitor")
@@ -207,9 +209,18 @@ class LoadMonitor:
         max_allowed_extrapolations: int = 5,
         capacity_estimation_percentile: float = 0.0,
         skip_loading_samples: bool = False,
+        sample_validator: Optional[SampleValidator] = None,
     ):
         self.metadata = metadata
         self.sampler = sampler
+        #: the data-integrity front door (ISSUE 13): every ingested batch
+        #: passes validation before it can touch the aggregate tensors.
+        #: Default-on with the conservative config (finiteness / sign /
+        #: metadata-membership checks only); None disables the stage.
+        self.sample_validator = (
+            sample_validator if sample_validator is not None
+            else SampleValidator()
+        )
         self.capacity_resolver = capacity_resolver or StaticCapacityResolver(
             {Resource.CPU: 100.0, Resource.NW_IN: 1e5, Resource.NW_OUT: 1e5,
              Resource.DISK: 1e6}
@@ -277,9 +288,42 @@ class LoadMonitor:
 
     def ingest_samples(self, psamples, bsamples, now_ms: int) -> int:
         """Aggregate + persist one batch of samples (shared by the single-
-        sampler iteration below and the MetricFetcherManager fetcher pool)."""
+        sampler iteration below and the MetricFetcherManager fetcher pool).
+
+        The validation stage runs first: non-finite / negative /
+        metadata-unknown (and, when configured, stale / spiking) samples
+        are quarantined — journaled as ``monitor.sample_quarantined``,
+        counted per reason, and NEVER aggregated or persisted (a
+        quarantined sample must not come back via sample-store replay).
+        Clean batches pass through bit-identically.  Quarantine also
+        stops phantom entity growth: a stale reporter still emitting for
+        a removed broker no longer widens the aggregate tensors."""
         if self.state == LoadMonitorState.PAUSED:
             return 0
+        validator = self.sample_validator
+        if validator is not None and validator.config.enabled \
+                and (psamples or bsamples):
+            topo = self.metadata.refresh()
+            psamples, bsamples, report = validator.validate(
+                psamples, bsamples,
+                known_brokers=set(topo.broker_ids()),
+                known_partitions=set(topo.assignment),
+                now_ms=now_ms,
+            )
+            if report is not None:
+                _LOG.warning(
+                    "quarantined %d/%d samples: %s",
+                    report.quarantined,
+                    report.quarantined + report.accepted, report.reasons,
+                )
+                events.emit(
+                    "monitor.sample_quarantined", severity="WARNING",
+                    accepted=report.accepted,
+                    quarantined=report.quarantined,
+                    reasons=report.reasons,
+                    brokers=report.brokers,
+                    partitions=report.partitions,
+                )
         prev_state, self.state = self.state, LoadMonitorState.SAMPLING
         try:
             if psamples:
@@ -690,7 +734,7 @@ class LoadMonitor:
     def state_summary(self) -> dict:
         agg = self.partition_aggregator.aggregate()
         c = agg.completeness
-        return {
+        out = {
             "state": self.state.value,
             "numValidWindows": c.num_valid_windows,
             "numWindows": c.num_windows,
@@ -698,6 +742,9 @@ class LoadMonitor:
             "lastSampleMs": self._last_sample_ms,
             "aggregatorGeneration": self.partition_aggregator.generation,
         }
+        if self.sample_validator is not None:
+            out["sampleValidation"] = self.sample_validator.state_summary()
+        return out
 
 
 class ModelGenerationLock:

@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+import threading
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -334,6 +336,264 @@ def estimate_partition_cpu(
     if broker_bytes_out > 0:
         share += params.cpu_weight_bytes_out * (bytes_out / broker_bytes_out)
     return broker_cpu * share
+
+
+# ---------------------------------------------------------------------------------
+# Sample validation / quarantine (ISSUE 13: the data-integrity front door)
+# ---------------------------------------------------------------------------------
+
+#: the closed reject-reason vocabulary (journal payloads, metric labels)
+VALIDATION_REASONS = (
+    "non-finite", "negative", "unknown-broker", "unknown-partition",
+    "stale", "spike",
+)
+
+#: static meter names per reason (obs-dynamic-name: no runtime formatting)
+_REASON_METERS = {
+    r: "monitor.sample.quarantined." + r for r in VALIDATION_REASONS
+}
+
+
+@dataclasses.dataclass
+class SampleValidationConfig:
+    """The ``monitor.sample.validation.*`` key surface (upstream
+    ``CruiseControlMetricsProcessor`` sanity checks, SURVEY §2.2)."""
+
+    enabled: bool = True
+    #: >1 arms the absurd-spike rate limit on BROKER samples: a metric
+    #: more than ``spike_factor``× the broker's last accepted value is
+    #: quarantined (partition samples are not spike-checked — per-entity
+    #: last-value state at the 1M-partition scale is not worth one bad
+    #: sample's damage, which the finiteness checks already bound)
+    spike_factor: float = 0.0
+    #: >0 quarantines samples timestamped more than this many ms before
+    #: the poll's ``now_ms`` (a wedged reporter replaying ancient data)
+    max_age_ms: int = 0
+    # quarantine-storm detection: a broker whose samples are
+    # PERSISTENTLY bad is itself an anomaly (surfaced through the
+    # metric-anomaly detector as an alert-only finding)
+    storm_ratio: float = 0.5
+    storm_min_samples: int = 4
+    storm_window_batches: int = 8
+
+
+@dataclasses.dataclass
+class ValidationBatchReport:
+    """What one ingest batch quarantined (the journal-event payload)."""
+
+    accepted: int = 0
+    quarantined: int = 0
+    reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
+    brokers: List[int] = dataclasses.field(default_factory=list)
+    partitions: List[int] = dataclasses.field(default_factory=list)
+
+
+class SampleValidator:
+    """Validation stage between the sampler and the aggregator.
+
+    Clean samples pass through **bit-identically** (the exact input list
+    objects, untouched) — the stage must not perturb a single pinned
+    scenario or soak fingerprint.  Rejects are routed to a per-broker
+    quarantine ledger that feeds ``monitor.sample_quarantined`` journal
+    events (emitted by the LoadMonitor), ``cc_monitor_quarantined_total
+    {reason=}`` metric rows, the ``monitor.sample.quarantine.ratio`` SLO
+    (via the ``monitor.sample.accepted``/``.quarantined`` meters), and
+    the quarantine-storm findings the metric-anomaly detector surfaces.
+
+    Thread-safe: the ledger lock covers every mutable attribute (ingest
+    runs on the fetcher thread, storm findings are read on the detector
+    scheduler thread).
+    """
+
+    def __init__(self, config: Optional[SampleValidationConfig] = None,
+                 registry=None):
+        self.config = config or SampleValidationConfig()
+        #: metric registry for the accepted/quarantined meters; None
+        #: defers to the process default at first use
+        if registry is None:
+            from cruise_control_tpu.utils.metrics import DEFAULT_REGISTRY
+
+            registry = DEFAULT_REGISTRY
+        self.registry = registry
+        self._lock = threading.Lock()
+        #: lifetime reason → count (the labeled-metric rows)
+        self._reason_totals: Dict[str, int] = {}
+        self.total_accepted = 0
+        self.total_quarantined = 0
+        #: broker → last ACCEPTED value vector (spike baseline)
+        self._last_broker_values: Dict[int, np.ndarray] = {}
+        #: broker → deque[(accepted, quarantined)] per batch — tracked
+        #: only once a broker misbehaves, so a clean fleet costs nothing
+        self._storm: Dict[int, deque] = {}
+
+    # ---- the validation pass ----------------------------------------------------
+    def validate(
+        self,
+        psamples: List["PartitionMetricSample"],
+        bsamples: List["BrokerMetricSample"],
+        known_brokers: Set[int],
+        known_partitions: Set[int],
+        now_ms: int,
+    ) -> Tuple[List["PartitionMetricSample"], List["BrokerMetricSample"],
+               Optional[ValidationBatchReport]]:
+        """``(clean_p, clean_b, report)``; report is None when nothing
+        was quarantined (the bit-identical clean path)."""
+        cfg = self.config
+        if not cfg.enabled:
+            return psamples, bsamples, None
+        report = ValidationBatchReport()
+        bad_p: Dict[int, str] = {}   # sample index → reason
+        bad_b: Dict[int, str] = {}
+        #: per-broker (accepted, quarantined) for storm accounting —
+        #: broker-attributed samples only (partition samples carry no
+        #: broker id once processed)
+        ok_by_broker: Dict[int, int] = {}
+        bad_by_broker: Dict[int, int] = {}
+
+        if psamples:
+            vals = np.asarray([s.values for s in psamples], np.float64)
+            ids = np.fromiter((s.partition for s in psamples), np.int64,
+                              len(psamples))
+            finite = np.isfinite(vals).all(axis=1)
+            neg = ~(vals >= 0).all(axis=1) & finite
+            known = np.isin(
+                ids, np.fromiter(known_partitions, np.int64,
+                                 len(known_partitions))
+            ) if known_partitions else np.zeros(len(psamples), bool)
+            bad_mask = ~finite | neg | ~known
+            if cfg.max_age_ms > 0:
+                ts = np.fromiter((s.time_ms for s in psamples), np.int64,
+                                 len(psamples))
+                stale = (now_ms - ts) > cfg.max_age_ms
+                bad_mask |= stale
+            else:
+                stale = None
+            for i in np.nonzero(bad_mask)[0]:
+                i = int(i)
+                if not finite[i]:
+                    bad_p[i] = "non-finite"
+                elif neg[i]:
+                    bad_p[i] = "negative"
+                elif not known[i]:
+                    bad_p[i] = "unknown-partition"
+                else:
+                    bad_p[i] = "stale"
+
+        for i, s in enumerate(bsamples):
+            v = np.asarray(s.values, np.float64)
+            if not np.isfinite(v).all():
+                bad_b[i] = "non-finite"
+            elif (v < 0).any():
+                bad_b[i] = "negative"
+            elif s.broker_id not in known_brokers:
+                bad_b[i] = "unknown-broker"
+            elif cfg.max_age_ms > 0 and now_ms - s.time_ms > cfg.max_age_ms:
+                bad_b[i] = "stale"
+            elif cfg.spike_factor > 1.0:
+                prev = self._last_broker_values.get(s.broker_id)
+                if prev is not None and bool(
+                    np.any((prev > 0) & (v > cfg.spike_factor * prev))
+                ):
+                    bad_b[i] = "spike"
+            if i in bad_b:
+                bad_by_broker[s.broker_id] = \
+                    bad_by_broker.get(s.broker_id, 0) + 1
+            else:
+                ok_by_broker[s.broker_id] = \
+                    ok_by_broker.get(s.broker_id, 0) + 1
+
+        n_bad = len(bad_p) + len(bad_b)
+        n_ok = len(psamples) + len(bsamples) - n_bad
+        with self._lock:
+            # spike baselines advance on ACCEPTED samples only — a spike
+            # must not become the next interval's normal
+            if cfg.spike_factor > 1.0:
+                for i, s in enumerate(bsamples):
+                    if i not in bad_b:
+                        self._last_broker_values[s.broker_id] = np.asarray(
+                            s.values, np.float64
+                        )
+            self.total_accepted += n_ok
+            self.total_quarantined += n_bad
+            for reason in list(bad_p.values()) + list(bad_b.values()):
+                self._reason_totals[reason] = \
+                    self._reason_totals.get(reason, 0) + 1
+            # storm window: start tracking a broker at its first reject;
+            # every tracked broker gets one (ok, bad) point per batch so
+            # the window drains once the broker behaves (or goes silent)
+            for b in bad_by_broker:
+                if b not in self._storm:
+                    self._storm[b] = deque(
+                        maxlen=max(1, int(cfg.storm_window_batches))
+                    )
+            for b, window in self._storm.items():
+                window.append(
+                    (ok_by_broker.get(b, 0), bad_by_broker.get(b, 0))
+                )
+        if self.registry is not None:
+            self.registry.meter("monitor.sample.accepted").mark(n_ok)
+            if n_bad:
+                self.registry.meter("monitor.sample.quarantined").mark(n_bad)
+                for reason, meter in _REASON_METERS.items():
+                    n = sum(1 for r in bad_p.values() if r == reason) \
+                        + sum(1 for r in bad_b.values() if r == reason)
+                    if n:
+                        self.registry.meter(meter).mark(n)
+        if not n_bad:
+            # THE clean-path contract: the exact input lists, untouched
+            return psamples, bsamples, None
+        report.accepted = n_ok
+        report.quarantined = n_bad
+        reasons: Dict[str, int] = {}
+        for reason in list(bad_p.values()) + list(bad_b.values()):
+            reasons[reason] = reasons.get(reason, 0) + 1
+        report.reasons = {k: reasons[k] for k in sorted(reasons)}
+        report.brokers = sorted(
+            {bsamples[i].broker_id for i in bad_b}
+        )[:16]
+        report.partitions = sorted(
+            {psamples[i].partition for i in bad_p}
+        )[:16]
+        clean_p = [s for i, s in enumerate(psamples) if i not in bad_p]
+        clean_b = [s for i, s in enumerate(bsamples) if i not in bad_b]
+        return clean_p, clean_b, report
+
+    # ---- readers ----------------------------------------------------------------
+    def reason_totals(self) -> Dict[str, int]:
+        """Lifetime reject counts by reason (the
+        ``cc_monitor_quarantined_total{reason=}`` rows)."""
+        with self._lock:
+            return dict(self._reason_totals)
+
+    def storm_findings(self) -> List[Tuple[int, float, float]]:
+        """``(broker, ratio, threshold)`` for brokers whose quarantine
+        ratio over the rolling batch window crossed the storm threshold
+        — persistent badness, not a single blip."""
+        cfg = self.config
+        out: List[Tuple[int, float, float]] = []
+        with self._lock:
+            for b, window in sorted(self._storm.items()):
+                ok = sum(w[0] for w in window)
+                bad = sum(w[1] for w in window)
+                total = ok + bad
+                if total < max(1, int(cfg.storm_min_samples)):
+                    continue
+                ratio = bad / total
+                if ratio >= cfg.storm_ratio:
+                    out.append((int(b), float(ratio),
+                                float(cfg.storm_ratio)))
+        return out
+
+    def state_summary(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.config.enabled,
+                "accepted": self.total_accepted,
+                "quarantined": self.total_quarantined,
+                "reasons": {k: self._reason_totals[k]
+                            for k in sorted(self._reason_totals)},
+                "stormBrokers": sorted(self._storm),
+            }
 
 
 # ---------------------------------------------------------------------------------

@@ -531,19 +531,34 @@ class CruiseControlHttpServer:
     def _extra_metric_families(self):
         """Labeled families the flat registry can't express: per-action
         anomaly-handling outcome counters (upstream AnomalyDetectorState
-        metrics; ``cc_anomaly_actions_total{action="FIX"}``)."""
+        metrics; ``cc_anomaly_actions_total{action="FIX"}``) and the
+        monitor's per-reason quarantine counters
+        (``cc_monitor_quarantined_total{reason="non-finite"}``)."""
+        families = []
         det = getattr(self.cc, "anomaly_detector", None)
         counts_fn = getattr(det, "action_counts", None)
-        if counts_fn is None:
-            return []
-        rows = [({"action": action}, float(n))
-                for action, n in sorted(counts_fn().items())]
-        if not rows:
-            return []
-        return [(
-            "cc_anomaly_actions_total", "counter",
-            "Anomaly-handling outcomes by final action", rows,
-        )]
+        if counts_fn is not None:
+            rows = [({"action": action}, float(n))
+                    for action, n in sorted(counts_fn().items())]
+            if rows:
+                families.append((
+                    "cc_anomaly_actions_total", "counter",
+                    "Anomaly-handling outcomes by final action", rows,
+                ))
+        validator = getattr(
+            getattr(self.cc, "load_monitor", None), "sample_validator", None
+        )
+        if validator is not None:
+            rows = [({"reason": reason}, float(n))
+                    for reason, n in sorted(validator.reason_totals()
+                                            .items())]
+            if rows:
+                families.append((
+                    "cc_monitor_quarantined_total", "counter",
+                    "Metric samples quarantined by the validation stage, "
+                    "by reject reason", rows,
+                ))
+        return families
 
     # ---- GET endpoints ----------------------------------------------------------
     def _handle_get(self, handler, endpoint: str, params: dict) -> None:

@@ -27,8 +27,11 @@ from cruise_control_tpu.sim.timeline import (
     add_broker,
     perturb_broker_load,
     analyzer_outage,
+    corrupt_checkpoint,
+    corrupt_metrics,
     crash_process,
     disk_failure,
+    fail_engine,
     flap_broker,
     hot_partition_skew,
     http_request,
@@ -564,6 +567,89 @@ def _warm_replan_after_add_broker() -> ScenarioSpec:
     )
 
 
+# ---- data-integrity hardening (ISSUE 13): byzantine inputs ----------------------
+def _poisoned_metrics_quarantined_then_healed() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="poisoned_metrics_quarantined_then_healed",
+        description=(
+            "Broker 1's metrics reporter goes byzantine for six minutes "
+            "(NaN broker CPU every interval, plus records for a broker "
+            "metadata has never seen) while a REAL hot-partition skew "
+            "develops on broker 0: the monitor quarantines every "
+            "poisoned sample (journaled, counted per reason, zero NaN "
+            "reaches the aggregate tensors), the persistent badness "
+            "surfaces as an alert-only quarantine-storm metric anomaly, "
+            "and once the poison clears and windows refill, the skew is "
+            "detected and healed on clean data — garbage never moved a "
+            "replica."
+        ),
+        timeline=Timeline([
+            corrupt_metrics(4 * MIN_MS, broker=1, duration_ms=6 * MIN_MS),
+            hot_partition_skew(5 * MIN_MS, factor=8.0, leader=0),
+        ]),
+        self_healing={"goal_violation": True, "metric_anomaly": True},
+        mean_utilization=0.18,
+        duration_ms=30 * MIN_MS,
+    )
+
+
+def _checkpoint_bitflip_recovers_loudly() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="checkpoint_bitflip_recovers_loudly",
+        description=(
+            "The control plane crashes mid-rebalance; while it is down, "
+            "one byte of the durable execution checkpoint is flipped "
+            "MID-FILE (the record still parses as JSON — the exact "
+            "corruption resume reconciliation used to trust verbatim). "
+            "The restarted process's recovery detects the damage via the "
+            "per-record CRC, journals executor.checkpoint_corrupt, "
+            "treats the checkpoint as absent after the last good record, "
+            "and reconciles the rest from live cluster state — loudly "
+            "recovered, never silently wrong."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=6.0, leader=0),
+            crash_process(4 * MIN_MS, after_ticks=6),
+            corrupt_checkpoint(12 * MIN_MS, line=1),
+            restart_process(16 * MIN_MS),
+        ]),
+        self_healing={"goal_violation": True},
+        checkpoint=True,
+        mean_utilization=0.18,
+        move_latency_ticks=4,
+        executor_moves_per_broker=1,
+        fix_cooldown_ms=2 * MIN_MS,
+        duration_ms=32 * MIN_MS,
+    )
+
+
+def _engine_failure_degrades_to_greedy() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="engine_failure_degrades_to_greedy",
+        description=(
+            "The facade runs the TPU engine; a scripted cold engine "
+            "failure (XLA OOM stand-in) starts before a hot-partition "
+            "skew breaches.  The self-healing rebalance's TPU attempt "
+            "fails, the degradation ladder journals "
+            "analyzer.engine_degraded and serves the heal on the greedy "
+            "engine, and every operation inside the cooldown goes "
+            "straight to greedy — the fault is contained to one journal "
+            "line, not a failed heal."
+        ),
+        timeline=Timeline([
+            fail_engine(3 * MIN_MS),
+            hot_partition_skew(4 * MIN_MS, factor=8.0, leader=0),
+        ]),
+        self_healing={"goal_violation": True},
+        engine="tpu",
+        # the cooldown outlives the scenario: no recovery probe ever
+        # touches the (real) TPU engine mid-run
+        engine_degraded_cooldown_ms=60 * MIN_MS,
+        mean_utilization=0.18,
+        duration_ms=30 * MIN_MS,
+    )
+
+
 #: name → spec factory; a fresh ScenarioSpec per call
 SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
     factory().name: factory
@@ -591,6 +677,9 @@ SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
         _warm_replan_after_drift,
         _warm_replan_after_add_broker,
         _slo_observatory,
+        _poisoned_metrics_quarantined_then_healed,
+        _checkpoint_bitflip_recovers_loudly,
+        _engine_failure_degrades_to_greedy,
     )
 }
 
@@ -608,10 +697,15 @@ SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
 #: derivable (all green) from one scenario's journal on every run
 #: (ISSUE 11; its sequential requests keep the journal bit-reproducible,
 #: deterministic sim-trace-N ids included).
+#: poisoned_metrics_quarantined_then_healed rides in tier-1 so the
+#: byzantine-input story (quarantine → storm finding → clean heal) is
+#: re-verified bit-for-bit on every run (ISSUE 13; no RNG, sequential
+#: journal, deterministic poison windows).
 SMOKE_SCENARIOS = ("rack_loss", "cascading_disk_failures",
                    "crash_resume_mid_execution",
                    "degraded_serving_survives_analyzer_outage",
-                   "warm_replan_after_drift", "slo_observatory")
+                   "warm_replan_after_drift", "slo_observatory",
+                   "poisoned_metrics_quarantined_then_healed")
 
 
 def make_scenario(name: str, seed: Optional[int] = None) -> ScenarioSpec:

@@ -174,6 +174,13 @@ class ScenarioSpec:
     journal_path: Optional[str] = None
     journal_max_bytes: int = 16 * 1024 * 1024
     journal_max_files: int = 3
+    # data-integrity knobs (ISSUE 13).  The engine-degradation cooldown
+    # runs on the VIRTUAL clock; default outlives most scenarios so a
+    # degraded run never probes the real TPU engine mid-scenario (a
+    # probe would genuinely compile the search program).
+    engine_degraded_cooldown_ms: int = 60 * MIN_MS
+    quarantine_storm_min_samples: int = 4
+    quarantine_storm_window_batches: int = 8
 
     def healing_enables(self) -> Dict[AnomalyType, bool]:
         return {
@@ -430,6 +437,33 @@ def _restore_analyzer(cc) -> None:
         del cc.__dict__["_make_engine"]
 
 
+def _script_engine_failure(cc) -> None:
+    """Swap the facade's engine factory for one whose TPU engine always
+    raises (XLA OOM stand-in) while the greedy engine stays real — the
+    seam the engine degradation ladder is chaos-tested through."""
+
+    class _FailingTpuOptimizer:
+        def optimize(self, state, options=None, **kwargs):
+            raise RuntimeError(
+                "scripted TPU engine failure: RESOURCE_EXHAUSTED: out of "
+                "memory while trying to allocate device buffers"
+            )
+
+    orig = type(cc)._make_engine
+
+    def make(engine, constraint=None):
+        if (engine or cc.default_engine) == "tpu":
+            return _FailingTpuOptimizer()
+        return orig(cc, engine, constraint)
+
+    cc._make_engine = make
+
+
+def _restore_engine(cc) -> None:
+    if "_make_engine" in cc.__dict__:
+        del cc.__dict__["_make_engine"]
+
+
 class _Sim:
     """The assembled stack plus scripting state for one run.
 
@@ -490,11 +524,16 @@ class _Sim:
         self.process_up = True
         #: metric-gap windows [(start_ms, end_ms)), virtual
         self.gaps: List[tuple] = []
+        #: poisoned-metrics windows [(start_ms, end_ms, broker)), virtual
+        self.poisons: List[tuple] = []
         #: the virtual clock, readable by injected clocks (the breaker)
         self.now_ms = 0
         #: scripted analyzer failure window (analyzer_outage event);
         #: survives restarts — the outage outlives the process
         self.analyzer_down = False
+        #: scripted TPU-engine failure window (fail_engine event);
+        #: survives restarts for the same reason
+        self.engine_down = False
         #: deterministic User-Task-ID source (uuid4 would make every
         #: journal fingerprint unreproducible)
         self._task_seq = 0
@@ -516,12 +555,31 @@ class _Sim:
         self.topic = MetricsTopic()
         self.reporter = SimulatedMetricsReporter(self.workload.model,
                                                  self.topic)
+        # a private registry: scenario runs must not pollute the process
+        # default the server / other tests read.  Shared by the monitor's
+        # sample validator and the facade, so quarantine meters and the
+        # SLO engine see one world.
+        registry = MetricRegistry()
+        from cruise_control_tpu.monitor.sampling import (
+            SampleValidationConfig,
+            SampleValidator,
+        )
+
         self.monitor = LoadMonitor(
             metadata,
             MetricsReporterSampler(self.topic),
             capacity_resolver=self._capacity_resolver,
             window_ms=spec.tick_ms,
             num_windows=5,
+            sample_validator=SampleValidator(
+                SampleValidationConfig(
+                    storm_min_samples=spec.quarantine_storm_min_samples,
+                    storm_window_batches=(
+                        spec.quarantine_storm_window_batches
+                    ),
+                ),
+                registry=registry,
+            ),
         )
         journal = (
             ExecutionJournal(self._checkpoint_path)
@@ -555,12 +613,20 @@ class _Sim:
                 reset_s=spec.breaker_reset_ms / 1000.0,
                 clock=lambda: self.now_ms / 1000.0,
             )
-        # a private registry: scenario runs must not pollute the process
-        # default the server / other tests read
+        from cruise_control_tpu.analyzer.degradation import (
+            EngineDegradation,
+        )
+
         self.cc = CruiseControl(
             self.monitor, self.executor, engine=spec.engine,
-            registry=MetricRegistry(), breaker=breaker,
+            registry=registry, breaker=breaker,
             replan_heals=spec.replan_heal,
+            # the TPU→greedy ladder on the VIRTUAL clock, so degradation
+            # cooldowns are deterministic scenario facts
+            engine_degradation=EngineDegradation(
+                cooldown_s=spec.engine_degraded_cooldown_ms / 1000.0,
+                clock=lambda: self.now_ms / 1000.0,
+            ),
         )
         if spec.replan_enabled:
             from cruise_control_tpu.replan import (
@@ -579,6 +645,8 @@ class _Sim:
             )
         if self.analyzer_down:
             _script_analyzer_outage(self.cc)
+        if self.engine_down:
+            _script_engine_failure(self.cc)
         from cruise_control_tpu.detector.detectors import (
             PercentileMetricAnomalyFinder,
         )
@@ -679,6 +747,55 @@ class _Sim:
 
     def in_gap(self, now_ms: int) -> bool:
         return any(start <= now_ms < end for start, end in self.gaps)
+
+    # ---- data-integrity chaos (ISSUE 13) ----------------------------------------
+    def emit_poisoned_metrics(self, time_ms: int, now_ms: int) -> None:
+        """Produce the byzantine records an active ``corrupt_metrics``
+        window scripts: a NaN BROKER_CPU_UTIL for the poisoned broker
+        (produced AFTER the honest records, so the processor's
+        last-wins dict adopts it — exactly the unchecked-reporter bug
+        class) plus a record for a broker metadata has never seen."""
+        from cruise_control_tpu.monitor.sampling import (
+            CruiseControlMetric,
+            RawMetricType,
+        )
+
+        for start, end, broker in self.poisons:
+            if not (start <= now_ms < end):
+                continue
+            unknown = self.spec.num_brokers + 41
+            self.topic.produce([
+                CruiseControlMetric(
+                    RawMetricType.BROKER_CPU_UTIL, time_ms, broker,
+                    float("nan"),
+                ),
+                CruiseControlMetric(
+                    RawMetricType.BROKER_CPU_UTIL, time_ms, unknown, 55.0,
+                ),
+            ])
+
+    def corrupt_checkpoint_file(self, line: int) -> Optional[int]:
+        """Flip one byte (XOR 0x01) in the middle of non-empty line
+        ``line`` of the execution checkpoint; returns the damaged line
+        index, or None when the file is too short to have a mid-file
+        line (corruption must stay off the torn-tail path)."""
+        path = self._checkpoint_path
+        if path is None or not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            raw = f.read().split(b"\n")
+        nonempty = [i for i, seg in enumerate(raw) if seg.strip()]
+        if len(nonempty) < 2:
+            return None
+        # clip to the penultimate non-empty line: the FINAL line is the
+        # torn-tail case, which load() tolerates by design
+        target = nonempty[min(max(0, line), len(nonempty) - 2)]
+        seg = bytearray(raw[target])
+        seg[len(seg) // 2] ^= 0x01
+        raw[target] = bytes(seg)
+        with open(path, "wb") as f:
+            f.write(b"\n".join(raw))
+        return nonempty.index(target)
 
     # ---- HTTP drivers (serving-layer chaos) -------------------------------------
     def _request(self, method: str, endpoint: str, params: Dict[str, str],
@@ -824,6 +941,19 @@ def _apply_event(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
     elif ev.kind == "restore_analyzer":
         sim.analyzer_down = False
         _restore_analyzer(sim.cc)
+    elif ev.kind == "corrupt_metrics":
+        sim.poisons.append(
+            (ev.at_ms, ev.at_ms + ev.arg("duration_ms"), ev.arg("broker"))
+        )
+    elif ev.kind == "corrupt_checkpoint":
+        corrupted = sim.corrupt_checkpoint_file(ev.arg("line", 1))
+        detail["corruptedLine"] = corrupted
+    elif ev.kind == "fail_engine":
+        sim.engine_down = True
+        _script_engine_failure(sim.cc)
+    elif ev.kind == "restore_engine":
+        sim.engine_down = False
+        _restore_engine(sim.cc)
     elif ev.kind == "http_request":
         events.emit("sim.fault", fault=ev.kind, virtualMs=now_ms,
                     atMs=ev.at_ms, args=dict(ev.args))
@@ -978,7 +1108,11 @@ def run_scenario(spec: ScenarioSpec, on_tick=None) -> ScenarioResult:
             sim.workload.sync_topology(sim.backend)
             if sim.process_up:
                 if not sim.in_gap(now):
-                    sim.reporter.report(time_ms=now - spec.tick_ms // 2)
+                    report_ms = now - spec.tick_ms // 2
+                    sim.reporter.report(time_ms=report_ms)
+                    # byzantine-input windows poison the topic AFTER the
+                    # honest report, exactly like a misbehaving reporter
+                    sim.emit_poisoned_metrics(report_ms, now)
                 sim.monitor.run_sampling_iteration(now)
                 try:
                     sim.manager.run_detection_cycle(now)

@@ -65,6 +65,22 @@ faults the executor must survive):
 ``analyzer_outage`` / ``restore_analyzer``
     Scripted analyzer failure window: every optimization raises until
     restored — degraded-mode serving + circuit-breaker territory.
+``corrupt_metrics``
+    Byzantine metrics (ISSUE 13): for a window, the reporter's records
+    for one broker are poisoned — NaN broker CPU (which upstream of the
+    validation stage would flow reporter → topic → sampler → aggregator
+    → model unchecked) plus a record for a broker metadata has never
+    seen.  The monitor's quarantine stage must reject them.
+``corrupt_checkpoint``
+    Flips one byte mid-file in the durable execution checkpoint while
+    the process is down — the restarted process's recovery must detect
+    the damage via the per-record CRC (``executor.checkpoint_corrupt``)
+    and reconcile from the last good record, never adopt a
+    bit-flipped-but-parseable plan.
+``fail_engine`` / ``restore_engine``
+    Scripted TPU-engine failure (XLA OOM / compile error stand-in): TPU
+    optimizations raise until restored while the greedy engine stays
+    healthy — the engine degradation ladder's territory.
 """
 
 from __future__ import annotations
@@ -96,6 +112,10 @@ KINDS = (
     "slow_client",
     "analyzer_outage",
     "restore_analyzer",
+    "corrupt_metrics",
+    "corrupt_checkpoint",
+    "fail_engine",
+    "restore_engine",
 )
 
 
@@ -296,6 +316,36 @@ def analyzer_outage(at_ms: int) -> TimelineEvent:
 
 def restore_analyzer(at_ms: int) -> TimelineEvent:
     return _event(at_ms, "restore_analyzer")
+
+
+# ---- data-integrity chaos (ISSUE 13) --------------------------------------------
+def corrupt_metrics(at_ms: int, broker: int,
+                    duration_ms: int) -> TimelineEvent:
+    """Poison the metrics stream for ``broker`` for ``duration_ms``:
+    every reporting interval inside the window also produces a NaN
+    BROKER_CPU_UTIL record for the broker (overriding the honest one)
+    and a record for a broker id metadata has never seen."""
+    return _event(at_ms, "corrupt_metrics", broker=int(broker),
+                  duration_ms=int(duration_ms))
+
+
+def corrupt_checkpoint(at_ms: int, line: int = 1) -> TimelineEvent:
+    """Flip one byte in the middle of non-empty line ``line`` of the
+    execution checkpoint file (clipped to the penultimate line, so the
+    damage is always MID-FILE — the torn-tail path is a different,
+    already-tolerated animal).  Fire it while the process is down."""
+    return _event(at_ms, "corrupt_checkpoint", line=int(line))
+
+
+def fail_engine(at_ms: int) -> TimelineEvent:
+    """From this point every TPU-engine optimization raises (scripted
+    XLA OOM); the greedy engine keeps working — the degradation ladder
+    must serve operations on it."""
+    return _event(at_ms, "fail_engine")
+
+
+def restore_engine(at_ms: int) -> TimelineEvent:
+    return _event(at_ms, "restore_engine")
 
 
 class Timeline:

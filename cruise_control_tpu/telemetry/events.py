@@ -30,7 +30,15 @@ Persistence: an append-only JSONL file with size rotation
 ring serving ``GET /events`` and the flight-recorder merge without file
 reads.  A failed rebalance must be reconstructable from the FILE alone
 (the diagnosability contract in ``tests/test_events.py``) — every emit
-reaches disk before returning.
+reaches disk before returning.  File lines carry the per-record CRC32
+frame (:mod:`cruise_control_tpu.utils.checksum`; ISSUE 13) — still one
+valid JSON object per line, with a trailing ``crc`` member the ring
+never sees.  :func:`load_records` reads a journal file back with the
+same torn-tail-vs-mid-file discipline as the execution checkpoint: a
+bad final line (a real crash mid-write) is dropped quietly, a bad
+earlier line raises :class:`CorruptJournalError` carrying the trusted
+prefix — an incident reconstruction must never silently skip damaged
+evidence in the middle of the story.
 
 Thread-safe: one lock around the ring + file; the User-Task-ID context is
 thread-local (set by UserTaskManager around each async operation, so
@@ -47,6 +55,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from cruise_control_tpu.utils.checksum import scan_lines, stamp_line
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("events")
@@ -195,7 +204,9 @@ class EventJournal:
         if payload:
             rec["payload"] = payload
         try:
-            line = json.dumps(rec, default=str)
+            # CRC-framed for the file; the in-memory ring keeps the bare
+            # record (readers, fingerprints and GET /events are unchanged)
+            line = stamp_line(json.dumps(rec, default=str), compact=False)
         except Exception:  # pragma: no cover - defensive
             LOG.exception("event %s not serializable", kind)
             return
@@ -267,6 +278,43 @@ class EventJournal:
         if limit is not None and limit >= 0:
             out = out[-limit:]
         return out
+
+
+class CorruptJournalError(RuntimeError):
+    """Mid-file corruption in a persisted event journal.  ``records``
+    carries the trusted prefix (every good record before the damage) and
+    ``line`` the non-empty-line index of the first bad record."""
+
+    def __init__(self, path: str, line: int, records: List[dict]):
+        super().__init__(
+            f"event journal {path}: corrupt record at line {line} "
+            f"({len(records)} trusted record(s) precede it)"
+        )
+        self.path = path
+        self.line = line
+        self.records = records
+
+
+def load_records(path: str) -> List[dict]:
+    """Read one persisted journal file back, verifying per-record CRCs
+    (pre-CRC lines load as legacy).  A bad FINAL line — the torn write
+    of a real crash — is dropped with a warning; a bad earlier line
+    raises :class:`CorruptJournalError` (fail loudly, never silently
+    skip damaged evidence mid-story)."""
+    # binary read: bit rot may leave non-UTF-8 bytes — such a line must
+    # classify as torn/corrupt, not crash the reader
+    with open(path, "rb") as f:
+        lines = f.read().splitlines()
+    records, bad, n_lines = scan_lines(lines)
+    # the frame is transport, not content: hand back ring-shaped records
+    records = [{k: v for k, v in r.items() if k != "crc"} for r in records]
+    if bad:
+        if bad == [n_lines - 1]:
+            LOG.warning("event journal %s: dropping torn final record",
+                        path)
+        else:
+            raise CorruptJournalError(path, bad[0], records[:bad[0]])
+    return records
 
 
 #: process-wide default (bootstrap reconfigures it from telemetry.events.*)

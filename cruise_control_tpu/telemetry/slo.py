@@ -306,6 +306,29 @@ def _journal_growth(inp: SloInputs) -> Optional[float]:
     return len(inp.events) / (inp.horizon_ms / 60_000.0)
 
 
+def _quarantine_ratio(inp: SloInputs) -> Optional[float]:
+    """Quarantined fraction of ingested metric samples.  Live mode reads
+    the validator's accepted/quarantined meters; journal mode sums the
+    ``monitor.sample_quarantined`` batch payloads (which only cover
+    batches that rejected something, so the scenario-mode ratio is the
+    in-storm ratio — conservative, never understated)."""
+    acc = _meter_count(inp.snapshot, "monitor.sample.accepted")
+    quar = _meter_count(inp.snapshot, "monitor.sample.quarantined")
+    if acc is not None or quar is not None:
+        total = (acc or 0) + (quar or 0)
+        return ((quar or 0) / total) if total else None
+    a = q = 0
+    seen = False
+    for e in inp.events:
+        if e.get("kind") != "monitor.sample_quarantined":
+            continue
+        seen = True
+        p = e.get("payload", {})
+        a += int(p.get("accepted") or 0)
+        q += int(p.get("quarantined") or 0)
+    return (q / (a + q)) if seen and (a + q) else None
+
+
 def _live_buffer_mb(inp: SloInputs) -> Optional[float]:
     if not inp.snapshot:
         return None
@@ -343,6 +366,9 @@ SLO_DEFS: List[SloDef] = [
     SloDef("http.shed.missing.retry.after",
            "Load sheds not carrying Retry-After (shed fairness)",
            0.0, "<=", "count", _sheds_missing_retry_after),
+    SloDef("monitor.sample.quarantine.ratio",
+           "Quarantined fraction of ingested metric samples",
+           0.05, "<=", "ratio", _quarantine_ratio),
     SloDef("journal.growth.per.min",
            "Event-journal records per minute (bounded growth)",
            6_000.0, "<=", "events/min", _journal_growth),

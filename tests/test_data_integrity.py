@@ -1,0 +1,677 @@
+"""Data-integrity hardening (ISSUE 13): metrics quarantine, checksummed
+durable state, engine-failure containment.
+
+Three fronts, one contract — garbage must never silently become state:
+
+* the monitor's validation stage quarantines non-finite / negative /
+  metadata-unknown / stale / spiking samples BEFORE aggregation (clean
+  samples pass bit-identically);
+* the durable JSONL logs (execution checkpoint, event journal) carry
+  per-record CRC32 frames, and their loaders distinguish the torn tail
+  of a real crash (tolerated) from mid-file corruption (fail loudly,
+  trust only the prefix) — proven by a bit-flip fuzzer over EVERY byte
+  of real files;
+* the facade's engine degradation ladder contains cold TPU failures
+  (greedy fallback + breaker-style cooldown) and the plan sanity gate
+  refuses to emit insane OptimizerResults.
+"""
+
+import dataclasses
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.degradation import (
+    EngineDegradation,
+    PlanSanityError,
+    plan_sanity_reason,
+)
+from cruise_control_tpu.executor.journal import ExecutionJournal
+from cruise_control_tpu.monitor.aggregator import MetricSampleAggregator
+from cruise_control_tpu.monitor.load_monitor import (
+    BackendMetadataClient,
+    LoadMonitor,
+)
+from cruise_control_tpu.monitor.metric_defs import broker_metric_def
+from cruise_control_tpu.monitor.sampling import (
+    BrokerMetricSample,
+    CruiseControlMetric,
+    MetricsReporterSampler,
+    MetricsTopic,
+    PartitionMetricSample,
+    RawMetricType,
+    SampleValidationConfig,
+    SampleValidator,
+    SimulatedMetricsReporter,
+)
+from cruise_control_tpu.telemetry import events
+from cruise_control_tpu.telemetry.events import (
+    CorruptJournalError,
+    EventJournal,
+    load_records,
+)
+from cruise_control_tpu.utils.checksum import (
+    parse_line,
+    record_status,
+    stamp_line,
+)
+from cruise_control_tpu.utils.metrics import MetricRegistry
+
+from harness import WINDOW, full_stack
+
+
+@pytest.fixture
+def captured_journal():
+    """Swap a private enabled EventJournal in for the test."""
+    prev = events.JOURNAL
+    events.JOURNAL = EventJournal(enabled=True)
+    try:
+        yield events.JOURNAL
+    finally:
+        events.JOURNAL = prev
+
+
+# ---- CRC framing (utils/checksum.py) --------------------------------------------
+def test_stamp_and_parse_roundtrip_both_separator_styles():
+    for compact in (True, False):
+        seps = (",", ":") if compact else (", ", ": ")
+        base = json.dumps({"kind": "x", "payload": {"a": 1.5, "s": "p|q"}},
+                          separators=seps)
+        framed = stamp_line(base, compact=compact)
+        rec, status = parse_line(framed)
+        assert status == "ok"
+        assert rec["kind"] == "x" and "crc" in rec
+        assert record_status(rec) == "ok"
+
+
+def test_unframed_line_is_legacy_and_garbage_is_undecodable():
+    rec, status = parse_line('{"kind": "old-style"}')
+    assert status == "legacy" and rec["kind"] == "old-style"
+    assert parse_line("not json at all")[1] == "undecodable"
+    assert parse_line('[1, 2, 3]')[1] == "undecodable"  # not an object
+
+
+def test_content_flip_is_detected_as_corrupt():
+    framed = stamp_line(json.dumps({"kind": "task", "v": 12345},
+                                   separators=(",", ":")))
+    tampered = framed.replace("12345", "12346")  # still valid JSON
+    assert parse_line(tampered)[1] == "corrupt"
+
+
+# ---- execution checkpoint: torn tail vs mid-file corruption ---------------------
+def _small_checkpoint(path, n_tasks=3):
+    j = ExecutionJournal(path)
+    j.append("start", executionId=7, strategy="s", maxTicks=100,
+             proposals=[[p, 0, 0, 1, [0], [1], [], []]
+                        for p in range(n_tasks)],
+             sizes={str(p): 10.0 for p in range(n_tasks)}, config={})
+    j.append("batch", taskIds=list(range(n_tasks)), tick=1,
+             phase="replica_moves", partitions=list(range(n_tasks)),
+             moves=n_tasks)
+    for p in range(n_tasks):
+        j.append("task", taskId=p, state="COMPLETED", tick=2 + p)
+    j.close()
+    return j
+
+
+def test_torn_final_line_is_tolerated(tmp_path, captured_journal):
+    path = str(tmp_path / "ck.jsonl")
+    _small_checkpoint(path)
+    intact = ExecutionJournal(path).load()
+    with open(path) as f:
+        lines = f.read().splitlines()
+    # a real crash tears the FINAL line mid-write
+    with open(path, "w") as f:
+        f.write("\n".join(lines[:-1]) + "\n" + lines[-1][: len(lines[-1]) // 2])
+    ck = ExecutionJournal(path).load()
+    assert ck is not None and ck.execution_id == intact.execution_id
+    # only the torn record's state is lost (the batch watermark still
+    # marks that task IN_PROGRESS); nothing journaled loudly
+    assert intact.tasks[2]["state"] == "COMPLETED"
+    assert ck.tasks[2]["state"] == "IN_PROGRESS"
+    assert not captured_journal.recent(kind="executor.checkpoint_corrupt")
+
+
+def test_mid_file_bad_line_fails_loudly_and_trusts_only_prefix(
+    tmp_path, captured_journal
+):
+    path = str(tmp_path / "ck.jsonl")
+    _small_checkpoint(path)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    # an EARLIER line goes bad (undecodable garbage, not just CRC drift)
+    lines[1] = "@@@ definitely not json @@@"
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    ck = ExecutionJournal(path).load()
+    # absent-after-last-good-record: only the start record survives
+    assert ck is not None and ck.tasks == {}
+    (ev,) = captured_journal.recent(kind="executor.checkpoint_corrupt")
+    assert ev["severity"] == "ERROR"
+    assert ev["payload"]["line"] == 1
+    assert ev["payload"]["dropped"] == len(lines) - 1
+
+
+def test_bitflipped_but_parseable_record_is_caught(tmp_path,
+                                                   captured_journal):
+    """THE motivating hole: a flipped digit keeps the line valid JSON —
+    pre-CRC, resume reconciliation trusted it verbatim."""
+    path = str(tmp_path / "ck.jsonl")
+    _small_checkpoint(path)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    assert '"state":"COMPLETED"' in lines[2]
+    lines[2] = lines[2].replace('"state":"COMPLETED"', '"state":"COMPLETEE"')
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    ck = ExecutionJournal(path).load()
+    assert captured_journal.recent(kind="executor.checkpoint_corrupt")
+    # the doctored state was never adopted
+    assert all(t.get("state") != "COMPLETEE" for t in ck.tasks.values())
+
+
+def test_legacy_checkpoint_without_crc_still_loads(tmp_path):
+    """Format versioning: v1 logs (no crc member) load exactly as before."""
+    path = str(tmp_path / "legacy.jsonl")
+    recs = [
+        {"schema": "cc-tpu-execution-checkpoint/1", "seq": 1,
+         "kind": "start", "ts": 1.0,
+         "payload": {"executionId": 3, "strategy": "", "maxTicks": 10,
+                     "proposals": [[0, 0, 0, 1, [0], [1], [], []]],
+                     "sizes": {}, "config": {}}},
+        {"schema": "cc-tpu-execution-checkpoint/1", "seq": 2,
+         "kind": "task", "ts": 2.0,
+         "payload": {"taskId": 0, "state": "COMPLETED"}},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    ck = ExecutionJournal(path).load()
+    assert ck is not None and ck.execution_id == 3
+    assert ck.tasks[0]["state"] == "COMPLETED"
+
+
+# ---- the bit-flip fuzzer (acceptance criterion) ---------------------------------
+def _prefix_checkpoints(path, tmp_path):
+    """Checkpoint loaded from every line-prefix of ``path`` (the set of
+    SAFE states: group commit already means a crash may lose any suffix
+    of buffered records)."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    out = []
+    for k in range(len(lines) + 1):
+        p = str(tmp_path / f"prefix_{k}.jsonl")
+        with open(p, "w") as f:
+            f.write("\n".join(lines[:k]) + ("\n" if k else ""))
+        out.append(ExecutionJournal(p).load())
+    return out, lines
+
+
+def test_checkpoint_bitflip_fuzzer_never_silently_wrong(tmp_path,
+                                                        captured_journal):
+    """Flip one bit at EVERY byte offset of a real checkpoint: load()
+    must either recover to a line-prefix state (the group-commit-safe
+    set) or fail loudly — never return a non-prefix (silently wrong)
+    checkpoint, and never silently drop MID-FILE records."""
+    path = str(tmp_path / "ck.jsonl")
+    _small_checkpoint(path)
+    prefixes, lines = _prefix_checkpoints(path, tmp_path)
+    raw = open(path, "rb").read()
+    n_lines = len(lines)
+    # byte offset where the final line starts (flips at/after it may
+    # silently drop tail records — that IS the torn-tail contract)
+    last_line_start = len(raw) - len(lines[-1].encode()) - 1
+    flip_path = str(tmp_path / "flip.jsonl")
+    silent_wrong = []
+    for off in range(len(raw)):
+        flipped = bytearray(raw)
+        flipped[off] ^= 1 << (off % 8)
+        with open(flip_path, "wb") as f:
+            f.write(bytes(flipped))
+        events.JOURNAL.reset()
+        try:
+            got = ExecutionJournal(flip_path).load()
+        except Exception as e:  # loud is acceptable; silent-wrong is not
+            pytest.fail(f"offset {off}: load() raised {e!r}")
+        loud = bool(events.JOURNAL.recent(
+            kind="executor.checkpoint_corrupt"))
+        matches = [k for k, pk in enumerate(prefixes) if got == pk]
+        if not matches:
+            silent_wrong.append((off, "non-prefix state"))
+            continue
+        if loud:
+            continue
+        # silent outcomes must be explainable without mid-file damage:
+        # the full file, a tail-line flip, or a flipped newline that
+        # merged the final lines into one bad tail line
+        k = max(matches)
+        if k >= n_lines:          # identical to the intact checkpoint
+            continue
+        if off >= last_line_start:
+            continue              # tail-region flip: torn-tail contract
+        if raw[off] == 0x0A:
+            continue              # merged-lines variant of a torn tail
+        silent_wrong.append((off, f"silent drop to prefix {k}"))
+    assert not silent_wrong, silent_wrong[:10]
+
+
+def test_events_journal_bitflip_fuzzer(tmp_path):
+    """Same oracle for the event journal's reader: every returned record
+    list is a prefix of the originals; mid-file damage raises."""
+    path = str(tmp_path / "ev.jsonl")
+    j = EventJournal(enabled=True, path=path)
+    for i in range(5):
+        j.emit("executor.batch", moves=i, partitions=[i], tick=i,
+               phase="replica_moves")
+    j.close()
+    original = load_records(path)
+    assert len(original) == 5
+    raw = open(path, "rb").read()
+    lines = raw.decode().splitlines()
+    last_line_start = len(raw) - len(lines[-1].encode()) - 1
+    flip_path = str(tmp_path / "flip.jsonl")
+    for off in range(len(raw)):
+        flipped = bytearray(raw)
+        flipped[off] ^= 1 << (off % 8)
+        with open(flip_path, "wb") as f:
+            f.write(bytes(flipped))
+        try:
+            got = load_records(flip_path)
+        except CorruptJournalError as e:
+            # loud — and the carried prefix must really be a prefix
+            assert e.records == original[: len(e.records)], off
+            continue
+        assert got == original[: len(got)], (off, "non-prefix records")
+        if len(got) < len(original) - 1:
+            # >1 record silently gone: only a merged-tail flip may
+            assert off >= last_line_start or raw[off] == 0x0A, off
+        elif len(got) == len(original) - 1:
+            assert off >= last_line_start or raw[off] == 0x0A, off
+
+
+# ---- metrics quarantine: the ingest path ----------------------------------------
+BROKER_M = broker_metric_def().num_metrics
+
+
+def _validator(registry=None, **cfg):
+    return SampleValidator(SampleValidationConfig(**cfg), registry=registry)
+
+
+def test_clean_batch_passes_through_bit_identically():
+    v = _validator(registry=MetricRegistry())
+    p = [PartitionMetricSample(0, 100, (1.0, 2.0, 3.0, 4.0))]
+    b = [BrokerMetricSample(0, 100, tuple([1.0] * BROKER_M))]
+    cp, cb, report = v.validate(p, b, {0}, {0}, now_ms=200)
+    assert cp is p and cb is b  # the EXACT list objects
+    assert report is None
+
+
+@pytest.mark.parametrize("poison,reason", [
+    (float("nan"), "non-finite"),
+    (float("inf"), "non-finite"),
+    (-5.0, "negative"),
+])
+def test_nonfinite_and_negative_values_are_quarantined(poison, reason):
+    reg = MetricRegistry()
+    v = _validator(registry=reg)
+    vals = [1.0, 2.0, 3.0, 4.0]
+    vals[1] = poison
+    p = [PartitionMetricSample(0, 100, tuple(vals)),
+         PartitionMetricSample(1, 100, (1.0, 1.0, 1.0, 1.0))]
+    bvals = [1.0] * BROKER_M
+    bvals[0] = poison
+    b = [BrokerMetricSample(0, 100, tuple(bvals))]
+    cp, cb, report = v.validate(p, b, {0}, {0, 1}, now_ms=200)
+    assert [s.partition for s in cp] == [1] and cb == []
+    assert report.quarantined == 2 and report.reasons == {reason: 2}
+    snap = reg.snapshot()["meters"]
+    assert snap["monitor.sample.quarantined"]["count"] == 2
+    assert snap["monitor.sample.accepted"]["count"] == 1
+    assert v.reason_totals() == {reason: 2}
+
+
+def test_unknown_entities_are_quarantined_not_grown():
+    v = _validator()
+    p = [PartitionMetricSample(99, 100, (1.0, 1.0, 1.0, 1.0))]
+    b = [BrokerMetricSample(42, 100, tuple([1.0] * BROKER_M))]
+    cp, cb, report = v.validate(p, b, {0, 1}, {0, 1}, now_ms=200)
+    assert cp == [] and cb == []
+    assert report.reasons == {"unknown-broker": 1, "unknown-partition": 1}
+
+
+def test_stale_and_spike_checks_are_opt_in():
+    v = _validator(max_age_ms=1000, spike_factor=10.0)
+    b_old = BrokerMetricSample(0, 100, tuple([1.0] * BROKER_M))
+    _, cb, report = v.validate([], [b_old], {0}, set(), now_ms=5000)
+    assert cb == [] and report.reasons == {"stale": 1}
+    # spike: baseline from an accepted sample, then a 20x jump
+    base = BrokerMetricSample(0, 6000, tuple([10.0] * BROKER_M))
+    _, cb, _ = v.validate([], [base], {0}, set(), now_ms=6000)
+    assert cb == [base]
+    spike = BrokerMetricSample(0, 7000, tuple([200.0] * BROKER_M))
+    _, cb, report = v.validate([], [spike], {0}, set(), now_ms=7000)
+    assert cb == [] and report.reasons == {"spike": 1}
+    # the rejected spike did NOT advance the baseline
+    again = BrokerMetricSample(0, 8000, tuple([200.0] * BROKER_M))
+    _, cb, _ = v.validate([], [again], {0}, set(), now_ms=8000)
+    assert cb == []
+
+
+def test_aggregator_refuses_nonfinite_even_without_validator():
+    agg = MetricSampleAggregator(broker_metric_def(), 2, 1000, 3)
+    assert agg.add_sample(0, 500, [1.0] * BROKER_M) is True
+    bad = [1.0] * BROKER_M
+    bad[0] = float("nan")
+    assert agg.add_sample(0, 600, bad) is False
+    out = agg.aggregate()
+    assert np.isfinite(out.values).all()
+
+
+def test_full_ingest_path_quarantines_poison_and_model_stays_finite(
+    captured_journal,
+):
+    """End to end: reporter → topic → sampler → monitor with poisoned raw
+    records — NaN broker CPU spreads into the derived partition samples,
+    all of it is quarantined, and the built model is finite."""
+    cc, backend, reporter = full_stack(windows=3)
+    monitor = cc.load_monitor
+    topic = monitor.sampler.topic
+    before_entities = monitor.broker_aggregator.num_entities
+    # poison: NaN CPU for broker 0 (last-wins in the processor) and a
+    # record for a broker metadata has never seen
+    t = 3 * WINDOW + 500
+    reporter.report(time_ms=t)
+    topic.produce([
+        CruiseControlMetric(RawMetricType.BROKER_CPU_UTIL, t, 0,
+                            float("nan")),
+        CruiseControlMetric(RawMetricType.BROKER_CPU_UTIL, t, 77, 50.0),
+    ])
+    accepted = monitor.run_sampling_iteration(4 * WINDOW)
+    assert accepted > 0
+    (ev,) = captured_journal.recent(kind="monitor.sample_quarantined")
+    payload = ev["payload"]
+    assert payload["reasons"].get("non-finite", 0) >= 1
+    assert payload["reasons"].get("unknown-broker", 0) == 1
+    assert 0 in payload["brokers"] and 77 in payload["brokers"]
+    # no phantom broker entity was grown for id 77
+    assert monitor.broker_aggregator.num_entities == before_entities
+    state = monitor.cluster_model()
+    assert np.isfinite(np.asarray(state.leader_load)).all()
+    assert np.isfinite(np.asarray(state.follower_load)).all()
+
+
+def test_stale_reporter_after_broker_removal_and_add_broker_acceptance():
+    """Satellite: a reporter still emitting for a broker metadata no
+    longer knows is quarantined (reason unknown-broker, no phantom
+    entity); once add_broker registers a newcomer, its samples are
+    accepted — and a KILLED (dead but still hosting) broker's samples
+    keep flowing."""
+    from cruise_control_tpu.sim.backend import ScriptedClusterBackend
+
+    backend = ScriptedClusterBackend(
+        {0: [0, 1], 1: [1, 2], 2: [2, 0]}, {0: 0, 1: 1, 2: 2},
+        brokers={0, 1, 2}, broker_racks={0: 0, 1: 1, 2: 0},
+    )
+    topic = MetricsTopic()
+    monitor = LoadMonitor(
+        BackendMetadataClient(backend, backend.broker_racks),
+        MetricsReporterSampler(topic),
+        window_ms=1000, num_windows=3,
+    )
+    def b_cpu(broker, t, v=10.0):
+        return CruiseControlMetric(RawMetricType.BROKER_CPU_UTIL, t,
+                                   broker, v)
+
+    entities_before = monitor.broker_aggregator.num_entities
+    # broker 9 is not in metadata: quarantined, no growth
+    topic.produce([b_cpu(0, 500), b_cpu(9, 500)])
+    monitor.run_sampling_iteration(1000)
+    assert monitor.broker_aggregator.num_entities == entities_before
+    assert monitor.sample_validator.reason_totals() == {
+        "unknown-broker": 1}
+    # a killed broker still hosts replicas — its samples stay valid
+    backend.kill_broker(2)
+    monitor.metadata.invalidate()
+    topic.produce([b_cpu(2, 1500)])
+    assert monitor.run_sampling_iteration(2000) == 1
+    # add_broker registers id 9; its samples are accepted from then on
+    backend.add_broker(9, rack=1)
+    monitor.metadata.invalidate()
+    topic.produce([b_cpu(9, 2500)])
+    assert monitor.run_sampling_iteration(3000) == 1
+    assert monitor.broker_aggregator.num_entities == 10
+    assert monitor.sample_validator.reason_totals() == {
+        "unknown-broker": 1}
+
+
+def test_quarantine_storm_surfaces_as_metric_anomaly():
+    from cruise_control_tpu.detector.detectors import MetricAnomalyDetector
+
+    cc, backend, reporter = full_stack(windows=3)
+    monitor = cc.load_monitor
+    monitor.sample_validator.config.storm_min_samples = 3
+    monitor.sample_validator.config.storm_window_batches = 4
+    topic = monitor.sampler.topic
+    for i in range(4):
+        t = (3 + i) * WINDOW + 500
+        reporter.report(time_ms=t)
+        topic.produce([CruiseControlMetric(
+            RawMetricType.BROKER_CPU_UTIL, t, 1, float("nan"))])
+        monitor.run_sampling_iteration((4 + i) * WINDOW)
+    det = MetricAnomalyDetector(cc)
+    storms = [a for a in det.detect(10_000)
+              if a.metric == "sample.quarantine.ratio"]
+    assert storms and storms[0].broker_id == 1
+    assert not storms[0].fixable
+    # the window drains on clean batches: the storm clears
+    for i in range(4):
+        t = (7 + i) * WINDOW + 500
+        reporter.report(time_ms=t)
+        monitor.run_sampling_iteration((8 + i) * WINDOW)
+    assert not [a for a in det.detect(20_000)
+                if a.metric == "sample.quarantine.ratio"]
+
+
+def test_quarantine_ratio_slo_live_and_journal_modes():
+    from cruise_control_tpu.telemetry.slo import evaluate_slos
+
+    reg = MetricRegistry()
+    reg.meter("monitor.sample.accepted").mark(95)
+    reg.meter("monitor.sample.quarantined").mark(5)
+    rep = evaluate_slos([], snapshot=reg.snapshot())
+    row = rep.slo("monitor.sample.quarantine.ratio")
+    assert row.measured == pytest.approx(0.05)
+    assert row.ok is True
+    journal = [{"kind": "monitor.sample_quarantined", "ts": 1.0,
+                "payload": {"accepted": 1, "quarantined": 3}}]
+    rep = evaluate_slos(journal, snapshot=None)
+    row = rep.slo("monitor.sample.quarantine.ratio")
+    assert row.measured == pytest.approx(0.75)
+    assert row.ok is False
+    # no data at all abstains (never flips hysteresis)
+    assert evaluate_slos([], snapshot=None).slo(
+        "monitor.sample.quarantine.ratio").state == "NO_DATA"
+
+
+def test_quarantine_rows_on_metrics_exposition():
+    from cruise_control_tpu.telemetry.exposition import render_prometheus
+
+    reg = MetricRegistry()
+    cc, _, reporter = full_stack(windows=3, registry=reg)
+    monitor = cc.load_monitor
+    monitor.sample_validator.registry = reg
+    t = 3 * WINDOW + 500
+    reporter.report(time_ms=t)
+    monitor.sampler.topic.produce([CruiseControlMetric(
+        RawMetricType.BROKER_CPU_UTIL, t, 0, float("nan"))])
+    monitor.run_sampling_iteration(4 * WINDOW)
+    rows = [({"reason": r}, float(n))
+            for r, n in sorted(monitor.sample_validator.reason_totals()
+                               .items())]
+    text = render_prometheus(reg, extra_families=[(
+        "cc_monitor_quarantined_total", "counter", "test", rows)])
+    assert 'cc_monitor_quarantined_total{reason="non-finite"}' in text
+
+
+# ---- engine degradation ladder + plan sanity gate -------------------------------
+class _FailingTpu:
+    def optimize(self, state, options=None, **kwargs):
+        raise RuntimeError("XLA RESOURCE_EXHAUSTED (scripted)")
+
+
+def _fail_tpu(cc):
+    orig = type(cc)._make_engine
+
+    def make(engine, constraint=None):
+        if (engine or cc.default_engine) == "tpu":
+            return _FailingTpu()
+        return orig(cc, engine, constraint)
+
+    cc._make_engine = make
+
+
+def _tpu_as_greedy(cc):
+    """'Recovered' engine: the tpu request resolves to a (real) greedy
+    optimizer so the recovery probe succeeds without a device compile."""
+    orig = type(cc)._make_engine
+
+    def make(engine, constraint=None):
+        if (engine or cc.default_engine) == "tpu":
+            return orig(cc, "greedy", constraint)
+        return orig(cc, engine, constraint)
+
+    cc._make_engine = make
+
+
+def test_engine_ladder_degrades_recovers_and_journals(captured_journal):
+    clock = [0.0]
+    cc, _, _ = full_stack(engine="tpu")
+    cc.engine_degradation = EngineDegradation(
+        cooldown_s=60.0, clock=lambda: clock[0])
+    _fail_tpu(cc)
+    # 1) cold TPU failure → greedy serves the SAME operation
+    r = cc.rebalance(dryrun=True)
+    assert r.engine == "greedy"
+    (deg,) = captured_journal.recent(kind="analyzer.engine_degraded")
+    assert deg["payload"]["fallback"] == "greedy"
+    assert "RESOURCE_EXHAUSTED" in deg["payload"]["error"]
+    assert cc.engine_degradation.active()
+    # 2) inside the cooldown: straight to greedy, no new failure/degrade
+    r2 = cc.rebalance(dryrun=True)
+    assert r2.engine == "greedy"
+    assert len(captured_journal.recent(
+        kind="analyzer.engine_degraded")) == 1
+    summary = cc.engine_degradation.state_summary()
+    assert summary["state"] == "DEGRADED" and summary["degradations"] == 1
+    assert cc.state()["AnalyzerState"]["engineDegradation"]["state"] == \
+        "DEGRADED"
+    # 3) past the cooldown the next attempt probes; success recovers
+    clock[0] = 61.0
+    _tpu_as_greedy(cc)
+    cc.rebalance(dryrun=True)
+    assert captured_journal.recent(kind="analyzer.engine_recovered")
+    assert not cc.engine_degradation.active()
+
+
+def test_engine_ladder_refailure_rearms_cooldown(captured_journal):
+    clock = [0.0]
+    cc, _, _ = full_stack(engine="tpu")
+    cc.engine_degradation = EngineDegradation(
+        cooldown_s=30.0, clock=lambda: clock[0])
+    _fail_tpu(cc)
+    cc.rebalance(dryrun=True)
+    clock[0] = 31.0  # probe window — tpu still broken
+    cc.rebalance(dryrun=True)
+    assert len(captured_journal.recent(
+        kind="analyzer.engine_degraded")) == 2
+    assert cc.engine_degradation.active()
+    assert not captured_journal.recent(kind="analyzer.engine_recovered")
+
+
+def test_no_ladder_without_degradation_state(captured_journal):
+    """engine_degradation=None keeps the historical behavior: a cold TPU
+    failure surfaces to the caller."""
+    cc, _, _ = full_stack(engine="tpu")
+    assert cc.engine_degradation is None
+    _fail_tpu(cc)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        cc.rebalance(dryrun=True)
+    assert captured_journal.recent(kind="optimize.failed")
+    assert not captured_journal.recent(kind="analyzer.engine_degraded")
+
+
+class _InsaneOptimizer:
+    """Returns a structurally-valid result whose final loads are NaN."""
+
+    def __init__(self, cc):
+        self._real = type(cc)._make_engine(cc, "greedy")
+
+    def optimize(self, state, options=None, **kwargs):
+        r = self._real.optimize(state, options)
+        bad = np.asarray(r.final_state.leader_load).copy()
+        bad[0] = np.nan
+        r.final_state = r.final_state.replace(leader_load=bad)
+        return r
+
+
+def test_plan_sanity_gate_refuses_nonfinite_plans(captured_journal):
+    cc, _, _ = full_stack(engine="greedy")
+    insane = _InsaneOptimizer(cc)
+    cc._make_engine = lambda engine, constraint=None: insane
+    with pytest.raises(PlanSanityError, match="non-finite-final-loads"):
+        cc.rebalance(dryrun=True)
+    (rej,) = captured_journal.recent(kind="analyzer.plan_rejected")
+    assert rej["payload"]["reason"] == "non-finite-final-loads"
+    assert captured_journal.recent(kind="optimize.failed")
+
+
+def test_plan_sanity_gate_rejection_rides_the_ladder(captured_journal):
+    """A TPU result failing the gate degrades to greedy like any other
+    cold engine failure — the operation still succeeds."""
+    cc, _, _ = full_stack(engine="tpu")
+    cc.engine_degradation = EngineDegradation(cooldown_s=60.0,
+                                              clock=lambda: 0.0)
+    insane = _InsaneOptimizer(cc)
+    orig = type(cc)._make_engine
+
+    def make(engine, constraint=None):
+        if (engine or cc.default_engine) == "tpu":
+            return insane
+        return orig(cc, engine, constraint)
+
+    cc._make_engine = make
+    r = cc.rebalance(dryrun=True)
+    assert r.engine == "greedy"
+    assert captured_journal.recent(kind="analyzer.plan_rejected")
+    assert captured_journal.recent(kind="analyzer.engine_degraded")
+    assert not captured_journal.recent(kind="optimize.failed")
+
+
+def test_plan_sanity_reason_unit():
+    class _R:
+        def __init__(self, before, after, hard_b=0, hard_a=0):
+            self.violations_before = {"CpuCapacityGoal": hard_b,
+                                      "ReplicaDistributionGoal": before}
+            self.violations_after = {"CpuCapacityGoal": hard_a,
+                                     "ReplicaDistributionGoal": after}
+            self.final_state = None
+
+        @property
+        def violation_score_before(self):
+            return sum(self.violations_before.values())
+
+        @property
+        def violation_score_after(self):
+            return sum(self.violations_after.values())
+
+    assert plan_sanity_reason(_R(5, 0)) is None
+    # soft goals may legitimately end worse (evacuations trade balance)
+    assert plan_sanity_reason(_R(0, 4)) is None
+    # hard violations appearing from nowhere may not
+    assert plan_sanity_reason(_R(0, 0, hard_b=0, hard_a=2)) == \
+        "hard-score-worse-than-pre-plan"
+    assert plan_sanity_reason(_R(0, math.nan)) == \
+        "non-finite-violation-score"

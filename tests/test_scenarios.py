@@ -39,7 +39,7 @@ from cruise_control_tpu.sim.timeline import (
 from test_artifact_schemas import SCHEMAS, validate
 
 MIN = MIN_MS
-ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r12.json"
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r13.json"
 
 #: the outcome each scripted timeline must reach — also pinned against the
 #: committed artifact below, so a regression shows up in tier-1 without
@@ -68,6 +68,9 @@ EXPECTED_OUTCOMES = {
     "warm_replan_after_drift": "HEALED",
     "warm_replan_after_add_broker": "HEALED",
     "slo_observatory": "HEALED",
+    "poisoned_metrics_quarantined_then_healed": "HEALED",
+    "checkpoint_bitflip_recovers_loudly": "HEALED",
+    "engine_failure_degrades_to_greedy": "HEALED",
 }
 
 _cache = {}
@@ -410,6 +413,76 @@ def _check_slo_observatory(r):
                for e in r.journal)
 
 
+# ---- data-integrity hardening (ISSUE 13): journal-only byzantine proofs --------
+def _check_poisoned_metrics_quarantined_then_healed(r):
+    """The journal alone proves the quarantine story: poisoned samples
+    were rejected (counted, attributed), the persistently-bad broker
+    surfaced as a storm anomaly, NOTHING NaN-shaped broke an
+    optimization, and the real skew healed on clean data."""
+    q = [e["payload"] for e in r.events_of("monitor.sample_quarantined")]
+    assert q, "no quarantine events — the poison was swallowed silently"
+    assert all(p["reasons"].get("non-finite", 0) >= 1 for p in q)
+    assert any(p["reasons"].get("unknown-broker", 0) >= 1 for p in q)
+    assert all(1 in p["brokers"] for p in q)
+    # quarantine is bounded to the poison window: none in the tail
+    last_q = max(e["ts"] for e in r.events_of("monitor.sample_quarantined"))
+    assert last_q * 1000 <= 11 * MIN
+    # the storm finding: broker 1's persistent badness IS an anomaly,
+    # alert-only (no automatic fix for data gone dark)
+    storms = [p for p in r.anomalies("METRIC_ANOMALY")
+              if "sample.quarantine.ratio" in p["description"]]
+    assert storms and all(p["action"] == "IGNORE" for p in storms)
+    assert any("broker 1 " in p["description"] for p in storms)
+    # the REAL fault healed on clean data; no optimization ever failed
+    assert r.fixes_started("GOAL_VIOLATION")
+    assert not r.events_of("optimize.failed")
+    assert not r.events_of("analyzer.plan_rejected")
+    assert r.actions_executed() > 0
+    # the quarantine SLO holds over the whole run (in-storm ratio is the
+    # journal-mode measurement — bounded, not runaway)
+    rep = r.slo_report(objectives={
+        "monitor.sample.quarantine.ratio": 0.25})
+    assert rep.slo("monitor.sample.quarantine.ratio").ok is True
+
+
+def _check_checkpoint_bitflip_recovers_loudly(r):
+    (corrupt,) = r.events_of("executor.checkpoint_corrupt")
+    assert corrupt["severity"] == "ERROR"
+    assert corrupt["payload"]["line"] == 1
+    assert corrupt["payload"]["dropped"] >= 2  # mid-file, not torn tail
+    # LOUD and ordered: corruption detected before recovery adopted it
+    idx = {e["kind"]: i for i, e in reversed(list(enumerate(r.journal)))}
+    assert idx["executor.checkpoint_corrupt"] < \
+        idx["execution.recovery.start"]
+    (recovery,) = r.recoveries()
+    assert recovery["outcome"] == "resumed" and recovery["succeeded"]
+    # reconciliation re-derived everything the corruption dropped from
+    # LIVE state: moves finished while down were adopted, not re-moved
+    (resume,) = r.resume_summaries()
+    assert resume["completedWhileDown"] or resume["alreadyCompleted"]
+    assert r.dead_tasks() == 0
+    assert not [p for p in r.anomalies("GOAL_VIOLATION")
+                if p["timeMs"] > r.duration_virtual_ms - 4 * MIN]
+
+
+def _check_engine_failure_degrades_to_greedy(r):
+    (deg,) = r.events_of("analyzer.engine_degraded")
+    assert deg["payload"]["engine"] == "tpu"
+    assert deg["payload"]["fallback"] == "greedy"
+    assert "RESOURCE_EXHAUSTED" in deg["payload"]["error"]
+    # containment: the failed TPU attempt cost ONE journal line, not a
+    # failed heal — every optimization end is a greedy success and no
+    # operation ever failed
+    ends = [e["payload"]["engine"] for e in r.events_of("optimize.end")]
+    assert ends and all(e == "greedy" for e in ends)
+    assert not r.events_of("optimize.failed")
+    # inside the cooldown further operations skip TPU entirely (exactly
+    # one degradation for the whole run)
+    assert r.fixes_started("GOAL_VIOLATION")
+    assert r.actions_executed() > 0
+    assert not r.events_of("analyzer.engine_recovered")
+
+
 CHECKS = {
     "broker_death_mid_execution": _check_broker_death_mid_execution,
     "rack_loss": _check_rack_loss,
@@ -439,6 +512,12 @@ CHECKS = {
     "warm_replan_after_drift": _check_warm_replan_after_drift,
     "warm_replan_after_add_broker": _check_warm_replan_after_add_broker,
     "slo_observatory": _check_slo_observatory,
+    "poisoned_metrics_quarantined_then_healed":
+        _check_poisoned_metrics_quarantined_then_healed,
+    "checkpoint_bitflip_recovers_loudly":
+        _check_checkpoint_bitflip_recovers_loudly,
+    "engine_failure_degrades_to_greedy":
+        _check_engine_failure_degrades_to_greedy,
 }
 
 
