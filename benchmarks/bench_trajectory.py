@@ -38,6 +38,7 @@ GATES: Dict[str, Tuple[str, float]] = {
     "replan_overhead_pct": ("max", 1.0),
     "slo_overhead_pct": ("max", 1.0),
     "validation_overhead_pct": ("max", 1.0),
+    "profiler_overhead_pct": ("max", 1.0),
 }
 
 #: the north-star wall-clock ceiling (round-6 acceptance, held since)
@@ -129,6 +130,7 @@ def render(rounds: List[Tuple[int, dict]]) -> str:
         ("replan_overhead_pct", "replan % (≤1)"),
         ("slo_overhead_pct", "slo % (≤1)"),
         ("validation_overhead_pct", "validation % (≤1)"),
+        ("profiler_overhead_pct", "profiler % (≤1)"),
         ("replan_settle_speedup", f"settle × (≥{REPLAN_SETTLE_MIN:g})"),
         ("soak_smoke", "soak smoke s (green, ≤budget)"),
     ]

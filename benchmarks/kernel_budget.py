@@ -1,40 +1,41 @@
-"""Per-kernel device budget of the resident scan step (round-4 item #1).
+"""Per-kernel device budget of the resident scan step — offline edition.
 
-Traces ONE warm scan call (``--steps`` while-loop steps) at north-star
-shapes with ``jax.profiler``, parses the device track of the Chrome-trace
-the TPU runtime emits (per-kernel ``device_duration_ps``,
-``bytes_accessed``, ``model_flops``, ``hlo_category``), and prints a
-per-step kernel budget:
+Traces ONE warm scan call (``--steps`` while-loop steps) at the requested
+shapes through the kernel observatory's single profiler entry point
+(:mod:`cruise_control_tpu.telemetry.kernel_budget` — the parser, bucket
+classifier, and artifact builder live THERE now; this script is the
+steps-based offline driver) and prints a ``cc-tpu-kernel-budget/2``
+artifact on stdout: per-step kernels / device-busy / bytes / HBM floor,
+per-BUCKET self-time accounting (grid+top-k, auction rounds, move_vec
+build, pool rebuild, long tail), and — with ``--devices N`` — the
+per-device busy split and shard-skew ratio over a forced
+``--xla_force_host_platform_device_count`` CPU mesh.
 
-  * kernels/step, device-busy time/step, wall time/step
-  * bytes accessed/step  → HBM-bandwidth floor at the chip's peak
-  * model flops/step     → compute floor
-  * top kernels by total device time, with per-step count/time/bytes
+The artifact records the backend it was measured on: r04 numbers came
+from a real v5e (``backend: "tpu"``, the device-event dialect with byte
+counters); CPU refreshes parse the XLA:CPU thunk stream (wall-time
+self-accounting, no byte counters) and are comparable to each other, not
+to device-dialect rounds.
 
-This is the number that decides whether the ~28 ms step has fusion
-headroom or sits on a hardware floor (round-2 ask, round-3 VERDICT weak
-#1).  Output: human table on stderr, one JSON document on stdout —
-commit it as ``benchmarks/KERNEL_BUDGET_r*.json``.
+``--compare tests/budgets/kernel_budget.json`` gates the measured
+per-bucket kernel counts against the pinned budget (exit 1 on growth
+past the ceiling) — the same regression loop the tier-1 test runs on the
+tiny fixture, available at any shape.
 
 Usage:
-    PYTHONPATH=.:/root/.axon_site python benchmarks/kernel_budget.py \
-        [--brokers 10000] [--partitions 1000000] [--steps 64]
+    PYTHONPATH=. python benchmarks/kernel_budget.py \
+        [--brokers 10000] [--partitions 1000000] [--steps 64] \
+        [--devices 8] [--compare tests/budgets/kernel_budget.json]
 """
 
 from __future__ import annotations
 
 import argparse
 import dataclasses
-import glob
-import gzip
 import json
 import os
 import sys
 import time
-
-# TPU v5e (v5 lite) datasheet peaks — the roofline denominators
-HBM_BYTES_PER_S = 819e9
-PEAK_F32_FLOPS = 98.3e12  # MXU bf16 is 197; the scoring path is f32
 
 
 def sync(x):
@@ -50,126 +51,64 @@ def sync(x):
     np.asarray(jax.numpy.ravel(leaves[0])[0])
 
 
-def newest_trace(trace_dir: str) -> str:
-    paths = glob.glob(
-        os.path.join(trace_dir, "plugins", "profile", "*", "*.trace.json.gz")
-    )
-    if not paths:
-        raise FileNotFoundError(f"no trace under {trace_dir}")
-    return max(paths, key=os.path.getmtime)
-
-
-def parse_device_kernels(trace_path: str):
-    """→ kernel rows: one per HLO name, aggregated over the device "XLA
-    Ops" track with SELF-time accounting.
-
-    Control-flow region events (``while.*``/``cond.*``) nest their body
-    kernels inside their interval on the same thread, so naive sums count
-    every nanosecond (and byte) twice.  Events nest strictly; a stack
-    walk attributes to each event its duration minus its children's
-    (self time) and, for bytes/flops, leaf values only (region events'
-    counters re-aggregate their bodies)."""
-    with gzip.open(trace_path, "rt") as f:
-        data = json.load(f)
-    events = data.get("traceEvents", [])
-    device_pids = {
-        e["pid"]
-        for e in events
-        if e.get("ph") == "M"
-        and e.get("name") == "process_name"
-        and str(e.get("args", {}).get("name", "")).startswith("/device:")
-    }
-    per_thread: dict = {}
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
-            continue
-        if "hlo_category" not in e.get("args", {}):
-            continue  # umbrella program event, not a kernel
-        per_thread.setdefault((e["pid"], e["tid"]), []).append(e)
-
-    agg: dict = {}
-
-    def account(e, child_time_us: float, is_region: bool):
-        args = e.get("args", {})
-        dur_us = float(args.get("device_duration_ps", 0)) / 1e6
-        row = agg.setdefault(
-            e["name"],
-            {
-                "name": e["name"],
-                "category": args.get("hlo_category", "?"),
-                "count": 0,
-                "time_us": 0.0,
-                "total_time_us": 0.0,
-                "bytes": 0,
-                "flops": 0,
-                "long_name": args.get("long_name", "")[:240],
-            },
-        )
-        row["count"] += 1
-        row["time_us"] += max(0.0, dur_us - child_time_us)
-        row["total_time_us"] += dur_us
-        if not is_region:
-            row["bytes"] += int(args.get("raw_bytes_accessed",
-                                         args.get("bytes_accessed", 0)))
-            row["flops"] += int(args.get("model_flops", 0) or 0)
-
-    for evs in per_thread.values():
-        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
-        stack: list = []       # open events: (end_ts, event)
-        child_time: list = []  # per open event: accumulated child device us
-
-        def close_one():
-            _end, ev = stack.pop()
-            ct = child_time.pop()
-            account(ev, ct, _is_region(ev))
-            if child_time:  # this event is a child of the new stack top
-                child_time[-1] += float(
-                    ev["args"].get("device_duration_ps", 0)) / 1e6
-
-        for e in evs:
-            ts = e["ts"]
-            while stack and ts >= stack[-1][0] - 1e-9:
-                close_one()
-            stack.append((ts + e.get("dur", 0.0), e))
-            child_time.append(0.0)
-        while stack:
-            close_one()
-    return list(agg.values())
-
-
-def _is_region(e) -> bool:
-    return e.get("args", {}).get("hlo_category") in (
-        "while", "conditional", "fusion root"  # control-flow containers
-    )
-
-
 def main() -> None:
-    from cruise_control_tpu.utils.jit_cache import enable as _jc
-
-    _jc()
     ap = argparse.ArgumentParser()
     ap.add_argument("--brokers", type=int, default=10000)
     ap.add_argument("--partitions", type=int, default=1000000)
     ap.add_argument("--racks", type=int, default=200)
     ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=5)
     ap.add_argument("--trace-dir", default="/tmp/cc_tpu_kernel_budget")
     ap.add_argument("--top", type=int, default=25)
+    ap.add_argument(
+        "--devices", type=int, default=0,
+        help="shard the scan over an N-device mesh "
+        "(--xla_force_host_platform_device_count on CPU) so the artifact "
+        "carries per-device busy-ms and the shard-skew ratio",
+    )
+    ap.add_argument(
+        "--device-batch", type=int, default=0,
+        help="device_batch_per_step for the traced call (0 = the "
+        "B/4-clamped auto heuristic).  Small skewed fixtures commit "
+        "full batches every step and trip the slot-budget honesty "
+        "assertion — give them headroom with a larger batch",
+    )
     ap.add_argument(
         "--auction-rounds", type=int, default=-1,
         help="override tpu.search auction_rounds for the traced call "
         "(-1 = engine default, 0 = one round per alternate destination) — "
         "the r4 budget's item-2 sweep axis",
     )
+    ap.add_argument(
+        "--compare", default="",
+        help="pinned budget JSON (tests/budgets/kernel_budget.json "
+        "shape); exit 1 when per-bucket kernel counts grew past its "
+        "ceiling",
+    )
     args = ap.parse_args()
+
+    if args.devices > 1:
+        # must land before the first jax import in this process
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+
+    from cruise_control_tpu.utils.jit_cache import enable as _jc
+
+    _jc()
+
+    import numpy as np
 
     import jax
 
     import cruise_control_tpu.analyzer.tpu_optimizer as T
     from cruise_control_tpu.analyzer.context import AnalyzerContext
     from cruise_control_tpu.models.generators import random_cluster
+    from cruise_control_tpu.telemetry import kernel_budget as kb
 
     state = random_cluster(
-        seed=5, num_brokers=args.brokers, num_racks=args.racks,
+        seed=args.seed, num_brokers=args.brokers, num_racks=args.racks,
         num_partitions=args.partitions,
     )
     opt = T.TpuGoalOptimizer()
@@ -181,19 +120,34 @@ def main() -> None:
     K, D = opt._pool_sizes(P, S, B)
     cfg = dataclasses.replace(
         opt.config,
-        device_batch_per_step=int(min(max(B // 4, 32), 1024)),
+        device_batch_per_step=(
+            args.device_batch if args.device_batch > 0
+            else int(min(max(B // 4, 32), 1024))
+        ),
     )
     if args.auction_rounds >= 0:
         cfg = dataclasses.replace(cfg, auction_rounds=args.auction_rounds)
-    fn = T._cached_scan_fn(cfg, K, D, args.steps)
+    mesh = None
+    if args.devices > 1:
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[: args.devices]), ("search",)
+        )
+    fn = T._cached_scan_fn(cfg, K, D, args.steps, mesh)
+
+    def tables():
+        # cold pool-row tables (the cross-call diet carry): invalid, so
+        # the traced call's first repool is the full rebuild r04 measured
+        return (jax.numpy.zeros((P, S), jax.numpy.float32),
+                jax.numpy.zeros((P, S), jax.numpy.float32),
+                jax.numpy.zeros(P, bool), np.False_)
 
     print("warming (compile or cache load)...", file=sys.stderr)
-    sync(fn(m, ca))
+    sync(fn(m, ca, np.int32(args.steps), tables()))
 
-    os.makedirs(args.trace_dir, exist_ok=True)
     t0 = time.perf_counter()
-    with jax.profiler.trace(args.trace_dir):
-        packed, m2 = fn(m, ca)
+    # the repo's ONE raw-profiler entry point (cclint profiler-discipline)
+    with kb.profiler_session(args.trace_dir):
+        packed, m2, _tab = fn(m, ca, np.int32(args.steps), tables())
         sync(packed)
     wall_s = time.perf_counter() - t0
 
@@ -216,93 +170,60 @@ def main() -> None:
         f"{steps} steps executed — rerun with fewer --steps"
     )
 
-    rows = parse_device_kernels(newest_trace(args.trace_dir))
-    rows.sort(key=lambda r: -r["time_us"])
-    tot_time_us = sum(r["time_us"] for r in rows)
-    tot_count = sum(r["count"] for r in rows)
-    tot_bytes = sum(r["bytes"] for r in rows)
-    tot_flops = sum(r["flops"] for r in rows)
-
-    by_cat: dict = {}
-    for r in rows:
-        c = by_cat.setdefault(
-            r["category"], {"count": 0, "time_us": 0.0, "bytes": 0}
-        )
-        c["count"] += r["count"]
-        c["time_us"] += r["time_us"]
-        c["bytes"] += r["bytes"]
-
-    per_step = {
-        "kernels": tot_count / steps,
-        "device_busy_ms": tot_time_us / steps / 1e3,
-        "wall_ms": wall_s * 1e3 / steps,
-        "bytes_mb": tot_bytes / steps / 1e6,
-        "model_gflops": tot_flops / steps / 1e9,
-        "hbm_floor_ms": tot_bytes / steps / HBM_BYTES_PER_S * 1e3,
-        "flops_floor_ms": tot_flops / steps / PEAK_F32_FLOPS * 1e3,
-    }
-    per_step["hbm_utilization_of_busy"] = (
-        (tot_bytes / (tot_time_us / 1e6)) / HBM_BYTES_PER_S
-        if tot_time_us else 0.0
+    parsed = kb.parse_trace(kb.newest_trace(args.trace_dir))
+    artifact = kb.build_artifact(
+        parsed, units=steps, unit="step", source="benchmark",
+        backend=jax.default_backend(),
+        fixture={
+            "brokers": args.brokers, "partitions": args.partitions,
+            "racks": args.racks, "seed": args.seed, "K": K, "D": D,
+            "steps_traced": steps, "devices": max(1, args.devices),
+            "auction_rounds": int(cfg.auction_rounds),
+        },
+        top=max(args.top, 25),
     )
+    artifact["per_unit"]["wall_ms"] = round(wall_s * 1e3 / steps, 4)
 
-    hdr = (f"{'kernel':46s} {'cat':18s} {'n/step':>7s} {'us/step':>9s} "
-           f"{'MB/step':>9s} {'GB/s':>7s}")
+    rows = artifact["kernels"]
+    hdr = (f"{'kernel':40s} {'bucket':14s} {'cat':14s} {'n/step':>7s} "
+           f"{'us/step':>9s} {'MB/step':>9s}")
     print("\n" + hdr, file=sys.stderr)
     print("-" * len(hdr), file=sys.stderr)
     for r in rows[: args.top]:
-        t_us = r["time_us"] / steps
-        mb = r["bytes"] / steps / 1e6
-        bw = (r["bytes"] / (r["time_us"] / 1e6) / 1e9) if r["time_us"] else 0
         print(
-            f"{r['name'][:46]:46s} {r['category'][:18]:18s} "
-            f"{r['count'] / steps:7.1f} {t_us:9.1f} {mb:9.3f} {bw:7.1f}",
+            f"{r['name'][:40]:40s} {r['bucket'][:14]:14s} "
+            f"{r['category'][:14]:14s} {r['count_per_unit']:7.1f} "
+            f"{r['us_per_unit']:9.1f} {r['mb_per_unit']:9.3f}",
             file=sys.stderr,
         )
-    print(f"\nper step: {per_step['kernels']:.0f} kernels, "
-          f"busy {per_step['device_busy_ms']:.2f} ms, "
-          f"wall {per_step['wall_ms']:.2f} ms, "
-          f"{per_step['bytes_mb']:.1f} MB "
-          f"(HBM floor {per_step['hbm_floor_ms']:.2f} ms), "
-          f"{per_step['model_gflops']:.1f} GF "
-          f"(compute floor {per_step['flops_floor_ms']:.2f} ms)",
+    pu = artifact["per_unit"]
+    print(f"\nper step: {pu['kernels']:.0f} kernels, "
+          f"busy {pu['device_busy_ms']:.2f} ms, "
+          f"wall {pu['wall_ms']:.2f} ms, "
+          f"{pu['bytes_mb']:.1f} MB "
+          f"(HBM floor {pu['hbm_floor_ms']:.2f} ms); "
+          f"buckets: "
+          + ", ".join(f"{k}={v['us_per_unit'] / 1e3:.2f}ms"
+                      for k, v in artifact["by_bucket"].items()),
           file=sys.stderr)
+    dev = artifact["devices"]
+    if dev["count"] > 1:
+        print(f"shards: {dev['count']} devices, busy "
+              + ", ".join(f"{k}={v:.2f}ms"
+                          for k, v in dev["busy_ms"].items())
+              + f", skew {dev['skew']}", file=sys.stderr)
 
-    doc = {
-        "fixture": {
-            "brokers": args.brokers, "partitions": args.partitions,
-            "racks": args.racks, "seed": 5, "K": K, "D": D,
-            "steps_traced": steps,
-            "auction_rounds": int(cfg.auction_rounds),
-        },
-        "hw": {"hbm_bytes_per_s": HBM_BYTES_PER_S,
-               "peak_f32_flops": PEAK_F32_FLOPS, "chip": "v5e"},
-        "per_step": {k: round(v, 4) for k, v in per_step.items()},
-        "by_category": {
-            k: {
-                "count_per_step": round(v["count"] / steps, 2),
-                "us_per_step": round(v["time_us"] / steps, 2),
-                "mb_per_step": round(v["bytes"] / steps / 1e6, 4),
-            }
-            for k, v in sorted(by_cat.items(),
-                               key=lambda kv: -kv[1]["time_us"])
-        },
-        "kernels": [
-            {
-                "name": r["name"],
-                "category": r["category"],
-                "count_per_step": round(r["count"] / steps, 2),
-                "us_per_step": round(r["time_us"] / steps, 3),
-                "mb_per_step": round(r["bytes"] / steps / 1e6, 5),
-                "gbps": round(
-                    r["bytes"] / (r["time_us"] / 1e6) / 1e9, 2
-                ) if r["time_us"] else 0.0,
-                "long_name": r["long_name"],
-            }
-            for r in rows
-        ],
-    }
-    print(json.dumps(doc))
+    print(json.dumps(artifact))
+
+    if args.compare:
+        with open(args.compare) as f:
+            budget = json.load(f)
+        violations = kb.compare_budget(artifact, budget)
+        for v in violations:
+            print(f"BUDGET VIOLATION: {v}", file=sys.stderr)
+        if violations:
+            raise SystemExit(1)
+        print(f"budget gate holds vs {args.compare}", file=sys.stderr)
 
 
 if __name__ == "__main__":

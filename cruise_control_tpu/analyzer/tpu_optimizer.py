@@ -74,7 +74,7 @@ from cruise_control_tpu.ops.pools import (
     pool_row_tables,
     pool_row_tables_update,
 )
-from cruise_control_tpu.telemetry import device_stats, tracing
+from cruise_control_tpu.telemetry import device_stats, kernel_budget, tracing
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("engine")
@@ -327,10 +327,13 @@ class TpuSearchConfig:
     #: replica evacuation) always runs to completion — only soft-goal
     #: refinement is cut short, and _finalize still enforces hard goals
     time_budget_s: float = 0.0
-    #: when set, wrap the device search in a ``jax.profiler.trace`` written
-    #: here (TensorBoard/XProf-viewable) — the kernel-granularity analog of
-    #: the reference's Dropwizard ``proposal-computation-timer`` (SURVEY.md
-    #: §5.1); the coarse timer still lands in the shared metric registry
+    #: when set, trace the WHOLE device search into this directory
+    #: (TensorBoard/XProf-viewable) through the kernel observatory's
+    #: single profiler entry point (telemetry/kernel_budget.py) — the
+    #: trace also feeds the parsed cc-tpu-kernel-budget/2 artifact.  The
+    #: on-demand path (GET /profile/kernels?arm=true) captures N scan
+    #: calls instead; both are host-loop-only knobs normalized out of the
+    #: scan compile-cache key
     profiler_trace_dir: str = ""
     #: score-only rounds run after the device-resident search converges: the
     #: finer per-source candidate granularity can recover a last slice of
@@ -3093,13 +3096,12 @@ class TpuGoalOptimizer:
                 reused_before = []
             stats_before = stats_summary(cluster_stats(state))
 
-            import contextlib
-
-            trace_ctx = (
-                jax.profiler.trace(cfg.profiler_trace_dir)
-                if cfg.profiler_trace_dir else contextlib.nullcontext()
-            )
-            with trace_ctx:
+            # kernel observatory (telemetry/kernel_budget.py): claims an
+            # armed capture for this search's scan calls; a configured
+            # profiler_trace_dir traces the whole search through the same
+            # single profiler entry point (the old ad-hoc hook, subsumed)
+            with kernel_budget.CAPTURE.search_scope(
+                    legacy_trace_dir=cfg.profiler_trace_dir):
                 return self._search(
                     state, ctx, goals, violations_before, stats_before,
                     initial_assignment, initial_leader_slot,
@@ -3160,13 +3162,16 @@ class TpuGoalOptimizer:
                 cfg = dataclasses.replace(
                     cfg, device_batch_per_step=int(np.clip(B // 2, 32, 2048))
                 )
-            # pipeline_depth and time_budget_s are host-loop knobs — the
-            # compiled program is identical at every value (the step cap
-            # rides a runtime arg), so they must not key the compile cache
-            # (a per-request deadline would recompile a ~minute program)
+            # pipeline_depth, time_budget_s and profiler_trace_dir are
+            # host-loop knobs — the compiled program is identical at every
+            # value (the step cap rides a runtime arg; the profiler wraps
+            # the call from outside), so they must not key the compile
+            # cache (a per-request deadline, or ARMING the kernel
+            # observatory, would recompile a ~minute program)
             scan_fn = _cached_scan_fn(
                 dataclasses.replace(cfg, pipeline_depth=0,
-                                    time_budget_s=0.0), K, D,
+                                    time_budget_s=0.0,
+                                    profiler_trace_dir=""), K, D,
                 cfg.steps_per_call, self.mesh,
             )
             # convergence exits via the device done flag / no-progress break;
@@ -3199,9 +3204,13 @@ class TpuGoalOptimizer:
             # warm starts run SERIAL: a steady-state replan converges in
             # one or two calls, so the speculative call the pipeline
             # issues at call 2 is almost always pure waste — and its
-            # enqueued device work delays the carry export behind it
+            # enqueued device work delays the carry export behind it.
+            # An active kernel capture also forces serial so "the next N
+            # scan calls" is a well-defined traced window (plan identity
+            # between serial and pipelined is already the contract)
             depth = (
-                0 if (cfg.time_budget_s or warm_start is not None)
+                0 if (cfg.time_budget_s or warm_start is not None
+                      or kernel_budget.CAPTURE.capturing)
                 else max(0, cfg.pipeline_depth)
             )
             inflight: List[Tuple] = []
@@ -3274,16 +3283,25 @@ class TpuGoalOptimizer:
                     # cannot be auto-replicated into a multi-process mesh
                     # (the multihost dryrun), while numpy inputs are
                     # treated as replicated
-                    with tracing.device_span("analyzer.scan") as dsp:
-                        packed, m_new, tab_new = scan_fn(
-                            m, ca,
-                            np.int32(
-                                cfg.steps_per_call if t_cap is None else t_cap
-                            ),
-                            tab,
-                        )
-                        if not depth:
-                            dsp.block(packed)
+                    # scan_call: the kernel observatory's traced window —
+                    # starts the profiler before the first armed call and
+                    # stops after the requested count (no-op when disarmed)
+                    with kernel_budget.CAPTURE.scan_call():
+                        with tracing.device_span("analyzer.scan") as dsp:
+                            packed, m_new, tab_new = scan_fn(
+                                m, ca,
+                                np.int32(
+                                    cfg.steps_per_call if t_cap is None
+                                    else t_cap
+                                ),
+                                tab,
+                            )
+                            if not depth:
+                                dsp.block(packed)
+                        # a capture must see the call COMPLETE inside its
+                        # window (dsp.block is a no-op with spans off)
+                        kernel_budget.CAPTURE.block((packed, m_new,
+                                                     tab_new))
                 n_calls += 1
                 evaluator.round_index = n_calls
                 if t_cap is not None:

@@ -338,6 +338,7 @@ def build_app(
         device_cost,
         device_stats,
         events,
+        kernel_budget,
         tracing,
     )
     from cruise_control_tpu.telemetry import trace as trace_mod
@@ -356,6 +357,11 @@ def build_app(
     device_cost.configure(
         enabled=cfg.get_boolean("telemetry.device.cost.enabled"),
         hbm_gbps=cfg.get_double("telemetry.device.cost.hbm.gbps"),
+    )
+    kernel_budget.configure(
+        enabled=cfg.get_boolean("telemetry.kernel.enabled"),
+        default_scans=cfg.get_int("telemetry.kernel.capture.scans"),
+        trace_dir=cfg.get("telemetry.kernel.trace.dir") or "",
     )
     trace_mod.configure(
         enabled=cfg.get_boolean("telemetry.trace.enabled"),
@@ -791,15 +797,19 @@ def build_app(
     if cfg.get_boolean("telemetry.device.cost.enabled"):
         # HBM-utilization estimate + pending-capture depth as gauges
         device_cost.install_gauges(cc.registry)
+    if cfg.get_boolean("telemetry.kernel.enabled"):
+        # kernel-observatory capture count + pending-parse depth
+        kernel_budget.install_gauges(cc.registry)
     flight_recorder = None
     if cfg.get_boolean("telemetry.recorder.enabled"):
         from cruise_control_tpu.telemetry.recorder import FlightRecorder
 
         def _device_summary() -> dict:
             out = device_stats.MONITOR.summary()
-            # the kernel budget, live: per-executable flops/bytes/HBM
-            # alongside the compile stats in one diagnostics block
-            out["deviceCost"] = device_cost.MONITOR.summary()
+            # cost ESTIMATES, per fn / per executable / per device —
+            # beside the MEASURED kernel budget the artifact's
+            # kernelBudget block carries, one diagnostics dump holds both
+            out["deviceCost"] = device_cost.MONITOR.summary(detail=True)
             return out
 
         flight_recorder = FlightRecorder(
@@ -826,6 +836,12 @@ def build_app(
                 trace_mod.STORE.index
                 if cfg.get_boolean("telemetry.trace.enabled") else None
             ),
+            # the measured kernel budget (latest parsed capture) rides
+            # the same dump the estimates do
+            kernel_budget_source=(
+                kernel_budget.CAPTURE.summary
+                if cfg.get_boolean("telemetry.kernel.enabled") else None
+            ),
         )
         detector.flight_recorder = flight_recorder
         flight_recorder.start()
@@ -849,6 +865,10 @@ def build_app(
             # per-executable cost capture pays one AOT compile each —
             # pumped here, off every request thread
             maintenance.append(device_cost.MONITOR.capture_pending)
+        if cfg.get_boolean("telemetry.kernel.enabled"):
+            # Chrome-trace parsing is seconds of host work at north-star
+            # scale — same discipline: the SLO tick pumps it
+            maintenance.append(kernel_budget.CAPTURE.parse_pending)
         slo_engine = SloEngine(
             registry=cc.registry,
             events_reader=(

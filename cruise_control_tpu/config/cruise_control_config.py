@@ -1072,6 +1072,22 @@ def default_config_def() -> ConfigDef:
     d.define("telemetry.device.cost.hbm.gbps", ConfigType.DOUBLE, 819.0,
              Importance.LOW, "Assumed per-device HBM bandwidth (GB/s) for "
              "the utilization estimate.", at_least(0.001), G)
+    d.define("telemetry.kernel.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Kernel observatory "
+             "(telemetry/kernel_budget.py): allow on-demand device-kernel "
+             "captures (GET /profile/kernels?arm=true) around drive-loop "
+             "scan calls, parsed off the request thread into the "
+             "cc-tpu-kernel-budget/2 artifact, cc_kernel_*/cc_shard_* "
+             "metric families, and the /diagnostics kernelBudget block. "
+             "Disarmed cost is one attribute check per scan call "
+             "(bench.py profiler_overhead_pct gate).", None, G)
+    d.define("telemetry.kernel.capture.scans", ConfigType.INT, 3,
+             Importance.LOW, "Drive-loop scan calls traced per capture "
+             "when the arm request names no count.", at_least(1), G)
+    d.define("telemetry.kernel.trace.dir", ConfigType.STRING, None,
+             Importance.LOW, "Parent directory for capture traces (a "
+             "per-capture temp subdirectory is created and removed after "
+             "parsing); empty = the system temp dir.", None, G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a

@@ -62,6 +62,9 @@ from cruise_control_tpu.devtools.lint.rules_except import (
 from cruise_control_tpu.devtools.lint.rules_jax import JaxHotPathRule
 from cruise_control_tpu.devtools.lint.rules_lock import LockDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_obs import ObsDynamicNameRule
+from cruise_control_tpu.devtools.lint.rules_profiler import (
+    ProfilerDisciplineRule,
+)
 from cruise_control_tpu.devtools.lint.rules_retry import RetryDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_schema import JournalSchemaRule
 from cruise_control_tpu.devtools.lint.rules_wallclock import (
@@ -90,6 +93,7 @@ RULES = {
         DeadlinePropagationRule(),
         JournalSchemaRule(),
         WallClockDisciplineRule(),
+        ProfilerDisciplineRule(),
     )
 }
 

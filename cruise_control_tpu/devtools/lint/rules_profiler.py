@@ -1,0 +1,90 @@
+"""profiler-discipline — ``jax.profiler`` has ONE entry point.
+
+ISSUE 14 promoted kernel-budget capture into a telemetry subsystem
+(``telemetry/kernel_budget.py``): its ``CaptureManager`` owns the global
+profiler session (one capture at a time, parse off the request thread,
+journal lifecycle events, compile-cache keys normalized), and the old
+ad-hoc ``profiler_trace_dir`` hook in the optimizer was subsumed by it.
+A direct ``jax.profiler.trace`` / ``start_trace`` / ``stop_trace`` call
+anywhere else reopens the hole this closed: two sessions race the global
+profiler (the second ``start_trace`` raises, failing whatever request
+carries it), captures bypass the journal/artifact surface, and the traced
+window stops meaning "N scan calls".
+
+Findings: any call site whose callee resolves to the profiler session API
+outside ``telemetry/kernel_budget.py`` —
+
+* dotted calls: ``jax.profiler.trace(...)``, ``something.profiler.
+  start_trace(...)`` (any receiver ending in ``profiler``);
+* module aliases: ``import jax.profiler as prof; prof.trace(...)``,
+  ``from jax import profiler; profiler.start_trace(...)``;
+* direct-name imports: ``from jax.profiler import start_trace;
+  start_trace(...)``.
+
+Non-session profiler helpers (``annotate_trace_event``,
+``device_memory_profile``) are out of scope — only the session API can
+collide.  Evaluated over the phase-1 summaries (no re-parse).
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import List, Set
+
+from cruise_control_tpu.devtools.lint.findings import Finding
+
+RULE_ID = "profiler-discipline"
+
+#: the session API that must stay behind the single entry point
+_SESSION_FNS = frozenset(("trace", "start_trace", "stop_trace"))
+
+#: the one module allowed to touch jax.profiler directly
+_ALLOWED_SUFFIX = ("telemetry", "kernel_budget.py")
+
+
+class ProfilerDisciplineRule:
+    id = RULE_ID
+    summary = ("direct jax.profiler.trace/start_trace/stop_trace calls "
+               "outside telemetry/kernel_budget.py (the kernel "
+               "observatory is the single profiler entry point)")
+    project_rule = True
+
+    def check_project(self, project) -> List[Finding]:
+        findings: List[Finding] = []
+        for s in project.summaries:
+            parts = pathlib.PurePath(s.path).parts
+            if parts[-2:] == _ALLOWED_SUFFIX:
+                continue
+            profiler_modules: Set[str] = set()
+            direct_names: Set[str] = set()
+            for _level, from_mod, name, alias in s.imports:
+                if from_mod is None and name == "jax.profiler":
+                    profiler_modules.add(alias)
+                elif from_mod == "jax" and name == "profiler":
+                    profiler_modules.add(alias)
+                elif from_mod == "jax.profiler" and name in _SESSION_FNS:
+                    direct_names.add(alias)
+            for fn in s.functions.values():
+                for call in fn.calls:
+                    callee = call.callee
+                    head, _, tail = callee.rpartition(".")
+                    hit = (
+                        callee in direct_names
+                        or (tail in _SESSION_FNS
+                            and (head in profiler_modules
+                                 or head == "profiler"
+                                 or head.endswith(".profiler")))
+                    )
+                    if hit:
+                        findings.append(Finding(
+                            path=s.path, line=call.lineno, rule=self.id,
+                            message=(
+                                f"direct profiler-session call "
+                                f"{callee}() in {fn.name or '<module>'} — "
+                                "route captures through telemetry/"
+                                "kernel_budget.py (CaptureManager.arm / "
+                                "profiler_session), the single "
+                                "jax.profiler entry point"
+                            ),
+                        ))
+        return findings

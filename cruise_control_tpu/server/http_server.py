@@ -77,7 +77,7 @@ RETRY_AFTER_NOT_READY_S = 30
 GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
     "user_tasks", "review_board", "metrics", "diagnostics", "events",
-    "health", "slo", "trace",
+    "health", "slo", "trace", "profile/kernels",
 }
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
@@ -647,6 +647,46 @@ class CruiseControlHttpServer:
             return self._send(
                 handler, 200, trace_mod.chrome_trace(tid, spans, matched)
             )
+        if endpoint == "profile/kernels":
+            # kernel observatory (docs/OBSERVABILITY.md "Reading a kernel
+            # budget"): ?arm=true[&scans=N] arms a capture of the next N
+            # drive-loop scan calls (202 + state; trigger an optimization
+            # and poll), plain GETs serve the latest parsed
+            # cc-tpu-kernel-budget/2 artifact (404 before the first
+            # capture; 202 while armed / parsing — the SLO tick parses)
+            from cruise_control_tpu.telemetry import kernel_budget
+
+            capture = kernel_budget.CAPTURE
+            if not capture.enabled:
+                return self._send(handler, 503, {
+                    "errorMessage": "kernel observatory disabled "
+                                    "(telemetry.kernel.enabled=false?)"
+                })
+            if _flag(params, "arm"):
+                scans = params.get("scans")
+                state = capture.arm(
+                    scans=int(scans) if scans else None, reason="http")
+                return self._send(handler, 202, {
+                    "message": "capture armed: run an optimization and "
+                               "poll GET /profile/kernels",
+                    "capture": state,
+                })
+            artifact = capture.latest()
+            if artifact is not None:
+                return self._send(handler, 200, artifact)
+            state = capture.state()
+            if state["state"] != "IDLE" or state["pendingParses"] \
+                    or state["activeParses"]:
+                return self._send(handler, 202, {
+                    "message": "capture in flight (armed, mid-parse, or "
+                               "awaiting the SLO-tick parse) — poll again",
+                    "capture": state,
+                })
+            return self._send(handler, 404, {
+                "errorMessage": "no kernel capture parsed yet — arm one "
+                                "with GET /profile/kernels?arm=true",
+                "capture": state,
+            })
         if endpoint == "diagnostics":
             # flight-recorder artifact: retained time series + the merged
             # anomaly journal (docs/OBSERVABILITY.md) — the crash-readable

@@ -159,6 +159,12 @@ class ScenarioSpec:
     #: the engine the facade optimizes with (self-heals AND proposals).
     #: Scenarios keep the greedy default; the 1000-broker soak runs "tpu".
     engine: str = "greedy"
+    #: >0: arm the kernel observatory for this many drive-loop scan calls
+    #: at scenario start (telemetry/kernel_budget.py), on the VIRTUAL
+    #: clock with deterministic ``sim-capture-N`` ids, parse pumped once
+    #: per tick — capture events land in the journal bit-reproducibly.
+    #: Only meaningful with ``engine="tpu"`` (greedy never scans).
+    kernel_capture_scans: int = 0
     # metric-anomaly finder tuning (the production metric.anomaly.* keys;
     # defaults mirror PercentileMetricAnomalyFinder's).  A full-stack
     # rebalance redistributes traffic, so at soak scale every broker's
@@ -1079,12 +1085,33 @@ def run_scenario(spec: ScenarioSpec, on_tick=None) -> ScenarioResult:
     scenario time."""
     spec.timeline.reset()
     clock_ms = [0.0]
+    # deterministic kernel capture (kernel_capture_scans > 0): virtual
+    # clock + sim-capture-N ids, so profiler.capture.* journal records
+    # fingerprint bit-stably; a no-op scope otherwise
+    from cruise_control_tpu.telemetry import kernel_budget
+
+    cap_seq = [0]
+
+    def _next_capture_id() -> str:
+        cap_seq[0] += 1
+        return f"sim-capture-{cap_seq[0]}"
+
+    capture_scope = (
+        kernel_budget.CAPTURE.scoped(
+            clock=lambda: clock_ms[0] / 1000.0,
+            id_factory=_next_capture_id,
+        )
+        if spec.kernel_capture_scans > 0 else contextlib.nullcontext()
+    )
     with _scenario_journal(
         ring_size=spec.journal_ring_size, path=spec.journal_path,
         max_bytes=spec.journal_max_bytes, max_files=spec.journal_max_files,
         clock=lambda: clock_ms[0] / 1000.0,
-    ) as journal:
+    ) as journal, capture_scope:
         sim = _Sim(spec)
+        if spec.kernel_capture_scans > 0:
+            kernel_budget.CAPTURE.arm(
+                scans=spec.kernel_capture_scans, reason="scenario")
         events.emit(
             "sim.scenario_start", name=spec.name, seed=spec.seed,
             brokers=spec.num_brokers, partitions=spec.num_partitions,
@@ -1131,6 +1158,10 @@ def run_scenario(spec: ScenarioSpec, on_tick=None) -> ScenarioResult:
                 # the process is down but the cluster lives on: in-flight
                 # reassignments keep progressing, brokers keep flapping
                 sim.backend.tick()
+            if spec.kernel_capture_scans > 0:
+                # the SLO tick's job in production; synchronous here so
+                # the artifact lands deterministically within the run
+                kernel_budget.CAPTURE.parse_pending()
             if on_tick is not None:
                 on_tick(sim, now)
         sim.stop_serving()  # graceful drain (journaled) before the end mark

@@ -75,7 +75,7 @@ class ExecutableCost:
 
     __slots__ = ("signature", "flops", "bytes_accessed", "arg_bytes",
                  "output_bytes", "temp_bytes", "code_bytes",
-                 "captured_unix")
+                 "captured_unix", "num_devices", "per_device")
 
     def __init__(self, signature: tuple):
         self.signature = signature
@@ -86,16 +86,27 @@ class ExecutableCost:
         self.temp_bytes = 0
         self.code_bytes = 0
         self.captured_unix = 0.0
+        #: addressable devices at capture time (an executable compiled
+        #: under a mesh spans all of them)
+        self.num_devices = 1
+        #: per-device cost rows when the backend reports one
+        #: ``cost_analysis`` entry per device (single-entry backends
+        #: report program-wide totals and this stays empty)
+        self.per_device: list = []
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "flops": self.flops,
             "bytesAccessed": self.bytes_accessed,
             "argBytes": self.arg_bytes,
             "outputBytes": self.output_bytes,
             "tempBytes": self.temp_bytes,
             "codeBytes": self.code_bytes,
+            "devices": self.num_devices,
         }
+        if self.per_device:
+            out["perDevice"] = list(self.per_device)
+        return out
 
 
 class DeviceCostMonitor:
@@ -200,11 +211,25 @@ class DeviceCostMonitor:
     def _capture_one(name: str, fn: Any, signature: tuple,
                      skeleton) -> Optional[ExecutableCost]:
         try:
+            import jax
+
             args, kwargs = skeleton
             compiled = fn.lower(*args, **kwargs).compile()
             cost = ExecutableCost(signature)
+            cost.num_devices = max(1, jax.local_device_count())
             analysis = compiled.cost_analysis()
             if isinstance(analysis, (list, tuple)):
+                if len(analysis) > 1:
+                    # one entry per device: keep the split for the
+                    # per-fn per-device diagnostics breakdown
+                    cost.per_device = [
+                        {
+                            "flops": float(a.get("flops", 0.0) or 0.0),
+                            "bytesAccessed": float(
+                                a.get("bytes accessed", 0.0) or 0.0),
+                        }
+                        for a in analysis
+                    ]
                 analysis = analysis[0] if analysis else {}
             if analysis:
                 cost.flops = float(analysis.get("flops", 0.0) or 0.0)
@@ -238,9 +263,13 @@ class DeviceCostMonitor:
         calls = sum(c for s, c in buckets if s >= cutoff)
         return calls / float(_RATE_WINDOW_S)
 
-    def per_function(self) -> Dict[str, dict]:
+    def per_function(self, detail: bool = False) -> Dict[str, dict]:
         """fn → aggregated cost view (worst-case executable per metric,
-        call totals, live rate)."""
+        call totals, live rate).  ``detail`` adds the per-executable /
+        per-device breakdown (``perExecutable`` rows keyed by argument
+        signature, each carrying the device split when the backend
+        reports one) — the ``GET /diagnostics`` surface, so cost
+        estimates sit beside the measured kernel budget."""
         with self._lock:
             names = sorted(set(self._costs) | set(self._call_totals))
             out = {}
@@ -261,6 +290,15 @@ class DeviceCostMonitor:
                         c.output_bytes for c in per.values())
                     entry["tempBytes"] = max(
                         c.temp_bytes for c in per.values())
+                    if detail:
+                        entry["perExecutable"] = [
+                            {
+                                "signature": repr(c.signature)[:240],
+                                "capturedUnix": c.captured_unix,
+                                **c.to_json(),
+                            }
+                            for c in per.values()
+                        ]
                 out[name] = entry
             return out
 
@@ -279,8 +317,9 @@ class DeviceCostMonitor:
         with self._lock:
             return len(self._pending)
 
-    def summary(self) -> dict:
-        """JSON view (flight-recorder artifact, diagnostics)."""
+    def summary(self, detail: bool = False) -> dict:
+        """JSON view (flight-recorder artifact, diagnostics).  ``detail``
+        includes the per-executable / per-device breakdown."""
         return {
             "enabled": self.enabled,
             "hbmGbps": self.hbm_gbps,
@@ -288,7 +327,7 @@ class DeviceCostMonitor:
             "captureFailures": self.capture_failures,
             "pendingCaptures": self.pending(),
             "hbmUtilization": round(self.hbm_utilization(), 6),
-            "functions": self.per_function(),
+            "functions": self.per_function(detail=detail),
         }
 
     def families(self) -> List[tuple]:

@@ -26,6 +26,10 @@ Rendering rules (one metric family per registry entry):
   (``fn`` label) + ``cc_device_hbm_utilization_estimate``, from
   :mod:`telemetry.device_cost` — already-captured analyses only, a
   scrape never triggers a compile
+* Kernel    -> ``cc_kernel_busy_ms/count/bytes{category=}`` +
+  ``cc_kernel_hbm_utilization_measured`` + ``cc_shard_busy_ms{device=}``
+  / ``cc_shard_skew``, from :mod:`telemetry.kernel_budget`'s latest
+  PARSED capture — a scrape never parses a trace
 
 Registry names like ``proposal-computation-timer`` or ``http.GET.state``
 are sanitized to the ``[a-zA-Z_:][a-zA-Z0-9_:]*`` metric grammar and
@@ -37,7 +41,12 @@ from __future__ import annotations
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from cruise_control_tpu.telemetry import device_cost, device_stats, profile
+from cruise_control_tpu.telemetry import (
+    device_cost,
+    device_stats,
+    kernel_budget,
+    profile,
+)
 from cruise_control_tpu.telemetry.tracing import Telemetry
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
@@ -214,6 +223,11 @@ def render_prometheus(
         # from ALREADY-captured analyses — a scrape never compiles
         device_families = device_cost.MONITOR.families() \
             if device_cost.MONITOR.enabled else ()
+        # measured kernel-budget gauges (cc_kernel_* / cc_shard_*): the
+        # latest PARSED capture only — a scrape never parses a trace
+        kernel_families = kernel_budget.CAPTURE.families() \
+            if kernel_budget.CAPTURE.enabled else ()
+        device_families = tuple(device_families) + tuple(kernel_families)
     else:
         device_families = ()
 

@@ -1,0 +1,1136 @@
+"""Kernel observatory — live device-kernel budget capture + parsing
+(``cc-tpu-kernel-budget/2``).
+
+``benchmarks/KERNEL_BUDGET_r04.md`` answered the question that licenses
+every remaining device optimization — where does the scan step's device
+time go, and how far above the HBM floor does it run — but the answer
+lived in a one-off benchmark artifact that went stale the moment the
+program changed.  This module promotes that accounting into a telemetry
+subsystem:
+
+* **Shared trace parser** (:func:`parse_trace`): the self-time /
+  region-nesting accounting extracted from ``benchmarks/kernel_budget.py``
+  round 4, speaking BOTH profiler dialects — the TPU runtime's device
+  track (``/device:*`` pids, ``hlo_category``, ``device_duration_ps``,
+  ``bytes_accessed``, ``model_flops``) and XLA:CPU's thunk stream
+  (``hlo_op`` args, wall ``dur``, per-device
+  ``ThunkExecutor::Execute`` client-thread lanes).  Control-flow regions
+  (``while``/``conditional``) nest their body kernels inside their own
+  interval on the same track, so naive sums double-count; a stack walk
+  attributes self time and leaf-only byte/flop counters.
+* **Semantic buckets** (:func:`classify_bucket`): every kernel lands in
+  exactly one budget bucket — ``grid_topk`` (selection network / top-k /
+  sort), ``auction`` (kernels inside a nested while: the round storm),
+  ``move_vec_build`` (gather chains feeding the candidate tables),
+  ``pool_rebuild`` (kernels under the repool conditional), ``scan_loop``
+  (the outer step loop's own bookkeeping) and ``long_tail`` — so bucket
+  self-times partition total busy time (the reconciliation invariant the
+  tests pin) and regressions gate per bucket
+  (``tests/budgets/kernel_budget.json``).
+* **CaptureManager** (module singleton :data:`CAPTURE`): the repo's ONE
+  entry point to ``jax.profiler`` (cclint rule ``profiler-discipline``).
+  :meth:`~CaptureManager.arm` requests a capture of the next N drive-loop
+  scan calls; the TPU optimizer wraps each scan dispatch in
+  :meth:`~CaptureManager.scan_call`, which starts the trace before call 1
+  and stops it after call N (the legacy ``tpu.search.profiler.trace.dir``
+  whole-search hook is subsumed via :meth:`~CaptureManager.search_scope`).
+  Parsing runs OFF the request thread — :meth:`~CaptureManager.
+  parse_pending` is pumped by the SLO observatory's maintenance tick,
+  exactly like ``device_cost.capture_pending`` — and lands the artifact on
+  ``GET /profile/kernels`` (202-arm + poll; 404 before the first capture),
+  in the flight-recorder ``/diagnostics`` dump (``kernelBudget``), and on
+  ``GET /metrics`` as ``cc_kernel_busy_ms/count/bytes{category=}``,
+  ``cc_kernel_hbm_utilization_measured``, ``cc_shard_busy_ms{device=}``
+  and ``cc_shard_skew`` families.
+* **Journal**: ``profiler.capture.start`` / ``profiler.capture.end``
+  record the capture lifecycle with deterministic payloads (sequence-
+  numbered ids, no paths, no timings), so a capture inside a scenario run
+  keeps the journal fingerprint bit-stable.
+
+Per-shard skew: on the device dialect each ``/device:N`` pid's kernel
+self-time sums independently; on the host-thunk dialect each device's
+execution blocks its own PJRT client thread, whose
+``ThunkExecutor::Execute`` wall intervals are the per-shard lanes (dispatch-to-done wall, which
+includes collective waits — good enough to SEE skew, not to apportion
+it; the device dialect gives true busy).  ``skew = max/mean`` of the
+per-device busy — the number ROADMAP item 1's mesh investigation needs.
+
+Disarmed cost: one lock-free attribute check per scan call and per
+search — gated ≤1 % by ``bench.py``'s ``profiler_overhead_pct`` — and
+ZERO device-side cost: ``profiler_trace_dir`` is normalized out of the
+scan compile-cache key next to ``pipeline_depth``/``time_budget_s``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("kernel_budget")
+
+SCHEMA = "cc-tpu-kernel-budget/2"
+
+# roofline denominators (TPU v5e datasheet; the scoring path is f32).
+# The artifact embeds them so floors stay interpretable next to the
+# measured numbers whatever chip the capture ran on.
+HBM_BYTES_PER_S = 819e9
+PEAK_F32_FLOPS = 98.3e12
+
+#: the closed bucket vocabulary — by_bucket rows partition busy time
+BUCKETS = ("grid_topk", "auction", "move_vec_build", "pool_rebuild",
+           "scan_loop", "long_tail")
+
+#: kernel rows retained in the artifact (the full table is benchmark
+#: material; the live artifact keeps the head)
+_TOP_KERNELS = 40
+
+#: parse queue bound: captures are operator-paced; a burst just drops the
+#: oldest unparsed trace (and removes its directory)
+_MAX_PENDING_PARSES = 4
+
+
+# ---- the profiler session (the repo's ONE raw-profiler surface) ------------------
+class _ProfilerHandle:
+    """One live profiler session writing to ``trace_dir``.
+
+    Uses the backend ``ProfilerSession`` with the **Python tracer OFF**:
+    the kernel budget's signal is the device/thunk stream, and the
+    default python tracer floods the trace's ~1M-event cap the moment a
+    cold compile lands inside the window (measured: ~1M ``$builtins``
+    events, ZERO kernels).  Falls back to ``jax.profiler.start_trace``
+    (python tracer and all) if the options API drifts — a noisier trace
+    beats a dead observatory."""
+
+    def __init__(self, trace_dir: str):
+        self.trace_dir = trace_dir
+        self._session = None
+        self._via_jax = False
+        try:
+            from jax._src.lib import xla_client
+
+            opts = xla_client.profiler.ProfileOptions()
+            opts.python_tracer_level = 0
+            opts.host_tracer_level = 2
+            self._session = xla_client.profiler.ProfilerSession(opts)
+        except Exception:
+            import jax
+
+            jax.profiler.start_trace(trace_dir)
+            self._via_jax = True
+
+    def stop(self, export: bool = True) -> None:
+        """Stop the session; ``export`` writes the trace to
+        ``trace_dir`` (False aborts a capture without the export cost)."""
+        try:
+            if self._via_jax:
+                import jax
+
+                jax.profiler.stop_trace()
+            elif export:
+                self._session.stop_and_export(self.trace_dir)
+            else:
+                self._session.stop()
+        finally:
+            self._session = None
+
+
+# ---- trace discovery -------------------------------------------------------------
+def newest_trace(trace_dir: str) -> str:
+    """The newest ``*.trace.json.gz`` under a ``jax.profiler`` output dir."""
+    paths = glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*",
+                     "*.trace.json.gz")
+    )
+    if not paths:
+        raise FileNotFoundError(f"no trace under {trace_dir}")
+    return max(paths, key=os.path.getmtime)
+
+
+# ---- parsing ---------------------------------------------------------------------
+@dataclass
+class KernelRow:
+    """One HLO kernel aggregated over the trace (self-time accounting)."""
+
+    name: str
+    category: str
+    bucket: str
+    count: int = 0
+    time_us: float = 0.0        # self time (children excluded)
+    total_time_us: float = 0.0  # wall incl. children (regions re-span)
+    bytes: int = 0
+    flops: int = 0
+    long_name: str = ""
+
+
+@dataclass
+class ParsedTrace:
+    """Parser output: kernel rows + the per-device split."""
+
+    dialect: str                        # "device" | "host-thunk"
+    rows: List[KernelRow] = field(default_factory=list)
+    #: device label → busy microseconds (kernel self time on the device
+    #: dialect; per-lane execution wall on the host-thunk dialect)
+    device_busy_us: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def total_time_us(self) -> float:
+        return sum(r.time_us for r in self.rows)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(r.bytes for r in self.rows)
+
+    @property
+    def total_flops(self) -> int:
+        return sum(r.flops for r in self.rows)
+
+    @property
+    def total_count(self) -> int:
+        return sum(r.count for r in self.rows)
+
+    def skew(self) -> Optional[float]:
+        """max/mean of per-device busy — 1.0 is a perfectly level mesh;
+        None without device attribution."""
+        vals = [v for v in self.device_busy_us.values() if v > 0]
+        if not vals:
+            return None
+        mean = sum(vals) / len(vals)
+        return (max(vals) / mean) if mean > 0 else None
+
+
+def _name_root(name: str) -> str:
+    """``fusion.933`` → ``fusion``; ``reduce-window.2`` → ``reduce-window``."""
+    root = name.split(".", 1)[0]
+    return root
+
+
+def classify_bucket(name: str, category: str,
+                    enclosing: Sequence[str]) -> str:
+    """Map one kernel to its budget bucket.
+
+    ``enclosing`` is the stack of REGION categories open around the
+    kernel, outermost first (e.g. ``("while",)`` for a step-body kernel,
+    ``("while", "while")`` inside the auction round loop).  The mapping
+    mirrors the r04 human analysis: the repool ``conditional`` is the
+    pool rebuild, nested whiles are the auction round storm, top-k/sort/
+    reduce-window machinery is the selection network, gather chains feed
+    the candidate/``move_vec`` tables, and the rest is the long tail.
+
+    Only the DEVICE dialect passes region context: its per-device
+    timeline nests strictly.  The host-thunk dialect passes ``()`` —
+    XLA:CPU records regions as scheduling-dependent resumption slices,
+    so name-only classification is the deterministic subset there (its
+    whiles land in ``scan_loop``; the auction split needs device data).
+    """
+    if category == "conditional" or "conditional" in enclosing:
+        return "pool_rebuild"
+    whiles = sum(1 for c in enclosing if c == "while")
+    if category == "while":
+        # the outermost while IS the scan step loop; whiles nested inside
+        # it are the auction rounds (self time only — bodies re-bucket)
+        return "auction" if whiles >= 1 else "scan_loop"
+    if whiles >= 2:
+        return "auction"
+    nl = name.lower()
+    root = _name_root(nl)
+    if (category in ("sort", "top-k", "reduce-window")
+            or root in ("sort", "top-k", "topk", "reduce-window")
+            or "top_k" in nl or "topk" in nl or "partial-reduce" in nl):
+        return "grid_topk"
+    if "gather" in nl or category == "gather":
+        return "move_vec_build"
+    return "long_tail"
+
+
+def _is_region_device(e: dict) -> bool:
+    return e.get("args", {}).get("hlo_category") in (
+        "while", "conditional", "fusion root",
+    )
+
+
+def _is_region_thunk(e: dict) -> bool:
+    return _name_root(e.get("name", "")) in ("while", "conditional")
+
+
+def _region_category(e: dict, dialect: str) -> str:
+    if dialect == "device":
+        return e.get("args", {}).get("hlo_category", "?")
+    return _name_root(e.get("name", ""))
+
+
+def _walk_threads(per_thread: Dict[Any, List[dict]], dialect: str,
+                  dur_us: Callable[[dict], float],
+                  is_region: Callable[[dict], bool],
+                  account: Callable[[dict, float, Tuple[str, ...]], None],
+                  ) -> None:
+    """Per-thread interval stack walk: events nest strictly; each event is
+    accounted its duration minus its children's (self time), tagged with
+    the categories of the regions enclosing it."""
+    for evs in per_thread.values():
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: List[Tuple[float, dict]] = []   # (end_ts, event)
+        child_time: List[float] = []
+
+        def close_one() -> None:
+            _end, ev = stack.pop()
+            ct = child_time.pop()
+            enclosing = tuple(
+                _region_category(open_ev, dialect)
+                for _, open_ev in stack if is_region(open_ev)
+            )
+            account(ev, ct, enclosing)
+            if child_time:
+                child_time[-1] += dur_us(ev)
+
+        for e in evs:
+            ts = e["ts"]
+            while stack and ts >= stack[-1][0] - 1e-9:
+                close_one()
+            stack.append((ts + e.get("dur", 0.0), e))
+            child_time.append(0.0)
+        while stack:
+            close_one()
+
+
+def parse_trace(trace_path: str) -> ParsedTrace:
+    """Parse one Chrome-trace (``.trace.json.gz``) into kernel rows with
+    self-time accounting and the per-device split, auto-detecting the
+    profiler dialect (TPU device track vs XLA:CPU thunk stream)."""
+    with gzip.open(trace_path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    device_pids: Dict[int, str] = {}
+    client_threads: Dict[Tuple[int, int], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        name = e.get("args", {}).get("name", "")
+        if e.get("name") == "process_name" \
+                and str(name).startswith("/device:"):
+            device_pids[e["pid"]] = str(name)
+        elif e.get("name") == "thread_name" \
+                and str(name).startswith("tf_XLATfrtCpuClient"):
+            client_threads[(e["pid"], e.get("tid"))] = str(name)
+
+    device_events = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("pid") in device_pids
+        and "hlo_category" in e.get("args", {})
+    ]
+    if device_events:
+        return _parse_device_dialect(device_events, device_pids)
+    thunk_events = [
+        e for e in events
+        if e.get("ph") == "X" and "hlo_op" in e.get("args", {})
+    ]
+    # per-device lanes: each device's execution blocks one PJRT client
+    # thread in "ThunkExecutor::Execute (wait for completion)" for the
+    # execution's wall — ExecuteHelper is only the ~20µs enqueue.
+    # Single-device runs may execute on the caller thread instead, so the
+    # client-thread filter applies only when client threads exist.
+    lane_events = [
+        e for e in events
+        if e.get("ph") == "X"
+        and str(e.get("name", "")).startswith("ThunkExecutor::Execute")
+    ]
+    on_clients = [e for e in lane_events
+                  if (e["pid"], e.get("tid")) in client_threads]
+    return _parse_thunk_dialect(thunk_events, on_clients or lane_events)
+
+
+def _parse_device_dialect(events: List[dict],
+                          device_pids: Dict[int, str]) -> ParsedTrace:
+    parsed = ParsedTrace(dialect="device")
+    agg: Dict[Tuple[str, str], KernelRow] = {}
+    per_device: Dict[str, float] = {}
+
+    def dur_us(e: dict) -> float:
+        return float(e["args"].get("device_duration_ps", 0)) / 1e6
+
+    def account(e: dict, child_us: float,
+                enclosing: Tuple[str, ...]) -> None:
+        args = e.get("args", {})
+        d_us = dur_us(e)
+        self_us = max(0.0, d_us - child_us)
+        category = args.get("hlo_category", "?")
+        bucket = classify_bucket(e["name"], category, enclosing)
+        row = agg.setdefault((e["name"], bucket), KernelRow(
+            name=e["name"], category=category, bucket=bucket,
+            long_name=args.get("long_name", "")[:240],
+        ))
+        row.count += 1
+        row.time_us += self_us
+        row.total_time_us += d_us
+        if not _is_region_device(e):
+            # region events' counters re-aggregate their bodies: leaf only
+            row.bytes += int(args.get("raw_bytes_accessed",
+                                      args.get("bytes_accessed", 0)))
+            row.flops += int(args.get("model_flops", 0) or 0)
+        label = device_pids.get(e["pid"], f"pid-{e['pid']}")
+        per_device[label] = per_device.get(label, 0.0) + self_us
+
+    per_thread: Dict[Any, List[dict]] = {}
+    for e in events:
+        per_thread.setdefault((e["pid"], e["tid"]), []).append(e)
+    _walk_threads(per_thread, "device", dur_us, _is_region_device, account)
+    parsed.rows = list(agg.values())
+    parsed.device_busy_us = per_device
+    return parsed
+
+
+def _parse_thunk_dialect(thunk_events: List[dict],
+                         helper_events: List[dict]) -> ParsedTrace:
+    parsed = ParsedTrace(dialect="host-thunk")
+    agg: Dict[Tuple[str, str], KernelRow] = {}
+
+    # Scope to the DOMINANT hlo_module: the capture window opens while
+    # earlier async-dispatched executables (goal violations, model
+    # upload) may still be draining on the pool, and whether their
+    # straggler thunks land inside the window is a scheduling accident.
+    # The budget being captured is the budget of the scan executable —
+    # keeping only the module that dominates the thunk stream makes the
+    # parse deterministic for a deterministic program.
+    by_module: Dict[str, int] = {}
+    for e in thunk_events:
+        mod = e["args"].get("hlo_module", "")
+        by_module[mod] = by_module.get(mod, 0) + 1
+    if by_module:
+        dominant = max(sorted(by_module), key=lambda k: by_module[k])
+        thunk_events = [e for e in thunk_events
+                        if e["args"].get("hlo_module", "") == dominant]
+
+    # Region nesting by TIME containment, not thread nesting: XLA:CPU's
+    # thunk executor runs a while's body iterations on whatever pool
+    # thread is free, so a body thunk and its region routinely land on
+    # different tids (per-thread stack walks made bucket attribution a
+    # scheduling coin-flip).  A body thunk always executes INSIDE its
+    # region's wall interval, so interval containment is the
+    # thread-independent ground truth; partial overlaps (independent
+    # thunks running concurrently with a region) are simply not
+    # contained and keep their outer context.
+    events = sorted(thunk_events,
+                    key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+    stack: List[Tuple[float, float, str, list]] = []  # (ts, end, cat, child)
+    eps = 1e-9
+    for e in events:
+        ts = float(e["ts"])
+        dur = float(e.get("dur", 0.0))
+        end = ts + dur
+        while stack and stack[-1][1] <= ts + eps:
+            stack.pop()  # fully in the past
+        containing = [r for r in stack if r[0] <= ts + eps
+                      and end <= r[1] + eps]
+        if containing:
+            containing[-1][3].append(dur)  # child of the DEEPEST region
+        category = _name_root(e["name"])
+        # NAME-ONLY bucketing on this dialect: the thunk executor records
+        # a while as resumption slices whose intervals may or may not
+        # span the body (scheduling-dependent), so region context cannot
+        # classify deterministically here — the auction/scan_loop split
+        # needs the device dialect's strict per-device timeline
+        bucket = classify_bucket(e["name"], category, ())
+        row = agg.setdefault((e["name"], bucket), KernelRow(
+            name=e["name"], category=category, bucket=bucket,
+        ))
+        row.count += 1
+        row.total_time_us += dur
+        if _is_region_thunk(e):
+            children: list = []
+            stack.append((ts, end, category, children))
+            # self time settles once the region's children are known
+            row.time_us += dur
+            agg[(e["name"], bucket)] = row
+            e["_cc_row"] = (row, children)
+        else:
+            row.time_us += dur
+    # subtract each region's direct-children time from its self time
+    for e in events:
+        marker = e.pop("_cc_row", None)
+        if marker is not None:
+            row, children = marker
+            row.time_us -= min(sum(children), float(e.get("dur", 0.0)))
+    for row in agg.values():
+        row.time_us = max(0.0, row.time_us)
+    parsed.rows = list(agg.values())
+    # per-device lanes: one PJRT client thread per addressable device;
+    # each lane sums that device's execution-wall intervals
+    lanes: Dict[int, float] = {}
+    for e in helper_events:
+        tid = e.get("tid")
+        lanes[tid] = lanes.get(tid, 0.0) + float(e.get("dur", 0.0))
+    parsed.device_busy_us = {
+        f"cpu-lane-{i}": lanes[tid]
+        for i, tid in enumerate(sorted(lanes))
+    }
+    return parsed
+
+
+# ---- artifact --------------------------------------------------------------------
+def build_artifact(
+    parsed: ParsedTrace,
+    units: int,
+    unit: str = "scan-call",
+    source: str = "live-capture",
+    backend: Optional[str] = None,
+    capture: Optional[dict] = None,
+    fixture: Optional[dict] = None,
+    top: int = _TOP_KERNELS,
+    now: Optional[float] = None,
+) -> dict:
+    """Assemble the ``cc-tpu-kernel-budget/2`` artifact from a parsed
+    trace.  ``units`` is the per-unit divisor: traced while-loop steps for
+    the benchmark (``unit="step"``, the r04 basis), scan calls for a live
+    capture."""
+    units = max(1, int(units))
+    tot_us = parsed.total_time_us
+    tot_bytes = parsed.total_bytes
+    tot_flops = parsed.total_flops
+    by_bucket: Dict[str, dict] = {}
+    by_category: Dict[str, dict] = {}
+    for row in parsed.rows:
+        b = by_bucket.setdefault(
+            row.bucket, {"count": 0, "time_us": 0.0, "bytes": 0})
+        b["count"] += row.count
+        b["time_us"] += row.time_us
+        b["bytes"] += row.bytes
+        c = by_category.setdefault(
+            row.category, {"count": 0, "time_us": 0.0, "bytes": 0})
+        c["count"] += row.count
+        c["time_us"] += row.time_us
+        c["bytes"] += row.bytes
+    rows = sorted(parsed.rows, key=lambda r: -r.time_us)
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    skew = parsed.skew()
+    art = {
+        "schema": SCHEMA,
+        "generated_unix": round(time.time() if now is None else now, 3),
+        "backend": backend,
+        "dialect": parsed.dialect,
+        "source": source,
+        "unit": unit,
+        "units": units,
+        "hw": {"hbm_bytes_per_s": HBM_BYTES_PER_S,
+               "peak_f32_flops": PEAK_F32_FLOPS, "chip": "v5e"},
+        "per_unit": {
+            "kernels": round(parsed.total_count / units, 2),
+            "device_busy_ms": round(tot_us / units / 1e3, 4),
+            "bytes_mb": round(tot_bytes / units / 1e6, 4),
+            "model_gflops": round(tot_flops / units / 1e9, 4),
+            "hbm_floor_ms": round(
+                tot_bytes / units / HBM_BYTES_PER_S * 1e3, 4),
+            "flops_floor_ms": round(
+                tot_flops / units / PEAK_F32_FLOPS * 1e3, 4),
+        },
+        # bytes / busy-time over datasheet bandwidth — the 7.5 % number,
+        # measured (0 on the host-thunk dialect, which has no counters)
+        "hbm_utilization_of_busy": round(
+            (tot_bytes / (tot_us / 1e6)) / HBM_BYTES_PER_S
+            if tot_us else 0.0, 6),
+        "by_bucket": {
+            k: {
+                "count_per_unit": round(v["count"] / units, 2),
+                "us_per_unit": round(v["time_us"] / units, 2),
+                "mb_per_unit": round(v["bytes"] / units / 1e6, 4),
+                "share_of_busy": round(
+                    v["time_us"] / tot_us if tot_us else 0.0, 4),
+            }
+            for k, v in sorted(by_bucket.items(),
+                               key=lambda kv: -kv[1]["time_us"])
+        },
+        "by_category": {
+            k: {
+                "count_per_unit": round(v["count"] / units, 2),
+                "us_per_unit": round(v["time_us"] / units, 2),
+                "mb_per_unit": round(v["bytes"] / units / 1e6, 4),
+            }
+            for k, v in sorted(by_category.items(),
+                               key=lambda kv: -kv[1]["time_us"])
+        },
+        "devices": {
+            "count": len(parsed.device_busy_us),
+            "busy_ms": {
+                k: round(v / 1e3, 4)
+                for k, v in sorted(parsed.device_busy_us.items())
+            },
+            "skew": round(skew, 4) if skew is not None else None,
+        },
+        "kernels": [
+            {
+                "name": r.name,
+                "category": r.category,
+                "bucket": r.bucket,
+                "count_per_unit": round(r.count / units, 2),
+                "us_per_unit": round(r.time_us / units, 3),
+                "mb_per_unit": round(r.bytes / units / 1e6, 5),
+                "gbps": round(r.bytes / (r.time_us / 1e6) / 1e9, 2)
+                if r.time_us else 0.0,
+                "long_name": r.long_name,
+            }
+            for r in rows[:top]
+        ],
+    }
+    if capture is not None:
+        art["capture"] = capture
+    if fixture is not None:
+        art["fixture"] = fixture
+    return art
+
+
+# ---- budget regression gate ------------------------------------------------------
+def compare_budget(artifact: dict, budget: dict) -> List[str]:
+    """Gate a measured artifact against a pinned budget
+    (``tests/budgets/kernel_budget.json``): per-bucket kernel COUNTS and
+    the total may not grow past the budget's ceiling (timings are too
+    host-noisy to pin; counts are deterministic for a fixed program —
+    the same discipline as ``scan_jaxpr_budget.json``).  Shrinkage is an
+    improvement, never a violation.  Returns human-readable violations
+    (empty = gate holds); regenerate an INTENDED change with the
+    ``write_budget()`` regenerator next to the gate test."""
+    tol = 1.0 + float(budget.get("tolerance_pct", 10)) / 100.0
+    out: List[str] = []
+    pinned_fixture = budget.get("fixture") or {}
+    fixture = artifact.get("fixture") or {}
+    for key in sorted(set(pinned_fixture) & set(fixture)):
+        if pinned_fixture[key] != fixture[key]:
+            out.append(
+                f"fixture mismatch on {key!r}: measured "
+                f"{fixture[key]!r} vs budget {pinned_fixture[key]!r} — "
+                "kernel counts only compare at identical shapes"
+            )
+    if out:
+        return out
+    measured_total = float(artifact["per_unit"]["kernels"])
+    budget_total = float(budget["total_kernels_per_unit"])
+    if measured_total > budget_total * tol:
+        out.append(
+            f"total kernels/{artifact['unit']} grew to "
+            f"{measured_total:g} (budget {budget_total:g}, "
+            f"+{budget.get('tolerance_pct', 10)}% ceiling "
+            f"{budget_total * tol:g})"
+        )
+    for bucket, pinned in budget.get("by_bucket", {}).items():
+        ceiling = float(pinned["count_per_unit"]) * tol
+        got = float(
+            artifact["by_bucket"].get(bucket, {}).get("count_per_unit", 0.0)
+        )
+        if got > ceiling:
+            out.append(
+                f"bucket {bucket!r} grew to {got:g} kernels/"
+                f"{artifact['unit']} (budget "
+                f"{pinned['count_per_unit']:g}, ceiling {ceiling:g})"
+            )
+    return out
+
+
+# ---- the capture manager ---------------------------------------------------------
+_IDLE = "IDLE"
+_ARMED = "ARMED"
+_TRACING = "TRACING"
+
+
+class CaptureManager:
+    """On-demand device-kernel capture around drive-loop scan calls.
+
+    State machine (one capture at a time)::
+
+        IDLE --arm()--> ARMED --1st scan_call--> TRACING
+        TRACING --Nth scan_call / search end--> IDLE (+ pending parse)
+
+    The TPU optimizer claims an armed capture at search entry
+    (:meth:`search_scope`) so concurrent searches cannot interleave one
+    trace, and wraps every serial scan dispatch in :meth:`scan_call`.
+    Parsing happens in :meth:`parse_pending`, pumped off the request
+    thread by the SLO observatory's maintenance tick.  All jax imports
+    are call-site lazy; the disarmed fast path is one attribute read.
+    """
+
+    def __init__(self, enabled: bool = True, default_scans: int = 3,
+                 trace_dir: str = "",
+                 clock: Optional[Callable[[], float]] = None,
+                 id_factory: Optional[Callable[[], str]] = None):
+        self.enabled = enabled
+        self.default_scans = max(1, int(default_scans))
+        self.trace_dir = trace_dir
+        self._clock = clock or time.time
+        self._seq = 0
+        self._id_factory = id_factory or self._next_id
+        self._lock = threading.Lock()
+        self._state = _IDLE
+        self._owner: Optional[int] = None
+        self._capture_id: Optional[str] = None
+        self._reason = ""
+        self._scans_requested = 0
+        self._scans_seen = 0
+        self._started = 0.0
+        self._active_dir: Optional[str] = None
+        self._cleanup_dir: Optional[str] = None
+        self._handle: Optional[_ProfilerHandle] = None
+        #: traces waiting for an off-thread parse:
+        #: (trace_dir, cleanup_dir|None, capture meta)
+        self._pending: List[Tuple[str, Optional[str], dict]] = []
+        #: parses popped from the queue and currently running — a poll
+        #: mid-parse must read "in flight", not "never captured"
+        self._parsing = 0
+        self._latest: Optional[dict] = None
+        self.captures = 0
+        self.parse_failures = 0
+        #: scan calls running serially because a capture is active — the
+        #: drive loop reads this once per search (plan identity holds:
+        #: serial and pipelined drive loops produce bit-identical plans)
+        self.capturing = False
+
+    def _next_id(self) -> str:
+        self._seq += 1  # cclint: disable=lock-discipline -- only reachable via self._id_factory, whose call sites (arm, search_scope's legacy claim) hold self._lock
+        return f"capture-{self._seq}"
+
+    # ---- configuration ----------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  default_scans: Optional[int] = None,
+                  trace_dir: Optional[str] = None,
+                  clock: Optional[Callable[[], float]] = None,
+                  id_factory: Optional[Callable[[], str]] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if default_scans is not None:
+                self.default_scans = max(1, int(default_scans))
+            if trace_dir is not None:
+                self.trace_dir = trace_dir
+            if clock is not None:
+                self._clock = clock
+            if id_factory is not None:
+                self._id_factory = id_factory
+
+    def reset(self) -> None:
+        """Drop all state (tests).  An in-flight jax trace, if any, is
+        stopped so the global profiler is reusable."""
+        with self._lock:
+            handle, self._handle = self._handle, None
+            pending, self._pending = self._pending, []
+            self._state = _IDLE
+            self._owner = None
+            self.capturing = False
+            self._latest = None
+            self._seq = 0
+            self.captures = 0
+            self.parse_failures = 0
+        if handle is not None:
+            try:
+                handle.stop(export=False)
+            except Exception:  # backend refused; nothing to recover
+                LOG.exception("kernel-budget trace abort failed")
+        for _dir, cleanup, _meta in pending:
+            self._rm(cleanup)
+
+    @staticmethod
+    def _rm(path: Optional[str]) -> None:
+        if path:
+            shutil.rmtree(path, ignore_errors=True)
+
+    @contextlib.contextmanager
+    def scoped(self, clock: Optional[Callable[[], float]] = None,
+               id_factory: Optional[Callable[[], str]] = None):
+        """Swap in a deterministic clock / capture-id factory for the
+        scope of one scenario run (the simulator injects its virtual
+        clock and a ``sim-capture-N`` counter so journal fingerprints
+        stay bit-stable), resetting capture state and restoring the
+        previous configuration on exit."""
+        with self._lock:
+            prev_clock, prev_factory = self._clock, self._id_factory
+            if clock is not None:
+                self._clock = clock
+            if id_factory is not None:
+                self._id_factory = id_factory
+        try:
+            yield self
+        finally:
+            self.reset()
+            with self._lock:
+                self._clock, self._id_factory = prev_clock, prev_factory
+
+    # ---- arming -----------------------------------------------------------------
+    def arm(self, scans: Optional[int] = None,
+            reason: str = "api") -> dict:
+        """Request a capture of the next ``scans`` drive-loop scan calls.
+        Idempotent while a capture is in flight (the current state is
+        returned either way)."""
+        with self._lock:
+            if self.enabled and self._state == _IDLE:
+                self._state = _ARMED
+                self._owner = None
+                self._capture_id = self._id_factory()
+                self._reason = reason
+                self._scans_requested = max(
+                    1, int(scans) if scans else self.default_scans)
+                self._scans_seen = 0
+        return self.state()
+
+    def state(self) -> dict:
+        """The poll body (202 responses) / diagnostics block."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "state": self._state,
+                "captureId": self._capture_id,
+                "scansRequested": self._scans_requested,
+                "scansTraced": self._scans_seen,
+                "pendingParses": len(self._pending),
+                "activeParses": self._parsing,
+                "captures": self.captures,
+                "parseFailures": self.parse_failures,
+            }
+
+    # ---- optimizer integration --------------------------------------------------
+    @contextlib.contextmanager
+    def search_scope(self, legacy_trace_dir: str = ""):
+        """Wraps ONE engine search.  Claims an armed capture for the
+        calling thread (so its scan calls are the traced ones) and, when
+        the legacy ``tpu.search.profiler.trace.dir`` key is set, traces
+        the WHOLE search into that directory through this single entry
+        point (the old ad-hoc optimizer hook, subsumed) — the resulting
+        trace feeds the same parse queue."""
+        claimed = False
+        legacy = False
+        if legacy_trace_dir:
+            meta = None
+            with self._lock:
+                if self._state == _IDLE:
+                    legacy = True
+                    self._state = _TRACING
+                    self._owner = threading.get_ident()
+                    self._capture_id = self._id_factory()
+                    self._reason = "profiler_trace_dir"
+                    self._scans_requested = 0
+                    self._scans_seen = 0
+                    self._started = self._clock()
+                    self._active_dir = legacy_trace_dir
+                    self._cleanup_dir = None
+                    meta = self._start_meta()
+            if legacy:
+                self._start_jax_trace(legacy_trace_dir, meta)
+        elif self.enabled:
+            with self._lock:
+                if self._state == _ARMED and self._owner is None:
+                    self._owner = threading.get_ident()
+                    claimed = True
+                    self.capturing = True
+        try:
+            yield self
+        finally:
+            if legacy:
+                with self._lock:
+                    legacy_live = self._state == _TRACING \
+                        and self._owner == threading.get_ident()
+                if legacy_live:  # trace start may have failed
+                    self._finish(reason="search-end")
+            elif claimed:
+                with self._lock:
+                    still_mine = self._owner == threading.get_ident() \
+                        and self._state in (_ARMED, _TRACING)
+                    tracing_now = self._state == _TRACING
+                if still_mine:
+                    if tracing_now:
+                        # the search ended before N scan calls landed:
+                        # close the capture with what it got
+                        self._finish(reason="search-end")
+                    else:
+                        # never reached a scan call (score-only path /
+                        # converged instantly): release the claim so the
+                        # next search can serve the armed capture
+                        with self._lock:
+                            self._owner = None
+                            self.capturing = False
+
+    @contextlib.contextmanager
+    def scan_call(self):
+        """Wraps one serial drive-loop scan dispatch (dispatch + device
+        block).  Starts the jax trace before the first traced call and
+        stops it once the requested scan count has been traced.  No-op
+        (one lock-free check) unless this thread owns an armed capture."""
+        if self._owner != threading.get_ident():
+            yield
+            return
+        start_meta = None
+        with self._lock:
+            if self._owner != threading.get_ident():
+                yield
+                return
+            if self._state == _ARMED:
+                self._state = _TRACING
+                self._started = self._clock()
+                base = self.trace_dir or None
+                if base:
+                    os.makedirs(base, exist_ok=True)
+                self._cleanup_dir = tempfile.mkdtemp(
+                    prefix="cc-kernel-budget-", dir=base)
+                self._active_dir = self._cleanup_dir
+                start_meta = self._start_meta()
+                trace_dir = self._active_dir
+            else:
+                trace_dir = None
+        if start_meta is not None:
+            self._start_jax_trace(trace_dir, start_meta)
+        try:
+            yield
+        finally:
+            done = False
+            with self._lock:
+                if self._state == _TRACING \
+                        and self._owner == threading.get_ident():
+                    self._scans_seen += 1
+                    # scansRequested == 0 is the legacy whole-search trace:
+                    # only search_scope exit finishes it
+                    done = (self._scans_requested > 0
+                            and self._scans_seen >= self._scans_requested)
+            if done:
+                self._finish(reason="scans-complete")
+
+    def block(self, value) -> None:
+        """Materialize a traced scan call's outputs INSIDE the capture
+        window.  The drive loop's ``device_span.block`` only blocks when
+        span tracing is enabled; a capture must not depend on that — an
+        unblocked window would stop the trace while the scan still
+        executes, losing its kernels to scheduling luck.  No-op unless
+        this thread's capture is tracing."""
+        if self._state == _TRACING \
+                and self._owner == threading.get_ident():
+            import jax
+
+            jax.block_until_ready(value)
+
+    def _start_meta(self) -> dict:
+        return {
+            "id": self._capture_id,
+            "reason": self._reason,
+            "scansRequested": self._scans_requested,
+            "startedUnix": round(self._started, 3),
+        }
+
+    def _start_jax_trace(self, trace_dir: str, meta: dict) -> None:
+        from cruise_control_tpu.telemetry import events
+
+        try:
+            handle = _ProfilerHandle(trace_dir)
+        except Exception:
+            # a second profiler session (external tooling) must fail the
+            # capture, not the rebalance that carries it
+            LOG.exception("kernel-budget trace start failed")
+            with self._lock:
+                self._state = _IDLE
+                self._owner = None
+                self.capturing = False
+                self._rm(self._cleanup_dir)
+                self._cleanup_dir = None
+            return
+        with self._lock:
+            self._handle = handle
+        events.emit(
+            "profiler.capture.start", captureId=meta["id"],
+            scans=meta["scansRequested"], reason=meta["reason"],
+        )
+
+    def _finish(self, reason: str) -> None:
+        """Stop the jax trace and queue the directory for an off-thread
+        parse."""
+        from cruise_control_tpu.telemetry import events
+
+        with self._lock:
+            handle, self._handle = self._handle, None
+        if handle is not None:
+            try:
+                handle.stop(export=True)
+            except Exception:  # export failed; the parse will report it
+                LOG.exception("kernel-budget trace stop failed")
+        with self._lock:
+            meta = {
+                "id": self._capture_id,
+                "reason": self._reason,
+                "scansRequested": self._scans_requested,
+                "scansTraced": self._scans_seen,
+                "startedUnix": round(self._started, 3),
+                "wallS": round(max(0.0, self._clock() - self._started), 3),
+            }
+            self._pending.append(
+                (self._active_dir, self._cleanup_dir, meta))
+            while len(self._pending) > _MAX_PENDING_PARSES:
+                _dir, cleanup, dropped = self._pending.pop(0)
+                self._rm(cleanup)
+                LOG.warning("kernel-budget parse queue full; dropped "
+                            "capture %s", dropped.get("id"))
+            self._state = _IDLE
+            self._owner = None
+            self.capturing = False
+            self._active_dir = None
+            self._cleanup_dir = None
+            capture_id = meta["id"]
+            scans_traced = meta["scansTraced"]
+        events.emit(
+            "profiler.capture.end", captureId=capture_id,
+            scansTraced=scans_traced, stopReason=reason,
+        )
+
+    # ---- off-thread parse (SLO maintenance tick) --------------------------------
+    def parse_pending(self, max_parses: int = 1) -> int:
+        """Parse up to ``max_parses`` captured traces into artifacts.
+        Chrome-trace parsing is tens of milliseconds to seconds of pure
+        host work — which is why this rides the SLO observatory's
+        maintenance tick (like ``device_cost.capture_pending``), never a
+        request thread.  Returns the number parsed; never raises."""
+        done = 0
+        while done < max_parses:
+            with self._lock:
+                if not self._pending:
+                    return done
+                trace_dir, cleanup_dir, meta = self._pending.pop(0)
+                self._parsing += 1
+            try:
+                parsed = parse_trace(newest_trace(trace_dir))
+                units = max(1, int(meta.get("scansTraced") or 0))
+                artifact = build_artifact(
+                    parsed, units=units, unit="scan-call",
+                    source=("legacy-trace-dir"
+                            if meta.get("reason") == "profiler_trace_dir"
+                            else "live-capture"),
+                    capture=meta, now=self._clock(),
+                )
+                with self._lock:
+                    self._latest = artifact
+                    self.captures += 1
+            except Exception:
+                with self._lock:
+                    self.parse_failures += 1
+                LOG.exception("kernel-budget trace parse failed for "
+                              "capture %s", meta.get("id"))
+            finally:
+                self._rm(cleanup_dir)
+                with self._lock:
+                    self._parsing -= 1
+            done += 1
+        return done
+
+    # ---- readers ----------------------------------------------------------------
+    def latest(self) -> Optional[dict]:
+        with self._lock:
+            return self._latest
+
+    def summary(self) -> dict:
+        """The ``/diagnostics`` merge block: capture state + the latest
+        measured budget (estimates from ``deviceCost`` sit beside it)."""
+        out = self.state()
+        with self._lock:
+            out["latest"] = self._latest
+        return out
+
+    def families(self) -> List[tuple]:
+        """``extra_families`` rows for the Prometheus exposition, from the
+        latest parsed capture: per-bucket busy/count/bytes, the measured
+        HBM utilization, and the per-shard split."""
+        art = self.latest()
+        if art is None:
+            return []
+        fams: List[tuple] = []
+        for fam, key, scale, help_ in (
+            ("cc_kernel_busy_ms", "us_per_unit", 1e-3,
+             "Measured device-kernel self time per scan call, by budget "
+             "bucket (latest capture)"),
+            ("cc_kernel_count", "count_per_unit", 1.0,
+             "Measured kernels per scan call, by budget bucket"),
+            ("cc_kernel_bytes", "mb_per_unit", 1e6,
+             "Measured HBM bytes accessed per scan call, by budget "
+             "bucket (0 on backends without byte counters)"),
+        ):
+            rows = [({"category": bucket}, float(v.get(key, 0.0)) * scale)
+                    for bucket, v in art["by_bucket"].items()]
+            if rows:
+                fams.append((fam, "gauge", help_, rows))
+        fams.append((
+            "cc_kernel_hbm_utilization_measured", "gauge",
+            "Measured HBM-bandwidth utilization of device busy time "
+            "(latest capture; the always-on estimate is "
+            "cc_device_hbm_utilization_estimate)",
+            [({}, float(art["hbm_utilization_of_busy"]))],
+        ))
+        devices = art.get("devices", {})
+        busy = devices.get("busy_ms", {})
+        if busy:
+            fams.append((
+                "cc_shard_busy_ms", "gauge",
+                "Per-device busy time of the latest capture (kernel self "
+                "time on device backends; dispatch wall per PJRT lane on "
+                "host backends)",
+                [({"device": label}, float(ms))
+                 for label, ms in busy.items()],
+            ))
+        if devices.get("skew") is not None:
+            fams.append((
+                "cc_shard_skew", "gauge",
+                "max/mean of per-device busy time (1.0 = level mesh)",
+                [({}, float(devices["skew"]))],
+            ))
+        return fams
+
+    def install_gauges(self, registry) -> None:
+        registry.gauge("kernel.capture.parses.pending",
+                       lambda: float(len(self._pending)))
+        registry.gauge("kernel.capture.count",
+                       lambda: float(self.captures))
+
+
+# ---- the single profiler entry point (benchmarks ride it too) -------------------
+@contextlib.contextmanager
+def profiler_session(trace_dir: str):
+    """Raw ``jax.profiler`` trace context — the repo's ONE place that may
+    start/stop the profiler directly (cclint rule ``profiler-discipline``
+    flags any other call site).  ``benchmarks/kernel_budget.py`` uses
+    this for its offline steps-based budget; the live path goes through
+    :class:`CaptureManager`."""
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+#: process-wide default (bootstrap reconfigures it from the
+#: telemetry.kernel.* keys; the sim swaps in a virtual clock and a
+#: deterministic id factory so scenario fingerprints stay bit-stable)
+CAPTURE = CaptureManager()
+
+
+# module-level conveniences bound to the default instance -------------------------
+def configure(**kwargs) -> None:
+    CAPTURE.configure(**kwargs)
+
+
+def arm(scans: Optional[int] = None, reason: str = "api") -> dict:
+    return CAPTURE.arm(scans=scans, reason=reason)
+
+
+def parse_pending(max_parses: int = 1) -> int:
+    return CAPTURE.parse_pending(max_parses)
+
+
+def latest() -> Optional[dict]:
+    return CAPTURE.latest()
+
+
+def install_gauges(registry) -> None:
+    CAPTURE.install_gauges(registry)
+
+
+def reset() -> None:
+    CAPTURE.reset()

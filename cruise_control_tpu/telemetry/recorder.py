@@ -34,6 +34,7 @@ Artifact schema (``SCHEMA``):
       "journal": [<cc-tpu-events/1 decision records, when attached>],
       "traces": [<trace.TraceStore.index() summaries, when attached>],
       "deviceStats": {<device_stats.MONITOR.summary()>},
+      "kernelBudget": {<kernel_budget.CAPTURE.summary()>, when attached},
       ...extra keys the dump path merges in ("dumpReason")
     }
 
@@ -84,6 +85,7 @@ class FlightRecorder:
         device_stats_source: Optional[Callable[[], dict]] = None,
         events_source: Optional[Callable[[], List[dict]]] = None,
         traces_source: Optional[Callable[[], List[dict]]] = None,
+        kernel_budget_source: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.interval_s = max(0.01, float(interval_s))
@@ -100,6 +102,10 @@ class FlightRecorder:
         #: into the artifact as `traces` (an incident dump names the
         #: correlation ids an operator can pull via GET /trace?id=)
         self.traces_source = traces_source
+        #: telemetry/kernel_budget.CAPTURE.summary — the measured device-
+        #: kernel budget (latest parsed capture + capture state) merged as
+        #: `kernelBudget`, beside deviceStats.deviceCost's estimates
+        self.kernel_budget_source = kernel_budget_source
         self._lock = threading.Lock()
         self._series: Dict[str, deque] = {}
         self._prev_cum: Dict[str, float] = {}
@@ -222,6 +228,11 @@ class FlightRecorder:
                 out["traces"] = list(self.traces_source())
             except Exception:  # pragma: no cover - defensive
                 LOG.exception("flight-recorder traces source failed")
+        if self.kernel_budget_source is not None:
+            try:
+                out["kernelBudget"] = self.kernel_budget_source()
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("flight-recorder kernel-budget source failed")
         if extra:
             out.update(extra)
         return out

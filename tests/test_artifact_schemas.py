@@ -244,9 +244,21 @@ def _scenario_artifact():
     return [make_artifact([run_scenario(spec)])]
 
 
+def _kernel_budget_artifacts():
+    """The live producer: a REAL capture of the scan program at the tiny
+    pinned fixture (shared — and session-cached — with
+    tests/test_kernel_budget.py, so one capture serves both suites)."""
+    import test_kernel_budget as tkb
+
+    art = tkb._live_capture()["artifact"]
+    assert art is not None
+    return [art]
+
+
 @pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
                                       "events", "scenarios", "checkpoint",
-                                      "slo", "trace", "soak"])
+                                      "slo", "trace", "soak",
+                                      "kernel-budget"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     if producer == "phase-profile":
         arts = _phase_profile_artifact()
@@ -266,6 +278,9 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "trace":
         arts = _trace_artifact()
         schema = SCHEMAS["cc-tpu-trace/1"]
+    elif producer == "kernel-budget":
+        arts = _kernel_budget_artifacts()
+        schema = SCHEMAS["cc-tpu-kernel-budget/2"]
     elif producer == "soak":
         arts = _soak_artifact()
         schema = SCHEMAS["cc-tpu-soak/1"]

@@ -735,6 +735,68 @@ PACKAGE_FIXTURES = {
             },
         ],
     },
+    "profiler-discipline": {
+        "positive": [
+            # the raw dotted call anywhere outside the observatory
+            {
+                "pkg/engine.py": (
+                    "import jax\n"
+                    "def search(trace_dir):\n"
+                    "    with jax.profiler.trace(trace_dir):\n"
+                    "        return 1\n"
+                ),
+            },
+            # module alias + session start/stop
+            {
+                "pkg/bench.py": (
+                    "import jax.profiler as prof\n"
+                    "def run(d):\n"
+                    "    prof.start_trace(d)\n"
+                    "    prof.stop_trace()\n"
+                ),
+            },
+            # direct-name import of the session API
+            {
+                "pkg/probe.py": (
+                    "from jax.profiler import start_trace\n"
+                    "def go(d):\n"
+                    "    start_trace(d)\n"
+                ),
+            },
+        ],
+        "negative": [
+            # the single entry point itself is exempt by path
+            {
+                "pkg/telemetry/__init__.py": "",
+                "pkg/telemetry/kernel_budget.py": (
+                    "import jax\n"
+                    "def profiler_session(trace_dir):\n"
+                    "    return jax.profiler.trace(trace_dir)\n"
+                ),
+            },
+            # non-session profiler helpers are out of scope
+            {
+                "pkg/spans.py": (
+                    "import jax\n"
+                    "def note(name):\n"
+                    "    jax.profiler.annotate_trace_event(name)\n"
+                ),
+            },
+            # routing through the observatory is the prescribed shape
+            {
+                "pkg/driver.py": (
+                    "from pkg.telemetry import kernel_budget\n"
+                    "def capture(n):\n"
+                    "    return kernel_budget.arm(scans=n)\n"
+                ),
+                "pkg/telemetry/__init__.py": "",
+                "pkg/telemetry/kernel_budget.py": (
+                    "def arm(scans):\n"
+                    "    return {'scans': scans}\n"
+                ),
+            },
+        ],
+    },
     "journal-schema": {
         "positive": [
             # unregistered kind + undeclared field + bad severity
@@ -1241,6 +1303,18 @@ MUTATIONS = {
         "cruise_control_tpu/sim/simulator.py",
         "sim.now_ms = now  # injected clocks (the breaker) read this",
         "sim.now_ms = int(time.time() * 1000)",
+    ),
+    # ISSUE 14 satellite: a raw profiler-session call planted back into
+    # the optimizer's drive loop — the exact ad-hoc hole the kernel
+    # observatory's single entry point closed — must be caught
+    "profiler-discipline-optimizer": (
+        "profiler-discipline",
+        "cruise_control_tpu/analyzer/tpu_optimizer.py",
+        "                if inflight:\n"
+        "                    packed, m_new, tab_new = inflight.pop(0)",
+        "                jax.profiler.start_trace(\"/tmp/cc-mutation\")\n"
+        "                if inflight:\n"
+        "                    packed, m_new, tab_new = inflight.pop(0)",
     ),
 }
 
