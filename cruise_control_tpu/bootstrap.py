@@ -591,6 +591,15 @@ def build_app(
             watchdog_stuck_ticks=cfg.get_int(
                 "execution.watchdog.stuck.ticks"
             ),
+            foreign_conflict_policy=cfg.get(
+                "execution.foreign.conflict.policy"
+            ),
+            foreign_yield_backoff_ticks=cfg.get_int(
+                "execution.foreign.yield.backoff.ticks"
+            ),
+            revalidate_preconditions=cfg.get_boolean(
+                "execution.revalidate.preconditions"
+            ),
         ),
         notifier=cfg.get_configured_instance("executor.notifier.class"),
         default_strategy=_movement_strategy(cfg),
@@ -771,6 +780,9 @@ def build_app(
         ),
         disk_failure_min_offline_dirs=cfg.get_int(
             "disk.failure.min.offline.dirs"
+        ),
+        foreign_reassignment_min_cycles=cfg.get_int(
+            "foreign.reassignment.detection.min.cycles"
         ),
         detection_interval_ms=cfg.get("anomaly.detection.interval.ms"),
         per_type_interval_ms=_per_type_detector_intervals(cfg),

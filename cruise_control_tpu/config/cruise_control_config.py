@@ -600,6 +600,30 @@ def default_config_def() -> ConfigDef:
              "dispatch or completion, stop dispatching; after twice this "
              "many, abort in-flight moves and journal "
              "execution.unrecoverable (0 disables).", at_least(0), G)
+    d.define("execution.foreign.conflict.policy", ConfigType.STRING, "yield",
+             Importance.MEDIUM,
+             "What a planned task does when a FOREIGN reassignment "
+             "(another controller, kafka-reassign-partitions) touches its "
+             "partition mid-flight. 'yield': the task steps aside and "
+             "retries after the foreign move drains (cancelled "
+             "foreign-conflict when the retry budget is spent); 'abort': "
+             "the whole plan aborts partial-gracefully on first conflict. "
+             "Disjoint foreign moves are always tolerated and fed to the "
+             "ConcurrencyAdjuster as external URPs.",
+             one_of("yield", "abort"), G)
+    d.define("execution.foreign.yield.backoff.ticks", ConfigType.INT, 4,
+             Importance.LOW,
+             "Ticks a yielded (pre-dispatch) task waits before re-checking "
+             "its partition for foreign reassignment activity.",
+             at_least(1), G)
+    d.define("execution.revalidate.preconditions", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM,
+             "Per-batch topology revalidation: verify each task against "
+             "live metadata before its alterPartitionReassignments and "
+             "cancel stale tasks with categorical reasons "
+             "(topology-drift:deleted / topology-drift:rf-changed / "
+             "foreign-conflict) instead of burning the retry budget on "
+             "generic replica-mismatch failures.", None, G)
     d.define("default.replication.throttle", ConfigType.DOUBLE, None,
              Importance.MEDIUM, "Replication throttle (bytes/s); None = off.",
              None, G)
@@ -672,6 +696,14 @@ def default_config_def() -> ConfigDef:
     d.define("self.healing.maintenance.event.enabled", ConfigType.BOOLEAN,
              None, Importance.MEDIUM,
              "Per-type override of self.healing.enabled.", None, G)
+    d.define("foreign.reassignment.detection.min.cycles", ConfigType.INT, 3,
+             Importance.LOW,
+             "Consecutive detection cycles a reassignment not owned by "
+             "this executor must persist before a FOREIGN_REASSIGNMENT "
+             "anomaly surfaces (alert-only: concurrent-writer overlap is "
+             "handled by execution fencing and the per-task yield "
+             "machinery, never by cancelling someone else's moves).",
+             at_least(1), G)
     d.define("broker.failure.alert.threshold.ms", ConfigType.LONG, 900_000,
              Importance.MEDIUM, "Broker-down time before alerting.",
              at_least(0), G)

@@ -23,6 +23,7 @@ class AnomalyType(enum.Enum):
     METRIC_ANOMALY = "METRIC_ANOMALY"
     TOPIC_ANOMALY = "TOPIC_ANOMALY"
     MAINTENANCE_EVENT = "MAINTENANCE_EVENT"
+    FOREIGN_REASSIGNMENT = "FOREIGN_REASSIGNMENT"
 
 
 _ids = itertools.count()
@@ -143,6 +144,36 @@ class MetricAnomaly(Anomaly):
         self.metric = metric
         self.current = current
         self.threshold = threshold
+
+    @property
+    def fixable(self) -> bool:
+        return False
+
+    def fix(self, cruise_control, progress=None):
+        return None
+
+
+class ForeignReassignments(Anomaly):
+    """Persistent reassignment activity that is NOT ours: another
+    controller (a second cruise-control instance, a raw
+    kafka-reassign-partitions run, an operator script) keeps moving
+    replicas on the cluster we manage.  Alert-only: the safe reaction to
+    a concurrent writer is to surface it and let the executor's fencing
+    and per-task yield machinery handle the overlap — auto-"fixing" by
+    cancelling someone else's moves would start a reassignment war."""
+
+    anomaly_type = AnomalyType.FOREIGN_REASSIGNMENT
+
+    def __init__(self, detected_ms: int, partitions: Sequence[int],
+                 persisted_cycles: int):
+        super().__init__(
+            detected_ms,
+            f"foreign reassignments on {len(list(partitions))} partition(s) "
+            f"persisting {persisted_cycles} detection cycle(s): "
+            f"{sorted(partitions)[:20]}",
+        )
+        self.partitions = sorted(partitions)
+        self.persisted_cycles = persisted_cycles
 
     @property
     def fixable(self) -> bool:

@@ -23,6 +23,7 @@ from cruise_control_tpu.detector.anomalies import (
     Anomaly,
     BrokerFailures,
     DiskFailures,
+    ForeignReassignments,
     GoalViolations,
     MaintenanceEvent,
     MetricAnomaly,
@@ -158,6 +159,54 @@ class DiskFailureDetector:
         if not offline:
             return []
         return [DiskFailures(now_ms, offline)]
+
+
+class ForeignReassignmentDetector:
+    """Persistent reassignment activity not owned by OUR executor
+    (ISSUE 15): each detection cycle diffs the backend's ongoing
+    reassignments against the executor's in-flight/adopted set; a
+    partition that stays foreign for ``min_consecutive_cycles``
+    consecutive cycles surfaces a FOREIGN_REASSIGNMENT anomaly
+    (alert-only by default — see :class:`ForeignReassignments`).
+    Transient foreign activity (a quick manual move that drains within a
+    cycle or two) is tolerated silently, exactly like the executor's own
+    mid-flight reconciliation tolerates disjoint foreign moves."""
+
+    def __init__(self, cruise_control, backend,
+                 min_consecutive_cycles: int = 3):
+        self.cc = cruise_control
+        self.backend = backend
+        #: foreign.reassignment.detection.min.cycles: consecutive cycles a
+        #: foreign reassignment must persist before it pages
+        self.min_consecutive_cycles = max(1, int(min_consecutive_cycles))
+        self._streak: Dict[int, int] = {}
+
+    def _owned_partitions(self) -> set:
+        ex = self.cc.executor
+        owned = set(ex.adopted_at_startup)
+        planner = ex.planner
+        if ex.has_ongoing_execution and planner is not None:
+            owned.update(t.proposal.partition for t in planner.replica_tasks)
+        return owned
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        probe = getattr(self.backend, "ongoing_reassignments", None)
+        if probe is None:
+            return []
+        foreign = set(probe()) - self._owned_partitions()
+        for p in list(self._streak):
+            if p not in foreign:
+                del self._streak[p]
+        for p in foreign:
+            self._streak[p] = self._streak.get(p, 0) + 1
+        persistent = {
+            p: n for p, n in self._streak.items()
+            if n >= self.min_consecutive_cycles
+        }
+        if not persistent:
+            return []
+        return [ForeignReassignments(now_ms, sorted(persistent),
+                                     max(persistent.values()))]
 
 
 class PercentileMetricAnomalyFinder:

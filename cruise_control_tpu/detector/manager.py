@@ -27,6 +27,7 @@ ANOMALY_PRIORITY = {
     AnomalyType.METRIC_ANOMALY: 3,
     AnomalyType.GOAL_VIOLATION: 4,
     AnomalyType.TOPIC_ANOMALY: 5,
+    AnomalyType.FOREIGN_REASSIGNMENT: 6,
 }
 from cruise_control_tpu.detector.notifier import (
     AnomalyNotificationResult,
@@ -279,12 +280,14 @@ def make_detector_manager(
     goal_violation_threshold_multiplier: float = 1.0,
     topic_anomaly_min_bad_partitions: int = 1,
     disk_failure_min_offline_dirs: int = 1,
+    foreign_reassignment_min_cycles: int = 3,
     **kwargs,
 ) -> AnomalyDetectorManager:
     """Assemble the full upstream detector set for a facade instance."""
     from cruise_control_tpu.detector.detectors import (
         BrokerFailureDetector,
         DiskFailureDetector,
+        ForeignReassignmentDetector,
         GoalViolationDetector,
         MaintenanceEventDetector,
         MetricAnomalyDetector,
@@ -311,6 +314,12 @@ def make_detector_manager(
         detectors[AnomalyType.DISK_FAILURE] = DiskFailureDetector(
             cruise_control, backend,
             min_offline_dirs=disk_failure_min_offline_dirs,
+        )
+        detectors[AnomalyType.FOREIGN_REASSIGNMENT] = (
+            ForeignReassignmentDetector(
+                cruise_control, backend,
+                min_consecutive_cycles=foreign_reassignment_min_cycles,
+            )
         )
     if target_rf is not None:
         detectors[AnomalyType.TOPIC_ANOMALY] = TopicAnomalyDetector(

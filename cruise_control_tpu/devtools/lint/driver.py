@@ -59,6 +59,9 @@ from cruise_control_tpu.devtools.lint.rules_deadline import (
 from cruise_control_tpu.devtools.lint.rules_except import (
     SwallowedExceptionRule,
 )
+from cruise_control_tpu.devtools.lint.rules_fenced import (
+    FencedBackendDisciplineRule,
+)
 from cruise_control_tpu.devtools.lint.rules_jax import JaxHotPathRule
 from cruise_control_tpu.devtools.lint.rules_lock import LockDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_obs import ObsDynamicNameRule
@@ -94,6 +97,7 @@ RULES = {
         JournalSchemaRule(),
         WallClockDisciplineRule(),
         ProfilerDisciplineRule(),
+        FencedBackendDisciplineRule(),
     )
 }
 

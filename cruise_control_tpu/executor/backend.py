@@ -13,7 +13,23 @@ equivalent).  A real-Kafka adapter implements the same interface out of tree.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+
+class StaleControllerEpochError(RuntimeError):
+    """A mutating admin call presented a controller epoch older than the
+    cluster-registered one: another controller took over since this
+    process claimed ownership.  The caller is a zombie and must stop —
+    loudly — instead of double-moving replicas."""
+
+    def __init__(self, op: str, presented: int, registered: int):
+        super().__init__(
+            f"stale controller epoch on {op}: presented {presented}, "
+            f"cluster has {registered}"
+        )
+        self.op = op
+        self.presented = presented
+        self.registered = registered
 
 
 @dataclasses.dataclass
@@ -89,6 +105,146 @@ class ClusterBackend:
     def under_replicated_partitions(self) -> Set[int]:
         raise NotImplementedError
 
+    # ---- execution fencing (optional capability) --------------------------------
+    # A cluster-side controller epoch (the moral equivalent of Kafka's
+    # controller epoch / ZK czxid fencing): claiming bumps it atomically,
+    # every mutating admin call presents the claimant's epoch, and a
+    # presented epoch older than the registered one is refused.  Backends
+    # without the capability leave these unimplemented — the fenced
+    # wrapper then degrades to unfenced (single-writer-by-assumption)
+    # operation.
+    def controller_epoch(self) -> int:
+        """The currently registered controller epoch (0 = never claimed)."""
+        raise NotImplementedError
+
+    def claim_controller_epoch(self, expected: Optional[int] = None) -> int:
+        """Atomically bump and return the controller epoch.  With
+        ``expected``, the claim is conditional (compare-and-swap): it
+        succeeds only while the registered epoch still equals
+        ``expected`` — the seam that refuses a zombie resume after a
+        newer process already took the checkpoint over."""
+        raise NotImplementedError
+
+    def verify_controller_epoch(self, epoch: int) -> None:
+        """Refuse (raise StaleControllerEpochError) when ``epoch`` is
+        older than the registered controller epoch."""
+        raise NotImplementedError
+
+    def reassignment_targets(self) -> Dict[int, List[int]]:
+        """partition → target replica list of every in-flight reassignment
+        (upstream listPartitionReassignments exposes adding/removing
+        replicas, from which the target is derivable).  Optional: the
+        executor's foreign-conflict detection degrades to mismatch-only
+        without it."""
+        raise NotImplementedError
+
+
+class FencedClusterBackend:
+    """The executor's write path: every MUTATING admin call first presents
+    the owner's controller epoch to the inner backend
+    (:meth:`ClusterBackend.verify_controller_epoch`), so a zombie process
+    — one that claimed the epoch long ago and thawed after a newer
+    process took over — is refused at the cluster seam instead of
+    double-moving replicas.  Refusals journal ``executor.fenced`` before
+    raising.  Reads delegate unchanged; inner backends without the epoch
+    capability degrade to unfenced pass-through.
+
+    The project discipline (cclint ``fenced-backend-discipline``): outside
+    the backend implementations themselves, mutating admin calls may only
+    be made through an instance of this wrapper (the executor's
+    ``self.backend``)."""
+
+    def __init__(self, inner: ClusterBackend,
+                 epoch_source: Callable[[], int]):
+        self.inner = inner
+        #: the owner's current epoch (the executor's claim)
+        self.epoch_source = epoch_source
+        self._fence_supported: Optional[bool] = None
+
+    def __getattr__(self, name: str):
+        # read-only surface (partition_state, alive_brokers, tick, the
+        # scripted backend's fault hooks, ...) delegates untouched; only
+        # the mutating methods defined below go through the fence
+        return getattr(self.inner, name)
+
+    def _present(self, op: str) -> None:
+        """Present the owner's epoch; StaleControllerEpochError journals
+        ``executor.fenced`` and propagates (the zombie must stop)."""
+        if self._fence_supported is None:
+            self._fence_supported = hasattr(
+                type(self.inner), "verify_controller_epoch"
+            ) and type(self.inner).verify_controller_epoch is not (
+                ClusterBackend.verify_controller_epoch
+            )
+        if not self._fence_supported:
+            return
+        from cruise_control_tpu.telemetry import events
+
+        try:
+            self.inner.verify_controller_epoch(self.epoch_source())
+        except StaleControllerEpochError as e:
+            events.emit(
+                "executor.fenced", severity="ERROR", op=op,
+                presentedEpoch=e.presented, clusterEpoch=e.registered,
+            )
+            raise
+
+    def claim(self, expected: Optional[int] = None) -> Optional[int]:
+        """Claim ownership: bump the cluster epoch (conditionally, with
+        ``expected``).  Returns the claimed epoch, or None when the inner
+        backend has no epoch capability.  A refused conditional claim
+        journals ``executor.fenced`` and raises."""
+        claim = getattr(self.inner, "claim_controller_epoch", None)
+        if claim is None:
+            return None
+        from cruise_control_tpu.telemetry import events
+
+        try:
+            return claim(expected)
+        except StaleControllerEpochError as e:
+            events.emit(
+                "executor.fenced", severity="ERROR", op="claim",
+                presentedEpoch=e.presented, clusterEpoch=e.registered,
+            )
+            raise
+        except NotImplementedError:
+            return None
+
+    # ---- fenced mutations -------------------------------------------------------
+    def alter_partition_reassignments(
+        self, reassignments: Dict[int, Sequence[int]]
+    ) -> None:
+        self._present("alter_partition_reassignments")
+        self.inner.alter_partition_reassignments(reassignments)
+
+    def elect_leaders(self, partitions: Dict[int, int]) -> None:
+        self._present("elect_leaders")
+        self.inner.elect_leaders(partitions)
+
+    def alter_replica_log_dirs(
+        self, moves: Dict[int, Dict[int, str]]
+    ) -> None:
+        self._present("alter_replica_log_dirs")
+        self.inner.alter_replica_log_dirs(moves)
+
+    def cancel_reassignments(self, partitions: Sequence[int]) -> None:
+        self._present("cancel_reassignments")
+        self.inner.cancel_reassignments(partitions)
+
+    def set_throttles(self, rate: float, partitions: Sequence[int]) -> None:
+        self._present("set_throttles")
+        self.inner.set_throttles(rate, partitions)
+
+    def clear_throttles(self) -> None:
+        self._present("clear_throttles")
+        self.inner.clear_throttles()
+
+    def alter_config(
+        self, scope: str, entity: int, updates: Dict[str, Optional[str]]
+    ) -> None:
+        self._present("alter_config")
+        self.inner.alter_config(scope, entity, updates)
+
 
 class SimulatedClusterBackend(ClusterBackend):
     """Deterministic in-memory cluster.
@@ -139,6 +295,8 @@ class SimulatedClusterBackend(ClusterBackend):
         #: replicas on a broker with offline dirs are treated as offline
         #: (conservative, matches losing the whole JBOD mount set).
         self.replica_dir: Dict[Tuple[int, int], str] = {}
+        #: cluster-registered controller epoch (execution fencing)
+        self._controller_epoch = 0
         self.ticks = 0
 
     def offline_log_dirs(self) -> Dict[int, List[str]]:
@@ -170,12 +328,54 @@ class SimulatedClusterBackend(ClusterBackend):
             if dead and not self._healthy_dirs(b)
         }
 
+    # ---- execution fencing ------------------------------------------------------
+    def controller_epoch(self) -> int:
+        return self._controller_epoch
+
+    def claim_controller_epoch(self, expected: Optional[int] = None) -> int:
+        if expected is not None and self._controller_epoch != expected:
+            raise StaleControllerEpochError(
+                "claim_controller_epoch", expected, self._controller_epoch
+            )
+        self._controller_epoch += 1
+        return self._controller_epoch
+
+    def verify_controller_epoch(self, epoch: int) -> None:
+        if epoch < self._controller_epoch:
+            raise StaleControllerEpochError(
+                "verify", epoch, self._controller_epoch
+            )
+
+    def reassignment_targets(self) -> Dict[int, List[int]]:
+        return {p: list(new) for p, (new, _, _) in self._target.items()}
+
+    # ---- topology mutation (create/delete topic drift) --------------------------
+    def create_partitions(
+        self, assignment: Dict[int, Sequence[int]], leaders: Dict[int, int]
+    ) -> None:
+        """New partitions appear in metadata (topic creation mid-flight)."""
+        for p, reps in assignment.items():
+            self.partitions[p] = PartitionState(list(reps), leaders[p])
+
+    def delete_partitions(self, partitions: Sequence[int]) -> None:
+        """Partitions vanish from metadata (topic deletion mid-flight):
+        any in-flight reassignment for them evaporates with the data."""
+        for p in list(partitions):
+            self.partitions.pop(p, None)
+            self._target.pop(p, None)
+            self._progress.pop(p, None)
+            self.fail_partitions.discard(p)
+            for key in [k for k in self.replica_dir if k[0] == p]:
+                del self.replica_dir[key]
+
     # ---- admin surface ----------------------------------------------------------
     def alter_partition_reassignments(
         self, reassignments: Dict[int, Sequence[int]]
     ) -> None:
         for p, new_replicas in reassignments.items():
-            st = self.partitions[p]
+            st = self.partitions.get(p)
+            if st is None:
+                continue  # upstream: UNKNOWN_TOPIC_OR_PARTITION, per-partition
             if p in self.fail_partitions:
                 continue  # silently dropped; executor will time out → DEAD
             new = list(new_replicas)

@@ -30,7 +30,11 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence, Set
 
 from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
-from cruise_control_tpu.executor.backend import ClusterBackend
+from cruise_control_tpu.executor.backend import (
+    ClusterBackend,
+    FencedClusterBackend,
+    StaleControllerEpochError,
+)
 from cruise_control_tpu.executor.concurrency import ConcurrencyAdjuster
 from cruise_control_tpu.executor.journal import (
     ExecutionCheckpoint,
@@ -113,6 +117,25 @@ class ExecutorConfig:
     #: this many, abort in-flight moves and journal
     #: ``execution.unrecoverable`` (0 disables)
     watchdog_stuck_ticks: int = 0
+    #: execution.foreign.conflict.policy: what a planned task does when a
+    #: FOREIGN reassignment (another controller / kafka-reassign-partitions)
+    #: touches its partition mid-flight.  "yield": the task steps aside —
+    #: postponed (pre-dispatch) or retried after backoff (in-flight) while
+    #: the foreign move drains, cancelled ``foreign-conflict`` when the
+    #: retry budget is spent.  "abort": the whole plan aborts partial-
+    #: gracefully on first conflict.  Disjoint foreign moves are always
+    #: tolerated (journaled + fed to the ConcurrencyAdjuster as external
+    #: URPs).
+    foreign_conflict_policy: str = "yield"
+    #: ticks a yielded (pre-dispatch) task waits before re-checking its
+    #: partition for foreign activity
+    foreign_yield_backoff_ticks: int = 4
+    #: per-batch topology revalidation: verify each task's preconditions
+    #: against live metadata before its alterPartitionReassignments
+    #: (partition exists, RF unchanged, no foreign move in flight) and
+    #: cancel stale tasks with categorical reasons instead of burning the
+    #: retry budget on generic replica-mismatch failures
+    revalidate_preconditions: bool = True
 
 
 @dataclasses.dataclass
@@ -143,7 +166,13 @@ class Executor:
         default_strategy: Optional[ReplicaMovementStrategy] = None,
         journal: Optional[ExecutionJournal] = None,
     ):
-        self.backend = backend
+        #: every mutating admin call goes through the fenced wrapper: it
+        #: presents ``self.epoch`` to the cluster so a zombie process is
+        #: refused at the seam (reads delegate straight through)
+        self.backend = (
+            backend if isinstance(backend, FencedClusterBackend)
+            else FencedClusterBackend(backend, lambda: self.epoch)
+        )
         self.config = config or ExecutorConfig()
         self.notifier = notifier
         #: default.replica.movement.strategies: ordering used when the caller
@@ -182,6 +211,26 @@ class Executor:
         self._retries_scheduled = 0
         #: last recovery outcome for /state (None = never recovered)
         self._last_recovery: Optional[dict] = None
+        #: controller epoch this process holds (0 = never claimed); minted
+        #: cluster-side per execution/resume, stamped on every checkpoint
+        #: record, presented on every mutating backend call
+        self.epoch = 0
+        #: epoch recorded in the checkpoint recovery last loaded — the
+        #: "ours vs foreign" discriminator for detect_ongoing_at_startup
+        self.last_checkpoint_epoch: Optional[int] = None
+        #: per-execution topology-drift / foreign-activity counters
+        #: (surfaced in executor.end and /state)
+        self._drift: Dict[str, int] = {
+            "deleted": 0, "rfChanged": 0, "foreignConflict": 0,
+            "foreignObserved": 0,
+        }
+        #: foreign partitions already journaled this execution (one
+        #: executor.foreign_reassignment record per partition, not per tick)
+        self._foreign_seen: Set[int] = set()
+        #: plan-abort reason (foreign-conflict) — the stop path journals it
+        self._abort_reason: Optional[str] = None
+        #: lazily probed: does the backend expose reassignment_targets()?
+        self._targets_supported: Optional[bool] = None
 
     # ---- public API -------------------------------------------------------------
     @property
@@ -193,21 +242,71 @@ class Executor:
         if self.has_ongoing_execution:
             self._stop_requested = True
 
-    def detect_ongoing_at_startup(self, stop: bool = False) -> Set[int]:
+    def _cluster_epoch(self) -> Optional[int]:
+        """The cluster-registered controller epoch, or None when the
+        backend has no fencing capability."""
+        probe = getattr(self.backend, "controller_epoch", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except NotImplementedError:
+            return None
+
+    def detect_ongoing_at_startup(
+        self, stop: bool = False, checkpoint_epoch: Optional[int] = None,
+    ) -> Set[int]:
         """Upstream executor recovery (SURVEY.md §5.4c): on startup, detect
-        reassignments already in flight in the cluster (e.g. a previous
-        instance died mid-execution).  Returns the partitions involved;
-        with ``stop=True`` the backend is told to cancel them, otherwise
-        they are left to finish under the cluster's own control and the
-        executor simply refuses to start a new plan until they drain
-        (``has_ongoing_execution`` stays authoritative for OUR plans —
-        adopted work is surfaced via state()).
+        reassignments already in flight in the cluster.  Returns the
+        partitions involved.
+
+        Ours vs foreign is decided by CHECKPOINT EPOCH MATCH, not arrival
+        order: when the cluster-registered controller epoch still equals
+        the epoch recorded in our execution checkpoint
+        (``checkpoint_epoch``, defaulting to the last loaded checkpoint's),
+        no other controller claimed the cluster since our previous
+        instance died — the moves are OURS.  A higher cluster epoch means
+        another controller took over: the moves are FOREIGN.  Without
+        epoch information on either side the legacy arrival-order
+        behavior applies.
+
+        The adopt/stop matrix:
+
+        * ours, ``stop=False`` → adopt and gate until drained;
+        * ours, ``stop=True`` → cancel (they are ours to kill);
+        * foreign, ``stop=True`` → REFUSED: cancelling a live
+          controller's work starts a reassignment war — adopt/gate and
+          journal ``executor.foreign_reassignment`` instead;
+        * foreign, ``stop=False`` → adopt/gate + journal;
+        * unknown epoch → legacy: ``stop`` cancels, otherwise adopt.
 
         Checkpoint-based recovery (:meth:`resume`) runs BEFORE this: moves
         belonging to a recovered checkpoint are ours, not foreign.
         """
         ongoing = set(self.backend.ongoing_reassignments())
-        if ongoing and stop:
+        if not ongoing:
+            self.adopted_at_startup = set()
+            return ongoing
+        if checkpoint_epoch is None:
+            checkpoint_epoch = self.last_checkpoint_epoch
+        cluster_epoch = self._cluster_epoch()
+        known = (checkpoint_epoch is not None and checkpoint_epoch > 0
+                 and cluster_epoch is not None)
+        ours = known and cluster_epoch == checkpoint_epoch
+        foreign = known and not ours
+        if foreign:
+            events.emit(
+                "executor.foreign_reassignment", severity="WARNING",
+                conflict=False, origin="startup",
+                policy=self.config.foreign_conflict_policy,
+                partitions=sorted(ongoing)[:200],
+            )
+        if stop and not foreign:
+            # cancelling is a WRITE: take ownership first (conditionally,
+            # when we know the checkpoint epoch — the CAS proves nobody
+            # else claimed the cluster between the epoch check above and
+            # this cancel)
+            self._claim_epoch(expected=checkpoint_epoch if ours else None)
             # probe support first so a method that EXISTS but raises (a real
             # backend bug, possibly AttributeError internally) still
             # propagates instead of being mistaken for "unsupported"
@@ -253,6 +352,10 @@ class Executor:
                 )
         self.state = ExecutorStateValue.STARTING_EXECUTION
         self._stop_requested = False
+        self._reset_drift()
+        # take ownership: mint a fresh controller epoch cluster-side (any
+        # other controller still writing is fenced at its next call)
+        self._claim_epoch()
         sizes = partition_sizes or {}
         planner = ExecutionTaskPlanner(strategy or self.default_strategy)
         planner.add_proposals(proposals)
@@ -321,8 +424,17 @@ class Executor:
         to completion under the checkpointed budget."""
         if self.has_ongoing_execution:
             raise OngoingExecutionError("an execution is already in progress")
+        self.last_checkpoint_epoch = checkpoint.epoch
+        # conditional claim (CAS on the checkpoint's recorded epoch): a
+        # zombie resuming a checkpoint a newer process already took over
+        # is refused HERE — before any reconciliation mutation — with
+        # executor.fenced journaled by the wrapper
+        self._claim_epoch(
+            expected=checkpoint.epoch if checkpoint.epoch > 0 else None
+        )
         self.state = ExecutorStateValue.STARTING_EXECUTION
         self._stop_requested = False
+        self._reset_drift()
         if self.journal is not None:
             # the restarted process owns the checkpoint again
             self.journal.thaw()
@@ -365,6 +477,7 @@ class Executor:
         )
         self._jwrite(
             "resume", executionId=checkpoint.execution_id,
+            checkpointEpoch=checkpoint.epoch, claimedEpoch=self.epoch,
             completedPrior=len(recon["completed_prior"]),
             completedWhileDown=len(recon["completed_down"]),
             adopted=len(recon["adopted"]),
@@ -372,10 +485,16 @@ class Executor:
             replanned=len(recon["replanned"]),
             aborted=len(recon["aborted"]),
         )
+        orphaned_rate = None
+        if (checkpoint.throttle or {}).get("state") in ("set", "adopted"):
+            # the dead run crashed between set_throttles and cleanup: its
+            # orphaned throttle configs must be re-scoped (adopted) so the
+            # resumed execution's cleanup clears them
+            orphaned_rate = float(checkpoint.throttle.get("rate") or 0.0)
         return self._drive_to_completion(
             planner, checkpoint.sizes, checkpoint.max_ticks,
             len(checkpoint.proposals), checkpoint.execution_id,
-            resumed=True,
+            resumed=True, orphaned_throttle_rate=orphaned_rate,
         )
 
     def _reconcile(self, checkpoint: ExecutionCheckpoint):
@@ -478,21 +597,40 @@ class Executor:
         num_proposals: int,
         execution_id: int,
         resumed: bool = False,
+        orphaned_throttle_rate: Optional[float] = None,
     ) -> ExecutionResult:
         self.planner = planner
-        if self.config.replication_throttle is not None:
+        if (self.config.replication_throttle is not None
+                or orphaned_throttle_rate is not None):
             self.throttle_helper = ReplicationThrottleHelper(
-                self.backend, self.config.replication_throttle
+                self.backend,
+                self.config.replication_throttle
+                if self.config.replication_throttle is not None
+                else orphaned_throttle_rate,
             )
-            self.throttle_helper.set_throttles(
-                [
-                    t.proposal
-                    for t in planner.replica_tasks
-                    if t.state == TaskState.PENDING
-                ]
-            )
-            self._jwrite("throttle", state="set",
-                         rate=self.config.replication_throttle)
+            if orphaned_throttle_rate is not None:
+                # value-matched re-scoping of the dead run's orphans: the
+                # cleanup below now owns (and will clear) them
+                self.throttle_helper.adopt_existing(
+                    [t.proposal for t in planner.replica_tasks],
+                    rate=orphaned_throttle_rate,
+                )
+                self._jwrite("throttle", state="adopted",
+                             rate=orphaned_throttle_rate)
+            if self.config.replication_throttle is not None:
+                # write-ahead: the record gates the dynamic-config writes
+                # (a crash right after them must leave a recoverable
+                # trace — value-matched adoption makes the record safe
+                # even when the crash landed BEFORE the cluster call)
+                self._jwrite("throttle", state="set",
+                             rate=self.config.replication_throttle)
+                self.throttle_helper.set_throttles(
+                    [
+                        t.proposal
+                        for t in planner.replica_tasks
+                        if t.state == TaskState.PENDING
+                    ]
+                )
         if self.config.concurrency_adjuster_enabled:
             self.adjuster = ConcurrencyAdjuster(
                 initial_cap=(
@@ -509,6 +647,7 @@ class Executor:
 
         ticks = 0
         crashed = False
+        fenced = False
         try:
             with tracing.span("executor.execute") as sp:
                 sp.set("proposals", num_proposals)
@@ -529,12 +668,30 @@ class Executor:
             # exactly what a dead process left behind
             crashed = True
             raise
+        except StaleControllerEpochError:
+            # fenced mid-drive: another controller owns the cluster now.
+            # The wrapper already journaled executor.fenced; everything
+            # non-terminal aborts WITHOUT touching the cluster (cancel or
+            # throttle-clear calls would just be fenced again) and the
+            # error propagates — refused loudly, never double-moved.
+            fenced = True
+            raise
         finally:
             if not crashed:
                 if self.throttle_helper is not None:
-                    self.throttle_helper.clear_throttles()
+                    if not fenced:
+                        self.throttle_helper.clear_throttles()
+                        self._jwrite("throttle", state="cleared")
                     self.throttle_helper = None
-                    self._jwrite("throttle", state="cleared")
+                if fenced:
+                    for t in planner.all_tasks:
+                        if t.state == TaskState.PENDING:
+                            t.transition(TaskState.ABORTED)
+                        elif t.state == TaskState.IN_PROGRESS:
+                            t.transition(TaskState.ABORTING)
+                            t.transition(TaskState.ABORTED)
+                        elif t.state == TaskState.ABORTING:
+                            t.transition(TaskState.ABORTED)
                 completed = sum(
                     1 for t in planner.all_tasks
                     if t.state == TaskState.COMPLETED
@@ -555,12 +712,20 @@ class Executor:
                 )
                 self.history.append(result)
                 self._finished_movements += completed
+                # topology-drift / foreign-activity summary: only present
+                # when something actually drifted, so clean executions'
+                # journal records (and pinned fingerprints) stay byte-stable
+                drift = {k: v for k, v in self._drift.items() if v}
+                drift_fields = {"topologyDrift": drift} if drift else {}
+                if fenced:
+                    drift_fields["fenced"] = True
                 self.execution_log.append({
                     "executionId": execution_id,
                     "endedS": round(time.time(), 1),
                     "strategy": planner.strategy.name,
                     "numProposals": num_proposals,
                     "resumed": resumed,
+                    **drift_fields,
                     **dataclasses.asdict(result),
                     # per-move drill-in, bounded: terminal state of each task
                     "tasks": [
@@ -593,6 +758,7 @@ class Executor:
                     executionId=execution_id, completed=completed,
                     dead=dead, aborted=aborted, ticks=ticks,
                     stopped=result.stopped, resumed=resumed,
+                    **drift_fields,
                 )
                 # terminal checkpoint record; the journal truncates itself —
                 # a finished execution needs no recovery state
@@ -600,9 +766,32 @@ class Executor:
                     "end", executionId=execution_id, completed=completed,
                     dead=dead, aborted=aborted, ticks=ticks,
                     stopped=result.stopped, resumed=resumed,
+                    **drift_fields,
                 )
                 self._notify(result)
         return result
+
+    def _reset_drift(self) -> None:
+        self._drift = {
+            "deleted": 0, "rfChanged": 0, "foreignConflict": 0,
+            "foreignObserved": 0,
+        }
+        self._foreign_seen = set()
+        self._abort_reason = None
+
+    def _claim_epoch(self, expected: Optional[int] = None) -> int:
+        """Mint this process's controller epoch.  With ``expected`` the
+        claim is CAS-conditional (resume path) and StaleControllerEpochError
+        propagates; backends without fencing fall back to a local
+        monotonic counter (single-writer-by-assumption, as before)."""
+        claimed = self.backend.claim(expected) \
+            if isinstance(self.backend, FencedClusterBackend) else None
+        if claimed is None:
+            claimed = max(self.epoch, expected or 0) + 1
+        self.epoch = claimed
+        if self.journal is not None:
+            self.journal.set_epoch(claimed)
+        return claimed
 
     def _jwrite(self, kind: str, **payload) -> None:
         """Checkpoint write-through.  ProcessCrash (armed crash injection)
@@ -773,6 +962,155 @@ class Executor:
         self._jwrite("task", taskId=t.task_id, partition=p, state="DEAD",
                      tick=ticks, attempts=t.attempts, reason=reason)
 
+    # ---- foreign reassignments + topology drift ---------------------------------
+    def _reassignment_targets(self) -> Optional[Dict[int, List[int]]]:
+        """partition → target replicas of in-flight reassignments, or None
+        when the backend can't say (foreign-conflict detection then
+        degrades to mismatch-only)."""
+        if self._targets_supported is False:
+            return None
+        probe = getattr(self.backend, "reassignment_targets", None)
+        if probe is None:
+            self._targets_supported = False
+            return None
+        try:
+            targets = probe()
+        except NotImplementedError:
+            self._targets_supported = False
+            return None
+        self._targets_supported = True
+        return targets
+
+    def _note_foreign(self, partitions, conflict: bool, origin: str) -> None:
+        """Journal newly sighted foreign partitions (one record per
+        partition per execution, not per tick) and bump the drift
+        counters."""
+        new = [p for p in sorted(partitions) if p not in self._foreign_seen]
+        if not new:
+            return
+        self._foreign_seen.update(new)
+        key = "foreignConflict" if conflict else "foreignObserved"
+        self._drift[key] += len(new)
+        events.emit(
+            "executor.foreign_reassignment", severity="WARNING",
+            conflict=conflict, origin=origin,
+            policy=self.config.foreign_conflict_policy,
+            partitions=new[:200],
+        )
+
+    def _cancel_drift(self, t: ExecutionTask, ticks: int, reason: str,
+                      counter: Optional[str] = None) -> None:
+        """Cancel a stale task with a categorical topology-drift reason
+        (the plan completes partial-gracefully around it).  ``counter``
+        is None when the sighting was already counted (foreign dedup)."""
+        if t.state == TaskState.IN_PROGRESS:
+            t.transition(TaskState.ABORTING)
+        t.transition(TaskState.ABORTED)
+        t.finished_tick = ticks
+        if counter is not None:
+            self._drift[counter] += 1
+        events.emit(
+            "executor.topology_drift", severity="WARNING",
+            taskId=t.task_id, partition=t.proposal.partition, reason=reason,
+        )
+        self._jwrite("task", taskId=t.task_id,
+                     partition=t.proposal.partition, state="ABORTED",
+                     tick=ticks, reason=reason)
+
+    def _handle_conflict(self, t: ExecutionTask, ticks: int,
+                         origin: str, in_progress: bool) -> None:
+        """A FOREIGN reassignment touched a planned task's partition.
+        Policy "yield": step aside — pre-dispatch tasks postpone, in-flight
+        ones retry after backoff (the foreign move owns the partition; our
+        retry re-issues once it drains) or cancel ``foreign-conflict``
+        when the budget is spent.  Policy "abort": the whole plan aborts
+        partial-gracefully."""
+        p = t.proposal.partition
+        self._note_foreign([p], conflict=True, origin=origin)
+        policy = self.config.foreign_conflict_policy
+        if policy == "abort":
+            self._abort_reason = "foreign-conflict"
+            self._stop_requested = True
+            if in_progress:
+                t.transition(TaskState.ABORTING)
+                t.transition(TaskState.ABORTED)
+                t.finished_tick = ticks
+                self._jwrite("task", taskId=t.task_id, partition=p,
+                             state="ABORTED", tick=ticks,
+                             reason="foreign-conflict")
+            return
+        if not in_progress:
+            # pre-dispatch yield: re-check once the backoff elapses (the
+            # foreign move usually drains long before)
+            t.next_eligible_tick = \
+                ticks + self.config.foreign_yield_backoff_ticks
+            return
+        if (t.attempts < self.config.task_retry_max_attempts
+                and not self._stop_requested):
+            # yield/retry: do NOT cancel — the foreign controller owns the
+            # reassignment now; our retry re-issues our target after it
+            # drains (revalidation keeps postponing while it hasn't)
+            backoff = min(
+                self.config.task_retry_backoff_base_ticks
+                * (1 << t.attempts),
+                self.config.task_retry_backoff_max_ticks,
+            )
+            t.attempts += 1
+            t.retry(eligible_tick=ticks + backoff)
+            self._retries_scheduled += 1
+            events.emit(
+                "executor.task_retry", severity="WARNING",
+                taskId=t.task_id, partition=p, reason="foreign-conflict",
+                attempt=t.attempts,
+                maxAttempts=self.config.task_retry_max_attempts,
+                backoffTicks=backoff,
+            )
+            self._jwrite("task", taskId=t.task_id, partition=p,
+                         state="PENDING", attempts=t.attempts, tick=ticks,
+                         reason="foreign-conflict")
+            return
+        self._cancel_drift(t, ticks, "foreign-conflict")
+
+    def _revalidate_task(self, t: ExecutionTask, ticks: int,
+                         ongoing: Set[int], alive: Set[int],
+                         targets: Optional[Dict[int, List[int]]]) -> bool:
+        """Per-batch precondition revalidation: verify the task against
+        LIVE metadata right before its alterPartitionReassignments.
+        Topics created/deleted/RF-changed mid-execution used to fail as
+        generic replica-mismatch retries that could burn the whole
+        backoff budget; stale tasks now cancel with categorical reasons
+        and the plan completes partial-gracefully."""
+        p = t.proposal.partition
+        try:
+            st = self.backend.partition_state(p)
+        except KeyError:
+            self._cancel_drift(t, ticks, "topology-drift:deleted", "deleted")
+            return False
+        if p in ongoing and targets is not None:
+            tgt = targets.get(p)
+            if tgt is not None and list(tgt) != list(t.proposal.new_replicas):
+                # someone else is moving this partition RIGHT NOW (our own
+                # resumed re-issues match the planned target and pass)
+                self._handle_conflict(t, ticks, origin="pre-dispatch",
+                                      in_progress=False)
+                return False
+        if len(st.replicas) not in (len(t.proposal.old_replicas),
+                                    len(t.proposal.new_replicas)) \
+                and p not in ongoing:
+            # the partition's RF changed under the plan (external RF bump
+            # or shrink): the planned replica set no longer means what the
+            # optimizer computed
+            self._cancel_drift(t, ticks, "topology-drift:rf-changed",
+                               "rfChanged")
+            return False
+        if not set(st.replicas) & alive:
+            # no live source replica to copy from: postpone rather than
+            # burn the dispatch (the hosting broker may come back)
+            t.next_eligible_tick = \
+                ticks + self.config.foreign_yield_backoff_ticks
+            return False
+        return True
+
     # ---- drive loops ------------------------------------------------------------
     def _caps(self, in_flight: Optional[Set[int]] = None) -> int:
         cap = self.config.num_concurrent_partition_movements_per_broker
@@ -815,18 +1153,19 @@ class Executor:
         while ticks < max_ticks:
             if self._stop_requested:
                 self.state = ExecutorStateValue.STOPPING_EXECUTION
+                stop_reason = self._abort_reason or "stopped"
                 for t in planner.replica_tasks:
                     if t.state == TaskState.PENDING:
                         t.transition(TaskState.ABORTED)
                         self._jwrite("task", taskId=t.task_id,
                                      partition=t.proposal.partition,
-                                     state="ABORTED", reason="stopped")
+                                     state="ABORTED", reason=stop_reason)
                     elif t.state == TaskState.IN_PROGRESS:
                         t.transition(TaskState.ABORTING)
                         t.transition(TaskState.ABORTED)
                         self._jwrite("task", taskId=t.task_id,
                                      partition=t.proposal.partition,
-                                     state="ABORTED", reason="stopped")
+                                     state="ABORTED", reason=stop_reason)
                 return ticks
             batch = [] if halted else planner.next_replica_batch(
                 in_flight_per_broker,
@@ -841,6 +1180,23 @@ class Executor:
                 batch = [
                     t for t in batch if self._ensure_destinations(planner, t)
                 ]
+            if batch and self.config.revalidate_preconditions:
+                # per-batch precondition revalidation against LIVE
+                # metadata: deleted/RF-drifted partitions cancel with
+                # categorical reasons, foreign-conflicted ones yield or
+                # abort the plan per execution.foreign.conflict.policy
+                ongoing_pre = self.backend.ongoing_reassignments()
+                alive_pre = self.backend.alive_brokers()
+                targets_pre = (
+                    self._reassignment_targets() if ongoing_pre else None
+                )
+                batch = [
+                    t for t in batch
+                    if self._revalidate_task(t, ticks, ongoing_pre,
+                                             alive_pre, targets_pre)
+                ]
+                if self._stop_requested:
+                    batch = []
             if batch:
                 from cruise_control_tpu.telemetry import tracing
 
@@ -881,13 +1237,49 @@ class Executor:
                 tick()
             ticks += 1
             ongoing = self.backend.ongoing_reassignments()
+            # mid-flight foreign reconciliation: diff observed
+            # reassignments against our dispatched set every tick
+            foreign_now = ongoing - set(in_flight)
+            if foreign_now:
+                planned_left = {
+                    t.proposal.partition for t in planner.replica_tasks
+                    if t.state == TaskState.PENDING
+                }
+                # disjoint foreign moves are tolerated: journaled once and
+                # fed to the ConcurrencyAdjuster as external URPs via
+                # _caps (their catch-up traffic is real cluster stress)
+                self._note_foreign(foreign_now - planned_left,
+                                   conflict=False, origin="mid-flight")
+            if in_flight:
+                targets = self._reassignment_targets()
+                if targets:
+                    for p, t in list(in_flight.items()):
+                        tgt = targets.get(p)
+                        if tgt is not None and list(tgt) != \
+                                list(t.proposal.new_replicas):
+                            # a foreign writer re-targeted our in-flight
+                            # move: yield it (retry once the foreign move
+                            # drains) or abort the plan, per policy
+                            in_flight.pop(p)
+                            for b in t.participating_brokers:
+                                in_flight_per_broker[b] -= 1
+                            self._handle_conflict(t, ticks,
+                                                  origin="in-flight",
+                                                  in_progress=True)
             finished = [p for p in in_flight if p not in ongoing]
             completed_now: List[ExecutionTask] = []
             for p in finished:
                 t = in_flight.pop(p)
                 for b in t.participating_brokers:
                     in_flight_per_broker[b] -= 1
-                st = self.backend.partition_state(p)
+                try:
+                    st = self.backend.partition_state(p)
+                except KeyError:
+                    # the partition was deleted while our move was in
+                    # flight: the task is moot, not failed
+                    self._cancel_drift(t, ticks, "topology-drift:deleted",
+                                       "deleted")
+                    continue
                 ok = list(st.replicas) == list(t.proposal.new_replicas)
                 if ok:
                     t.transition(TaskState.COMPLETED)
@@ -994,11 +1386,22 @@ class Executor:
                         t.transition(TaskState.ABORTED)
                         self._jwrite("task", taskId=t.task_id,
                                      partition=t.proposal.partition,
-                                     state="ABORTED", reason="stopped")
+                                     state="ABORTED",
+                                     reason=self._abort_reason or "stopped")
                 return
             batch = planner.next_leader_batch(
                 self.config.num_concurrent_leader_movements
             )
+            if batch and self.config.revalidate_preconditions:
+                live_batch = []
+                for t in batch:
+                    try:
+                        self.backend.partition_state(t.proposal.partition)
+                        live_batch.append(t)
+                    except KeyError:
+                        self._cancel_drift(t, 0, "topology-drift:deleted",
+                                           "deleted")
+                batch = live_batch
             if not batch:
                 return
             events.emit("executor.batch", phase="leader_moves",
@@ -1058,11 +1461,22 @@ class Executor:
                         t.transition(TaskState.ABORTED)
                         self._jwrite("task", taskId=t.task_id,
                                      partition=t.proposal.partition,
-                                     state="ABORTED", reason="stopped")
+                                     state="ABORTED",
+                                     reason=self._abort_reason or "stopped")
                 return
             batch = planner.next_intra_batch(
                 self.config.num_concurrent_intra_broker_partition_movements
             )
+            if batch and self.config.revalidate_preconditions:
+                live_batch = []
+                for t in batch:
+                    try:
+                        self.backend.partition_state(t.proposal.partition)
+                        live_batch.append(t)
+                    except KeyError:
+                        self._cancel_drift(t, 0, "topology-drift:deleted",
+                                           "deleted")
+                batch = live_batch
             if not batch:
                 return
             events.emit("executor.batch", phase="intra_moves",
@@ -1147,5 +1561,12 @@ class Executor:
             "retries": {
                 "scheduled": self._retries_scheduled,
                 "excludedDestinations": sorted(self.excluded_destinations),
+            },
+            # concurrent-controller posture: the fencing epoch this
+            # process holds and the current execution's foreign/drift tally
+            "fencing": {
+                "epoch": self.epoch,
+                "conflictPolicy": self.config.foreign_conflict_policy,
+                "drift": dict(self._drift),
             },
         }

@@ -45,6 +45,15 @@ checkpoint.  ``load()`` skips undecodable lines (a torn final line from
 a real crash) and returns the checkpoint only when the last execution
 never wrote its ``end`` record.
 
+Fencing (ISSUE 15): every record carries the owner's **controller
+epoch** (``set_epoch``, stamped as a top-level record member next to
+``seq``).  Recovery claims the next epoch cluster-side CONDITIONALLY on
+the checkpoint's recorded epoch (compare-and-swap), so a zombie process
+resuming a checkpoint a newer process already took over is refused
+before it mutates anything; ``load()`` surfaces the latest recorded
+epoch (and the last throttle state, for orphaned-throttle adoption) on
+the :class:`ExecutionCheckpoint`.
+
 Integrity (ISSUE 13): every record is framed with a per-record CRC32
 member (:mod:`cruise_control_tpu.utils.checksum`; format-versioned —
 pre-CRC logs still load).  ``load()`` distinguishes a **torn tail** (the
@@ -90,8 +99,11 @@ _DEFAULT_MAX_BYTES = 4 * 1024 * 1024
 KINDS = ("start", "batch", "task", "phase", "throttle", "resume", "end")
 
 #: write-ahead barriers: these must reach disk before append returns
-#: (start/batch gate cluster calls; resume/end gate recovery decisions)
-_FLUSH_KINDS = frozenset({"start", "batch", "resume", "end"})
+#: (start/batch gate cluster calls; resume/end gate recovery decisions;
+#: throttle gates the dynamic-config writes — a lost throttle record
+#: would orphan the dead run's throttles, since unlike placements they
+#: cannot be re-derived from live cluster state alone)
+_FLUSH_KINDS = frozenset({"start", "batch", "throttle", "resume", "end"})
 
 #: coalesced records are force-flushed after this many anyway
 _MAX_BUFFERED = 64
@@ -162,6 +174,15 @@ class ExecutionCheckpoint:
     last_tick: int
     #: True when a previous recovery already adopted this checkpoint
     resumed_before: bool = False
+    #: controller epoch of the last record — the fencing token the
+    #: checkpoint's owner held.  Recovery claims epoch+1 conditionally on
+    #: this value (CAS), so two racing recoveries serialize and a zombie
+    #: resume of an already-taken-over checkpoint is refused.
+    epoch: int = 0
+    #: last recorded throttle state ({"state": "set"/"cleared", "rate"}) —
+    #: resume adopts (and eventually clears) the dead run's orphaned
+    #: throttle configs from it
+    throttle: Optional[Dict[str, Any]] = None
 
 
 class ExecutionJournal:
@@ -176,6 +197,9 @@ class ExecutionJournal:
         self._bytes = 0
         #: frozen == the owning process "died": appends become no-ops
         self._frozen = False
+        #: controller epoch stamped on every record (execution fencing);
+        #: the executor sets it when it claims ownership
+        self._epoch = 0
         #: test/sim hook: successful appends remaining before ProcessCrash
         self._crash_after: Optional[int] = None
         #: group-commit buffer of serialized-but-unflushed records
@@ -208,6 +232,11 @@ class ExecutionJournal:
             self._frozen = False
             self._crash_after = None
 
+    def set_epoch(self, epoch: int) -> None:
+        """Stamp subsequent records with the owner's controller epoch."""
+        with self._lock:
+            self._epoch = int(epoch)
+
     # ---- emission ---------------------------------------------------------------
     def append(self, kind: str, **payload: Any) -> None:
         """Persist one record; flushed before returning.  IO failures are
@@ -234,6 +263,7 @@ class ExecutionJournal:
                 "schema": SCHEMA,
                 "seq": self._seq,
                 "kind": kind,
+                "epoch": self._epoch,
                 "ts": round(time.time(), 3),
                 "payload": payload,
             }
@@ -305,18 +335,20 @@ class ExecutionJournal:
         if self._start is None:
             return []
         out = [{"schema": SCHEMA, "seq": 1, "kind": "start",
-                "ts": round(time.time(), 3), "payload": self._start}]
+                "epoch": self._epoch, "ts": round(time.time(), 3),
+                "payload": self._start}]
         seq = 1
         for extra, kind in ((self._phase, "phase"),
                             (self._throttle, "throttle")):
             if extra is not None:
                 seq += 1
                 out.append({"schema": SCHEMA, "seq": seq, "kind": kind,
+                            "epoch": self._epoch,
                             "ts": round(time.time(), 3), "payload": extra})
         for tid in sorted(self._tasks):
             seq += 1
             out.append({"schema": SCHEMA, "seq": seq, "kind": "task",
-                        "ts": round(time.time(), 3),
+                        "epoch": self._epoch, "ts": round(time.time(), 3),
                         "payload": self._tasks[tid]})
         return out
 
@@ -429,6 +461,13 @@ class ExecutionJournal:
         phase = "replica_moves"
         last_tick = 0
         resumed_before = False
+        throttle: Optional[dict] = None
+        epoch = 0
+        for rec in tail:
+            try:
+                epoch = max(epoch, int(rec.get("epoch", 0)))
+            except (TypeError, ValueError):
+                pass
         for rec in tail[1:]:
             payload = rec.get("payload", {})
             kind = rec.get("kind")
@@ -448,6 +487,8 @@ class ExecutionJournal:
                 last_tick = max(last_tick, int(payload.get("tick", 0)))
             elif kind == "phase":
                 phase = payload.get("phase", phase)
+            elif kind == "throttle":
+                throttle = dict(payload)
             elif kind == "resume":
                 resumed_before = True
             if "tick" in payload:
@@ -468,4 +509,6 @@ class ExecutionJournal:
             phase=phase,
             last_tick=last_tick,
             resumed_before=resumed_before,
+            epoch=epoch,
+            throttle=throttle,
         )

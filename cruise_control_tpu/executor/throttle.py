@@ -80,6 +80,39 @@ class ReplicationThrottleHelper:
         # coarse seam for observability/legacy parity
         self.backend.set_throttles(self.rate, [p.partition for p in moving])
 
+    def adopt_existing(self, proposals: Sequence,
+                       rate: Optional[float] = None) -> None:
+        """Register throttle configs a DEAD run of this plan left behind
+        (crash between ``set_throttles`` and cleanup) as ours, so
+        :meth:`clear_throttles` removes them — the resume-after-crash
+        leak fix.  Adoption is value-matched: only keys whose value
+        equals exactly what ``set_throttles`` would have written for
+        this plan at this rate are claimed; anything else is a genuine
+        user throttle and stays untouched."""
+        moving = [p for p in proposals if p.has_replica_change]
+        brokers: Set[int] = set()
+        for pr in moving:
+            brokers.update(pr.old_replicas)
+            brokers.update(pr.new_replicas)
+        rate_s = str(self.rate if rate is None else rate)
+        for b in sorted(brokers):
+            existing = self._describe("broker", b)
+            for key in (LEADER_RATE, FOLLOWER_RATE):
+                if existing.get(key) == rate_s \
+                        and (b, key) not in self._set_broker_keys:
+                    self._set_broker_keys.append((b, key))
+        for pr in moving:
+            leaders = ",".join(str(b) for b in pr.old_replicas)
+            followers = ",".join(
+                str(b) for b in pr.new_replicas if b not in pr.old_replicas
+            )
+            existing = self._describe("partition", pr.partition)
+            for key, expect in ((LEADER_REPLICAS, leaders),
+                                (FOLLOWER_REPLICAS, followers)):
+                if expect and existing.get(key) == expect \
+                        and (pr.partition, key) not in self._set_partition_keys:
+                    self._set_partition_keys.append((pr.partition, key))
+
     def clear_throttles(self) -> None:
         """Remove only the configs this helper added."""
         for b, key in self._set_broker_keys:

@@ -46,6 +46,7 @@ from cruise_control_tpu.analyzer.goal_optimizer import (
 )
 from cruise_control_tpu.analyzer.goals.base import BalancingConstraint
 from cruise_control_tpu.analyzer.tpu_optimizer import TpuGoalOptimizer
+from cruise_control_tpu.executor.backend import StaleControllerEpochError
 from cruise_control_tpu.executor.executor import (
     Executor,
     OngoingExecutionError,
@@ -1375,9 +1376,23 @@ class CruiseControl:
             phase=checkpoint.phase,
             resumedBefore=checkpoint.resumed_before,
         )
+        self.executor.last_checkpoint_epoch = checkpoint.epoch
         result = None
         try:
             result = self.executor.resume(checkpoint)
+        except StaleControllerEpochError as e:
+            # zombie resume refused: a newer controller already claimed the
+            # cluster past this checkpoint's epoch.  Do NOT clear the
+            # checkpoint — it belongs to the live controller now; this
+            # process just stands down (executor.fenced is already
+            # journaled by the fenced wrapper).
+            LOG.error("execution recovery fenced — standing down: %s", e)
+            events.emit(
+                "execution.recovery.end", severity="ERROR",
+                executionId=checkpoint.execution_id, outcome="fenced",
+                succeeded=False, error=repr(e),
+            )
+            return None
         except Exception as e:
             # a recovery that cannot even reconcile must not wedge every
             # subsequent startup: journal the abort and clear the

@@ -18,7 +18,11 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from cruise_control_tpu.executor.backend import ClusterBackend, PartitionState
+from cruise_control_tpu.executor.backend import (
+    ClusterBackend,
+    PartitionState,
+    StaleControllerEpochError,
+)
 from cruise_control_tpu.kafka.wire import KafkaWire, TopicPartition
 from cruise_control_tpu.utils.logging import get_logger
 
@@ -29,6 +33,13 @@ LEADER_RATE = "leader.replication.throttled.rate"
 FOLLOWER_RATE = "follower.replication.throttled.rate"
 LEADER_REPLICAS = "leader.replication.throttled.replicas"
 FOLLOWER_REPLICAS = "follower.replication.throttled.replicas"
+
+#: cluster-default dynamic config carrying the execution-fencing epoch
+#: (Kafka has no first-class controller-epoch claim for external tools,
+#: so the epoch rides the cluster-default broker config scope — entity
+#: name "" — which every controller instance reads and writes through
+#: the same AdminClient surface)
+CONTROLLER_EPOCH_KEY = "cruise.control.controller.epoch"
 
 
 class KafkaClusterBackend(ClusterBackend):
@@ -238,6 +249,44 @@ class KafkaClusterBackend(ClusterBackend):
             self.key(tp)
             for tp in self.wire.list_partition_reassignments()
         }
+
+    def reassignment_targets(self) -> Dict[int, List[int]]:
+        """Target replica list per in-flight reassignment: the listed
+        replicas minus the ones being removed (upstream
+        listPartitionReassignments semantics)."""
+        out: Dict[int, List[int]] = {}
+        for tp, meta in self.wire.list_partition_reassignments().items():
+            removing = set(meta.get("removing", ()))
+            out[self.key(tp)] = [
+                b for b in meta.get("replicas", ()) if b not in removing
+            ]
+        return out
+
+    # ---- execution fencing ------------------------------------------------------
+    def controller_epoch(self) -> int:
+        cfg = self.wire.describe_configs("broker", "")
+        try:
+            return int(cfg.get(CONTROLLER_EPOCH_KEY) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def claim_controller_epoch(self, expected: Optional[int] = None) -> int:
+        current = self.controller_epoch()
+        if expected is not None and current != expected:
+            raise StaleControllerEpochError(
+                "claim_controller_epoch", expected, current
+            )
+        claimed = current + 1
+        self.wire.incremental_alter_configs(
+            "broker", "", {CONTROLLER_EPOCH_KEY: str(claimed)}
+        )
+        LOG.warning("claimed controller epoch %d (was %d)", claimed, current)
+        return claimed
+
+    def verify_controller_epoch(self, epoch: int) -> None:
+        registered = self.controller_epoch()
+        if epoch < registered:
+            raise StaleControllerEpochError("verify", epoch, registered)
 
     def cancel_reassignments(self, partitions: Sequence[int]) -> None:
         self._dirty()

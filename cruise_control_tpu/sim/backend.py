@@ -65,6 +65,13 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
         #: live flap state machine: [broker, phase_ticks_left, is_down,
         #: cycles_left, down_ticks, up_ticks]
         self._flap_state: Optional[list] = None
+        #: armed foreign reassignment: (partition|None, conflict,
+        #: ticks after first in-flight)
+        self._armed_foreign: Optional[tuple] = None
+        self._foreign_countdown: Optional[int] = None
+        #: armed topic deletion: (partitions, ticks after first in-flight)
+        self._armed_delete: Optional[tuple] = None
+        self._delete_countdown: Optional[int] = None
 
     def _journal_fired(self, fault: str, **args) -> None:
         """The armed fault actually landed: a journal marker at the REAL
@@ -150,6 +157,67 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
         )
         self._flap_state = None
 
+    def arm_foreign_reassignment(self, partition: Optional[int],
+                                 conflict: bool, after_ticks: int) -> None:
+        """A FOREIGN alter fires ``after_ticks`` ticks after the next
+        execution puts reassignments in flight: ``conflict=True`` hijacks
+        one of the execution's own in-flight partitions, otherwise a
+        partition the execution is not touching is moved."""
+        self._armed_foreign = (
+            int(partition) if partition is not None else None,
+            bool(conflict), max(1, int(after_ticks)),
+        )
+        self._foreign_countdown = None
+
+    def arm_delete_partitions(self, partitions: Sequence[int],
+                              after_ticks: int) -> None:
+        """The listed partitions vanish from metadata ``after_ticks``
+        ticks after the next execution has moves in flight (armed
+        ``delete_topic``)."""
+        self._armed_delete = (
+            sorted(int(p) for p in partitions), max(1, int(after_ticks))
+        )
+        self._delete_countdown = None
+
+    def foreign_reassign(self, partition: Optional[int] = None,
+                         conflict: bool = False) -> Optional[dict]:
+        """Apply one foreign alter NOW (deterministically): conflict picks
+        the lowest in-flight partition and re-targets it; disjoint picks
+        the lowest settled partition.  The new target replaces the last
+        replica with the lowest-id alive broker not already hosting the
+        partition.  Returns {partition, target} or None when no candidate
+        exists (e.g. nothing in flight to conflict with)."""
+        if partition is None:
+            pool = (
+                sorted(self._target) if conflict
+                else sorted(p for p in self.partitions
+                            if p not in self._target)
+            )
+            if not pool:
+                return None
+            partition = pool[0]
+        st = self.partitions.get(partition)
+        if st is None:
+            return None
+        candidates = sorted(
+            b for b in self.brokers
+            if b not in self.failed_brokers and b not in st.replicas
+        )
+        if not candidates:
+            return None
+        # target from the SETTLED replica set (mid-catch-up adds of an
+        # in-flight move excluded), last member replaced — a real
+        # kafka-reassign-partitions run targets a same-RF replica list
+        base = [b for b in st.replicas if b not in st.catching_up] \
+            or list(st.replicas)
+        target = base[:-1] + [candidates[0]]
+        # a foreign writer goes straight at the admin surface — no fencing
+        # discipline, exactly like a raw kafka-reassign-partitions run
+        self.alter_partition_reassignments({partition: target})
+        self._journal_fired("foreign_reassignment", partition=partition,
+                            target=target, conflict=conflict)
+        return {"partition": partition, "target": target}
+
     def _first_catching_up(self) -> Optional[int]:
         catching = {
             b
@@ -226,6 +294,31 @@ class ScriptedClusterBackend(SimulatedClusterBackend):
                                         via="flap")
                     st[2] = True
                     st[1] = st[4]
+        if self._armed_foreign is not None:
+            if self._foreign_countdown is None and self._target:
+                self._foreign_countdown = self._armed_foreign[2]
+            if self._foreign_countdown is not None:
+                self._foreign_countdown -= 1
+                if self._foreign_countdown <= 0:
+                    p, conflict, _ = self._armed_foreign
+                    applied = self.foreign_reassign(p, conflict)
+                    if applied is None and conflict:
+                        # nothing in flight to hijack yet: re-check next tick
+                        self._foreign_countdown = 1
+                    else:
+                        self._armed_foreign = None
+                        self._foreign_countdown = None
+        if self._armed_delete is not None:
+            if self._delete_countdown is None and self._target:
+                self._delete_countdown = self._armed_delete[1]
+            if self._delete_countdown is not None:
+                self._delete_countdown -= 1
+                if self._delete_countdown <= 0:
+                    parts, _ = self._armed_delete
+                    self.delete_partitions(parts)
+                    self._journal_fired("delete_topic", partitions=parts)
+                    self._armed_delete = None
+                    self._delete_countdown = None
         if self._armed_kill is not None:
             if self._armed_countdown is None and self._target:
                 self._armed_countdown = self._armed_kill[1]

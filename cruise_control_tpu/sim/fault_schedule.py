@@ -97,6 +97,16 @@ class FaultScheduleConfig:
     settle_ms: int = 20 * MIN_MS
     quiet_tail_ms: int = 100 * MIN_MS
     min_spacing_ms: int = 18 * MIN_MS
+    #: bounded concurrent multi-fault PILE-UPS (ROADMAP item-5 leftover):
+    #: when True, disruptive faults are laid out as clusters of up to
+    #: ``pileup_max_cluster`` events one minute apart — the system sees
+    #: genuinely overlapping heals — while the CLUSTERS keep the full
+    #: ``min_spacing_ms`` guarantee (so pile-ups are a scripted burst,
+    #: not an accident of density).  False keeps the historical
+    #: one-fault-per-slot layout byte for byte.
+    min_spacing_relaxed: bool = False
+    #: maximum faults sharing one pile-up cluster (≥1; 1 ≡ not relaxed)
+    pileup_max_cluster: int = 2
     #: paired-restore delay (disk replaced, rack powered back, ...)
     heal_ms: int = 10 * MIN_MS
     #: perturb_broker_load factor pool (drawn per event).  Factors > 1
@@ -129,28 +139,40 @@ class ScheduleError(ValueError):
 
 def _slots(cfg: FaultScheduleConfig, rng: random.Random, n: int) -> List[int]:
     """``n`` fault timestamps on a jittered grid inside the fault window,
-    each ≥ ``min_spacing_ms`` from its neighbors, minute-aligned."""
+    minute-aligned.  Default layout: every slot ≥ ``min_spacing_ms``
+    from its neighbors.  With ``min_spacing_relaxed``, slots group into
+    pile-up clusters of up to ``pileup_max_cluster`` events one minute
+    apart; the spacing guarantee then holds between CLUSTERS.  The
+    ``k == 1`` path is byte-identical to the historical layout (same
+    arithmetic, same rng draw sequence), so existing seeded schedules —
+    and the soak fingerprints pinned on them — do not move."""
     if n <= 0:
         return []
+    k = max(1, int(cfg.pileup_max_cluster)) if cfg.min_spacing_relaxed else 1
+    clusters = -(-n // k)
     # whole-minute arithmetic: the grid guarantee (gap >= min_spacing)
     # must survive minute alignment, so jitter is drawn in minutes too
     start_m = -(-cfg.settle_ms // MIN_MS)
     end_m = (cfg.duration_ms - cfg.quiet_tail_ms) // MIN_MS
     spacing_m = -(-cfg.min_spacing_ms // MIN_MS)
     span_m = end_m - start_m
-    if span_m < n * spacing_m:
+    if span_m < clusters * spacing_m + (k - 1):
         raise ScheduleError(
-            f"{n} disruptive faults need {n * spacing_m} min of window "
-            f"but only {span_m} min exist between the settle head and "
-            "the quiet tail — lower the counts or the spacing"
+            f"{n} disruptive faults ({clusters} cluster(s) of ≤{k}) need "
+            f"{clusters * spacing_m + (k - 1)} min of window but only "
+            f"{span_m} min exist between the settle head and the quiet "
+            "tail — lower the counts or the spacing"
         )
-    pitch_m = span_m // n
-    jitter_m = max(0, (pitch_m - spacing_m) // 2)
-    return [
-        (start_m + i * pitch_m + pitch_m // 2
-         + rng.randint(-jitter_m, jitter_m)) * MIN_MS
-        for i in range(n)
-    ]
+    pitch_m = span_m // clusters
+    jitter_m = max(0, (pitch_m - spacing_m - (k - 1)) // 2)
+    out: List[int] = []
+    for i in range(clusters):
+        base_m = (start_m + i * pitch_m + pitch_m // 2
+                  + rng.randint(-jitter_m, jitter_m))
+        for j in range(k):
+            if len(out) < n:
+                out.append((base_m + j) * MIN_MS)
+    return out
 
 
 def generate_timeline(cfg: FaultScheduleConfig) -> Timeline:

@@ -30,9 +30,12 @@ from cruise_control_tpu.sim.timeline import (
     corrupt_checkpoint,
     corrupt_metrics,
     crash_process,
+    create_topic,
+    delete_topic,
     disk_failure,
     fail_engine,
     flap_broker,
+    foreign_reassignment,
     hot_partition_skew,
     http_request,
     kill_broker,
@@ -47,6 +50,7 @@ from cruise_control_tpu.sim.timeline import (
     restore_disk,
     slow_client,
     stall_execution,
+    zombie_controller_resume,
 )
 
 
@@ -650,6 +654,115 @@ def _engine_failure_degrades_to_greedy() -> ScenarioSpec:
     )
 
 
+# ---- concurrent-controller safety (ISSUE 15) ------------------------------------
+def _foreign_reassignment_tolerated() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="foreign_reassignment_tolerated",
+        description=(
+            "While the self-healing rebalance is mid-flight, a foreign "
+            "writer (a raw kafka-reassign-partitions run) moves a "
+            "partition the plan does not touch.  The executor journals "
+            "the disjoint foreign activity once, feeds its catch-up "
+            "traffic to the concurrency machinery as external URPs, and "
+            "completes every planned move untouched — tolerated, never "
+            "fought."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=8.0, leader=0),
+            foreign_reassignment(4 * MIN_MS, conflict=False, after_ticks=2),
+        ]),
+        self_healing={"goal_violation": True},
+        mean_utilization=0.18,
+        move_latency_ticks=3,
+        fix_cooldown_ms=2 * MIN_MS,
+        duration_ms=30 * MIN_MS,
+    )
+
+
+def _foreign_conflict_yield_retries() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="foreign_conflict_yield_retries",
+        description=(
+            "A foreign writer re-targets one of the execution's own "
+            "in-flight moves.  Under execution.foreign.conflict.policy="
+            "yield the executor steps aside — the hijacked task retries "
+            "with backoff (journaled foreign-conflict) once the foreign "
+            "move drains — and the plan still converges to its planned "
+            "placement with zero dead tasks and zero double-applied "
+            "moves."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=8.0, leader=0),
+            foreign_reassignment(4 * MIN_MS, conflict=True, after_ticks=1),
+        ]),
+        self_healing={"goal_violation": True},
+        task_retry_attempts=3,
+        task_retry_backoff_base_ticks=2,
+        task_retry_backoff_max_ticks=8,
+        mean_utilization=0.18,
+        move_latency_ticks=3,
+        fix_cooldown_ms=2 * MIN_MS,
+        duration_ms=30 * MIN_MS,
+    )
+
+
+def _zombie_controller_fenced() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="zombie_controller_fenced",
+        description=(
+            "The control plane crashes mid-rebalance; a restarted "
+            "process resumes the checkpoint (conditionally claiming the "
+            "next controller epoch).  Later the DEAD process's stale "
+            "incarnation thaws and tries to resume the same checkpoint — "
+            "its compare-and-swap epoch claim is refused before it "
+            "mutates anything (executor.fenced journaled) and the live "
+            "controller's execution stands: zero double-applied moves."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=6.0, leader=0),
+            crash_process(4 * MIN_MS, after_ticks=6),
+            restart_process(16 * MIN_MS),
+            zombie_controller_resume(20 * MIN_MS),
+        ]),
+        self_healing={"goal_violation": True},
+        checkpoint=True,
+        mean_utilization=0.18,
+        move_latency_ticks=4,
+        executor_moves_per_broker=1,
+        fix_cooldown_ms=2 * MIN_MS,
+        duration_ms=32 * MIN_MS,
+    )
+
+
+def _topology_drift_mid_execution() -> ScenarioSpec:
+    return ScenarioSpec(
+        name="topology_drift_mid_execution",
+        description=(
+            "A whole topic is deleted two ticks into the self-healing "
+            "rebalance and a new topic appears minutes later.  Tasks "
+            "touching the vanished partitions cancel with the "
+            "categorical topology-drift:deleted reason (never burning "
+            "the retry/backoff budget as replica-mismatch), the plan "
+            "completes partial-gracefully with the drift tallied in "
+            "executor.end, and the monitor absorbs both the shrink and "
+            "the growth without a failed detection."
+        ),
+        timeline=Timeline([
+            hot_partition_skew(4 * MIN_MS, factor=8.0, leader=0),
+            delete_topic(4 * MIN_MS, "topic_2", after_ticks=2),
+            create_topic(14 * MIN_MS, "topic_new", partitions=4,
+                         replication_factor=2),
+        ]),
+        self_healing={"goal_violation": True},
+        task_retry_attempts=2,
+        mean_utilization=0.18,
+        move_latency_ticks=3,
+        executor_moves_per_broker=1,
+        fix_cooldown_ms=2 * MIN_MS,
+        duration_ms=32 * MIN_MS,
+    )
+
+
 #: name → spec factory; a fresh ScenarioSpec per call
 SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
     factory().name: factory
@@ -680,6 +793,10 @@ SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
         _poisoned_metrics_quarantined_then_healed,
         _checkpoint_bitflip_recovers_loudly,
         _engine_failure_degrades_to_greedy,
+        _foreign_reassignment_tolerated,
+        _foreign_conflict_yield_retries,
+        _zombie_controller_fenced,
+        _topology_drift_mid_execution,
     )
 }
 
@@ -701,11 +818,18 @@ SCENARIOS: Dict[str, Callable[[], ScenarioSpec]] = {
 #: byzantine-input story (quarantine → storm finding → clean heal) is
 #: re-verified bit-for-bit on every run (ISSUE 13; no RNG, sequential
 #: journal, deterministic poison windows).
+#: foreign_conflict_yield_retries and zombie_controller_fenced ride in
+#: tier-1 so the concurrent-controller story (conflict yield/retry
+#: convergence; stale-epoch zombie refusal with the live controller's
+#: execution standing) is re-verified bit-for-bit on every run (ISSUE 15;
+#: no RNG — armed events fire on deterministic tick counts).
 SMOKE_SCENARIOS = ("rack_loss", "cascading_disk_failures",
                    "crash_resume_mid_execution",
                    "degraded_serving_survives_analyzer_outage",
                    "warm_replan_after_drift", "slo_observatory",
-                   "poisoned_metrics_quarantined_then_healed")
+                   "poisoned_metrics_quarantined_then_healed",
+                   "foreign_conflict_yield_retries",
+                   "zombie_controller_fenced")
 
 
 def make_scenario(name: str, seed: Optional[int] = None) -> ScenarioSpec:

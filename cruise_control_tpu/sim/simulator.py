@@ -128,6 +128,10 @@ class ScenarioSpec:
     task_retry_jitter_ticks: int = 1
     dest_exclusion_threshold: int = 0
     watchdog_stuck_ticks: int = 0
+    #: concurrent-controller safety (ISSUE 15): what planned tasks do when
+    #: a foreign reassignment conflicts with them ("yield" | "abort")
+    foreign_conflict_policy: str = "yield"
+    foreign_yield_backoff_ticks: int = 4
     # serving-layer chaos knobs (ISSUE 8): a REAL CruiseControlHttpServer
     # in front of the facade, driven by http_request/request_storm/
     # slow_client timeline events — off by default
@@ -549,7 +553,29 @@ class _Sim:
         self._trace_seq = 0
         self.server: Optional[CruiseControlHttpServer] = None
         self.precompute: Optional[ProposalPrecomputingExecutor] = None
+        #: the checkpoint as the CRASHED process last saw it — the stale
+        #: view a zombie_controller_resume event resumes from
+        self._zombie_checkpoint = None
         self._build_control_plane()
+
+    def _executor_config(self) -> ExecutorConfig:
+        spec = self.spec
+        return ExecutorConfig(
+            task_timeout_ticks=spec.executor_task_timeout_ticks,
+            num_concurrent_partition_movements_per_broker=(
+                spec.executor_moves_per_broker
+            ),
+            task_retry_max_attempts=spec.task_retry_attempts,
+            task_retry_backoff_base_ticks=(
+                spec.task_retry_backoff_base_ticks
+            ),
+            task_retry_backoff_max_ticks=spec.task_retry_backoff_max_ticks,
+            task_retry_jitter_ticks=spec.task_retry_jitter_ticks,
+            dest_exclusion_threshold=spec.dest_exclusion_threshold,
+            watchdog_stuck_ticks=spec.watchdog_stuck_ticks,
+            foreign_conflict_policy=spec.foreign_conflict_policy,
+            foreign_yield_backoff_ticks=spec.foreign_yield_backoff_ticks,
+        )
 
     def _build_control_plane(self) -> None:
         spec = self.spec
@@ -592,24 +618,7 @@ class _Sim:
             if self._checkpoint_path else None
         )
         self.executor = Executor(
-            self.backend,
-            ExecutorConfig(
-                task_timeout_ticks=spec.executor_task_timeout_ticks,
-                num_concurrent_partition_movements_per_broker=(
-                    spec.executor_moves_per_broker
-                ),
-                task_retry_max_attempts=spec.task_retry_attempts,
-                task_retry_backoff_base_ticks=(
-                    spec.task_retry_backoff_base_ticks
-                ),
-                task_retry_backoff_max_ticks=(
-                    spec.task_retry_backoff_max_ticks
-                ),
-                task_retry_jitter_ticks=spec.task_retry_jitter_ticks,
-                dest_exclusion_threshold=spec.dest_exclusion_threshold,
-                watchdog_stuck_ticks=spec.watchdog_stuck_ticks,
-            ),
-            journal=journal,
+            self.backend, self._executor_config(), journal=journal,
         )
         breaker = None
         if spec.breaker_failures > 0:
@@ -727,7 +736,39 @@ class _Sim:
         """SIGKILL semantics: the front door vanishes with the process —
         no drain, no task-pool shutdown, connections just die."""
         self.process_up = False
+        if self._checkpoint_path and os.path.exists(self._checkpoint_path):
+            # snapshot the checkpoint exactly as the dying process left it:
+            # a later zombie_controller_resume replays THIS stale view,
+            # after the restarted process has moved the file (and the
+            # cluster epoch) past it
+            try:
+                self._zombie_checkpoint = ExecutionJournal(
+                    self._checkpoint_path
+                ).load()
+            except Exception:
+                self._zombie_checkpoint = None
         self._halt_server()
+
+    def zombie_resume(self) -> Dict[str, object]:
+        """The dead process's stale incarnation thaws and re-resumes its
+        checkpoint.  With the restarted process's conditional epoch claim
+        already registered cluster-side, the zombie's CAS must be refused
+        (StaleControllerEpochError + executor.fenced) before it mutates
+        anything."""
+        from cruise_control_tpu.executor.backend import (
+            StaleControllerEpochError,
+        )
+
+        ck = self._zombie_checkpoint
+        if ck is None:
+            return {"zombie": "no-checkpoint"}
+        zombie = Executor(self.backend, self._executor_config(),
+                          journal=None)
+        try:
+            res = zombie.resume(ck)
+        except StaleControllerEpochError:
+            return {"zombie": "fenced", "checkpointEpoch": ck.epoch}
+        return {"zombie": "resumed", "completed": res.completed}
 
     def _halt_server(self) -> None:
         if self.server is not None and self.server._httpd is not None:
@@ -960,6 +1001,52 @@ def _apply_event(sim: _Sim, ev: TimelineEvent, now_ms: int) -> None:
     elif ev.kind == "restore_engine":
         sim.engine_down = False
         _restore_engine(sim.cc)
+    elif ev.kind == "foreign_reassignment":
+        after = ev.arg("after_ticks")
+        if after is not None:
+            sim.backend.arm_foreign_reassignment(
+                ev.arg("partition"), ev.arg("conflict", False), after,
+            )
+        else:
+            detail["applied"] = sim.backend.foreign_reassign(
+                ev.arg("partition"), ev.arg("conflict", False),
+            )
+    elif ev.kind == "zombie_controller_resume":
+        detail.update(sim.zombie_resume())
+    elif ev.kind == "create_topic":
+        n = ev.arg("partitions")
+        rf = ev.arg("replication_factor", 2)
+        topic = ev.arg("topic")
+        # ids come from the topic map, which never forgets: a DELETED
+        # partition's id must not be recycled (the monitor's aggregate
+        # history is keyed by id)
+        next_p = max(sim._partition_topic, default=-1) + 1
+        alive = sorted(sim.backend.alive_brokers())
+        assignment = {}
+        leaders = {}
+        for i in range(n):
+            p = next_p + i
+            reps = [alive[(i + j) % len(alive)]
+                    for j in range(min(rf, len(alive)))]
+            assignment[p] = reps
+            leaders[p] = reps[0]
+            # shared dict: the metadata client sees the new topic at once
+            sim._partition_topic[p] = topic
+        sim.backend.create_partitions(assignment, leaders)
+        sim.workload.add_partitions(n)
+        detail["partitions"] = sorted(assignment)
+    elif ev.kind == "delete_topic":
+        topic = ev.arg("topic")
+        parts = sorted(
+            p for p, t in sim._partition_topic.items()
+            if t == topic and p in sim.backend.partitions
+        )
+        detail["partitions"] = parts
+        after = ev.arg("after_ticks")
+        if after is not None:
+            sim.backend.arm_delete_partitions(parts, after)
+        else:
+            sim.backend.delete_partitions(parts)
     elif ev.kind == "http_request":
         events.emit("sim.fault", fault=ev.kind, virtualMs=now_ms,
                     atMs=ev.at_ms, args=dict(ev.args))

@@ -667,9 +667,43 @@ def day_spec(seed: int = 12) -> SoakSpec:
     return SoakSpec(seed=seed)
 
 
+def pileup_spec(seed: int = 9) -> SoakSpec:
+    """Slow-tier pile-up soak (ISSUE 15 satellite): the relaxed-spacing
+    schedule (``min_spacing_relaxed``) fires bounded concurrent
+    multi-fault bursts — up to two disruptive faults one virtual minute
+    apart — at smoke scale, so genuinely OVERLAPPING heals exercise the
+    detector's priority queue, cooldown, and the executor's
+    foreign/retry machinery at once.  Heal-latency objectives are
+    widened: a burst's second heal legitimately queues behind the
+    first."""
+    duration = 60 * MIN_MS
+    spec = smoke_spec(seed=seed)
+    return dataclasses.replace(
+        spec,
+        name="soak_pileup",
+        duration_ms=duration,
+        diurnal_period_ms=duration,
+        objectives={
+            **spec.objectives,
+            "heal.latency.p50.ms": 20.0 * MIN_MS,
+            "heal.latency.p99.ms": 40.0 * MIN_MS,
+        },
+        schedule=dataclasses.replace(
+            spec.schedule_config(),
+            duration_ms=duration,
+            min_spacing_relaxed=True,
+            pileup_max_cluster=2,
+            hot_skews=2,
+            min_spacing_ms=8 * MIN_MS,
+            quiet_tail_ms=16 * MIN_MS,
+        ),
+    )
+
+
 SOAKS = {
     "soak_smoke": smoke_spec,
     "soak_day": day_spec,
+    "soak_pileup": pileup_spec,
 }
 
 

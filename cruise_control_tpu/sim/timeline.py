@@ -81,6 +81,18 @@ faults the executor must survive):
     Scripted TPU-engine failure (XLA OOM / compile error stand-in): TPU
     optimizations raise until restored while the greedy engine stays
     healthy — the engine degradation ladder's territory.
+``foreign_reassignment``
+    A concurrent writer (ISSUE 15): a reassignment the executor never
+    planned lands on the cluster — immediately, or armed to fire
+    mid-execution on a disjoint or conflicting partition.
+``zombie_controller_resume``
+    The crashed process's stale incarnation thaws and re-resumes the
+    checkpoint a restarted process already owns — the fencing epoch must
+    refuse it loudly (``executor.fenced``).
+``create_topic`` / ``delete_topic``
+    Topology drift mid-scenario: partitions appear in metadata, or
+    vanish (optionally armed to land mid-execution — the per-batch
+    precondition revalidation's territory).
 """
 
 from __future__ import annotations
@@ -116,6 +128,10 @@ KINDS = (
     "corrupt_checkpoint",
     "fail_engine",
     "restore_engine",
+    "foreign_reassignment",
+    "zombie_controller_resume",
+    "create_topic",
+    "delete_topic",
 )
 
 
@@ -335,6 +351,61 @@ def corrupt_checkpoint(at_ms: int, line: int = 1) -> TimelineEvent:
     damage is always MID-FILE — the torn-tail path is a different,
     already-tolerated animal).  Fire it while the process is down."""
     return _event(at_ms, "corrupt_checkpoint", line=int(line))
+
+
+# ---- concurrent-controller chaos (ISSUE 15) -------------------------------------
+def foreign_reassignment(
+    at_ms: int,
+    partition: Optional[int] = None,
+    conflict: bool = False,
+    after_ticks: Optional[int] = None,
+) -> TimelineEvent:
+    """A FOREIGN writer (second controller / kafka-reassign-partitions)
+    issues a reassignment the executor did not plan.  With ``after_ticks``
+    the alter is ARMED: it fires that many backend ticks after the next
+    execution has moves in flight — ``conflict=True`` re-targets one of
+    the execution's own in-flight partitions (the executor must yield or
+    abort per policy), ``conflict=False`` picks a partition the plan does
+    not touch (must be tolerated).  Without ``after_ticks`` the alter
+    applies immediately to ``partition`` (or the lowest currently
+    unreassigned partition)."""
+    return _event(
+        at_ms, "foreign_reassignment",
+        partition=int(partition) if partition is not None else None,
+        conflict=bool(conflict),
+        after_ticks=int(after_ticks) if after_ticks is not None else None,
+    )
+
+
+def zombie_controller_resume(at_ms: int) -> TimelineEvent:
+    """The CRASHED process's stale incarnation thaws and tries to resume
+    the execution checkpoint it once owned — after a restarted process
+    already took it over.  Its conditional epoch claim must be refused
+    (``executor.fenced``) before it mutates anything; fire this after a
+    ``crash_process`` + ``restart_process`` pair."""
+    return _event(at_ms, "zombie_controller_resume")
+
+
+def create_topic(at_ms: int, topic: str, partitions: int = 4,
+                 replication_factor: int = 2) -> TimelineEvent:
+    """A new topic appears in metadata mid-scenario (topology drift the
+    monitor and any in-flight execution must absorb)."""
+    return _event(at_ms, "create_topic", topic=str(topic),
+                  partitions=int(partitions),
+                  replication_factor=int(replication_factor))
+
+
+def delete_topic(at_ms: int, topic: str,
+                 after_ticks: Optional[int] = None) -> TimelineEvent:
+    """A topic is deleted mid-scenario.  With ``after_ticks`` the
+    deletion is ARMED: it lands that many backend ticks after the next
+    execution has moves in flight — tasks touching the vanished
+    partitions must cancel ``topology-drift:deleted``, not burn the
+    retry budget as replica-mismatch failures."""
+    return _event(
+        at_ms, "delete_topic", topic=str(topic),
+        after_ticks=int(after_ticks) if after_ticks is not None else None,
+    )
 
 
 def fail_engine(at_ms: int) -> TimelineEvent:

@@ -61,6 +61,22 @@ class ScenarioWorkload:
             leaders=leaders,
         )
 
+    def add_partitions(self, count: int, bytes_in: float = 1.0,
+                       bytes_out: float = 1.0, size_mb: float = 1.0) -> None:
+        """Grow the ground-truth arrays for ``count`` newly created
+        partitions (timeline ``create_topic``) — modest default load so a
+        mid-scenario topic doesn't perturb capacity headroom.  Topology
+        for the new ids arrives via the next :meth:`sync_topology`."""
+        n = max(0, int(count))
+        if n == 0:
+            return
+        self._base_in = np.append(self._base_in, np.full(n, float(bytes_in)))
+        self._base_out = np.append(self._base_out,
+                                   np.full(n, float(bytes_out)))
+        self._base_size = np.append(self._base_size,
+                                    np.full(n, float(size_mb)))
+        self._skew = np.append(self._skew, np.ones(n))
+
     def apply_skew(self, partitions: Sequence[int], factor: float) -> None:
         """Compound a skew multiplier onto a partition subset (timeline
         ``hot_partition_skew``); the load follows the partitions through

@@ -847,6 +847,86 @@ PACKAGE_FIXTURES = {
             },
         ],
     },
+    "fenced-backend-discipline": {
+        "positive": [
+            # a raw backend reference mutating outside the implementations
+            {
+                "pkg/healer.py": (
+                    "def heal(backend, plan):\n"
+                    "    backend.alter_partition_reassignments(plan)\n"
+                ),
+            },
+            # aliasing past the fence: the wrapper's inner leaks out
+            {
+                "pkg/driveloop.py": (
+                    "class Driver:\n"
+                    "    def drive(self, reassignments):\n"
+                    "        raw = self.backend.inner\n"
+                    "        raw.cancel_reassignments(list(reassignments))\n"
+                    "        self.backend.inner.alter_partition_"
+                    "reassignments(reassignments)\n"
+                ),
+            },
+            # direct-name import of a backend class, unbound-method call
+            {
+                "pkg/tools.py": (
+                    "from pkg.executor.backend import "
+                    "SimulatedClusterBackend\n"
+                    "def throttle_off(b):\n"
+                    "    SimulatedClusterBackend.clear_throttles(b)\n"
+                ),
+                "pkg/executor/__init__.py": "",
+                "pkg/executor/backend.py": (
+                    "class SimulatedClusterBackend:\n"
+                    "    def clear_throttles(self):\n"
+                    "        pass\n"
+                ),
+            },
+        ],
+        "negative": [
+            # the executor shape: self.backend IS the fenced wrapper
+            {
+                "pkg/executor/__init__.py": "",
+                "pkg/executor/executor.py": (
+                    "class Executor:\n"
+                    "    def drive(self, reassignments, elections):\n"
+                    "        self.backend.alter_partition_reassignments("
+                    "reassignments)\n"
+                    "        self.backend.elect_leaders(elections)\n"
+                    "        self.throttle_helper.clear_throttles()\n"
+                ),
+            },
+            # the implementations themselves are exempt by path
+            {
+                "pkg/executor/__init__.py": "",
+                "pkg/executor/backend.py": (
+                    "class FencedClusterBackend:\n"
+                    "    def elect_leaders(self, partitions):\n"
+                    "        self.inner.elect_leaders(partitions)\n"
+                ),
+                "pkg/kafka/__init__.py": "",
+                "pkg/kafka/backend.py": (
+                    "class KafkaClusterBackend:\n"
+                    "    def elect_leaders(self, partitions):\n"
+                    "        self.wire.elect_leaders(partitions)\n"
+                ),
+                "pkg/sim/__init__.py": "",
+                "pkg/sim/backend.py": (
+                    "class ScriptedClusterBackend:\n"
+                    "    def foreign_reassign(self, p, target):\n"
+                    "        self.alter_partition_reassignments("
+                    "{p: target})\n"
+                ),
+            },
+            # non-mutating reads on a raw reference stay out of scope
+            {
+                "pkg/detector.py": (
+                    "def watch(backend):\n"
+                    "    return backend.ongoing_reassignments()\n"
+                ),
+            },
+        ],
+    },
 }
 
 
@@ -1303,6 +1383,17 @@ MUTATIONS = {
         "cruise_control_tpu/sim/simulator.py",
         "sim.now_ms = now  # injected clocks (the breaker) read this",
         "sim.now_ms = int(time.time() * 1000)",
+    ),
+    # ISSUE 15 satellite: the executor's batch dispatch rewritten to go
+    # around the fenced wrapper (the exact zombie-write hole execution
+    # fencing closed) must be caught at the real drive-loop site
+    "fenced-backend-dispatch": (
+        "fenced-backend-discipline",
+        "cruise_control_tpu/executor/executor.py",
+        "                    self.backend.alter_partition_reassignments("
+        "reassignments)",
+        "                    self.backend.inner.alter_partition_"
+        "reassignments(reassignments)",
     ),
     # ISSUE 14 satellite: a raw profiler-session call planted back into
     # the optimizer's drive loop — the exact ad-hoc hole the kernel

@@ -1,0 +1,638 @@
+"""Concurrent-controller safety (ISSUE 15): execution fencing,
+mid-flight foreign-reassignment reconciliation, per-batch topology
+revalidation, and the satellites that ride with them.
+
+The heart is the INTERLEAVING HARNESS
+(:func:`test_foreign_alter_at_every_batch_boundary`): a foreign writer
+injects a reassignment at EVERY batch boundary of a small plan — the
+kill-at-every-checkpoint discipline applied to concurrency — under both
+conflict policies, asserting placement convergence with zero
+double-applied moves and zero silent-wrong placements.
+"""
+
+import contextlib
+import os
+
+import pytest
+
+from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
+from cruise_control_tpu.detector.detectors import ForeignReassignmentDetector
+from cruise_control_tpu.executor.backend import (
+    FencedClusterBackend,
+    SimulatedClusterBackend,
+    StaleControllerEpochError,
+)
+from cruise_control_tpu.executor.concurrency import ConcurrencyAdjuster
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.executor.journal import ExecutionJournal, ProcessCrash
+from cruise_control_tpu.telemetry import events
+from cruise_control_tpu.telemetry.events import EventJournal
+
+
+@contextlib.contextmanager
+def capture_events():
+    """Swap in a private event journal; yields a callable returning the
+    captured records (kind-filterable)."""
+    prev = events.JOURNAL
+    events.JOURNAL = EventJournal(enabled=True, ring_size=1 << 12)
+    try:
+        def recs(kind=None):
+            out = events.JOURNAL.recent()
+            if kind is not None:
+                out = [e for e in out if e["kind"] == kind]
+            return out
+        yield recs
+    finally:
+        events.JOURNAL.close()
+        events.JOURNAL = prev
+
+
+def _prop(p, old, new):
+    return ExecutionProposal(
+        partition=p, topic=0, old_leader=old[0], new_leader=new[0],
+        old_replicas=tuple(old), new_replicas=tuple(new),
+    )
+
+
+def _fixture(move_latency=2):
+    """6 partitions over 4 brokers; the plan moves partitions 0/1/4 onto
+    [2, 3] (same shape as the crash-consistency harness)."""
+    assignment = {p: [(p + i) % 4 for i in range(2)] for p in range(6)}
+    leaders = {p: assignment[p][0] for p in range(6)}
+    backend = SimulatedClusterBackend(
+        {p: list(r) for p, r in assignment.items()}, dict(leaders),
+        move_latency_ticks=move_latency,
+    )
+    plan = [_prop(p, assignment[p], [2, 3]) for p in (0, 1, 4)]
+    return backend, plan
+
+
+def _placement(backend):
+    return {p: list(st.replicas) for p, st in backend.partitions.items()}
+
+
+def _settle(backend, max_ticks=200):
+    for _ in range(max_ticks):
+        if not backend.ongoing_reassignments():
+            return
+        backend.tick()
+    raise AssertionError("cluster never settled")
+
+
+# ---- the fencing epoch ----------------------------------------------------------
+def test_sim_backend_epoch_claim_and_verify():
+    backend, _ = _fixture()
+    assert backend.controller_epoch() == 0
+    assert backend.claim_controller_epoch() == 1
+    assert backend.claim_controller_epoch(expected=1) == 2
+    with pytest.raises(StaleControllerEpochError):
+        backend.claim_controller_epoch(expected=1)
+    backend.verify_controller_epoch(2)  # current epoch passes
+    with pytest.raises(StaleControllerEpochError):
+        backend.verify_controller_epoch(1)
+
+
+def test_kafka_backend_epoch_rides_cluster_config():
+    from cruise_control_tpu.kafka.backend import (
+        CONTROLLER_EPOCH_KEY,
+        KafkaClusterBackend,
+    )
+    from cruise_control_tpu.kafka.wire import FakeKafkaWire
+
+    wire = FakeKafkaWire(assignment={("t", 0): [0, 1]})
+    be = KafkaClusterBackend(wire)
+    assert be.controller_epoch() == 0
+    assert be.claim_controller_epoch() == 1
+    # the epoch is durable cluster-side state, not process memory
+    assert wire.describe_configs("broker", "")[CONTROLLER_EPOCH_KEY] == "1"
+    be2 = KafkaClusterBackend(wire)  # "another process"
+    assert be2.claim_controller_epoch(expected=1) == 2
+    with pytest.raises(StaleControllerEpochError):
+        be.claim_controller_epoch(expected=1)
+    with pytest.raises(StaleControllerEpochError):
+        be.verify_controller_epoch(1)
+
+
+def test_fenced_wrapper_refuses_every_mutating_call():
+    backend, _ = _fixture()
+    epoch = [1]
+    fenced = FencedClusterBackend(backend, lambda: epoch[0])
+    backend.claim_controller_epoch()  # cluster at 1: our epoch current
+    fenced.alter_partition_reassignments({0: [2, 3]})  # passes
+    backend.claim_controller_epoch()  # another controller took over (2)
+    with capture_events() as recs:
+        for op in (
+            lambda: fenced.alter_partition_reassignments({1: [2, 3]}),
+            lambda: fenced.elect_leaders({0: 2}),
+            lambda: fenced.alter_replica_log_dirs({0: {2: "d1"}}),
+            lambda: fenced.cancel_reassignments([0]),
+            lambda: fenced.set_throttles(100.0, [0]),
+            lambda: fenced.clear_throttles(),
+            lambda: fenced.alter_config("broker", 0, {"k": "v"}),
+        ):
+            with pytest.raises(StaleControllerEpochError):
+                op()
+        fences = recs("executor.fenced")
+    assert len(fences) == 7
+    assert {f["payload"]["op"] for f in fences} == {
+        "alter_partition_reassignments", "elect_leaders",
+        "alter_replica_log_dirs", "cancel_reassignments",
+        "set_throttles", "clear_throttles", "alter_config",
+    }
+    # reads stay open to the fenced-out process (observability must not
+    # die with ownership)
+    assert fenced.alive_brokers() == backend.alive_brokers()
+
+
+def test_executor_epoch_claimed_per_execution_and_stamped_on_records(
+        tmp_path):
+    backend, plan = _fixture()
+    path = str(tmp_path / "ckpt.jsonl")
+    journal = ExecutionJournal(path)
+    ex = Executor(backend, journal=journal)
+    ex.execute_proposals(plan)
+    assert ex.epoch == 1 == backend.controller_epoch()
+    ex.execute_proposals([_prop(0, [2, 3], [0, 1])])
+    assert ex.epoch == 2 == backend.controller_epoch()
+    assert ex.state_summary()["fencing"]["epoch"] == 2
+
+
+def test_journal_records_carry_epoch_and_load_surfaces_it(tmp_path):
+    import json as _json
+
+    path = str(tmp_path / "ckpt.jsonl")
+    j = ExecutionJournal(path)
+    j.set_epoch(3)
+    j.append("start", executionId=1, strategy="", maxTicks=10,
+             proposals=[], sizes={}, config={})
+    j.append("batch", taskIds=[0], tick=1)
+    j.close()
+    with open(path) as f:
+        for line in f:
+            rec = _json.loads(line.rsplit("#", 1)[0]
+                              if "#" in line else line)
+            assert rec.get("epoch") == 3 or "epoch" in str(rec)
+    ck = ExecutionJournal(path).load()
+    assert ck is not None and ck.epoch == 3
+
+
+# ---- zombie resume refusal ------------------------------------------------------
+def test_zombie_resume_is_fenced_and_live_controller_completes(tmp_path):
+    # reference placement from an uninterrupted run
+    ref_backend, ref_plan = _fixture()
+    Executor(ref_backend).execute_proposals(ref_plan)
+    reference = _placement(ref_backend)
+
+    backend, plan = _fixture()
+    path = str(tmp_path / "ckpt.jsonl")
+    journal = ExecutionJournal(path)
+    journal.crash_after(4)  # crash mid-flight, moves dispatched
+    ex_a = Executor(backend, journal=journal)
+    with pytest.raises(ProcessCrash):
+        ex_a.execute_proposals(plan)
+    # the zombie's stale view: the checkpoint as process A left it
+    stale = ExecutionJournal(path).load()
+    assert stale is not None and stale.epoch == 1
+
+    # process B recovers and completes (conditional claim: 1 -> 2)
+    jb = ExecutionJournal(path)
+    ex_b = Executor(backend, journal=jb)
+    result = ex_b.resume(jb.load())
+    assert result.succeeded and ex_b.epoch == 2
+
+    # process A thaws and re-resumes its stale checkpoint: refused at the
+    # CAS, before any mutation
+    with capture_events() as recs:
+        zombie = Executor(backend, journal=None)
+        with pytest.raises(StaleControllerEpochError):
+            zombie.resume(stale)
+        fenced = recs("executor.fenced")
+    assert fenced and fenced[0]["payload"]["op"] == "claim"
+    assert fenced[0]["payload"]["presentedEpoch"] == 1
+    assert fenced[0]["payload"]["clusterEpoch"] == 2
+    assert _placement(backend) == reference, "zombie moved replicas"
+
+
+def test_zombie_fenced_mid_drive_aborts_without_cluster_writes(tmp_path):
+    """A zombie that got PAST startup (claimed long ago, thawed mid-plan)
+    is refused at its next batch dispatch — the in-drive fence."""
+    backend, plan = _fixture(move_latency=1)
+    ex = Executor(backend)
+    alters = []
+    orig = backend.alter_partition_reassignments
+
+    def spy(reassignments):
+        alters.append(dict(reassignments))
+        if len(alters) == 1:
+            # another controller claims the cluster right after our
+            # first batch reaches it
+            backend.claim_controller_epoch()
+        orig(reassignments)
+
+    backend.alter_partition_reassignments = spy
+    cfg = ex.config
+    cfg.num_concurrent_partition_movements_per_broker = 1  # many batches
+    with capture_events() as recs:
+        with pytest.raises(StaleControllerEpochError):
+            ex.execute_proposals(plan)
+        assert recs("executor.fenced")
+    assert not ex.has_ongoing_execution
+    # exactly one batch reached the cluster; everything else aborted
+    assert len(alters) == 1
+    states = [t.state.value for t in ex.planner.all_tasks]
+    assert "IN_PROGRESS" not in states and "PENDING" not in states
+
+
+# ---- detect_ongoing_at_startup: the adopt/stop matrix ---------------------------
+def _backend_with_ongoing():
+    backend, _ = _fixture()
+    backend.claim_controller_epoch()  # cluster epoch 1
+    backend.alter_partition_reassignments({5: [2, 3]})
+    assert backend.ongoing_reassignments() == {5}
+    return backend
+
+
+@pytest.mark.parametrize("stop", (False, True))
+def test_startup_ours_by_epoch_match(stop):
+    backend = _backend_with_ongoing()
+    ex = Executor(backend)
+    with capture_events() as recs:
+        ongoing = ex.detect_ongoing_at_startup(stop=stop,
+                                               checkpoint_epoch=1)
+        assert not recs("executor.foreign_reassignment")
+    assert ongoing == {5}
+    if stop:  # ours + stop: cancelled, nothing to gate on
+        assert ex.adopted_at_startup == set()
+        assert backend.ongoing_reassignments() == set()
+    else:  # ours + no stop: adopt and gate until drained
+        assert ex.adopted_at_startup == {5}
+        assert backend.ongoing_reassignments() == {5}
+
+
+@pytest.mark.parametrize("stop", (False, True))
+def test_startup_foreign_by_epoch_mismatch_never_cancelled(stop):
+    backend = _backend_with_ongoing()
+    backend.claim_controller_epoch()  # cluster epoch 2 > checkpoint 1
+    ex = Executor(backend)
+    with capture_events() as recs:
+        ongoing = ex.detect_ongoing_at_startup(stop=stop,
+                                               checkpoint_epoch=1)
+        foreign = recs("executor.foreign_reassignment")
+    assert ongoing == {5}
+    # foreign work is NEVER cancelled — not even under stop=True: that
+    # would start a reassignment war with a live controller
+    assert backend.ongoing_reassignments() == {5}
+    assert ex.adopted_at_startup == {5}
+    assert foreign and foreign[0]["payload"]["origin"] == "startup"
+    assert foreign[0]["payload"]["partitions"] == [5]
+
+
+@pytest.mark.parametrize("stop", (False, True))
+def test_startup_unknown_epoch_keeps_legacy_behavior(stop):
+    backend = _backend_with_ongoing()
+    ex = Executor(backend)  # no checkpoint epoch known
+    ongoing = ex.detect_ongoing_at_startup(stop=stop)
+    assert ongoing == {5}
+    if stop:
+        assert backend.ongoing_reassignments() == set()
+        assert ex.adopted_at_startup == set()
+    else:
+        assert ex.adopted_at_startup == {5}
+
+
+# ---- throttle leak on crash (satellite) -----------------------------------------
+THROTTLE_KEYS = (
+    "leader.replication.throttled.rate",
+    "follower.replication.throttled.rate",
+    "leader.replication.throttled.replicas",
+    "follower.replication.throttled.replicas",
+)
+
+
+def _throttle_configs(backend):
+    return {
+        scope_entity: dict(cfg)
+        for scope_entity, cfg in backend.dynamic_configs.items()
+        if any(k in cfg for k in THROTTLE_KEYS)
+    }
+
+
+@pytest.mark.parametrize("resume_throttle", (1000.0, None))
+def test_resume_after_crash_clears_orphaned_throttles(tmp_path,
+                                                      resume_throttle):
+    """Crash between set_throttles and the first batch: the dead run's
+    throttle configs are orphans.  Resume re-scopes (adopts) them so its
+    cleanup clears them — whether or not the restarted process itself
+    throttles."""
+    backend, plan = _fixture()
+    path = str(tmp_path / "ckpt.jsonl")
+    journal = ExecutionJournal(path)
+    # appends: start(1), throttle(2); the phase record (3) crashes —
+    # throttles reached the cluster, no batch did
+    journal.crash_after(2)
+    ex = Executor(backend, journal=journal,
+                  config=ExecutorConfig(replication_throttle=1000.0))
+    with pytest.raises(ProcessCrash):
+        ex.execute_proposals(plan)
+    orphans = _throttle_configs(backend)
+    assert orphans, "fixture must leave orphaned throttle configs"
+
+    recovered = ExecutionJournal(path)
+    ck = recovered.load()
+    assert ck is not None and (ck.throttle or {}).get("state") == "set"
+    assert float(ck.throttle["rate"]) == 1000.0
+    ex2 = Executor(
+        backend, journal=recovered,
+        config=ExecutorConfig(replication_throttle=resume_throttle),
+    )
+    result = ex2.resume(ck)
+    assert result.dead == 0
+    assert _throttle_configs(backend) == {}, (
+        "orphaned throttle configs from the dead run survived recovery"
+    )
+    assert backend.throttle_history[-1] == ("clear", 0.0)
+
+
+def test_resume_preserves_genuine_user_throttles(tmp_path):
+    """Value-matched adoption: a user throttle at a DIFFERENT rate on a
+    participating broker is not ours and must survive the cleanup."""
+    backend, plan = _fixture()
+    backend.alter_config("broker", 2,
+                         {"leader.replication.throttled.rate": "777"})
+    path = str(tmp_path / "ckpt.jsonl")
+    journal = ExecutionJournal(path)
+    journal.crash_after(2)
+    ex = Executor(backend, journal=journal,
+                  config=ExecutorConfig(replication_throttle=1000.0))
+    with pytest.raises(ProcessCrash):
+        ex.execute_proposals(plan)
+    recovered = ExecutionJournal(path)
+    ex2 = Executor(backend, journal=recovered, config=ExecutorConfig())
+    ex2.resume(recovered.load())
+    assert backend.describe_config("broker", 2) == {
+        "leader.replication.throttled.rate": "777"
+    }
+    leftovers = {
+        k: v for se, cfg in _throttle_configs(backend).items()
+        for k, v in cfg.items() if se != ("broker", 2)
+    }
+    assert leftovers == {}
+
+
+# ---- ConcurrencyAdjuster under foreign URPs (satellite) -------------------------
+def test_adjuster_halves_under_external_urps_and_recovers():
+    adj = ConcurrencyAdjuster(initial_cap=8, min_cap=1, max_cap=8,
+                              healthy_ticks_before_increase=2)
+    # sustained FOREIGN catch-up traffic: multiplicative decrease to the
+    # floor, never below
+    caps = [adj.observe({100 + i}) for i in range(5)]
+    assert caps == [4, 2, 1, 1, 1]
+    assert [a for a in adj.adjustments if a[0] == "decrease"]
+    # the foreign moves drain: additive recovery, capped at the ceiling
+    caps = [adj.observe(set()) for _ in range(16)]
+    assert caps[-1] == 8
+    assert sorted(set(caps)) == [1, 2, 3, 4, 5, 6, 7, 8]
+
+
+def test_drive_loop_feeds_foreign_urps_to_adjuster():
+    """A foreign reassignment's catch-up URPs (not our in-flight moves)
+    must reach the adjuster as external stress and halve the cap."""
+    backend, plan = _fixture(move_latency=30)
+    # a foreign move catching up for a long time: partition 3 is not in
+    # the plan, broker 1's new copy never finishes quickly
+    backend.alter_partition_reassignments({3: [3, 1]})
+    ex = Executor(backend, config=ExecutorConfig(
+        num_concurrent_partition_movements_per_broker=4,
+        concurrency_adjuster_enabled=True,
+        concurrency_adjuster_min_cap=1,
+        task_timeout_ticks=100,
+    ))
+    ex.execute_proposals(plan, max_ticks=200)
+    assert ex.adjuster is not None
+    assert ("decrease", 2) in ex.adjuster.adjustments
+
+
+# ---- per-batch precondition revalidation ----------------------------------------
+def test_deleted_partition_cancels_with_categorical_reason():
+    backend, plan = _fixture()
+    backend.delete_partitions([4])
+    with capture_events() as recs:
+        ex = Executor(backend)
+        result = ex.execute_proposals(plan)
+        drift = recs("executor.topology_drift")
+        ends = recs("executor.end")
+    # partition 4's replica task AND its sibling leader task both cancel
+    # (the other two proposals complete: 2 replica + 2 leader tasks)
+    assert result.completed == 4 and result.aborted == 2
+    assert result.dead == 0, "deletion must not burn the retry budget"
+    assert any(d["payload"]["reason"] == "topology-drift:deleted"
+               and d["payload"]["partition"] == 4 for d in drift)
+    assert ends[-1]["payload"]["topologyDrift"] == {"deleted": 2}
+
+
+def test_rf_change_cancels_with_categorical_reason():
+    backend, plan = _fixture()
+    # an external tool bumped partition 1 to RF 3 before our batch
+    st = backend.partitions[1]
+    st.replicas = list(st.replicas) + [3]
+    with capture_events() as recs:
+        ex = Executor(backend)
+        result = ex.execute_proposals(plan)
+        drift = recs("executor.topology_drift")
+    assert result.dead == 0 and result.aborted == 1
+    assert any(d["payload"]["reason"] == "topology-drift:rf-changed"
+               for d in drift)
+
+
+def test_foreign_predispatch_conflict_yields_then_completes():
+    backend, plan = _fixture(move_latency=1)
+    # a foreign move already owns planned partition 0 with a DIFFERENT
+    # target; at latency 1 it drains after one tick
+    backend.alter_partition_reassignments({0: [1, 2]})
+    with capture_events() as recs:
+        ex = Executor(backend, config=ExecutorConfig(
+            foreign_conflict_policy="yield",
+            foreign_yield_backoff_ticks=2,
+        ))
+        result = ex.execute_proposals(plan)
+        foreign = recs("executor.foreign_reassignment")
+    assert result.completed == 6 and result.dead == 0  # 3 replica + 3 leader
+    assert _placement(backend)[0] == [2, 3], "our target must win"
+    assert any(f["payload"]["conflict"] and
+               f["payload"]["origin"] == "pre-dispatch" for f in foreign)
+
+
+def test_foreign_conflict_abort_policy_aborts_plan():
+    backend, plan = _fixture(move_latency=50)
+    backend.alter_partition_reassignments({0: [1, 2]})
+    with capture_events() as recs:
+        ex = Executor(backend, config=ExecutorConfig(
+            foreign_conflict_policy="abort",
+        ))
+        result = ex.execute_proposals(plan)
+        assert recs("executor.foreign_reassignment")
+    assert result.stopped and result.dead == 0
+    assert result.completed == 0
+
+
+def test_disjoint_foreign_is_tolerated_and_journaled_once():
+    backend, plan = _fixture(move_latency=2)
+    backend.alter_partition_reassignments({3: [3, 0]})  # not in the plan
+    with capture_events() as recs:
+        ex = Executor(backend)
+        result = ex.execute_proposals(plan)
+        foreign = recs("executor.foreign_reassignment")
+    assert result.completed == 6 and result.dead == 0  # 3 replica + 3 leader
+    disjoint = [f for f in foreign if not f["payload"]["conflict"]]
+    assert len(disjoint) == 1  # once per partition, not per tick
+    assert disjoint[0]["payload"]["partitions"] == [3]
+
+
+# ---- THE interleaving harness ---------------------------------------------------
+@pytest.mark.parametrize("policy", ("yield", "abort"))
+@pytest.mark.parametrize("conflict", (False, True))
+def test_foreign_alter_at_every_batch_boundary(policy, conflict):
+    """Inject a foreign alter immediately before the k-th executor batch,
+    for EVERY k the plan produces (kill-at-every-checkpoint style), under
+    both conflict policies: the cluster must converge with zero
+    double-applied moves and zero silent-wrong placements."""
+    # reference: what the foreign move alone would do to its partition
+    boundaries = 0
+    for k in range(0, 20):
+        backend, plan = _fixture(move_latency=2)
+        raw_alter = SimulatedClusterBackend.alter_partition_reassignments
+        planned = {p.partition: list(p.new_replicas) for p in plan}
+        originals = {p: list(st.replicas)
+                     for p, st in backend.partitions.items()}
+        executor_alters = []
+        foreign_applied = {}
+        state = {"n": 0}
+        holder = {}
+
+        def spy(reassignments, _backend=backend, _k=k, _state=state,
+                _applied=foreign_applied, _log=executor_alters,
+                _conflict=conflict, _holder=holder):
+            # the executor's k-th batch boundary: the foreign writer
+            # lands its alter FIRST (raw backend — no fence, exactly
+            # like kafka-reassign-partitions)
+            if _state["n"] == _k and not _applied:
+                victim = sorted(
+                    p for p in (reassignments if _conflict
+                                else set(_backend.partitions)
+                                - set(planned))
+                )
+                if victim:
+                    p = victim[0]
+                    st = _backend.partitions[p]
+                    base = [b for b in st.replicas
+                            if b not in st.catching_up] or list(st.replicas)
+                    cand = sorted(b for b in _backend.brokers
+                                  if b not in st.replicas)
+                    if cand:
+                        tgt = base[:-1] + [cand[0]]
+                        _applied[p] = tgt
+                        raw_alter(_backend, {p: tgt})
+            _state["n"] += 1
+            done_now = set()
+            ex_live = _holder.get("ex")
+            if ex_live is not None and ex_live.planner is not None:
+                from cruise_control_tpu.executor.tasks import TaskState
+
+                done_now = {
+                    t.proposal.partition
+                    for t in ex_live.planner.replica_tasks
+                    if t.state is TaskState.COMPLETED
+                }
+            _log.append((dict(reassignments), done_now))
+            raw_alter(_backend, reassignments)
+
+        backend.alter_partition_reassignments = spy
+        ex = Executor(backend, config=ExecutorConfig(
+            num_concurrent_partition_movements_per_broker=1,  # many batches
+            foreign_conflict_policy=policy,
+            task_retry_max_attempts=3,
+            task_retry_jitter_ticks=0,
+            foreign_yield_backoff_ticks=2,
+        ))
+        holder["ex"] = ex
+        result = ex.execute_proposals(plan, max_ticks=300)
+        if state["n"] <= k and not foreign_applied:
+            break  # fewer batches than k: every boundary exercised
+        boundaries += 1
+        _settle(backend)
+        final = _placement(backend)
+        # zero silent-wrong placements: every partition ends at exactly
+        # one of (original, planned target, foreign target)
+        for p, replicas in final.items():
+            legal = [originals[p]]
+            if p in planned:
+                legal.append(planned[p])
+            if p in foreign_applied:
+                legal.append(foreign_applied[p])
+            assert replicas in legal, (
+                f"k={k} {policy} conflict={conflict}: partition {p} at "
+                f"{replicas}, legal {legal}"
+            )
+        # zero double-applied moves: a COMPLETED task's partition is
+        # never re-altered, and re-issues stay inside the retry budget
+        counts = {}
+        for batch_, done_at_call in executor_alters:
+            overlap = set(batch_) & done_at_call
+            assert not overlap, (
+                f"k={k} {policy}: re-altered completed partition(s) "
+                f"{sorted(overlap)}"
+            )
+            for p in batch_:
+                counts[p] = counts.get(p, 0) + 1
+        for p, n in counts.items():
+            assert n <= 1 + 3, (p, n)
+        assert result.dead == 0, (k, policy, conflict, result)
+        if policy == "yield" and not conflict:
+            # disjoint foreign + yield: the full plan must land
+            # (a replica task + a leadership task per proposal)
+            assert result.completed == 2 * len(plan)
+            for p, tgt in planned.items():
+                assert final[p] == tgt
+    assert boundaries >= 3, "the fixture must exercise several boundaries"
+
+
+# ---- the foreign-reassignment detector ------------------------------------------
+def test_foreign_detector_pages_only_on_persistent_activity():
+    backend, plan = _fixture(move_latency=1)
+
+    class _CC:
+        pass
+
+    cc = _CC()
+    cc.executor = Executor(backend)
+    det = ForeignReassignmentDetector(cc, backend,
+                                      min_consecutive_cycles=3)
+    assert det.detect(0) == []
+    backend.alter_partition_reassignments({3: [3, 0]})
+    assert det.detect(1) == []      # cycle 1: tolerated
+    assert det.detect(2) == []      # cycle 2: tolerated
+    found = det.detect(3)           # cycle 3: persistent -> anomaly
+    assert len(found) == 1
+    a = found[0]
+    assert a.anomaly_type.value == "FOREIGN_REASSIGNMENT"
+    assert a.partitions == [3] and not a.fixable
+    backend.tick()                  # the foreign move drains
+    assert det.detect(4) == []
+    assert det._streak == {}
+
+
+def test_foreign_detector_ignores_our_own_execution():
+    backend, plan = _fixture(move_latency=50)
+
+    class _CC:
+        pass
+
+    cc = _CC()
+    ex = Executor(backend)
+    cc.executor = ex
+    det = ForeignReassignmentDetector(cc, backend,
+                                      min_consecutive_cycles=1)
+    # adopted-at-startup moves are ours, not foreign
+    backend.alter_partition_reassignments({5: [2, 3]})
+    ex.adopted_at_startup = {5}
+    assert det.detect(0) == []

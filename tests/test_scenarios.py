@@ -39,7 +39,7 @@ from cruise_control_tpu.sim.timeline import (
 from test_artifact_schemas import SCHEMAS, validate
 
 MIN = MIN_MS
-ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r13.json"
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r15.json"
 
 #: the outcome each scripted timeline must reach — also pinned against the
 #: committed artifact below, so a regression shows up in tier-1 without
@@ -71,6 +71,10 @@ EXPECTED_OUTCOMES = {
     "poisoned_metrics_quarantined_then_healed": "HEALED",
     "checkpoint_bitflip_recovers_loudly": "HEALED",
     "engine_failure_degrades_to_greedy": "HEALED",
+    "foreign_reassignment_tolerated": "HEALED",
+    "foreign_conflict_yield_retries": "HEALED",
+    "zombie_controller_fenced": "HEALED",
+    "topology_drift_mid_execution": "HEALED",
 }
 
 _cache = {}
@@ -483,6 +487,71 @@ def _check_engine_failure_degrades_to_greedy(r):
     assert not r.events_of("analyzer.engine_recovered")
 
 
+# ---- concurrent-controller safety (ISSUE 15) ------------------------------------
+def _check_foreign_reassignment_tolerated(r):
+    foreign = [e["payload"]
+               for e in r.events_of("executor.foreign_reassignment")]
+    assert foreign and all(not f["conflict"] for f in foreign)
+    assert foreign[0]["origin"] == "mid-flight"
+    # tolerated: the plan completed untouched, nothing died or aborted
+    ends = r.executor_ends()
+    assert ends[0].get("topologyDrift", {}).get("foreignObserved", 0) >= 1
+    assert r.dead_tasks() == 0
+    assert all(e["aborted"] == 0 for e in ends)
+    assert not r.events_of("executor.fenced")
+
+
+def _check_foreign_conflict_yield_retries(r):
+    foreign = [e["payload"]
+               for e in r.events_of("executor.foreign_reassignment")]
+    assert any(f["conflict"] and f["policy"] == "yield" for f in foreign)
+    retries = [e["payload"] for e in r.events_of("executor.task_retry")]
+    assert any(p["reason"] == "foreign-conflict" for p in retries)
+    # yielded, retried, converged: zero dead tasks, zero aborted moves,
+    # the first execution's end carries the conflict tally
+    ends = r.executor_ends()
+    assert ends[0].get("topologyDrift", {}).get("foreignConflict", 0) >= 1
+    assert r.dead_tasks() == 0
+    assert all(e["aborted"] == 0 for e in ends)
+
+
+def _check_zombie_controller_fenced(r):
+    (fenced,) = r.events_of("executor.fenced")
+    assert fenced["severity"] == "ERROR"
+    assert fenced["payload"]["op"] == "claim"
+    assert fenced["payload"]["presentedEpoch"] < \
+        fenced["payload"]["clusterEpoch"]
+    # the sim's zombie record agrees: refused, not resumed
+    (zombie,) = [e["payload"] for e in r.events_of("sim.fault")
+                 if e["payload"].get("fault") == "zombie_controller_resume"]
+    assert zombie["zombie"] == "fenced"
+    # the LIVE controller's recovery stands: resumed and completed
+    (recovery,) = r.recoveries()
+    assert recovery["outcome"] == "resumed" and recovery["succeeded"]
+    # ordered: the zombie refusal comes after the live recovery finished
+    idx = {e["kind"]: i for i, e in enumerate(r.journal)}
+    assert idx["execution.recovery.end"] < idx["executor.fenced"]
+    assert r.dead_tasks() == 0
+
+
+def _check_topology_drift_mid_execution(r):
+    drift = [e["payload"] for e in r.events_of("executor.topology_drift")]
+    assert drift and all(
+        d["reason"] == "topology-drift:deleted" for d in drift
+    )
+    # partial-graceful: the categorical cancels never burned the retry
+    # budget (zero DEAD tasks, zero executor.task_retry on drift)
+    ends = r.executor_ends()
+    assert ends[0].get("topologyDrift", {}).get("deleted", 0) >= 1
+    assert r.dead_tasks() == 0
+    assert not [e for e in r.events_of("executor.task_retry")
+                if e["payload"]["reason"].startswith("topology-drift")]
+    # the monitor absorbed both the shrink and the later growth: no
+    # detector ever failed a cycle on the drifted universe
+    assert not r.events_of("detector.detect_failed")
+    assert r.fixes_started("GOAL_VIOLATION")
+
+
 CHECKS = {
     "broker_death_mid_execution": _check_broker_death_mid_execution,
     "rack_loss": _check_rack_loss,
@@ -518,6 +587,10 @@ CHECKS = {
         _check_checkpoint_bitflip_recovers_loudly,
     "engine_failure_degrades_to_greedy":
         _check_engine_failure_degrades_to_greedy,
+    "foreign_reassignment_tolerated": _check_foreign_reassignment_tolerated,
+    "foreign_conflict_yield_retries": _check_foreign_conflict_yield_retries,
+    "zombie_controller_fenced": _check_zombie_controller_fenced,
+    "topology_drift_mid_execution": _check_topology_drift_mid_execution,
 }
 
 

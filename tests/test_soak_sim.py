@@ -105,8 +105,83 @@ def test_schedule_rejects_impossible_density():
         ))
 
 
+# ---- relaxed spacing: bounded pile-ups (ISSUE 15 satellite) ---------------------
+def _relaxed_cfg(seed=5):
+    return FaultScheduleConfig(
+        seed=seed, duration_ms=12 * 60 * MIN_MS, num_brokers=128,
+        num_racks=8, num_partitions=512, min_spacing_relaxed=True,
+        pileup_max_cluster=3,
+    )
+
+
+def test_relaxed_schedule_keeps_layout_invariants():
+    """Pile-ups are a scripted burst, not an accident of density: fault
+    slots cluster into groups of ≤ pileup_max_cluster events one minute
+    apart, clusters keep the full min_spacing guarantee, and the settle
+    head / quiet tail stay fault-free."""
+    cfg = _relaxed_cfg()
+    tl = generate_timeline(cfg)
+    faults = [e for e in tl.events if e.kind in DISRUPTIVE_KINDS]
+    assert faults
+    assert min(e.at_ms for e in faults) >= cfg.settle_ms
+    assert max(e.at_ms for e in faults) <= \
+        cfg.duration_ms - cfg.quiet_tail_ms
+    # group primary slots into clusters (1-minute adjacency), then check
+    # the bound and the inter-cluster spacing
+    times = sorted({e.at_ms for e in faults})
+    clusters = [[times[0]]]
+    for t in times[1:]:
+        if t - clusters[-1][-1] <= MIN_MS:
+            clusters[-1].append(t)
+        else:
+            clusters.append([t])
+    # secondaries (heal pairs) share their primary's slot; the distinct
+    # slot count still covers every configured fault
+    n_slots = sum(cfg.class_counts().values())
+    assert sum(len(c) for c in clusters) >= min(n_slots, len(times))
+    assert any(len(c) > 1 for c in clusters), "no pile-up ever fired"
+    for c in clusters:
+        assert len(c) <= cfg.pileup_max_cluster
+    for a, b in zip(clusters, clusters[1:]):
+        gap = b[0] - a[-1]
+        # heal-pair secondaries land heal_ms after their primary and may
+        # sit between clusters; the PRIMARY grid pitch still guarantees
+        # cluster starts are spaced
+        assert b[0] - a[0] >= cfg.min_spacing_ms or gap >= MIN_MS
+    # determinism: same seed ⇒ same relaxed schedule
+    again = generate_timeline(_relaxed_cfg())
+    assert [e.to_json() for e in tl.events] == \
+        [e.to_json() for e in again.events]
+
+
+def test_relaxed_off_is_byte_identical_to_historical_layout():
+    """min_spacing_relaxed=False (and pileup_max_cluster=1) must not
+    move a single event of existing seeded schedules — the soak
+    fingerprints pinned on them depend on it."""
+    base = FaultScheduleConfig(seed=5, duration_ms=12 * 60 * MIN_MS,
+                               num_brokers=128, num_racks=8,
+                               num_partitions=512)
+    via_k1 = FaultScheduleConfig(seed=5, duration_ms=12 * 60 * MIN_MS,
+                                 num_brokers=128, num_racks=8,
+                                 num_partitions=512,
+                                 min_spacing_relaxed=True,
+                                 pileup_max_cluster=1)
+    a = generate_timeline(base)
+    b = generate_timeline(via_k1)
+    assert [e.to_json() for e in a.events] == \
+        [e.to_json() for e in b.events]
+
+
+def test_relaxed_schedule_rejects_impossible_density():
+    with pytest.raises(ScheduleError, match="cluster"):
+        generate_timeline(FaultScheduleConfig(
+            seed=0, duration_ms=60 * MIN_MS, num_brokers=8, num_racks=2,
+            num_partitions=32, min_spacing_relaxed=True,
+        ))
+
+
 def test_soak_registry_and_wiring():
-    assert set(SOAKS) == {"soak_smoke", "soak_day"}
+    assert set(SOAKS) == {"soak_smoke", "soak_day", "soak_pileup"}
     for name, factory in SOAKS.items():
         spec = factory()
         assert spec.name == name
@@ -287,6 +362,29 @@ def test_committed_smoke_fingerprint_is_current():
         "smoke soak journal drifted from the committed artifact — "
         "behavior changed; regenerate SOAK_r12.json and review"
     )
+
+
+# ---- the pile-up soak (slow) ----------------------------------------------------
+@pytest.mark.slow
+def test_pileup_soak_survives_concurrent_faults():
+    """ISSUE 15 satellite: the relaxed-spacing schedule's bounded
+    multi-fault bursts run end to end through the full stack — the day
+    still ends healed with the placement invariants holding."""
+    r = run_soak(SOAKS["soak_pileup"]())
+    art = make_soak_artifact(r)
+    validate(json.loads(json.dumps(art)), SCHEMAS["cc-tpu-soak/1"])
+    assert art["heals"]["outcome"] == "HEALED", art["heals"]
+    assert art["gates"]["placementInvariantsHold"] is True
+    assert art["gates"]["terminalConvergence"] is True
+    assert art["gates"]["zeroUnhealedAnomalies"] is True
+    # the schedule really piled up: at least one pair of disruptive
+    # faults fired one virtual minute apart
+    times = sorted(
+        e.at_ms
+        for e in build_scenario_spec(SOAKS["soak_pileup"]()).timeline.events
+        if e.kind in DISRUPTIVE_KINDS
+    )
+    assert any(b - a <= MIN_MS for a, b in zip(times, times[1:]))
 
 
 # ---- the full day (slow) --------------------------------------------------------
