@@ -201,10 +201,14 @@ def main() -> None:
     # INTERLEAVED off/on pairs, best-of-each-side: the deltas being
     # resolved are single-digit milliseconds on a ~quarter-second metric,
     # and sequential A-then-B measurement folds allocator/GC drift into
-    # whichever side runs second (measured: ±2% either direction)
+    # whichever side runs second (measured: ±2% either direction).
+    # 21 pairs, not 7: on a 1-CPU box the neighbors' steal arrives in
+    # multi-second bursts, and 7 draws (~1.75s a side) can land entirely
+    # inside one — the minima only converge when the window outlasts it
+    # (same reasoning on every interleaved gate below)
     tracing.reset()
     tpu_off_s = tpu_traced_s = np.inf
-    for _ in range(7):
+    for _ in range(21):
         tracing.configure(enabled=False)
         t0 = time.perf_counter()
         tpu_opt.optimize(state)
@@ -226,7 +230,7 @@ def main() -> None:
     recorder = FlightRecorder(DEFAULT_REGISTRY, interval_s=0.1,
                               retention=4096)
     rec_off_s = rec_on_s = np.inf
-    for _ in range(7):
+    for _ in range(21):
         t0 = time.perf_counter()
         tpu_opt.optimize(state)
         rec_off_s = min(rec_off_s, time.perf_counter() - t0)
@@ -249,7 +253,7 @@ def main() -> None:
         tempfile.mkdtemp(prefix="cc-events-bench-"), "events.jsonl"
     )
     ev_off_s = ev_on_s = np.inf
-    for _ in range(7):
+    for _ in range(21):
         events.configure(enabled=False)
         t0 = time.perf_counter()
         tpu_opt.optimize(state)
@@ -296,7 +300,7 @@ def main() -> None:
         ex = Executor(backend, ExecutorConfig(), journal=journal)
         ex.execute_proposals(plan, max_ticks=10**6)
 
-    # best-of-9 with the CYCLE COLLECTOR off: the measured quantity is a
+    # best-of-25 with the CYCLE COLLECTOR off: the measured quantity is a
     # ~2ms delta between ~10ms drives, and by this point the process
     # heap holds everything the earlier gates allocated — allocation-
     # count-triggered gc passes inside a drive charge the journal a
@@ -309,7 +313,7 @@ def main() -> None:
     gc.collect()
     gc.disable()
     try:
-        for _ in range(9):
+        for _ in range(25):
             t0 = time.perf_counter()
             _drive(None)
             ck_off_s = min(ck_off_s, time.perf_counter() - t0)
@@ -333,18 +337,55 @@ def main() -> None:
 
     pre_cc = _full_stack_cc(engine="greedy")
     pre_cc.get_proposals()  # warm + generation-fresh for the whole gate
+    # This is the one TWO-SIDED (±1%) gate, so a favorable-direction
+    # noise floor fails it just as hard — and best-of-each-side minima
+    # refuse to converge inside ±1% on this guest (observed swinging
+    # -1.4%..+2.9% across runs).  So this gate alone uses the paired
+    # estimator: median of per-pair (on − off) deltas.  Adjacent draws
+    # share their environment, so the subtraction cancels slow drift
+    # (allocator growth, guest-frequency policy) that hits the two
+    # minima independently, and the median discards the draws a gc pass
+    # or timeslice theft polluted.  Cycle collector parked (the
+    # checkpoint gate's discipline), plus a neutral 50ms-heartbeat
+    # thread alive through BOTH sides: on a 1-vCPU guest any thread
+    # waking at the daemon's cadence keeps the guest scheduled hot,
+    # which alone makes ON draws measure ~1.3% faster — the heartbeat
+    # equalizes the wake cadence so the delta isolates the daemon's
+    # probe work, not the hypervisor's idle policy.
+    import threading
+
     precompute = ProposalPrecomputingExecutor(pre_cc, interval_s=0.05)
-    pc_off_s = pc_on_s = np.inf
-    for _ in range(7):
-        t0 = time.perf_counter()
-        tpu_opt.optimize(state)
-        pc_off_s = min(pc_off_s, time.perf_counter() - t0)
-        precompute.start(tick_s=0.05)
-        t0 = time.perf_counter()
-        tpu_opt.optimize(state)
-        pc_on_s = min(pc_on_s, time.perf_counter() - t0)
-        precompute.stop()
-    precompute_overhead_pct = (pc_on_s / pc_off_s - 1.0) * 100.0
+    pc_deltas = []
+    pc_offs = []
+    hb_stop = threading.Event()
+
+    def _heartbeat():
+        while not hb_stop.wait(0.05):
+            pass
+
+    hb = threading.Thread(target=_heartbeat, daemon=True)
+    hb.start()
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(35):
+            t0 = time.perf_counter()
+            tpu_opt.optimize(state)
+            pc_off = time.perf_counter() - t0
+            precompute.start(tick_s=0.05)
+            t0 = time.perf_counter()
+            tpu_opt.optimize(state)
+            pc_on = time.perf_counter() - t0
+            precompute.stop()
+            pc_offs.append(pc_off)
+            pc_deltas.append(pc_on - pc_off)
+    finally:
+        gc.enable()
+        hb_stop.set()
+        hb.join()
+    precompute_overhead_pct = (
+        float(np.median(pc_deltas)) / float(np.median(pc_offs)) * 100.0
+    )
 
     # SLO-observatory overhead (ISSUE 11): the SLO engine ticking at a
     # 250ms STRESS interval (120x the production default; a full
@@ -365,11 +406,11 @@ def main() -> None:
         DEFAULT_REGISTRY, events_reader=events.recent,
         maintenance_hooks=[device_cost.MONITOR.capture_pending],
     )
-    # best-of-9 interleaved pairs: the true cost (~one 1.5ms evaluation
+    # best-of-21 interleaved pairs: the true cost (~one 1.5ms evaluation
     # landing inside each measured optimize) is well under the box's
     # run-to-run noise, so both minima need the extra draws to converge
     slo_off_s = slo_on_s = np.inf
-    for i in range(9):
+    for i in range(21):
         trace_mod.configure(enabled=False)
         device_cost.configure(enabled=False)
         t0 = time.perf_counter()
@@ -398,7 +439,7 @@ def main() -> None:
     from cruise_control_tpu.telemetry import kernel_budget
 
     prof_off_s = prof_on_s = np.inf
-    for _ in range(7):
+    for _ in range(21):
         kernel_budget.configure(enabled=False)
         t0 = time.perf_counter()
         tpu_opt.optimize(state)
@@ -428,7 +469,7 @@ def main() -> None:
         val_t[0] += 1000
 
     val_off_s = val_on_s = np.inf
-    for _ in range(9):
+    for _ in range(21):
         val_validator.config.enabled = False
         t0 = time.perf_counter()
         _ingest_pass()
@@ -453,7 +494,7 @@ def main() -> None:
 
     replan_fixture = measure_fixture("load_perturbation", engine="tpu",
                                      best_of=2)
-    replan_overhead = measure_overhead(engine="tpu", rounds=3)
+    replan_overhead = measure_overhead(engine="tpu", rounds=7)
 
     # long-horizon soak smoke gate (ISSUE 12): the tier-1 soak — the
     # seeded composed fault schedule + continuous traffic over the full
@@ -471,6 +512,16 @@ def main() -> None:
     soak_wall_s = time.perf_counter() - t0
     soak_art = make_soak_artifact(soak_result)
     soak_budget_s = 120.0
+
+    # what-if batched-futures gate (ISSUE 16): 64 futures — every rack
+    # loss, every broker loss, a growth ladder — evaluated in ONE
+    # batched vmapped dispatch must cost < 2x a single plan search on
+    # the same 50b/1k fixture (the subsystem's whole premise: a complete
+    # survivability sweep for less than two plan searches).  Full
+    # measurement + the proactive-vs-reactive twins: WHATIF_r16.json.
+    from cruise_control_tpu.whatif.artifact import measure_batch
+
+    whatif_batch = measure_batch(num_futures=64, best_of=3)
 
     phases = _full_path_phases()
     tracing.configure(enabled=False)
@@ -525,6 +576,15 @@ def main() -> None:
                 "slo_evaluations": slo_evaluations,
                 # kernel observatory enabled-but-disarmed vs off (<=1%)
                 "profiler_overhead_pct": round(profiler_overhead_pct, 2),
+                # 64-future batched what-if sweep vs one plan search
+                # (<2x gate; full artifact: WHATIF_r16.json)
+                "whatif_batch_ratio": whatif_batch["ratio"],
+                "whatif_batch": {
+                    "numFutures": whatif_batch["numFutures"],
+                    "batchSize": whatif_batch["batchSize"],
+                    "batchedWallS": whatif_batch["batchedWallS"],
+                    "singlePlanWallS": whatif_batch["singlePlanWallS"],
+                },
                 # the tier-1 soak smoke: all gates green + wall budget
                 "soak_smoke": {
                     "wall_s": round(soak_wall_s, 2),

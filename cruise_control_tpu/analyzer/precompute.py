@@ -194,22 +194,38 @@ class ProposalPrecomputingExecutor:
     def refresh_once(self) -> bool:
         """One precompute pass; False when skipped or failed.
 
-        A pass is skipped (not an error) when the warm plan is still
-        fresh — generation unchanged and not invalidated — so an idle
-        cluster costs one generation probe per tick, not one full
-        optimization."""
+        A pass is skipped (not an error) only when EVERY warm artifact is
+        still fresh — the plan (generation unchanged, not invalidated)
+        AND the precomputed what-if verdict set, which carries its own
+        per-generation freshness.  The probe used to cover present state
+        only, so a model-generation bump could leave stale counterfactual
+        verdicts serving from cache; now each stale half is refreshed
+        independently and an idle cluster still costs one generation
+        probe per tick, not one full optimization."""
         try:
             fresh = getattr(self.cc, "proposal_cache_fresh", None)
-            if fresh is not None and fresh():
+            plan_fresh = fresh is not None and fresh()
+            wfresh = getattr(self.cc, "whatif_cache_fresh", None)
+            # facades without a what-if engine (test doubles) have
+            # nothing to refresh there — treat that half as fresh
+            whatif_fresh = wfresh is None or wfresh()
+            if plan_fresh and whatif_fresh:
                 self.skipped += 1
                 return False
-            # NO breaker pre-check here: the facade's gate is the single
-            # arbiter, and its half-open allow() must be consumed by the
-            # compute itself — this pass IS the probe
-            self.cc.get_proposals(engine=self.engine, ignore_cache=True)
-            self.runs += 1
-            self.last_run_s = time.time()
-            return True
+            did = False
+            if not plan_fresh:
+                # NO breaker pre-check here: the facade's gate is the
+                # single arbiter, and its half-open allow() must be
+                # consumed by the compute itself — this pass IS the probe
+                self.cc.get_proposals(engine=self.engine, ignore_cache=True)
+                did = True
+            if not whatif_fresh:
+                self.cc.refresh_whatif_precompute()
+                did = True
+            if did:
+                self.runs += 1
+                self.last_run_s = time.time()
+            return did
         except Exception as exc:  # model not ready, ongoing execution, ...
             self.errors += 1
             self.last_error = f"{type(exc).__name__}: {exc}"

@@ -70,8 +70,12 @@ class App:
     flight_recorder: object = None
     #: telemetry/slo.SloEngine; None when disabled
     slo_engine: object = None
+    #: whatif/proactive.ProactiveScheduler; None when disabled
+    proactive_scheduler: object = None
 
     def shutdown(self) -> None:
+        if self.proactive_scheduler is not None:
+            self.proactive_scheduler.stop()
         self.cruise_control.stop_proposal_precomputation()
         self.detector_manager.stop()
         self.fetcher_manager.stop()
@@ -677,6 +681,9 @@ def build_app(
         replanner=replanner,
         replan_heals=cfg.get_boolean("replan.heal.enabled"),
         engine_degradation=engine_degradation,
+        whatif_cache_entries=cfg.get_int("whatif.cache.max.entries"),
+        whatif_precompute_futures=cfg.get_int("whatif.precompute.futures"),
+        whatif_max_futures=cfg.get_int("whatif.max.futures"),
     )
     if kafka_mode and cfg.get_int("num.metric.fetchers") > 1:
         # each per-fetcher consumer reads the WHOLE reporter topic (the
@@ -947,8 +954,26 @@ def build_app(
             interval_s=cfg.get("proposal.precompute.interval.ms") / 1000,
             engine=cfg.get("proposal.precompute.engine"),
         )
+    proactive = None
+    if cfg.get_boolean("whatif.proactive.enabled"):
+        # forecast-driven proactive control (ISSUE 16): fit the diurnal
+        # curve to observed ingress, project the peak, rebalance BEFORE
+        # the what-if verdict says a goal breaks
+        from cruise_control_tpu.whatif.proactive import ProactiveScheduler
+
+        proactive = ProactiveScheduler(
+            cc,
+            period_ms=cfg.get_int("whatif.proactive.period.ms"),
+            horizon_ms=cfg.get_int("whatif.proactive.horizon.ms"),
+            threshold=cfg.get_double("whatif.proactive.threshold"),
+            cooldown_ms=cfg.get_int("whatif.proactive.cooldown.ms"),
+            sample_fn=monitor.observed_total_ingress,
+        )
+        proactive.start(
+            interval_s=cfg.get("whatif.proactive.interval.ms") / 1000,
+        )
     return App(cfg, backend, reporter, cc, fetchers, server, detector,
-               flight_recorder, slo_engine)
+               flight_recorder, slo_engine, proactive)
 
 
 def _movement_strategy(cfg: CruiseControlConfig):

@@ -536,6 +536,43 @@ def default_config_def() -> ConfigDef:
              "the greedy engine (analyzer.engine_degraded journaled); "
              "the first TPU attempt past the cooldown is the recovery "
              "probe.", at_least(1), G)
+    d.define("whatif.max.futures", ConfigType.INT, 256,
+             Importance.LOW, "Most hypothetical futures one POST /whatif "
+             "request may carry (each adds one row to the batched device "
+             "dispatch).", at_least(1), G)
+    d.define("whatif.cache.max.entries", ConfigType.INT, 256,
+             Importance.LOW, "Bound on cached per-future what-if verdicts "
+             "(keyed model-generation × future fingerprint; FIFO "
+             "eviction).", at_least(1), G)
+    d.define("whatif.precompute.futures", ConfigType.INT, 0,
+             Importance.MEDIUM, "Top-k likely futures (rack losses, broker "
+             "losses, traffic growth) the precompute daemon keeps warm "
+             "what-if verdicts for, re-evaluated alongside the warm plan "
+             "on every model-generation bump (0 disables; requires "
+             "proposals.precompute.enabled to refresh in background).",
+             at_least(0), G)
+    d.define("whatif.proactive.enabled", ConfigType.BOOLEAN, False,
+             Importance.MEDIUM, "Forecast-driven proactive control: fit a "
+             "diurnal model to observed load, project the next peak, ask "
+             "the what-if engine whether the cluster survives it, and "
+             "trigger a rebalance BEFORE the projected breach "
+             "(proactive.* journal kinds).", None, G)
+    d.define("whatif.proactive.period.ms", ConfigType.LONG, 86_400_000,
+             Importance.LOW, "Diurnal period the proactive forecaster "
+             "fits (24h for real workloads; the sim passes its own).",
+             at_least(1), G)
+    d.define("whatif.proactive.horizon.ms", ConfigType.LONG, 3_600_000,
+             Importance.LOW, "How far ahead the proactive forecaster "
+             "looks for the projected peak.", at_least(1), G)
+    d.define("whatif.proactive.threshold", ConfigType.DOUBLE, 1.1,
+             Importance.LOW, "Projected-peak/current load ratio below "
+             "which the proactive scheduler stands down.", at_least(1), G)
+    d.define("whatif.proactive.cooldown.ms", ConfigType.LONG, 1_800_000,
+             Importance.LOW, "Minimum spacing between proactive "
+             "rebalances.", at_least(0), G)
+    d.define("whatif.proactive.interval.ms", ConfigType.LONG, 60_000,
+             Importance.LOW, "Proactive scheduler tick period (sample + "
+             "decide).", at_least(1), G)
 
     G = "executor"
     d.define("num.concurrent.partition.movements.per.broker", ConfigType.INT, 5,

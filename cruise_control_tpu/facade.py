@@ -62,8 +62,29 @@ from cruise_control_tpu.server.progress import OperationProgress
 from cruise_control_tpu.telemetry import events, tracing
 from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.utils.metrics import DEFAULT_REGISTRY, MetricRegistry
+from cruise_control_tpu.whatif.cache import WhatifCache
 
 LOG = get_logger("facade")
+
+
+@dataclasses.dataclass
+class WhatifResult:
+    """Per-future verdicts from one ``whatif()`` call (the ``POST
+    /whatif`` response body via ``to_json``)."""
+
+    verdicts: List[dict]
+    generation: str
+    batch_size: int
+    cached: bool
+
+    def to_json(self) -> dict:
+        return {
+            "verdicts": self.verdicts,
+            "generation": self.generation,
+            "numFutures": len(self.verdicts),
+            "batchSize": self.batch_size,
+            "cached": self.cached,
+        }
 
 
 @dataclasses.dataclass
@@ -103,6 +124,9 @@ class CruiseControl:
         replanner: Optional["DeltaReplanner"] = None,
         replan_heals: bool = False,
         engine_degradation: Optional["EngineDegradation"] = None,
+        whatif_cache_entries: int = 256,
+        whatif_precompute_futures: int = 0,
+        whatif_max_futures: int = 256,
     ):
         self.load_monitor = load_monitor
         self.executor = executor
@@ -179,6 +203,15 @@ class CruiseControl:
         #: GET /proposals stampede on a cold cache must not fan out into
         #: N identical optimizations
         self._compute_lock = threading.Lock()
+        # counterfactual what-if engine (ISSUE 16): per-future verdicts
+        # keyed model_generation × fingerprint, invalidated with the warm
+        # plan; whatif.precompute.futures > 0 keeps the top-k likely
+        # futures warm through the precompute daemon
+        self._whatif_cache = WhatifCache(whatif_cache_entries)
+        self.whatif_precompute_futures = max(
+            0, int(whatif_precompute_futures)
+        )
+        self.whatif_max_futures = max(1, int(whatif_max_futures))
 
     # ---- engine selection -------------------------------------------------------
     def _make_engine(self, engine: Optional[str], constraint=None):
@@ -1143,12 +1176,15 @@ class CruiseControl:
     def invalidate_proposal_cache(self, reason: str = "execution") -> None:
         """Drop the TTL cache and mark the warm plan stale.  The warm plan
         is KEPT — it is the last-good answer degraded-mode serving falls
-        back on, now carrying its invalidation reason."""
+        back on, now carrying its invalidation reason.  What-if verdicts
+        ride the same invalidation: a counterfactual computed against a
+        model the cluster no longer matches has no stale-serving value."""
         with self._cache_lock:
             self._cached_proposals = None
             if self._last_good is not None and \
                     self._last_good.invalidated is None:
                 self._last_good.invalidated = reason
+        self._whatif_cache.invalidate(reason)
 
     def note_anomaly(self, anomaly) -> None:
         """Detector hook: a detected anomaly means the model the warm plan
@@ -1327,6 +1363,146 @@ class CruiseControl:
         if self.proposal_precomputer is not None:
             self.proposal_precomputer.stop()
             self.proposal_precomputer = None
+
+    # ---- counterfactual what-if engine (ISSUE 16) -------------------------------
+    def whatif(
+        self,
+        futures: Optional[Sequence] = None,
+        progress: Optional[OperationProgress] = None,
+        use_cache: bool = True,
+    ) -> WhatifResult:
+        """Evaluate hypothetical futures in ONE batched device dispatch.
+
+        ``futures`` is a sequence of :class:`whatif.FutureSpec`; None
+        derives the model's likely futures.  Verdicts are cached per
+        ``model_generation × fingerprint`` — an all-hit request answers
+        in microseconds without touching the model semaphore."""
+        from cruise_control_tpu.whatif.compiler import compile_futures
+        from cruise_control_tpu.whatif.engine import (
+            evaluate_batch,
+            verdicts as verdicts_of,
+        )
+        from cruise_control_tpu.whatif.futures import likely_futures
+
+        progress = progress or OperationProgress("WHATIF")
+        generation = self._model_generation()
+        if futures is not None:
+            futures = tuple(futures)
+            if len(futures) > self.whatif_max_futures:
+                raise ValueError(
+                    f"{len(futures)} futures > cap "
+                    f"{self.whatif_max_futures} (whatif.max.futures)"
+                )
+            if use_cache:
+                cached = [
+                    self._whatif_cache.get(generation, f.fingerprint())
+                    for f in futures
+                ]
+                if all(v is not None for v in cached):
+                    self.registry.meter("whatif.cache.hit").mark()
+                    events.emit(
+                        "whatif.request", numFutures=len(futures),
+                        cached=True, generation=generation,
+                    )
+                    progress.add_step("Serving cached what-if verdicts")
+                    progress.finish()
+                    return WhatifResult(
+                        verdicts=cached, generation=generation,
+                        batch_size=0, cached=True,
+                    )
+        self.registry.meter("whatif.cache.miss").mark()
+        state = self._model(None, progress)
+        if futures is None:
+            futures = likely_futures(
+                state, k=max(self.whatif_precompute_futures, 8)
+            )
+        events.emit(
+            "whatif.request", numFutures=len(futures), cached=False,
+            generation=generation,
+        )
+        with progress.step(f"Evaluating {len(futures)} futures"), \
+                tracing.span("whatif.evaluate"):
+            t0 = time.perf_counter()
+            batch = compile_futures(state, futures)
+            raw = evaluate_batch(
+                state, batch, capacity_scale=self._whatif_capacity_scale()
+            )
+            duration_s = time.perf_counter() - t0
+        verdict_list = verdicts_of(batch, raw)
+        for f, v in zip(futures, verdict_list):
+            self._whatif_cache.put(generation, f.fingerprint(), v)
+        events.emit(
+            "whatif.evaluated", numFutures=len(futures),
+            batchSize=batch.padded_size, generation=generation,
+            survivable=sum(1 for v in verdict_list if v["survivable"]),
+            violations=sum(v["goalViolations"] for v in verdict_list),
+            durationS=round(duration_s, 4),
+        )
+        progress.finish()
+        return WhatifResult(
+            verdicts=verdict_list, generation=generation,
+            batch_size=batch.padded_size, cached=False,
+        )
+
+    def _whatif_capacity_scale(self):
+        """Per-resource usable-fraction vector from the analyzer's
+        capacity thresholds, so what-if overload verdicts share the
+        capacity goals' bar instead of raw hardware limits."""
+        from cruise_control_tpu.common.resources import (
+            NUM_RESOURCES,
+            Resource,
+        )
+
+        thresholds = self.constraint.capacity_threshold
+        return [
+            float(thresholds.get(Resource(r), 1.0))
+            for r in range(NUM_RESOURCES)
+        ]
+
+    def whatif_cache_fresh(self) -> bool:
+        """The precompute daemon's per-future freshness probe (the
+        satellite-2 generalization of ``proposal_cache_fresh``): True
+        while the warm top-k future set still answers for the live model
+        generation — or what-if precompute is disabled entirely."""
+        if self.whatif_precompute_futures <= 0:
+            return True
+        return self._whatif_cache.fresh_for(self._model_generation())
+
+    def refresh_whatif_precompute(self) -> int:
+        """Re-evaluate the top-k likely futures against a fresh model and
+        mark the warm set current (daemon-driven; one batched dispatch)."""
+        from cruise_control_tpu.whatif.compiler import compile_futures
+        from cruise_control_tpu.whatif.engine import (
+            evaluate_batch,
+            verdicts as verdicts_of,
+        )
+        from cruise_control_tpu.whatif.futures import likely_futures
+
+        k = self.whatif_precompute_futures
+        if k <= 0:
+            return 0
+        progress = OperationProgress("WHATIF")
+        generation = self._model_generation()
+        state = self._model(None, progress)
+        futures = likely_futures(state, k)
+        if not futures:
+            return 0
+        batch = compile_futures(state, futures)
+        raw = evaluate_batch(
+            state, batch, capacity_scale=self._whatif_capacity_scale()
+        )
+        for f, v in zip(futures, verdicts_of(batch, raw)):
+            self._whatif_cache.put(generation, f.fingerprint(), v)
+        self._whatif_cache.mark_warm(generation)
+        events.emit(
+            "whatif.precompute", numFutures=len(futures),
+            generation=generation,
+        )
+        progress.finish()
+        return len(futures)
+
+    def whatif_cache_state(self) -> dict:
+        return self._whatif_cache.state_summary()
 
     def rightsize(
         self, progress: Optional[OperationProgress] = None

@@ -194,6 +194,15 @@ class MetricSampleAggregator:
             accepted += bool(self.add_sample(int(e), int(t), v))
         return accepted
 
+    def latest_window_total(self, metric_id: int) -> float:
+        """Sum of the newest window's latest per-entity values for one
+        metric — an O(E) probe (no aggregation pass) for consumers that
+        only need a load-shaped scalar, e.g. the proactive forecaster."""
+        if (self._window_index < 0).all():
+            return 0.0
+        slot = int(np.argmax(self._window_index))
+        return float(self._latest_val[slot, :, metric_id].sum())
+
     # ---- aggregate --------------------------------------------------------------
     def _completed_windows(self) -> List[int]:
         """Absolute indices of completed windows — the CONTIGUOUS range from

@@ -380,6 +380,15 @@ class LoadMonitor:
         agg = self.partition_aggregator
         return f"w{agg.window_generation}.e{agg.num_entities}"
 
+    def observed_total_ingress(self) -> float:
+        """Cluster-wide leader ingress (KB/s) from the newest window's
+        latest samples — one O(P) probe, no model build.  The proactive
+        forecaster's sample feed: it only needs a stable load-shaped
+        scalar to fit the diurnal curve against, not a complete model."""
+        agg = self.partition_aggregator
+        m = agg.metric_def.metric_info("LEADER_BYTES_IN")
+        return agg.latest_window_total(m.metric_id)
+
     def cluster_model(
         self,
         requirements: Optional[ModelCompletenessRequirements] = None,

@@ -82,6 +82,7 @@ GET_ENDPOINTS = {
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
     "fix_offline_replicas", "topic_configuration", "rightsize",
+    "whatif",
 }
 SYNC_POST_ENDPOINTS = {
     "stop_proposal_execution", "pause_sampling", "resume_sampling",
@@ -983,6 +984,21 @@ class CruiseControlHttpServer:
             )
         if endpoint == "rightsize":
             return lambda progress: cc.rightsize(progress=progress)
+        if endpoint == "whatif":
+            from cruise_control_tpu.whatif.futures import parse_futures_param
+            # `futures` is a JSON list of future specs in the query
+            # string (request bodies are unused by this API); absent →
+            # the facade evaluates its likely-futures set against the
+            # model it builds.  Parsing happens HERE, at the request
+            # boundary, so a malformed spec is a 400 — not a failed task
+            raw = params.get("futures")
+            futures = None if not raw else parse_futures_param(
+                raw, max_futures=getattr(cc, "whatif_max_futures", 256),
+            )
+            use_cache = _flag(params, "use_cache", default=True)
+            return lambda progress: cc.whatif(
+                futures, progress=progress, use_cache=use_cache
+            )
         raise ValueError(f"unhandled async endpoint {endpoint}")
 
     # ---- sync POST endpoints ----------------------------------------------------

@@ -184,6 +184,16 @@ class ScenarioSpec:
     journal_path: Optional[str] = None
     journal_max_bytes: int = 16 * 1024 * 1024
     journal_max_files: int = 3
+    # forecast-driven proactive control (ISSUE 16): a ProactiveScheduler
+    # on the VIRTUAL clock fits the diurnal curve to observed ingress,
+    # projects the peak, and — when the what-if verdict says a goal
+    # breaks — rebalances BEFORE the breach.  Off by default so
+    # pre-existing scenario journals keep their bits.
+    proactive_enabled: bool = False
+    proactive_horizon_ms: int = 60 * MIN_MS
+    proactive_threshold: float = 1.1
+    proactive_cooldown_ms: int = 30 * MIN_MS
+    proactive_min_samples: int = 8
     # data-integrity knobs (ISSUE 13).  The engine-degradation cooldown
     # runs on the VIRTUAL clock; default outlives most scenarios so a
     # degraded run never probes the real TPU engine mid-scenario (a
@@ -731,6 +741,24 @@ class _Sim:
             # built but never start()ed: run_scenario drives refresh_once
             # synchronously on the virtual clock
             self.precompute = ProposalPrecomputingExecutor(self.cc)
+        self.proactive = None
+        if spec.proactive_enabled:
+            # built but never start()ed: run_scenario records samples and
+            # calls maybe_trigger on the virtual clock, so forecast →
+            # what-if → pre-peak rebalance is a deterministic journal fact
+            from cruise_control_tpu.whatif.proactive import (
+                ProactiveScheduler,
+            )
+
+            self.proactive = ProactiveScheduler(
+                self.cc,
+                period_ms=spec.diurnal_period_ms,
+                horizon_ms=spec.proactive_horizon_ms,
+                threshold=spec.proactive_threshold,
+                cooldown_ms=spec.proactive_cooldown_ms,
+                min_samples=spec.proactive_min_samples,
+                clock=lambda: self.now_ms,
+            )
 
     def crash(self) -> None:
         """SIGKILL semantics: the front door vanishes with the process —
@@ -1228,6 +1256,14 @@ def run_scenario(spec: ScenarioSpec, on_tick=None) -> ScenarioResult:
                     # honest report, exactly like a misbehaving reporter
                     sim.emit_poisoned_metrics(report_ms, now)
                 sim.monitor.run_sampling_iteration(now)
+                if sim.proactive is not None:
+                    # forecast-driven proactive control, virtual-clocked:
+                    # sample the synthesizer's ground-truth total rate,
+                    # refit the diurnal model, maybe pre-empt the peak
+                    sim.proactive.record(
+                        now, sim.workload.observed_total_rate()
+                    )
+                    sim.proactive.maybe_trigger(now)
                 try:
                     sim.manager.run_detection_cycle(now)
                 except ProcessCrash:

@@ -19,14 +19,129 @@ partitions wherever the executor moves them.
 
 from __future__ import annotations
 
+import dataclasses
 import math
-from typing import Sequence
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.models.cluster_state import ClusterState
 from cruise_control_tpu.monitor.sampling import WorkloadModel
+
+#: the synthesizer's load floor: the diurnal trough + drift can never push
+#: the multiplier below this (a cluster is never fully idle)
+MIN_MULTIPLIER = 0.05
+
+
+def diurnal_multiplier(
+    now_ms: float,
+    amplitude: float,
+    period_ms: int,
+    drift_per_hour: float = 0.0,
+) -> float:
+    """The synthesizer's exact load multiplier at virtual time ``now_ms``.
+
+    This is THE formula — :meth:`ScenarioWorkload.advance` applies it
+    verbatim (bit-identity contract: extracting it must not move a single
+    float op), and the proactive scheduler's forecast projects it forward.
+    """
+    phase = math.sin(2.0 * math.pi * now_ms / period_ms)
+    mult = (1.0 + amplitude * phase
+            + drift_per_hour * (now_ms / 3_600_000.0))
+    return max(mult, MIN_MULTIPLIER)
+
+
+@dataclasses.dataclass(frozen=True)
+class DiurnalForecast:
+    """A fitted diurnal load model: ``level(t) = mean + a·sin(ωt) +
+    b·cos(ωt)`` with ``ω = 2π/period_ms``.
+
+    Seed-stable by construction: :func:`fit_diurnal` is a closed-form
+    least-squares solve over the caller's samples — same samples, same
+    coefficients, bit for bit.  Shared by the sim (whose ground truth it
+    recovers) and the proactive scheduler (which projects the next peak
+    from observed monitor windows).
+    """
+
+    mean: float
+    a: float
+    b: float
+    period_ms: int
+    num_samples: int = 0
+
+    @property
+    def amplitude(self) -> float:
+        """Relative swing of the fitted sine around its mean."""
+        if self.mean <= 0.0:
+            return 0.0
+        return math.hypot(self.a, self.b) / self.mean
+
+    def level_at(self, now_ms: float) -> float:
+        w = 2.0 * math.pi * now_ms / self.period_ms
+        return self.mean + self.a * math.sin(w) + self.b * math.cos(w)
+
+    def multiplier_at(self, now_ms: float) -> float:
+        """Projected load at ``now_ms`` relative to the fitted mean."""
+        if self.mean <= 0.0:
+            return 1.0
+        return max(self.level_at(now_ms) / self.mean, MIN_MULTIPLIER)
+
+    def peak_within(
+        self, now_ms: float, horizon_ms: float, steps: int = 128
+    ) -> Tuple[float, float]:
+        """``(peak_time_ms, peak_multiplier)`` over ``[now, now+horizon]``.
+
+        Deterministic coarse grid + the analytic sine crest when it falls
+        inside the horizon — ties resolve to the earliest time.
+        """
+        candidates = [
+            now_ms + horizon_ms * i / steps for i in range(steps + 1)
+        ]
+        # analytic crest: a·sin(ωt) + b·cos(ωt) = R·cos(ωt − ψ) with
+        # ψ = atan2(a, b), so the maximum lands at ωt = ψ + 2πk
+        crest = math.atan2(self.a, self.b)
+        w = 2.0 * math.pi / self.period_ms
+        t0 = crest / w
+        k = math.ceil((now_ms - t0) / self.period_ms)
+        t = t0 + k * self.period_ms
+        if now_ms <= t <= now_ms + horizon_ms:
+            candidates.append(t)
+        best_t, best_m = now_ms, self.multiplier_at(now_ms)
+        for t in candidates:
+            m = self.multiplier_at(t)
+            if m > best_m + 1e-12:
+                best_t, best_m = t, m
+        return best_t, best_m
+
+
+def fit_diurnal(
+    samples: Sequence[Tuple[float, float]],
+    period_ms: int,
+) -> Optional[DiurnalForecast]:
+    """Least-squares fit of ``mean + a·sin(ωt) + b·cos(ωt)`` at the KNOWN
+    period to observed ``(time_ms, load)`` samples.
+
+    Returns None when the samples cannot pin the three coefficients
+    (fewer than 4 points, or all at one instant).  Pure numpy normal
+    equations — deterministic for identical inputs.
+    """
+    if len(samples) < 4:
+        return None
+    t = np.asarray([s[0] for s in samples], np.float64)
+    y = np.asarray([s[1] for s in samples], np.float64)
+    if float(t.max() - t.min()) <= 0.0:
+        return None
+    w = 2.0 * np.pi * t / float(period_ms)
+    design = np.stack([np.ones_like(w), np.sin(w), np.cos(w)], axis=1)
+    coef, *_ = np.linalg.lstsq(design, y, rcond=None)
+    mean, a, b = (float(c) for c in coef)
+    if not (math.isfinite(mean) and math.isfinite(a) and math.isfinite(b)):
+        return None
+    return DiurnalForecast(
+        mean=mean, a=a, b=b, period_ms=int(period_ms),
+        num_samples=len(samples),
+    )
 
 
 class ScenarioWorkload:
@@ -86,16 +201,22 @@ class ScenarioWorkload:
 
     def advance(self, now_ms: int) -> None:
         """Re-derive the observable rates for virtual time ``now_ms``."""
-        phase = math.sin(2.0 * math.pi * now_ms / self.diurnal_period_ms)
-        mult = (1.0 + self.diurnal_amplitude * phase
-                + self.drift_per_hour * (now_ms / 3_600_000.0))
-        mult = max(mult, 0.05)
+        mult = diurnal_multiplier(
+            now_ms, self.diurnal_amplitude, self.diurnal_period_ms,
+            self.drift_per_hour,
+        )
         m = self.model
         m.bytes_in = self._base_in * mult * self._skew
         m.bytes_out = self._base_out * mult * self._skew
         # on-disk size tracks skew (hot partitions grow) but not the
         # diurnal breath — disk is an integral, not a rate
         m.size_mb = self._base_size * self._skew
+
+    def observed_total_rate(self) -> float:
+        """Total cluster bytes-in rate as of the last :meth:`advance` —
+        the scalar load signal the proactive scheduler samples during
+        scenario runs (production wires the monitor's model instead)."""
+        return float(np.sum(self.model.bytes_in))
 
     def sync_topology(self, backend) -> None:
         """Mirror the scripted backend's current placement into the ground

@@ -1,0 +1,50 @@
+"""Counterfactual what-if engine (ISSUE 16).
+
+Compiles hypothetical futures — broker/rack loss, traffic ×k, planned
+maintenance, topic growth, expressed in the timeline-DSL vocabulary —
+into perturbed device-model batches, evaluates every future in ONE
+batched device dispatch (a vmapped verdict kernel over a stacked
+leading futures axis, padded to a power of two so request sizes share
+compiled executables), and feeds the same machinery forward: the
+precompute daemon keeps the top-k likely futures warm, and the
+proactive scheduler projects the workload's diurnal peak and rebalances
+*before* the projected breach (``whatif.*`` / ``proactive.*`` journal
+kinds; ``POST /whatif``; ``docs/ARCHITECTURE.md`` "Counterfactual
+what-if engine").
+"""
+
+from cruise_control_tpu.whatif.cache import WhatifCache
+from cruise_control_tpu.whatif.compiler import FutureBatch, compile_futures
+from cruise_control_tpu.whatif.engine import evaluate_batch, verdicts
+from cruise_control_tpu.whatif.futures import (
+    FutureEvent,
+    FutureSpec,
+    broker_loss,
+    hot_partitions,
+    likely_futures,
+    maintenance,
+    parse_future,
+    rack_loss,
+    topic_growth,
+    traffic_scale,
+)
+from cruise_control_tpu.whatif.proactive import ProactiveScheduler
+
+__all__ = [
+    "FutureBatch",
+    "FutureEvent",
+    "FutureSpec",
+    "ProactiveScheduler",
+    "WhatifCache",
+    "broker_loss",
+    "compile_futures",
+    "evaluate_batch",
+    "hot_partitions",
+    "likely_futures",
+    "maintenance",
+    "parse_future",
+    "rack_loss",
+    "topic_growth",
+    "traffic_scale",
+    "verdicts",
+]

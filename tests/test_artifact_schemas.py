@@ -244,6 +244,21 @@ def _scenario_artifact():
     return [make_artifact([run_scenario(spec)])]
 
 
+def _whatif_artifact():
+    """The live cc-tpu-whatif/1 producer: a small-scale batched sweep
+    (8 futures, one dispatch) + the real proactive-vs-reactive scenario
+    twins through the actual measurement functions."""
+    from cruise_control_tpu.whatif.artifact import (
+        make_artifact,
+        measure_batch,
+        measure_proactive,
+    )
+
+    batch = measure_batch(num_futures=8, best_of=1, num_brokers=6,
+                          num_racks=3, num_partitions=24)
+    return [make_artifact(batch, measure_proactive())]
+
+
 def _kernel_budget_artifacts():
     """The live producer: a REAL capture of the scan program at the tiny
     pinned fixture (shared — and session-cached — with
@@ -258,7 +273,7 @@ def _kernel_budget_artifacts():
 @pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
                                       "events", "scenarios", "checkpoint",
                                       "slo", "trace", "soak",
-                                      "kernel-budget"])
+                                      "kernel-budget", "whatif"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     if producer == "phase-profile":
         arts = _phase_profile_artifact()
@@ -281,6 +296,9 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "kernel-budget":
         arts = _kernel_budget_artifacts()
         schema = SCHEMAS["cc-tpu-kernel-budget/2"]
+    elif producer == "whatif":
+        arts = _whatif_artifact()
+        schema = SCHEMAS["cc-tpu-whatif/1"]
     elif producer == "soak":
         arts = _soak_artifact()
         schema = SCHEMAS["cc-tpu-soak/1"]

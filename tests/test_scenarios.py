@@ -39,7 +39,7 @@ from cruise_control_tpu.sim.timeline import (
 from test_artifact_schemas import SCHEMAS, validate
 
 MIN = MIN_MS
-ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r15.json"
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "SCENARIOS_r16.json"
 
 #: the outcome each scripted timeline must reach — also pinned against the
 #: committed artifact below, so a regression shows up in tier-1 without
@@ -75,6 +75,7 @@ EXPECTED_OUTCOMES = {
     "foreign_conflict_yield_retries": "HEALED",
     "zombie_controller_fenced": "HEALED",
     "topology_drift_mid_execution": "HEALED",
+    "proactive_beats_reactive_peak": "NO_ANOMALY",
 }
 
 _cache = {}
@@ -552,6 +553,30 @@ def _check_topology_drift_mid_execution(r):
     assert r.fixes_started("GOAL_VIOLATION")
 
 
+def _check_proactive_beats_reactive_peak(r):
+    # the full forecast-driven chain, in journal order: diurnal fit →
+    # what-if verdict on the projected-peak future → pre-emptive
+    # rebalance — all BEFORE the peak the forecast called out
+    (fc,) = r.events_of("proactive.forecast")
+    assert fc["payload"]["peakMultiplier"] > 1.1
+    peak_s = fc["ts"] + fc["payload"]["peakInMs"] / 1000.0
+    (trig,) = r.events_of("proactive.trigger")
+    assert trig["payload"]["reason"] == "projected-goal-violation"
+    assert trig["payload"]["overloadedBrokers"] >= 1
+    (req,) = r.events_of("whatif.request")
+    (ev,) = r.events_of("whatif.evaluated")
+    assert ev["payload"]["violations"] >= 1
+    assert req["ts"] <= trig["ts"] < peak_s
+    ends = r.executor_ends()
+    assert len(ends) == 1 and ends[0]["completed"] > 0
+    # the point of the scenario: the detector never saw a violation —
+    # the rebalance landed while current load was still legal (the
+    # reactive twin with proactive off heals this same swell only
+    # after a CpuCapacityGoal breach)
+    assert not r.events_of("detector.anomaly")
+    assert r.fixes_started("GOAL_VIOLATION") == []
+
+
 CHECKS = {
     "broker_death_mid_execution": _check_broker_death_mid_execution,
     "rack_loss": _check_rack_loss,
@@ -591,6 +616,7 @@ CHECKS = {
     "foreign_conflict_yield_retries": _check_foreign_conflict_yield_retries,
     "zombie_controller_fenced": _check_zombie_controller_fenced,
     "topology_drift_mid_execution": _check_topology_drift_mid_execution,
+    "proactive_beats_reactive_peak": _check_proactive_beats_reactive_peak,
 }
 
 

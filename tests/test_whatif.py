@@ -1,0 +1,479 @@
+"""Counterfactual what-if engine tests (ISSUE 16).
+
+Four contracts:
+
+* **batched = sequential, bit for bit** — every future's verdict from
+  one N-wide batched dispatch equals the verdict from its own
+  single-future dispatch exactly (``np.array_equal`` per output key), so
+  batching is pure wall-clock engineering, never a semantics change;
+* **the cache tells the truth** — verdicts are keyed
+  ``model_generation × future fingerprint``: repeat queries hit, an
+  invalidation or a generation bump misses, and the precompute daemon's
+  freshness probe covers the per-future warm set (the satellite-2 fix),
+  so a stale future never serves;
+* **``POST /whatif`` honors the front-door contract** — the async
+  202/long-poll protocol, admission control (429 + Retry-After), and a
+  400 at the request boundary for malformed futures;
+* **proactive fires BEFORE the peak** — the forecast-driven scheduler
+  triggers a rebalance while the projected breach is still in the
+  future (virtual clock; the full closed loop is the
+  ``proactive_beats_reactive_peak`` scenario in test_scenarios).
+
+Plus the committed ``WHATIF_r16.json`` artifact gates: N≥64 futures in
+one batched dispatch under 2× a single plan search, and the proactive
+twin beating the reactive twin's heal p99 — regenerate via
+``python -m cruise_control_tpu.whatif --artifact WHATIF_r16.json``.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.models.generators import random_cluster
+from cruise_control_tpu.whatif import (
+    FutureSpec,
+    broker_loss,
+    compile_futures,
+    evaluate_batch,
+    hot_partitions,
+    likely_futures,
+    maintenance,
+    rack_loss,
+    topic_growth,
+    traffic_scale,
+)
+from cruise_control_tpu.whatif.compiler import MIN_BUCKET, bucket_size
+from cruise_control_tpu.whatif.engine import verdicts
+from cruise_control_tpu.whatif.futures import parse_future
+from cruise_control_tpu.whatif.proactive import ProactiveScheduler
+
+from harness import WINDOW, full_stack
+from test_artifact_schemas import SCHEMAS, validate
+
+ARTIFACT_PATH = pathlib.Path(__file__).parent.parent / "WHATIF_r16.json"
+
+
+def _state():
+    return random_cluster(
+        seed=7, num_brokers=12, num_racks=4, num_partitions=60
+    )
+
+
+def _mixed_futures():
+    """One of every DSL kind plus a composition — the equivalence matrix."""
+    return [
+        FutureSpec(name="b3", events=(broker_loss(3),)),
+        FutureSpec(name="rack2", events=(rack_loss(2),)),
+        FutureSpec(name="x1.8", events=(traffic_scale(1.8),)),
+        FutureSpec(name="maint", events=(maintenance(4, 5),)),
+        FutureSpec(name="topic0", events=(topic_growth(0, 2.5),)),
+        FutureSpec(name="hot", events=(hot_partitions((0, 1, 2), 3.0),)),
+        FutureSpec(
+            name="compound",
+            events=(broker_loss(0), traffic_scale(1.5)),
+        ),
+    ]
+
+
+# ---- batched = sequential, bit for bit ------------------------------------------
+def test_batched_matches_sequential_bit_for_bit():
+    state = _state()
+    futures = _mixed_futures()
+    batch = compile_futures(state, futures)
+    raw = evaluate_batch(state, batch)
+    for i, f in enumerate(futures):
+        single = compile_futures(state, [f])
+        raw1 = evaluate_batch(state, single)
+        for key in raw:
+            assert np.array_equal(raw[key][i], raw1[key][0]), (
+                f"future {f.name!r} key {key!r}: batched row differs "
+                "from its single-future dispatch"
+            )
+
+
+def test_verdict_semantics():
+    state = _state()
+    rows = verdicts(
+        *(lambda b: (b, evaluate_batch(state, b)))(
+            compile_futures(state, _mixed_futures())
+        )
+    )
+    assert len(rows) == 7  # padding rows dropped
+    by_name = {v["future"]: v for v in rows}
+    # killing one broker of an rf-3 placement leaves partitions under-
+    # replicated but never unavailable
+    b3 = by_name["b3"]
+    assert b3["survivable"] and b3["unavailablePartitions"] == 0
+    assert b3["underReplicated"] > 0 and b3["movesRequired"] > 0
+    # every verdict's goal count decomposes as documented
+    for v in rows:
+        assert v["goalViolations"] == (
+            v["overloadedBrokers"] + v["rackViolations"]
+        )
+    # suggested actions only for futures that displace replicas
+    assert b3["topActions"]
+    assert all(a["from"] >= 0 and a["to"] >= 0 for a in b3["topActions"])
+    assert by_name["x1.8"]["movesRequired"] == 0
+
+
+def test_power_of_two_bucketing():
+    assert [bucket_size(n) for n in (1, 8, 9, 16, 17, 64)] == \
+        [MIN_BUCKET, 8, 16, 16, 32, 64]
+    state = _state()
+    batch = compile_futures(state, _mixed_futures()[:3])
+    assert batch.padded_size == MIN_BUCKET
+    assert batch.num_futures == 3
+    assert list(batch.valid) == [True] * 3 + [False] * (MIN_BUCKET - 3)
+
+
+def test_future_fingerprints_are_semantic():
+    a = FutureSpec(name="a", events=(broker_loss(1),))
+    b = FutureSpec(name="renamed", events=(broker_loss(1),))
+    c = FutureSpec(name="a", events=(broker_loss(2),))
+    assert a.fingerprint() == b.fingerprint()  # names are display-only
+    assert a.fingerprint() != c.fingerprint()
+    # and the JSON round trip preserves semantics
+    assert parse_future(a.to_json()).fingerprint() == a.fingerprint()
+
+
+def test_likely_futures_deterministic_and_load_ordered():
+    state = _state()
+    ranked = likely_futures(state, k=8)
+    assert ranked == likely_futures(state, k=8)
+    assert len(ranked) == 8
+    assert all(f.events[0].kind == "rack_loss" for f in ranked[:4])
+
+
+# ---- cache: hit / invalidate / generation bump ----------------------------------
+def test_whatif_cache_hit_and_invalidate():
+    cc, _, _ = full_stack()
+    futures = [FutureSpec(name="b1", events=(broker_loss(1),))]
+    first = cc.whatif(futures)
+    assert not first.cached and first.batch_size == MIN_BUCKET
+    again = cc.whatif(futures)
+    assert again.cached and again.verdicts == first.verdicts
+    cc.invalidate_proposal_cache("test")  # whatif rides the same hook
+    third = cc.whatif(futures)
+    assert not third.cached
+
+
+def test_generation_bump_never_serves_stale_verdict():
+    cc, _, reporter = full_stack()
+    futures = [FutureSpec(name="b1", events=(broker_loss(1),))]
+    assert not cc.whatif(futures).cached
+    assert cc.whatif(futures).cached
+    # a new completed window bumps model_generation: the cached verdict
+    # is keyed to the old generation and must MISS, not serve stale
+    gen = cc.load_monitor.model_generation()
+    reporter.report(time_ms=3 * WINDOW + 500)
+    cc.load_monitor.run_sampling_iteration(4 * WINDOW)
+    assert cc.load_monitor.model_generation() != gen
+    assert not cc.whatif(futures).cached
+
+
+def test_use_cache_false_bypasses():
+    cc, _, _ = full_stack()
+    futures = [FutureSpec(name="b2", events=(broker_loss(2),))]
+    cc.whatif(futures)
+    assert not cc.whatif(futures, use_cache=False).cached
+
+
+def test_whatif_max_futures_cap():
+    cc, _, _ = full_stack()
+    cc.whatif_max_futures = 2
+    too_many = [
+        FutureSpec(name=f"b{b}", events=(broker_loss(b),))
+        for b in range(3)
+    ]
+    with pytest.raises(ValueError, match="whatif.max.futures"):
+        cc.whatif(too_many)
+
+
+# ---- precompute daemon covers the per-future warm set (satellite 2) -------------
+def test_precompute_refreshes_stale_future_cache():
+    from cruise_control_tpu.analyzer.precompute import (
+        ProposalPrecomputingExecutor,
+    )
+
+    cc, _, reporter = full_stack()
+    cc.whatif_precompute_futures = 4
+    daemon = ProposalPrecomputingExecutor(cc, interval_s=3600)
+    assert daemon.refresh_once()  # cold: fills plan AND warm futures
+    assert cc.proposal_cache_fresh() and cc.whatif_cache_fresh()
+    assert cc.whatif_cache_state()["entries"] == 4
+    # both fresh → the daemon skips (the steady-state probe)
+    assert not daemon.refresh_once()
+    # generation bump: BOTH probes go stale, one refresh re-warms both
+    reporter.report(time_ms=3 * WINDOW + 500)
+    cc.load_monitor.run_sampling_iteration(4 * WINDOW)
+    assert not cc.whatif_cache_fresh()
+    assert daemon.refresh_once()
+    assert cc.whatif_cache_fresh()
+    # the satellite-2 fix: plan still fresh, ONLY the future set stale —
+    # the old present-state-only probe would skip here and a stale
+    # future could serve; the generalized probe refreshes it
+    cc._whatif_cache.invalidate("test")
+    assert cc.proposal_cache_fresh() and not cc.whatif_cache_fresh()
+    assert daemon.refresh_once()
+    assert cc.whatif_cache_fresh()
+    # precomputed futures now answer whatif queries as cache hits
+    from cruise_control_tpu.server.progress import OperationProgress
+
+    state = cc._model(None, OperationProgress("TEST"))
+    assert cc.whatif(likely_futures(state, 4)).cached
+
+
+def test_precompute_disabled_keeps_old_semantics():
+    from cruise_control_tpu.analyzer.precompute import (
+        ProposalPrecomputingExecutor,
+    )
+
+    cc, _, _ = full_stack()  # whatif_precompute_futures defaults to 0
+    daemon = ProposalPrecomputingExecutor(cc, interval_s=3600)
+    assert daemon.refresh_once()
+    assert cc.whatif_cache_fresh()  # disabled == always fresh
+    assert not daemon.refresh_once()
+    assert cc.whatif_cache_state()["entries"] == 0
+
+
+# ---- POST /whatif behind the front-door contract --------------------------------
+@pytest.fixture
+def server():
+    from cruise_control_tpu.server import CruiseControlHttpServer
+
+    cc, backend, _ = full_stack()
+    srv = CruiseControlHttpServer(cc, port=0)
+    srv.start()
+    yield srv, cc, backend
+    srv.stop()
+
+
+def _client(srv, **kw):
+    from cruise_control_tpu.client.cccli import CruiseControlClient
+
+    return CruiseControlClient(srv.url, **kw)
+
+
+def _raw_post(srv, endpoint, **params):
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    url = f"{srv.url}/{endpoint}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(url, method="POST", data=b"")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, dict(resp.headers), json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), None
+
+
+class TestWhatifEndpoint:
+    def test_default_futures_long_poll(self, server):
+        srv, _, _ = server
+        body = _client(srv).post("whatif")
+        assert body["numFutures"] >= 1
+        assert body["generation"]
+        assert not body["cached"]
+        for v in body["verdicts"]:
+            assert {"future", "survivable", "goalViolations"} <= set(v)
+
+    def test_explicit_futures_and_cache_hit(self, server):
+        srv, _, _ = server
+        c = _client(srv)
+        spec = json.dumps([{
+            "name": "lose-b1",
+            "events": [{"kind": "kill_broker", "broker": 1}],
+        }])
+        first = c.post("whatif", futures=spec)
+        assert first["numFutures"] == 1 and not first["cached"]
+        assert first["verdicts"][0]["future"] == "lose-b1"
+        again = c.post("whatif", futures=spec)
+        assert again["cached"]
+        assert again["verdicts"] == first["verdicts"]
+
+    def test_malformed_futures_is_400(self, server):
+        from cruise_control_tpu.client.cccli import CruiseControlError
+
+        srv, _, _ = server
+        for bad in ("not json", "[]",
+                    '[{"events": [{"kind": "meteor_strike"}]}]'):
+            with pytest.raises(CruiseControlError) as e:
+                _client(srv).post("whatif", futures=bad)
+            assert e.value.code == 400
+
+    def test_deadline_202_then_completion(self, server):
+        """The async deadline contract: a zero-budget long poll answers
+        202 + task id immediately; re-polling the task id completes."""
+        srv, _, _ = server
+        code, _, body = _raw_post(srv, "whatif", get_response_timeout_s="0")
+        assert code == 202
+        task_id = body["UserTaskId"]
+        done = _client(srv).post("whatif", user_task_id=task_id)
+        assert done["numFutures"] >= 1
+
+    def test_admission_control_429_with_retry_after(self):
+        from cruise_control_tpu.server import (
+            CruiseControlHttpServer,
+            UserTaskManager,
+        )
+
+        cc, _, _ = full_stack()
+        srv = CruiseControlHttpServer(
+            cc, port=0,
+            user_task_manager=UserTaskManager(max_active_tasks=0),
+        )
+        srv.start()
+        try:
+            code, headers, _ = _raw_post(srv, "whatif")
+            assert code == 429
+            assert headers.get("Retry-After") == "2"
+        finally:
+            srv.stop()
+
+
+# ---- proactive: trigger fires BEFORE the virtual-clock peak ---------------------
+class _FacadeStub:
+    """Records the proactive scheduler's calls; returns a scripted
+    verdict."""
+
+    def __init__(self, verdict):
+        self.verdict = verdict
+        self.whatif_calls = []
+        self.rebalances = 0
+
+    def whatif(self, futures):
+        self.whatif_calls.append(tuple(futures))
+
+        class _R:
+            verdicts = [dict(self.verdict)]
+
+        return _R()
+
+    def rebalance(self, dryrun):
+        assert dryrun is False
+        self.rebalances += 1
+
+
+_BREACH = {
+    "survivable": True, "goalViolations": 1, "overloadedBrokers": 1,
+    "unavailablePartitions": 0,
+}
+_FINE = {
+    "survivable": True, "goalViolations": 0, "overloadedBrokers": 0,
+    "unavailablePartitions": 0,
+}
+
+HOUR_MS = 3_600_000
+
+
+def _fed_scheduler(cc, period_ms=4 * HOUR_MS, until_ms=30 * 60_000,
+                   amplitude=0.5, **kw):
+    """A scheduler fed a clean sinusoid sampled every minute up to
+    ``until_ms`` — peak at period/4 (t = 1 hour for the default)."""
+    sched = ProactiveScheduler(
+        cc, period_ms=period_ms, horizon_ms=2 * HOUR_MS,
+        threshold=1.1, cooldown_ms=HOUR_MS, clock=lambda: until_ms, **kw,
+    )
+    for t in range(0, until_ms + 1, 60_000):
+        mult = 1.0 + amplitude * np.sin(2 * np.pi * t / period_ms)
+        sched.record(t, 1000.0 * mult)
+    return sched
+
+
+def test_proactive_triggers_before_projected_peak():
+    cc = _FacadeStub(_BREACH)
+    sched = _fed_scheduler(cc)
+    now_ms = 30 * 60_000
+    assert sched.maybe_trigger(now_ms)
+    assert cc.rebalances == 1
+    # the what-if asked about a genuine FUTURE: the projected peak (the
+    # sinusoid crests at t = 1h) is still ahead of the trigger time
+    (future,) = cc.whatif_calls[0]
+    factor = future.events[0].arg("factor")
+    assert factor > 1.1  # peak multiplier over the current one
+    assert now_ms < HOUR_MS  # triggered with the peak still ahead
+
+
+def test_proactive_survivable_peak_does_not_trigger():
+    cc = _FacadeStub(_FINE)
+    sched = _fed_scheduler(cc)
+    assert not sched.maybe_trigger(30 * 60_000)
+    assert cc.rebalances == 0
+    assert sched.state_summary()["lastSkipReason"] == "peak-survivable"
+
+
+def test_proactive_skips_without_signal():
+    cc = _FacadeStub(_BREACH)
+    sched = ProactiveScheduler(cc, period_ms=4 * HOUR_MS,
+                               clock=lambda: 0.0)
+    assert not sched.maybe_trigger(0.0)  # no samples at all
+    assert sched.state_summary()["lastSkipReason"] == "insufficient-samples"
+    flat = _fed_scheduler(cc, amplitude=0.0)
+    assert not flat.maybe_trigger(30 * 60_000)  # constant load
+    assert cc.rebalances == 0
+
+
+def test_proactive_cooldown_suppresses_retrigger():
+    cc = _FacadeStub(_BREACH)
+    sched = _fed_scheduler(cc)
+    assert sched.maybe_trigger(30 * 60_000)
+    assert not sched.maybe_trigger(31 * 60_000)
+    assert sched.state_summary()["lastSkipReason"] == "cooldown"
+    assert cc.rebalances == 1
+
+
+# ---- the forecast API shared by sim and scheduler (satellite 1) -----------------
+def test_fit_diurnal_recovers_the_synthesizers_curve():
+    """The forecast fit and the workload synthesizer speak ONE formula:
+    fitting samples of ``diurnal_multiplier`` reproduces the curve (and
+    its peak) to numerical tolerance."""
+    from cruise_control_tpu.sim.workload import (
+        diurnal_multiplier,
+        fit_diurnal,
+    )
+
+    period, amp = 4 * HOUR_MS, 0.35
+    samples = [
+        (t, 100.0 * diurnal_multiplier(t, amp, period, 0.0))
+        for t in range(0, 2 * HOUR_MS, 5 * 60_000)
+    ]
+    fc = fit_diurnal(samples, period)
+    assert fc is not None
+    assert fc.amplitude == pytest.approx(amp, abs=1e-6)
+    for t in (0, 30 * 60_000, HOUR_MS, 3 * HOUR_MS):
+        assert fc.multiplier_at(t) == pytest.approx(
+            diurnal_multiplier(t, amp, period, 0.0), abs=1e-6
+        )
+    peak_t, peak_mult = fc.peak_within(0, period)
+    assert peak_t == pytest.approx(period / 4, rel=0.01)  # sin crest
+    assert peak_mult == pytest.approx(1.0 + amp, abs=1e-4)
+
+
+def test_fit_diurnal_refuses_unfittable_input():
+    from cruise_control_tpu.sim.workload import fit_diurnal
+
+    assert fit_diurnal([], 1000) is None
+    assert fit_diurnal([(0, 1.0)] * 3, 1000) is None          # < 4 samples
+    assert fit_diurnal([(5, 1.0), (5, 2.0), (5, 3.0), (5, 4.0)],
+                       1000) is None                          # zero span
+    assert fit_diurnal([(0, 1.0), (1, float("nan")), (2, 1.0), (3, 1.0)],
+                       1000) is None                          # non-finite
+
+
+# ---- the committed artifact keeps the headline claims honest --------------------
+def test_committed_whatif_artifact_gates():
+    art = json.loads(ARTIFACT_PATH.read_text())
+    validate(art, SCHEMAS["cc-tpu-whatif/1"])
+    assert art["allOk"] and all(art["gates"].values())
+    assert art["batch"]["numFutures"] >= 64
+    assert art["batch"]["numDispatches"] == 1
+    assert art["batch"]["ratio"] < 2.0
+    pro, rea = art["proactive"]["proactive"], art["proactive"]["reactive"]
+    assert pro["healP99Ms"] < rea["healP99Ms"]
+    assert pro["anomalies"] == 0 and rea["fixesStarted"] > 0
+    assert art["proactive"]["leadVirtualMs"] > 0
