@@ -85,6 +85,12 @@ def main() -> None:
         "shape); exit 1 when per-bucket kernel counts grew past its "
         "ceiling",
     )
+    ap.add_argument(
+        "--mesh-out", default="",
+        help="also parse the SAME trace through the mesh observatory "
+        "(collectives / transfers / dispatch-gap attribution) and write "
+        "the cc-tpu-mesh-budget/1 artifact here",
+    )
     args = ap.parse_args()
 
     if args.devices > 1:
@@ -214,6 +220,34 @@ def main() -> None:
               + f", skew {dev['skew']}", file=sys.stderr)
 
     print(json.dumps(artifact))
+
+    if args.mesh_out:
+        from cruise_control_tpu.telemetry import mesh_budget as mb
+
+        mparsed = mb.parse_mesh_trace(kb.newest_trace(args.trace_dir))
+        mesh_art = mb.build_mesh_artifact(
+            mparsed, units=steps, unit="step", source="benchmark",
+            backend=jax.default_backend(), fixture=artifact["fixture"],
+        )
+        w = mesh_art["wall"]
+        print(
+            f"mesh: wall {w['window_ms']:.2f} ms/device = "
+            f"busy {w['busy_ms']:.2f} + "
+            f"collective {w['collective_ms']:.2f} + "
+            f"transfer {w['transfer_ms']:.2f} + "
+            f"host gap {w['host_gap_ms']:.2f} "
+            f"(reconciles {w['reconciliation_pct']:.1f}%); "
+            f"collectives: "
+            + (", ".join(
+                f"{op}={v['count_per_unit']:g}/step"
+                for op, v in mesh_art["collectives"]["by_op"].items())
+               or "none")
+            + f" -> {args.mesh_out}",
+            file=sys.stderr,
+        )
+        with open(args.mesh_out, "w") as f:
+            json.dump(mesh_art, f, indent=1)
+            f.write("\n")
 
     if args.compare:
         with open(args.compare) as f:

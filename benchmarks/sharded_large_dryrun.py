@@ -14,10 +14,27 @@ shard_map over an 8-device mesh — and requires the two PLANS to be
 identical action for action (K divisible by the mesh → arithmetically
 identical programs), then verifies the plan against the goal stack.
 
+``--mesh-out`` (round-17) additionally rides the mesh observatory over
+BOTH runs — arm the shared capture pipeline, trace ``--mesh-scans`` scan
+calls of each search, parse the collective/transfer/gap decomposition —
+and writes a ``cc-tpu-mesh-budget/1`` artifact whose ``sharding_loss``
+block charges the single→sharded wall regression to NAMED terms: each
+run's captured window partitions exactly into busy + collective-wait +
+transfer + host-gap, so scaling the term shares to the measured walls
+and differencing decomposes the loss with nothing left over.
+
+Profiler capacity caveat: a traced scan call at the advertised shape
+overflows the profiler's 2 GB XSpace protobuf bound (and 8 rendezvous
+threads on a 1-vCPU container wedge), so run ``--mesh-out`` at a shape
+the trace can hold — the committed ``benchmarks/MESH_BUDGET_r17.json``
+records its reduced fixture in the artifact; the decomposition protocol
+is shape-independent.
+
 Usage (fresh process; forces the virtual CPU platform):
     PYTHONPATH=. python benchmarks/sharded_large_dryrun.py \
         [--devices 8] [--brokers 1000] [--partitions 50000] \
-        [--out SHARDED_DRYRUN_r05.json]
+        [--out SHARDED_DRYRUN_r05.json] \
+        [--mesh-out MESH_BUDGET_r17.json] [--mesh-scans 2]
 """
 
 from __future__ import annotations
@@ -25,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 
@@ -36,6 +54,16 @@ def main() -> None:
     ap.add_argument("--racks", type=int, default=40)
     ap.add_argument("--seed", type=int, default=13)
     ap.add_argument("--out", default="SHARDED_DRYRUN_r05.json")
+    ap.add_argument(
+        "--mesh-out", default="",
+        help="also write a cc-tpu-mesh-budget/1 artifact with a "
+        "sharding_loss block decomposing wall_sharded - wall_single "
+        "into busy_scaling / collective / transfer / host_gap terms",
+    )
+    ap.add_argument(
+        "--mesh-scans", type=int, default=2,
+        help="scan calls to trace per run for the --mesh-out capture",
+    )
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -75,15 +103,38 @@ def main() -> None:
              a.dest_broker) for a in result.actions
         ]
 
-    t0 = time.perf_counter()
-    single = TpuGoalOptimizer(config=cfg).optimize(state)
-    t_single = time.perf_counter() - t0
+    if args.mesh_out:
+        from cruise_control_tpu.telemetry import kernel_budget as kb
+        from cruise_control_tpu.telemetry import mesh_budget as mb
+
+        mb.MESH.attach(kb.CAPTURE)
+
+    def profiled(run):
+        """Run ``run()`` timed; with --mesh-out, under an armed capture
+        whose parsed mesh artifact is returned alongside."""
+        if not args.mesh_out:
+            t0 = time.perf_counter()
+            return run(), time.perf_counter() - t0, None
+        mb.MESH.reset()
+        kb.CAPTURE.reset()
+        kb.CAPTURE.arm(scans=args.mesh_scans, reason="benchmark")
+        t0 = time.perf_counter()
+        result = run()
+        wall = time.perf_counter() - t0
+        kb.parse_pending(max_parses=4)
+        art = mb.MESH.latest()
+        if art is None:
+            raise SystemExit("mesh capture produced no artifact — did "
+                             "the run make any scan calls?")
+        return result, wall, art
+
+    single, t_single, mesh_single = profiled(
+        lambda: TpuGoalOptimizer(config=cfg).optimize(state))
     verify_result(state, single, goals)
 
     mesh = Mesh(np.array(jax.devices()[: args.devices]), ("search",))
-    t0 = time.perf_counter()
-    sharded = TpuGoalOptimizer(config=cfg, mesh=mesh).optimize(state)
-    t_sharded = time.perf_counter() - t0
+    sharded, t_sharded, mesh_sharded = profiled(
+        lambda: TpuGoalOptimizer(config=cfg, mesh=mesh).optimize(state))
     verify_result(state, sharded, goals)
 
     p1, p2 = plan(single), plan(sharded)
@@ -105,6 +156,55 @@ def main() -> None:
     print(json.dumps(out, indent=1))
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
+
+    if args.mesh_out:
+        # scale each run's captured-window term SHARES to its measured
+        # wall, then difference: both windows partition exactly
+        # (reconciliation ~100%), so the four term deltas sum to the
+        # loss with nothing left over
+        def full_run_terms_s(art, wall_s):
+            w = art["wall"]
+            win = w["window_ms"] or 1.0
+            return {
+                term: w[f"{key}_ms"] / win * wall_s
+                for term, key in (
+                    ("busy_scaling", "busy"), ("collective", "collective"),
+                    ("transfer", "transfer"), ("host_gap", "host_gap"),
+                )
+            }
+
+        ts_single = full_run_terms_s(mesh_single, t_single)
+        ts_sharded = full_run_terms_s(mesh_sharded, t_sharded)
+        loss_s = t_sharded - t_single
+        by_term = {
+            term: round(ts_sharded[term] - ts_single[term], 3)
+            for term in ts_sharded
+        }
+        mesh_art = dict(mesh_sharded)
+        mesh_art["source"] = "benchmark"
+        mesh_art["fixture"] = dict(out["fixture"], devices=args.devices,
+                                   mesh_scans=args.mesh_scans)
+        mesh_art["sharding_loss"] = {
+            "wall_single_s": round(t_single, 3),
+            "wall_sharded_s": round(t_sharded, 3),
+            "loss_s": round(loss_s, 3),
+            "by_term_s": by_term,
+            "attributed_share": {
+                term: round(v / loss_s, 4) if loss_s else 0.0
+                for term, v in by_term.items()
+            },
+        }
+        with open(args.mesh_out, "w") as f:
+            json.dump(mesh_art, f, indent=1)
+            f.write("\n")
+        print(
+            "mesh: loss "
+            + f"{loss_s:+.1f}s of {t_sharded:.1f}s sharded wall, by term "
+            + ", ".join(f"{k}={v:+.1f}s" for k, v in by_term.items())
+            + f" -> {args.mesh_out}",
+            file=sys.stderr,
+        )
+
     if not out["ok"]:
         raise SystemExit("sharded plan diverged from single-device plan")
 

@@ -74,7 +74,12 @@ from cruise_control_tpu.ops.pools import (
     pool_row_tables,
     pool_row_tables_update,
 )
-from cruise_control_tpu.telemetry import device_stats, kernel_budget, tracing
+from cruise_control_tpu.telemetry import (
+    device_stats,
+    kernel_budget,
+    mesh_budget,
+    tracing,
+)
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("engine")
@@ -1433,16 +1438,20 @@ def _fetch_scan_result(packed, T: int):
     cached).  Index values are < 2^24, exact in the f32 wire format."""
     total_cols = packed.shape[1]
     n_slots = total_cols - (T + 2)
+    # D2H through the transfer ledger: cc_transfer_bytes{fn="analyzer.
+    # scan_fetch"} names what the drive loop pays per scan call
     if n_slots <= 4096:
-        arr = np.asarray(packed)
+        arr = mesh_budget.fetch(packed, fn="analyzer.scan_fetch")
         meta, body = arr[:, n_slots:], arr
     else:
-        meta = np.asarray(packed[:, n_slots:])
+        meta = mesh_budget.fetch(packed[:, n_slots:],
+                                 fn="analyzer.scan_fetch")
         count = int(meta[0, T])
         n2 = 256
         while n2 < count:
             n2 <<= 1
-        body = np.asarray(packed[:, : min(n2, n_slots)])
+        body = mesh_budget.fetch(packed[:, : min(n2, n_slots)],
+                                 fn="analyzer.scan_fetch")
     counts = meta[0, :T].astype(np.int64)
     n = int(meta[0, T])
     done = bool(meta[0, T + 1] > 0)
@@ -3018,7 +3027,8 @@ class TpuGoalOptimizer:
         carry.had_must_move = np.any(ctx.replica_offline, axis=1)
         if tab is not None and bool(tab[3]):
             carry.tables = (tab[0], tab[1])
-            pending = np.asarray(tab[2]).copy()
+            pending = mesh_budget.fetch(
+                tab[2], fn="analyzer.carry_fetch").copy()
             if post_table_touched is not None:
                 pending |= post_table_touched
             carry.pending_touched = pending
@@ -3120,7 +3130,13 @@ class TpuGoalOptimizer:
             m, tab = self._warm_device_model(ctx, warm_start, carry)
             dsp.block(m.broker_load)
         can = self._constraint_arrays_np(ctx)
+        t_up = time.perf_counter()
         ca = {k: jnp.asarray(v) for k, v in can.items()}
+        mesh_budget.note_transfer(
+            "h2d", "analyzer.constraints_upload",
+            sum(int(v.nbytes) for v in can.values()),
+            time.perf_counter() - t_up,
+        )
         P, S, B = ctx.num_partitions, ctx.max_rf, ctx.num_brokers
         K, D = self._pool_sizes(P, S, B)
         evaluator = _HostEvaluator(ctx, cfg, can)
@@ -3437,7 +3453,8 @@ class TpuGoalOptimizer:
             polish_rounds_run += 1
             with tracing.device_span("analyzer.score") as dsp:
                 scores, k_top, p_top, s_top, d_top = _unpack_round_result(
-                    np.asarray(dsp.block(round_fn(m, ca)))
+                    mesh_budget.fetch(dsp.block(round_fn(m, ca)),
+                                      fn="analyzer.round_fetch")
                 )
             order = np.argsort(scores, kind="stable")
             # Exact-recheck batch commit: the device proposes its top-k against

@@ -343,6 +343,7 @@ def build_app(
         device_stats,
         events,
         kernel_budget,
+        mesh_budget,
         tracing,
     )
     from cruise_control_tpu.telemetry import trace as trace_mod
@@ -367,6 +368,15 @@ def build_app(
         default_scans=cfg.get_int("telemetry.kernel.capture.scans"),
         trace_dir=cfg.get("telemetry.kernel.trace.dir") or "",
     )
+    mesh_budget.configure(
+        enabled=cfg.get_boolean("telemetry.mesh.enabled"),
+        ledger_enabled=cfg.get_boolean("telemetry.mesh.ledger.enabled"),
+        audit_max_arrays=cfg.get_int("telemetry.mesh.audit.max.arrays"),
+    )
+    if cfg.get_boolean("telemetry.mesh.enabled"):
+        # ride the kernel observatory's capture pipeline: one armed
+        # capture feeds both /profile/kernels and /profile/mesh
+        mesh_budget.MESH.attach(kernel_budget.CAPTURE)
     trace_mod.configure(
         enabled=cfg.get_boolean("telemetry.trace.enabled"),
         max_traces=cfg.get_int("telemetry.trace.max.traces"),
@@ -819,6 +829,9 @@ def build_app(
     if cfg.get_boolean("telemetry.kernel.enabled"):
         # kernel-observatory capture count + pending-parse depth
         kernel_budget.install_gauges(cc.registry)
+    if cfg.get_boolean("telemetry.mesh.enabled"):
+        # mesh-observatory parse counters
+        mesh_budget.install_gauges(cc.registry)
     flight_recorder = None
     if cfg.get_boolean("telemetry.recorder.enabled"):
         from cruise_control_tpu.telemetry.recorder import FlightRecorder
@@ -860,6 +873,11 @@ def build_app(
             kernel_budget_source=(
                 kernel_budget.CAPTURE.summary
                 if cfg.get_boolean("telemetry.kernel.enabled") else None
+            ),
+            # the mesh decomposition + replication audit beside it
+            mesh_budget_source=(
+                mesh_budget.MESH.summary
+                if cfg.get_boolean("telemetry.mesh.enabled") else None
             ),
         )
         detector.flight_recorder = flight_recorder

@@ -1157,6 +1157,25 @@ def default_config_def() -> ConfigDef:
              Importance.LOW, "Parent directory for capture traces (a "
              "per-capture temp subdirectory is created and removed after "
              "parsing); empty = the system temp dir.", None, G)
+    d.define("telemetry.mesh.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Mesh observatory "
+             "(telemetry/mesh_budget.py): ride armed kernel captures to "
+             "decompose wall time into busy / collective-wait / transfer "
+             "/ host-gap per device, account collective HLOs and H2D/D2H "
+             "transfers, and audit replicated vs sharded bytes across "
+             "live arrays (GET /profile/mesh, cc_collective_*/"
+             "cc_transfer_*/cc_mesh_* families, /diagnostics meshBudget "
+             "block). No profiler session of its own — observes the "
+             "kernel observatory's captures.", None, G)
+    d.define("telemetry.mesh.ledger.enabled", ConfigType.BOOLEAN, True,
+             Importance.LOW, "Count bytes through the instrumented "
+             "transfer entry points (mesh_budget.device_put/fetch) into "
+             "the per-function transfer ledger; disabling keeps the "
+             "trace-derived transfer accounting only.", None, G)
+    d.define("telemetry.mesh.audit.max.arrays", ConfigType.INT, 4096,
+             Importance.LOW, "Live arrays the replication audit walks "
+             "before truncating (bounds audit cost on huge states).",
+             at_least(1), G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a

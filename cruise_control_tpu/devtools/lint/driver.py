@@ -70,6 +70,9 @@ from cruise_control_tpu.devtools.lint.rules_profiler import (
 )
 from cruise_control_tpu.devtools.lint.rules_retry import RetryDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_schema import JournalSchemaRule
+from cruise_control_tpu.devtools.lint.rules_transfer import (
+    TransferDisciplineRule,
+)
 from cruise_control_tpu.devtools.lint.rules_wallclock import (
     WallClockDisciplineRule,
 )
@@ -98,6 +101,7 @@ RULES = {
         WallClockDisciplineRule(),
         ProfilerDisciplineRule(),
         FencedBackendDisciplineRule(),
+        TransferDisciplineRule(),
     )
 }
 

@@ -1,0 +1,132 @@
+"""transfer-discipline — host↔device copies go through the ledger.
+
+ISSUE 17's mesh observatory (``telemetry/mesh_budget.py``) accounts
+every host↔device transfer: the trace shows copies as anonymous events,
+the :class:`~cruise_control_tpu.telemetry.mesh_budget.TransferLedger`
+names them per logical fn (``cc_transfer_bytes{direction=,fn=}``), and
+the committed mesh budget gates their counts.  A raw ``jax.device_put``
+— or an implicit D2H via ``np.asarray`` on a device array — outside the
+sanctioned modules reopens the hole: the copy happens, the ledger stays
+blind, and the budget gate can no longer prove where the transfer bytes
+went.
+
+Findings, outside the sanctioned modules (``ops/`` and ``telemetry/``
+wholesale, plus ``models/builder.py`` — the device-model upload — and
+``parallel/mesh.py`` — the sharding layout layer, whose device_put IS
+the placement primitive):
+
+* calls resolving to ``jax.device_put`` — dotted through a jax module
+  alias (``jax.device_put(...)``, ``import jax as j; j.device_put``)
+  or a direct-name import (``from jax import device_put``);
+* ``np.asarray``/``np.array`` (any numpy module alias) whose first
+  argument roots in a parameter annotated with a device-array type
+  (``jax.Array``, ``jnp.ndarray``, ``jax.numpy.ndarray``,
+  ``*DeviceModel``) — a provable implicit D2H fetch.
+
+Route them through ``mesh_budget.device_put(x, fn=...)`` /
+``mesh_budget.fetch(x, fn=...)`` (or ``note_transfer`` for sites that
+perform the copy themselves).  Evaluated over the phase-1 summaries
+(no re-parse).
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import List, Set
+
+from cruise_control_tpu.devtools.lint.findings import Finding
+
+RULE_ID = "transfer-discipline"
+
+#: numpy module names whose asarray/array materialize a device array
+_NP_MODULES = frozenset(("np", "numpy", "onp"))
+
+#: annotations (as written) that prove a param is a device array
+_DEVICE_ANNOTATIONS = frozenset(
+    ("jax.Array", "jnp.ndarray", "jax.numpy.ndarray"))
+
+#: modules allowed to move bytes raw: the kernel/transfer layers
+#: themselves plus the device-model upload and the sharding layout
+_ALLOWED_DIRS = ("ops", "telemetry")
+_ALLOWED_FILES = (
+    ("models", "builder.py"),
+    ("parallel", "mesh.py"),
+)
+
+
+def _allowed(path: str) -> bool:
+    parts = pathlib.PurePath(path).parts
+    if len(parts) >= 2 and parts[-2] in _ALLOWED_DIRS:
+        return True
+    return parts[-2:] in [tuple(sfx) for sfx in _ALLOWED_FILES]
+
+
+def _is_device_annotation(ann: str) -> bool:
+    return ann in _DEVICE_ANNOTATIONS or ann.endswith("DeviceModel")
+
+
+class TransferDisciplineRule:
+    id = RULE_ID
+    summary = ("raw jax.device_put / implicit np.asarray on a device "
+               "array outside ops/, telemetry/, models/builder.py and "
+               "parallel/mesh.py — route transfers through the mesh "
+               "observatory's ledger entry points (mesh_budget."
+               "device_put / fetch) so cc_transfer_bytes{fn=} can name "
+               "what the copy costs")
+    project_rule = True
+
+    def check_project(self, project) -> List[Finding]:
+        findings: List[Finding] = []
+        for s in project.summaries:
+            if _allowed(s.path):
+                continue
+            jax_modules: Set[str] = set()
+            np_modules: Set[str] = set(_NP_MODULES)
+            direct_put: Set[str] = set()
+            for _level, from_mod, name, alias in s.imports:
+                if from_mod is None and name == "jax":
+                    jax_modules.add(alias)
+                elif from_mod is None and name == "numpy":
+                    np_modules.add(alias)
+                elif from_mod == "jax" and name == "device_put":
+                    direct_put.add(alias)
+            for fn in s.functions.values():
+                for call in fn.calls:
+                    head, _, tail = call.callee.rpartition(".")
+                    if (call.callee in direct_put
+                            or (tail == "device_put"
+                                and (head in jax_modules
+                                     or head == "jax"))):
+                        findings.append(Finding(
+                            path=s.path, line=call.lineno, rule=self.id,
+                            message=(
+                                f"raw {call.callee}() in "
+                                f"{fn.name or '<module>'} bypasses the "
+                                "transfer ledger — use telemetry/"
+                                "mesh_budget.device_put(x, fn=...) so "
+                                "the H2D bytes are charged to a named "
+                                "fn in cc_transfer_bytes"
+                            ),
+                        ))
+                        continue
+                    if (tail in ("asarray", "array")
+                            and head in np_modules and call.arg_exprs
+                            and call.arg_exprs[0]):
+                        root = call.arg_exprs[0].split(".", 1)[0]
+                        ann = fn.annotations.get(root, "")
+                        if root in fn.params and _is_device_annotation(ann):
+                            findings.append(Finding(
+                                path=s.path, line=call.lineno,
+                                rule=self.id,
+                                message=(
+                                    f"{call.callee}({call.arg_exprs[0]}) "
+                                    f"in {fn.name or '<module>'} "
+                                    f"materializes a device array "
+                                    f"({root}: {ann}) host-side outside "
+                                    "the ledger — use telemetry/"
+                                    "mesh_budget.fetch(x, fn=...) so "
+                                    "the D2H bytes are charged to a "
+                                    "named fn"
+                                ),
+                            ))
+        return findings

@@ -77,7 +77,7 @@ RETRY_AFTER_NOT_READY_S = 30
 GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
     "user_tasks", "review_board", "metrics", "diagnostics", "events",
-    "health", "slo", "trace", "profile/kernels",
+    "health", "slo", "trace", "profile/kernels", "profile/mesh",
 }
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
@@ -687,6 +687,49 @@ class CruiseControlHttpServer:
                 "errorMessage": "no kernel capture parsed yet — arm one "
                                 "with GET /profile/kernels?arm=true",
                 "capture": state,
+            })
+        if endpoint == "profile/mesh":
+            # mesh observatory (docs/OBSERVABILITY.md "Mesh observatory"):
+            # the same 202-arm/poll ladder as /profile/kernels — one armed
+            # capture feeds both artifacts.  ?audit=true runs the
+            # replication audit inline (a cheap live-array metadata walk)
+            from cruise_control_tpu.telemetry import kernel_budget
+            from cruise_control_tpu.telemetry import mesh_budget
+
+            mesh = mesh_budget.MESH
+            if not mesh.enabled or not kernel_budget.CAPTURE.enabled:
+                return self._send(handler, 503, {
+                    "errorMessage": "mesh observatory disabled "
+                                    "(telemetry.mesh.enabled=false or "
+                                    "telemetry.kernel.enabled=false?)"
+                })
+            if _flag(params, "audit"):
+                return self._send(handler, 200, mesh.audit())
+            if _flag(params, "arm"):
+                scans = params.get("scans")
+                state = mesh.arm(
+                    scans=int(scans) if scans else None, reason="http")
+                return self._send(handler, 202, {
+                    "message": "capture armed: run an optimization and "
+                               "poll GET /profile/mesh",
+                    "mesh": state,
+                })
+            artifact = mesh.latest()
+            if artifact is not None:
+                return self._send(handler, 200, artifact)
+            state = mesh.state()
+            cap = state["capture"]
+            if cap["state"] != "IDLE" or cap["pendingParses"] \
+                    or cap["activeParses"]:
+                return self._send(handler, 202, {
+                    "message": "capture in flight (armed, mid-parse, or "
+                               "awaiting the SLO-tick parse) — poll again",
+                    "mesh": state,
+                })
+            return self._send(handler, 404, {
+                "errorMessage": "no mesh capture parsed yet — arm one "
+                                "with GET /profile/mesh?arm=true",
+                "mesh": state,
             })
         if endpoint == "diagnostics":
             # flight-recorder artifact: retained time series + the merged

@@ -1203,7 +1203,7 @@ def run_scenario(spec: ScenarioSpec, on_tick=None) -> ScenarioResult:
     # deterministic kernel capture (kernel_capture_scans > 0): virtual
     # clock + sim-capture-N ids, so profiler.capture.* journal records
     # fingerprint bit-stably; a no-op scope otherwise
-    from cruise_control_tpu.telemetry import kernel_budget
+    from cruise_control_tpu.telemetry import kernel_budget, mesh_budget
 
     cap_seq = [0]
 
@@ -1225,6 +1225,11 @@ def run_scenario(spec: ScenarioSpec, on_tick=None) -> ScenarioResult:
     ) as journal, capture_scope:
         sim = _Sim(spec)
         if spec.kernel_capture_scans > 0:
+            # the mesh observatory rides the same capture (observer
+            # hooks); attach is idempotent, and its profiler.mesh.parsed
+            # payloads are deterministic under the scoped clock/ids
+            if mesh_budget.MESH.enabled:
+                mesh_budget.MESH.attach(kernel_budget.CAPTURE)
             kernel_budget.CAPTURE.arm(
                 scans=spec.kernel_capture_scans, reason="scenario")
         events.emit(

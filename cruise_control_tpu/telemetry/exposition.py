@@ -45,6 +45,7 @@ from cruise_control_tpu.telemetry import (
     device_cost,
     device_stats,
     kernel_budget,
+    mesh_budget,
     profile,
 )
 from cruise_control_tpu.telemetry.tracing import Telemetry
@@ -227,7 +228,12 @@ def render_prometheus(
         # latest PARSED capture only — a scrape never parses a trace
         kernel_families = kernel_budget.CAPTURE.families() \
             if kernel_budget.CAPTURE.enabled else ()
-        device_families = tuple(device_families) + tuple(kernel_families)
+        # mesh-observatory gauges (cc_collective_* / cc_transfer_* /
+        # cc_mesh_*): latest parsed mesh capture + replication audit
+        mesh_families = mesh_budget.MESH.families() \
+            if mesh_budget.MESH.enabled else ()
+        device_families = (tuple(device_families) + tuple(kernel_families)
+                           + tuple(mesh_families))
     else:
         device_families = ()
 

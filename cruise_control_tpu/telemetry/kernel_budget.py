@@ -50,10 +50,15 @@ subsystem:
 Per-shard skew: on the device dialect each ``/device:N`` pid's kernel
 self-time sums independently; on the host-thunk dialect each device's
 execution blocks its own PJRT client thread, whose
-``ThunkExecutor::Execute`` wall intervals are the per-shard lanes (dispatch-to-done wall, which
-includes collective waits — good enough to SEE skew, not to apportion
-it; the device dialect gives true busy).  ``skew = max/mean`` of the
-per-device busy — the number ROADMAP item 1's mesh investigation needs.
+``ThunkExecutor::Execute`` wall intervals are the per-shard lanes.  A
+lane's wall includes collective waits (every participating lane blocks
+for the whole collective), so the parser subtracts each lane's overlap
+with the classified collective intervals (:func:`classify_collective`)
+and the artifact records ``devices.skew_source``:
+``"busy_minus_collectives"`` when the correction applied,
+``"busy"`` otherwise (the device dialect is true kernel self time
+already).  ``skew = max/mean`` of the per-device busy — the number
+ROADMAP item 1's mesh investigation needs.
 
 Disarmed cost: one lock-free attribute check per scan call and per
 search — gated ≤1 % by ``bench.py``'s ``profiler_overhead_pct`` — and
@@ -90,6 +95,57 @@ PEAK_F32_FLOPS = 98.3e12
 #: the closed bucket vocabulary — by_bucket rows partition busy time
 BUCKETS = ("grid_topk", "auction", "move_vec_build", "pool_rebuild",
            "scan_loop", "long_tail")
+
+#: the closed collective-op vocabulary (mesh observatory + the host-
+#: dialect skew correction below); HLO instruction roots, async
+#: ``-start``/``-done`` halves included by :func:`classify_collective`
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+
+
+def classify_collective(name: str) -> Optional[str]:
+    """Map an HLO/thunk event name to its collective op, or None.
+
+    ``all-reduce.12`` → ``all-reduce``; async halves
+    (``all-gather-start.3`` / ``all-gather-done.3``) classify as their
+    op — both dialects record collectives under these instruction
+    roots.  Fusions never classify (a fused collective keeps its
+    ``all-*`` root in both profiler dialects)."""
+    root = _name_root(name.lower())
+    for op in COLLECTIVE_OPS:
+        if root == op or root == op + "-start" or root == op + "-done":
+            return op
+    return None
+
+
+def merge_intervals(
+        ivals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Sorted union of ``(start, end)`` intervals (overlaps coalesced)."""
+    out: List[Tuple[float, float]] = []
+    for s, e in sorted(i for i in ivals if i[1] > i[0]):
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def overlap_us(merged_a: List[Tuple[float, float]],
+               merged_b: List[Tuple[float, float]]) -> float:
+    """Total intersection length of two MERGED interval lists."""
+    total = 0.0
+    i = j = 0
+    while i < len(merged_a) and j < len(merged_b):
+        s = max(merged_a[i][0], merged_b[j][0])
+        e = min(merged_a[i][1], merged_b[j][1])
+        if e > s:
+            total += e - s
+        if merged_a[i][1] <= merged_b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
 
 #: kernel rows retained in the artifact (the full table is benchmark
 #: material; the live artifact keeps the head)
@@ -180,8 +236,19 @@ class ParsedTrace:
     dialect: str                        # "device" | "host-thunk"
     rows: List[KernelRow] = field(default_factory=list)
     #: device label → busy microseconds (kernel self time on the device
-    #: dialect; per-lane execution wall on the host-thunk dialect)
+    #: dialect; per-lane execution wall MINUS collective-wait on the
+    #: host-thunk dialect — see ``skew_source``)
     device_busy_us: Dict[str, float] = field(default_factory=dict)
+    #: device label → collective-wait microseconds subtracted from the
+    #: lane wall (host-thunk dialect only; empty on the device dialect,
+    #: whose busy is true kernel self time already)
+    device_collective_us: Dict[str, float] = field(default_factory=dict)
+    #: what per-device "busy" means in this parse: ``"busy"`` (device
+    #: dialect, or a host parse with no collectives to subtract) vs
+    #: ``"busy_minus_collectives"`` (host-thunk dialect with the
+    #: collective-wait correction applied) — recorded in the artifact so
+    #: the two dialects stop silently disagreeing about skew
+    skew_source: str = "busy"
 
     @property
     def total_time_us(self) -> float:
@@ -464,15 +531,37 @@ def _parse_thunk_dialect(thunk_events: List[dict],
         row.time_us = max(0.0, row.time_us)
     parsed.rows = list(agg.values())
     # per-device lanes: one PJRT client thread per addressable device;
-    # each lane sums that device's execution-wall intervals
-    lanes: Dict[int, float] = {}
+    # each lane sums that device's execution-wall intervals.  That wall
+    # includes collective waits (every participating lane blocks for the
+    # whole collective), so with collectives now classified we subtract
+    # the lane's overlap with the collective intervals — per-device busy
+    # becomes comparable to the device dialect's kernel self time
+    # instead of silently disagreeing with it on meshed runs.
+    lane_ivals: Dict[int, List[Tuple[float, float]]] = {}
     for e in helper_events:
-        tid = e.get("tid")
-        lanes[tid] = lanes.get(tid, 0.0) + float(e.get("dur", 0.0))
+        ts = float(e["ts"])
+        lane_ivals.setdefault(e.get("tid"), []).append(
+            (ts, ts + float(e.get("dur", 0.0))))
+    col_merged = merge_intervals([
+        (float(e["ts"]), float(e["ts"]) + float(e.get("dur", 0.0)))
+        for e in events if classify_collective(e["name"]) is not None
+    ])
+    busy: Dict[int, float] = {}
+    col_wait: Dict[int, float] = {}
+    for tid, ivals in lane_ivals.items():
+        wall = sum(e - s for s, e in ivals)
+        wait = overlap_us(merge_intervals(ivals), col_merged)
+        busy[tid] = max(0.0, wall - wait)
+        col_wait[tid] = wait
+    order = {tid: i for i, tid in enumerate(sorted(lane_ivals))}
     parsed.device_busy_us = {
-        f"cpu-lane-{i}": lanes[tid]
-        for i, tid in enumerate(sorted(lanes))
+        f"cpu-lane-{order[tid]}": v for tid, v in busy.items()
     }
+    parsed.device_collective_us = {
+        f"cpu-lane-{order[tid]}": v for tid, v in col_wait.items()
+    }
+    parsed.skew_source = (
+        "busy_minus_collectives" if col_merged else "busy")
     return parsed
 
 
@@ -567,6 +656,7 @@ def build_artifact(
                 for k, v in sorted(parsed.device_busy_us.items())
             },
             "skew": round(skew, 4) if skew is not None else None,
+            "skew_source": parsed.skew_source,
         },
         "kernels": [
             {
@@ -692,6 +782,33 @@ class CaptureManager:
         #: drive loop reads this once per search (plan identity holds:
         #: serial and pipelined drive loops produce bit-identical plans)
         self.capturing = False
+        #: secondary consumers of the ONE capture pipeline (the mesh
+        #: observatory).  Each observer may implement ``on_trace_start
+        #: (meta)`` (trace just started — window baselines),
+        #: ``on_trace_finish(meta)`` (trace stopped, still on the owner
+        #: thread with the search's device state alive — replication
+        #: audits), and ``on_parse(trace_path, meta)`` (the off-thread
+        #: parse, before the trace directory is removed).  Registration
+        #: is structural: observers survive :meth:`reset`/:meth:`scoped`.
+        self._observers: List[Any] = []
+
+    def add_observer(self, observer: Any) -> None:
+        """Register a capture observer (idempotent)."""
+        with self._lock:
+            if observer not in self._observers:
+                self._observers.append(observer)
+
+    def _notify(self, hook: str, *args) -> None:
+        with self._lock:
+            observers = list(self._observers)
+        for obs in observers:
+            fn = getattr(obs, hook, None)
+            if fn is None:
+                continue
+            try:
+                fn(*args)
+            except Exception:  # an observer must not break the capture
+                LOG.exception("kernel-budget observer %s failed", hook)
 
     def _next_id(self) -> str:
         self._seq += 1  # cclint: disable=lock-discipline -- only reachable via self._id_factory, whose call sites (arm, search_scope's legacy claim) hold self._lock
@@ -938,6 +1055,7 @@ class CaptureManager:
             return
         with self._lock:
             self._handle = handle
+        self._notify("on_trace_start", meta)
         events.emit(
             "profiler.capture.start", captureId=meta["id"],
             scans=meta["scansRequested"], reason=meta["reason"],
@@ -955,6 +1073,9 @@ class CaptureManager:
                 handle.stop(export=True)
             except Exception:  # export failed; the parse will report it
                 LOG.exception("kernel-budget trace stop failed")
+        # still on the capture-owner thread, with the search's device
+        # state alive — the mesh observatory's replication audit runs here
+        self._notify("on_trace_finish", {"id": self._capture_id})
         with self._lock:
             meta = {
                 "id": self._capture_id,
@@ -998,7 +1119,8 @@ class CaptureManager:
                 trace_dir, cleanup_dir, meta = self._pending.pop(0)
                 self._parsing += 1
             try:
-                parsed = parse_trace(newest_trace(trace_dir))
+                trace_path = newest_trace(trace_dir)
+                parsed = parse_trace(trace_path)
                 units = max(1, int(meta.get("scansTraced") or 0))
                 artifact = build_artifact(
                     parsed, units=units, unit="scan-call",
@@ -1010,6 +1132,9 @@ class CaptureManager:
                 with self._lock:
                     self._latest = artifact
                     self.captures += 1
+                # secondary consumers (the mesh observatory) parse the
+                # same trace before the directory is cleaned up
+                self._notify("on_parse", trace_path, meta)
             except Exception:
                 with self._lock:
                     self.parse_failures += 1

@@ -1,0 +1,971 @@
+"""Mesh observatory — collective & transfer accounting, dispatch-gap
+attribution, and a replication audit (``cc-tpu-mesh-budget/1``).
+
+PR 14's kernel observatory proved the 8-device mesh is *level* (skew
+1.002) and pinned the sharded slowdown (``SHARDED_DRYRUN_r06.json``:
+83.3 s vs 72.8 s single-device) on "replication / collectives / host
+overhead" — three terms the telemetry stack measured none of.  This
+module closes that gap, riding the kernel observatory's ONE capture
+pipeline (:data:`~cruise_control_tpu.telemetry.kernel_budget.CAPTURE`
+arm → trace → parse; cclint rule ``profiler-discipline`` still holds: no
+second profiler session exists) as a registered capture observer:
+
+* **Collective accounting**: every trace event classifying under the
+  closed :data:`~cruise_control_tpu.telemetry.kernel_budget.
+  COLLECTIVE_OPS` vocabulary (all-reduce / all-gather / reduce-scatter /
+  collective-permute / all-to-all, async ``-start``/``-done`` halves
+  included) aggregates per-op counts, time, and bytes — exposed as
+  ``cc_collective_busy_ms{op=}`` / ``cc_collective_bytes{op=}``.
+* **Transfer ledger**: H2D/D2H copy events from the trace (``MemcpyH2D``
+  / ``TransferToDevice`` / ``TransferFromDevice`` … vocabularies of both
+  runtimes) PLUS an instrumented byte counter per logical fn — the
+  sanctioned transfer entry points :func:`device_put` / :func:`fetch`
+  (cclint rule ``transfer-discipline`` flags raw ``jax.device_put`` /
+  device-array ``np.asarray`` sites outside sanctioned modules).  The
+  per-capture artifact windows the ledger (baseline at trace start), and
+  ``GET /metrics`` carries ``cc_transfer_bytes/ms{direction=,fn=}``.
+* **Dispatch-gap attribution**: per device, a priority sweep
+  (collective > transfer > busy) over the capture window assigns every
+  elementary time slice to exactly ONE term, so
+  ``busy + collective + transfer + host_gap == wall`` EXACTLY — the same
+  partition discipline as ``cc-tpu-kernel-budget/2``'s by-bucket
+  reconciliation, now at mesh level.  On the host-thunk dialect the
+  per-device lanes are the PJRT client threads' ``ThunkExecutor::
+  Execute`` walls; collective/transfer intervals count only where they
+  intersect the lane (the lane is provably blocked inside its own wall),
+  and out-of-lane time is host gap.
+* **Replication audit** (:func:`audit_replication`): walks live arrays'
+  sharding specs and reports bytes stored replicated vs sharded across
+  the mesh (``cc_mesh_replicated_bytes``; merged into ``/diagnostics``
+  and the flight recorder).  The capture-finish hook runs it on the
+  owner thread while the search's device state is still alive.
+
+Served on ``GET /profile/mesh`` with the same 202-arm / poll ladder as
+``/profile/kernels`` (one armed capture feeds BOTH observatories);
+regression gates live in ``tests/budgets/mesh_budget.json``
+(:func:`compare_mesh_budget`), and the committed ``MESH_BUDGET_r17.json``
+decomposes the full 8-device ``SHARDED_DRYRUN`` run
+(``benchmarks/sharded_large_dryrun.py --mesh-out``).
+
+Journal: ``profiler.mesh.parsed`` (deterministic payload — capture id,
+dialect, units, sorted collective-op names, device count) and
+``profiler.mesh.audit`` (explicit audits only, never the capture hook,
+so scenario fingerprints stay bit-stable).  Disarmed cost is one
+attribute check per routed transfer — gated ≤1 % by ``bench.py``'s
+``mesh_overhead_pct``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.telemetry import kernel_budget
+from cruise_control_tpu.telemetry.kernel_budget import (
+    COLLECTIVE_OPS,
+    classify_collective,
+    merge_intervals,
+)
+from cruise_control_tpu.utils.logging import get_logger
+
+LOG = get_logger("mesh_budget")
+
+SCHEMA = "cc-tpu-mesh-budget/1"
+
+#: the closed wall-decomposition vocabulary — terms partition the window
+WALL_TERMS = ("busy", "collective", "transfer", "host_gap")
+
+_H2D_MARKS = ("memcpyh2d", "transfertodevice", "bufferfromhostbuffer",
+              "copytodevice", "infeed")
+_D2H_MARKS = ("memcpyd2h", "transferfromdevice", "copyrawtohost",
+              "toliteral", "outfeed")
+
+
+def classify_transfer(name: str) -> Optional[str]:
+    """Map a trace event name to a transfer direction (``"h2d"`` /
+    ``"d2h"``) or None.  Covers both runtimes' host-transfer event
+    vocabularies; device-side ``copy`` HLOs are intra-device moves, not
+    host transfers, and do not classify."""
+    n = name.lower()
+    for mark in _H2D_MARKS:
+        if mark in n:
+            return "h2d"
+    for mark in _D2H_MARKS:
+        if mark in n:
+            return "d2h"
+    return None
+
+
+def _event_bytes(args: dict) -> int:
+    for key in ("raw_bytes_accessed", "bytes_accessed",
+                "bytes_transferred", "bytes", "size"):
+        v = args.get(key)
+        if v is not None:
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                continue
+    return 0
+
+
+# ---- parsing ---------------------------------------------------------------------
+@dataclass
+class DeviceSplit:
+    """One device's exact wall partition over the capture window."""
+
+    wall_us: float = 0.0
+    busy_us: float = 0.0
+    collective_us: float = 0.0
+    transfer_us: float = 0.0
+    gap_us: float = 0.0
+
+
+@dataclass
+class MeshParse:
+    """Parser output: the mesh-level decomposition of one capture."""
+
+    dialect: str                        # "device" | "host-thunk"
+    window_us: float = 0.0
+    #: op → {"count", "time_us", "bytes"} (closed COLLECTIVE_OPS keys)
+    collectives: Dict[str, dict] = field(default_factory=dict)
+    #: direction → {"count", "time_us", "bytes"} (trace-derived copies)
+    transfers: Dict[str, dict] = field(default_factory=dict)
+    devices: Dict[str, DeviceSplit] = field(default_factory=dict)
+    skew_source: str = "busy"
+
+    def skew(self) -> Optional[float]:
+        vals = [d.busy_us for d in self.devices.values() if d.busy_us > 0]
+        if not vals:
+            return None
+        mean = sum(vals) / len(vals)
+        return (max(vals) / mean) if mean > 0 else None
+
+
+_PRIO = {"collective": 0, "transfer": 1, "busy": 2}
+
+
+def _sweep(window: Tuple[float, float],
+           classed: List[Tuple[float, float, str]]) -> DeviceSplit:
+    """Priority sweep-line: assign every elementary slice of ``window``
+    to exactly one class (collective > transfer > busy; uncovered time is
+    the gap), so the returned terms partition the window EXACTLY —
+    overlapping async kernels are counted once, never double."""
+    w0, w1 = window
+    span = max(0.0, w1 - w0)
+    deltas: Dict[float, List[int]] = {}
+    for s, e, cls in classed:
+        s, e = max(s, w0), min(e, w1)
+        if e <= s:
+            continue
+        i = _PRIO[cls]
+        deltas.setdefault(s, [0, 0, 0])[i] += 1
+        deltas.setdefault(e, [0, 0, 0])[i] -= 1
+    acc = [0.0, 0.0, 0.0]
+    active = [0, 0, 0]
+    prev: Optional[float] = None
+    for t in sorted(deltas):
+        if prev is not None and t > prev:
+            seg = t - prev
+            for i in range(3):
+                if active[i] > 0:
+                    acc[i] += seg
+                    break
+        d = deltas[t]
+        for i in range(3):
+            active[i] += d[i]
+        prev = t
+    occupied = acc[0] + acc[1] + acc[2]
+    return DeviceSplit(
+        wall_us=span, busy_us=acc[2], collective_us=acc[0],
+        transfer_us=acc[1], gap_us=max(0.0, span - occupied),
+    )
+
+
+def _intersect(merged_a: List[Tuple[float, float]],
+               merged_b: List[Tuple[float, float]],
+               ) -> List[Tuple[float, float]]:
+    """Pairwise intersection of two MERGED interval lists."""
+    out: List[Tuple[float, float]] = []
+    i = j = 0
+    while i < len(merged_a) and j < len(merged_b):
+        s = max(merged_a[i][0], merged_b[j][0])
+        e = min(merged_a[i][1], merged_b[j][1])
+        if e > s:
+            out.append((s, e))
+        if merged_a[i][1] <= merged_b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _tally(table: Dict[str, dict], key: str, dur: float, nbytes: int,
+           ) -> None:
+    row = table.setdefault(key, {"count": 0, "time_us": 0.0, "bytes": 0})
+    row["count"] += 1
+    row["time_us"] += dur
+    row["bytes"] += nbytes
+
+
+def parse_mesh_trace(trace_path: str) -> MeshParse:
+    """Parse one Chrome-trace into the mesh decomposition, auto-detecting
+    the profiler dialect exactly like
+    :func:`~cruise_control_tpu.telemetry.kernel_budget.parse_trace`."""
+    with gzip.open(trace_path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    device_pids: Dict[int, str] = {}
+    client_threads: Dict[Tuple[int, int], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        name = e.get("args", {}).get("name", "")
+        if e.get("name") == "process_name" \
+                and str(name).startswith("/device:"):
+            device_pids[e["pid"]] = str(name)
+        elif e.get("name") == "thread_name" \
+                and str(name).startswith("tf_XLATfrtCpuClient"):
+            client_threads[(e["pid"], e.get("tid"))] = str(name)
+
+    xevents = [e for e in events if e.get("ph") == "X"]
+    transfer_events = [
+        (e, classify_transfer(str(e.get("name", ""))))
+        for e in xevents
+    ]
+    transfer_events = [(e, d) for e, d in transfer_events if d]
+
+    device_events = [
+        e for e in xevents
+        if e.get("pid") in device_pids and "hlo_category" in e.get("args", {})
+    ]
+    if device_events:
+        return _parse_device_mesh(device_events, device_pids,
+                                  transfer_events)
+    thunk_events = [e for e in xevents if "hlo_op" in e.get("args", {})]
+    lane_events = [
+        e for e in xevents
+        if str(e.get("name", "")).startswith("ThunkExecutor::Execute")
+    ]
+    on_clients = [e for e in lane_events
+                  if (e["pid"], e.get("tid")) in client_threads]
+    return _parse_thunk_mesh(thunk_events, on_clients or lane_events,
+                             transfer_events)
+
+
+def _ival(e: dict) -> Tuple[float, float]:
+    ts = float(e["ts"])
+    return ts, ts + float(e.get("dur", 0.0))
+
+
+def _window(ivals: List[Tuple[float, float]]) -> Tuple[float, float]:
+    if not ivals:
+        return (0.0, 0.0)
+    return (min(s for s, _ in ivals), max(e for _, e in ivals))
+
+
+def _parse_device_mesh(device_events: List[dict],
+                       device_pids: Dict[int, str],
+                       transfer_events: List[Tuple[dict, str]],
+                       ) -> MeshParse:
+    parsed = MeshParse(dialect="device")
+
+    def dur_us(e: dict) -> float:
+        return float(e["args"].get("device_duration_ps", 0)) / 1e6
+
+    # leaf kernels only: regions (while/conditional) re-span their
+    # bodies and would blanket genuine dispatch gaps as busy
+    leaves = [e for e in device_events
+              if not kernel_budget._is_region_device(e)]
+    ivals: Dict[int, List[Tuple[float, float, str]]] = {}
+    all_spans: List[Tuple[float, float]] = []
+    for e in leaves:
+        ts = float(e["ts"])
+        end = ts + dur_us(e)
+        all_spans.append((ts, end))
+        name = str(e.get("name", ""))
+        op = classify_collective(name)
+        if op is not None:
+            cls = "collective"
+            _tally(parsed.collectives, op, dur_us(e),
+                   _event_bytes(e.get("args", {})))
+        elif classify_transfer(name) is not None:
+            cls = "transfer"
+        else:
+            cls = "busy"
+        ivals.setdefault(e["pid"], []).append((ts, end, cls))
+    for e, direction in transfer_events:
+        ts, end = _ival(e)
+        all_spans.append((ts, end))
+        _tally(parsed.transfers, direction, end - ts,
+               _event_bytes(e.get("args", {})))
+        if e.get("pid") in device_pids \
+                and "hlo_category" not in e.get("args", {}):
+            # host-track copy events on a device pid (memcpy streams)
+            # charge that device; hlo-classified ones already did above
+            ivals.setdefault(e["pid"], []).append((ts, end, "transfer"))
+    window = _window(all_spans)
+    parsed.window_us = max(0.0, window[1] - window[0])
+    for pid, classed in ivals.items():
+        label = device_pids.get(pid, f"pid-{pid}")
+        parsed.devices[label] = _sweep(window, classed)
+    parsed.skew_source = "busy"
+    return parsed
+
+
+def _parse_thunk_mesh(thunk_events: List[dict],
+                      lane_events: List[dict],
+                      transfer_events: List[Tuple[dict, str]],
+                      ) -> MeshParse:
+    parsed = MeshParse(dialect="host-thunk")
+    col_ivals: List[Tuple[float, float]] = []
+    for e in thunk_events:
+        op = classify_collective(str(e.get("name", "")))
+        if op is not None:
+            s, end = _ival(e)
+            col_ivals.append((s, end))
+            _tally(parsed.collectives, op, end - s,
+                   _event_bytes(e.get("args", {})))
+    xfer_ivals: List[Tuple[float, float]] = []
+    for e, direction in transfer_events:
+        s, end = _ival(e)
+        xfer_ivals.append((s, end))
+        _tally(parsed.transfers, direction, end - s,
+               _event_bytes(e.get("args", {})))
+    col_merged = merge_intervals(col_ivals)
+    xfer_merged = merge_intervals(xfer_ivals)
+
+    lanes: Dict[Any, List[Tuple[float, float]]] = {}
+    all_spans = [_ival(e) for e in thunk_events] + xfer_ivals
+    for e in lane_events:
+        iv = _ival(e)
+        all_spans.append(iv)
+        lanes.setdefault(e.get("tid"), []).append(iv)
+    window = _window(all_spans)
+    parsed.window_us = max(0.0, window[1] - window[0])
+    order = {tid: i for i, tid in enumerate(sorted(lanes))}
+    for tid, ivals in lanes.items():
+        lane_merged = merge_intervals(ivals)
+        # collective/transfer time counts only where it intersects the
+        # lane's own execution wall (the lane is provably blocked there);
+        # out-of-lane time is host gap, never speculatively attributed
+        classed: List[Tuple[float, float, str]] = \
+            [(s, e, "busy") for s, e in ivals]
+        classed += [(s, e, "collective")
+                    for s, e in _intersect(col_merged, lane_merged)]
+        classed += [(s, e, "transfer")
+                    for s, e in _intersect(xfer_merged, lane_merged)]
+        parsed.devices[f"cpu-lane-{order[tid]}"] = _sweep(window, classed)
+    parsed.skew_source = (
+        "busy_minus_collectives" if col_merged else "busy")
+    return parsed
+
+
+# ---- the transfer ledger ---------------------------------------------------------
+def _tree_nbytes(x: Any) -> int:
+    n = getattr(x, "nbytes", None)
+    if n is not None:
+        return int(n)
+    if isinstance(x, dict):
+        return sum(_tree_nbytes(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return sum(_tree_nbytes(v) for v in x)
+    try:
+        return int(np.asarray(x).nbytes)
+    except Exception:
+        return 0
+
+
+class TransferLedger:
+    """Byte/time counters per (direction, logical fn) for every transfer
+    routed through the sanctioned entry points.  The trace sees copies as
+    anonymous events; the ledger names them, so ``cc_transfer_bytes
+    {direction=,fn=}`` can say WHICH code path pays.  Disabled cost: one
+    attribute read per call."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        #: fn → {"h2d_count", "h2d_bytes", "h2d_us", "d2h_count", ...}
+        self._by_fn: Dict[str, Dict[str, float]] = {}
+
+    def note(self, direction: str, fn: str, nbytes: int,
+             dur_s: float = 0.0) -> None:
+        """Record one transfer (``direction`` is ``"h2d"``/``"d2h"``).
+        The generic seam for sites that perform the copy themselves
+        (e.g. the model upload's ``jnp.asarray`` batch)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            row = self._by_fn.setdefault(fn, {
+                "h2d_count": 0, "h2d_bytes": 0, "h2d_us": 0.0,
+                "d2h_count": 0, "d2h_bytes": 0, "d2h_us": 0.0,
+            })
+            row[f"{direction}_count"] += 1
+            row[f"{direction}_bytes"] += int(nbytes)
+            row[f"{direction}_us"] += dur_s * 1e6
+
+    def device_put(self, x: Any, device: Any = None, *,
+                   fn: str = "unlabeled") -> Any:
+        """The instrumented ``jax.device_put`` — the ONE sanctioned raw
+        call site outside ``ops/`` / ``models/builder`` (cclint rule
+        ``transfer-discipline``)."""
+        import jax
+
+        t0 = time.perf_counter()
+        out = jax.device_put(x, device) if device is not None \
+            else jax.device_put(x)
+        if self.enabled:
+            self.note("h2d", fn, _tree_nbytes(x),
+                      time.perf_counter() - t0)
+        return out
+
+    def fetch(self, x: Any, *, fn: str = "unlabeled") -> np.ndarray:
+        """The instrumented D2H materialization (``np.asarray`` on a
+        device array) — drive-loop result fetches route through here so
+        the ledger charges them to a named fn."""
+        if not self.enabled:
+            return np.asarray(x)
+        t0 = time.perf_counter()
+        out = np.asarray(x)
+        self.note("d2h", fn, int(out.nbytes), time.perf_counter() - t0)
+        return out
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {fn: dict(row) for fn, row in self._by_fn.items()}
+
+    @staticmethod
+    def delta(now: Dict[str, Dict[str, float]],
+              baseline: Optional[Dict[str, Dict[str, float]]],
+              ) -> Dict[str, Dict[str, float]]:
+        """``now - baseline`` per fn/field (fns absent from the window
+        drop out) — the per-capture ledger window."""
+        if not baseline:
+            return now
+        out: Dict[str, Dict[str, float]] = {}
+        for fn, row in now.items():
+            base = baseline.get(fn, {})
+            d = {k: v - base.get(k, 0) for k, v in row.items()}
+            if any(d[k] for k in ("h2d_count", "d2h_count")):
+                out[fn] = d
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._by_fn = {}
+
+
+# ---- the replication audit -------------------------------------------------------
+def audit_replication(max_arrays: int = 4096) -> dict:
+    """Walk live arrays' sharding specs: bytes stored once per mesh
+    (sharded), bytes stored as extra copies (replicated), and bytes on
+    single-device arrays.  ``stored`` sums addressable shard sizes, so
+    ``replicated_bytes == stored - logical`` per multi-device array —
+    the device memory the sharding PR can reclaim."""
+    import jax
+
+    arrays = jax.live_arrays()
+    out = {
+        "arrays": 0, "skipped": 0,
+        "truncated": len(arrays) > max_arrays,
+        "devices": len(jax.devices()),
+        "logical_bytes": 0, "stored_bytes": 0,
+        "replicated_bytes": 0, "sharded_bytes": 0,
+        "single_device_bytes": 0,
+    }
+    for arr in arrays[:max_arrays]:
+        try:
+            nbytes = int(arr.nbytes)
+            shards = arr.addressable_shards
+            stored = sum(int(s.data.nbytes) for s in shards)
+            ndev = len(shards)
+        except (RuntimeError, ValueError, AttributeError):
+            # deleted/donated arrays raise on access; skip, count
+            out["skipped"] += 1
+            continue
+        out["arrays"] += 1
+        out["logical_bytes"] += nbytes
+        out["stored_bytes"] += stored
+        if ndev <= 1:
+            out["single_device_bytes"] += stored
+        else:
+            extra = max(0, stored - nbytes)
+            out["replicated_bytes"] += extra
+            out["sharded_bytes"] += stored - extra
+    return out
+
+
+# ---- artifact --------------------------------------------------------------------
+def build_mesh_artifact(
+    parsed: MeshParse,
+    units: int,
+    unit: str = "scan-call",
+    source: str = "live-capture",
+    backend: Optional[str] = None,
+    capture: Optional[dict] = None,
+    fixture: Optional[dict] = None,
+    ledger: Optional[Dict[str, Dict[str, float]]] = None,
+    replication: Optional[dict] = None,
+    now: Optional[float] = None,
+) -> dict:
+    """Assemble the ``cc-tpu-mesh-budget/1`` artifact.  The ``wall``
+    block is the per-device MEAN of each term; by the sweep's
+    construction ``busy + collective + transfer + host_gap == wall``
+    exactly (``reconciliation_pct`` is the proof the gate test pins)."""
+    units = max(1, int(units))
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    devs = parsed.devices
+    n = max(1, len(devs))
+
+    def mean(attr: str) -> float:
+        return sum(getattr(d, attr) for d in devs.values()) / n
+
+    wall_us = mean("wall_us")
+    terms_us = {
+        "busy": mean("busy_us"),
+        "collective": mean("collective_us"),
+        "transfer": mean("transfer_us"),
+        "host_gap": mean("gap_us"),
+    }
+    skew = parsed.skew()
+    col_total_us = sum(v["time_us"] for v in parsed.collectives.values())
+    art = {
+        "schema": SCHEMA,
+        "generated_unix": round(time.time() if now is None else now, 3),
+        "backend": backend,
+        "dialect": parsed.dialect,
+        "source": source,
+        "unit": unit,
+        "units": units,
+        "collectives": {
+            "time_ms": round(col_total_us / 1e3, 4),
+            "bytes": int(sum(v["bytes"]
+                             for v in parsed.collectives.values())),
+            "by_op": {
+                op: {
+                    "count": int(v["count"]),
+                    "count_per_unit": round(v["count"] / units, 2),
+                    "time_ms": round(v["time_us"] / 1e3, 4),
+                    "bytes": int(v["bytes"]),
+                }
+                for op, v in sorted(parsed.collectives.items())
+            },
+        },
+        "transfers": {
+            "trace": {
+                d: {
+                    "count": int(v["count"]),
+                    "count_per_unit": round(v["count"] / units, 2),
+                    "time_ms": round(v["time_us"] / 1e3, 4),
+                    "bytes": int(v["bytes"]),
+                }
+                for d, v in sorted(parsed.transfers.items())
+            },
+            "ledger": {
+                "enabled": ledger is not None,
+                "by_fn": {
+                    fn: {
+                        "h2d_count": int(row.get("h2d_count", 0)),
+                        "h2d_bytes": int(row.get("h2d_bytes", 0)),
+                        "h2d_ms": round(row.get("h2d_us", 0.0) / 1e3, 4),
+                        "d2h_count": int(row.get("d2h_count", 0)),
+                        "d2h_bytes": int(row.get("d2h_bytes", 0)),
+                        "d2h_ms": round(row.get("d2h_us", 0.0) / 1e3, 4),
+                    }
+                    for fn, row in sorted((ledger or {}).items())
+                },
+            },
+        },
+        "devices": {
+            "count": len(devs),
+            "skew": round(skew, 4) if skew is not None else None,
+            "skew_source": parsed.skew_source,
+            "per_device": {
+                label: {
+                    "wall_ms": round(d.wall_us / 1e3, 4),
+                    "busy_ms": round(d.busy_us / 1e3, 4),
+                    "collective_ms": round(d.collective_us / 1e3, 4),
+                    "transfer_ms": round(d.transfer_us / 1e3, 4),
+                    "gap_ms": round(d.gap_us / 1e3, 4),
+                }
+                for label, d in sorted(devs.items())
+            },
+        },
+        "wall": {
+            "window_ms": round(wall_us / 1e3, 4),
+            "busy_ms": round(terms_us["busy"] / 1e3, 4),
+            "collective_ms": round(terms_us["collective"] / 1e3, 4),
+            "transfer_ms": round(terms_us["transfer"] / 1e3, 4),
+            "host_gap_ms": round(terms_us["host_gap"] / 1e3, 4),
+            "reconciliation_pct": round(
+                100.0 * sum(terms_us.values()) / wall_us
+                if wall_us > 0 else 100.0, 4),
+        },
+    }
+    if replication is not None:
+        art["replication"] = replication
+    if capture is not None:
+        art["capture"] = capture
+    if fixture is not None:
+        art["fixture"] = fixture
+    return art
+
+
+# ---- budget regression gate ------------------------------------------------------
+def compare_mesh_budget(artifact: dict, budget: dict) -> List[str]:
+    """Gate a measured mesh artifact against the pinned per-term budget
+    (``tests/budgets/mesh_budget.json``).  Counts only — timings are
+    host-noisy; counts are deterministic for a fixed program:
+
+    * collective ops: per-op ``count_per_unit`` ceilings, and any op NOT
+      in the budget appearing at all is a regression (a new collective
+      in the scan program must be a deliberate budget regen);
+    * trace transfers: per-direction ``count_per_unit`` ceilings;
+    * ledger fns: the fn vocabulary is closed, with per-fn d2h/h2d count
+      ceilings (a new un-budgeted transfer site fails the gate).
+
+    Shrinkage is an improvement, never a violation."""
+    tol = 1.0 + float(budget.get("tolerance_pct", 25)) / 100.0
+    out: List[str] = []
+    pinned_fixture = budget.get("fixture") or {}
+    fixture = artifact.get("fixture") or {}
+    for key in sorted(set(pinned_fixture) & set(fixture)):
+        if pinned_fixture[key] != fixture[key]:
+            out.append(
+                f"fixture mismatch on {key!r}: measured "
+                f"{fixture[key]!r} vs budget {pinned_fixture[key]!r} — "
+                "mesh counts only compare at identical shapes"
+            )
+    if out:
+        return out
+    pinned_ops = budget.get("collective_ops", {})
+    by_op = artifact.get("collectives", {}).get("by_op", {})
+    for op, v in sorted(by_op.items()):
+        got = float(v.get("count_per_unit", 0.0))
+        if op not in pinned_ops:
+            if got > 0:
+                out.append(
+                    f"unexpected collective op {op!r}: {got:g}/"
+                    f"{artifact['unit']} (not in the pinned budget)"
+                )
+            continue
+        ceiling = float(pinned_ops[op]) * tol
+        if got > ceiling:
+            out.append(
+                f"collective {op!r} grew to {got:g}/{artifact['unit']} "
+                f"(budget {pinned_ops[op]:g}, ceiling {ceiling:g})"
+            )
+    pinned_xfer = budget.get("transfer_trace", {})
+    trace = artifact.get("transfers", {}).get("trace", {})
+    for direction, v in sorted(trace.items()):
+        got = float(v.get("count_per_unit", 0.0))
+        ceiling = float(pinned_xfer.get(direction, 0.0)) * tol
+        if got > ceiling:
+            out.append(
+                f"trace {direction} transfers grew to {got:g}/"
+                f"{artifact['unit']} (ceiling {ceiling:g})"
+            )
+    pinned_fns = budget.get("ledger_fns", {})
+    by_fn = artifact.get("transfers", {}).get("ledger", {}) \
+        .get("by_fn", {})
+    for fn, row in sorted(by_fn.items()):
+        if fn not in pinned_fns:
+            out.append(
+                f"unexpected ledger fn {fn!r} "
+                "(new transfer site — regen tests/budgets/"
+                "mesh_budget.json if intended)"
+            )
+            continue
+        for direction in ("h2d", "d2h"):
+            got = float(row.get(f"{direction}_count", 0)) \
+                / max(1, int(artifact.get("units", 1)))
+            ceiling = float(
+                pinned_fns[fn].get(f"{direction}_count_per_unit", 0.0)
+            ) * tol
+            if got > ceiling:
+                out.append(
+                    f"ledger fn {fn!r} {direction} grew to {got:g}/"
+                    f"{artifact['unit']} (ceiling {ceiling:g})"
+                )
+    return out
+
+
+# ---- the observatory (a CaptureManager observer) ---------------------------------
+class MeshObservatory:
+    """Mesh-level consumer of the kernel observatory's capture pipeline.
+
+    Registered as a :class:`~cruise_control_tpu.telemetry.kernel_budget.
+    CaptureManager` observer (:meth:`attach`): one armed capture feeds
+    BOTH artifacts.  Hooks: trace start snapshots the transfer-ledger
+    baseline (the artifact windows the ledger), trace finish runs the
+    replication audit while the search's device state is alive, and the
+    off-thread parse builds ``cc-tpu-mesh-budget/1``."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.ledger = TransferLedger()
+        self.audit_max_arrays = 4096
+        self._lock = threading.Lock()
+        self._latest: Optional[dict] = None
+        self._ledger_baseline: Optional[dict] = None
+        self._last_audit: Optional[dict] = None
+        self.parses = 0
+        self.parse_failures = 0
+
+    # ---- configuration ----------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  ledger_enabled: Optional[bool] = None,
+                  audit_max_arrays: Optional[int] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if audit_max_arrays is not None:
+                self.audit_max_arrays = max(1, int(audit_max_arrays))
+        if ledger_enabled is not None:
+            self.ledger.enabled = bool(ledger_enabled)
+
+    def attach(self, capture: Optional[Any] = None) -> None:
+        """Register on the capture pipeline (idempotent)."""
+        (capture or kernel_budget.CAPTURE).add_observer(self)
+
+    def reset(self) -> None:
+        """Drop parsed state + ledger (tests).  Attachment survives —
+        registration is structural, like the capture manager's own."""
+        with self._lock:
+            self._latest = None
+            self._ledger_baseline = None
+            self._last_audit = None
+            self.parses = 0
+            self.parse_failures = 0
+        self.ledger.reset()
+
+    # ---- CaptureManager observer hooks ------------------------------------------
+    def on_trace_start(self, meta: dict) -> None:
+        if not self.enabled:
+            return
+        baseline = self.ledger.snapshot()
+        with self._lock:
+            self._ledger_baseline = baseline
+
+    def on_trace_finish(self, meta: dict) -> None:
+        if not self.enabled:
+            return
+        try:
+            audit = audit_replication(self.audit_max_arrays)
+        except Exception:  # no jax / backend refused: artifact goes without
+            LOG.exception("mesh-budget replication audit failed")
+            audit = None
+        with self._lock:
+            self._last_audit = audit
+
+    def on_parse(self, trace_path: str, meta: dict) -> None:
+        if not self.enabled:
+            return
+        from cruise_control_tpu.telemetry import events
+
+        try:
+            parsed = parse_mesh_trace(trace_path)
+            units = max(1, int(meta.get("scansTraced") or 0))
+            with self._lock:
+                baseline = self._ledger_baseline
+                audit = self._last_audit
+            ledger = TransferLedger.delta(self.ledger.snapshot(), baseline)
+            artifact = build_mesh_artifact(
+                parsed, units=units, unit="scan-call",
+                source=("legacy-trace-dir"
+                        if meta.get("reason") == "profiler_trace_dir"
+                        else "live-capture"),
+                capture=dict(meta), ledger=ledger, replication=audit,
+            )
+            with self._lock:
+                self._latest = artifact
+                self.parses += 1
+        except Exception:
+            with self._lock:
+                self.parse_failures += 1
+            LOG.exception("mesh-budget trace parse failed for capture %s",
+                          meta.get("id"))
+            return
+        # deterministic payload ONLY (scenario fingerprints): the lane
+        # count on the host-thunk dialect follows thread scheduling, so
+        # it stays out of the journal — read it from the artifact
+        events.emit(
+            "profiler.mesh.parsed", captureId=meta.get("id"),
+            dialect=parsed.dialect, units=units,
+            collectiveOps=sorted(parsed.collectives),
+        )
+
+    # ---- operator surface --------------------------------------------------------
+    def arm(self, scans: Optional[int] = None,
+            reason: str = "mesh-api") -> dict:
+        """Arm a capture through the shared pipeline (the kernel
+        observatory parses the same trace)."""
+        self.attach()
+        kernel_budget.CAPTURE.arm(scans=scans, reason=reason)
+        return self.state()
+
+    def audit(self) -> dict:
+        """Run the replication audit NOW (journaled — the explicit
+        operator action, unlike the capture-finish hook)."""
+        from cruise_control_tpu.telemetry import events
+
+        art = audit_replication(self.audit_max_arrays)
+        with self._lock:
+            self._last_audit = art
+        events.emit(
+            "profiler.mesh.audit", arrays=art["arrays"],
+            replicatedBytes=art["replicated_bytes"],
+            shardedBytes=art["sharded_bytes"],
+            singleDeviceBytes=art["single_device_bytes"],
+        )
+        return art
+
+    def state(self) -> dict:
+        cap = kernel_budget.CAPTURE.state()
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "ledgerEnabled": self.ledger.enabled,
+                "capture": cap,
+                "parses": self.parses,
+                "parseFailures": self.parse_failures,
+            }
+
+    def latest(self) -> Optional[dict]:
+        with self._lock:
+            return self._latest
+
+    def summary(self) -> dict:
+        """The ``/diagnostics`` merge block (``meshBudget``)."""
+        out = self.state()
+        with self._lock:
+            out["latest"] = self._latest
+            out["lastAudit"] = self._last_audit
+        return out
+
+    def families(self) -> List[tuple]:
+        """``extra_families`` rows for the Prometheus exposition."""
+        art = self.latest()
+        with self._lock:
+            audit = self._last_audit
+        fams: List[tuple] = []
+        if art is not None:
+            by_op = art["collectives"]["by_op"]
+            if by_op:
+                fams.append((
+                    "cc_collective_busy_ms", "gauge",
+                    "Collective time in the latest mesh capture, by op",
+                    [({"op": op}, float(v["time_ms"]))
+                     for op, v in by_op.items()],
+                ))
+                fams.append((
+                    "cc_collective_bytes", "gauge",
+                    "Collective bytes in the latest mesh capture, by op "
+                    "(0 on backends without byte counters)",
+                    [({"op": op}, float(v["bytes"]))
+                     for op, v in by_op.items()],
+                ))
+            xfer_rows_b: List[tuple] = []
+            xfer_rows_ms: List[tuple] = []
+            for d, v in art["transfers"]["trace"].items():
+                xfer_rows_b.append(
+                    ({"direction": d, "fn": "trace"}, float(v["bytes"])))
+                xfer_rows_ms.append(
+                    ({"direction": d, "fn": "trace"}, float(v["time_ms"])))
+            for fn, row in art["transfers"]["ledger"]["by_fn"].items():
+                for d in ("h2d", "d2h"):
+                    if row[f"{d}_count"]:
+                        xfer_rows_b.append(({"direction": d, "fn": fn},
+                                            float(row[f"{d}_bytes"])))
+                        xfer_rows_ms.append(({"direction": d, "fn": fn},
+                                             float(row[f"{d}_ms"])))
+            if xfer_rows_b:
+                fams.append((
+                    "cc_transfer_bytes", "gauge",
+                    "H2D/D2H bytes in the latest mesh capture window "
+                    "(trace copies + the instrumented ledger, by fn)",
+                    xfer_rows_b,
+                ))
+                fams.append((
+                    "cc_transfer_ms", "gauge",
+                    "H2D/D2H time in the latest mesh capture window",
+                    xfer_rows_ms,
+                ))
+            wall = art["wall"]
+            fams.append((
+                "cc_mesh_host_gap_ms", "gauge",
+                "Mean per-device host/dispatch gap in the latest mesh "
+                "capture window",
+                [({}, float(wall["host_gap_ms"]))],
+            ))
+        if audit is not None:
+            fams.append((
+                "cc_mesh_replicated_bytes", "gauge",
+                "Bytes stored as extra replicated copies across the mesh "
+                "(latest replication audit)",
+                [({}, float(audit["replicated_bytes"]))],
+            ))
+            fams.append((
+                "cc_mesh_sharded_bytes", "gauge",
+                "Bytes stored sharded (one logical copy split across "
+                "devices; latest replication audit)",
+                [({}, float(audit["sharded_bytes"]))],
+            ))
+        return fams
+
+    def install_gauges(self, registry) -> None:
+        registry.gauge("mesh.capture.parses",
+                       lambda: float(self.parses))
+        registry.gauge("mesh.capture.parse.failures",
+                       lambda: float(self.parse_failures))
+
+
+#: process-wide default (bootstrap reconfigures it from the
+#: telemetry.mesh.* keys and attaches it to the capture pipeline)
+MESH = MeshObservatory()
+
+
+# module-level conveniences bound to the default instance -------------------------
+def configure(**kwargs) -> None:
+    MESH.configure(**kwargs)
+
+
+def arm(scans: Optional[int] = None, reason: str = "mesh-api") -> dict:
+    return MESH.arm(scans=scans, reason=reason)
+
+
+def latest() -> Optional[dict]:
+    return MESH.latest()
+
+
+def device_put(x: Any, device: Any = None, *,
+               fn: str = "unlabeled") -> Any:
+    """The sanctioned H2D entry point (cclint ``transfer-discipline``)."""
+    return MESH.ledger.device_put(x, device, fn=fn)
+
+
+def fetch(x: Any, *, fn: str = "unlabeled") -> np.ndarray:
+    """The sanctioned D2H entry point (cclint ``transfer-discipline``)."""
+    return MESH.ledger.fetch(x, fn=fn)
+
+
+def note_transfer(direction: str, fn: str, nbytes: int,
+                  dur_s: float = 0.0) -> None:
+    MESH.ledger.note(direction, fn, nbytes, dur_s)
+
+
+def install_gauges(registry) -> None:
+    MESH.install_gauges(registry)
+
+
+def reset() -> None:
+    MESH.reset()

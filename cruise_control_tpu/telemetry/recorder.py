@@ -35,6 +35,7 @@ Artifact schema (``SCHEMA``):
       "traces": [<trace.TraceStore.index() summaries, when attached>],
       "deviceStats": {<device_stats.MONITOR.summary()>},
       "kernelBudget": {<kernel_budget.CAPTURE.summary()>, when attached},
+      "meshBudget": {<mesh_budget.MESH.summary()>, when attached},
       ...extra keys the dump path merges in ("dumpReason")
     }
 
@@ -86,6 +87,7 @@ class FlightRecorder:
         events_source: Optional[Callable[[], List[dict]]] = None,
         traces_source: Optional[Callable[[], List[dict]]] = None,
         kernel_budget_source: Optional[Callable[[], dict]] = None,
+        mesh_budget_source: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.interval_s = max(0.01, float(interval_s))
@@ -106,6 +108,10 @@ class FlightRecorder:
         #: kernel budget (latest parsed capture + capture state) merged as
         #: `kernelBudget`, beside deviceStats.deviceCost's estimates
         self.kernel_budget_source = kernel_budget_source
+        #: telemetry/mesh_budget.MESH.summary — the mesh observatory's
+        #: collective/transfer/gap decomposition + replication audit,
+        #: merged as `meshBudget`
+        self.mesh_budget_source = mesh_budget_source
         self._lock = threading.Lock()
         self._series: Dict[str, deque] = {}
         self._prev_cum: Dict[str, float] = {}
@@ -233,6 +239,11 @@ class FlightRecorder:
                 out["kernelBudget"] = self.kernel_budget_source()
             except Exception:  # pragma: no cover - defensive
                 LOG.exception("flight-recorder kernel-budget source failed")
+        if self.mesh_budget_source is not None:
+            try:
+                out["meshBudget"] = self.mesh_budget_source()
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("flight-recorder mesh-budget source failed")
         if extra:
             out.update(extra)
         return out

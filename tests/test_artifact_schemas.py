@@ -270,10 +270,22 @@ def _kernel_budget_artifacts():
     return [art]
 
 
+def _mesh_budget_artifacts():
+    """The live producer: the mesh observatory rides the SAME session
+    capture (tests/test_mesh_budget.py attaches it at import, before any
+    test runs ``tkb._live_capture()``)."""
+    import test_mesh_budget as tmb
+
+    art = tmb._live_mesh()["artifact"]
+    assert art is not None
+    return [art]
+
+
 @pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
                                       "events", "scenarios", "checkpoint",
                                       "slo", "trace", "soak",
-                                      "kernel-budget", "whatif"])
+                                      "kernel-budget", "mesh-budget",
+                                      "whatif"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     if producer == "phase-profile":
         arts = _phase_profile_artifact()
@@ -296,6 +308,9 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "kernel-budget":
         arts = _kernel_budget_artifacts()
         schema = SCHEMAS["cc-tpu-kernel-budget/2"]
+    elif producer == "mesh-budget":
+        arts = _mesh_budget_artifacts()
+        schema = SCHEMAS["cc-tpu-mesh-budget/1"]
     elif producer == "whatif":
         arts = _whatif_artifact()
         schema = SCHEMAS["cc-tpu-whatif/1"]

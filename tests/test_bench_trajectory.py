@@ -43,7 +43,8 @@ def test_latest_round_holds_every_gate():
                  "recorder_overhead_pct", "events_overhead_pct",
                  "checkpoint_overhead_pct", "precompute_overhead_pct",
                  "replan_overhead_pct", "slo_overhead_pct",
-                 "profiler_overhead_pct", "whatif_batch_ratio",
+                 "profiler_overhead_pct", "mesh_overhead_pct",
+                 "whatif_batch_ratio",
                  "replan_settle_speedup", "soak_smoke"):
         assert gate in verdicts, f"round r{latest} lost the {gate} gate"
         value, ok = verdicts[gate]

@@ -927,6 +927,84 @@ PACKAGE_FIXTURES = {
             },
         ],
     },
+    "transfer-discipline": {
+        "positive": [
+            # a raw jax.device_put outside the sanctioned modules
+            {
+                "pkg/drive.py": (
+                    "import jax\n"
+                    "def upload(x):\n"
+                    "    return jax.device_put(x)\n"
+                ),
+            },
+            # direct-name import dodging the dotted form
+            {
+                "pkg/loader.py": (
+                    "from jax import device_put\n"
+                    "def up(arrs):\n"
+                    "    return device_put(arrs)\n"
+                ),
+            },
+            # implicit D2H: np.asarray on a provable device array
+            {
+                "pkg/fetcher.py": (
+                    "import jax\n"
+                    "import numpy as np\n"
+                    "def pull(packed: jax.Array):\n"
+                    "    return np.asarray(packed)\n"
+                ),
+            },
+        ],
+        "negative": [
+            # the sanctioned modules move bytes raw by design
+            {
+                "pkg/telemetry/__init__.py": "",
+                "pkg/telemetry/mesh_budget.py": (
+                    "import jax\n"
+                    "import numpy as np\n"
+                    "def device_put(x, fn='unlabeled'):\n"
+                    "    return jax.device_put(x)\n"
+                    "def fetch(x: jax.Array, fn='unlabeled'):\n"
+                    "    return np.asarray(x)\n"
+                ),
+                "pkg/ops/__init__.py": "",
+                "pkg/ops/grid.py": (
+                    "import jax\n"
+                    "import numpy as np\n"
+                    "def gather(idx: jax.Array):\n"
+                    "    return np.asarray(idx)\n"
+                ),
+                "pkg/models/__init__.py": "",
+                "pkg/models/builder.py": (
+                    "import jax\n"
+                    "def build(arrays):\n"
+                    "    return jax.device_put(arrays)\n"
+                ),
+            },
+            # the ledger route IS the fix — stays silent
+            {
+                "pkg/telemetry/__init__.py": "",
+                "pkg/telemetry/mesh_budget.py": (
+                    "def device_put(x, fn='unlabeled'):\n"
+                    "    return x\n"
+                ),
+                "pkg/drive.py": (
+                    "from pkg.telemetry import mesh_budget\n"
+                    "def upload(x):\n"
+                    "    return mesh_budget.device_put(x, fn='upload')\n"
+                ),
+            },
+            # host-side numpy stays out of scope: np.ndarray params and
+            # unannotated locals prove nothing about device residency
+            {
+                "pkg/stats.py": (
+                    "import numpy as np\n"
+                    "def norm(v: np.ndarray, w):\n"
+                    "    return np.asarray(v) + np.asarray(w)\n"
+                ),
+            },
+        ],
+    },
 }
 
 
@@ -1406,6 +1484,15 @@ MUTATIONS = {
         "                jax.profiler.start_trace(\"/tmp/cc-mutation\")\n"
         "                if inflight:\n"
         "                    packed, m_new, tab_new = inflight.pop(0)",
+    ),
+    # ISSUE 17 satellite: the constraint upload rewritten as a stray
+    # jax.device_put in the drive loop — the exact ledger-blind copy
+    # the mesh observatory's transfer discipline closed — must be caught
+    "transfer-discipline-optimizer": (
+        "transfer-discipline",
+        "cruise_control_tpu/analyzer/tpu_optimizer.py",
+        "        ca = {k: jnp.asarray(v) for k, v in can.items()}",
+        "        ca = {k: jax.device_put(v) for k, v in can.items()}",
     ),
 }
 

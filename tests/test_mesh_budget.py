@@ -1,0 +1,627 @@
+"""Mesh observatory (ISSUE 17): collective & transfer accounting,
+dispatch-gap attribution, the replication audit, and the end-to-end
+arm → scan → poll loop on ``GET /profile/mesh``.
+
+The parser tests run on SYNTHETIC traces of BOTH profiler dialects so
+the priority-sweep partition (collective > transfer > busy; uncovered =
+host gap) and the exact ``busy + collective + transfer + host_gap ==
+wall`` reconciliation are pinned independently of this box's profiler.
+The live tests ride the SAME session capture as the kernel suite
+(``test_kernel_budget._live_capture`` — the mesh observatory is attached
+at import time, before any test triggers it), and the committed
+``MESH_BUDGET_r17.json`` gate pins the 8-device sharding-loss
+decomposition the artifact was built to explain.
+"""
+
+import gzip
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.telemetry import events
+from cruise_control_tpu.telemetry import kernel_budget as kb
+from cruise_control_tpu.telemetry import mesh_budget as mb
+from harness import full_stack
+from test_artifact_schemas import SCHEMAS, validate
+
+#: attach BEFORE any test runs: pytest imports every collected module
+#: first, so whichever suite triggers the session's one live capture,
+#: the mesh observatory rides it (one capture, two artifacts)
+mb.MESH.attach(kb.CAPTURE)
+
+BUDGET_PATH = os.path.join(
+    os.path.dirname(__file__), "budgets", "mesh_budget.json"
+)
+R17_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "MESH_BUDGET_r17.json",
+)
+
+
+# ---- synthetic traces ------------------------------------------------------------
+def _write_trace(tmp_path, events_list):
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    path = d / "host.trace.json.gz"
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events_list}, f)
+    return str(tmp_path)
+
+
+def _device_meta(pid, name):
+    return {"ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": name}}
+
+
+def test_device_dialect_collectives_transfers_and_exact_reconciliation(
+        tmp_path):
+    """TPU-dialect semantics, pinned: collective HLOs classify under the
+    closed op vocabulary with per-device time+bytes, host-copy events on
+    a device pid charge that device as transfer, an async kernel
+    OVERLAPPING a collective is counted once (collective wins), and the
+    four terms partition each device's window exactly."""
+    def dev(pid, name, cat, ts, dur, byts=0):
+        return {"ph": "X", "pid": pid, "tid": 1, "name": name,
+                "ts": ts, "dur": dur,
+                "args": {"hlo_category": cat,
+                         "device_duration_ps": dur * 1e6,
+                         "bytes_accessed": byts}}
+
+    trace_dir = _write_trace(tmp_path, [
+        _device_meta(7, "/device:TPU:0"),
+        _device_meta(8, "/device:TPU:1"),
+        # device 0: 40us busy kernel, then a 30us all-reduce with an
+        # overlapping async 20us fusion INSIDE it (double-count bait)
+        dev(7, "fusion.1", "fusion", 0, 40, 128),
+        dev(7, "all-reduce.2", "all-reduce", 50, 30, 512),
+        dev(7, "fusion.3", "fusion", 60, 20, 64),
+        # a memcpy stream event on the device pid (no hlo_category):
+        # charges device 0 as transfer AND tallies the d2h ledger
+        {"ph": "X", "pid": 7, "tid": 9, "name": "MemcpyD2H (dyn)",
+         "ts": 90, "dur": 10, "args": {"bytes_transferred": 256}},
+        # device 1: one flat 100us kernel — fully busy
+        dev(8, "fusion.4", "fusion", 0, 100, 320),
+    ])
+    parsed = mb.parse_mesh_trace(kb.newest_trace(trace_dir))
+    assert parsed.dialect == "device"
+    assert parsed.skew_source == "busy"
+    assert parsed.window_us == pytest.approx(100.0)
+    # collective accounting: op, count, time, bytes
+    assert set(parsed.collectives) == {"all-reduce"}
+    col = parsed.collectives["all-reduce"]
+    assert col["count"] == 1
+    assert col["time_us"] == pytest.approx(30.0)
+    assert col["bytes"] == 512
+    # transfer accounting from the trace
+    assert set(parsed.transfers) == {"d2h"}
+    assert parsed.transfers["d2h"] == {
+        "count": 1, "time_us": pytest.approx(10.0), "bytes": 256}
+    d0 = parsed.devices["/device:TPU:0"]
+    # busy [0,40); collective [50,80) — the overlapped fusion.3 slice is
+    # charged ONCE, to the collective; transfer [90,100); gap = the rest
+    assert d0.busy_us == pytest.approx(40.0)
+    assert d0.collective_us == pytest.approx(30.0)
+    assert d0.transfer_us == pytest.approx(10.0)
+    assert d0.gap_us == pytest.approx(20.0)
+    d1 = parsed.devices["/device:TPU:1"]
+    assert d1.busy_us == pytest.approx(100.0)
+    assert d1.gap_us == pytest.approx(0.0)
+    # THE invariant: the terms partition each device's wall EXACTLY
+    for d in parsed.devices.values():
+        assert d.busy_us + d.collective_us + d.transfer_us + d.gap_us \
+            == pytest.approx(d.wall_us, abs=1e-9)
+    # and the artifact's mean-over-devices wall block reconciles to 100%
+    art = mb.build_mesh_artifact(parsed, units=2, backend="tpu",
+                                 source="benchmark")
+    assert art["wall"]["reconciliation_pct"] == pytest.approx(100.0)
+    assert art["collectives"]["by_op"]["all-reduce"]["count_per_unit"] \
+        == pytest.approx(0.5)
+    validate(json.loads(json.dumps(art)), SCHEMAS["cc-tpu-mesh-budget/1"])
+
+
+def test_thunk_dialect_lane_clipping_and_out_of_lane_host_gap(tmp_path):
+    """XLA:CPU dialect: per-device lanes are the client threads' Execute
+    walls; collective/transfer intervals count only where they intersect
+    the lane (provably blocked), out-of-lane time is host gap, and async
+    ``-start`` halves classify under the base op."""
+    def thunk(name, ts, dur, byts=0):
+        return {"ph": "X", "pid": 1, "tid": 5, "name": name,
+                "ts": ts, "dur": dur,
+                "args": {"hlo_module": "jit_run", "hlo_op": name,
+                         "bytes_accessed": byts}}
+
+    trace_dir = _write_trace(tmp_path, [
+        {"ph": "M", "pid": 1, "tid": 21, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient/21"}},
+        {"ph": "M", "pid": 1, "tid": 22, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient/22"}},
+        thunk("while.1", 0, 400),
+        thunk("all-reduce.5", 100, 60, 2048),
+        thunk("all-gather-start.6", 200, 40, 1024),
+        # an H2D copy landing partly OUTSIDE both lanes
+        {"ph": "X", "pid": 1, "tid": 30, "name": "TransferToDevice",
+         "ts": 380, "dur": 40, "args": {"bytes": 4096}},
+        {"ph": "X", "pid": 1, "tid": 21, "ts": 0, "dur": 300,
+         "name": "ThunkExecutor::Execute (wait for completion)"},
+        {"ph": "X", "pid": 1, "tid": 22, "ts": 0, "dur": 100,
+         "name": "ThunkExecutor::Execute (wait for completion)"},
+    ])
+    parsed = mb.parse_mesh_trace(kb.newest_trace(trace_dir))
+    assert parsed.dialect == "host-thunk"
+    assert parsed.skew_source == "busy_minus_collectives"
+    # async -start half classifies under the base op
+    assert set(parsed.collectives) == {"all-reduce", "all-gather"}
+    assert parsed.collectives["all-gather"]["time_us"] == pytest.approx(40)
+    assert parsed.transfers["h2d"]["bytes"] == 4096
+    # window spans thunks + transfers + lanes: [0, 420)
+    assert parsed.window_us == pytest.approx(420.0)
+    lane0 = parsed.devices["cpu-lane-0"]
+    # lane [0,300): both collectives intersect → 100us collective-wait,
+    # busy 200; the transfer [380,420) is OUT of lane → host gap
+    assert lane0.collective_us == pytest.approx(100.0)
+    assert lane0.busy_us == pytest.approx(200.0)
+    assert lane0.transfer_us == pytest.approx(0.0)
+    assert lane0.gap_us == pytest.approx(120.0)
+    lane1 = parsed.devices["cpu-lane-1"]
+    # lane [0,100): collectives start AT 100 — zero overlap, all busy
+    assert lane1.collective_us == pytest.approx(0.0)
+    assert lane1.busy_us == pytest.approx(100.0)
+    assert lane1.gap_us == pytest.approx(320.0)
+    for d in parsed.devices.values():
+        assert d.busy_us + d.collective_us + d.transfer_us + d.gap_us \
+            == pytest.approx(d.wall_us, abs=1e-9)
+    # skew over collective-corrected busy: 200 / mean(200, 100)
+    assert parsed.skew() == pytest.approx(200.0 / 150.0)
+
+
+def test_kernel_parser_thunk_skew_subtracts_collective_wait(tmp_path):
+    """Satellite (host-thunk skew fix): the KERNEL parser's per-lane
+    busy now subtracts collective-wait overlap — a lane blocked in an
+    all-reduce is waiting, not working — and records ``skew_source`` so
+    r14-era artifacts (pure Execute walls) stay honestly labeled."""
+    def thunk(name, ts, dur):
+        return {"ph": "X", "pid": 1, "tid": 5, "name": name,
+                "ts": ts, "dur": dur,
+                "args": {"hlo_module": "jit_run", "hlo_op": name}}
+
+    events_list = [
+        {"ph": "M", "pid": 1, "tid": 21, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient/21"}},
+        {"ph": "M", "pid": 1, "tid": 22, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient/22"}},
+        thunk("while.1", 0, 400),
+        thunk("all-reduce.5", 100, 60),
+        {"ph": "X", "pid": 1, "tid": 21, "ts": 0, "dur": 300,
+         "name": "ThunkExecutor::Execute (wait for completion)"},
+        {"ph": "X", "pid": 1, "tid": 22, "ts": 0, "dur": 100,
+         "name": "ThunkExecutor::Execute (wait for completion)"},
+    ]
+    parsed = kb.parse_trace(kb.newest_trace(
+        _write_trace(tmp_path, events_list)))
+    assert parsed.skew_source == "busy_minus_collectives"
+    # lane 0's 60us all-reduce overlap is subtracted: 300-60; lane 1
+    # never overlaps it
+    assert parsed.device_busy_us == pytest.approx(
+        {"cpu-lane-0": 240.0, "cpu-lane-1": 100.0})
+    assert parsed.device_collective_us == pytest.approx(
+        {"cpu-lane-0": 60.0, "cpu-lane-1": 0.0})
+    assert parsed.skew() == pytest.approx(240.0 / 170.0)
+    art = kb.build_artifact(parsed, units=1, backend="cpu")
+    assert art["devices"]["skew_source"] == "busy_minus_collectives"
+    validate(json.loads(json.dumps(art)), SCHEMAS["cc-tpu-kernel-budget/2"])
+    # without collectives the source stays "busy" (r14 semantics)
+    parsed2 = kb.parse_trace(kb.newest_trace(_write_trace(
+        tmp_path / "b",
+        [e for e in events_list if "all-reduce" not in e.get("name", "")])))
+    assert parsed2.skew_source == "busy"
+    assert parsed2.device_busy_us["cpu-lane-0"] == pytest.approx(300.0)
+
+
+def test_collective_and_transfer_vocabularies():
+    assert kb.classify_collective("all-reduce.1") == "all-reduce"
+    assert kb.classify_collective("all-gather-start.2") == "all-gather"
+    assert kb.classify_collective("reduce-scatter-done.3") \
+        == "reduce-scatter"
+    assert kb.classify_collective("collective-permute.4") \
+        == "collective-permute"
+    assert kb.classify_collective("all-to-all.9") == "all-to-all"
+    assert kb.classify_collective("fusion.1") is None
+    assert kb.classify_collective("reduce.7") is None  # not a collective
+    assert mb.classify_transfer("MemcpyH2D (stream)") == "h2d"
+    assert mb.classify_transfer("TransferToDevice") == "h2d"
+    assert mb.classify_transfer("BufferFromHostBuffer") == "h2d"
+    assert mb.classify_transfer("MemcpyD2H") == "d2h"
+    assert mb.classify_transfer("TransferFromDevice") == "d2h"
+    assert mb.classify_transfer("ToLiteral") == "d2h"
+    assert mb.classify_transfer("fusion.1") is None
+    assert mb.classify_transfer("copy.3") is None  # intra-device copy
+
+
+def test_sweep_priority_and_interval_helpers():
+    """The sweep's priority order and exactness on pathological overlap:
+    nested, staggered, and duplicated intervals still partition."""
+    split = mb._sweep((0.0, 100.0), [
+        (0, 60, "busy"), (20, 40, "collective"), (30, 50, "transfer"),
+        (0, 60, "busy"),          # duplicate busy must not double-count
+    ])
+    # collective [20,40); transfer [40,50) (clipped by priority);
+    # busy [0,20)+[50,60); gap [60,100)
+    assert split.collective_us == pytest.approx(20.0)
+    assert split.transfer_us == pytest.approx(10.0)
+    assert split.busy_us == pytest.approx(30.0)
+    assert split.gap_us == pytest.approx(40.0)
+    assert split.busy_us + split.collective_us + split.transfer_us \
+        + split.gap_us == pytest.approx(split.wall_us, abs=1e-12)
+    assert kb.merge_intervals([(5, 7), (0, 2), (1, 3)]) == [(0, 3), (5, 7)]
+    assert mb._intersect([(0, 10), (20, 30)], [(5, 25)]) \
+        == [(5, 10), (20, 25)]
+    assert kb.overlap_us([(0, 10)], [(5, 25)]) == pytest.approx(5.0)
+
+
+# ---- the transfer ledger ---------------------------------------------------------
+def test_transfer_ledger_windows_and_instrumented_entry_points():
+    led = mb.TransferLedger()
+    led.note("h2d", "upload", 1000, 0.001)
+    baseline = led.snapshot()
+    out = led.device_put(np.ones(8, np.float32), fn="upload")
+    assert int(jnp.sum(out)) == 8  # it really went through jax
+    fetched = led.fetch(jnp.arange(4), fn="drain")
+    assert isinstance(fetched, np.ndarray)
+    delta = mb.TransferLedger.delta(led.snapshot(), baseline)
+    # the pre-baseline note is windowed OUT; both entry points are in
+    assert delta["upload"]["h2d_count"] == 1
+    assert delta["upload"]["h2d_bytes"] == 32
+    assert delta["drain"]["d2h_count"] == 1
+    assert delta["drain"]["d2h_bytes"] == fetched.nbytes
+    assert "h2d" not in {
+        k for k, v in delta.get("upload", {}).items()
+        if k == "h2d_bytes" and v == 1000
+    }
+    # disabled: zero accounting, the copy itself still happens
+    led2 = mb.TransferLedger(enabled=False)
+    led2.fetch(jnp.arange(4), fn="x")
+    led2.note("h2d", "x", 1, 0.0)
+    assert led2.snapshot() == {}
+    led.reset()
+    assert led.snapshot() == {}
+
+
+def test_replication_audit_counts_replicated_vs_sharded_bytes():
+    keep = jnp.arange(64, dtype=jnp.float32)  # 256 bytes, single device
+    dead = jnp.ones(16, jnp.float32)
+    jax.block_until_ready((keep, dead))
+    dead.delete()
+    audit = mb.audit_replication(max_arrays=100_000)
+    assert audit["devices"] >= 1
+    assert audit["arrays"] >= 1
+    assert audit["logical_bytes"] >= keep.nbytes
+    assert audit["stored_bytes"] >= keep.nbytes
+    # CPU single-device arrays never store extra copies
+    assert audit["replicated_bytes"] >= 0
+    assert audit["single_device_bytes"] >= keep.nbytes
+    assert audit["stored_bytes"] == (
+        audit["replicated_bytes"] + audit["sharded_bytes"]
+        + audit["single_device_bytes"])
+    # deleted arrays are skipped, not fatal (the audit runs mid-flight)
+    assert audit["skipped"] >= 0
+    # truncation bound honors max_arrays
+    tiny = mb.audit_replication(max_arrays=1)
+    assert tiny["arrays"] <= 1
+
+
+# ---- observatory plumbing --------------------------------------------------------
+def test_observer_registration_survives_capture_reset():
+    cap = kb.CaptureManager()
+    obs = mb.MeshObservatory()
+    obs.attach(cap)
+    obs.attach(cap)  # idempotent
+    assert cap._observers.count(obs) == 1
+    cap.reset()
+    assert obs in cap._observers
+    obs.reset()
+    assert obs in cap._observers  # mesh reset drops state, not wiring
+
+
+def test_mesh_budget_gate_semantics():
+    art = mb.build_mesh_artifact(
+        mb.MeshParse(dialect="host-thunk"), units=2, backend="cpu",
+        ledger={"analyzer.scan_fetch": {
+            "h2d_count": 0, "h2d_bytes": 0, "h2d_us": 0.0,
+            "d2h_count": 8, "d2h_bytes": 1024, "d2h_us": 5.0}},
+    )
+    art["collectives"]["by_op"]["all-reduce"] = {
+        "count": 8, "count_per_unit": 4.0, "time_ms": 1.0, "bytes": 0}
+    art["transfers"]["trace"]["h2d"] = {
+        "count": 8, "count_per_unit": 4.0, "time_ms": 0.1, "bytes": 0}
+    budget = {
+        "tolerance_pct": 25,
+        "collective_ops": {"all-reduce": 4.0},
+        "transfer_trace": {"h2d": 4.0},
+        "ledger_fns": {"analyzer.scan_fetch": {
+            "h2d_count_per_unit": 0.0, "d2h_count_per_unit": 4.0}},
+    }
+    assert mb.compare_mesh_budget(art, budget) == []
+    # growth past the ceiling, a novel op, and a novel fn all violate
+    art["collectives"]["by_op"]["all-reduce"]["count_per_unit"] = 5.1
+    art["collectives"]["by_op"]["all-to-all"] = {
+        "count": 1, "count_per_unit": 0.5, "time_ms": 0.1, "bytes": 0}
+    art["transfers"]["ledger"]["by_fn"]["rogue.fetch"] = {
+        "h2d_count": 0, "h2d_bytes": 0, "h2d_ms": 0.0,
+        "d2h_count": 2, "d2h_bytes": 64, "d2h_ms": 0.1}
+    violations = mb.compare_mesh_budget(art, budget)
+    assert len(violations) == 3
+    assert any("all-reduce" in v for v in violations)
+    assert any("all-to-all" in v for v in violations)
+    assert any("rogue.fetch" in v for v in violations)
+    # fixture drift fails loudly
+    bad = dict(budget, fixture={"seed": 999})
+    assert any("fixture" in v
+               for v in mb.compare_mesh_budget(
+                   dict(art, fixture={"seed": 7}), bad))
+
+
+# ---- live capture (shared with the kernel suite) ---------------------------------
+_MESH_LIVE = {}
+
+
+def _live_mesh():
+    """Snapshot the mesh side of the session's ONE live capture the
+    first time any test asks (tkb._live_capture drives it)."""
+    if _MESH_LIVE:
+        return _MESH_LIVE
+    import test_kernel_budget as tkb
+
+    live = tkb._live_capture()
+    _MESH_LIVE.update(
+        artifact=mb.MESH.latest(), kernel=live["artifact"],
+        journal=live["journal"], state=mb.MESH.state(),
+        audit=mb.MESH.summary()["lastAudit"],
+    )
+    return _MESH_LIVE
+
+
+def test_live_capture_produces_schema_valid_mesh_artifact():
+    live = _live_mesh()
+    art = live["artifact"]
+    assert art is not None, "mesh observer missed the session capture"
+    validate(json.loads(json.dumps(art)), SCHEMAS["cc-tpu-mesh-budget/1"])
+    assert art["source"] == "live-capture"
+    assert art["unit"] == "scan-call"
+    assert art["units"] == live["kernel"]["units"]
+    assert art["capture"]["id"] == live["kernel"]["capture"]["id"]
+    # the decomposition reconciles EXACTLY (well inside the 5% gate)
+    assert art["wall"]["reconciliation_pct"] == pytest.approx(
+        100.0, abs=0.5)
+    assert art["wall"]["window_ms"] > 0
+    assert art["wall"]["busy_ms"] > 0
+    for label, d in art["devices"]["per_device"].items():
+        assert d["busy_ms"] + d["collective_ms"] + d["transfer_ms"] \
+            + d["gap_ms"] == pytest.approx(d["wall_ms"], abs=0.01)
+    # the drive loop's instrumented fetches landed in the window
+    by_fn = art["transfers"]["ledger"]["by_fn"]
+    assert "analyzer.scan_fetch" in by_fn
+    assert by_fn["analyzer.scan_fetch"]["d2h_count"] > 0
+    assert by_fn["analyzer.scan_fetch"]["d2h_bytes"] > 0
+    # the capture-finish replication audit ran on live device state
+    assert art["replication"]["arrays"] > 0
+    assert art["replication"]["stored_bytes"] > 0
+
+
+def test_live_capture_journals_mesh_parse_deterministically():
+    live = _live_mesh()
+    parsed_events = [e for e in live["journal"]
+                     if e["kind"] == "profiler.mesh.parsed"]
+    assert parsed_events, "mesh parse was not journaled"
+    payload = parsed_events[0]["payload"]
+    assert payload["captureId"] == live["kernel"]["capture"]["id"]
+    assert payload["dialect"] == live["artifact"]["dialect"]
+    assert payload["units"] == live["artifact"]["units"]
+    assert payload["collectiveOps"] == sorted(
+        live["artifact"]["collectives"]["by_op"])
+    # the audit kind is NOT emitted by the capture hook (fingerprints)
+    assert not any(e["kind"] == "profiler.mesh.audit"
+                   for e in live["journal"])
+
+
+def test_mesh_families_render_in_prometheus_exposition():
+    _live_mesh()
+    fams = {f[0] for f in mb.MESH.families()}
+    assert "cc_transfer_bytes" in fams
+    assert "cc_transfer_ms" in fams
+    assert "cc_mesh_host_gap_ms" in fams
+    assert "cc_mesh_replicated_bytes" in fams
+    from cruise_control_tpu.telemetry.exposition import render_prometheus
+    from cruise_control_tpu.telemetry.tracing import Telemetry
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    body = render_prometheus(MetricRegistry(), Telemetry(enabled=True))
+    assert 'cc_transfer_bytes{direction="' in body
+    assert 'fn="analyzer.scan_fetch"' in body
+    assert "cc_mesh_host_gap_ms" in body
+    assert "cc_mesh_replicated_bytes" in body
+
+
+def test_mesh_summary_merges_into_flight_recorder_artifact():
+    _live_mesh()
+    from cruise_control_tpu.telemetry.recorder import FlightRecorder
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    rec = FlightRecorder(MetricRegistry(), interval_s=60.0, retention=8,
+                         mesh_budget_source=mb.MESH.summary)
+    art = rec.artifact()
+    assert "meshBudget" in art
+    assert art["meshBudget"]["enabled"] is True
+    latest = art["meshBudget"]["latest"]
+    if latest is not None:  # a later test may have reset the singleton
+        assert latest["schema"] == mb.SCHEMA
+    validate(json.loads(json.dumps(art)),
+             SCHEMAS["cc-tpu-flight-recorder/1"])
+
+
+# ---- the budget regression gate --------------------------------------------------
+def write_budget() -> None:
+    """Regenerate the checked-in mesh-budget count gate (run on an
+    INTENDED transfer/collective-profile change): ``JAX_PLATFORMS=cpu
+    python -c "import tests.test_mesh_budget as t; t.write_budget()"``
+    from the repo root."""
+    import test_kernel_budget as tkb
+
+    art = _live_mesh()["artifact"]
+    budget = {
+        "unit": art["unit"],
+        "fixture": dict(tkb._FIXTURE, scans=tkb._CAPTURE_SCANS,
+                        **tkb._CAPTURE_CFG),
+        "backend": art["backend"],
+        "tolerance_pct": 25,
+        "collective_ops": {
+            op: v["count_per_unit"]
+            for op, v in sorted(art["collectives"]["by_op"].items())
+        },
+        "transfer_trace": {
+            d: v["count_per_unit"]
+            for d, v in sorted(art["transfers"]["trace"].items())
+        },
+        "ledger_fns": {
+            fn: {
+                "h2d_count_per_unit": round(
+                    row["h2d_count"] / art["units"], 2),
+                "d2h_count_per_unit": round(
+                    row["d2h_count"] / art["units"], 2),
+            }
+            for fn, row in sorted(
+                art["transfers"]["ledger"]["by_fn"].items())
+        },
+    }
+    os.makedirs(os.path.dirname(BUDGET_PATH), exist_ok=True)
+    with open(BUDGET_PATH, "w") as f:
+        json.dump(budget, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def test_mesh_budget_gate():
+    """Per-term counts of the live capture may not grow more than 25%
+    over the pinned budget, and the collective-op / ledger-fn
+    vocabularies are CLOSED — a new collective in the scan program or a
+    new un-budgeted transfer site fails until deliberately regenerated
+    (:func:`write_budget`)."""
+    assert os.path.exists(BUDGET_PATH), (
+        f"missing {BUDGET_PATH} — generate it with the command in "
+        "write_budget's docstring"
+    )
+    with open(BUDGET_PATH) as f:
+        budget = json.load(f)
+    art = _live_mesh()["artifact"]
+    violations = mb.compare_mesh_budget(art, budget)
+    assert not violations, (
+        "mesh budget regressed (regenerate via write_budget() ONLY for "
+        "an intended change):\n" + "\n".join(violations)
+    )
+
+
+# ---- committed sharded artifact --------------------------------------------------
+def test_committed_r17_artifact_decomposes_the_sharding_loss():
+    """The committed MESH_BUDGET_r17 (``benchmarks/sharded_large_dryrun
+    .py --mesh-out``, 8-device CPU mesh) is schema-valid, reconciles to
+    the measured wall within the 5% acceptance bound, and charges the
+    single→sharded slowdown to NAMED terms that sum to the loss."""
+    with open(R17_PATH) as f:
+        art = json.load(f)
+    validate(art, SCHEMAS["cc-tpu-mesh-budget/1"])
+    assert art["source"] == "benchmark"
+    assert art["backend"] == "cpu"           # NOT comparable to a TPU run
+    assert art["devices"]["count"] == 8
+    assert abs(art["wall"]["reconciliation_pct"] - 100.0) <= 5.0
+    for d in art["devices"]["per_device"].values():
+        assert d["busy_ms"] + d["collective_ms"] + d["transfer_ms"] \
+            + d["gap_ms"] == pytest.approx(d["wall_ms"], abs=0.05)
+    loss = art["sharding_loss"]
+    assert loss["wall_sharded_s"] > loss["wall_single_s"] > 0
+    assert loss["loss_s"] == pytest.approx(
+        loss["wall_sharded_s"] - loss["wall_single_s"], abs=0.01)
+    # the by-term charge covers the loss (within the same 5% bound)
+    assert set(loss["by_term_s"]) <= {"busy_scaling", "collective",
+                                      "transfer", "host_gap"}
+    assert sum(loss["by_term_s"].values()) == pytest.approx(
+        loss["loss_s"], rel=0.05)
+    # shares are the per-term fraction of the loss
+    assert sum(loss["attributed_share"].values()) == pytest.approx(
+        1.0, abs=0.01)
+    # the replication audit rode the same run
+    assert art["replication"]["devices"] == 8
+
+
+# ---- end-to-end through the real server ------------------------------------------
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_profile_mesh_arm_poll_audit_ladder_through_http_server():
+    """Acceptance (ISSUE 17): GET /profile/mesh?arm=true → 202, a
+    rebalance runs the scan under the shared capture, the pumped parse
+    yields a schema-valid cc-tpu-mesh-budget/1, and ?audit=true runs
+    the replication audit inline."""
+    from cruise_control_tpu.server.http_server import (
+        CruiseControlHttpServer,
+    )
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    _live_mesh()  # snapshot the session artifact BEFORE resetting
+    kb.CAPTURE.reset()
+    mb.MESH.reset()
+    cc, backend, reporter = full_stack(engine="tpu",
+                                       registry=MetricRegistry())
+    server = CruiseControlHttpServer(cc, port=0, access_log=False)
+    server.start()
+    try:
+        status, body = _get(f"{server.url}/profile/mesh")
+        assert status == 404  # nothing captured yet
+        status, body = _get(f"{server.url}/profile/mesh?arm=true&scans=1")
+        assert status == 202
+        assert body["mesh"]["capture"]["state"] == "ARMED"
+        status, body = _get(f"{server.url}/profile/mesh")
+        assert status == 202  # armed, no artifact yet — poll semantics
+        req = urllib.request.Request(
+            f"{server.url}/rebalance?dryrun=true"
+            "&get_response_timeout_s=120",
+            method="POST", data=b"",
+        )
+        with urllib.request.urlopen(req, timeout=150) as resp:
+            assert resp.status == 200
+        # production pumps this from the SLO tick; tests pump directly
+        assert kb.parse_pending(max_parses=4) >= 1
+        status, art = _get(f"{server.url}/profile/mesh")
+        assert status == 200
+        validate(art, SCHEMAS["cc-tpu-mesh-budget/1"])
+        assert art["capture"]["reason"] == "http"
+        assert art["wall"]["reconciliation_pct"] == pytest.approx(
+            100.0, abs=0.5)
+        # the explicit audit is served inline; pin one live array so the
+        # walk has something to count (the finished rebalance released
+        # its device state)
+        pin = jnp.arange(8)
+        jax.block_until_ready(pin)
+        status, audit = _get(f"{server.url}/profile/mesh?audit=true")
+        assert status == 200
+        assert audit["arrays"] > 0
+        del pin
+        # disabling either observatory 503s the endpoint
+        mb.MESH.configure(enabled=False)
+        status, body = _get(f"{server.url}/profile/mesh")
+        assert status == 503
+        assert "mesh" in body["errorMessage"]
+    finally:
+        mb.MESH.configure(enabled=True)
+        server.stop()
+        kb.CAPTURE.reset()
+        mb.MESH.reset()
