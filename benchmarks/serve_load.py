@@ -181,9 +181,15 @@ def main() -> int:
     ap.add_argument("--rebalance-brokers", type=int, default=50)
     ap.add_argument("--rebalance-partitions", type=int, default=1000)
     ap.add_argument("--artifact", default=None)
+    ap.add_argument("--critical-path", action="store_true",
+                    help="emit the per-request critical-path decomposition "
+                         "(telemetry/critical_path) alongside the gates — "
+                         "the server threads a PhaseClock through every "
+                         "dispatch, so this costs nothing extra")
     args = ap.parse_args()
 
     from cruise_control_tpu.server.http_server import CruiseControlHttpServer
+    from cruise_control_tpu.telemetry import critical_path as cpath
 
     # serving-process tuning: with the analyzer burning CPU in-process,
     # the default 5ms GIL switch interval adds multi-quantum stalls to
@@ -216,6 +222,9 @@ def main() -> int:
     ) as r:
         health = json.loads(r.read())
         assert health["ready"] is True, f"not ready: {health}"
+
+    if args.critical_path:
+        cpath.STORE.reset()  # decompose THIS run, not the warmup
 
     rebalance_result: Dict[str, object] = {}
 
@@ -347,6 +356,8 @@ def main() -> int:
         "rebalance": rebalance_result,
         "gates": gates,
     }
+    if args.critical_path:
+        artifact["criticalPath"] = cpath.STORE.snapshot()
     print(json.dumps(artifact, indent=1, sort_keys=True))
     if args.artifact:
         with open(args.artifact, "w") as f:

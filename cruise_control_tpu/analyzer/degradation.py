@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from cruise_control_tpu.utils.locks import InstrumentedLock
+
 
 class PlanSanityError(RuntimeError):
     """An engine produced a plan the sanity gate refuses to emit."""
@@ -105,7 +107,7 @@ class EngineDegradation:
                  clock: Optional[Callable[[], float]] = None):
         self.cooldown_s = float(cooldown_s)
         self.clock = clock or time.monotonic
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("engine.degradation")
         self._degraded_until: Optional[float] = None
         self._last_error: Optional[str] = None
         self.degradations = 0

@@ -44,6 +44,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from cruise_control_tpu.telemetry import events
+from cruise_control_tpu.utils.locks import InstrumentedLock
 
 logger = logging.getLogger(__name__)
 
@@ -93,7 +94,7 @@ class CircuitBreaker:
         self.failure_threshold = max(1, int(failure_threshold))
         self.reset_s = max(0.0, float(reset_s))
         self._clock = clock or time.monotonic
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("precompute.state")
         self._state = self.CLOSED
         self._consecutive_failures = 0
         self._opened_at: Optional[float] = None

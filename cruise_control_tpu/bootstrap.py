@@ -339,14 +339,17 @@ def build_app(
     """
     cfg = config or CruiseControlConfig()
     from cruise_control_tpu.telemetry import (
+        critical_path,
         device_cost,
         device_stats,
         events,
+        host_profile,
         kernel_budget,
         mesh_budget,
         tracing,
     )
     from cruise_control_tpu.telemetry import trace as trace_mod
+    from cruise_control_tpu.utils import locks
 
     tracing.configure(
         enabled=cfg.get_boolean("telemetry.enabled"),
@@ -377,6 +380,17 @@ def build_app(
         # ride the kernel observatory's capture pipeline: one armed
         # capture feeds both /profile/kernels and /profile/mesh
         mesh_budget.MESH.attach(kernel_budget.CAPTURE)
+    host_profile.configure(
+        enabled=cfg.get_boolean("telemetry.host.enabled"),
+        interval_ms=cfg.get_double("telemetry.host.sample.interval.ms"),
+        default_samples=cfg.get_int("telemetry.host.capture.samples"),
+    )
+    locks.CONTENTION.configure(
+        threshold_ms=cfg.get_double(
+            "telemetry.host.contention.threshold.ms"),
+        sustain_windows=cfg.get_int(
+            "telemetry.host.contention.sustain.windows"),
+    )
     trace_mod.configure(
         enabled=cfg.get_boolean("telemetry.trace.enabled"),
         max_traces=cfg.get_int("telemetry.trace.max.traces"),
@@ -832,6 +846,12 @@ def build_app(
     if cfg.get_boolean("telemetry.mesh.enabled"):
         # mesh-observatory parse counters
         mesh_budget.install_gauges(cc.registry)
+    if cfg.get_boolean("telemetry.host.enabled"):
+        # the always-on host sampling profiler: lifetime sample count +
+        # pending-build depth as gauges, sampler daemon started here
+        # (server path only — sims/tests drive ingest() synthetically)
+        host_profile.install_gauges(cc.registry)
+        host_profile.ensure_started()
     flight_recorder = None
     if cfg.get_boolean("telemetry.recorder.enabled"):
         from cruise_control_tpu.telemetry.recorder import FlightRecorder
@@ -879,6 +899,15 @@ def build_app(
                 mesh_budget.MESH.summary
                 if cfg.get_boolean("telemetry.mesh.enabled") else None
             ),
+            # host observatory: where the host threads were (profiler
+            # window + latest capture), which named locks they fought
+            # over, and how recent requests' walls decompose
+            host_profile_source=(
+                host_profile.PROFILER.summary
+                if cfg.get_boolean("telemetry.host.enabled") else None
+            ),
+            contention_source=locks.CONTENTION.snapshot,
+            critical_path_source=critical_path.STORE.snapshot,
         )
         detector.flight_recorder = flight_recorder
         flight_recorder.start()
@@ -906,6 +935,12 @@ def build_app(
             # Chrome-trace parsing is seconds of host work at north-star
             # scale — same discipline: the SLO tick pumps it
             maintenance.append(kernel_budget.CAPTURE.parse_pending)
+        if cfg.get_boolean("telemetry.host.enabled"):
+            # host-profile artifact builds + the sustained-contention
+            # detector ride the same maintenance tick: never a request
+            # thread, never the sim (journal fingerprints stay pinned)
+            maintenance.append(host_profile.PROFILER.parse_pending)
+            maintenance.append(locks.CONTENTION.check_pending)
         slo_engine = SloEngine(
             registry=cc.registry,
             events_reader=(

@@ -1176,6 +1176,33 @@ def default_config_def() -> ConfigDef:
              Importance.LOW, "Live arrays the replication audit walks "
              "before truncating (bounds audit cost on huge states).",
              at_least(1), G)
+    d.define("telemetry.host.enabled", ConfigType.BOOLEAN, True,
+             Importance.MEDIUM, "Host observatory "
+             "(telemetry/host_profile.py): an always-on sampling "
+             "profiler walks every thread's stack on a daemon tick, "
+             "aggregating folded stacks per thread role into a bounded "
+             "rolling window; GET /profile/host?arm=true captures the "
+             "next N ticks into a cc-tpu-host-profile/1 artifact "
+             "(flame-graph folded lines), built off-thread on the SLO "
+             "maintenance tick. Also gates the named-lock contention "
+             "detector and the cc_host_* metric families. Always-on "
+             "cost is gated at <=1% (bench.py "
+             "host_profiler_overhead_pct).", None, G)
+    d.define("telemetry.host.sample.interval.ms", ConfigType.DOUBLE, 50.0,
+             Importance.LOW, "Sampling-profiler tick interval "
+             "(milliseconds between stack walks).", at_least(1), G)
+    d.define("telemetry.host.capture.samples", ConfigType.INT, 100,
+             Importance.LOW, "Sampling ticks per capture when the arm "
+             "request names no count.", at_least(1), G)
+    d.define("telemetry.host.contention.threshold.ms", ConfigType.DOUBLE,
+             250.0, Importance.LOW, "Named-lock wait accumulated in one "
+             "contention-check window (the SLO maintenance tick) above "
+             "which the lock counts as hot; two consecutive hot windows "
+             "journal contention.hot_lock.", at_least(1), G)
+    d.define("telemetry.host.contention.sustain.windows", ConfigType.INT,
+             2, Importance.LOW, "Consecutive hot windows before a "
+             "contention.hot_lock event is journaled (cooldown-limited "
+             "per lock).", at_least(1), G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a

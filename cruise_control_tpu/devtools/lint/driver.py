@@ -64,6 +64,9 @@ from cruise_control_tpu.devtools.lint.rules_fenced import (
 )
 from cruise_control_tpu.devtools.lint.rules_jax import JaxHotPathRule
 from cruise_control_tpu.devtools.lint.rules_lock import LockDisciplineRule
+from cruise_control_tpu.devtools.lint.rules_lockinst import (
+    LockInstrumentationRule,
+)
 from cruise_control_tpu.devtools.lint.rules_obs import ObsDynamicNameRule
 from cruise_control_tpu.devtools.lint.rules_profiler import (
     ProfilerDisciplineRule,
@@ -102,6 +105,7 @@ RULES = {
         ProfilerDisciplineRule(),
         FencedBackendDisciplineRule(),
         TransferDisciplineRule(),
+        LockInstrumentationRule(),
     )
 }
 

@@ -42,8 +42,9 @@ SUMMARY_VERSION = 1
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _NP_MODULES = {"np", "numpy", "onp"}
 _NP_SYNC_FUNCS = {"asarray", "array"}
-_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "InstrumentedLock"}
 _SAFE_CTORS = {"Event", "Semaphore", "BoundedSemaphore", "Barrier",
+               "InstrumentedSemaphore",
                "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
                "ThreadPoolExecutor", "ProcessPoolExecutor"}
 _MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",

@@ -35,10 +35,13 @@ from cruise_control_tpu.devtools.lint.findings import Finding
 
 RULE_ID = "lock-discipline"
 
-_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+#: InstrumentedLock is a guarding ctor like the stdlib's: converting a
+#: hot lock to the contention wrapper must not lose lockset coverage
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "InstrumentedLock"}
 #: constructors whose instances synchronize internally — their attrs are
 #: exempt from the lockset (calling .set()/.put() needs no outer lock)
 _SAFE_CTORS = {"Event", "Semaphore", "BoundedSemaphore", "Barrier",
+               "InstrumentedSemaphore",
                "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
                "ThreadPoolExecutor", "ProcessPoolExecutor"}
 #: method names that mutate their receiver in place

@@ -87,6 +87,7 @@ from typing import Any, Dict, List, Optional
 
 from cruise_control_tpu.analyzer.goal_optimizer import ExecutionProposal
 from cruise_control_tpu.utils.checksum import scan_lines, stamp_line
+from cruise_control_tpu.utils.locks import InstrumentedLock
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("executor.journal")
@@ -191,7 +192,7 @@ class ExecutionJournal:
     def __init__(self, path: str, max_bytes: int = _DEFAULT_MAX_BYTES):
         self.path = path
         self.max_bytes = max(1024, int(max_bytes))
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("journal.execution")
         self._fh = None
         self._seq = 0
         self._bytes = 0

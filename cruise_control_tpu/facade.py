@@ -60,6 +60,7 @@ from cruise_control_tpu.monitor.load_monitor import (
 from cruise_control_tpu.server import admission
 from cruise_control_tpu.server.progress import OperationProgress
 from cruise_control_tpu.telemetry import events, tracing
+from cruise_control_tpu.utils.locks import InstrumentedLock
 from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.utils.metrics import DEFAULT_REGISTRY, MetricRegistry
 from cruise_control_tpu.whatif.cache import WhatifCache
@@ -194,7 +195,7 @@ class CruiseControl:
         self._proposal_ttl_s = proposal_ttl_s
         self._cached_proposals: Optional[OptimizerResult] = None
         self._cached_at: float = 0.0
-        self._cache_lock = threading.Lock()
+        self._cache_lock = InstrumentedLock("proposal.cache")
         #: the warm plan degraded-mode serving falls back on: survives
         #: invalidation (marked stale, not dropped) so an overloaded or
         #: window-starved server still has a last-good answer
@@ -202,7 +203,7 @@ class CruiseControl:
         #: single-flight guard: one proposal computation at a time — a
         #: GET /proposals stampede on a cold cache must not fan out into
         #: N identical optimizations
-        self._compute_lock = threading.Lock()
+        self._compute_lock = InstrumentedLock("proposal.single_flight")
         # counterfactual what-if engine (ISSUE 16): per-future verdicts
         # keyed model_generation × fingerprint, invalidated with the warm
         # plan; whatif.precompute.futures > 0 keeps the top-k likely

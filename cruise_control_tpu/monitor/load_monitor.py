@@ -35,6 +35,7 @@ from cruise_control_tpu.monitor.capacity import (
     BrokerCapacityConfigResolver,
     StaticCapacityResolver,
 )
+from cruise_control_tpu.utils.locks import InstrumentedSemaphore
 from cruise_control_tpu.monitor.sampling import (
     BROKER_DEF,
     PARTITION_DEF,
@@ -233,7 +234,8 @@ class LoadMonitor:
         #: model/Load.java window semantics; 0 keeps mean-only models)
         self.capacity_estimation_percentile = capacity_estimation_percentile
         self.state = LoadMonitorState.NOT_STARTED
-        self._model_semaphore = threading.Semaphore(1)
+        self._model_semaphore = InstrumentedSemaphore(
+            1, name="model.semaphore")
         self._last_sample_ms = 0
 
         topo = metadata.refresh()

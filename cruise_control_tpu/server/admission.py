@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from cruise_control_tpu.utils.locks import InstrumentedLock
+
 #: admission classes — every endpoint maps onto one of these two:
 #: cheap reads ("get") vs analyzer-bound work ("compute")
 CLASS_GET = "get"
@@ -138,7 +140,11 @@ class AdmissionController:
         )
         #: observability hook: (admission class, reason) per shed
         self.on_shed = on_shed
-        self._cond = threading.Condition(threading.Lock())
+        # the queue lock is instrumented (ISSUE 18): every admit/track/
+        # drain serializes here, so its wait series IS the front door's
+        # contention telemetry.  InstrumentedLock implements _is_owned,
+        # so Condition never probe-acquires it.
+        self._cond = threading.Condition(InstrumentedLock("admission.queue"))
         self._active: Dict[str, int] = {c: 0 for c in CLASSES}
         self._queued = 0
         self._inflight = 0  # every tracked request, queued or running

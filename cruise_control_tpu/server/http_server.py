@@ -44,7 +44,7 @@ from cruise_control_tpu.server.admission import (
     RequestShedError,
 )
 from cruise_control_tpu.server.purgatory import Purgatory
-from cruise_control_tpu.telemetry import events, tracing
+from cruise_control_tpu.telemetry import critical_path, events, tracing
 from cruise_control_tpu.telemetry import trace as trace_mod
 from cruise_control_tpu.utils.logging import get_logger
 from cruise_control_tpu.server.security import (  # re-exported (legacy import site)
@@ -78,6 +78,7 @@ GET_ENDPOINTS = {
     "state", "load", "partition_load", "proposals", "kafka_cluster_state",
     "user_tasks", "review_board", "metrics", "diagnostics", "events",
     "health", "slo", "trace", "profile/kernels", "profile/mesh",
+    "profile/host",
 }
 ASYNC_POST_ENDPOINTS = {
     "rebalance", "add_broker", "remove_broker", "demote_broker",
@@ -297,8 +298,13 @@ class CruiseControlHttpServer:
     def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
         # one correlation id per request: every span and journal event
         # produced inside (including on async worker threads) carries it,
-        # and GET /trace?id= reconstructs the request end-to-end
-        with trace_mod.trace_scope(self._request_trace_id(handler)):
+        # and GET /trace?id= reconstructs the request end-to-end.  The
+        # critical-path clock opens here and closes when the response is
+        # flushed: its consecutive marks partition the request wall
+        # EXACTLY (docs/OBSERVABILITY.md "Reading a critical-path
+        # breakdown")
+        with critical_path.request_scope(), \
+                trace_mod.trace_scope(self._request_trace_id(handler)):
             with self.admission.track():
                 try:
                     self._dispatch_inner(handler, method)
@@ -343,6 +349,7 @@ class CruiseControlHttpServer:
         # load balancer's probe must never be queued, shed, or locked out
         if method == "GET" and parsed.path.rstrip("/") in (
                 "/health", self.prefix + "/health"):
+            critical_path.set_endpoint("health")
             return self._handle_health(handler)
         if not parsed.path.startswith(self.prefix + "/"):
             return self._send(handler, 404, {"errorMessage": "not found"})
@@ -380,6 +387,8 @@ class CruiseControlHttpServer:
                         f"max.body.bytes)"
                     )
                 })
+        critical_path.set_endpoint(endpoint or "root")
+        critical_path.mark("parse")  # routing + params + body cap
         if self.security is not None and not self._authenticated(handler):
             handler.send_response(401)
             handler.send_header("WWW-Authenticate", "Basic")
@@ -387,11 +396,13 @@ class CruiseControlHttpServer:
             return
         deadline = self._request_deadline(handler)
         cls = self._admission_class(method, endpoint, handler, params)
+        critical_path.mark("auth")  # authentication + deadline header
         with admission_mod.deadline_scope(deadline):
             # an already-dead request sheds before admission: it must not
             # consume a slot another client could use
             admission_mod.check_deadline(f"{method} {endpoint}")
             with self.admission.admit(cls):
+                critical_path.mark("admissionQueue")  # slot wait
                 # request span, correlated with the async protocol's task
                 # id via _respond_task's annotate (guard before the
                 # f-string: the disabled path must not pay for formatting)
@@ -491,11 +502,14 @@ class CruiseControlHttpServer:
 
     def _send(self, handler, code: int, body: dict,
               headers: Optional[Dict[str, str]] = None) -> None:
+        # everything since the previous mark was endpoint work
+        critical_path.mark("handler")
         if self.access_log:
             self._log.info(
                 "%s %s %d", handler.command, handler.path, code
             )
         data = json.dumps(body, default=str).encode()
+        critical_path.mark("serialize")
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(data)))
@@ -514,12 +528,15 @@ class CruiseControlHttpServer:
             handler.send_header(k, v)
         handler.end_headers()
         handler.wfile.write(data)
+        critical_path.mark("flush")
 
     def _send_text(self, handler, code: int, body: str,
                    content_type: str) -> None:
+        critical_path.mark("handler")
         if self.access_log:
             self._log.info("%s %s %d", handler.command, handler.path, code)
         data = body.encode()
+        critical_path.mark("serialize")
         handler.send_response(code)
         handler.send_header("Content-Type", content_type)
         handler.send_header("Content-Length", str(len(data)))
@@ -528,6 +545,7 @@ class CruiseControlHttpServer:
                                 self.cors_origin)
         handler.end_headers()
         handler.wfile.write(data)
+        critical_path.mark("flush")
 
     def _extra_metric_families(self):
         """Labeled families the flat registry can't express: per-action
@@ -731,6 +749,48 @@ class CruiseControlHttpServer:
                                 "with GET /profile/mesh?arm=true",
                 "mesh": state,
             })
+        if endpoint == "profile/host":
+            # host observatory (docs/OBSERVABILITY.md "Host
+            # observatory"): ?arm=true[&samples=N] opens a capture over
+            # the next N sampling ticks (202 + state; poll), plain GETs
+            # serve the latest built cc-tpu-host-profile/1 artifact (404
+            # before the first capture; 202 while armed / building — the
+            # SLO tick builds)
+            from cruise_control_tpu.telemetry import host_profile
+
+            profiler = host_profile.PROFILER
+            if not profiler.enabled:
+                return self._send(handler, 503, {
+                    "errorMessage": "host observatory disabled "
+                                    "(telemetry.host.enabled=false?)"
+                })
+            if _flag(params, "arm"):
+                samples = params.get("samples")
+                profiler.ensure_started()
+                state = profiler.arm(
+                    samples=int(samples) if samples else None,
+                    reason="http")
+                return self._send(handler, 202, {
+                    "message": "capture armed: the sampler collects the "
+                               "next ticks — poll GET /profile/host",
+                    "capture": state,
+                })
+            artifact = profiler.latest()
+            if artifact is not None:
+                return self._send(handler, 200, artifact)
+            state = profiler.state()
+            if state["state"] != "IDLE" or state["pendingParses"] \
+                    or state["activeParses"]:
+                return self._send(handler, 202, {
+                    "message": "capture in flight (armed, mid-build, or "
+                               "awaiting the SLO-tick build) — poll again",
+                    "capture": state,
+                })
+            return self._send(handler, 404, {
+                "errorMessage": "no host capture built yet — arm one "
+                                "with GET /profile/host?arm=true",
+                "capture": state,
+            })
         if endpoint == "diagnostics":
             # flight-recorder artifact: retained time series + the merged
             # anomaly journal (docs/OBSERVABILITY.md) — the crash-readable
@@ -758,6 +818,10 @@ class CruiseControlHttpServer:
                 ignore_cache=_flag(params, "ignore_proposal_cache"),
                 allow_stale=_flag(params, "allow_stale", default=True),
             )
+            # time inside the facade (cache hit / single-flight wait /
+            # compute) gets its own critical-path phase; the remaining
+            # response shaping reads as "handler"
+            critical_path.mark("facade")
             body = _optimizer_response(result, params)
             body.update(meta)
             return self._send(handler, 200, body)

@@ -14,6 +14,8 @@ import threading
 import time
 from typing import List, Optional
 
+from cruise_control_tpu.utils.locks import InstrumentedLock
+
 
 class OperationStep:
     def __init__(self, description: str, start_s: float):
@@ -40,7 +42,7 @@ class OperationProgress:
     def __init__(self, operation: str = ""):
         self.operation = operation
         self._steps: List[OperationStep] = []
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("operation.progress")
 
     def add_step(self, description: str) -> OperationStep:
         step = OperationStep(description, time.time())

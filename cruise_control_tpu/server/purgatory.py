@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from cruise_control_tpu.utils.locks import InstrumentedLock
+
 
 class ReviewStatus:
     PENDING_REVIEW = "PENDING_REVIEW"
@@ -46,7 +48,7 @@ class Purgatory:
     def __init__(self, retention_s: float = 86_400.0):
         self._requests: Dict[int, RequestInfo] = {}
         self._ids = itertools.count(1)
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("review.purgatory")
         self.retention_s = retention_s
 
     def add(self, endpoint: str, params: dict) -> RequestInfo:

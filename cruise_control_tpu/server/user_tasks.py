@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Optional
 from cruise_control_tpu.server import admission
 from cruise_control_tpu.server.progress import OperationProgress
 from cruise_control_tpu.telemetry import events, trace
+from cruise_control_tpu.utils.locks import InstrumentedLock
 
 
 class UserTaskState:
@@ -72,7 +73,7 @@ class UserTaskManager:
         #: counter so journal fingerprints are reproducible)
         self.id_factory = id_factory
         self._tasks: Dict[str, UserTask] = {}
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("user_tasks.table")
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="user-task"
         )

@@ -432,6 +432,15 @@ def _scenario_journal(ring_size: int = 1 << 15, path: Optional[str] = None,
     events.JOURNAL = EventJournal(
         enabled=True, ring_size=ring_size, path=path,
         max_bytes=max_bytes, max_files=max_files, clock=clock,
+        # real-wall-clock telemetry kinds are inadmissible in a
+        # virtual-clock journal: a bootstrap SLO engine elsewhere in the
+        # process (real clock, maintenance hooks) may pump the contention
+        # detector / host-profile parser mid-run, and those emissions
+        # would land HERE nondeterministically and break the pinned
+        # scenario/soak fingerprints.  The sim drivers never pump either
+        # on purpose (bootstrap comment: "never the sim").
+        exclude_kinds=frozenset(
+            {"contention.hot_lock", "profiler.host.parsed"}),
     )
     try:
         yield events.JOURNAL

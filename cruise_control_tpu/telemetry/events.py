@@ -56,6 +56,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from cruise_control_tpu.utils.checksum import scan_lines, stamp_line
+from cruise_control_tpu.utils.locks import InstrumentedLock
 from cruise_control_tpu.utils.logging import get_logger
 
 LOG = get_logger("events")
@@ -80,8 +81,17 @@ class EventJournal:
         max_files: int = _DEFAULT_MAX_FILES,
         ring_size: int = _DEFAULT_RING_SIZE,
         clock=None,
+        exclude_kinds: frozenset = frozenset(),
     ):
         self.enabled = enabled
+        #: kinds this journal refuses.  The scenario simulator swaps a
+        #: virtual-clock journal in for the whole run; telemetry generated
+        #: from REAL wall-clock observations (the sustained-contention
+        #: detector, host-profile parses — both pumped by bootstrap SLO
+        #: engines on host time) is meaningless in scenario time and
+        #: nondeterministic, so the scenario journal drops those kinds at
+        #: the door rather than racing every background emitter.
+        self.exclude_kinds = frozenset(exclude_kinds)
         self.path = path
         self.max_bytes = max(4096, int(max_bytes))
         self.max_files = max(1, int(max_files))
@@ -91,7 +101,7 @@ class EventJournal:
         #: clock instead of the host's — a soak evaluating "the last 30
         #: minutes" means 30 *virtual* minutes.
         self.clock = clock or time.time
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("journal.events")
         self._ring: deque = deque(maxlen=max(16, int(ring_size)))
         self._fh = None
         self._bytes_written = 0
@@ -180,7 +190,7 @@ class EventJournal:
         """Append one event.  No-op when disabled; never raises (a journal
         failure must not add a second failure to whatever is being
         journaled)."""
-        if not self.enabled:
+        if not self.enabled or kind in self.exclude_kinds:
             return
         scope = getattr(self._local, "scope", None)
         if task_id is None and scope:

@@ -44,11 +44,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from cruise_control_tpu.telemetry import (
     device_cost,
     device_stats,
+    host_profile,
     kernel_budget,
     mesh_budget,
     profile,
 )
 from cruise_control_tpu.telemetry.tracing import Telemetry
+from cruise_control_tpu.utils import locks
 from cruise_control_tpu.utils.metrics import MetricRegistry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -152,18 +154,27 @@ def render_prometheus(
     extra_families: Optional[Sequence[ExtraFamily]] = None,
 ) -> str:
     """Render the registry (+ phase timers and device/compile stats when
-    ``telemetry`` is given) as Prometheus text exposition format 0.0.4."""
-    snap = registry.snapshot()
+    ``telemetry`` is given) as Prometheus text exposition format 0.0.4.
+
+    Snapshot-then-render discipline (ISSUE 18): ONE locked table copy
+    (``scrape_parts``), then every per-metric read happens off the
+    registry lock and every reservoir is copied under its own lock and
+    sorted OFF it.  The previous shape called ``registry.snapshot()`` —
+    rendering (and discarding) timer/histogram JSON, then re-snapshotting
+    every timer — so each scrape sorted every 1024-sample reservoir four
+    times with request threads' ``update()`` calls blocked behind the
+    in-lock sorts."""
+    counters, meters, gauges, timers, histograms = registry.scrape_parts()
     lines: List[str] = []
 
-    for raw in sorted(snap["counters"]):
+    for raw in sorted(counters):
         name = _metric_name(raw, "_total")
         lines.append(f"# HELP {name} Counter {raw}")
         lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {_fmt(snap['counters'][raw]['count'])}")
+        lines.append(f"{name} {_fmt(counters[raw].count)}")
 
-    for raw in sorted(snap["meters"]):
-        m = snap["meters"][raw]
+    for raw in sorted(meters):
+        m = meters[raw].snapshot()
         name = _metric_name(raw, "_total")
         lines.append(f"# HELP {name} Meter {raw}")
         lines.append(f"# TYPE {name} counter")
@@ -176,7 +187,7 @@ def render_prometheus(
     # live Timer/Histogram objects, not their JSON snapshots: the bucket
     # emission needs the cumulative counts, which the JSON surface rounds
     # into a {le: count} dict keyed by repr
-    for raw, timer in sorted(registry.timers().items()):
+    for raw, timer in sorted(timers.items()):
         t = timer.snapshot()
         name = _metric_name(raw, "_seconds")
         _histogram_lines(lines, name, f"Timer {raw}",
@@ -186,13 +197,16 @@ def render_prometheus(
         lines.append(f"# TYPE {mx} gauge")
         lines.append(f"{mx} {_fmt(t['maxSec'])}")
 
-    for raw, hist in sorted(registry.histograms().items()):
+    for raw, hist in sorted(histograms.items()):
         h = hist.snapshot()
         _histogram_lines(lines, _metric_name(raw), f"Histogram {raw}",
                          hist.cumulative_buckets(), h["sum"], h["count"])
 
-    for raw in sorted(snap["gauges"]):
-        v = snap["gauges"][raw]
+    for raw in sorted(gauges):
+        try:
+            v = gauges[raw]()
+        except Exception:  # cclint: disable=swallowed-exception -- a broken gauge must not corrupt the scrape; GET /state surfaces its error string
+            continue
         if isinstance(v, bool) or not isinstance(v, (int, float)):
             continue  # error strings / non-numerics are unrepresentable
         name = _metric_name(raw)
@@ -232,8 +246,15 @@ def render_prometheus(
         # cc_mesh_*): latest parsed mesh capture + replication audit
         mesh_families = mesh_budget.MESH.families() \
             if mesh_budget.MESH.enabled else ()
+        # host observatory: named-lock contention counters
+        # (cc_lock_wait_ms / cc_lock_hold_ms / cc_lock_acquisitions_total)
+        # + the sampling profiler's summary gauges (cc_host_*)
+        lock_families = locks.CONTENTION.families()
+        host_families = host_profile.PROFILER.families() \
+            if host_profile.PROFILER.enabled else ()
         device_families = (tuple(device_families) + tuple(kernel_families)
-                           + tuple(mesh_families))
+                           + tuple(mesh_families) + tuple(lock_families)
+                           + tuple(host_families))
     else:
         device_families = ()
 

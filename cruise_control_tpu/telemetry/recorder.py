@@ -36,6 +36,9 @@ Artifact schema (``SCHEMA``):
       "deviceStats": {<device_stats.MONITOR.summary()>},
       "kernelBudget": {<kernel_budget.CAPTURE.summary()>, when attached},
       "meshBudget": {<mesh_budget.MESH.summary()>, when attached},
+      "hostProfile": {<host_profile.PROFILER.summary()>, when attached},
+      "lockContention": {<locks.CONTENTION.snapshot()>, when attached},
+      "criticalPath": {<critical_path.STORE.snapshot()>, when attached},
       ...extra keys the dump path merges in ("dumpReason")
     }
 
@@ -88,6 +91,9 @@ class FlightRecorder:
         traces_source: Optional[Callable[[], List[dict]]] = None,
         kernel_budget_source: Optional[Callable[[], dict]] = None,
         mesh_budget_source: Optional[Callable[[], dict]] = None,
+        host_profile_source: Optional[Callable[[], dict]] = None,
+        contention_source: Optional[Callable[[], dict]] = None,
+        critical_path_source: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.interval_s = max(0.01, float(interval_s))
@@ -112,6 +118,16 @@ class FlightRecorder:
         #: collective/transfer/gap decomposition + replication audit,
         #: merged as `meshBudget`
         self.mesh_budget_source = mesh_budget_source
+        #: telemetry/host_profile.PROFILER.summary — the host sampling
+        #: profiler's rolling window + latest capture, merged as
+        #: `hostProfile` (where were the host threads when it broke)
+        self.host_profile_source = host_profile_source
+        #: utils/locks.CONTENTION.snapshot — per-named-lock wait/hold
+        #: totals, merged as `lockContention`
+        self.contention_source = contention_source
+        #: telemetry/critical_path.STORE.snapshot — per-endpoint request
+        #: phase decompositions, merged as `criticalPath`
+        self.critical_path_source = critical_path_source
         self._lock = threading.Lock()
         self._series: Dict[str, deque] = {}
         self._prev_cum: Dict[str, float] = {}
@@ -244,6 +260,21 @@ class FlightRecorder:
                 out["meshBudget"] = self.mesh_budget_source()
             except Exception:  # pragma: no cover - defensive
                 LOG.exception("flight-recorder mesh-budget source failed")
+        if self.host_profile_source is not None:
+            try:
+                out["hostProfile"] = self.host_profile_source()
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("flight-recorder host-profile source failed")
+        if self.contention_source is not None:
+            try:
+                out["lockContention"] = self.contention_source()
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("flight-recorder contention source failed")
+        if self.critical_path_source is not None:
+            try:
+                out["criticalPath"] = self.critical_path_source()
+            except Exception:  # pragma: no cover - defensive
+                LOG.exception("flight-recorder critical-path source failed")
         if extra:
             out.update(extra)
         return out

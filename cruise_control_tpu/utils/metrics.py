@@ -18,6 +18,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
+from cruise_control_tpu.utils.locks import InstrumentedLock
+
 #: Fixed log-spaced duration buckets (seconds): 3 per decade, 1ms → 100s.
 #: Fixed — not per-instance — so bucket series from different processes and
 #: different runs line up in dashboards, and the exposition layer can emit
@@ -172,10 +174,14 @@ class Timer:
                 self._samples = self._samples[-self._KEEP:]
 
     def _percentile(self, q: float) -> float:
+        # copy under the lock, SORT OFF-LOCK: a scrape sorting 1024
+        # samples while holding the lock stalls every request thread's
+        # update() behind it (the GET /metrics contention ISSUE 18 fixed)
         with self._lock:
             if not self._samples:
                 return 0.0
-            s = sorted(self._samples)
+            s = list(self._samples)
+        s.sort()
         idx = min(int(q * len(s)), len(s) - 1)
         return s[idx]
 
@@ -191,19 +197,35 @@ class Timer:
         return out
 
     def snapshot(self) -> dict:
+        # one locked copy, one off-lock sort, both percentiles from it —
+        # not two _percentile() calls (two copies + two sorts per
+        # snapshot, and the pre-ISSUE-18 version sorted under the lock)
+        with self._lock:
+            count, total, mx = self.count, self.total_s, self.max_s
+            samples = list(self._samples)
+        samples.sort()
+
+        def pct(q: float) -> float:
+            if not samples:
+                return 0.0
+            return samples[min(int(q * len(samples)), len(samples) - 1)]
+
         return {
-            "count": self.count,
-            "sumSec": round(self.total_s, 6),
-            "meanSec": round(self.total_s / self.count, 6) if self.count else 0.0,
-            "maxSec": round(self.max_s, 6),
-            "p50Sec": round(self._percentile(0.50), 6),
-            "p99Sec": round(self._percentile(0.99), 6),
+            "count": count,
+            "sumSec": round(total, 6),
+            "meanSec": round(total / count, 6) if count else 0.0,
+            "maxSec": round(mx, 6),
+            "p50Sec": round(pct(0.50), 6),
+            "p99Sec": round(pct(0.99), 6),
         }
 
 
 class MetricRegistry:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # instrumented (ISSUE 18): every request thread's timer(name)
+        # lookup serializes here, so its wait series is the scrape-vs-
+        # serve contention evidence (cc_lock_wait_ms{lock="metric.registry"})
+        self._lock = InstrumentedLock("metric.registry")
         self._timers: Dict[str, Timer] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._meters: Dict[str, Meter] = {}
@@ -233,6 +255,16 @@ class MetricRegistry:
     def gauge(self, name: str, fn: Callable[[], float]) -> None:
         with self._lock:
             self._gauges[name] = fn
+
+    def scrape_parts(self) -> tuple:
+        """(counters, meters, gauges, timers, histograms) — ONE locked
+        table copy for the exposition layer, which then reads the live
+        objects off-lock.  ``snapshot()`` would render timer/histogram
+        JSON the scrape discards (re-sorting every reservoir twice)."""
+        with self._lock:
+            return (dict(self._counters), dict(self._meters),
+                    dict(self._gauges), dict(self._timers),
+                    dict(self._histograms))
 
     def timers(self) -> Dict[str, Timer]:
         with self._lock:

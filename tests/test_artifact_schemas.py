@@ -259,6 +259,58 @@ def _whatif_artifact():
     return [make_artifact(batch, measure_proactive())]
 
 
+def _host_profile_artifacts():
+    """The live producer: a deterministic capture over a synthetic
+    frame stream (the same ingest() surface the sampler daemon uses),
+    built through the real off-thread parse path."""
+    from cruise_control_tpu.telemetry.host_profile import HostProfiler
+
+    p = HostProfiler(interval_ms=10.0, clock=lambda: 1000.0,
+                     id_factory=lambda: "host-capture-probe")
+    p.arm(samples=3, reason="schema-probe")
+    for _ in range(3):
+        p.ingest([
+            ("Thread-4", "server/http_server:_dispatch;facade:serve"),
+            ("cc-slo-engine", "telemetry/slo:_tick"),
+            ("user-task_0", "executor/executor:execute_proposals"),
+        ])
+    assert p.parse_pending() == 1
+    art = p.latest()
+    assert art is not None
+    return [art]
+
+
+def _critical_path_artifacts():
+    """The live producer: real request_scope clocks + a real journal
+    heal episode through heal_episodes(), assembled by build_artifact."""
+    from cruise_control_tpu.telemetry import critical_path as cp
+
+    store = cp.CriticalPathStore()
+    ticks = iter([i * 0.001 for i in range(1000)])
+    for _ in range(20):
+        clock = cp.PhaseClock(clock=lambda: next(ticks))
+        clock.endpoint = "proposals"
+        for phase in ("parse", "auth", "admissionQueue", "facade",
+                      "handler", "serialize", "flush"):
+            clock.mark(phase)
+        store.record(clock)
+    serve = store.decompose("proposals")
+    heal = cp.heal_episodes([
+        {"ts": 100.0, "kind": "sim.fault"},
+        {"ts": 101.5, "kind": "detector.anomaly"},
+        {"ts": 101.6, "kind": "detector.recovery_cooldown"},
+        {"ts": 103.0, "kind": "optimize.start"},
+        {"ts": 105.0, "kind": "optimize.end"},
+        {"ts": 105.2, "kind": "executor.start"},
+        {"ts": 109.0, "kind": "executor.end"},
+    ])
+    assert serve is not None and len(heal) == 1
+    return [cp.build_artifact(serve=serve, heal=heal,
+                              metrics_scrape={"beforeWaitMs": 10.0,
+                                              "afterWaitMs": 1.0},
+                              now=1000.0)]
+
+
 def _kernel_budget_artifacts():
     """The live producer: a REAL capture of the scan program at the tiny
     pinned fixture (shared — and session-cached — with
@@ -285,7 +337,8 @@ def _mesh_budget_artifacts():
                                       "events", "scenarios", "checkpoint",
                                       "slo", "trace", "soak",
                                       "kernel-budget", "mesh-budget",
-                                      "whatif"])
+                                      "whatif", "host-profile",
+                                      "critical-path"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     if producer == "phase-profile":
         arts = _phase_profile_artifact()
@@ -314,6 +367,12 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "whatif":
         arts = _whatif_artifact()
         schema = SCHEMAS["cc-tpu-whatif/1"]
+    elif producer == "host-profile":
+        arts = _host_profile_artifacts()
+        schema = SCHEMAS["cc-tpu-host-profile/1"]
+    elif producer == "critical-path":
+        arts = _critical_path_artifacts()
+        schema = SCHEMAS["cc-tpu-critical-path/1"]
     elif producer == "soak":
         arts = _soak_artifact()
         schema = SCHEMAS["cc-tpu-soak/1"]

@@ -44,7 +44,7 @@ def test_latest_round_holds_every_gate():
                  "checkpoint_overhead_pct", "precompute_overhead_pct",
                  "replan_overhead_pct", "slo_overhead_pct",
                  "profiler_overhead_pct", "mesh_overhead_pct",
-                 "whatif_batch_ratio",
+                 "host_profiler_overhead_pct", "whatif_batch_ratio",
                  "replan_settle_speedup", "soak_smoke"):
         assert gate in verdicts, f"round r{latest} lost the {gate} gate"
         value, ok = verdicts[gate]

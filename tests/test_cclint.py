@@ -1005,6 +1005,73 @@ PACKAGE_FIXTURES = {
             },
         ],
     },
+    "lock-instrumentation-discipline": {
+        "positive": [
+            # raw Lock on a serving-path coordination point (hot dir)
+            {
+                "pkg/server/__init__.py": "",
+                "pkg/server/handler.py": (
+                    "import threading\n"
+                    "class Queue:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                ),
+            },
+            # from-import direct name, in the hot facade module
+            {
+                "pkg/facade.py": (
+                    "from threading import RLock\n"
+                    "class Facade:\n"
+                    "    def __init__(self):\n"
+                    "        self._cache_lock = RLock()\n"
+                ),
+            },
+            # module-aliased import still resolves
+            {
+                "pkg/analyzer/__init__.py": "",
+                "pkg/analyzer/degradation.py": (
+                    "import threading as th\n"
+                    "class Window:\n"
+                    "    def make(self):\n"
+                    "        return th.Lock()\n"
+                ),
+            },
+        ],
+        "negative": [
+            # the blessed idiom: Condition wrapping an injected
+            # (instrumented) lock — Condition itself is exempt
+            {
+                "pkg/server/__init__.py": "",
+                "pkg/server/handler.py": (
+                    "import threading\n"
+                    "class Queue:\n"
+                    "    def __init__(self, lk):\n"
+                    "        self._cond = threading.Condition(lk)\n"
+                ),
+            },
+            # cold modules keep stdlib freedom (per-metric nanosecond
+            # holds would drown in wrapper overhead)
+            {
+                "pkg/telemetry/__init__.py": "",
+                "pkg/telemetry/agg.py": (
+                    "import threading\n"
+                    "class Agg:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                ),
+            },
+            # non-lock threading ctors in hot modules stay silent
+            {
+                "pkg/executor/__init__.py": "",
+                "pkg/executor/drive.py": (
+                    "import threading\n"
+                    "class Drive:\n"
+                    "    def __init__(self):\n"
+                    "        self._stop = threading.Event()\n"
+                ),
+            },
+        ],
+    },
 }
 
 
@@ -1485,6 +1552,16 @@ MUTATIONS = {
         "                if inflight:\n"
         "                    packed, m_new, tab_new = inflight.pop(0)",
     ),
+    # ISSUE 18 satellite: the admission queue's instrumented lock
+    # reverted to a raw stdlib lock — the exact attribution hole the
+    # lock observatory closed (waits nobody can name) — must be caught
+    "lock-instrumentation-admission": (
+        "lock-instrumentation-discipline",
+        "cruise_control_tpu/server/admission.py",
+        "self._cond = threading.Condition("
+        'InstrumentedLock("admission.queue"))',
+        "self._cond = threading.Condition(threading.Lock())",
+    ),
     # ISSUE 17 satellite: the constraint upload rewritten as a stray
     # jax.device_put in the drive loop — the exact ledger-blind copy
     # the mesh observatory's transfer discipline closed — must be caught
@@ -1545,9 +1622,25 @@ def test_package_lints_clean_within_budget():
         + "\n".join(f.render() for f in cold.findings)
     )
     assert cold.files_scanned > 50
+    if cold.duration_s >= 5.0:
+        # This guest has sustained multi-second interference windows that
+        # can double a wall-clock draw (see bench.py's interleaved-gate
+        # rationale).  One retry separates "the box was busy" from "the
+        # single-parse budget regressed": a real regression fails both
+        # draws, a noise window doesn't.  The structural single-parse
+        # asserts below are unaffected.
+        import shutil
+
+        from cruise_control_tpu.devtools.lint.driver import cache_dir
+
+        cd = cache_dir()
+        if cd is not None and cd.exists():
+            shutil.rmtree(cd)
+        cold = run_lint(paths=[str(PKG)])
+        assert not cold.findings
     assert cold.duration_s < 5.0, (
-        f"cold lint pass took {cold.duration_s:.2f}s — the single-parse "
-        "budget regressed"
+        f"cold lint pass took {cold.duration_s:.2f}s twice — the "
+        "single-parse budget regressed"
     )
     # the whole-program phase really ran (the graph is not optional)
     assert cold.stats["graphBuildMs"] > 0.0

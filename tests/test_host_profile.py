@@ -1,0 +1,658 @@
+"""Host observatory (ISSUE 18): sampling profiler, lock/queue contention
+telemetry, and end-to-end critical-path decomposition.
+
+The profiler tests run on SYNTHETIC frame streams (``(thread_name,
+folded_stack)`` ticks through :meth:`HostProfiler.ingest`) so role
+mapping, window bounds and the capture ladder are pinned independently of
+what this box's threads happen to be doing; the lock tests use a private
+:class:`ContentionRegistry` and a deterministic lock-schedule fixture
+(direct ``record_acquire`` calls) so the sustained-contention detector's
+streak/cooldown semantics are exact.  One live test drives the real
+``GET /profile/host`` 404 → arm → 202 → 200 ladder through the real HTTP
+server with the real sampler daemon.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.telemetry import critical_path as cp
+from cruise_control_tpu.telemetry import events
+from cruise_control_tpu.telemetry import host_profile as hp
+from cruise_control_tpu.telemetry.events import EventJournal
+from cruise_control_tpu.utils import locks
+from harness import full_stack
+from test_artifact_schemas import SCHEMAS, validate
+
+#: one synthetic sampling tick: every interesting thread role at once
+_TICK = [
+    ("Thread-12", "server/http_server:_dispatch;facade:serve_proposals"),
+    ("cc-http-1", "server/http_server:_dispatch;server/admission:admit"),
+    ("user-task_3", "executor/executor:execute_proposals"),
+    ("anomaly-detector", "monitor/detector:_tick"),
+    ("cc-slo-engine", "telemetry/slo:_maintenance"),
+    ("MainThread", "bootstrap:main"),
+    ("weird-daemon", "somewhere:spin"),
+]
+
+
+def _seq_clock(start=500.0, step=0.05):
+    state = [start]
+
+    def clock():
+        state[0] += step
+        return state[0]
+
+    return clock
+
+
+# ---- role mapping + folding ------------------------------------------------------
+def test_role_mapping_prefixes():
+    assert hp.role_for("cc-http-3") == "http-worker"
+    assert hp.role_for("Thread-17") == "http-worker"
+    assert hp.role_for("user-task_0") == "executor-drive"
+    assert hp.role_for("anomaly-detector") == "detector"
+    assert hp.role_for("proposal-precompute") == "precompute"
+    assert hp.role_for("cc-slo-engine") == "slo-tick"
+    assert hp.role_for("cc-flight-recorder") == "recorder"
+    assert hp.role_for("metric-fetcher-manager-0") == "fetcher"
+    assert hp.role_for("whatif-proactive") == "proactive"
+    assert hp.role_for("MainThread") == "main"
+    assert hp.role_for("somebody-else") == "other"
+
+
+def test_short_file_is_package_relative_and_extensionless():
+    assert hp._short_file(
+        "/x/y/cruise_control_tpu/server/http_server.py"
+    ) == "server/http_server"
+    assert hp._short_file("/usr/lib/python3.11/threading.py") == "threading"
+
+
+def test_fold_stack_is_root_first():
+    import sys
+
+    def inner():
+        return hp.fold_stack(sys._getframe())
+
+    def outer():
+        return inner()
+
+    folded = outer()
+    frames = folded.split(";")
+    # root-first: the CALLER precedes the callee (flame-graph order)
+    assert frames.index("test_host_profile:outer") \
+        < frames.index("test_host_profile:inner")
+    assert frames[-1] == "test_host_profile:inner"
+
+
+def test_fold_stack_depth_bounded():
+    import sys
+
+    def deep(n):
+        if n == 0:
+            return hp.fold_stack(sys._getframe(), max_depth=10)
+        return deep(n - 1)
+
+    assert len(deep(50).split(";")) == 10
+
+
+# ---- window bounds ---------------------------------------------------------------
+def test_stack_agg_overflow_folds_the_tail():
+    agg = hp._StackAgg()
+    for i in range(hp._MAX_STACKS_PER_ROLE):
+        agg.record("r", f"s{i}", None)
+    for _ in range(8):
+        agg.record("r", "one-more-distinct", None)
+    per = agg.stacks["r"]
+    assert len(per) == hp._MAX_STACKS_PER_ROLE + 1
+    assert per[hp._OVERFLOW_STACK] == 8
+    assert agg.total == hp._MAX_STACKS_PER_ROLE + 8
+
+
+def test_stack_agg_decay_halves_and_drops_zeros():
+    agg = hp._StackAgg()
+    for _ in range(10):
+        agg.record("r", "hot", 1)
+    agg.record("r", "cold", 1)
+    agg.decay()
+    assert agg.stacks["r"] == {"hot": 5}
+    assert agg.total == 5
+    assert agg.samples["r"] == 5
+
+
+def test_window_decays_when_full():
+    p = hp.HostProfiler(clock=_seq_clock())
+    for _ in range(600):  # 600 ticks x 7 samples crosses the 4096 window
+        p.ingest(_TICK)
+    st = p.state()
+    assert p.ticks == 600
+    assert st["windowSamples"] < hp._WINDOW_MAX_SAMPLES
+    # lifetime counters never decay
+    assert sum(p.lifetime_samples.values()) == 600 * len(_TICK)
+
+
+# ---- the capture ladder ----------------------------------------------------------
+def test_arm_ingest_parse_ladder_produces_schema_valid_artifact():
+    p = hp.HostProfiler(interval_ms=25.0, clock=_seq_clock(),
+                        id_factory=lambda: "host-capture-fixed")
+    assert p.state()["state"] == "IDLE"
+    st = p.arm(samples=2, reason="fixture")
+    assert st["state"] == "ARMED" and st["captureId"] == "host-capture-fixed"
+    # arming is idempotent while in flight
+    assert p.arm(samples=99)["captureId"] == "host-capture-fixed"
+    p.ingest(_TICK)
+    assert p.state()["state"] == "ARMED"
+    p.ingest(_TICK)
+    st = p.state()
+    assert st["state"] == "IDLE" and st["pendingParses"] == 1
+    assert p.latest() is None  # the build is off-thread, not inline
+    assert p.parse_pending() == 1
+    art = p.latest()
+    validate(json.loads(json.dumps(art)), SCHEMAS["cc-tpu-host-profile/1"])
+    assert art["capture"]["id"] == "host-capture-fixed"
+    assert art["capture"]["samplesCollected"] == 2
+    assert art["totalSamples"] == 2 * len(_TICK)
+    # both http-ish thread names fold into ONE role
+    assert art["roles"]["http-worker"]["samples"] == 4
+    assert art["roles"]["executor-drive"]["samples"] == 2
+    assert art["roles"]["other"]["samples"] == 2
+    # flame-graph folded lines: role as root frame, trailing count
+    assert ("http-worker;server/http_server:_dispatch;"
+            "facade:serve_proposals 2") in art["folded"]
+    shares = [s["share"] for s in art["roles"]["http-worker"]["topStacks"]]
+    assert sum(shares) == pytest.approx(1.0)
+
+
+def test_parse_journals_profiler_host_parsed_deterministically():
+    def run():
+        journal = EventJournal(enabled=True, clock=lambda: 111.0)
+        prev = events.JOURNAL
+        events.JOURNAL = journal
+        try:
+            p = hp.HostProfiler(interval_ms=25.0)
+            with p.scoped(clock=_seq_clock(),
+                          id_factory=lambda: "host-capture-fixed"):
+                p.arm(samples=2, reason="fixture")
+                p.ingest(_TICK)
+                p.ingest(_TICK)
+                assert p.parse_pending() == 1
+                art = p.latest()
+        finally:
+            events.JOURNAL = prev
+        recs = [e for e in journal.recent()
+                if e["kind"] == "profiler.host.parsed"]
+        return recs, art
+
+    recs1, art1 = run()
+    recs2, art2 = run()
+    assert len(recs1) == 1
+    payload = recs1[0]["payload"]
+    assert payload["captureId"] == "host-capture-fixed"
+    assert payload["samples"] == 2
+    assert payload["stacks"] == 2 * len(_TICK)
+    assert payload["reason"] == "fixture"
+    # bit-stable under the scoped clock/id factory: same bytes both runs
+    assert json.dumps(recs1, sort_keys=True) == \
+        json.dumps(recs2, sort_keys=True)
+    assert json.dumps(art1, sort_keys=True) == \
+        json.dumps(art2, sort_keys=True)
+
+
+def test_real_clock_kinds_never_land_in_a_scenario_journal():
+    """A bootstrap SLO engine elsewhere in the process pumps the
+    contention detector / host-profile parser on REAL wall time; if one
+    fires mid-scenario its emission must not reach the virtual-clock
+    scenario journal, or the pinned scenario/soak fingerprints go
+    nondeterministic on a loaded box."""
+    from cruise_control_tpu.sim.simulator import _scenario_journal
+
+    with _scenario_journal(clock=lambda: 42.0) as journal:
+        # what a leaked maintenance tick would do mid-run
+        events.emit("contention.hot_lock", severity="WARNING",
+                    lock="model.semaphore", waitMs=300.0)
+        events.emit("profiler.host.parsed", captureId="x", samples=1)
+        events.emit("sim.scenario_start", name="t", seed=0)
+    kinds = [r["kind"] for r in journal.recent()]
+    assert kinds == ["sim.scenario_start"]
+
+
+def test_exclude_kinds_is_per_journal_not_global():
+    """The production journal still accepts both kinds (the /events and
+    recorder surfaces depend on them) — exclusion is a property of the
+    scenario journal alone."""
+    journal = EventJournal(enabled=True, clock=lambda: 1.0)
+    prev = events.JOURNAL
+    events.JOURNAL = journal
+    try:
+        events.emit("contention.hot_lock", severity="WARNING",
+                    lock="model.semaphore", waitMs=300.0)
+        events.emit("profiler.host.parsed", captureId="x", samples=1)
+    finally:
+        events.JOURNAL = prev
+    assert [r["kind"] for r in journal.recent()] == \
+        ["contention.hot_lock", "profiler.host.parsed"]
+
+
+def test_disabled_profiler_is_inert():
+    p = hp.HostProfiler(enabled=False)
+    assert p.ensure_started() is False
+    assert p.arm(samples=1)["state"] == "IDLE"
+    p.ingest(_TICK)
+    st = p.state()
+    assert st["windowSamples"] == 0 and st["samplerAlive"] is False
+
+
+def test_pending_parse_queue_is_bounded():
+    p = hp.HostProfiler(clock=_seq_clock())
+    for _ in range(hp._MAX_PENDING_PARSES + 2):
+        p.arm(samples=1, reason="x")
+        p.ingest(_TICK)
+    assert p.state()["pendingParses"] == hp._MAX_PENDING_PARSES
+    assert p.parse_pending(max_parses=10) == hp._MAX_PENDING_PARSES
+
+
+def test_profiler_families_expose_roles():
+    p = hp.HostProfiler(clock=_seq_clock())
+    assert p.families() == []  # nothing sampled yet: no empty families
+    p.ingest(_TICK)
+    fams = {f[0]: f[3] for f in p.families()}
+    samples = dict((tuple(sorted(lbl.items()))[0][1], v)
+                   for lbl, v in fams["cc_host_samples_total"])
+    assert samples["http-worker"] == 2.0
+    assert samples["main"] == 1.0
+
+
+# ---- instrumented locks ----------------------------------------------------------
+def test_instrumented_lock_measures_wait_and_hold():
+    reg = locks.ContentionRegistry()
+    lk = locks.InstrumentedLock("t.hot", registry=reg)
+    entered = threading.Event()
+
+    def worker():
+        entered.set()
+        with lk:
+            pass
+
+    with lk:
+        t = threading.Thread(target=worker)
+        t.start()
+        assert entered.wait(5)
+        time.sleep(0.15)  # make the worker's blocked wait measurable
+    t.join(5)
+    snap = reg.snapshot()["t.hot"]
+    assert snap["acquisitions"] == 2
+    assert snap["contended"] >= 1
+    assert snap["waitMs"] > 0
+    assert snap["holdMs"] >= 100  # we held it through the sleep
+    assert snap["waitMaxMs"] <= snap["waitMs"] or snap["contended"] == 1
+
+
+def test_instrumented_lock_timeout_abandon_records_the_wait():
+    reg = locks.ContentionRegistry()
+    lk = locks.InstrumentedLock("t.abandon", registry=reg)
+    assert lk.acquire()
+    out = []
+    t = threading.Thread(target=lambda: out.append(
+        lk.acquire(timeout=0.05)))
+    t.start()
+    t.join(5)
+    lk.release()
+    assert out == [False]
+    snap = reg.snapshot()["t.abandon"]
+    # the wait was real, the acquisition never happened
+    assert snap["acquisitions"] == 1
+    assert snap["contended"] == 1
+    assert snap["waitMs"] >= 40
+    assert not lk.locked()
+
+
+def test_instrumented_lock_condition_interop_no_phantom_acquisitions():
+    reg = locks.ContentionRegistry()
+    cond = threading.Condition(locks.InstrumentedLock("t.cond",
+                                                      registry=reg))
+    waiting = threading.Event()
+    got = []
+
+    def waiter():
+        with cond:
+            waiting.set()
+            got.append(cond.wait(timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert waiting.wait(5)
+    with cond:
+        cond.notify_all()
+    t.join(5)
+    assert got == [True]
+    # exactly 3 acquisitions: waiter enter, notifier enter, waiter
+    # re-acquire after notify — _is_owned kept Condition off the
+    # nonblocking-probe fallback, so no phantom counts
+    assert reg.snapshot()["t.cond"]["acquisitions"] == 3
+
+
+def test_instrumented_semaphore_cross_thread_release_records_no_hold():
+    reg = locks.ContentionRegistry()
+    sem = locks.InstrumentedSemaphore(2, name="t.sem", registry=reg)
+    with sem:
+        time.sleep(0.02)
+    same_thread_hold = reg.snapshot()["t.sem"]["holdMs"]
+    assert same_thread_hold >= 10
+    # a permit released by a DIFFERENT thread must not invent a hold
+    assert sem.acquire()
+    t = threading.Thread(target=sem.release)
+    t.start()
+    t.join(5)
+    snap = reg.snapshot()["t.sem"]
+    assert snap["acquisitions"] == 2
+    assert snap["holdMs"] == same_thread_hold
+
+
+def test_contention_detector_sustain_streak_and_cooldown():
+    now = [1000.0]
+    reg = locks.ContentionRegistry(threshold_ms=250.0, sustain_windows=2,
+                                   cooldown_s=300.0, clock=lambda: now[0])
+    st = reg.stats("server.hot")
+    journal = EventJournal(enabled=True, clock=lambda: 42.0)
+    prev = events.JOURNAL
+    events.JOURNAL = journal
+    try:
+        # one hot window is a blip, not an event
+        st.record_acquire(0.3)
+        assert reg.check_pending() == 0
+        # the second consecutive hot window journals exactly once
+        st.record_acquire(0.3)
+        assert reg.check_pending() == 1
+        # still hot, but inside the cooldown: streak builds, no event
+        st.record_acquire(0.3)
+        assert reg.check_pending() == 0
+        st.record_acquire(0.3)
+        assert reg.check_pending() == 0
+        # past the cooldown the sustained streak fires again
+        now[0] += 301.0
+        st.record_acquire(0.3)
+        assert reg.check_pending() == 1
+        # a quiet window resets the streak entirely
+        st.record_acquire(0.1)
+        assert reg.check_pending() == 0
+        st.record_acquire(0.3)
+        assert reg.check_pending() == 0
+        assert reg.hot_events == 2
+    finally:
+        events.JOURNAL = prev
+    recs = [e for e in journal.recent()
+            if e["kind"] == "contention.hot_lock"]
+    assert len(recs) == 2
+    assert recs[0]["severity"] == "WARNING"
+    payload = recs[0]["payload"]
+    assert payload["lock"] == "server.hot"
+    assert payload["windowWaitMs"] == pytest.approx(300.0)
+    assert payload["windowAcquisitions"] == 1
+    assert payload["sustainedWindows"] == 2
+    assert payload["totalWaitMs"] >= payload["windowWaitMs"]
+    assert "totalHoldMs" in payload
+
+
+def test_lock_families_render_in_prometheus_exposition():
+    from cruise_control_tpu.telemetry.exposition import render_prometheus
+    from cruise_control_tpu.telemetry.tracing import Telemetry
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    # the journal's own lock is instrumented, so the row always exists
+    events.JOURNAL._lock.acquire()
+    events.JOURNAL._lock.release()
+    fams = {f[0] for f in locks.CONTENTION.families()}
+    assert fams == {"cc_lock_wait_ms", "cc_lock_hold_ms",
+                    "cc_lock_acquisitions_total"}
+    body = render_prometheus(MetricRegistry(), Telemetry(enabled=True))
+    assert 'cc_lock_wait_ms{lock="journal.events"}' in body
+    assert 'cc_lock_hold_ms{lock="journal.events"}' in body
+
+
+# ---- per-request critical path ---------------------------------------------------
+def test_phase_clock_partitions_the_wall_exactly():
+    ticks = iter([i * 0.25 for i in range(100)])
+    clock = cp.PhaseClock(clock=lambda: next(ticks))
+    clock.mark("parse")
+    clock.mark("auth")
+    clock.mark("handler")
+    clock.mark("handler")  # repeated names accumulate
+    clock.mark("flush")
+    phases = clock.phases()
+    assert phases == {"parse": 0.25, "auth": 0.25,
+                      "handler": 0.5, "flush": 0.25}
+    assert sum(phases.values()) == clock.wall_s()  # exact, by construction
+
+
+def test_request_scope_is_thread_local_and_safe_outside():
+    cp.mark("nowhere")  # no active scope: safe no-op
+    cp.set_endpoint("nowhere")
+    store = cp.CriticalPathStore()
+    with cp.request_scope(store=store):
+        cp.set_endpoint("state")
+        cp.mark("parse")
+        cp.mark("handler")
+    assert store.recorded == 1
+    block = store.decompose("state")
+    assert set(block["meanPhasesMs"]) == {"parse", "handler"}
+    assert block["reconciliationPct"] == 100.0
+
+
+def test_store_skips_requests_that_never_marked():
+    store = cp.CriticalPathStore()
+    with cp.request_scope(store=store):
+        pass  # e.g. the /ui short-circuit: no marks, no wall
+    assert store.recorded == 0 and store.snapshot() == {}
+
+
+def test_decompose_percentiles_and_ring_bound():
+    store = cp.CriticalPathStore(keep=64)
+    ticks = iter([i * 0.001 for i in range(100000)])
+
+    def one(extra_ms):
+        clock = cp.PhaseClock(clock=lambda: next(ticks))
+        clock.endpoint = "proposals"
+        clock.mark("parse")
+        for _ in range(extra_ms):
+            clock.mark("handler")
+        clock.mark("flush")
+        store.record(clock)
+
+    for i in range(100):
+        one(1 + (i % 10))
+    block = store.decompose("proposals")
+    assert block["requests"] == 64  # ring-bounded
+    assert block["wallP99Ms"] >= block["wallP50Ms"]
+    assert block["p99"]["reconciliationPct"] == 100.0
+    assert block["reconciliationPct"] == 100.0
+    assert sum(block["p99"]["phasesMs"].values()) == \
+        pytest.approx(block["p99"]["wallMs"])
+
+
+# ---- per-heal critical path ------------------------------------------------------
+_HEAL_JOURNAL = [
+    {"ts": 100.0, "kind": "sim.fault"},
+    {"ts": 101.5, "kind": "detector.anomaly"},
+    {"ts": 101.6, "kind": "detector.recovery_cooldown"},
+    {"ts": 103.0, "kind": "optimize.start"},
+    {"ts": 105.0, "kind": "optimize.end"},
+    {"ts": 105.2, "kind": "executor.start"},
+    {"ts": 109.0, "kind": "executor.end"},
+]
+
+
+def test_heal_episode_exact_partition():
+    eps = cp.heal_episodes(list(_HEAL_JOURNAL))
+    assert len(eps) == 1
+    ep = eps[0]
+    assert ep["faultTs"] == 100.0 and ep["wallS"] == 9.0
+    assert ep["phasesS"] == {
+        "detection": 1.5, "admission": 0.1, "cooldownWait": 1.4,
+        "planCompute": 2.0, "executionPrep": 0.2, "executionTicks": 3.8,
+    }
+    assert sum(ep["phasesS"].values()) == pytest.approx(ep["wallS"])
+    assert ep["reconciliationPct"] == pytest.approx(100.0)
+
+
+def test_heal_cooldown_anchor_is_optional():
+    entries = [e for e in _HEAL_JOURNAL
+               if e["kind"] != "detector.recovery_cooldown"]
+    eps = cp.heal_episodes(entries)
+    assert len(eps) == 1
+    phases = eps[0]["phasesS"]
+    assert "admission" not in phases
+    assert phases["cooldownWait"] == 1.5  # anomaly → optimize.start
+    assert eps[0]["reconciliationPct"] == pytest.approx(100.0)
+
+
+def test_heal_incomplete_episode_skipped_and_next_fault_bounds():
+    entries = [
+        {"ts": 50.0, "kind": "sim.fault"},
+        {"ts": 51.0, "kind": "detector.anomaly"},
+        # heal still in flight when the next fault lands
+    ] + list(_HEAL_JOURNAL)
+    eps = cp.heal_episodes(entries)
+    assert len(eps) == 1
+    assert eps[0]["faultTs"] == 100.0
+
+
+def test_build_artifact_reconciliation_is_worst_of_parts():
+    serve = {"reconciliationPct": 99.5, "p99": {"reconciliationPct": 98.0}}
+    heal = [{"reconciliationPct": 97.2}, {"reconciliationPct": 100.0}]
+    art = cp.build_artifact(serve=serve, heal=heal, now=1234.0)
+    assert art["schema"] == cp.SCHEMA
+    assert art["reconciliationPct"] == 97.2
+    assert cp.build_artifact(now=1.0)["reconciliationPct"] == 0.0
+
+
+# ---- end-to-end through the real server ------------------------------------------
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_arm_sample_poll_e2e_through_http_server():
+    """Acceptance (ISSUE 18): GET /profile/host?arm=true → 202, the REAL
+    sampler daemon collects the requested ticks, the (test-pumped)
+    maintenance tick builds, and the poll returns a schema-valid
+    cc-tpu-host-profile/1 artifact whose roles include the live server's
+    own threads."""
+    from cruise_control_tpu.server.http_server import (
+        CruiseControlHttpServer,
+    )
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    hp.PROFILER.reset()
+    hp.configure(enabled=True, interval_ms=10.0)
+    cc, _backend, _reporter = full_stack(registry=MetricRegistry())
+    server = CruiseControlHttpServer(cc, port=0, access_log=False)
+    server.start()
+    try:
+        status, _ = _get(f"{server.url}/profile/host")
+        assert status == 404  # nothing captured yet
+        status, body = _get(f"{server.url}/profile/host?arm=true&samples=3")
+        assert status == 202
+        assert body["capture"]["state"] == "ARMED"
+        assert body["capture"]["samplesRequested"] == 3
+        deadline = time.monotonic() + 30
+        while hp.PROFILER.state()["pendingParses"] < 1:
+            assert time.monotonic() < deadline, "sampler never completed"
+            status, _ = _get(f"{server.url}/profile/host")
+            assert status == 202  # armed / building — poll semantics
+            time.sleep(0.02)
+        # production pumps this from the SLO tick; tests pump directly
+        assert hp.parse_pending() == 1
+        status, art = _get(f"{server.url}/profile/host")
+        assert status == 200
+        validate(art, SCHEMAS["cc-tpu-host-profile/1"])
+        assert art["capture"]["reason"] == "http"
+        assert art["capture"]["samplesCollected"] == 3
+        assert art["totalSamples"] > 0
+        # the serving thread answering our polls is visible to itself
+        assert "http-worker" in art["roles"]
+    finally:
+        server.stop()
+        hp.PROFILER.stop()
+        hp.PROFILER.reset()
+        hp.configure(interval_ms=50.0)
+
+
+def test_profile_host_503_when_disabled():
+    from cruise_control_tpu.server.http_server import (
+        CruiseControlHttpServer,
+    )
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    cc, _backend, _reporter = full_stack(registry=MetricRegistry())
+    server = CruiseControlHttpServer(cc, port=0, access_log=False)
+    server.start()
+    hp.configure(enabled=False)
+    try:
+        status, body = _get(f"{server.url}/profile/host")
+        assert status == 503
+        assert "telemetry.host.enabled" in body["errorMessage"]
+    finally:
+        hp.configure(enabled=True)
+        server.stop()
+
+
+def test_host_blocks_merge_into_flight_recorder_artifact():
+    from cruise_control_tpu.telemetry.recorder import FlightRecorder
+    from cruise_control_tpu.utils.metrics import MetricRegistry
+
+    p = hp.HostProfiler(clock=_seq_clock())
+    p.ingest(_TICK)
+    reg = locks.ContentionRegistry()
+    locks.InstrumentedLock("t.rec", registry=reg).acquire()
+    store = cp.CriticalPathStore()
+    with cp.request_scope(store=store):
+        cp.set_endpoint("state")
+        cp.mark("handler")
+    rec = FlightRecorder(MetricRegistry(), interval_s=60.0, retention=8,
+                         host_profile_source=p.summary,
+                         contention_source=reg.snapshot,
+                         critical_path_source=store.snapshot)
+    art = rec.artifact()
+    assert art["hostProfile"]["window"]["totalSamples"] == len(_TICK)
+    assert art["lockContention"]["t.rec"]["acquisitions"] == 1
+    assert art["criticalPath"]["state"]["requests"] == 1
+
+
+# ---- committed critical-path artifact ---------------------------------------------
+def test_committed_r18_artifact_decomposes_serve_and_heal():
+    """The committed CRITICAL_PATH_r18 (``PYTHONPATH=. python
+    benchmarks/critical_path.py``) is schema-valid, decomposes BOTH the
+    cached-GET serve p99 and a soak heal episode into named phases, and
+    every decomposition reconciles to >=95% of its measured wall — the
+    ISSUE 18 acceptance gate."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "CRITICAL_PATH_r18.json")
+    with open(path) as f:
+        art = json.load(f)
+    validate(art, SCHEMAS["cc-tpu-critical-path/1"])
+    assert art["reconciliationPct"] >= 95.0
+    serve = art["serve"]
+    assert serve["endpoint"] == "proposals"
+    assert serve["requests"] >= 100
+    p99 = serve["p99"]
+    assert sum(p99["phasesMs"].values()) == pytest.approx(
+        p99["wallMs"], rel=0.05)
+    assert art["heal"], "no heal episode decomposed"
+    for ep in art["heal"]:
+        assert ep["reconciliationPct"] >= 95.0
+        assert sum(ep["phasesS"].values()) == pytest.approx(
+            ep["wallS"], rel=0.05)
+    scrape = art["metricsScrape"]
+    # the satellite-1 before/after number: snapshot-then-render must
+    # reduce registry-lock wait per scrape vs render-inside-lock
+    assert (scrape["snapshotThenRender"]["lockWaitPerScrapeMs"]
+            < scrape["renderInsideRegistryLock"]["lockWaitPerScrapeMs"])

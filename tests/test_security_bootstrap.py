@@ -156,9 +156,19 @@ def test_bootstrap_reads_capacity_and_cluster_configs_files(tmp_path):
         "cluster.configs.file": str(cl),
     })
     app = build_app(cfg, port=0)
-    assert isinstance(
-        app.cruise_control.load_monitor.capacity_resolver,
-        BrokerCapacityConfigFileResolver,
-    )
-    topic_det = app.detector_manager.detectors[AnomalyType.TOPIC_ANOMALY]
-    assert topic_det.finder.target_rf == 3
+    try:
+        assert isinstance(
+            app.cruise_control.load_monitor.capacity_resolver,
+            BrokerCapacityConfigFileResolver,
+        )
+        topic_det = \
+            app.detector_manager.detectors[AnomalyType.TOPIC_ANOMALY]
+        assert topic_det.finder.target_rf == 3
+    finally:
+        # a leaked app keeps its real-clock SLO engine evaluating the
+        # process-wide registry for the rest of the session; its breach
+        # emissions then land in whatever journal is current — including
+        # a later scenario run's virtual-clock journal, breaking the
+        # pinned soak fingerprints (caught in the wild: three slo.breach
+        # records mid-soak, measured off suite-accumulated registry rows)
+        app.shutdown()

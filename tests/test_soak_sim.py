@@ -46,6 +46,24 @@ def smoke_result(key="first"):
     return _cache[key]
 
 
+def test_no_leaked_real_clock_slo_engines():
+    """Tripwire: a real-clock SloEngine leaked by an earlier test keeps
+    evaluating the process-wide registry and journals its breach
+    transitions into whatever journal is current — including a scenario
+    run's virtual-clock journal, which breaks the pinned fingerprints
+    below as a ~rare race instead of a diagnosable failure.  Fail HERE,
+    deterministically, naming the hygiene problem (the leaker forgot
+    ``app.shutdown()`` / ``engine.stop()``)."""
+    import threading
+
+    leaked = [t for t in threading.enumerate()
+              if t.name == "cc-slo-engine" and t.is_alive()]
+    assert not leaked, (
+        "an earlier test leaked a started SloEngine thread; find the "
+        "build_app()/SloEngine.start() without a matching shutdown"
+    )
+
+
 # ---- the schedule generator -----------------------------------------------------
 def test_schedule_same_seed_same_timeline():
     cfg = FaultScheduleConfig(seed=3, duration_ms=12 * 60 * MIN_MS,
@@ -237,6 +255,24 @@ def test_smoke_soak_heals_warm_through_the_replanner():
 def test_smoke_soak_is_deterministic():
     first = smoke_result()
     again = run_soak(smoke_spec())
+    if first.fingerprint() != again.fingerprint():
+        # dump both journals so the mismatch is a diff, not a hash pair
+        # (this is how the leaked-SloEngine contamination was caught)
+        import json as _json
+        import tempfile
+        d = tempfile.gettempdir()
+        for tag, res in (("first", first), ("again", again)):
+            with open(os.path.join(
+                    d, f"soak_diverge_{tag}.jsonl"), "w") as f:
+                for r in res.scenario.journal:
+                    f.write(_json.dumps(
+                        r, sort_keys=True, default=str) + "\n")
+        pytest.fail(
+            "smoke soak fingerprints diverged between two in-process "
+            f"runs — journals dumped to {d}/soak_diverge_*.jsonl; "
+            "diff them (a foreign real-clock emitter in the scenario "
+            "journal is the usual cause)"
+        )
     assert first.fingerprint() == again.fingerprint()
     reseeded = smoke_result("reseeded")
     assert first.fingerprint() != reseeded.fingerprint()
