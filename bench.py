@@ -67,6 +67,13 @@ device_put/fetch vs both off, interleaved best-of on the engine metric
 stopped, interleaved best-of on the engine metric — must
 cost <=1% (captures are operator actions; this bounds the always-on
 sampling residue).
+``lock_witness_overhead_pct`` gates the acquisition-order witness
+(utils/locks.py, ISSUE 19): 250 nested named-lock pairs — far above a
+serving request's named-lock traffic — with the witness enabled vs
+disabled on a private registry, expressed against the north-star
+metric; turning `telemetry.host.lock.order.witness` on must
+cost <=1% of a served rebalance (the disabled path is one attribute
+check and runs on BOTH sides).
 ``validation_overhead_pct`` gates the metrics-quarantine stage
 (monitor/sampling.py SampleValidator): one full ingest pass of the
 50b/1k reporter output (1000 partition + 50 broker samples) with the
@@ -547,6 +554,34 @@ def main() -> None:
     host_profile.PROFILER.stop()
     host_profile.reset()
 
+    # lock-order witness overhead (ISSUE 19): the acquisition-order
+    # recorder under a deliberately witness-heavy load — 250 nested
+    # named-lock pairs (~25x a serving request's named-lock traffic)
+    # on a private registry, enabled vs disabled, expressed against the
+    # north-star metric (the witness rides every named-lock acquire of
+    # a served deployment when the operator turns it on).  The off side
+    # ALSO runs the wrappers' disabled-path attribute check, so the
+    # delta is exactly what telemetry.host.lock.order.witness=true
+    # costs.
+    from cruise_control_tpu.utils import locks as _locks
+
+    wit_reg = _locks.ContentionRegistry()
+    wit_outer = _locks.InstrumentedLock("bench.outer", registry=wit_reg)
+    wit_inner = _locks.InstrumentedLock("bench.inner", registry=wit_reg)
+
+    def _witness_work():
+        for _ in range(250):
+            with wit_outer:
+                with wit_inner:
+                    pass
+
+    wit_off_s, wit_on_s, lock_witness_overhead_pct = _interleaved_gate(
+        _witness_work,
+        off=wit_reg.disable_order_witness,
+        on=wit_reg.enable_order_witness,
+        denom_s=tpu_s,
+        budget_pct=1.0)
+
     # sample-validation overhead (ISSUE 13): the metrics-quarantine stage
     # on the FULL ingest path — reporter output for the 50b/1k fixture
     # (1000 partition + 50 broker samples per interval) driven through
@@ -692,6 +727,13 @@ def main() -> None:
                 # stopped (<=1%)
                 "host_profiler_overhead_pct": round(
                     host_profiler_overhead_pct, 2),
+                # acquisition-order witness enabled vs off, 250 nested
+                # named-lock pairs vs the north-star (<=1%)
+                "lock_witness_overhead_pct": round(
+                    lock_witness_overhead_pct, 2),
+                "lock_witness_work_s": {
+                    "off": round(wit_off_s, 5), "on": round(wit_on_s, 5),
+                },
                 # 64-future batched what-if sweep vs one plan search
                 # (<2x gate; full artifact: WHATIF_r16.json)
                 "whatif_batch_ratio": whatif_batch["ratio"],

@@ -41,6 +41,7 @@ GATES: Dict[str, Tuple[str, float]] = {
     "profiler_overhead_pct": ("max", 1.0),
     "mesh_overhead_pct": ("max", 1.0),
     "host_profiler_overhead_pct": ("max", 1.0),
+    "lock_witness_overhead_pct": ("max", 1.0),
     # a ratio, not a pct: the 64-future batched what-if sweep must cost
     # < 2x one plan search (ISSUE 16)
     "whatif_batch_ratio": ("max", 2.0),
@@ -138,6 +139,7 @@ def render(rounds: List[Tuple[int, dict]]) -> str:
         ("profiler_overhead_pct", "profiler % (≤1)"),
         ("mesh_overhead_pct", "mesh % (≤1)"),
         ("host_profiler_overhead_pct", "host prof % (≤1)"),
+        ("lock_witness_overhead_pct", "lock witness % (≤1)"),
         ("whatif_batch_ratio", "whatif batch × (<2)"),
         ("replan_settle_speedup", f"settle × (≥{REPLAN_SETTLE_MIN:g})"),
         ("soak_smoke", "soak smoke s (green, ≤budget)"),

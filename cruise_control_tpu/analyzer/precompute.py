@@ -116,12 +116,14 @@ class CircuitBreaker:
                 # one probe at a time: further calls stay shed until the
                 # probe reports success/failure
                 return False
-            if (self._clock() - self._opened_at) >= self.reset_s:
-                self._state = self.HALF_OPEN
-                events.emit("analyzer.breaker", severity="WARNING",
-                            state=self.HALF_OPEN, probe=True)
-                return True
-            return False
+            if (self._clock() - self._opened_at) < self.reset_s:
+                return False
+            self._state = self.HALF_OPEN
+        # journal OFF the breaker lock: emit appends to the event file,
+        # and `allow()` sits on every precompute poll
+        events.emit("analyzer.breaker", severity="WARNING",
+                    state=self.HALF_OPEN, probe=True)
+        return True
 
     def retry_after_s(self) -> int:
         with self._lock:

@@ -391,6 +391,8 @@ def build_app(
         sustain_windows=cfg.get_int(
             "telemetry.host.contention.sustain.windows"),
     )
+    if cfg.get_boolean("telemetry.host.lock.order.witness"):
+        locks.CONTENTION.enable_order_witness()
     trace_mod.configure(
         enabled=cfg.get_boolean("telemetry.trace.enabled"),
         max_traces=cfg.get_int("telemetry.trace.max.traces"),

@@ -1203,6 +1203,15 @@ def default_config_def() -> ConfigDef:
              2, Importance.LOW, "Consecutive hot windows before a "
              "contention.hot_lock event is journaled (cooldown-limited "
              "per lock).", at_least(1), G)
+    d.define("telemetry.host.lock.order.witness", ConfigType.BOOLEAN,
+             False, Importance.LOW, "Record runtime lock-acquisition "
+             "ORDER on the named-lock registry (utils/locks.py): thread "
+             "holds A, acquires B => edge A->B into a bounded edge map, "
+             "read back via ContentionRegistry.order_witness(). The "
+             "reconciliation test validates observed edges against the "
+             "static cc-tpu-lock-graph/1 artifact (cclint lock-order). "
+             "Off by default; the off path is one attribute check "
+             "(bench.py lock_witness_overhead_pct).", None, G)
 
     # the build environment has no Kafka: the standalone server manages a
     # simulated cluster whose shape these keys control (bootstrap.py); a

@@ -50,6 +50,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--list-rules", action="store_true",
         help="print the rule ids and summaries, then exit",
     )
+    parser.add_argument(
+        "--lock-graph", metavar="PATH", default=None,
+        help="write the global lock-order graph (cc-tpu-lock-graph/1) "
+             "to PATH after linting — the committed LOCK_GRAPH_r*.json "
+             "artifacts are generated this way",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -69,6 +75,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     result = run_lint(paths=args.paths or None, rules=rules,
                       changed_only=args.changed_only)
+    if args.lock_graph:
+        import json
+        import pathlib
+
+        from cruise_control_tpu.devtools.lint.rules_lockorder import (
+            build_lock_graph,
+        )
+
+        artifact = build_lock_graph(result.project)
+        pathlib.Path(args.lock_graph).write_text(
+            json.dumps(artifact, indent=1, sort_keys=True) + "\n")
     print(render(result, args.format, show_stats=args.stats))
     return 1 if result.findings else 0
 

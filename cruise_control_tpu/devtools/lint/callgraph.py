@@ -43,6 +43,9 @@ class Edge:
     caller: str          # function id "module:funckey"
     callee: str
     lineno: int
+    #: the callee runs on another thread (Thread target / submit /
+    #: call_soon) — lock/blocking state does NOT flow across it
+    spawn: bool = False
 
 
 def fid(module: str, funckey: str) -> str:
@@ -141,7 +144,8 @@ class CallGraph:
             for site in func.calls:
                 target = self._resolve(module, func, site.callee)
                 if target is not None and target in self.funcs:
-                    out.append(Edge(caller_id, target, site.lineno))
+                    out.append(Edge(caller_id, target, site.lineno,
+                                    spawn=site.spawned))
                 tail = site.callee.rsplit(".", 1)[-1]
                 if tail in SPAWN_TAILS:
                     # the function argument is (eventually) called
@@ -150,7 +154,8 @@ class CallGraph:
                             continue
                         t = self._resolve(module, func, arg)
                         if t is not None and t in self.funcs:
-                            out.append(Edge(caller_id, t, site.lineno))
+                            out.append(Edge(caller_id, t, site.lineno,
+                                            spawn=True))
             if out:
                 self.edges[caller_id] = out
 

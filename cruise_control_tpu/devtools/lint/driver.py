@@ -62,15 +62,20 @@ from cruise_control_tpu.devtools.lint.rules_except import (
 from cruise_control_tpu.devtools.lint.rules_fenced import (
     FencedBackendDisciplineRule,
 )
+from cruise_control_tpu.devtools.lint.rules_blocking import (
+    BlockingUnderLockRule,
+)
 from cruise_control_tpu.devtools.lint.rules_jax import JaxHotPathRule
 from cruise_control_tpu.devtools.lint.rules_lock import LockDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_lockinst import (
     LockInstrumentationRule,
 )
+from cruise_control_tpu.devtools.lint.rules_lockorder import LockOrderRule
 from cruise_control_tpu.devtools.lint.rules_obs import ObsDynamicNameRule
 from cruise_control_tpu.devtools.lint.rules_profiler import (
     ProfilerDisciplineRule,
 )
+from cruise_control_tpu.devtools.lint.rules_release import ReleaseSafetyRule
 from cruise_control_tpu.devtools.lint.rules_retry import RetryDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_schema import JournalSchemaRule
 from cruise_control_tpu.devtools.lint.rules_transfer import (
@@ -106,6 +111,9 @@ RULES = {
         FencedBackendDisciplineRule(),
         TransferDisciplineRule(),
         LockInstrumentationRule(),
+        LockOrderRule(),
+        BlockingUnderLockRule(),
+        ReleaseSafetyRule(),
     )
 }
 
@@ -170,8 +178,14 @@ def changed_files() -> Optional[set]:
 
 
 def _rel(path: str) -> str:
+    p = pathlib.Path(path)
+    if not p.is_absolute():
+        # already repo-relative (project-rule findings carry the
+        # summaries' phase-1 rel paths) — resolving against the CWD
+        # would mangle it whenever the process runs outside the root
+        return path
     try:
-        return str(pathlib.Path(path).resolve().relative_to(_repo_root()))
+        return str(p.resolve().relative_to(_repo_root()))
     except ValueError:
         return path
 
@@ -184,8 +198,12 @@ class LintResult:
     suppressions_used: int
     unused_suppressions: List[tuple]  # (path, line, rule)
     #: phase/budget accounting (the --stats surface): filesParsed is
-    #: cache misses, cacheHits warm reuses, graphBuildMs phase 2
+    #: cache misses, cacheHits warm reuses, graphBuildMs phase 2,
+    #: lockflowMs the flow-sensitive lock analysis inside phase 2
     stats: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: the phase-2 context, for post-run artifact emission
+    #: (``--lock-graph``); never serialized
+    project: object = None
 
     @property
     def counts(self) -> Dict[str, int]:
@@ -207,6 +225,8 @@ class LintResult:
                 "cacheHits": int(self.stats.get("cacheHits", 0)),
                 "graphBuildMs": round(
                     float(self.stats.get("graphBuildMs", 0.0)), 3),
+                "lockflowMs": round(
+                    float(self.stats.get("lockflowMs", 0.0)), 3),
             },
         }
 
@@ -228,7 +248,8 @@ class LintResult:
                 f"cclint stats: {int(self.stats.get('filesParsed', 0))} "
                 f"parsed, {int(self.stats.get('cacheHits', 0))} cache "
                 f"hit(s), graph build "
-                f"{self.stats.get('graphBuildMs', 0.0):.1f} ms"
+                f"{self.stats.get('graphBuildMs', 0.0):.1f} ms, "
+                f"lockflow {self.stats.get('lockflowMs', 0.0):.1f} ms"
             )
         return "\n".join(lines)
 
@@ -371,6 +392,8 @@ def run_lint(paths: Optional[Sequence[str]] = None,
                 if (line, rule_id) not in supp.used:
                     unused.append((rel, line, rule_id))
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    lockflow_ms = (project._lockflow.build_ms
+                   if project._lockflow is not None else 0.0)
     return LintResult(
         findings=kept,
         files_scanned=len(lint_set),
@@ -381,7 +404,9 @@ def run_lint(paths: Optional[Sequence[str]] = None,
             "filesParsed": parsed,
             "cacheHits": store.hits,
             "graphBuildMs": graph_ms,
+            "lockflowMs": lockflow_ms,
         },
+        project=project,
     )
 
 

@@ -33,11 +33,12 @@ import hashlib
 import pathlib
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from cruise_control_tpu.devtools.lint import cfg as cfg_mod
 from cruise_control_tpu.devtools.lint import rules_config
 
 #: bump (or just edit any lint source — the salt covers it) to drop
 #: cached summaries whose shape this module no longer understands
-SUMMARY_VERSION = 1
+SUMMARY_VERSION = 2
 
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _NP_MODULES = {"np", "numpy", "onp"}
@@ -66,6 +67,8 @@ class CallSite:
     arg_exprs: Tuple[str, ...]   # dotted reprs of the first args ("" = complex)
     with_ctxs: Tuple[str, ...]   # dotted with-contexts held at this site
     first_arg_false: bool = False  # first positional arg is literal False
+    spawned: bool = False        # synthesized Thread(target=...) edge —
+    #                              the callee runs on ANOTHER thread
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,6 +90,19 @@ class EmitSite:
     severity: Optional[str]      # literal severity keyword, if any
 
 
+@dataclasses.dataclass(frozen=True)
+class BlockingOp:
+    """One potentially blocking operation (I/O, unbounded wait, host
+    sync).  ``kind`` gates applicability: "" is unconditional, "queue"
+    requires the receiver to resolve to a queue type, "wait" marks a
+    wait that releases its own condition lock while blocked."""
+
+    lineno: int
+    callee: str                  # dotted as written ("self._fh.flush")
+    desc: str
+    kind: str = ""
+
+
 @dataclasses.dataclass
 class FuncSummary:
     name: str                    # "f", "C.m", "start>Handler.do_GET"
@@ -102,6 +118,16 @@ class FuncSummary:
         default_factory=list)          # (recv Name, attr, lineno)
     is_jit: bool = False
     static_params: Tuple[str, ...] = ()
+    #: local/global lock bindings: var name → InstrumentedLock name literal
+    lock_names: Dict[str, str] = dataclasses.field(default_factory=dict)
+    blocking_ops: List[BlockingOp] = dataclasses.field(default_factory=list)
+    #: ``return <call>(...)`` facts: (dotted callee, dotted first
+    #: positional arg or None) — lockflow resolves context-manager
+    #: factories (the model-generation-lock idiom) through these
+    returns_calls: List[Tuple[str, Optional[str]]] = dataclasses.field(
+        default_factory=list)
+    #: control-flow graph, present only for functions with lock events
+    cfg: Optional[cfg_mod.CFG] = None
 
 
 @dataclasses.dataclass
@@ -113,6 +139,9 @@ class ClassSummary:
     safe_attrs: Set[str] = dataclasses.field(default_factory=set)
     attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
     methods: Set[str] = dataclasses.field(default_factory=set)
+    #: attr → InstrumentedLock/Semaphore name literal (Condition-wrapped
+    #: locks resolve to the wrapped lock's name)
+    lock_names: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,6 +206,35 @@ def anno_to_dotted(node: ast.expr) -> Optional[str]:
             return anno_to_dotted(node.slice)
         return None
     return dotted(node)
+
+
+def _lock_name_of(value: ast.expr) -> Optional[str]:
+    """The name literal of an ``InstrumentedLock("name")`` /
+    ``InstrumentedSemaphore(n, name="name")`` constructor, unwrapping
+    ``Condition(InstrumentedLock("name"))`` — the named-lock vocabulary
+    the concurrency rules order on."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = dotted(value.func)
+    if f is None:
+        return None
+    tail = f.rsplit(".", 1)[-1]
+    if tail in ("InstrumentedLock", "InstrumentedSemaphore"):
+        for kw in value.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+    if tail == "InstrumentedLock":
+        if value.args and isinstance(value.args[0], ast.Constant) \
+                and isinstance(value.args[0].value, str):
+            return value.args[0].value
+    elif tail == "InstrumentedSemaphore":
+        if len(value.args) >= 2 and isinstance(value.args[1], ast.Constant) \
+                and isinstance(value.args[1].value, str):
+            return value.args[1].value
+    elif tail == "Condition" and value.args:
+        return _lock_name_of(value.args[0])
+    return None
 
 
 def _with_ctx_expr(item: ast.withitem) -> Optional[str]:
@@ -307,6 +365,10 @@ class _Extractor:
         self.summary.functions[key] = rec
         for stmt in fn.body:
             self._scan_stmt(stmt, rec, (), cls_key=cls_key, func_key=key)
+        # flow-sensitive rules need real control flow wherever locks are
+        # touched; everything else stays summary-only (held = ∅)
+        if cfg_mod.has_lock_events(fn):
+            rec.cfg = cfg_mod.build_cfg(fn)
 
     # -- statement walk with held with-contexts --
     def _scan_stmt(self, node: ast.stmt, rec: FuncSummary,
@@ -343,6 +405,20 @@ class _Extractor:
             for tgt in node.targets:
                 self._scan_target(tgt, rec, held)
             return
+        if isinstance(node, ast.Return):
+            # record `return Ctor(arg, ...)` so lockflow can resolve
+            # context-manager factories (a function that wraps a lock in
+            # a guard object and returns it — the model-generation-lock
+            # idiom) back to the lock the guard's __enter__ acquires
+            if isinstance(node.value, ast.Call):
+                f = dotted(node.value.func)
+                if f is not None:
+                    arg = (dotted(node.value.args[0])
+                           if node.value.args else None)
+                    rec.returns_calls.append((f, arg))
+            if node.value is not None:
+                self._scan_expr(node.value, rec, held)
+            return
         # compound statements: recurse with the same held set
         for field in ("body", "orelse", "finalbody"):
             for stmt in getattr(node, field, ()):
@@ -375,12 +451,15 @@ class _Extractor:
         elif isinstance(value, ast.Name) and value.id in rec.params:
             ctor = rec.annotations.get(value.id)
         is_self = isinstance(value, ast.Name) and value.id == "self"
+        lock_name = _lock_name_of(value)
         for tgt in targets:
             if isinstance(tgt, ast.Name):
                 if ctor is not None:
                     rec.var_types[tgt.id] = ctor
                 elif is_self:
                     rec.var_types[tgt.id] = "<self>"
+                if lock_name is not None:
+                    rec.lock_names[tgt.id] = lock_name
             elif isinstance(tgt, ast.Attribute):
                 d = dotted(tgt)
                 if d is None or ctor is None:
@@ -391,10 +470,15 @@ class _Extractor:
                     csum = self.summary.classes.get(rec.cls)
                     if csum is not None:
                         tail = ctor.rsplit(".", 1)[-1]
+                        if lock_name is not None:
+                            csum.lock_names.setdefault(attr, lock_name)
                         if tail in _LOCK_CTORS:
                             csum.lock_attrs.add(attr)
                         elif tail in _SAFE_CTORS:
                             csum.safe_attrs.add(attr)
+                            # the ctor is still a type fact: the
+                            # blocking rule needs queue-typed receivers
+                            csum.attr_types.setdefault(attr, ctor)
                         else:
                             csum.attr_types.setdefault(attr, ctor)
 
@@ -477,7 +561,9 @@ class _Extractor:
                     rec.calls.append(CallSite(
                         callee=d, lineno=node.lineno, nargs=0, kwargs=(),
                         none_kwargs=(), arg_exprs=(), with_ctxs=(),
+                        spawned=True,
                     ))
+        self._note_blocking(node, callee, tail, kwargs, rec)
         # host-sync ops, recorded for EVERY function: the transitive
         # jax rule decides whether a jit context reaches them
         if tail in _SYNC_METHODS and isinstance(node.func, ast.Attribute):
@@ -541,6 +627,56 @@ class _Extractor:
                     if keys:
                         self.summary.normalized_keys.append(
                             (node.lineno, keys))
+
+
+    #: socket-shaped method tails (blocking network I/O)
+    _SOCKET_TAILS = frozenset((
+        "sendall", "recv", "recvfrom", "accept", "connect", "sendto",
+    ))
+
+    def _note_blocking(self, node: ast.Call, callee: str, tail: str,
+                       kwargs: Tuple[str, ...], rec: FuncSummary) -> None:
+        """Record the blocking-op vocabulary for rules_blocking: journal
+        flush/fsync, socket I/O, host syncs, unbounded waits/joins and
+        queue ops.  Bounded variants (a timeout argument) don't block
+        indefinitely and are not recorded."""
+        lineno = node.lineno
+        ops = rec.blocking_ops
+        if "." not in callee and tail != "sleep":
+            return
+        if tail == "flush" and not node.args:
+            ops.append(BlockingOp(lineno, callee, "flush() file I/O"))
+        elif tail == "fsync":
+            ops.append(BlockingOp(lineno, callee, "fsync() disk barrier"))
+        elif tail in self._SOCKET_TAILS:
+            ops.append(BlockingOp(lineno, callee,
+                                  f".{tail}() socket I/O"))
+        elif callee == "time.sleep" or (tail == "sleep"
+                                        and callee.endswith("time.sleep")):
+            ops.append(BlockingOp(lineno, callee, "time.sleep()"))
+        elif tail == "device_get":
+            ops.append(BlockingOp(lineno, callee,
+                                  "jax.device_get host sync"))
+        elif tail == "block_until_ready":
+            ops.append(BlockingOp(lineno, callee,
+                                  ".block_until_ready() host sync"))
+        elif tail == "wait" and not node.args and "timeout" not in kwargs:
+            ops.append(BlockingOp(lineno, callee, "unbounded .wait()",
+                                  kind="wait"))
+        elif tail == "join" and not node.args and "timeout" not in kwargs:
+            # zero-arg filter excludes str.join / os.path.join
+            ops.append(BlockingOp(lineno, callee, "unbounded .join()"))
+        elif tail == "result" and not node.args \
+                and "timeout" not in kwargs:
+            ops.append(BlockingOp(lineno, callee,
+                                  "unbounded Future.result()"))
+        elif tail == "get" and not node.args and not kwargs:
+            ops.append(BlockingOp(lineno, callee, "blocking queue get()",
+                                  kind="queue"))
+        elif tail == "put" and "block" not in kwargs \
+                and not node.keywords:
+            ops.append(BlockingOp(lineno, callee, "blocking queue put()",
+                                  kind="queue"))
 
 
 #: parameter names that mark a function as receiving an injected clock —
@@ -780,6 +916,21 @@ class SymbolGraph:
                        if parent is not None else None)
             else:
                 hit = None
+            if (hit is None and head.isupper()
+                    and head not in func.params
+                    and head not in func.var_types):
+                # module-level singleton: ``JOURNAL = EventJournal()``
+                # at module scope types the receiver in every function
+                # of the module.  ALL_CAPS only — the constant
+                # convention makes local shadowing implausible, which
+                # keeps the fallback under-approximate
+                s = self.modules.get(module)
+                mfunc = (s.functions.get(_Extractor._MODULE_KEY)
+                         if s is not None else None)
+                if mfunc is not None and mfunc is not func:
+                    ctor = mfunc.var_types.get(head)
+                    if ctor is not None and ctor != "<self>":
+                        hit = self.resolve_class(module, ctor)
         # descend attribute chains through constructor-typed attrs:
         # app.worker → App.attr_types["worker"] → Worker
         while hit is not None and rest:

@@ -23,6 +23,7 @@ class ProjectContext:
     linted_abs: Set[pathlib.Path]
     repo_root: pathlib.Path
     _callgraph: Optional[CallGraph] = None
+    _lockflow: Optional[object] = None
 
     @property
     def callgraph(self) -> CallGraph:
@@ -31,3 +32,14 @@ class ProjectContext:
         if self._callgraph is None:
             self._callgraph = CallGraph(self.graph)
         return self._callgraph
+
+    @property
+    def lockflow(self):
+        """The flow-sensitive lock analysis (lockflow.LockFlow), built
+        once and shared by lock-order / blocking-under-lock — the CFG
+        dataflow and callgraph fixpoints run a single time per lint."""
+        if self._lockflow is None:
+            from cruise_control_tpu.devtools.lint.lockflow import LockFlow
+
+            self._lockflow = LockFlow(self)
+        return self._lockflow

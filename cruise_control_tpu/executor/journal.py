@@ -279,7 +279,7 @@ class ExecutionJournal:
             ))
             try:
                 if kind in _FLUSH_KINDS or len(self._pending) >= _MAX_BUFFERED:
-                    self._flush_locked()
+                    self._flush_locked()  # cclint: disable=blocking-under-lock -- journal.execution IS the file serializer: write-ahead semantics require the flush to land before append returns, under the same lock that orders the records
                 if kind == "end":
                     # terminal: atomically truncate — a completed
                     # execution needs no recovery state
@@ -398,7 +398,7 @@ class ExecutionJournal:
     def close(self) -> None:
         with self._lock:
             try:
-                self._flush_locked()
+                self._flush_locked()  # cclint: disable=blocking-under-lock -- close() drains the buffer exactly once; the lock serializes against a concurrent append, and there is no after-the-lock to defer to
             except OSError:  # pragma: no cover - defensive
                 LOG.exception("execution checkpoint flush on close failed")
                 self._pending.clear()

@@ -669,7 +669,7 @@ class CruiseControl:
             # options, and completeness overrides keep the cold path: the
             # snapshot describes full-stack plans only.
             with self._compute_lock:
-                result, _state = self._replan_operation(
+                result, _state = self._replan_operation(  # cclint: disable=blocking-under-lock -- proposal.single_flight intentionally serializes the WHOLE operation, execution-journal write-ahead included: two interleaved plans would corrupt the snapshot commit it exists to protect
                     "REBALANCE", dryrun, engine,
                     self._model_generation(), progress, strategy,
                 )
@@ -1015,12 +1015,12 @@ class CruiseControl:
                 return cached
             generation = self._model_generation()
             if self.replanner is not None:
-                result, state = self._replan_proposals(
+                result, state = self._replan_proposals(  # cclint: disable=blocking-under-lock -- proposal.single_flight intentionally serializes the whole proposal computation (that is the single-flight contract); journal write-ahead rides inside it by design
                     engine, generation, progress
                 )
             else:
                 state = self._model(None, progress)
-                result = self._goal_based_operation(
+                result = self._goal_based_operation(  # cclint: disable=blocking-under-lock -- proposal.single_flight intentionally serializes the whole proposal computation (that is the single-flight contract); journal write-ahead rides inside it by design
                     "PROPOSALS", state, None, OptimizationOptions(), True,
                     engine, progress,
                 )

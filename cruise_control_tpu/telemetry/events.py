@@ -225,7 +225,7 @@ class EventJournal:
             self.total_emitted += 1
             if self.path:
                 try:
-                    self._write_line(line)
+                    self._write_line(line)  # cclint: disable=blocking-under-lock -- journal.events IS the file serializer (append order = ring order is the journal's invariant); the line is pre-rendered off-lock, only the ~µs append+flush runs under it
                 except Exception:  # disk trouble must not kill the caller
                     LOG.exception("event journal write failed")
                     self._close_file()

@@ -26,6 +26,15 @@ per-metric locks inside ``utils/metrics.py`` (millions of acquisitions per
 rebalance) — only on the named coordination locks where waits are
 milliseconds, not nanoseconds.
 
+Acquisition-order witness (ISSUE 19, opt-in via
+``telemetry.host.lock.order.witness``): when enabled, the registry also
+records every *nested* acquisition — thread holds named lock A, acquires
+named lock B → edge ``A → B`` — into a bounded edge map.
+:meth:`ContentionRegistry.order_witness` snapshots it, and the lock-graph
+reconciliation test asserts every runtime-observed edge is present in the
+committed static ``cc-tpu-lock-graph/1`` artifact (cclint's lock-order
+rule).  Off by default; the off path is a single attribute check.
+
 ``Condition`` interop: :class:`InstrumentedLock` implements ``_is_owned``
 (owner-thread tracking), so ``threading.Condition(InstrumentedLock(...))``
 never falls back to the stdlib's ``acquire(False)`` probe — probe noise
@@ -144,6 +153,15 @@ class ContentionRegistry:
         self._hot_streak: Dict[str, int] = {}
         self._last_emit: Dict[str, float] = {}
         self.hot_events = 0
+        # ---- acquisition-order witness (off by default) ----------------------
+        # A plain bool on purpose: the wrappers' fast path is ONE attribute
+        # load + branch when the witness is off — no lock, no thread-local
+        # touch, no allocation (the bench gate asserts the overhead).
+        self.order_witness_enabled = False
+        self._witness_local = threading.local()
+        self._witness_edges: Dict[Tuple[str, str], int] = {}
+        self._witness_bound = 256
+        self._witness_dropped = 0
 
     def configure(self, threshold_ms: Optional[float] = None,
                   sustain_windows: Optional[int] = None,
@@ -230,9 +248,80 @@ class ContentionRegistry:
             )
         return emitted
 
+    # ---- acquisition-order witness ------------------------------------------------
+    def enable_order_witness(self, bound: int = 256) -> None:
+        """Start recording observed acquisition-order edges: whenever a
+        thread acquires named lock B while already holding named lock A,
+        the edge ``A → B`` is counted.  Bounded: at most ``bound``
+        DISTINCT edges are kept (overflow increments ``dropped`` — counts
+        on known edges keep accumulating).  Enable/disable while no named
+        lock is held: a thread's held-stack is only maintained while the
+        witness is on, so toggling mid-hold can leave a stale entry on
+        that thread (docs/OBSERVABILITY.md)."""
+        with self._lock:
+            self._witness_edges.clear()
+            self._witness_dropped = 0
+            self._witness_bound = int(bound)
+            # published last, under the lock: no recorder can observe
+            # enabled=True with a half-cleared edge map
+            self.order_witness_enabled = True
+
+    def disable_order_witness(self) -> None:
+        with self._lock:
+            self.order_witness_enabled = False
+
+    def order_witness(self) -> dict:
+        """Snapshot of the observed order edges — the runtime side the
+        lock-graph reconciliation test checks against the committed
+        static ``cc-tpu-lock-graph/1`` artifact."""
+        with self._lock:
+            edges = [
+                {"from": a, "to": b, "count": n}
+                for (a, b), n in sorted(self._witness_edges.items())
+            ]
+            return {"enabled": self.order_witness_enabled,
+                    "edges": edges, "dropped": self._witness_dropped}
+
+    def _witness_stack(self) -> List[str]:
+        stack = getattr(self._witness_local, "stack", None)
+        if stack is None:
+            stack = self._witness_local.stack = []
+        return stack
+
+    def _witness_acquired(self, name: str) -> None:
+        """Called by the wrappers AFTER a successful acquire, only while
+        the witness is enabled."""
+        stack = self._witness_stack()
+        if stack:
+            with self._lock:
+                for held in stack:
+                    if held == name:
+                        continue  # re-entry on a same-named sibling
+                    key = (held, name)
+                    n = self._witness_edges.get(key)
+                    if n is None and \
+                            len(self._witness_edges) >= self._witness_bound:
+                        self._witness_dropped += 1
+                        continue
+                    self._witness_edges[key] = (n or 0) + 1
+        stack.append(name)
+
+    def _witness_released(self, name: str) -> None:
+        stack = getattr(self._witness_local, "stack", None)
+        if stack:
+            # LIFO in the common case; reverse search tolerates
+            # out-of-order hand-releases
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == name:
+                    del stack[i]
+                    break
+
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._witness_edges.clear()
+            self._witness_dropped = 0
+            self.order_witness_enabled = False
         self._hot_streak.clear()
         self._last_emit.clear()
         self.hot_events = 0
@@ -252,8 +341,8 @@ class InstrumentedLock:
                  registry: Optional[ContentionRegistry] = None) -> None:
         self.name = name
         self._inner = threading.Lock()
-        self._stats = (registry if registry is not None
-                       else CONTENTION).stats(name)
+        self._reg = registry if registry is not None else CONTENTION
+        self._stats = self._reg.stats(name)
         self._owner: Optional[int] = None
         self._acquired_at = 0.0
 
@@ -270,12 +359,16 @@ class InstrumentedLock:
                 self._stats.record_wait_abandoned(waited)
                 return False
         self._stats.record_acquire(waited)
+        if self._reg.order_witness_enabled:
+            self._reg._witness_acquired(self.name)
         self._owner = threading.get_ident()
         self._acquired_at = time.perf_counter()
         return True
 
     def release(self) -> None:
         held = time.perf_counter() - self._acquired_at
+        if self._reg.order_witness_enabled:
+            self._reg._witness_released(self.name)
         # clear ownership BEFORE the inner release: the next owner writes
         # its own ident after acquiring, and must not be clobbered
         self._owner = None
@@ -307,8 +400,8 @@ class InstrumentedSemaphore:
                  registry: Optional[ContentionRegistry] = None) -> None:
         self.name = name
         self._inner = threading.Semaphore(value)
-        self._stats = (registry if registry is not None
-                       else CONTENTION).stats(name)
+        self._reg = registry if registry is not None else CONTENTION
+        self._stats = self._reg.stats(name)
         self._meta = threading.Lock()
         self._held_since: Dict[int, List[float]] = {}
 
@@ -326,6 +419,8 @@ class InstrumentedSemaphore:
                 self._stats.record_wait_abandoned(waited)
                 return False
         self._stats.record_acquire(waited)
+        if self._reg.order_witness_enabled:
+            self._reg._witness_acquired(self.name)
         ident = threading.get_ident()
         with self._meta:
             self._held_since.setdefault(ident, []).append(
@@ -333,6 +428,8 @@ class InstrumentedSemaphore:
         return True
 
     def release(self, n: int = 1) -> None:
+        if self._reg.order_witness_enabled:
+            self._reg._witness_released(self.name)
         ident = threading.get_ident()
         now = time.perf_counter()
         with self._meta:

@@ -492,6 +492,26 @@ _SCHEMA_REGISTRY = json.dumps({
 })
 _SCHEMA_EVENTS_STUB = "def emit(kind, severity='INFO', **payload):\n    pass\n"
 
+# a named-lock stub: the concurrency rules key on the CONSTRUCTOR NAME
+# (`InstrumentedLock("...")` literals anchor the vocabulary), so fixture
+# packages carry their own minimal class instead of importing the real
+# utils/locks (fixtures must lint in isolation)
+_ILOCK_STUB = (
+    "import threading\n"
+    "class InstrumentedLock:\n"
+    "    def __init__(self, name):\n"
+    "        self.name = name\n"
+    "        self._inner = threading.Lock()\n"
+    "    def acquire(self, blocking=True, timeout=-1):\n"
+    "        return self._inner.acquire(blocking, timeout)\n"
+    "    def release(self):\n"
+    "        self._inner.release()\n"
+    "    def __enter__(self):\n"
+    "        return self._inner.__enter__()\n"
+    "    def __exit__(self, *exc):\n"
+    "        return self._inner.__exit__(*exc)\n"
+)
+
 PACKAGE_FIXTURES = {
     "cross-module-lock": {
         "positive": [
@@ -1072,6 +1092,264 @@ PACKAGE_FIXTURES = {
             },
         ],
     },
+    "lock-order": {
+        "positive": [
+            # direct inversion: two named locks nested in both orders
+            {
+                "pkg/locks.py": _ILOCK_STUB,
+                "pkg/ab.py": (
+                    "from pkg.locks import InstrumentedLock\n"
+                    "class Pair:\n"
+                    "    def __init__(self):\n"
+                    "        self._a = InstrumentedLock('order.a')\n"
+                    "        self._b = InstrumentedLock('order.b')\n"
+                    "    def forward(self):\n"
+                    "        with self._a:\n"
+                    "            with self._b:\n"
+                    "                pass\n"
+                    "    def backward(self):\n"
+                    "        with self._b:\n"
+                    "            with self._a:\n"
+                    "                pass\n"
+                ),
+            },
+            # projected inversion: each leg acquires its second lock one
+            # CALL away — only the callgraph fixpoint sees the cycle
+            {
+                "pkg/locks.py": _ILOCK_STUB,
+                "pkg/m.py": (
+                    "from pkg.locks import InstrumentedLock\n"
+                    "class M:\n"
+                    "    def __init__(self):\n"
+                    "        self._a = InstrumentedLock('order.a')\n"
+                    "        self._b = InstrumentedLock('order.b')\n"
+                    "    def _grab_a(self):\n"
+                    "        with self._a:\n"
+                    "            pass\n"
+                    "    def _grab_b(self):\n"
+                    "        with self._b:\n"
+                    "            pass\n"
+                    "    def forward(self):\n"
+                    "        with self._a:\n"
+                    "            self._grab_b()\n"
+                    "    def backward(self):\n"
+                    "        with self._b:\n"
+                    "            self._grab_a()\n"
+                ),
+            },
+        ],
+        "negative": [
+            # globally consistent order (one leg projected) — acyclic
+            {
+                "pkg/locks.py": _ILOCK_STUB,
+                "pkg/ab.py": (
+                    "from pkg.locks import InstrumentedLock\n"
+                    "class Pair:\n"
+                    "    def __init__(self):\n"
+                    "        self._a = InstrumentedLock('order.a')\n"
+                    "        self._b = InstrumentedLock('order.b')\n"
+                    "    def _grab_b(self):\n"
+                    "        with self._b:\n"
+                    "            pass\n"
+                    "    def one(self):\n"
+                    "        with self._a:\n"
+                    "            with self._b:\n"
+                    "                pass\n"
+                    "    def two(self):\n"
+                    "        with self._a:\n"
+                    "            self._grab_b()\n"
+                ),
+            },
+            # inversion against an UNNAMED lock: invisible to the
+            # ordering vocabulary (documented blind spot, not a cycle)
+            {
+                "pkg/locks.py": _ILOCK_STUB,
+                "pkg/ab.py": (
+                    "import threading\n"
+                    "from pkg.locks import InstrumentedLock\n"
+                    "class Pair:\n"
+                    "    def __init__(self):\n"
+                    "        self._a = InstrumentedLock('order.a')\n"
+                    "        self._raw = threading.Lock()\n"
+                    "    def forward(self):\n"
+                    "        with self._a:\n"
+                    "            with self._raw:\n"
+                    "                pass\n"
+                    "    def backward(self):\n"
+                    "        with self._raw:\n"
+                    "            with self._a:\n"
+                    "                pass\n"
+                ),
+            },
+        ],
+    },
+    "blocking-under-lock": {
+        "positive": [
+            # intra: a sleep on the line where the named lock is held
+            {
+                "pkg/locks.py": _ILOCK_STUB,
+                "pkg/svc.py": (
+                    "import time\n"
+                    "from pkg.locks import InstrumentedLock\n"
+                    "class Svc:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = InstrumentedLock('svc.state')\n"
+                    "    def tick(self):\n"
+                    "        with self._lock:\n"
+                    "            time.sleep(0.5)\n"
+                ),
+            },
+            # projected: the call under the lock reaches a flush() one
+            # module away (witness chain in the finding)
+            {
+                "pkg/locks.py": _ILOCK_STUB,
+                "pkg/sink.py": (
+                    "class Sink:\n"
+                    "    def __init__(self, fh):\n"
+                    "        self._fh = fh\n"
+                    "    def push(self, rows):\n"
+                    "        self._fh.flush()\n"
+                ),
+                "pkg/svc.py": (
+                    "from pkg.locks import InstrumentedLock\n"
+                    "from pkg.sink import Sink\n"
+                    "class Svc:\n"
+                    "    def __init__(self, fh):\n"
+                    "        self._lock = InstrumentedLock('svc.state')\n"
+                    "        self._sink = Sink(fh)\n"
+                    "        self._rows = []\n"
+                    "    def tick(self):\n"
+                    "        with self._lock:\n"
+                    "            self._sink.push(self._rows)\n"
+                ),
+            },
+        ],
+        "negative": [
+            # the PR-18 /metrics shape: snapshot under the lock, render
+            # and write OFF it — the canonical fix this rule enforces
+            {
+                "pkg/locks.py": _ILOCK_STUB,
+                "pkg/svc.py": (
+                    "from pkg.locks import InstrumentedLock\n"
+                    "class Svc:\n"
+                    "    def __init__(self, fh):\n"
+                    "        self._lock = InstrumentedLock('svc.state')\n"
+                    "        self._rows = []\n"
+                    "        self._fh = fh\n"
+                    "    def render(self):\n"
+                    "        with self._lock:\n"
+                    "            rows = list(self._rows)\n"
+                    "        self._fh.write(str(rows))\n"
+                    "        self._fh.flush()\n"
+                ),
+            },
+            # Condition.wait on the HELD lock itself: wait releases it
+            # while sleeping, so it is not blocking-under-that-lock
+            {
+                "pkg/locks.py": _ILOCK_STUB,
+                "pkg/q.py": (
+                    "import threading\n"
+                    "from pkg.locks import InstrumentedLock\n"
+                    "class Q:\n"
+                    "    def __init__(self):\n"
+                    "        self._cond = threading.Condition(\n"
+                    "            InstrumentedLock('q.state'))\n"
+                    "    def take(self):\n"
+                    "        with self._cond:\n"
+                    "            self._cond.wait()\n"
+                ),
+            },
+        ],
+    },
+    "lock-release-safety": {
+        "positive": [
+            # bare acquire; the call between it and release() can
+            # raise, exiting with the lock held
+            {
+                "pkg/r.py": (
+                    "import threading\n"
+                    "class R:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self.n = 0\n"
+                    "    def poke(self):\n"
+                    "        self._lock.acquire()\n"
+                    "        self.refresh()\n"
+                    "        self._lock.release()\n"
+                    "    def refresh(self):\n"
+                    "        self.n += 1\n"
+                ),
+            },
+            # early return path that skips the release entirely
+            {
+                "pkg/r.py": (
+                    "import threading\n"
+                    "class R:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self.n = 0\n"
+                    "    def poke(self, flag):\n"
+                    "        self._lock.acquire()\n"
+                    "        if flag:\n"
+                    "            return None\n"
+                    "        self._lock.release()\n"
+                    "        return self.n\n"
+                ),
+            },
+        ],
+        "negative": [
+            # try/finally: the release is on every path by construction
+            {
+                "pkg/r.py": (
+                    "import threading\n"
+                    "class R:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self.n = 0\n"
+                    "    def poke(self):\n"
+                    "        self._lock.acquire()\n"
+                    "        try:\n"
+                    "            self.n += 1\n"
+                    "        finally:\n"
+                    "            self._lock.release()\n"
+                ),
+            },
+            # with statement: exempt by construction
+            {
+                "pkg/r.py": (
+                    "import threading\n"
+                    "class R:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self.n = 0\n"
+                    "    def poke(self):\n"
+                    "        with self._lock:\n"
+                    "            self.n += 1\n"
+                ),
+            },
+            # assigned timeout acquire with conditional release (the
+            # facade single-flight shape): exempt — ownership flows
+            # through the boolean (documented blind spot)
+            {
+                "pkg/r.py": (
+                    "import threading\n"
+                    "class R:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self.n = 0\n"
+                    "    def try_poke(self):\n"
+                    "        ok = self._lock.acquire(timeout=1.0)\n"
+                    "        if not ok:\n"
+                    "            return False\n"
+                    "        try:\n"
+                    "            self.n += 1\n"
+                    "        finally:\n"
+                    "            self._lock.release()\n"
+                    "        return True\n"
+                ),
+            },
+        ],
+    },
 }
 
 
@@ -1266,30 +1544,48 @@ def test_unused_suppression_is_reported_as_note(tmp_path):
 
 
 def test_checked_in_suppressions_are_load_bearing(tmp_path):
-    """Flipping any one suppression off re-surfaces its finding at the
-    same file:line (the acceptance criterion for zero-findings-by-
-    suppression honesty)."""
+    """Stripping every suppression re-surfaces each finding at the same
+    file:line (the acceptance criterion for zero-findings-by-suppression
+    honesty).  The whole package is copied and linted as ONE program:
+    interprocedural findings (a blocking-under-lock witness chain that
+    crosses into executor/journal.py) cannot fire on a single file in
+    isolation, so per-file stripping would call their suppressions
+    stale."""
     marker = re.compile(r"\s*# cclint: disable=[^\n]*")
-    checked = 0
+    # the copy keeps the real package name: absolute imports
+    # (`from cruise_control_tpu.x import y`) must keep resolving inside
+    # the copied tree or every cross-module witness chain goes dark
+    target = (tmp_path / "cruise_control_tpu").resolve()
+    expected = []  # (rel path, line, rule id)
     for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG)
+        dst = target / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
         text = path.read_text()
-        if "cclint: disable=" not in text:
-            continue
         supp = parse_suppressions(str(path), text, set(RULES))
-        if not supp.by_line:
-            continue  # marker only appears inside a string literal (docs)
-        stripped = tmp_path / path.name
-        stripped.write_text(marker.sub("", text))
-        result = run_lint(paths=[str(stripped)])
-        surfaced = {(f.line, f.rule) for f in result.findings}
-        for line, rule_ids in supp.by_line.items():
-            for rule_id in rule_ids:
-                assert (line, rule_id) in surfaced, (
-                    f"{path}:{line} suppression for '{rule_id}' is stale "
-                    "— the finding no longer fires without it"
-                )
-                checked += 1
-    assert checked >= 4  # the suppressions this PR checked in
+        if supp.by_line:
+            # strip only on the suppressing lines — a marker quoted in a
+            # string literal (rule docs) must survive untouched
+            lines = text.splitlines(keepends=True)
+            for line_no, rule_ids in supp.by_line.items():
+                lines[line_no - 1] = marker.sub("", lines[line_no - 1])
+                for rule_id in rule_ids:
+                    expected.append((str(rel), line_no, rule_id))
+            text = "".join(lines)
+        dst.write_text(text)
+    assert len(expected) >= 4  # the suppressions this PR checked in
+    result = run_lint(paths=[str(target)])
+    surfaced = {
+        (str(pathlib.Path(f.path).resolve().relative_to(target)),
+         f.line, f.rule)
+        for f in result.findings
+        if pathlib.Path(f.path).is_absolute()
+    }
+    for rel, line, rule_id in expected:
+        assert (rel, line, rule_id) in surfaced, (
+            f"{rel}:{line} suppression for '{rule_id}' is stale — the "
+            "finding no longer fires without it"
+        )
 
 
 # ---- output contracts -----------------------------------------------------------
@@ -1571,6 +1867,50 @@ MUTATIONS = {
         "        ca = {k: jnp.asarray(v) for k, v in can.items()}",
         "        ca = {k: jax.device_put(v) for k, v in can.items()}",
     ),
+    # ISSUE 19 satellite: a real lock inversion planted in the facade —
+    # cache-lock outside, single-flight inside, the exact opposite of
+    # the committed proposal.single_flight → proposal.cache edge — must
+    # close a cycle in the global order graph and be caught
+    "lock-order-inversion": (
+        "lock-order",
+        "cruise_control_tpu/facade.py",
+        "        with self._cache_lock:\n"
+        "            self._cached_proposals = None",
+        "        with self._cache_lock:\n"
+        "            with self._compute_lock:\n"
+        "                pass\n"
+        "            self._cached_proposals = None",
+    ),
+    # a journal flush planted under the metric-registry lock — the
+    # exact scrape-vs-serve convoy the PR-18 snapshot-then-render fix
+    # removed — must be caught at the planted site
+    "journal-flush-under-registry-lock": (
+        "blocking-under-lock",
+        "cruise_control_tpu/utils/metrics.py",
+        "        with self._lock:\n"
+        "            timers = dict(self._timers)",
+        "        with self._lock:\n"
+        "            journal.flush()\n"
+        "            timers = dict(self._timers)",
+    ),
+    # a bare acquire() with no try/finally replacing the progress log's
+    # `with` — any raise between acquire and release exits holding
+    # operation.progress forever — must be caught
+    "release-safety-no-finally": (
+        "lock-release-safety",
+        "cruise_control_tpu/server/progress.py",
+        "        with self._lock:\n"
+        "            # finish any still-open step: steps are sequential"
+        " by contract\n"
+        "            if self._steps and self._steps[-1].end_s is None:\n"
+        "                self._steps[-1].end_s = step.start_s\n"
+        "            self._steps.append(step)",
+        "        self._lock.acquire()\n"
+        "        if self._steps and self._steps[-1].end_s is None:\n"
+        "            self._steps[-1].end_s = step.start_s\n"
+        "        self._steps.append(step)\n"
+        "        self._lock.release()",
+    ),
 }
 
 
@@ -1642,8 +1982,10 @@ def test_package_lints_clean_within_budget():
         f"cold lint pass took {cold.duration_s:.2f}s twice — the "
         "single-parse budget regressed"
     )
-    # the whole-program phase really ran (the graph is not optional)
+    # the whole-program phase really ran (the graph is not optional),
+    # CFG dataflow included (lockflow is the ISSUE 19 engine)
     assert cold.stats["graphBuildMs"] > 0.0
+    assert cold.stats["lockflowMs"] > 0.0
     warm = run_lint(paths=[str(PKG)])
     assert not warm.findings
     assert warm.stats["filesParsed"] == 0, (

@@ -1,0 +1,219 @@
+"""Lock-order graph (ISSUE 19): the runtime acquisition-order witness
+on the contention registry, the committed ``cc-tpu-lock-graph/1``
+artifact, and the reconciliation between them.
+
+Three layers:
+
+* **witness unit tests** — a private :class:`ContentionRegistry` and
+  wrapper locks pin the recorder's semantics exactly: nested
+  acquisition → edge, off → zero recording, bounded distinct edges
+  with a ``dropped`` counter, ``reset()`` clears and disables, and
+  ``Condition``/semaphore interop (both delegate to the instrumented
+  ``acquire``/``release``, so they witness for free).
+
+* **committed-artifact gate** — ``LOCK_GRAPH_r19.json`` validates
+  against the closed ``cc-tpu-lock-graph/1`` schema, matches what
+  cclint's flow-sensitive analysis derives from the live tree (locks,
+  edges, cycles), and is ACYCLIC — the static side of the deadlock
+  contract.
+
+* **runtime reconciliation** — drive the real stack (proposals,
+  rebalance, a maintenance scenario) with the witness on: every
+  observed acquisition order between NAMED locks must be an edge of
+  the committed static graph.  A dynamic edge the static analysis
+  cannot see is exactly the blind spot that turns into an
+  unexplainable production deadlock — the factory-context propagation
+  in lockflow exists because this test demanded it
+  (``proposal.single_flight → model.semaphore`` through
+  ``ModelGenerationLock``).
+"""
+
+import json
+import pathlib
+import threading
+
+from cruise_control_tpu.devtools.lint.driver import run_lint
+from cruise_control_tpu.devtools.lint.rules_lockorder import (
+    SCHEMA,
+    build_lock_graph,
+)
+from cruise_control_tpu.utils import locks
+from cruise_control_tpu.utils.locks import (
+    ContentionRegistry,
+    InstrumentedLock,
+    InstrumentedSemaphore,
+)
+from harness import full_stack
+from test_artifact_schemas import SCHEMAS, validate
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PKG = ROOT / "cruise_control_tpu"
+ARTIFACT = ROOT / "LOCK_GRAPH_r19.json"
+
+
+def _pair(reg):
+    return (InstrumentedLock("w.outer", registry=reg),
+            InstrumentedLock("w.inner", registry=reg))
+
+
+# ---- witness unit tests ---------------------------------------------------------
+def test_nested_acquisition_records_an_edge():
+    reg = ContentionRegistry()
+    outer, inner = _pair(reg)
+    reg.enable_order_witness()
+    for _ in range(3):
+        with outer:
+            with inner:
+                pass
+    w = reg.order_witness()
+    assert w["enabled"] is True
+    assert w["dropped"] == 0
+    assert w["edges"] == [{"from": "w.outer", "to": "w.inner", "count": 3}]
+
+
+def test_witness_off_records_nothing():
+    reg = ContentionRegistry()
+    outer, inner = _pair(reg)
+    with outer:
+        with inner:
+            pass
+    w = reg.order_witness()
+    assert w["enabled"] is False
+    assert w["edges"] == []
+    # acquisitions still hit the contention stats — the witness is an
+    # overlay, not a replacement
+    assert reg.stats("w.outer").acquisitions >= 1
+
+
+def test_witness_bound_drops_new_edges_but_counts_known_ones():
+    reg = ContentionRegistry()
+    a = InstrumentedLock("w.a", registry=reg)
+    b = InstrumentedLock("w.b", registry=reg)
+    c = InstrumentedLock("w.c", registry=reg)
+    reg.enable_order_witness(bound=1)
+    with a:
+        with b:
+            pass
+    with a:  # known edge: count accumulates despite the full table
+        with b:
+            pass
+    with b:  # NEW distinct edge: over the bound, dropped
+        with c:
+            pass
+    w = reg.order_witness()
+    assert w["edges"] == [{"from": "w.a", "to": "w.b", "count": 2}]
+    assert w["dropped"] == 1
+
+
+def test_reset_clears_edges_and_disables():
+    reg = ContentionRegistry()
+    outer, inner = _pair(reg)
+    reg.enable_order_witness()
+    with outer:
+        with inner:
+            pass
+    reg.reset()
+    w = reg.order_witness()
+    assert w == {"enabled": False, "edges": [], "dropped": 0}
+
+
+def test_semaphore_participates_in_the_order_vocabulary():
+    reg = ContentionRegistry()
+    lock = InstrumentedLock("w.lock", registry=reg)
+    sem = InstrumentedSemaphore(2, name="w.sem", registry=reg)
+    reg.enable_order_witness()
+    with lock:
+        sem.acquire()
+        sem.release()
+    w = reg.order_witness()
+    assert w["edges"] == [{"from": "w.lock", "to": "w.sem", "count": 1}]
+
+
+def test_condition_interop_witnesses_through_the_inner_lock():
+    # threading.Condition calls the wrapped lock's acquire/release, so
+    # a Condition over an InstrumentedLock witnesses with no extra
+    # plumbing — the admission-queue idiom
+    reg = ContentionRegistry()
+    outer = InstrumentedLock("w.outer", registry=reg)
+    cond = threading.Condition(InstrumentedLock("w.cond", registry=reg))
+    reg.enable_order_witness()
+    with outer:
+        with cond:
+            pass
+    w = reg.order_witness()
+    assert w["edges"] == [{"from": "w.outer", "to": "w.cond", "count": 1}]
+
+
+def test_reacquiring_same_name_is_not_a_self_edge():
+    reg = ContentionRegistry()
+    a1 = InstrumentedLock("w.same", registry=reg)
+    a2 = InstrumentedLock("w.same", registry=reg)  # distinct instance
+    reg.enable_order_witness()
+    with a1:
+        with a2:
+            pass
+    assert reg.order_witness()["edges"] == []
+
+
+# ---- the committed artifact -----------------------------------------------------
+def test_committed_lock_graph_matches_schema_and_live_tree():
+    committed = json.loads(ARTIFACT.read_text())
+    validate(committed, SCHEMAS[SCHEMA], ARTIFACT.name)
+    result = run_lint(paths=[str(PKG)], rules=["lock-order"])
+    live = build_lock_graph(result.project)
+    assert committed["locks"] == live["locks"], (
+        "the named-lock vocabulary drifted — regenerate via "
+        "python -m cruise_control_tpu.devtools.lint --lock-graph "
+        "LOCK_GRAPH_r19.json cruise_control_tpu"
+    )
+    assert ([(e["from"], e["to"]) for e in committed["edges"]]
+            == [(e["from"], e["to"]) for e in live["edges"]]), (
+        "the acquisition-order edge set drifted — regenerate the "
+        "committed artifact and review the new ordering"
+    )
+    # the deadlock contract itself
+    assert committed["cycles"] == [] and live["cycles"] == []
+    # every edge carries a reviewable file:line witness chain
+    for e in committed["edges"]:
+        assert e["witness"], f"edge {e['from']}→{e['to']} has no witness"
+        for hop in e["witness"]:
+            assert hop["line"] >= 1
+
+
+# ---- runtime ⊆ static reconciliation --------------------------------------------
+def test_runtime_witnessed_orders_are_static_edges():
+    """Every acquisition order the live stack exhibits must be an edge
+    the static analysis already knows.  Scope: edges between locks in
+    the committed vocabulary (unnamed locks are a documented blind
+    spot), self-edges excluded (distinct instances sharing a name)."""
+    committed = json.loads(ARTIFACT.read_text())
+    vocab = set(committed["locks"])
+    static_edges = {(e["from"], e["to"]) for e in committed["edges"]}
+
+    locks.CONTENTION.reset()
+    locks.CONTENTION.enable_order_witness()
+    try:
+        from cruise_control_tpu.sim import make_scenario, run_scenario
+
+        cc, backend, reporter = full_stack(engine="greedy")
+        cc.get_proposals()
+        cc.rebalance(dryrun=False)
+        run_scenario(make_scenario("add_broker_rebalance"))
+        w = locks.CONTENTION.order_witness()
+    finally:
+        locks.CONTENTION.reset()
+
+    witnessed = {(e["from"], e["to"]) for e in w["edges"]}
+    assert witnessed, "the drive witnessed no edges — the probe is vacuous"
+    assert w["dropped"] == 0
+    checkable = {(a, b) for a, b in witnessed
+                 if a in vocab and b in vocab and a != b}
+    # non-vacuous: the serve path's known nestings must show up
+    assert ("proposal.single_flight", "model.semaphore") in checkable
+    missing = sorted(checkable - static_edges)
+    assert not missing, (
+        f"runtime acquisition order(s) {missing} are NOT edges of the "
+        "committed static lock graph — the flow-sensitive analysis has "
+        "a blind spot (or the artifact is stale); regenerate "
+        "LOCK_GRAPH_r19.json and close the gap in lockflow.py"
+    )
