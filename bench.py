@@ -667,6 +667,38 @@ def main() -> None:
 
     whatif_batch = measure_batch(num_futures=64, best_of=3)
 
+    # sharded-scaling gate (round 20): the mesh search must PARTITION —
+    # each device holding/scanning 1/n of the pool-table rows with plans
+    # bit-identical to single-device (>=4x per-device work, measured
+    # from live NamedSharding shard buffers).  Runs in a subprocess
+    # because the virtual mesh needs the host-device-count XLA flag set
+    # before jax initializes — this process is single-device on purpose.
+    # Full matrix incl. the 1M-partition placement leg:
+    # benchmarks/SHARDED_SCALING_r20.json.
+    import subprocess
+    import tempfile
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.NamedTemporaryFile(suffix=".json") as tf:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(root, "benchmarks", "sharded_large_dryrun.py"),
+             "--scaling-out", tf.name,
+             "--scaling-scales", "24x600x6",
+             "--scaling-placement", "200x5000x20"],
+            env=dict(os.environ, PYTHONPATH=root, JAX_PLATFORMS="cpu",
+                     CC_TPU_CACHE_CPU_EXECUTABLES="1",
+                     PALLAS_AXON_POOL_IPS=""),
+            capture_output=True, text=True, timeout=1800,
+        )
+        if proc.returncode != 0:
+            raise SystemExit(
+                "sharded scaling gate run failed:\n"
+                + proc.stdout[-2000:] + proc.stderr[-2000:])
+        with open(tf.name) as f:
+            scaling_art = json.load(f)
+    scaling_head = scaling_art["headline"]
+
     phases = _full_path_phases()
     tracing.configure(enabled=False)
 
@@ -742,6 +774,17 @@ def main() -> None:
                     "batchSize": whatif_batch["batchSize"],
                     "batchedWallS": whatif_batch["batchedWallS"],
                     "singlePlanWallS": whatif_batch["singlePlanWallS"],
+                },
+                # sharded search partitions the work: min per-device
+                # work speedup across scales (>=4x gate), plans
+                # bit-identical (full matrix: SHARDED_SCALING_r20.json)
+                "sharded_scaling": {
+                    "per_device_work_speedup": scaling_head[
+                        "min_across_scales"],
+                    "gate": scaling_head["gate"],
+                    "plan_identical": scaling_head[
+                        "plan_identical_all_scales"],
+                    "ok": bool(scaling_head["ok"]),
                 },
                 # the tier-1 soak smoke: all gates green + wall budget
                 "soak_smoke": {

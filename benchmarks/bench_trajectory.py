@@ -53,6 +53,9 @@ NORTHSTAR_MAX_S = 0.50
 VS_BASELINE_MIN = 2.0
 #: settled warm replans must stay at least this much faster than cold
 REPLAN_SETTLE_MIN = 10.0
+#: sharded search: min per-device work speedup across scales (round 20;
+#: plans must also stay bit-identical — folded into the same verdict)
+SHARDED_WORK_MIN = 4.0
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -114,6 +117,16 @@ def gate_verdicts(rec: dict) -> Dict[str, Tuple[float, bool]]:
             bool(soak.get("all_ok"))
             and w <= float(soak.get("budget_s", 120.0)),
         )
+    sharded = rec.get("sharded_scaling")
+    if isinstance(sharded, dict) \
+            and sharded.get("per_device_work_speedup") is not None:
+        s = float(sharded["per_device_work_speedup"])
+        out["sharded_scaling"] = (
+            s,
+            s >= float(sharded.get("gate", SHARDED_WORK_MIN))
+            and bool(sharded.get("plan_identical"))
+            and bool(sharded.get("ok")),
+        )
     return out
 
 
@@ -143,6 +156,8 @@ def render(rounds: List[Tuple[int, dict]]) -> str:
         ("whatif_batch_ratio", "whatif batch × (<2)"),
         ("replan_settle_speedup", f"settle × (≥{REPLAN_SETTLE_MIN:g})"),
         ("soak_smoke", "soak smoke s (green, ≤budget)"),
+        ("sharded_scaling",
+         f"shard work × (≥{SHARDED_WORK_MIN:g}, plans =)"),
     ]
     lines = [
         "# Perf trajectory — every committed driver-bench round",

@@ -140,20 +140,19 @@ def main() -> None:
         )
     fn = T._cached_scan_fn(cfg, K, D, args.steps, mesh)
 
-    def tables():
-        # cold pool-row tables (the cross-call diet carry): invalid, so
-        # the traced call's first repool is the full rebuild r04 measured
-        return (jax.numpy.zeros((P, S), jax.numpy.float32),
-                jax.numpy.zeros((P, S), jax.numpy.float32),
-                jax.numpy.zeros(P, bool), np.False_)
-
+    # cold pool-row tables: omitted (tables=None), so the scan entry
+    # builds invalid placed zeros — sharded across the mesh when the
+    # table carry is — and the traced call's first repool is the full
+    # rebuild r04 measured.  donate_carry consumes the input model and
+    # tables, so the traced call gets a fresh (bit-identical) upload.
     print("warming (compile or cache load)...", file=sys.stderr)
-    sync(fn(m, ca, np.int32(args.steps), tables()))
+    sync(fn(m, ca, np.int32(args.steps)))
+    m = opt._device_model(ctx)
 
     t0 = time.perf_counter()
     # the repo's ONE raw-profiler entry point (cclint profiler-discipline)
     with kb.profiler_session(args.trace_dir):
-        packed, m2, _tab = fn(m, ca, np.int32(args.steps), tables())
+        packed, m2, _tab = fn(m, ca, np.int32(args.steps))
         sync(packed)
     wall_s = time.perf_counter() - t0
 

@@ -30,11 +30,29 @@ the trace can hold — the committed ``benchmarks/MESH_BUDGET_r17.json``
 records its reduced fixture in the artifact; the decomposition protocol
 is shape-independent.
 
+``--scaling-out`` (round-20) runs the multi-scale scaling matrix instead
+of the single parity pair and writes a ``cc-tpu-sharded-scaling/1``
+artifact.  Per scale it measures three legs — single device, replicated
+mesh (``shard_tables=False``: every lane redoes full-width work, the
+pre-round-20 behaviour), sharded mesh — plus a placement-only leg at
+10k brokers / 1M partitions (model + tables built on the mesh, shard
+shapes read from the live ``NamedSharding`` buffers, one scan call
+executed; a full search at that shape is out of budget on this host).
+Honest-metric note baked into the artifact: the 8 "devices" timeshare
+ONE host core, so sharded wall-clock cannot beat single-device here and
+traced self-times absorb lane spin-waits; the backend-independent claim
+is the measured per-device WORK partition — each device holds and scans
+1/N of the [Pg, S] table rows (read from live shard buffers, not
+derived) with plans bit-identical — corroborated on walls by the
+sharded mesh beating the replicated mesh at every measured scale.
+
 Usage (fresh process; forces the virtual CPU platform):
     PYTHONPATH=. python benchmarks/sharded_large_dryrun.py \
         [--devices 8] [--brokers 1000] [--partitions 50000] \
         [--out SHARDED_DRYRUN_r05.json] \
-        [--mesh-out MESH_BUDGET_r17.json] [--mesh-scans 2]
+        [--mesh-out MESH_BUDGET_r17.json] [--mesh-scans 2] \
+        [--scaling-out SHARDED_SCALING_r20.json] \
+        [--scaling-scales 64x512x8,200x5000x20] [--scaling-placement ...]
 """
 
 from __future__ import annotations
@@ -44,6 +62,192 @@ import json
 import os
 import sys
 import time
+
+#: drive-loop knobs for the scaling matrix: the kernel-capture diet
+#: (small calls, tight pools) so every scale fits one process budget;
+#: the work-partition claim is knob-independent, and walls compare
+#: like-for-like because all legs of a scale share the config
+SCALING_CFG = dict(
+    steps_per_call=4, repool_steps=2, device_batch_per_step=4,
+    max_source_replicas=64, max_dest_brokers=8, repool_rows_budget=16,
+)
+
+
+def _parse_scales(spec: str):
+    """``"64x512x8,200x5000x20"`` → [(brokers, partitions, racks), ...]."""
+    out = []
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        b, p, r = (int(x) for x in part.strip().split("x"))
+        out.append((b, p, r))
+    return out
+
+
+def measure_scaling(devices, seed, scales, placement, replicated_max_p):
+    """Run the scaling matrix; return a cc-tpu-sharded-scaling/1 dict.
+
+    Caller must have set the host-device-count XLA flag BEFORE importing
+    jax (fresh-process contract, same as the parity run)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from cruise_control_tpu.analyzer import tpu_optimizer as T
+    from cruise_control_tpu.analyzer.context import AnalyzerContext
+    from cruise_control_tpu.analyzer.goal_optimizer import make_goals
+    from cruise_control_tpu.analyzer.tpu_optimizer import (
+        TpuGoalOptimizer,
+        TpuSearchConfig,
+    )
+    from cruise_control_tpu.analyzer.verifier import verify_result
+    from cruise_control_tpu.models.generators import random_cluster
+
+    mesh = Mesh(np.array(jax.devices()[:devices]), ("search",))
+    goals = make_goals()
+
+    def plan(result):
+        return [
+            (a.action_type.name, a.partition, a.slot, a.source_broker,
+             a.dest_broker) for a in result.actions
+        ]
+
+    def shard_partition(state, shard_tables):
+        """Rows per device read from LIVE cold-table shard buffers."""
+        cfg = TpuSearchConfig(shard_tables=shard_tables, **SCALING_CFG)
+        opt = TpuGoalOptimizer(config=cfg, mesh=mesh)
+        ctx = AnalyzerContext(state)
+        m = opt._device_model(ctx)
+        K, D = opt._pool_sizes(ctx.num_partitions, ctx.max_rf,
+                               ctx.num_brokers)
+        fn = T._cached_scan_fn(cfg, K, D, cfg.steps_per_call, mesh)
+        tab = fn.cold_tables(m)
+        rows = sorted({s.data.shape[0] for s in tab[0].addressable_shards})
+        return {
+            "table_rows_global": int(tab[0].shape[0]),
+            "table_rows_per_device": int(rows[-1]),
+            "table_shards": len(tab[0].addressable_shards),
+            "candidate_rows_global": int(K),
+            "candidate_rows_per_device": -(-int(K) // devices),
+        }, (m, fn, tab, ctx)
+
+    measured = []
+    for brokers, partitions, racks in scales:
+        state = random_cluster(
+            seed=seed, num_brokers=brokers, num_racks=racks,
+            num_partitions=partitions, mean_utilization=0.45,
+        )
+        legs = {}
+        plans = {}
+        leg_specs = [("single", None, True),
+                     ("replicated_mesh", mesh, False),
+                     ("sharded_mesh", mesh, True)]
+        if partitions > replicated_max_p:
+            # the replicated A/B leg costs ~8x single-device work on the
+            # one-core host; cap it to the mid scales (logged, not silent)
+            leg_specs = [s for s in leg_specs if s[0] != "replicated_mesh"]
+            print(f"scaling: {brokers}b/{partitions}p: skipping "
+                  "replicated_mesh leg (past --scaling-replicated-max-p)",
+                  file=sys.stderr)
+        for name, m_, shard_tab in leg_specs:
+            cfg = TpuSearchConfig(shard_tables=shard_tab, **SCALING_CFG)
+            t0 = time.perf_counter()
+            res = TpuGoalOptimizer(config=cfg, mesh=m_).optimize(state)
+            wall = time.perf_counter() - t0
+            legs[name] = {"wall_s": round(wall, 1),
+                          "actions": len(res.actions)}
+            plans[name] = plan(res)
+            print(f"scaling: {brokers}b/{partitions}p {name}: "
+                  f"{wall:.1f}s, {len(res.actions)} actions",
+                  file=sys.stderr)
+        verify_result(state, res, goals)  # sharded leg runs last
+        shard, _ = shard_partition(state, shard_tables=True)
+        ref = plans["single"]
+        row = {
+            "fixture": {"brokers": brokers, "partitions": partitions,
+                        "racks": racks, "seed": seed},
+            "legs": legs,
+            "plan_identical": all(p == ref for p in plans.values()),
+            "shard": shard,
+            "per_device_work_speedup": round(
+                partitions / shard["table_rows_per_device"], 2),
+        }
+        if "replicated_mesh" in legs:
+            row["mesh_wall_speedup_vs_replicated"] = round(
+                legs["replicated_mesh"]["wall_s"]
+                / max(legs["sharded_mesh"]["wall_s"], 1e-9), 2)
+        measured.append(row)
+
+    # placement leg: the 10k-broker/1M-partition dry run.  Build the
+    # sharded model + tables for real, read the live shard shapes, and
+    # execute ONE sharded scan call end to end; a full search at this
+    # shape exceeds the single-core budget (recorded, not hidden).
+    pb, pp, pr = placement
+    state = random_cluster(
+        seed=seed, num_brokers=pb, num_racks=pr, num_partitions=pp,
+        mean_utilization=0.45,
+    )
+    shard, (m, fn, tab, ctx) = shard_partition(state, shard_tables=True)
+    ca = {k: jnp.asarray(v)
+          for k, v in TpuGoalOptimizer(
+              config=TpuSearchConfig(**SCALING_CFG), mesh=mesh,
+          )._constraint_arrays_np(ctx).items()}
+    t0 = time.perf_counter()
+    out = fn(m, ca, np.int32(SCALING_CFG["steps_per_call"]), tab)
+    jax.block_until_ready(out)
+    call_s = time.perf_counter() - t0
+    placement_row = {
+        "fixture": {"brokers": pb, "partitions": pp, "racks": pr,
+                    "seed": seed},
+        "mode": "placement+one-scan-call",
+        "shard": shard,
+        "scan_call_s": round(call_s, 1),
+        "per_device_work_speedup": round(
+            pp / shard["table_rows_per_device"], 2),
+        "note": "full search at this shape exceeds the one-core host "
+                "budget; the leg proves the sharded path BUILDS and RUNS "
+                "at 1M partitions with 1/N rows per device",
+    }
+    print(f"scaling: placement {pb}b/{pp}p: "
+          f"{shard['table_rows_per_device']} rows/device, "
+          f"one scan call {call_s:.1f}s", file=sys.stderr)
+
+    speedups = [r["per_device_work_speedup"] for r in measured]
+    return {
+        "schema": "cc-tpu-sharded-scaling/1",
+        "generated_unix": round(time.time(), 3),
+        "backend": jax.default_backend(),
+        "host_sim": True,
+        "caveat": (
+            "the mesh devices are host-simulated and timeshare one CPU "
+            "core: sharded wall-clock cannot beat single-device here, "
+            "and traced self-times absorb lane spin-waits.  The "
+            "backend-independent measurement is the per-device work "
+            "partition (shard rows read from live NamedSharding "
+            "buffers, plans bit-identical); walls corroborate it via "
+            "the sharded-vs-replicated mesh A/B at every scale that "
+            "carries both legs."
+        ),
+        "devices": devices,
+        "config": dict(SCALING_CFG),
+        "scales": measured,
+        "placement": placement_row,
+        "headline": {
+            "metric": "per_device_work_speedup",
+            "definition": "partitions / measured table rows per device "
+                          "(single-device scans the full [P,S] axis; "
+                          "each mesh lane scans its shard)",
+            "min_across_scales": min(speedups),
+            "gate": 4.0,
+            "plan_identical_all_scales": all(
+                r["plan_identical"] for r in measured),
+            "ok": bool(min(speedups) >= 4.0
+                       and all(r["plan_identical"] for r in measured)),
+        },
+    }
 
 
 def main() -> None:
@@ -64,12 +268,52 @@ def main() -> None:
         "--mesh-scans", type=int, default=2,
         help="scan calls to trace per run for the --mesh-out capture",
     )
+    ap.add_argument(
+        "--scaling-out", default="",
+        help="run the multi-scale scaling matrix INSTEAD of the single "
+        "parity pair and write a cc-tpu-sharded-scaling/1 artifact",
+    )
+    ap.add_argument(
+        "--scaling-scales", default="64x512x8,200x5000x20,1000x50000x40",
+        help="comma list of brokers x partitions x racks for the "
+        "measured (full-search) scaling legs",
+    )
+    ap.add_argument(
+        "--scaling-placement", default="10000x1000000x80",
+        help="brokers x partitions x racks for the placement-only leg",
+    )
+    ap.add_argument(
+        "--scaling-replicated-max-p", type=int, default=5000,
+        help="skip the replicated-mesh A/B leg above this partition "
+        "count (it redoes full-width work on every lane)",
+    )
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + f" --xla_force_host_platform_device_count={args.devices}"
     ).strip()
+
+    if args.scaling_out:
+        from cruise_control_tpu.utils.jit_cache import (
+            enable as enable_cache,
+        )
+
+        enable_cache()
+        art = measure_scaling(
+            devices=args.devices, seed=args.seed,
+            scales=_parse_scales(args.scaling_scales),
+            placement=_parse_scales(args.scaling_placement)[0],
+            replicated_max_p=args.scaling_replicated_max_p,
+        )
+        with open(args.scaling_out, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        print(json.dumps(art["headline"], indent=1))
+        if not art["headline"]["ok"]:
+            raise SystemExit("sharded scaling gate failed")
+        return
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -193,6 +437,16 @@ def main() -> None:
                 term: round(v / loss_s, 4) if loss_s else 0.0
                 for term, v in by_term.items()
             },
+            # on the host-thunk dialect a lane's "busy" is its executor
+            # thread's wall — on a timeshared core that absorbs the
+            # other lanes' turns, so busy_scaling stays large here even
+            # after the round-20 table/candidate sharding partitioned
+            # the actual work 1/n per device (SHARDED_SCALING_r20.json
+            # measures the partition from live shard buffers; rerun
+            # --mesh-out on real hardware for a clean busy term)
+            "busy_term_caveat": "host-thunk busy = lane thread wall "
+                                "(timeshared core); see "
+                                "SHARDED_SCALING_r20.json",
         }
         with open(args.mesh_out, "w") as f:
             json.dump(mesh_art, f, indent=1)

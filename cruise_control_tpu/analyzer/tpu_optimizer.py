@@ -71,8 +71,11 @@ from cruise_control_tpu.ops.grid import gather_pload as _gather_pload
 from cruise_control_tpu.ops.pools import (
     POOL_RACK_PRIO,
     pool_prio,
+    pool_prio_rows,
     pool_row_tables,
+    pool_row_tables_rows,
     pool_row_tables_update,
+    pool_row_tables_update_rows,
 )
 from cruise_control_tpu.telemetry import (
     device_stats,
@@ -355,6 +358,28 @@ class TpuSearchConfig:
     #: 4.47 → ~0.6 ms/step (grid fused into the PartialReduce), final
     #: score 10 268 → 10 256 (better, and inside run-to-run noise)
     topk_mode: str = "approx"
+    #: shard the [P, S] pool row tables and the pool/leadership priority
+    #: build across the mesh (round-20 busy-scaling fix).  Each device
+    #: keeps a 1/n partition block of the row tables in the search carry
+    #: (NamedSharding over the search axis — never replicated), rebuilds
+    #: and incrementally refreshes ONLY its block, and computes its slab
+    #: of the [P, S] priorities; one all_gather reassembles the priority
+    #: for the REPLICATED top-k selection, so pools — and therefore plans
+    #: — stay bit-identical to single-device at any mesh size.  The mesh
+    #: observatory's busy_scaling term measured every lane redoing the
+    #: full [P, S]-scale rebuild under replication (+213.5 s of the
+    #: +224.8 s sharded loss, MESH_BUDGET_r17); this is the majority term
+    #: it collapses.  Ignored without a mesh.
+    shard_tables: bool = True
+    #: donate the scan-call carry buffers (device model + pool row tables
+    #: + touched set) to the compiled call, so XLA reuses their memory for
+    #: the updated outputs instead of holding both generations live —
+    #: the still-open KERNEL_BUDGET_r04 item.  The drive loop never
+    #: touches a donated buffer again (it chains the freshest outputs;
+    #: rejection resyncs rebuild from the live context), so plans are
+    #: bit-identical either way.  The OFF setting keeps inputs alive —
+    #: the A/B lever the live-bytes measurement uses.
+    donate_carry: bool = True
 
 
 # ---------------------------------------------------------------------------------
@@ -654,9 +679,20 @@ def _build_round_pools(
     touched-row-refreshed tables here; ``None`` recomputes from scratch
     (score-only rounds, first build).
     """
-    P, S = m.assignment.shape
     size, base = tables if tables is not None else pool_row_tables(m)
     prio = pool_prio(m, ca, size, base)
+    forced = jnp.any(m.must_move) | jnp.any(base >= POOL_RACK_PRIO)
+    return _select_round_pools(m, K, D, prio, forced)
+
+
+def _select_round_pools(
+    m: DeviceModel, K: int, D: int, prio: jax.Array, forced: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Replicated pool selection over a full [P, S] priority.  The sharded
+    build computes ``prio`` as per-device slabs and all_gathers before
+    calling here, so the selection input — and therefore the pools — is
+    bit-identical at any mesh size."""
+    S = m.assignment.shape[1]
     # Pool selection must be EXACT top-k whenever forced-priority
     # candidates exist — must-move (offline) replicas AND rack-violating
     # replicas both repair hard goals, and approx_max_k keeps one entry
@@ -664,10 +700,10 @@ def _build_round_pools(
     # (hard-goal failure).  Without forced candidates the pool is a recall
     # heuristic and the approx kernel is several times faster on the P·S
     # axis.  ``base`` carries the bonuses, so "any eligible rack repair or
-    # must-move row" reads off the stored table.
+    # must-move row" reads off the stored table (``forced``).
     flat = prio.reshape(-1)
     _, flat_idx = jax.lax.cond(
-        jnp.any(m.must_move) | jnp.any(base >= POOL_RACK_PRIO),
+        forced,
         lambda f: jax.lax.top_k(f, K),
         lambda f: jax.lax.approx_max_k(f, K),
         flat,
@@ -895,6 +931,12 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
     repool = max(1, cfg.repool_steps)
     axis = mesh.axis_names[0] if mesh is not None else None
     n_dev = mesh.shape[axis] if mesh is not None else 1
+    # round-20: the pool row tables — and the whole [P, S]-scale pool
+    # build — shard over the search axis instead of replicating.  The
+    # carried tables live at GLOBAL shape [Pg, S] (Pg = n·ceil(P/n), a
+    # padded device multiple) under NamedSharding; inside shard_map each
+    # device sees only its [Pl, S] block and rebuilds/refreshes only that.
+    shard_tab = axis is not None and cfg.shard_tables
 
     def step(carry):
         (m, ca, done, t, count, out, counts, pools, pt, since_pool, sc, tb,
@@ -910,11 +952,49 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         # small fixtures keep the lean full-rebuild program.
         RB_POOL = min(P, cfg.repool_rows_budget)
         incr_repool = cfg.repool_incremental and RB_POOL < P
+        if shard_tab:
+            # this device's partition block: size_t/base_t arrive at the
+            # LOCAL [Pl, S] block shape (shard_map splits the [Pg, S]
+            # carry); prow maps local row -> global partition, clamped at
+            # the edge (preal masks the clamp-duplicated tail rows out of
+            # the touched set — their stored values are never selected:
+            # the gathered priority slices [:P])
+            Pl = size_t.shape[0]
+            pr_base = (
+                jax.lax.axis_index(axis) * Pl
+                + jnp.arange(Pl, dtype=jnp.int32)
+            )
+            prow = jnp.clip(pr_base, 0, P - 1)
+            preal = pr_base < P
 
         def keep_pools():
             return pools, size_t, base_t, pt_valid, jnp.int32(0)
 
         def rebuild_pools():
+            if shard_tab:
+                # shard-local diet: the global decision (sum of the
+                # replicated [P] touched set vs the budget) matches the
+                # single-device predicate bit-for-bit, and when it holds
+                # every shard's local touched count is <= the budget too,
+                # so the local refresh covers every touched row (exact)
+                if incr_repool:
+                    can_incr = pt_valid & (jnp.sum(tpp) <= RB_POOL)
+                    sz, bs = jax.lax.cond(
+                        can_incr,
+                        lambda: pool_row_tables_update_rows(
+                            m, size_t, base_t, tpp[prow] & preal, prow,
+                            min(Pl, RB_POOL),
+                        ),
+                        lambda: pool_row_tables_rows(m, prow),
+                    )
+                    was_incr = can_incr.astype(jnp.int32)
+                else:
+                    sz, bs = pool_row_tables_rows(m, prow)
+                    was_incr = jnp.int32(0)
+                return (
+                    _build_pools_sharded(m, ca, K, D, sz, bs, prow, axis),
+                    sz, bs, jnp.bool_(True), was_incr,
+                )
             if incr_repool:
                 can_incr = pt_valid & (jnp.sum(tpp) <= RB_POOL)
                 sz, bs = jax.lax.cond(
@@ -1388,44 +1468,103 @@ def _cached_scan_fn(cfg: TpuSearchConfig, K: int, D: int, T: int,
         return (jnp.concatenate([out, meta], axis=1), m,
                 (size_t, base_t, tpp_out))
 
-    def _cold_tables(m: DeviceModel):
-        P, S = m.assignment.shape
-        z = jnp.zeros((P, S), jnp.float32)
-        return z, z, jnp.zeros(P, bool), jnp.bool_(False)
+    #: carried-table leading dim as the HOST sees it: the global padded
+    #: device multiple when the tables shard, P otherwise
+    def _table_rows(P: int) -> int:
+        return n_dev * (-(-P // n_dev)) if shard_tab else P
 
-    def run(m: DeviceModel, ca, t_cap=None, tables=None):
-        # t_cap omitted (benchmarks, unbudgeted runs) = uncapped; a jnp
-        # scalar binds by shape, so every capped call shares one executable
-        if t_cap is None:
-            t_cap = jnp.int32(T)
-        if tables is None:
-            tables = _cold_tables(m)
-        return run_capped(m, ca, t_cap, *tables)
+    def _cold_tables(m: DeviceModel):
+        # distinct arrays on purpose: size and base are donated separately,
+        # and a buffer may only be donated once per call.  On a mesh the
+        # zeros are created ALREADY placed (NamedSharding) — partitioned
+        # when the tables shard, replicated otherwise — so cold calls work
+        # on multi-process meshes too (no auto-resharding of a committed
+        # single-device array) and the replication audit sees the tables'
+        # true layout from the first call on.
+        P, S = m.assignment.shape
+        rows = _table_rows(P)
+        if mesh is None:
+            return (jnp.zeros((rows, S), jnp.float32),
+                    jnp.zeros((rows, S), jnp.float32),
+                    jnp.zeros(P, bool), np.False_)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        tsh = NamedSharding(
+            mesh, PartitionSpec(axis) if shard_tab else PartitionSpec()
+        )
+        rsh = NamedSharding(mesh, PartitionSpec())
+        return (jnp.zeros((rows, S), jnp.float32, device=tsh),
+                jnp.zeros((rows, S), jnp.float32, device=tsh),
+                jnp.zeros(P, bool, device=rsh), np.False_)
 
     if mesh is None:
-        return device_stats.instrument("analyzer.scan_fn", jax.jit(run))
+        flat = run_capped
+    else:
+        from jax.sharding import PartitionSpec
 
-    from jax.sharding import PartitionSpec
+        from cruise_control_tpu.parallel.mesh import shard_map_norep
 
-    from cruise_control_tpu.parallel.mesh import shard_map_norep
+        # model + constraints replicated in, results replicated out; the
+        # candidate scoring shards inside the loop (see
+        # _reduced_candidates) and — round 20 — so do the pool row tables:
+        # their carry crosses the call boundary PARTITIONED over the
+        # search axis (NamedSharding via the specs below), so each lane
+        # holds 1/n of the [Pg, S] tables and chained calls never gather,
+        # rereplicate, or touch the host with them
+        rep = PartitionSpec()
+        tabspec = PartitionSpec(axis) if shard_tab else rep
+        flat = shard_map_norep(
+            run_capped, mesh,
+            in_specs=(rep, rep, rep, tabspec, tabspec, rep, rep),
+            out_specs=(rep, rep, (tabspec, tabspec, rep)),
+        )
 
-    # model + constraints replicated in, results replicated out; the
-    # sharding happens inside the loop (see _reduced_candidates)
-    rep = PartitionSpec()
-    sharded = shard_map_norep(
-        run_capped, mesh,
-        in_specs=(rep, rep, rep, rep, rep, rep, rep),
-        out_specs=(rep, rep, (rep, rep, rep)),
-    )
+    # scan-carry donation (round-20 satellite, KERNEL_BUDGET_r04's open
+    # item): the model and the pool-table carry are dead to the caller
+    # the moment a call is dispatched on them — the drive loop always
+    # chains the newest outputs and resyncs from the live context after a
+    # rejection — so donating them lets XLA alias the updated outputs
+    # into the inputs' buffers instead of holding both generations live.
+    # valid0 (a host scalar) and t_cap stay undonated.
+    donate = (0, 3, 4, 5) if cfg.donate_carry else ()
+    jfn = jax.jit(flat, donate_argnums=donate)
 
-    def run_sharded(m: DeviceModel, ca, t_cap=None, tables=None):
+    def entry(m: DeviceModel, ca, t_cap=None, tables=None):
+        # t_cap omitted (benchmarks, unbudgeted runs) = uncapped; a scalar
+        # binds by shape, so every capped call shares one executable.
+        # Cold tables are created OUTSIDE the jit (already placed/sharded
+        # zeros), keeping the donation argnums meaningful on every call.
         if t_cap is None:
-            t_cap = jnp.int32(T)
+            t_cap = np.int32(T)
         if tables is None:
             tables = _cold_tables(m)
-        return sharded(m, ca, t_cap, *tables)
+        return jfn(m, ca, t_cap, *tables)
 
-    return device_stats.instrument("analyzer.scan_fn", jax.jit(run_sharded))
+    def _entry_lower(m, ca, t_cap=None, tables=None):
+        # AOT mirror of entry() for the device-cost capture path
+        # (telemetry/device_cost.py does ``fn.lower(shapes).compile()``
+        # off a shape skeleton of a real call): fill the same defaults,
+        # but as ShapeDtypeStructs — no device arrays are created.  The
+        # compiled stats expose donation as ``alias_size_in_bytes``.
+        if t_cap is None:
+            t_cap = jax.ShapeDtypeStruct((), jnp.int32)
+        if tables is None:
+            P, S = m.assignment.shape
+            rows = _table_rows(P)
+            tables = (jax.ShapeDtypeStruct((rows, S), jnp.float32),
+                      jax.ShapeDtypeStruct((rows, S), jnp.float32),
+                      jax.ShapeDtypeStruct((P,), jnp.bool_),
+                      jax.ShapeDtypeStruct((), jnp.bool_))
+        return jfn.lower(m, ca, t_cap, *tables)
+
+    entry.lower = _entry_lower
+    # jit-cache introspection (tests assert one executable per scan fn)
+    entry._cache_size = jfn._cache_size
+    # the drive loop pre-builds cold tables OUTSIDE the kernel-budget
+    # capture window so the traced scan calls keep their steady-state
+    # transfer profile (the mesh-budget h2d gate counts per-call)
+    entry.cold_tables = _cold_tables
+    return device_stats.instrument("analyzer.scan_fn", entry)
 
 
 def _fetch_scan_result(packed, T: int):
@@ -1978,14 +2117,10 @@ def _leadership_pool_size(P: int, S: int, K: int) -> int:
     return min(P * S, max(K, 4096))
 
 
-def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
-    """Top-L leadership candidates (p, s) by the current leader broker's
-    stress — the analog of the move source pool.  Priority: max resource
-    utilization of the leader's broker + its leader-NW-in utilization
-    (what a leadership transfer can actually relieve)."""
-    P, S = m.assignment.shape
-    lb = jnp.take_along_axis(m.assignment, m.leader_slot[:, None], axis=1)[:, 0]
-    lb_c = jnp.clip(lb, 0)
+def _leadership_prio_terms(m: DeviceModel, ca) -> Tuple[jax.Array, jax.Array]:
+    """Replicated [B]-scale terms of the leadership-pool priority →
+    (stress [B], ltab [B, 2]).  Cheap on every device; the [P, S]-scale
+    gather/combine shards (see :func:`_leadership_prio_rows`)."""
     cap = jnp.maximum(m.capacity, 1e-9)
     util = m.broker_load / cap                              # [B, R]
     # leader-count pressure keeps lcount-bound repairs in the pool even when
@@ -2006,19 +2141,45 @@ def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
     ltab = jnp.stack(
         [lc_need, m.lead_ok.astype(jnp.float32)], axis=1
     )                                                        # [B, 2]
-    g2 = ltab[jnp.clip(m.assignment, 0)]                     # [P, S, 2]
-    prio = stress[lb_c][:, None] + g2[..., 0]                # [P, S]
+    return stress, ltab
+
+
+def _leadership_prio_rows(
+    stress, ltab, row, lslot, must, excl
+) -> jax.Array:
+    """[N, S] leadership-pool priority (-inf = invalid) for the partition
+    rows whose sliced model columns are passed in (``row`` =
+    ``m.assignment[rows]`` etc.) — the full build passes the whole arrays.
+    Pure in the slices, so per-device slabs gather to the bit-identical
+    full priority."""
+    S = row.shape[1]
+    lb = jnp.take_along_axis(row, lslot[:, None], axis=1)[:, 0]
+    lb_c = jnp.clip(lb, 0)
+    g2 = ltab[jnp.clip(row, 0)]                              # [N, S, 2]
+    prio = stress[lb_c][:, None] + g2[..., 0]                # [N, S]
     # mirror lead_feasible's static terms (_score_candidates) so the pruned
     # pool never fills with always-infeasible candidates, starving feasible
     # transfers that the full grid would have scored
     valid = (
-        (m.assignment != EMPTY_SLOT)
-        & (jnp.arange(S)[None, :] != m.leader_slot[:, None])
-        & ~m.excluded[:, None]
-        & ~m.must_move
+        (row != EMPTY_SLOT)
+        & (jnp.arange(S)[None, :] != lslot[:, None])
+        & ~excl[:, None]
+        & ~must
         & (g2[..., 1] > 0.0)
     )
-    flat = jnp.where(valid, prio, -jnp.inf).reshape(-1)
+    return jnp.where(valid, prio, -jnp.inf)
+
+
+def _leadership_pool(m: DeviceModel, ca, L: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-L leadership candidates (p, s) by the current leader broker's
+    stress — the analog of the move source pool.  Priority: max resource
+    utilization of the leader's broker + its leader-NW-in utilization
+    (what a leadership transfer can actually relieve)."""
+    S = m.assignment.shape[1]
+    stress, ltab = _leadership_prio_terms(m, ca)
+    flat = _leadership_prio_rows(
+        stress, ltab, m.assignment, m.leader_slot, m.must_move, m.excluded
+    ).reshape(-1)
     # approximate pool selection — see the note in _build_round_pools
     _, idx = jax.lax.approx_max_k(flat, L)
     return (idx // S).astype(jnp.int32), (idx % S).astype(jnp.int32)
@@ -2054,6 +2215,51 @@ def _build_pools(m: DeviceModel, cfg: TpuSearchConfig, ca, K: int, D: int,
     P, S = m.assignment.shape
     kp, ks, dest_pool = _build_round_pools(m, ca, K, D, tables=tables)
     lp, lsl = _leadership_pool(m, ca, _leadership_pool_size(P, S, K))
+    return kp, ks, dest_pool, lp, lsl
+
+
+def _build_pools_sharded(
+    m: DeviceModel, ca, K: int, D: int, size_l, base_l, prow, axis
+):
+    """Sharded twin of :func:`_build_pools` (inside shard_map only).
+
+    Compute sharded, select replicated: each device evaluates the move and
+    leadership priorities ONLY for its 1/n partition block (``size_l`` /
+    ``base_l`` = its local row tables, ``prow`` = its global row ids,
+    edge-clamped at row P-1), then ONE all_gather per
+    table reassembles the full [P, S] priorities and the SAME replicated
+    top-k/approx selection as the single-device build runs on them.  The
+    per-row arithmetic is elementwise identical (ops.pools keeps both
+    paths on shared helpers), so the gathered priorities — and therefore
+    the pools and the plan — are bit-identical at any mesh size; what
+    shrinks 1/n is the [P, S, S] rack scan and the [P, S]-scale gathers,
+    the busy_scaling majority term of MESH_BUDGET_r17.
+
+    Exact-top-k forcing needs one bit of cross-shard agreement (a local
+    slab can't see another shard's rack-repair bonus): the local flag
+    rides a pmax.  Clamp-duplicated edge rows copy real row P-1, so they
+    can't force spuriously, and the gather's [:P] slice drops them before
+    selection."""
+    P, S = m.assignment.shape
+    prio_l = pool_prio_rows(m, ca, size_l, base_l, prow)
+    stress, ltab = _leadership_prio_terms(m, ca)
+    lprio_l = _leadership_prio_rows(
+        stress, ltab, m.assignment[prow], m.leader_slot[prow],
+        m.must_move[prow], m.excluded[prow],
+    )
+
+    def gather(x):
+        return jax.lax.all_gather(x, axis, axis=0, tiled=True)[:P]
+
+    prio = gather(prio_l)                                    # [P, S]
+    lflat = gather(lprio_l).reshape(-1)
+    forced_l = jnp.any(base_l >= POOL_RACK_PRIO).astype(jnp.int32)
+    forced = jnp.any(m.must_move) | (jax.lax.pmax(forced_l, axis) > 0)
+    kp, ks, dest_pool = _select_round_pools(m, K, D, prio, forced)
+    L = _leadership_pool_size(P, S, K)
+    _, idx = jax.lax.approx_max_k(lflat, L)
+    lp = (idx // S).astype(jnp.int32)
+    lsl = (idx % S).astype(jnp.int32)
     return kp, ks, dest_pool, lp, lsl
 
 
@@ -2996,7 +3202,9 @@ class TpuGoalOptimizer:
         )
         m = _recompute_aggregates(m)
         tab = None
-        if carry.tables is not None:
+        if carry.tables is not None and (
+            carry.tables[0].shape[0] == self._carry_table_rows(P)
+        ):
             # rows whose pool-table inputs may differ from the carried
             # tables: the delta's dirty rows, rows touched after the
             # tables were captured, and any row with must-move flags on
@@ -3016,6 +3224,18 @@ class TpuGoalOptimizer:
                    jnp.asarray(tpp0), np.True_)
         return m, tab
 
+    def _carry_table_rows(self, P: int) -> int:
+        """Row count of the pool-table carry arrays for this optimizer's
+        mesh shape: the sharded tables pad P up to a multiple of the mesh
+        so every device owns an equal block.  A carried table whose rows
+        don't match (mesh size changed, or single↔sharded crossover with
+        P not a multiple) is dropped — cold rebuild, not a shape error."""
+        cfg = self.config
+        if self.mesh is not None and cfg.shard_tables:
+            nd = int(self.mesh.devices.size)
+            return nd * (-(-P // nd))
+        return P
+
     def _export_carry(self, carry, m, ctx, tab, post_table_touched):
         """Retain this plan's end state for the next warm start."""
         if m is None:
@@ -3025,7 +3245,7 @@ class TpuGoalOptimizer:
         carry.assignment = ctx.assignment.copy()
         carry.leader_slot = ctx.leader_slot.copy()
         carry.had_must_move = np.any(ctx.replica_offline, axis=1)
-        if tab is not None and bool(tab[3]):
+        if tab is not None and bool(tab[3]) and not tab[0].is_deleted():
             carry.tables = (tab[0], tab[1])
             pending = mesh_budget.fetch(
                 tab[2], fn="analyzer.carry_fetch").copy()
@@ -3234,36 +3454,56 @@ class TpuGoalOptimizer:
             # each call returns its end-of-call tables + touched set, and
             # the next call's first repool refreshes only those rows.  A
             # warm start seeds them from the previous PLAN's carry with the
-            # delta's dirty rows pre-marked; cold runs start invalid (the
-            # first repool is a full rebuild, exactly as before).
+            # delta's dirty rows pre-marked; cold runs pass None and the
+            # scan entry creates placed (mesh: sharded) zeros with
+            # valid=False — the first repool is a full rebuild, exactly as
+            # before.
+            #
+            # Donation discipline (donate_carry): a model/table generation
+            # is DEAD the moment a call is dispatched on it — XLA reuses
+            # its buffers for the call's outputs.  ``m_live`` therefore
+            # tracks the newest never-donated model in the chain (the
+            # youngest dispatched call's output): every site that needs a
+            # readable model after the loop — rejection resync, polish
+            # resync, carry export — goes through it, and each of those
+            # sites resyncs mutable state from the live context first, so
+            # a speculative m_live is as good as a validated one.
+            m_live = m
             if tab is None:
-                P_ = ctx.num_partitions
-                tab = (
-                    jnp.zeros((P_, ctx.max_rf), jnp.float32),
-                    jnp.zeros((P_, ctx.max_rf), jnp.float32),
-                    jnp.zeros(P_, bool), np.False_,
-                )
+                # built here — not lazily in the scan entry — so the zeros
+                # land before any kernel-budget capture window opens and
+                # the traced calls keep the steady-state transfer profile
+                tab = scan_fn.cold_tables(m)
 
-            def dispatch_ahead(tip_model) -> None:
+            def dispatch_ahead(tip_model, tip_tab) -> None:
                 # enqueue-only (JAX async dispatch): the device chains the
                 # speculative call onto its predecessor's outputs while the
-                # host goes on to fetch/recheck the oldest result
+                # host goes on to fetch/recheck the oldest result.  Each
+                # speculative call consumes its OWN predecessor's tables —
+                # the popped call's tab_new rides in as tip_tab — so the
+                # (model, tables) pair is always one consistent generation
+                # (passing the host's older ``tab`` here would pair call
+                # k+1's model with call k-1's tables: invisible while the
+                # incremental repool is compiled out at small P, wrong —
+                # and, donated, deleted — at sharded scale).
+                nonlocal m_live
                 while (
                     len(inflight) < depth
                     and n_calls + len(inflight) < calls_budget
                 ):
                     if inflight:
-                        tip, tip_tab = (
+                        tip, ttab = (
                             inflight[-1][1],
                             inflight[-1][2] + (np.True_,),
                         )
                     else:
-                        tip, tip_tab = tip_model, tab
+                        tip, ttab = tip_model, tip_tab
                     with tracing.span("analyzer.dispatch_ahead"):
                         inflight.append(
                             scan_fn(tip, ca, np.int32(cfg.steps_per_call),
-                                    tip_tab)
+                                    ttab)
                         )
+                    m_live = inflight[-1][1]
 
             while n_calls < calls_budget:
                 if budget_exhausted():
@@ -3318,6 +3558,7 @@ class TpuGoalOptimizer:
                         # window (dsp.block is a no-op with spans off)
                         kernel_budget.CAPTURE.block((packed, m_new,
                                                      tab_new))
+                    m_live = m_new
                 n_calls += 1
                 evaluator.round_index = n_calls
                 if t_cap is not None:
@@ -3332,7 +3573,7 @@ class TpuGoalOptimizer:
                     # the steady-state production case) must not pay a
                     # wasted device call for the pipeline they cannot use
                     if n_calls >= 2:
-                        dispatch_ahead(m_new)
+                        dispatch_ahead(m_new, tab_new + (np.True_,))
                     with tracing.device_span("analyzer.fetch_wait") as dsp:
                         dsp.block(packed)
                 with tracing.span("analyzer.fetch"):
@@ -3406,12 +3647,24 @@ class TpuGoalOptimizer:
                     # the live context before the next call; speculative
                     # calls ran on that stale state and are discarded, and
                     # so are the row tables (computed against the rejected
-                    # placement — the next call rebuilds from scratch)
+                    # placement — the next direct call passes tables=None
+                    # and rebuilds from cold zeros).  The resync seeds from
+                    # m_live, the only model guaranteed undonated here: the
+                    # mutable fields all come from ctx, so a speculative
+                    # seed resyncs to the same model a validated one would.
                     inflight.clear()
-                    tab = (tab[0], tab[1],
-                           jnp.zeros(ctx.num_partitions, bool), np.False_)
                     with tracing.device_span("analyzer.resync") as dsp:
-                        m = dsp.block(_resync_device_model(m, ctx))
+                        m = dsp.block(_resync_device_model(m_live, ctx))
+                    m_live = m
+                    tab = scan_fn.cold_tables(m)
+            # past the loop the host-visible (m, tab) can be one donated
+            # generation stale (every dispatch consumed its inputs);
+            # m_live is the youngest call's undonated output, and every
+            # consumer below — polish resync, swap repair, carry export —
+            # resyncs mutable state from the live context first, so a
+            # speculative live model substitutes exactly.  A donated tab
+            # exports as no table carry (the is_deleted guard).
+            m = m_live
             LOG.info(
                 "resident search: %d device calls, %d actions committed, "
                 "%d rejected", n_calls, n_committed, n_rejected,

@@ -207,6 +207,8 @@ def _tpu_search_config(cfg: CruiseControlConfig):
         polish_rounds=cfg.get_int("tpu.search.polish.rounds"),
         topk_mode=cfg.get("tpu.search.topk.mode"),
         selection_rows=cfg.get_int("tpu.search.selection.rows"),
+        shard_tables=cfg.get_boolean("tpu.search.shard.tables"),
+        donate_carry=cfg.get_boolean("tpu.search.shard.donate"),
     )
 
 

@@ -1029,6 +1029,20 @@ def default_config_def() -> ConfigDef:
              "'approx' = TPU PartialReduce approximate top-k (recall "
              "~0.95; exact fallback off-TPU), 'exact' = full selection "
              "network.", one_of("approx", "exact"), G)
+    d.define("tpu.search.shard.tables", ConfigType.BOOLEAN, True,
+             Importance.LOW, "Shard the [P, S] pool row tables and their "
+             "priority build across the search mesh (each device rebuilds "
+             "only its 1/n partition block; selection runs replicated on "
+             "the all_gathered priorities, so plans stay bit-identical to "
+             "single-device).  Off = the pre-round-20 fully replicated "
+             "build — the A/B lever for the sharded_scaling bench gate.",
+             None, G)
+    d.define("tpu.search.shard.donate", ConfigType.BOOLEAN, True,
+             Importance.LOW, "Donate the scan call's carry buffers (device "
+             "model + pool-table carry) so XLA aliases each call's updated "
+             "outputs into its inputs' storage instead of holding two "
+             "generations live.  Off = keep inputs alive — the A/B lever "
+             "for live-bytes measurement.", None, G)
 
     # framework-specific: structured tracing spans + /metrics exposition
     # (telemetry/).  The upstream analog is the always-on Dropwizard

@@ -78,6 +78,9 @@ from cruise_control_tpu.devtools.lint.rules_profiler import (
 from cruise_control_tpu.devtools.lint.rules_release import ReleaseSafetyRule
 from cruise_control_tpu.devtools.lint.rules_retry import RetryDisciplineRule
 from cruise_control_tpu.devtools.lint.rules_schema import JournalSchemaRule
+from cruise_control_tpu.devtools.lint.rules_sharding import (
+    ShardingDisciplineRule,
+)
 from cruise_control_tpu.devtools.lint.rules_transfer import (
     TransferDisciplineRule,
 )
@@ -110,6 +113,7 @@ RULES = {
         ProfilerDisciplineRule(),
         FencedBackendDisciplineRule(),
         TransferDisciplineRule(),
+        ShardingDisciplineRule(),
         LockInstrumentationRule(),
         LockOrderRule(),
         BlockingUnderLockRule(),

@@ -1,0 +1,110 @@
+"""sharding-discipline — device uploads in mesh-enabled modules state
+their placement.
+
+Round 20 sharded the search's [P, S] pool row tables across the mesh
+(``NamedSharding`` over the search axis) after the mesh observatory
+measured the cost of NOT doing so: every upload without an explicit
+sharding lands fully replicated, and ``MESH_BUDGET_r17``'s
+``busy_scaling +213.5 s`` was exactly that bug class at work — each lane
+silently redoing near-full work on replicated state.  The code now
+places its carry arrays explicitly; this rule keeps the next upload
+honest.
+
+Findings, inside the mesh-enabled modules (``ops/`` wholesale, plus
+``models/builder.py`` — the device-model upload — and
+``analyzer/tpu_optimizer.py`` — the search engine): a call resolving to
+the ``device_put`` family — ``jax.device_put``, the ledger's
+``mesh_budget.device_put``, or a direct-name import of either — with no
+placement: fewer than two positional args and no
+``device=``/``sharding=`` keyword (a literal ``device=None`` counts as
+no placement).  Such an upload commits to the default single device and
+replicates on first collective use; under a mesh that is the silent
+full replication this round deleted.
+
+Fix: pass the intended ``NamedSharding`` (partitioned or an explicit
+``PartitionSpec()`` for deliberate replication), or suppress with a
+reviewed ``# cclint: disable=sharding-discipline -- reason`` where
+single-device placement is the point.  Evaluated over the phase-1
+summaries (no re-parse).
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import List, Set
+
+from cruise_control_tpu.devtools.lint.findings import Finding
+
+RULE_ID = "sharding-discipline"
+
+#: keywords that state a placement; a literal None value does not count
+_PLACEMENT_KWARGS = frozenset(("device", "sharding", "dst_sharding"))
+
+#: modules whose arrays ride the search mesh: uploads here decide
+#: replicated-vs-partitioned layout for every lane
+_MESH_DIRS = ("ops",)
+_MESH_FILES = (
+    ("models", "builder.py"),
+    ("analyzer", "tpu_optimizer.py"),
+)
+
+#: modules providing a direct-name ``device_put`` to track through
+#: ``from ... import device_put`` aliases
+_PUT_HOMES = frozenset(
+    ("jax", "cruise_control_tpu.telemetry.mesh_budget"))
+
+
+def _mesh_scoped(path: str) -> bool:
+    parts = pathlib.PurePath(path).parts
+    if len(parts) >= 2 and parts[-2] in _MESH_DIRS:
+        return True
+    return parts[-2:] in [tuple(sfx) for sfx in _MESH_FILES]
+
+
+class ShardingDisciplineRule:
+    id = RULE_ID
+    summary = ("device upload without an explicit sharding in a "
+               "mesh-enabled module (ops/, models/builder.py, "
+               "analyzer/tpu_optimizer.py) — a device_put with no "
+               "device/sharding lands fully replicated on the mesh, the "
+               "busy_scaling bug class MESH_BUDGET_r17 measured; pass a "
+               "NamedSharding (PartitionSpec() when replication is "
+               "deliberate) or add a reviewed disable comment")
+    project_rule = True
+
+    def check_project(self, project) -> List[Finding]:
+        findings: List[Finding] = []
+        for s in project.summaries:
+            if not _mesh_scoped(s.path):
+                continue
+            direct_put: Set[str] = set()
+            for _level, from_mod, name, alias in s.imports:
+                if from_mod in _PUT_HOMES and name == "device_put":
+                    direct_put.add(alias)
+            for fn in s.functions.values():
+                for call in fn.calls:
+                    _head, _, tail = call.callee.rpartition(".")
+                    if not (tail == "device_put"
+                            or call.callee in direct_put):
+                        continue
+                    if call.nargs >= 2:
+                        continue  # positional placement
+                    placed = (set(call.kwargs) - set(call.none_kwargs)) \
+                        & _PLACEMENT_KWARGS
+                    if placed:
+                        continue
+                    findings.append(Finding(
+                        path=s.path, line=call.lineno, rule=self.id,
+                        message=(
+                            f"{call.callee}() in "
+                            f"{fn.name or '<module>'} uploads without an "
+                            "explicit sharding — on a search mesh this "
+                            "array lands fully replicated (the "
+                            "busy_scaling loss MESH_BUDGET_r17 measured); "
+                            "pass device=NamedSharding(mesh, spec) — "
+                            "PartitionSpec() if replication is deliberate "
+                            "— or add a reviewed "
+                            "# cclint: disable=sharding-discipline"
+                        ),
+                    ))
+        return findings

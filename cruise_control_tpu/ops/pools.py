@@ -93,6 +93,21 @@ def pool_row_tables(m) -> Tuple[jax.Array, jax.Array]:
     )
 
 
+def pool_row_tables_rows(m, rows) -> Tuple[jax.Array, jax.Array]:
+    """Row tables for an explicit partition-row slice → ([N, S], [N, S]).
+
+    The sharded search's per-device rebuild: each device recomputes ONLY
+    its 1/n partition block (``rows`` = its global row ids, clamped at the
+    edge), so the [P, S, S]-scale rack-duplicate scan — the rebuild's
+    dominant term — genuinely shrinks with mesh size.  Row-for-row
+    bit-identical to :func:`pool_row_tables` (same ``_row_tables``
+    arithmetic on the sliced inputs)."""
+    return _row_tables(
+        m, m.assignment[rows], m.leader_slot[rows], m.leader_load[rows],
+        m.follower_load[rows], m.must_move[rows], m.excluded[rows],
+    )
+
+
 def pool_row_tables_update(
     m, size, base, touched_p, rows_budget: int
 ) -> Tuple[jax.Array, jax.Array]:
@@ -115,15 +130,37 @@ def pool_row_tables_update(
     return size, base
 
 
-def pool_prio(m, ca, size, base) -> jax.Array:
-    """[P, S] move-pool priority from fresh broker terms + stored row
-    tables.
+def pool_row_tables_update_rows(
+    m, size, base, touched_l, rows, rows_budget: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Shard-local twin of :func:`pool_row_tables_update`.
 
-    Broker ranking: hard overage ≫ above-average stress, plus a
-    surplus-matched size term (peaked where moving the replica brings its
-    broker to target — the water-filling shape the budgeted matcher
-    commits on).  ``base`` carries the repair bonuses and -inf for
-    ineligible rows (the -inf propagates through the sum)."""
+    ``size``/``base``/``touched_l`` cover ONE device's [N, S] partition
+    block; ``rows`` maps local index → global partition row.  The caller's
+    global guarantee ``sum(touched_global) <= rows_budget`` bounds every
+    local touched count too, so refreshing up to ``min(N, rows_budget)``
+    local rows covers every touched row of the block and the result equals
+    the block's full recompute bit-for-bit — the diet stays shard-local
+    (no cross-device traffic; only the [P]-bool touched set is
+    replicated)."""
+    N = touched_l.shape[0]
+    RB = min(N, rows_budget)
+    order = jnp.argsort(~touched_l)               # stable: touched first
+    lidx = order[:RB]
+    rok = touched_l[lidx]
+    gidx = rows[lidx]
+    size_r, base_r = _row_tables(
+        m, m.assignment[gidx], m.leader_slot[gidx], m.leader_load[gidx],
+        m.follower_load[gidx], m.must_move[gidx], m.excluded[gidx],
+    )
+    size = size.at[lidx].set(jnp.where(rok[:, None], size_r, size[lidx]))
+    base = base.at[lidx].set(jnp.where(rok[:, None], base_r, base[lidx]))
+    return size, base
+
+
+def pool_broker_terms(m, ca) -> jax.Array:
+    """[B, 2] broker terms of the move-pool priority (overage, stress) —
+    [B]-scale to compute, so the sharded build keeps them replicated."""
     cap = jnp.maximum(m.capacity, 1e-9)
     util = m.broker_load / cap                                   # [B, R]
     overage = jnp.sum(jnp.maximum(util - ca["util_upper"], 0.0), axis=1)
@@ -141,8 +178,36 @@ def pool_prio(m, ca, size, base) -> jax.Array:
     # ONE [P, S, 2] row-gather for both broker terms (scalar gathers over
     # the P·S axis are latency-bound — the round-4 btab packing, minus the
     # rack column the stored tables made unnecessary)
-    btab = jnp.stack([overage, stress], axis=1)                  # [B, 2]
-    g2 = btab[jnp.clip(m.assignment, 0)]                         # [P, S, 2]
+    return jnp.stack([overage, stress], axis=1)                  # [B, 2]
+
+
+def _prio_combine(g2, size, base) -> jax.Array:
     surplus = g2[..., 1]
     fit = surplus - jnp.abs(size - surplus)
     return g2[..., 0] * 10.0 + surplus * 2.0 + fit + base
+
+
+def pool_prio(m, ca, size, base) -> jax.Array:
+    """[P, S] move-pool priority from fresh broker terms + stored row
+    tables.
+
+    Broker ranking: hard overage ≫ above-average stress, plus a
+    surplus-matched size term (peaked where moving the replica brings its
+    broker to target — the water-filling shape the budgeted matcher
+    commits on).  ``base`` carries the repair bonuses and -inf for
+    ineligible rows (the -inf propagates through the sum)."""
+    btab = pool_broker_terms(m, ca)
+    g2 = btab[jnp.clip(m.assignment, 0)]                         # [P, S, 2]
+    return _prio_combine(g2, size, base)
+
+
+def pool_prio_rows(m, ca, size, base, rows) -> jax.Array:
+    """[N, S] move-pool priority for an explicit partition-row slice —
+    the sharded build's per-device slab.  ``size``/``base`` are the local
+    block tables for the same ``rows``.  Elementwise identical to the
+    matching rows of :func:`pool_prio` (same broker terms, same combine),
+    so the all_gathered priority is bit-identical to the replicated one
+    and the downstream top-k selection cannot diverge."""
+    btab = pool_broker_terms(m, ca)
+    g2 = btab[jnp.clip(m.assignment[rows], 0)]                   # [N, S, 2]
+    return _prio_combine(g2, size, base)

@@ -74,7 +74,7 @@ class ExecutableCost:
     """Cost/memory analysis of one compiled executable."""
 
     __slots__ = ("signature", "flops", "bytes_accessed", "arg_bytes",
-                 "output_bytes", "temp_bytes", "code_bytes",
+                 "output_bytes", "temp_bytes", "code_bytes", "alias_bytes",
                  "captured_unix", "num_devices", "per_device")
 
     def __init__(self, signature: tuple):
@@ -85,6 +85,9 @@ class ExecutableCost:
         self.output_bytes = 0
         self.temp_bytes = 0
         self.code_bytes = 0
+        #: outputs aliased into donated input buffers — the peak-memory
+        #: saving carry donation buys (0 without donate_argnums)
+        self.alias_bytes = 0
         self.captured_unix = 0.0
         #: addressable devices at capture time (an executable compiled
         #: under a mesh spans all of them)
@@ -101,6 +104,7 @@ class ExecutableCost:
             "argBytes": self.arg_bytes,
             "outputBytes": self.output_bytes,
             "tempBytes": self.temp_bytes,
+            "aliasBytes": self.alias_bytes,
             "codeBytes": self.code_bytes,
             "devices": self.num_devices,
         }
@@ -243,6 +247,8 @@ class DeviceCostMonitor:
                     getattr(mem, "output_size_in_bytes", 0) or 0)
                 cost.temp_bytes = int(
                     getattr(mem, "temp_size_in_bytes", 0) or 0)
+                cost.alias_bytes = int(
+                    getattr(mem, "alias_size_in_bytes", 0) or 0)
                 cost.code_bytes = int(
                     getattr(mem, "generated_code_size_in_bytes", 0) or 0)
             cost.captured_unix = round(time.time(), 3)
@@ -290,6 +296,8 @@ class DeviceCostMonitor:
                         c.output_bytes for c in per.values())
                     entry["tempBytes"] = max(
                         c.temp_bytes for c in per.values())
+                    entry["aliasBytes"] = max(
+                        c.alias_bytes for c in per.values())
                     if detail:
                         entry["perExecutable"] = [
                             {
@@ -348,6 +356,10 @@ class DeviceCostMonitor:
              "Output buffer bytes per call"),
             ("cc_device_hbm_temp_bytes", "tempBytes",
              "Temp (scratch) HBM bytes per call"),
+            ("cc_device_hbm_alias_bytes", "aliasBytes",
+             "Output bytes aliased into donated input buffers per call "
+             "(the peak-HBM saving of scan-carry donation; 0 = nothing "
+             "donated)"),
             ("cc_device_call_rate_per_s", "callRatePerS",
              "Dispatched calls per second (60s window)"),
         ):

@@ -333,10 +333,33 @@ def _mesh_budget_artifacts():
     return [art]
 
 
+def _sharded_scaling_artifacts():
+    """Live producer at micro scale — the full three-leg matrix at
+    10b/80p plus the placement leg at 24b/600p (the committed r20
+    artifact runs the advertised scales; the contract is
+    shape-independent) — AND the committed artifact itself, so the file
+    postmortem tooling reads is held to the same contract."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(
+        pathlib.Path(__file__).parent.parent / "benchmarks"))
+    from sharded_large_dryrun import measure_scaling
+
+    live = measure_scaling(devices=8, seed=13, scales=[(10, 80, 4)],
+                           placement=(24, 600, 6), replicated_max_p=80)
+    assert live["headline"]["ok"]
+    committed = json.loads(
+        (pathlib.Path(__file__).parent.parent / "benchmarks"
+         / "SHARDED_SCALING_r20.json").read_text())
+    return [live, committed]
+
+
 @pytest.mark.parametrize("producer", ["phase-profile", "flight-recorder",
                                       "events", "scenarios", "checkpoint",
                                       "slo", "trace", "soak",
                                       "kernel-budget", "mesh-budget",
+                                      "sharded-scaling",
                                       "whatif", "host-profile",
                                       "critical-path"])
 def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
@@ -364,6 +387,9 @@ def test_artifact_producers_match_checked_in_contract(producer, tmp_path):
     elif producer == "mesh-budget":
         arts = _mesh_budget_artifacts()
         schema = SCHEMAS["cc-tpu-mesh-budget/1"]
+    elif producer == "sharded-scaling":
+        arts = _sharded_scaling_artifacts()
+        schema = SCHEMAS["cc-tpu-sharded-scaling/1"]
     elif producer == "whatif":
         arts = _whatif_artifact()
         schema = SCHEMAS["cc-tpu-whatif/1"]

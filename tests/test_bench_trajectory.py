@@ -49,6 +49,8 @@ def test_latest_round_holds_every_gate():
                 "replan_settle_speedup", "soak_smoke"]
     if latest >= 19:
         required.append("lock_witness_overhead_pct")
+    if latest >= 18:
+        required.append("sharded_scaling")
     for gate in required:
         assert gate in verdicts, f"round r{latest} lost the {gate} gate"
         value, ok = verdicts[gate]

@@ -1025,6 +1025,80 @@ PACKAGE_FIXTURES = {
             },
         ],
     },
+    "sharding-discipline": {
+        "positive": [
+            # an unplaced upload in a mesh-enabled module (ops/)
+            {
+                "pkg/ops/__init__.py": "",
+                "pkg/ops/pools.py": (
+                    "import jax\n"
+                    "def upload_tables(size):\n"
+                    "    return jax.device_put(size)\n"
+                ),
+            },
+            # the ledger route is still an upload: placement required
+            # in mesh scope even through mesh_budget.device_put
+            {
+                "pkg/models/__init__.py": "",
+                "pkg/models/builder.py": (
+                    "from pkg.telemetry import mesh_budget\n"
+                    "def build(arrays):\n"
+                    "    return mesh_budget.device_put(arrays, "
+                    "fn='models.upload')\n"
+                ),
+                "pkg/telemetry/__init__.py": "",
+                "pkg/telemetry/mesh_budget.py": (
+                    "def device_put(x, device=None, fn='unlabeled'):\n"
+                    "    return x\n"
+                ),
+            },
+            # a literal device=None states nothing — still unplaced
+            {
+                "pkg/analyzer/__init__.py": "",
+                "pkg/analyzer/tpu_optimizer.py": (
+                    "from jax import device_put\n"
+                    "def upload(m):\n"
+                    "    return device_put(m, device=None)\n"
+                ),
+            },
+        ],
+        "negative": [
+            # explicit NamedSharding placement (kwarg or positional)
+            {
+                "pkg/ops/__init__.py": "",
+                "pkg/ops/pools.py": (
+                    "import jax\n"
+                    "from jax.sharding import NamedSharding, "
+                    "PartitionSpec\n"
+                    "def upload_tables(size, mesh, axis):\n"
+                    "    tsh = NamedSharding(mesh, PartitionSpec(axis))\n"
+                    "    a = jax.device_put(size, tsh)\n"
+                    "    return jax.device_put(size, device=tsh)\n"
+                ),
+            },
+            # outside the mesh-enabled modules the rule stays silent
+            # (transfer-discipline owns raw-copy hygiene there)
+            {
+                "pkg/server/__init__.py": "",
+                "pkg/server/handler.py": (
+                    "import jax\n"
+                    "def upload(x):\n"
+                    "    return jax.device_put(x)\n"
+                ),
+            },
+            # reviewed suppression: deliberate single-device placement
+            {
+                "pkg/ops/__init__.py": "",
+                "pkg/ops/grid.py": (
+                    "import jax\n"
+                    "def upload(x):\n"
+                    "    return jax.device_put(x)"
+                    "  # cclint: disable=sharding-discipline -- "
+                    "single-device micro-bench\n"
+                ),
+            },
+        ],
+    },
     "lock-instrumentation-discipline": {
         "positive": [
             # raw Lock on a serving-path coordination point (hot dir)
@@ -1866,6 +1940,22 @@ MUTATIONS = {
         "cruise_control_tpu/analyzer/tpu_optimizer.py",
         "        ca = {k: jnp.asarray(v) for k, v in can.items()}",
         "        ca = {k: jax.device_put(v) for k, v in can.items()}",
+    ),
+    # ISSUE 20 satellite: the sharded pool-table carry's cold upload
+    # rewritten as an unplaced device_put in the scan factory — the
+    # exact silent-replication hole the round-20 sharding deleted
+    # (every lane would hold the full [Pg, S] tables again) — must be
+    # caught at the planted site
+    "sharding-discipline-optimizer": (
+        "sharding-discipline",
+        "cruise_control_tpu/analyzer/tpu_optimizer.py",
+        "        return (jnp.zeros((rows, S), jnp.float32, device=tsh),\n"
+        "                jnp.zeros((rows, S), jnp.float32, device=tsh),\n"
+        "                jnp.zeros(P, bool, device=rsh), np.False_)",
+        "        return (jax.device_put("
+        "jnp.zeros((rows, S), jnp.float32)),\n"
+        "                jnp.zeros((rows, S), jnp.float32, device=tsh),\n"
+        "                jnp.zeros(P, bool, device=rsh), np.False_)",
     ),
     # ISSUE 19 satellite: a real lock inversion planted in the facade —
     # cache-lock outside, single-flight inside, the exact opposite of

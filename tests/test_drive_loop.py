@@ -189,7 +189,10 @@ def test_incremental_repool_scan_equivalence():
             repool_incremental=incr, repool_rows_budget=budget, **base
         )
         scan_fn = T._cached_scan_fn(cfg, K, D, cfg.steps_per_call, None)
-        packed, _, _tab = scan_fn(m, ca, np.int32(cfg.steps_per_call))
+        # donate_carry consumes the input model — fresh (bit-identical)
+        # upload per variant so every variant starts from the same state
+        packed, _, _tab = scan_fn(
+            opt._device_model(ctx), ca, np.int32(cfg.steps_per_call))
         arr = np.asarray(packed)
         res = T._fetch_scan_result(packed, cfg.steps_per_call)
         packs[(incr, budget)] = arr

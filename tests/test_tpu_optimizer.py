@@ -685,7 +685,9 @@ def test_anytime_budget_per_step_deadline():
     K, D = opt._pool_sizes(ctx.num_partitions, ctx.max_rf, ctx.num_brokers)
     scan_fn = T._cached_scan_fn(cfg, K, D, cfg.steps_per_call, None)
     for cap in (1, 7, cfg.steps_per_call):
-        packed, _, _tab = scan_fn(m, ca, jnp.asarray(cap, jnp.int32))
+        # donate_carry: a call consumes its input model, so thread the
+        # returned (undonated) model into the next capped call
+        packed, m, _tab = scan_fn(m, ca, jnp.asarray(cap, jnp.int32))
         diag = T._fetch_scan_result(packed, cfg.steps_per_call)[-1]
         assert 0 < diag["steps_run"] <= cap, (cap, diag["steps_run"])
     cache_size = getattr(scan_fn, "_cache_size", None)
